@@ -1,0 +1,50 @@
+"""Pure, clock-injectable core: identity, values, replicated state,
+reconciliation, and failure detection. No I/O, no concurrency — the seam
+that lets the asyncio socket backend and the JAX sim backend share one
+source of truth (SURVEY.md §7)."""
+
+from .cluster_state import ClusterState, Staleness, staleness_score
+from .config import Config, FailureDetectorConfig
+from .failure import BoundedWindow, FailureDetector, HeartbeatWindow
+from .identity import Address, NodeId
+from .kvstate import NodeState
+from .messages import (
+    Ack,
+    BadCluster,
+    Delta,
+    Digest,
+    KeyValueUpdate,
+    NodeDelta,
+    NodeDigest,
+    Packet,
+    Syn,
+    SynAck,
+)
+from .values import KeyStatus, VersionedValue, VersionStatusEnum
+
+__all__ = (
+    "Ack",
+    "Address",
+    "BadCluster",
+    "BoundedWindow",
+    "ClusterState",
+    "FailureDetector",
+    "HeartbeatWindow",
+    "Config",
+    "Delta",
+    "Digest",
+    "FailureDetectorConfig",
+    "KeyStatus",
+    "KeyValueUpdate",
+    "NodeDelta",
+    "NodeDigest",
+    "NodeId",
+    "NodeState",
+    "Packet",
+    "Staleness",
+    "Syn",
+    "SynAck",
+    "VersionStatusEnum",
+    "VersionedValue",
+    "staleness_score",
+)

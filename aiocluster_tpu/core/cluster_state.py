@@ -1,0 +1,190 @@
+"""Cluster-wide replicated state and ScuttleButt reconciliation.
+
+Parity: reference state.py:290-433 (``ClusterState``, ``staleness_score``).
+
+The interesting method is ``compute_partial_delta_respecting_mtu``: given a
+peer's digest, build the delta of everything the peer is missing, greedily
+packed under a byte MTU. Two deliberate improvements over the reference:
+
+1. **O(total kvs) packing.** The reference re-serialises the entire delta
+   protobuf after every appended key-value to test the MTU
+   (state.py:392-398) — quadratic in delta size. We account encoded sizes
+   incrementally with exact proto3 arithmetic (wire/sizes.py), so packing
+   is linear while selecting the *same* key-values byte-for-byte (the
+   ``max_version`` field is reserved in the accounting regardless of
+   whether it is finally emitted).
+2. **No lost updates on truncation.** The reference always stamps the
+   delta with the owner's full ``max_version`` (state.py:389); a receiver
+   of an MTU-truncated delta then advertises versions it never received
+   and the gap is never retransmitted. We only stamp ``max_version`` when
+   every stale key-value fit — the chitchat-correct rule — so truncated
+   ranges are re-requested on the next round.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from dataclasses import dataclass
+from datetime import datetime, timedelta
+
+from ..utils.clock import utc_now
+from .identity import Address, NodeId
+from .kvstate import KeyChangeFn, NodeState
+from .messages import Delta, Digest, KeyValueUpdate, NodeDelta
+
+
+@dataclass(frozen=True, slots=True)
+class Staleness:
+    """How far behind a peer is on one node's keyspace."""
+
+    is_unknown: bool
+    max_version: int
+    num_stale_key_values: int
+
+
+def staleness_score(node_state: NodeState, floor_version: int) -> Staleness | None:
+    """None when the peer is up to date; otherwise a score (parity:
+    reference state.py:425-433)."""
+    if node_state.max_version <= floor_version:
+        return None
+    is_unknown = floor_version == 0
+    if is_unknown:
+        num_stale = len(node_state.key_values)
+    else:
+        num_stale = sum(1 for _ in node_state.stale_key_values(floor_version))
+    return Staleness(is_unknown, node_state.max_version, num_stale)
+
+
+class ClusterState:
+    """All node keyspaces known to this process, keyed by NodeId."""
+
+    def __init__(self, seed_addrs: set[Address] | None = None) -> None:
+        self._node_states: dict[NodeId, NodeState] = {}
+        self._seed_addrs: set[Address] = seed_addrs or set()
+
+    # -- membership -----------------------------------------------------------
+
+    def node_state(self, node_id: NodeId) -> NodeState | None:
+        return self._node_states.get(node_id)
+
+    def node_state_or_default(self, node_id: NodeId) -> NodeState:
+        return self._node_states.setdefault(node_id, NodeState(node_id))
+
+    def nodes(self) -> Sequence[NodeId]:
+        return tuple(self._node_states)
+
+    def seed_addrs(self) -> Sequence[Address]:
+        return tuple(self._seed_addrs)
+
+    def remove_node(self, node_id: NodeId) -> None:
+        self._node_states.pop(node_id, None)
+
+    # -- reconciliation -------------------------------------------------------
+
+    def apply_delta(
+        self,
+        delta: Delta,
+        ts: datetime | None = None,
+        on_key_change: KeyChangeFn | None = None,
+    ) -> None:
+        now = ts if ts is not None else utc_now()
+        for nd in delta.node_deltas:
+            ns = self.node_state_or_default(nd.node_id)
+            ns.apply_delta(nd, ts=now, on_key_change=on_key_change)
+
+    def compute_digest(self, scheduled_for_deletion: set[NodeId]) -> Digest:
+        """Digest of every known node except those scheduled for deletion
+        (excluding them stops their state re-propagating before GC)."""
+        return Digest(
+            {
+                node_id: ns.digest()
+                for node_id, ns in self._node_states.items()
+                if node_id not in scheduled_for_deletion
+            }
+        )
+
+    def gc_marked_for_deletion(self, grace_period: timedelta) -> None:
+        for ns in self._node_states.values():
+            ns.gc_marked_for_deletion(grace_period)
+
+    def compute_partial_delta_respecting_mtu(
+        self,
+        digest: Digest,
+        mtu: int,
+        scheduled_for_deletion: set[NodeId],
+        size_model: Callable[..., object] | None = None,
+    ) -> Delta:
+        """Build the delta a peer (described by ``digest``) is missing,
+        packed under ``mtu`` encoded bytes.
+
+        For each node the peer is stale on, key-values above the peer's
+        floor version are sent in increasing version order, so a replica's
+        knowledge of any owner is always a *version-prefix* of the owner's
+        history — the invariant the TPU sim backend exploits by collapsing
+        per-replica knowledge to a single watermark integer.
+        """
+        if size_model is None:
+            from ..wire.sizes import DeltaSizeModel
+
+            size_model = DeltaSizeModel
+        sizes = size_model()
+
+        candidates: list[tuple[NodeState, int]] = []
+        for node_id, ns in self._node_states.items():
+            if node_id in scheduled_for_deletion:
+                continue
+            peer = digest.node_digests.get(node_id)
+            peer_gc = peer.last_gc_version if peer is not None else 0
+            peer_max = peer.max_version if peer is not None else 0
+            if ns.max_version <= peer_max:
+                continue
+            # If the peer is so far behind that our GC watermark has passed
+            # everything it knows, restart it from scratch (version floor 0).
+            reset = peer_gc < ns.last_gc_version and peer_max < ns.last_gc_version
+            floor = 0 if reset else peer_max
+            # ns.max_version > peer_max >= floor always holds here, so the
+            # node is stale by construction (no need to score it).
+            candidates.append((ns, floor))
+
+        node_deltas: list[NodeDelta] = []
+        for ns, floor in candidates:
+            stale = sorted(
+                (
+                    KeyValueUpdate(k, vv.value, vv.version, vv.status)
+                    for k, vv in ns.stale_key_values(floor)
+                ),
+                key=lambda kv: kv.version,
+            )
+            if not stale:
+                continue
+
+            # Reserve max_version bytes up front so packing decisions match
+            # the reference's accounting; emit it only if nothing truncates.
+            body = sizes.node_delta_base(ns.node, floor, ns.last_gc_version,
+                                         ns.max_version)
+            selected: list[KeyValueUpdate] = []
+            truncated = False
+            for kv in stale:
+                grown = body + sizes.kv_increment(kv)
+                if sizes.delta_total_with(grown) > mtu:
+                    truncated = True
+                    break
+                body = grown
+                selected.append(kv)
+
+            if selected:
+                node_deltas.append(
+                    NodeDelta(
+                        node_id=ns.node,
+                        from_version_excluded=floor,
+                        last_gc_version=ns.last_gc_version,
+                        key_values=selected,
+                        max_version=None if truncated else ns.max_version,
+                    )
+                )
+                sizes.commit(body)
+
+            if sizes.total() >= mtu:
+                break
+
+        return Delta(node_deltas=node_deltas)

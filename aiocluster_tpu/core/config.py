@@ -1,0 +1,56 @@
+"""Cluster configuration.
+
+Parity: reference entities.py:85-115. Field names and defaults are kept
+identical so code written against the reference's ``Config`` ports over
+unchanged. New fields beyond the reference are documented inline.
+"""
+
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass, field
+from datetime import timedelta
+
+from .identity import Address, NodeId
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class FailureDetectorConfig:
+    """Phi-accrual tuning (reference entities.py:85-91; the ``phi_threshhold``
+    spelling is preserved for API compatibility)."""
+
+    phi_threshhold: float = 8.0
+    sampling_window_size: int = 1_000
+    max_interval: timedelta = timedelta(seconds=10)
+    initial_interval: timedelta = timedelta(seconds=5)
+    dead_node_grace_period: timedelta = timedelta(hours=24)
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class Config:
+    """Runtime configuration for one cluster node."""
+
+    node_id: NodeId
+    cluster_id: str = "default-cluster"
+    gossip_interval: float = 1.0  # seconds between gossip rounds
+    gossip_count: int = 3  # live peers contacted per round
+    seed_nodes: list[Address] = field(default_factory=list)
+    marked_for_deletion_grace_period: int = 3600 * 2  # seconds
+    failure_detector: FailureDetectorConfig = field(
+        default_factory=FailureDetectorConfig,
+    )
+    max_payload_size: int = 65_507  # delta MTU in encoded bytes
+    connect_timeout: float = 3.0
+    read_timeout: float = 3.0
+    write_timeout: float = 3.0
+    max_concurrent_gossip: int = 32
+    hook_queue_maxsize: int = 10_000
+    drain_hooks_on_shutdown: bool = True
+    hook_shutdown_timeout: float = 5.0
+    tls_server_context: ssl.SSLContext | None = None
+    tls_client_context: ssl.SSLContext | None = None
+    tls_server_hostname: str | None = None
+    # New in aiocluster_tpu: fraction of gossip_interval used as random
+    # startup jitter so co-booted nodes desynchronise their rounds
+    # (the reference left this as a TODO, ticker.py:27-28).
+    gossip_jitter: float = 0.0

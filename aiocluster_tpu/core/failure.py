@@ -1,0 +1,198 @@
+"""Phi-accrual failure detection.
+
+Parity: reference failure_detector.py:12-162. The detector keeps, per peer,
+a bounded window of inter-heartbeat intervals and scores suspicion as
+
+    phi = elapsed_since_last_heartbeat / prior_weighted_mean_interval
+
+(the reference's simplification of the Hayashibara et al. log-CDF phi; the
+threshold default of 8.0 is calibrated for this ratio form). The mean is
+regularised toward a configured prior with weight ``PRIOR_WEIGHT`` so a
+freshly-seen node with few samples is not declared dead by noise.
+
+Lifecycle: phi > threshold flips a node to dead and resets its window (so a
+returning node must accumulate fresh evidence); dead for half the grace
+period ⇒ excluded from digests (stops re-propagation); dead for the full
+grace period ⇒ garbage-collected entirely. All methods take ``ts`` for
+deterministic time-travel tests.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timedelta
+
+from ..utils.clock import utc_now
+from .config import FailureDetectorConfig
+from .identity import NodeId
+
+__all__ = ("BoundedWindow", "FailureDetector", "HeartbeatWindow")
+
+PRIOR_WEIGHT = 5.0  # pseudo-samples of the prior interval (reference :23)
+
+
+class BoundedWindow:
+    """Fixed-capacity ring of float samples with an O(1) running sum.
+
+    Parity: reference BoundedArrayStats failure_detector.py:131-162.
+    """
+
+    __slots__ = ("_capacity", "_samples", "_next", "_sum", "_count")
+
+    def __init__(self, capacity: int) -> None:
+        if capacity <= 0:
+            raise ValueError("window capacity must be positive")
+        self._capacity = capacity
+        self._samples: list[float] = []
+        self._next = 0  # slot to overwrite once full
+        self._sum = 0.0
+        self._count = 0
+
+    def append(self, sample: float) -> None:
+        if self._count < self._capacity:
+            self._samples.append(sample)
+            self._count += 1
+        else:
+            self._sum -= self._samples[self._next]
+            self._samples[self._next] = sample
+            self._next = (self._next + 1) % self._capacity
+        self._sum += sample
+
+    def sum(self) -> float:
+        return self._sum
+
+    def clear(self) -> None:
+        self._samples.clear()
+        self._next = 0
+        self._sum = 0.0
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+
+class HeartbeatWindow:
+    """Inter-heartbeat sampling for one peer (reference SamplingWindow
+    failure_detector.py:12-53)."""
+
+    __slots__ = ("_intervals", "_last_heartbeat", "_max_interval", "_prior_mean")
+
+    def __init__(
+        self,
+        window_size: int,
+        max_interval: timedelta,
+        prior_interval: timedelta,
+    ) -> None:
+        self._intervals = BoundedWindow(window_size)
+        self._last_heartbeat: datetime | None = None
+        self._max_interval = max_interval
+        self._prior_mean = prior_interval.total_seconds()
+
+    def report_heartbeat(self, ts: datetime | None = None) -> None:
+        now = ts if ts is not None else utc_now()
+        if self._last_heartbeat is not None:
+            interval = now - self._last_heartbeat
+            # Gaps beyond max_interval are outages, not samples — admitting
+            # them would inflate the mean and mask real failures.
+            if interval <= self._max_interval:
+                self._intervals.append(interval.total_seconds())
+        self._last_heartbeat = now
+
+    def mean(self) -> float | None:
+        n = len(self._intervals)
+        if n == 0:
+            return None
+        return (self._intervals.sum() + PRIOR_WEIGHT * self._prior_mean) / (
+            n + PRIOR_WEIGHT
+        )
+
+    def phi(self, ts: datetime | None = None) -> float | None:
+        if self._last_heartbeat is None:
+            return None
+        mean = self.mean()
+        if mean is None:
+            return None
+        now = ts if ts is not None else utc_now()
+        elapsed = (now - self._last_heartbeat).total_seconds()
+        return elapsed / mean
+
+    def reset(self) -> None:
+        self._intervals.clear()
+
+
+class FailureDetector:
+    """Tracks live/dead sets for all peers plus two-stage dead-node GC."""
+
+    def __init__(self, config: FailureDetectorConfig) -> None:
+        self._config = config
+        self._windows: dict[NodeId, HeartbeatWindow] = {}
+        self._live: set[NodeId] = set()
+        self._dead: dict[NodeId, datetime] = {}  # node -> time of death
+
+    # -- observations ---------------------------------------------------------
+
+    def report_heartbeat(self, node_id: NodeId, ts: datetime | None = None) -> None:
+        self._window_for(node_id).report_heartbeat(ts=ts)
+
+    def phi(self, node_id: NodeId, ts: datetime | None = None) -> float | None:
+        window = self._windows.get(node_id)
+        return None if window is None else window.phi(ts=ts)
+
+    def _window_for(self, node_id: NodeId) -> HeartbeatWindow:
+        window = self._windows.get(node_id)
+        if window is None:
+            window = HeartbeatWindow(
+                self._config.sampling_window_size,
+                self._config.max_interval,
+                self._config.initial_interval,
+            )
+            self._windows[node_id] = window
+        return window
+
+    # -- liveness -------------------------------------------------------------
+
+    def live_nodes(self) -> list[NodeId]:
+        return list(self._live)
+
+    def dead_nodes(self) -> list[NodeId]:
+        return list(self._dead)
+
+    def update_node_liveness(self, node_id: NodeId, ts: datetime | None = None) -> None:
+        now = ts if ts is not None else utc_now()
+        phi = self.phi(node_id, ts=now)
+        alive = phi is not None and phi <= self._config.phi_threshhold
+        if alive:
+            self._live.add(node_id)
+            self._dead.pop(node_id, None)
+        else:
+            self._live.discard(node_id)
+            self._dead.setdefault(node_id, now)
+            window = self._windows.get(node_id)
+            if window is not None:
+                # A dead node must re-earn its liveness with fresh samples.
+                window.reset()
+
+    # -- dead-node lifecycle --------------------------------------------------
+
+    def scheduled_for_deletion_nodes(self, ts: datetime | None = None) -> list[NodeId]:
+        """Dead for half the grace period: excluded from digests so their
+        state stops re-propagating while still being individually GC-able."""
+        now = ts if ts is not None else utc_now()
+        half_grace = self._config.dead_node_grace_period / 2
+        return [
+            node_id
+            for node_id, died_at in self._dead.items()
+            if now >= died_at + half_grace
+        ]
+
+    def garbage_collect(self, ts: datetime | None = None) -> list[NodeId]:
+        """Dead for the full grace period: forget them entirely. Returns the
+        collected nodes so the caller can drop their cluster state too."""
+        now = ts if ts is not None else utc_now()
+        grace = self._config.dead_node_grace_period
+        collected = [
+            node_id for node_id, died_at in self._dead.items() if now >= died_at + grace
+        ]
+        for node_id in collected:
+            del self._dead[node_id]
+            self._windows.pop(node_id, None)
+        return collected

@@ -1,0 +1,28 @@
+"""Node identity.
+
+Parity: reference entities.py:52-82 (``Address``, ``NodeId``). A node is
+identified by a human name plus a ``generation_id`` that defaults to the boot
+monotonic clock, so a restarted node is a *new* cluster member and stale
+replicas of its old incarnation age out instead of shadowing fresh state.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+Address = tuple[str, int]
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class NodeId:
+    """Unique identity of one cluster member."""
+
+    name: str
+    generation_id: int = field(default_factory=time.monotonic_ns)
+    gossip_advertise_addr: Address = ("localhost", 7001)
+    tls_name: str | None = None
+
+    def long_name(self) -> str:
+        host, port = self.gossip_advertise_addr
+        return f"{self.name}-{self.generation_id}-{host}:{port}"

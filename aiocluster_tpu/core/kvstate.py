@@ -1,0 +1,240 @@
+"""One node's replicated keyspace.
+
+Parity: reference state.py:106-287 (``NodeState``). Semantics preserved:
+
+- Only the owner mutates its keyspace; replicas converge via deltas.
+- ``max_version`` is a per-owner monotonic counter; every local mutation
+  claims the next version.
+- Deletes are in-place tombstones (value cleared, version bumped) so the
+  deletion itself replicates; ``DELETE_AFTER_TTL`` keeps the value but
+  schedules GC eligibility.
+- ``last_gc_version`` is the GC watermark: once tombstones/TTL keys older
+  than the grace period are purged, the watermark advances and replicas
+  drop the same keys when they observe it in a delta.
+- A heartbeat's *first* observation only records it — one heartbeat is not
+  evidence of liveness (reference state.py:280-287).
+
+All time-dependent methods accept ``ts`` for deterministic tests.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Iterator
+from datetime import datetime, timedelta
+
+from ..utils.clock import utc_now
+from .identity import NodeId
+from .messages import NodeDelta, NodeDigest
+from .values import KeyStatus, VersionedValue
+
+KeyChangeFn = Callable[[NodeId, str, VersionedValue | None, VersionedValue], None]
+
+
+class NodeState:
+    """Versioned key-value state for a single node (owner or replica)."""
+
+    __slots__ = ("key_values", "heartbeat", "max_version", "last_gc_version", "node")
+
+    def __init__(
+        self,
+        node: NodeId,
+        heartbeat: int = 0,
+        key_values: dict[str, VersionedValue] | None = None,
+        max_version: int = 0,
+        last_gc_version: int = 0,
+    ) -> None:
+        self.node = node
+        self.heartbeat = heartbeat
+        self.key_values: dict[str, VersionedValue] = key_values or {}
+        self.max_version = max_version
+        self.last_gc_version = last_gc_version
+
+    # -- reads --------------------------------------------------------------
+
+    def get(self, key: str) -> VersionedValue | None:
+        """Visible value: hides tombstones and TTL-scheduled keys."""
+        vv = self.key_values.get(key)
+        if vv is None or vv.is_deleted():
+            return None
+        return vv
+
+    def get_versioned(self, key: str) -> VersionedValue | None:
+        """Raw value including tombstones."""
+        return self.key_values.get(key)
+
+    def stale_key_values(
+        self, floor_version: int
+    ) -> Iterator[tuple[str, VersionedValue]]:
+        """Keys with versions strictly above ``floor_version``."""
+        for key, vv in self.key_values.items():
+            if vv.version > floor_version:
+                yield key, vv
+
+    def digest(self) -> NodeDigest:
+        return NodeDigest(
+            self.node, self.heartbeat, self.last_gc_version, self.max_version
+        )
+
+    # -- owner-side writes ---------------------------------------------------
+
+    def set(self, key: str, value: str, ts: datetime | None = None) -> None:
+        """Idempotent set: writing the current live value is a no-op."""
+        current = self.key_values.get(key)
+        if (
+            current is not None
+            and current.status is KeyStatus.SET
+            and current.value == value
+        ):
+            return
+        self.set_with_version(key, value, self.max_version + 1, ts=ts)
+
+    def set_with_version(
+        self, key: str, value: str, version: int, ts: datetime | None = None
+    ) -> None:
+        now = ts if ts is not None else utc_now()
+        self.set_versioned(key, VersionedValue(value, version, KeyStatus.SET, now))
+
+    def set_versioned(self, key: str, vv: VersionedValue) -> None:
+        """Install ``vv`` unless we already hold an equal-or-newer version.
+        Always advances ``max_version`` (the owner has *seen* this version
+        even when the key itself is stale)."""
+        self.max_version = max(self.max_version, vv.version)
+        current = self.key_values.get(key)
+        if current is not None and current.version >= vv.version:
+            return
+        self.key_values[key] = vv
+
+    def set_with_ttl(self, key: str, value: str, ts: datetime | None = None) -> None:
+        """Set a value that becomes GC-eligible after the grace period."""
+        current = self.key_values.get(key)
+        if (
+            current is not None
+            and current.status is KeyStatus.DELETE_AFTER_TTL
+            and current.value == value
+        ):
+            return
+        now = ts if ts is not None else utc_now()
+        self.set_versioned(
+            key,
+            VersionedValue(value, self.max_version + 1, KeyStatus.DELETE_AFTER_TTL, now),
+        )
+
+    def delete(self, key: str, ts: datetime | None = None) -> None:
+        """Tombstone ``key`` in place; no-op for unknown keys."""
+        vv = self.key_values.get(key)
+        if vv is None:
+            return
+        self.max_version += 1
+        vv.status = KeyStatus.DELETED
+        vv.version = self.max_version
+        vv.value = ""
+        vv.status_change_ts = ts if ts is not None else utc_now()
+
+    def delete_after_ttl(self, key: str, ts: datetime | None = None) -> None:
+        """Schedule ``key`` for TTL deletion, keeping its value readable via
+        ``get_versioned`` until GC."""
+        vv = self.key_values.get(key)
+        if vv is None:
+            return
+        self.max_version += 1
+        vv.status = KeyStatus.DELETE_AFTER_TTL
+        vv.version = self.max_version
+        vv.status_change_ts = ts if ts is not None else utc_now()
+
+    # -- replica-side reconciliation ----------------------------------------
+
+    def apply_delta(
+        self,
+        node_delta: NodeDelta,
+        ts: datetime | None = None,
+        on_key_change: KeyChangeFn | None = None,
+    ) -> None:
+        """Merge a peer's delta for this node's keyspace.
+
+        Rules (parity: reference state.py:190-233, with one correctness
+        divergence documented below):
+        1. A *reset* delta (``from_version_excluded == 0`` with a GC
+           watermark ahead of ours) means the sender judged us staler than
+           the owner's GC horizon and is resending the keyspace from
+           scratch: wipe our copy and rebuild.
+        2. Otherwise, adopting a higher GC watermark purges only
+           *tombstoned* keys at or below it. Because deltas are
+           version-ordered prefixes, knowing ``max_version >= watermark``
+           means we already saw every tombstone the owner GC'd — live SET
+           keys with old versions are still live at the owner and must
+           survive. (The reference drops *all* keys at or below the
+           watermark, state.py:200-207, permanently losing live keys on
+           replicas; found by review, regression-tested.)
+        3. Skip updates not newer than our recorded ``max_version``.
+        4. Skip updates older than what we hold for that key.
+        5. Skip deleted/TTL updates already covered by the GC watermark.
+        6. ``max_version`` fast-forwards only when the sender marked the
+           delta complete (``max_version is not None``).
+        """
+        now = ts if ts is not None else utc_now()
+        if (
+            node_delta.from_version_excluded == 0
+            and node_delta.last_gc_version > self.last_gc_version
+        ):
+            self.key_values = {}
+            self.max_version = 0
+            self.last_gc_version = node_delta.last_gc_version
+        elif node_delta.last_gc_version > self.last_gc_version:
+            self.last_gc_version = node_delta.last_gc_version
+            self.key_values = {
+                k: v
+                for k, v in self.key_values.items()
+                if v.version > self.last_gc_version or not v.is_deleted()
+            }
+        for kv in node_delta.key_values:
+            if kv.version <= self.max_version:
+                continue
+            existing = self.key_values.get(kv.key)
+            if existing is not None and existing.version >= kv.version:
+                continue
+            if (
+                kv.status in (KeyStatus.DELETED, KeyStatus.DELETE_AFTER_TTL)
+                and kv.version <= self.last_gc_version
+            ):
+                continue
+            vv = VersionedValue(kv.value, kv.version, kv.status, now)
+            self.set_versioned(kv.key, vv)
+            if on_key_change is not None:
+                on_key_change(self.node, kv.key, existing, vv)
+        if node_delta.max_version is not None:
+            self.max_version = max(self.max_version, node_delta.max_version)
+
+    # -- garbage collection ---------------------------------------------------
+
+    def gc_marked_for_deletion(
+        self, grace_period: timedelta, ts: datetime | None = None
+    ) -> None:
+        """Purge tombstones and TTL keys older than ``grace_period`` and
+        advance the GC watermark to the highest purged version."""
+        now = ts if ts is not None else utc_now()
+        watermark = self.last_gc_version
+        survivors: dict[str, VersionedValue] = {}
+        for key, vv in self.key_values.items():
+            if vv.status is KeyStatus.SET or now < vv.status_change_ts + grace_period:
+                survivors[key] = vv
+            else:
+                watermark = max(watermark, vv.version)
+        self.key_values = survivors
+        self.last_gc_version = watermark
+
+    # -- heartbeats -----------------------------------------------------------
+
+    def inc_heartbeat(self) -> int:
+        self.heartbeat += 1
+        return self.heartbeat
+
+    def apply_heartbeat(self, value: int) -> bool:
+        """Record an observed heartbeat. Returns True only for a genuine
+        *increase* — the first observation just initialises the counter."""
+        if self.heartbeat == 0:
+            self.heartbeat = value
+            return False
+        if value > self.heartbeat:
+            self.heartbeat = value
+            return True
+        return False

@@ -1,0 +1,119 @@
+"""Reconciliation and handshake message DTOs.
+
+These are the *logical* messages of the ScuttleButt anti-entropy protocol
+(parity: reference state.py:22-103 for digest/delta DTOs and
+messages.proto:3-26 for the handshake envelope). Encoding lives entirely in
+``aiocluster_tpu.wire``; these types are plain data.
+
+Protocol recap: the initiator sends ``Syn(digest)`` — a per-node summary
+(heartbeat, gc watermark, max version) of everything it knows. The responder
+answers ``SynAck(its own digest, delta)`` where the delta carries exactly the
+key-value updates the initiator is missing, and the initiator closes with
+``Ack(delta)`` carrying what the responder is missing. State converges
+bidirectionally in a single handshake.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .identity import NodeId
+from .values import VersionStatusEnum
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class KeyValueUpdate:
+    """One replicated write: a key with its owner-assigned version/status."""
+
+    key: str
+    value: str
+    version: int
+    status: VersionStatusEnum
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class NodeDigest:
+    """Summary of one node's keyspace as known to the digest's sender."""
+
+    node_id: NodeId
+    heartbeat: int
+    last_gc_version: int
+    max_version: int
+
+
+@dataclass(slots=True)
+class Digest:
+    """Per-node summaries for every node the sender knows about."""
+
+    node_digests: dict[NodeId, NodeDigest] = field(default_factory=dict)
+
+    def add_node(
+        self,
+        node_id: NodeId,
+        heartbeat: int,
+        last_gc_version: int,
+        max_version: int,
+    ) -> None:
+        self.node_digests[node_id] = NodeDigest(
+            node_id, heartbeat, last_gc_version, max_version
+        )
+
+
+@dataclass(slots=True)
+class NodeDelta:
+    """Updates for one owner's keyspace, covering versions strictly above
+    ``from_version_excluded``.
+
+    ``max_version`` is only populated when the delta is *complete* (no MTU
+    truncation); receivers may then fast-forward their recorded max version.
+    The reference always populated it (state.py:389), which silently loses
+    truncated updates — see ClusterState.compute_partial_delta_respecting_mtu
+    for the fix rationale.
+    """
+
+    node_id: NodeId
+    from_version_excluded: int
+    last_gc_version: int
+    key_values: list[KeyValueUpdate]
+    max_version: int | None = None
+
+
+@dataclass(slots=True)
+class Delta:
+    """A bundle of per-node deltas; the unit bounded by the MTU."""
+
+    node_deltas: list[NodeDelta] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Handshake envelope (wire parity: messages.proto:3-26)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class Syn:
+    digest: Digest
+
+
+@dataclass(slots=True)
+class SynAck:
+    digest: Digest
+    delta: Delta
+
+
+@dataclass(slots=True)
+class Ack:
+    delta: Delta
+
+
+@dataclass(slots=True)
+class BadCluster:
+    """Reply sent when the peer's cluster_id does not match ours."""
+
+
+@dataclass(slots=True)
+class Packet:
+    """Top-level envelope: cluster id + exactly one handshake message."""
+
+    cluster_id: str
+    msg: Syn | SynAck | Ack | BadCluster

@@ -1,0 +1,44 @@
+"""Versioned values and their lifecycle status.
+
+Parity: reference entities.py:25-49. A key's value carries a per-owner
+monotonic version and a status:
+
+- ``SET``: live value.
+- ``DELETED``: tombstone (value cleared); removed for good once the
+  grace period elapses and the GC watermark advances past it.
+- ``DELETE_AFTER_TTL``: like SET but scheduled to become eligible for GC
+  after the grace period (a soft TTL).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from datetime import datetime
+from enum import IntEnum
+
+
+class VersionStatusEnum(IntEnum):
+    """Lifecycle status of a versioned key (wire values match the reference
+    proto enum messages.proto:33-37 for interop)."""
+
+    SET = 0
+    DELETED = 1
+    DELETE_AFTER_TTL = 2
+
+
+# Shorter idiomatic alias used internally.
+KeyStatus = VersionStatusEnum
+
+
+@dataclass(slots=True)
+class VersionedValue:
+    """A value with its owner-assigned version, status, and the time the
+    status last changed (drives tombstone/TTL GC)."""
+
+    value: str
+    version: int
+    status: VersionStatusEnum
+    status_change_ts: datetime
+
+    def is_deleted(self) -> bool:
+        return self.status in (KeyStatus.DELETED, KeyStatus.DELETE_AFTER_TTL)

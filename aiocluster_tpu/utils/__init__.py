@@ -1,0 +1,14 @@
+"""Shared utilities: clock, logging, wire framing."""
+
+from .clock import utc_now
+from .framing import frame, read_frame_size, unframe
+from .logging import logger, node_logger
+
+__all__ = (
+    "frame",
+    "logger",
+    "node_logger",
+    "read_frame_size",
+    "unframe",
+    "utc_now",
+)

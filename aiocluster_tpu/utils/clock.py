@@ -1,0 +1,15 @@
+"""Time helpers.
+
+Every time-dependent method in the core takes an optional ``ts`` so tests can
+time-travel instead of sleeping (parity with reference utils.py:5-6 and the
+clock-injection seam described in SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from datetime import UTC, datetime
+
+
+def utc_now() -> datetime:
+    """Current wall-clock time as an aware UTC datetime."""
+    return datetime.now(UTC)

@@ -1,0 +1,35 @@
+"""Stream framing for the socket transport.
+
+Frames are a 4-byte big-endian unsigned length followed by the payload —
+wire-compatible with the reference transport (reference utils.py:9-20,
+server.py:502-521) so mixed clusters interoperate.
+"""
+
+from __future__ import annotations
+
+HEADER_SIZE = 4
+_MAX_FRAME = 0xFFFFFFFF
+
+
+def frame(payload: bytes) -> bytes:
+    """Prefix ``payload`` with its 4-byte big-endian length."""
+    n = len(payload)
+    if n > _MAX_FRAME:
+        raise ValueError(f"payload too large to frame: {n} bytes")
+    return n.to_bytes(HEADER_SIZE, "big") + payload
+
+
+def read_frame_size(header: bytes) -> int:
+    """Decode the length prefix from the first 4 bytes of ``header``."""
+    if len(header) < HEADER_SIZE:
+        raise ValueError(f"short frame header: {len(header)} bytes")
+    return int.from_bytes(header[:HEADER_SIZE], "big")
+
+
+def unframe(data: bytes) -> bytes:
+    """Strip and validate the length prefix of a complete in-memory frame."""
+    size = read_frame_size(data)
+    body = data[HEADER_SIZE : HEADER_SIZE + size]
+    if len(body) != size:
+        raise ValueError(f"truncated frame: expected {size}, got {len(body)}")
+    return body

@@ -1,0 +1,12 @@
+"""Package logging (parity: reference log.py:8, server.py:92-93)."""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("aiocluster_tpu")
+
+
+def node_logger(node_name: str) -> logging.LoggerAdapter:
+    """Per-node adapter tagging records with the node's long name."""
+    return logging.LoggerAdapter(logger, extra={"node": node_name}, merge_extra=True)

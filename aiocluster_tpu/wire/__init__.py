@@ -1,0 +1,27 @@
+"""Wire format: hand-written proto3-compatible codec + size accounting.
+
+Byte-compatible with the reference's messages.proto schema (field numbers
+preserved) so this framework and the reference can gossip in one cluster.
+"""
+
+from .proto import (
+    WireError,
+    decode_delta,
+    decode_digest,
+    decode_packet,
+    encode_delta,
+    encode_digest,
+    encode_packet,
+)
+from .sizes import DeltaSizeModel
+
+__all__ = (
+    "DeltaSizeModel",
+    "WireError",
+    "decode_delta",
+    "decode_digest",
+    "decode_packet",
+    "encode_delta",
+    "encode_digest",
+    "encode_packet",
+)

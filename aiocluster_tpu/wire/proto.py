@@ -1,0 +1,441 @@
+"""Minimal proto3 wire codec for the gossip protocol.
+
+Hand-written, dependency-free encoder/decoder producing bytes **identical**
+to the reference's generated protobuf stubs for the schema in
+messages.proto:1-74 (same field numbers, same proto3 emission rules:
+zero-valued scalars omitted, message fields emitted when present, the
+``optional`` max_version field emitted whenever set). Byte-for-byte
+compatibility means a node of this framework can gossip with a node running
+the reference library.
+
+Only the two wire types the schema needs are implemented: varint (0) and
+length-delimited (2). Unknown fields are skipped on decode, so schema
+evolution by either side does not break the handshake.
+"""
+
+from __future__ import annotations
+
+from ..core.identity import NodeId
+from ..core.messages import (
+    Ack,
+    BadCluster,
+    Delta,
+    Digest,
+    KeyValueUpdate,
+    NodeDelta,
+    NodeDigest,
+    Packet,
+    Syn,
+    SynAck,
+)
+from ..core.values import VersionStatusEnum
+
+__all__ = (
+    "WireError",
+    "decode_packet",
+    "encode_packet",
+    "encode_digest",
+    "decode_digest",
+    "encode_delta",
+    "decode_delta",
+    "varint_size",
+)
+
+_VARINT = 0
+_LEN = 2
+
+
+class WireError(ValueError):
+    """Malformed or unsupported wire data."""
+
+
+# ---------------------------------------------------------------------------
+# Primitives
+# ---------------------------------------------------------------------------
+
+
+def varint_size(value: int) -> int:
+    """Encoded size in bytes of an unsigned varint."""
+    if value < 0:
+        raise WireError(f"negative varint: {value}")
+    size = 1
+    while value >= 0x80:
+        value >>= 7
+        size += 1
+    return size
+
+
+def _uvarint(value: int) -> bytes:
+    if value < 0:
+        raise WireError(f"negative varint: {value}")
+    out = bytearray()
+    while value >= 0x80:
+        out.append((value & 0x7F) | 0x80)
+        value >>= 7
+    out.append(value)
+    return bytes(out)
+
+
+def _field_varint(out: bytearray, field: int, value: int) -> None:
+    """Emit a varint field, skipping proto3 default zero."""
+    if value == 0:
+        return
+    out.append(field << 3 | _VARINT)
+    out += _uvarint(value)
+
+
+def _field_varint_present(out: bytearray, field: int, value: int) -> None:
+    """Emit a varint field unconditionally (explicit-presence fields)."""
+    out.append(field << 3 | _VARINT)
+    out += _uvarint(value)
+
+
+def _field_str(out: bytearray, field: int, value: str) -> None:
+    if not value:
+        return
+    raw = value.encode("utf-8")
+    out.append(field << 3 | _LEN)
+    out += _uvarint(len(raw))
+    out += raw
+
+
+def _field_msg(out: bytearray, field: int, body: bytes) -> None:
+    """Emit a submessage field (always, matching set-message presence)."""
+    out.append(field << 3 | _LEN)
+    out += _uvarint(len(body))
+    out += body
+
+
+class _Reader:
+    __slots__ = ("buf", "pos", "end")
+
+    def __init__(self, buf: bytes, start: int = 0, end: int | None = None) -> None:
+        self.buf = buf
+        self.pos = start
+        self.end = len(buf) if end is None else end
+
+    def at_end(self) -> bool:
+        return self.pos >= self.end
+
+    def varint(self) -> int:
+        result = 0
+        shift = 0
+        while True:
+            if self.pos >= self.end:
+                raise WireError("truncated varint")
+            b = self.buf[self.pos]
+            self.pos += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+            if shift > 63:
+                raise WireError("varint too long")
+
+    def chunk(self) -> bytes:
+        n = self.varint()
+        if self.pos + n > self.end:
+            raise WireError("truncated length-delimited field")
+        out = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def field(self) -> tuple[int, int]:
+        tag = self.varint()
+        return tag >> 3, tag & 0x7
+
+    def skip(self, wire_type: int) -> None:
+        if wire_type == _VARINT:
+            self.varint()
+        elif wire_type == _LEN:
+            self.chunk()
+        elif wire_type == 5:  # fixed32
+            self.pos += 4
+        elif wire_type == 1:  # fixed64
+            self.pos += 8
+        else:
+            raise WireError(f"unsupported wire type {wire_type}")
+        if self.pos > self.end:
+            raise WireError("truncated field")
+
+
+def _utf8(raw: bytes) -> str:
+    try:
+        return raw.decode("utf-8")
+    except UnicodeDecodeError as exc:
+        raise WireError(f"invalid utf-8 string field: {exc}") from exc
+
+
+# ---------------------------------------------------------------------------
+# Message bodies (field numbers per reference messages.proto:28-74)
+# ---------------------------------------------------------------------------
+
+
+def encode_node_id(node: NodeId) -> bytes:
+    addr = bytearray()
+    host, port = node.gossip_advertise_addr
+    _field_str(addr, 1, host)
+    _field_varint(addr, 2, port)
+
+    out = bytearray()
+    _field_str(out, 1, node.name)
+    _field_varint(out, 2, node.generation_id)
+    _field_msg(out, 3, bytes(addr))
+    _field_str(out, 4, node.tls_name or "")
+    return bytes(out)
+
+
+def decode_node_id(body: bytes) -> NodeId:
+    r = _Reader(body)
+    name = ""
+    generation_id = 0
+    host, port = "", 0
+    tls_name: str | None = None
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            name = _utf8(r.chunk())
+        elif field == 2 and wt == _VARINT:
+            generation_id = r.varint()
+        elif field == 3 and wt == _LEN:
+            ar = _Reader(r.chunk())
+            while not ar.at_end():
+                af, awt = ar.field()
+                if af == 1 and awt == _LEN:
+                    host = _utf8(ar.chunk())
+                elif af == 2 and awt == _VARINT:
+                    port = ar.varint()
+                else:
+                    ar.skip(awt)
+        elif field == 4 and wt == _LEN:
+            tls_name = _utf8(r.chunk()) or None
+        else:
+            r.skip(wt)
+    return NodeId(name, generation_id, (host, port), tls_name)
+
+
+def encode_node_digest(nd: NodeDigest) -> bytes:
+    out = bytearray()
+    _field_msg(out, 1, encode_node_id(nd.node_id))
+    _field_varint(out, 2, nd.heartbeat)
+    _field_varint(out, 3, nd.last_gc_version)
+    _field_varint(out, 4, nd.max_version)
+    return bytes(out)
+
+
+def decode_node_digest(body: bytes) -> NodeDigest:
+    r = _Reader(body)
+    node_id = NodeId("", 0, ("", 0))
+    heartbeat = last_gc = max_version = 0
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            node_id = decode_node_id(r.chunk())
+        elif field == 2 and wt == _VARINT:
+            heartbeat = r.varint()
+        elif field == 3 and wt == _VARINT:
+            last_gc = r.varint()
+        elif field == 4 and wt == _VARINT:
+            max_version = r.varint()
+        else:
+            r.skip(wt)
+    return NodeDigest(node_id, heartbeat, last_gc, max_version)
+
+
+def encode_kv_update(kv: KeyValueUpdate) -> bytes:
+    out = bytearray()
+    _field_str(out, 1, kv.key)
+    _field_str(out, 2, kv.value)
+    _field_varint(out, 3, kv.version)
+    _field_varint(out, 4, int(kv.status))
+    return bytes(out)
+
+
+def decode_kv_update(body: bytes) -> KeyValueUpdate:
+    r = _Reader(body)
+    key = value = ""
+    version = 0
+    status = 0
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            key = _utf8(r.chunk())
+        elif field == 2 and wt == _LEN:
+            value = _utf8(r.chunk())
+        elif field == 3 and wt == _VARINT:
+            version = r.varint()
+        elif field == 4 and wt == _VARINT:
+            status = r.varint()
+        else:
+            r.skip(wt)
+    try:
+        st = VersionStatusEnum(status)
+    except ValueError as exc:
+        raise WireError(f"unknown version status {status}") from exc
+    return KeyValueUpdate(key, value, version, st)
+
+
+def encode_node_delta(nd: NodeDelta) -> bytes:
+    out = bytearray()
+    _field_msg(out, 1, encode_node_id(nd.node_id))
+    _field_varint(out, 2, nd.from_version_excluded)
+    _field_varint(out, 3, nd.last_gc_version)
+    for kv in nd.key_values:
+        _field_msg(out, 4, encode_kv_update(kv))
+    if nd.max_version is not None:
+        _field_varint_present(out, 5, nd.max_version)
+    return bytes(out)
+
+
+def decode_node_delta(body: bytes) -> NodeDelta:
+    r = _Reader(body)
+    node_id = NodeId("", 0, ("", 0))
+    fve = lgc = 0
+    kvs: list[KeyValueUpdate] = []
+    max_version: int | None = None
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            node_id = decode_node_id(r.chunk())
+        elif field == 2 and wt == _VARINT:
+            fve = r.varint()
+        elif field == 3 and wt == _VARINT:
+            lgc = r.varint()
+        elif field == 4 and wt == _LEN:
+            kvs.append(decode_kv_update(r.chunk()))
+        elif field == 5 and wt == _VARINT:
+            max_version = r.varint()
+        else:
+            r.skip(wt)
+    return NodeDelta(node_id, fve, lgc, kvs, max_version)
+
+
+def encode_digest(digest: Digest) -> bytes:
+    out = bytearray()
+    for nd in digest.node_digests.values():
+        _field_msg(out, 1, encode_node_digest(nd))
+    return bytes(out)
+
+
+def decode_digest(body: bytes) -> Digest:
+    r = _Reader(body)
+    digests: dict[NodeId, NodeDigest] = {}
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            nd = decode_node_digest(r.chunk())
+            digests[nd.node_id] = nd
+        else:
+            r.skip(wt)
+    return Digest(digests)
+
+
+def encode_delta(delta: Delta) -> bytes:
+    out = bytearray()
+    for nd in delta.node_deltas:
+        _field_msg(out, 1, encode_node_delta(nd))
+    return bytes(out)
+
+
+def decode_delta(body: bytes) -> Delta:
+    r = _Reader(body)
+    nds: list[NodeDelta] = []
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            nds.append(decode_node_delta(r.chunk()))
+        else:
+            r.skip(wt)
+    return Delta(nds)
+
+
+# ---------------------------------------------------------------------------
+# Handshake envelope (field numbers per messages.proto:3-26)
+# ---------------------------------------------------------------------------
+
+
+def encode_packet(packet: Packet) -> bytes:
+    out = bytearray()
+    _field_str(out, 1, packet.cluster_id)
+    msg = packet.msg
+    if isinstance(msg, Syn):
+        body = bytearray()
+        _field_msg(body, 2, encode_digest(msg.digest))
+        _field_msg(out, 2, bytes(body))
+    elif isinstance(msg, SynAck):
+        body = bytearray()
+        _field_msg(body, 2, encode_digest(msg.digest))
+        _field_msg(body, 3, encode_delta(msg.delta))
+        _field_msg(out, 3, bytes(body))
+    elif isinstance(msg, Ack):
+        body = bytearray()
+        _field_msg(body, 3, encode_delta(msg.delta))
+        _field_msg(out, 4, bytes(body))
+    elif isinstance(msg, BadCluster):
+        _field_msg(out, 5, b"")
+    else:  # pragma: no cover - exhaustiveness guard
+        raise WireError(f"unknown packet message type: {type(msg)!r}")
+    return bytes(out)
+
+
+def _decode_syn(body: bytes) -> Syn:
+    r = _Reader(body)
+    digest = Digest()
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 2 and wt == _LEN:
+            digest = decode_digest(r.chunk())
+        else:
+            r.skip(wt)
+    return Syn(digest)
+
+
+def _decode_synack(body: bytes) -> SynAck:
+    r = _Reader(body)
+    digest = Digest()
+    delta = Delta()
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 2 and wt == _LEN:
+            digest = decode_digest(r.chunk())
+        elif field == 3 and wt == _LEN:
+            delta = decode_delta(r.chunk())
+        else:
+            r.skip(wt)
+    return SynAck(digest, delta)
+
+
+def _decode_ack(body: bytes) -> Ack:
+    r = _Reader(body)
+    delta = Delta()
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 3 and wt == _LEN:
+            delta = decode_delta(r.chunk())
+        else:
+            r.skip(wt)
+    return Ack(delta)
+
+
+def decode_packet(data: bytes) -> Packet:
+    r = _Reader(data)
+    cluster_id = ""
+    msg: Syn | SynAck | Ack | BadCluster | None = None
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            cluster_id = _utf8(r.chunk())
+        elif field == 2 and wt == _LEN:
+            msg = _decode_syn(r.chunk())
+        elif field == 3 and wt == _LEN:
+            msg = _decode_synack(r.chunk())
+        elif field == 4 and wt == _LEN:
+            msg = _decode_ack(r.chunk())
+        elif field == 5 and wt == _LEN:
+            r.chunk()
+            msg = BadCluster()
+        else:
+            r.skip(wt)
+    if msg is None:
+        raise WireError("packet carries no handshake message")
+    return Packet(cluster_id, msg)

@@ -1,0 +1,74 @@
+"""Exact encoded-size accounting for MTU-bounded delta packing.
+
+The packer (core/cluster_state.py) must answer "would this delta exceed the
+MTU if I add one more key-value?" The reference answered by re-serialising
+the whole delta per key-value (reference state.py:392-398, quadratic). Here
+each size is computed once and totals advance with O(1) arithmetic, while
+remaining byte-exact with the proto3 encoding in wire/proto.py.
+"""
+
+from __future__ import annotations
+
+from ..core.identity import NodeId
+from ..core.messages import KeyValueUpdate
+from .proto import encode_kv_update, encode_node_id, varint_size
+
+__all__ = ("DeltaSizeModel",)
+
+_TAG_SIZE = 1  # all fields in the schema have single-byte tags
+
+
+def _len_field_size(body_size: int) -> int:
+    """Bytes for a length-delimited field holding ``body_size`` bytes."""
+    return _TAG_SIZE + varint_size(body_size) + body_size
+
+
+def _varint_field_size(value: int) -> int:
+    """Bytes for a varint field, honouring proto3 zero-skipping."""
+    return 0 if value == 0 else _TAG_SIZE + varint_size(value)
+
+
+class DeltaSizeModel:
+    """Incremental size of one DeltaPb under construction.
+
+    ``node_delta_base``/``kv_increment`` price the parts; the caller tracks
+    a candidate node-delta body size, tests it with ``delta_total_with``,
+    and ``commit``s it once the node's key-values are chosen.
+    """
+
+    def __init__(self) -> None:
+        self._committed = 0
+
+    def node_delta_base(
+        self,
+        node_id: NodeId,
+        from_version_excluded: int,
+        last_gc_version: int,
+        max_version: int,
+    ) -> int:
+        """Body size of a NodeDeltaPb before any key-values, with the
+        ``max_version`` presence-tracked field reserved (always costed,
+        matching the reference's accounting even though we may omit it on
+        the wire for truncated deltas)."""
+        return (
+            _len_field_size(len(encode_node_id(node_id)))
+            + _varint_field_size(from_version_excluded)
+            + _varint_field_size(last_gc_version)
+            + _TAG_SIZE
+            + varint_size(max_version)  # optional field: emitted even when 0
+        )
+
+    def kv_increment(self, kv: KeyValueUpdate) -> int:
+        """Bytes added to a node-delta body by appending ``kv``."""
+        return _len_field_size(len(encode_kv_update(kv)))
+
+    def delta_total_with(self, node_delta_body: int) -> int:
+        """Total DeltaPb size if a node delta of ``node_delta_body`` bytes
+        were appended to what is already committed."""
+        return self._committed + _len_field_size(node_delta_body)
+
+    def commit(self, node_delta_body: int) -> None:
+        self._committed += _len_field_size(node_delta_body)
+
+    def total(self) -> int:
+        return self._committed

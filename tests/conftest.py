@@ -1,0 +1,55 @@
+"""Test harness.
+
+- Forces JAX onto CPU with 8 virtual devices *before* jax is imported, so
+  multi-chip sharding tests run anywhere (SURVEY.md §2 checklist item 3).
+- Runs ``async def`` tests on a fresh event loop (no pytest-asyncio in the
+  image).
+- ``free_port`` grabs an ephemeral port for loopback cluster tests
+  (reference tests/conftest.py:7-16 seam).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import socket
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture
+def free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def free_port_factory():
+    def _get() -> int:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    return _get

@@ -1,0 +1,59 @@
+"""Identity/value/config entity behavior (reference entities.py parity)."""
+
+from datetime import UTC, datetime, timedelta
+
+from aiocluster_tpu.core import (
+    Config,
+    FailureDetectorConfig,
+    NodeId,
+    VersionedValue,
+    VersionStatusEnum,
+)
+
+
+def test_node_id_generation_defaults_to_monotonic_and_is_fresh():
+    a = NodeId(name="n")
+    b = NodeId(name="n")
+    assert a.generation_id != b.generation_id
+    assert a != b  # a restarted node is a brand-new member
+
+
+def test_node_id_long_name():
+    n = NodeId(name="x", generation_id=7, gossip_advertise_addr=("10.0.0.1", 9000))
+    assert n.long_name() == "x-7-10.0.0.1:9000"
+
+
+def test_node_id_hashable_and_equal_by_value():
+    a = NodeId("n", 1, ("h", 1))
+    b = NodeId("n", 1, ("h", 1))
+    assert a == b
+    assert {a: 1}[b] == 1
+
+
+def test_versioned_value_is_deleted():
+    ts = datetime.now(UTC)
+    assert not VersionedValue("v", 1, VersionStatusEnum.SET, ts).is_deleted()
+    assert VersionedValue("", 2, VersionStatusEnum.DELETED, ts).is_deleted()
+    assert VersionedValue("v", 3, VersionStatusEnum.DELETE_AFTER_TTL, ts).is_deleted()
+
+
+def test_config_defaults_match_reference_tuning():
+    cfg = Config(node_id=NodeId("n", 1))
+    assert cfg.gossip_interval == 1.0
+    assert cfg.gossip_count == 3
+    assert cfg.max_payload_size == 65_507
+    assert cfg.max_concurrent_gossip == 32
+    assert cfg.marked_for_deletion_grace_period == 7200
+    assert cfg.hook_queue_maxsize == 10_000
+    fd = FailureDetectorConfig()
+    assert fd.phi_threshhold == 8.0
+    assert fd.sampling_window_size == 1000
+    assert fd.max_interval == timedelta(seconds=10)
+    assert fd.initial_interval == timedelta(seconds=5)
+    assert fd.dead_node_grace_period == timedelta(hours=24)
+
+
+def test_version_status_wire_values():
+    assert VersionStatusEnum.SET == 0
+    assert VersionStatusEnum.DELETED == 1
+    assert VersionStatusEnum.DELETE_AFTER_TTL == 2

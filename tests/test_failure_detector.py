@@ -1,0 +1,146 @@
+"""Phi-accrual failure detector: closed-form phi, window rollover,
+time-travel death and two-stage GC (reference tests/test_failure_detector.py
+coverage, rebuilt)."""
+
+from datetime import UTC, datetime, timedelta
+
+from aiocluster_tpu.core import NodeId
+from aiocluster_tpu.core.config import FailureDetectorConfig
+from aiocluster_tpu.core.failure import (
+    PRIOR_WEIGHT,
+    BoundedWindow,
+    FailureDetector,
+    HeartbeatWindow,
+)
+
+T0 = datetime(2026, 1, 1, tzinfo=UTC)
+NODE = NodeId("peer", 1, ("127.0.0.1", 7001))
+
+
+def at(seconds: float) -> datetime:
+    return T0 + timedelta(seconds=seconds)
+
+
+# -- BoundedWindow -------------------------------------------------------------
+
+
+def test_bounded_window_sum_and_len():
+    w = BoundedWindow(3)
+    assert len(w) == 0 and w.sum() == 0.0
+    w.append(1.0)
+    w.append(2.0)
+    assert len(w) == 2 and w.sum() == 3.0
+
+
+def test_bounded_window_rollover_evicts_oldest():
+    w = BoundedWindow(3)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        w.append(v)
+    assert len(w) == 3
+    assert w.sum() == 2.0 + 3.0 + 4.0
+    w.append(5.0)
+    assert w.sum() == 3.0 + 4.0 + 5.0
+    w.clear()
+    assert len(w) == 0 and w.sum() == 0.0
+
+
+# -- HeartbeatWindow -----------------------------------------------------------
+
+
+def test_phi_closed_form_prior_weighted_mean():
+    w = HeartbeatWindow(
+        window_size=10,
+        max_interval=timedelta(seconds=10),
+        prior_interval=timedelta(seconds=5),
+    )
+    assert w.phi(ts=at(0)) is None  # no heartbeat yet
+    w.report_heartbeat(ts=at(0))
+    assert w.phi(ts=at(1)) is None  # one heartbeat → no interval yet
+    w.report_heartbeat(ts=at(1))  # one interval of 1s
+    # mean = (1 + 5.0*5) / (1 + 5.0)
+    expected_mean = (1 + PRIOR_WEIGHT * 5) / (1 + PRIOR_WEIGHT)
+    assert w.mean() == expected_mean
+    assert w.phi(ts=at(3)) == (3 - 1) / expected_mean
+
+
+def test_intervals_beyond_max_are_not_samples():
+    w = HeartbeatWindow(10, timedelta(seconds=10), timedelta(seconds=5))
+    w.report_heartbeat(ts=at(0))
+    w.report_heartbeat(ts=at(100))  # 100s gap: outage, not a sample
+    assert w.mean() is None
+    w.report_heartbeat(ts=at(101))  # 1s: sampled
+    assert w.mean() is not None
+
+
+# -- FailureDetector -----------------------------------------------------------
+
+
+def ticking_detector(intervals: int = 100) -> tuple[FailureDetector, datetime]:
+    fd = FailureDetector(FailureDetectorConfig())
+    t = T0
+    for i in range(intervals):
+        t = at(float(i))
+        fd.report_heartbeat(NODE, ts=t)
+    return fd, t
+
+
+def test_steady_heartbeats_mean_alive():
+    fd, t = ticking_detector()
+    fd.update_node_liveness(NODE, ts=t)
+    assert fd.live_nodes() == [NODE]
+    assert fd.dead_nodes() == []
+
+
+def test_single_heartbeat_is_not_alive():
+    fd = FailureDetector(FailureDetectorConfig())
+    fd.report_heartbeat(NODE, ts=T0)
+    fd.update_node_liveness(NODE, ts=at(1))
+    # One heartbeat gives no interval → phi is None → dead.
+    assert fd.live_nodes() == []
+    assert fd.dead_nodes() == [NODE]
+
+
+def test_silence_flips_node_dead_and_resets_window():
+    fd, t = ticking_detector()
+    fd.update_node_liveness(NODE, ts=t)
+    assert fd.live_nodes() == [NODE]
+    # ~1s mean intervals, phi threshold 8 → 50s of silence is way past dead.
+    dead_time = t + timedelta(seconds=50)
+    fd.update_node_liveness(NODE, ts=dead_time)
+    assert fd.live_nodes() == []
+    assert fd.dead_nodes() == [NODE]
+    # The window was reset: one new heartbeat alone cannot revive it.
+    fd.report_heartbeat(NODE, ts=dead_time + timedelta(seconds=1))
+    fd.update_node_liveness(NODE, ts=dead_time + timedelta(seconds=1))
+    assert fd.live_nodes() == []
+    # But a run of fresh heartbeats does revive it.
+    t2 = dead_time
+    for i in range(10):
+        t2 = dead_time + timedelta(seconds=i)
+        fd.report_heartbeat(NODE, ts=t2)
+    fd.update_node_liveness(NODE, ts=t2)
+    assert fd.live_nodes() == [NODE]
+    assert fd.dead_nodes() == []
+
+
+def test_two_stage_dead_node_gc():
+    fd, t = ticking_detector()
+    fd.update_node_liveness(NODE, ts=t)
+    death = t + timedelta(seconds=50)
+    fd.update_node_liveness(NODE, ts=death)
+    assert fd.dead_nodes() == [NODE]
+    # Before half the grace period: still digested, still held.
+    assert fd.scheduled_for_deletion_nodes(ts=death + timedelta(hours=11)) == []
+    # After half (12h): excluded from digests.
+    assert fd.scheduled_for_deletion_nodes(ts=death + timedelta(hours=12)) == [NODE]
+    # Before full grace: not collected.
+    assert fd.garbage_collect(ts=death + timedelta(hours=23)) == []
+    # After full grace (24h): collected and forgotten.
+    assert fd.garbage_collect(ts=death + timedelta(hours=25)) == [NODE]
+    assert fd.dead_nodes() == []
+    assert fd.phi(NODE, ts=death) is None  # window dropped too
+
+
+def test_phi_unknown_node_is_none():
+    fd = FailureDetector(FailureDetectorConfig())
+    assert fd.phi(NODE) is None

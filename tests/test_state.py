@@ -1,0 +1,321 @@
+"""Replicated-state semantics: versioning, tombstones, TTL, GC watermark,
+digest, delta application, and MTU-bounded packing (reference
+tests/test_state.py + tests/test_node_state.py coverage, rebuilt)."""
+
+from datetime import UTC, datetime, timedelta
+
+from aiocluster_tpu.core import (
+    ClusterState,
+    Delta,
+    Digest,
+    KeyValueUpdate,
+    NodeDelta,
+    NodeId,
+    NodeState,
+    VersionStatusEnum,
+    staleness_score,
+)
+from aiocluster_tpu.wire import encode_delta
+
+T0 = datetime(2026, 1, 1, tzinfo=UTC)
+N1 = NodeId("n1", 1, ("127.0.0.1", 7001))
+N2 = NodeId("n2", 2, ("127.0.0.1", 7002))
+
+
+def advance(t: datetime, seconds: float) -> datetime:
+    return t + timedelta(seconds=seconds)
+
+
+# -- NodeState owner-side ------------------------------------------------------
+
+
+def test_set_assigns_monotonic_versions():
+    ns = NodeState(N1)
+    ns.set("a", "1")
+    ns.set("b", "2")
+    assert ns.get_versioned("a").version == 1
+    assert ns.get_versioned("b").version == 2
+    assert ns.max_version == 2
+
+
+def test_set_same_value_is_noop():
+    ns = NodeState(N1)
+    ns.set("a", "1")
+    ns.set("a", "1")
+    assert ns.max_version == 1
+    ns.set("a", "2")
+    assert ns.get_versioned("a").version == 2
+
+
+def test_set_versioned_ignores_stale_but_advances_max_version():
+    ns = NodeState(N1)
+    ns.set_with_version("a", "new", 5)
+    ns.set_with_version("a", "old", 3)
+    assert ns.get_versioned("a").value == "new"
+    assert ns.max_version == 5
+
+
+def test_delete_tombstones_in_place():
+    ns = NodeState(N1)
+    ns.set("a", "1")
+    ns.delete("a", ts=T0)
+    vv = ns.get_versioned("a")
+    assert vv.status is VersionStatusEnum.DELETED
+    assert vv.value == ""
+    assert vv.version == 2
+    assert ns.get("a") is None  # hidden from reads
+    ns.delete("missing")  # no-op
+    assert ns.max_version == 2
+
+
+def test_delete_after_ttl_keeps_value():
+    ns = NodeState(N1)
+    ns.set("a", "1")
+    ns.delete_after_ttl("a", ts=T0)
+    vv = ns.get_versioned("a")
+    assert vv.status is VersionStatusEnum.DELETE_AFTER_TTL
+    assert vv.value == "1"
+    assert ns.get("a") is None
+
+
+def test_set_with_ttl_idempotent():
+    ns = NodeState(N1)
+    ns.set_with_ttl("a", "1", ts=T0)
+    ns.set_with_ttl("a", "1", ts=T0)
+    assert ns.max_version == 1
+    assert ns.get_versioned("a").status is VersionStatusEnum.DELETE_AFTER_TTL
+
+
+def test_heartbeat_first_observation_is_not_an_increase():
+    ns = NodeState(N1)
+    assert ns.apply_heartbeat(5) is False  # first observation records only
+    assert ns.heartbeat == 5
+    assert ns.apply_heartbeat(5) is False
+    assert ns.apply_heartbeat(7) is True
+    assert ns.apply_heartbeat(6) is False
+    assert ns.heartbeat == 7
+
+
+def test_gc_marked_for_deletion_advances_watermark():
+    ns = NodeState(N1)
+    ns.set("keep", "x", ts=T0)
+    ns.set("gone", "y", ts=T0)
+    ns.delete("gone", ts=T0)  # version 3 tombstone
+    grace = timedelta(hours=2)
+    ns.gc_marked_for_deletion(grace, ts=advance(T0, 3600))  # inside grace
+    assert "gone" in ns.key_values
+    ns.gc_marked_for_deletion(grace, ts=advance(T0, 7201))  # past grace
+    assert "gone" not in ns.key_values
+    assert "keep" in ns.key_values
+    assert ns.last_gc_version == 3
+
+
+# -- NodeState replica-side ----------------------------------------------------
+
+
+def delta_for(node, kvs, fve=0, lgc=0, max_version=None):
+    return NodeDelta(node, fve, lgc, kvs, max_version)
+
+
+def test_apply_delta_installs_new_keys_and_fires_hook():
+    ns = NodeState(N1)
+    seen = []
+    nd = delta_for(
+        N1,
+        [KeyValueUpdate("a", "1", 1, VersionStatusEnum.SET)],
+        max_version=1,
+    )
+    ns.apply_delta(nd, ts=T0, on_key_change=lambda *args: seen.append(args))
+    assert ns.get("a").value == "1"
+    assert ns.max_version == 1
+    assert len(seen) == 1
+    node, key, old, new = seen[0]
+    assert (node, key, old) == (N1, "a", None)
+    assert new.value == "1"
+
+
+def test_apply_delta_skips_stale_updates():
+    ns = NodeState(N1)
+    ns.set_with_version("a", "new", 5)
+    nd = delta_for(N1, [KeyValueUpdate("a", "old", 3, VersionStatusEnum.SET)])
+    ns.apply_delta(nd, ts=T0)
+    assert ns.get("a").value == "new"
+    # Updates at or below our max_version are skipped even for unseen keys:
+    nd2 = delta_for(N1, [KeyValueUpdate("b", "x", 4, VersionStatusEnum.SET)])
+    ns.apply_delta(nd2, ts=T0)
+    assert ns.get("b") is None
+
+
+def test_apply_delta_adopts_gc_watermark_purging_only_tombstones():
+    """A higher watermark purges tombstones we already hold, but live SET
+    keys with old versions are still live at the owner and must survive
+    (divergence from reference state.py:200-207, which drops them)."""
+    ns = NodeState(N1)
+    ns.set_with_version("live-old", "x", 2)
+    ns.apply_delta(
+        delta_for(N1, [KeyValueUpdate("gone", "", 4, VersionStatusEnum.DELETED)],
+                  fve=2, max_version=4),
+        ts=T0,
+    )
+    assert ns.get_versioned("gone") is not None
+    nd = delta_for(N1, [], fve=4, lgc=4, max_version=6)
+    ns.apply_delta(nd, ts=T0)
+    assert "live-old" in ns.key_values  # SET key survives watermark adoption
+    assert "gone" not in ns.key_values  # tombstone <= watermark purged
+    assert ns.last_gc_version == 4
+
+
+def test_reset_delta_wipes_replica_state():
+    """A floor-0 delta with a higher watermark is a full reset: the replica
+    rebuilds from scratch instead of merging (fixes the review-found
+    divergence where old live keys were dropped then skipped forever)."""
+    # Owner: a@1 SET; b@2 SET; delete b -> tombstone@3; GC -> watermark 3.
+    owner = NodeState(N1)
+    owner.set("a", "live", ts=T0)
+    owner.set("b", "x", ts=T0)
+    owner.delete("b", ts=T0)
+    owner.gc_marked_for_deletion(timedelta(0), ts=advance(T0, 1))
+    assert owner.last_gc_version == 3 and owner.max_version == 3
+
+    # Replica knew a@1 and b@2 (pre-delete), max_version 2.
+    replica = NodeState(N1)
+    replica.set_with_version("a", "live", 1)
+    replica.set_with_version("b", "x", 2)
+
+    # Owner-side packer decides to reset (peer_max=2 < watermark=3).
+    cs = ClusterState()
+    cs._node_states[N1] = owner
+    d = Digest()
+    d.add_node(N1, heartbeat=1, last_gc_version=0, max_version=2)
+    delta = cs.compute_partial_delta_respecting_mtu(d, 65_507, set())
+    (nd,) = delta.node_deltas
+    assert nd.from_version_excluded == 0
+
+    replica.apply_delta(nd, ts=T0)
+    # The replica converged to exactly the owner's live state.
+    assert replica.get("a").value == "live"
+    assert replica.get("b") is None
+    assert replica.max_version == owner.max_version
+    assert replica.last_gc_version == owner.last_gc_version
+
+
+def test_apply_delta_skips_deletes_covered_by_watermark():
+    ns = NodeState(N1)
+    ns.last_gc_version = 10
+    nd = delta_for(N1, [KeyValueUpdate("a", "", 8, VersionStatusEnum.DELETED)])
+    # version 8 <= watermark 10 and it's a tombstone: never installed.
+    ns.max_version = 5
+    ns.apply_delta(nd, ts=T0)
+    assert "a" not in ns.key_values
+
+
+def test_apply_delta_without_max_version_does_not_fast_forward():
+    """A truncated delta must leave max_version at the highest received
+    version so the gap is re-requested (fixes reference state.py:389)."""
+    ns = NodeState(N1)
+    nd = delta_for(
+        N1, [KeyValueUpdate("a", "1", 1, VersionStatusEnum.SET)], max_version=None
+    )
+    ns.apply_delta(nd, ts=T0)
+    assert ns.max_version == 1  # not the sender's (unknown) full version
+
+
+# -- ClusterState --------------------------------------------------------------
+
+
+def two_node_cluster():
+    cs = ClusterState()
+    a = cs.node_state_or_default(N1)
+    a.set("k1", "v1", ts=T0)
+    a.set("k2", "v2", ts=T0)
+    b = cs.node_state_or_default(N2)
+    b.set("x", "y", ts=T0)
+    return cs
+
+
+def test_compute_digest_excludes_scheduled():
+    cs = two_node_cluster()
+    d = cs.compute_digest(set())
+    assert set(d.node_digests) == {N1, N2}
+    d2 = cs.compute_digest({N2})
+    assert set(d2.node_digests) == {N1}
+
+
+def test_partial_delta_sends_everything_to_empty_peer():
+    cs = two_node_cluster()
+    delta = cs.compute_partial_delta_respecting_mtu(Digest(), 65_507, set())
+    by_node = {nd.node_id: nd for nd in delta.node_deltas}
+    assert {kv.key for kv in by_node[N1].key_values} == {"k1", "k2"}
+    assert by_node[N1].max_version == 2  # complete → stamped
+    assert by_node[N2].max_version == 1
+    # Versions are increasing within each node delta (prefix invariant).
+    versions = [kv.version for kv in by_node[N1].key_values]
+    assert versions == sorted(versions)
+
+
+def test_partial_delta_skips_up_to_date_nodes():
+    cs = two_node_cluster()
+    d = Digest()
+    d.add_node(N1, heartbeat=1, last_gc_version=0, max_version=2)
+    delta = cs.compute_partial_delta_respecting_mtu(d, 65_507, set())
+    assert {nd.node_id for nd in delta.node_deltas} == {N2}
+
+
+def test_partial_delta_respects_mtu_exactly():
+    cs = two_node_cluster()
+    full = cs.compute_partial_delta_respecting_mtu(Digest(), 65_507, set())
+    full_size = len(encode_delta(full))
+    # An MTU one byte short of the full delta must trim something.
+    trimmed = cs.compute_partial_delta_respecting_mtu(Digest(), full_size - 1, set())
+    assert len(encode_delta(trimmed)) <= full_size - 1
+    total_kvs = sum(len(nd.key_values) for nd in trimmed.node_deltas)
+    assert total_kvs < 3
+    # A truncated node delta must not claim completeness.
+    by_node = {nd.node_id: nd for nd in trimmed.node_deltas}
+    for nd in trimmed.node_deltas:
+        src = cs.node_state(nd.node_id)
+        if len(nd.key_values) < len(src.key_values):
+            assert nd.max_version is None
+
+
+def test_partial_delta_reset_rule():
+    """A peer whose knowledge predates our GC watermark restarts from 0."""
+    cs = ClusterState()
+    ns = cs.node_state_or_default(N1)
+    ns.set("a", "1", ts=T0)
+    ns.delete("a", ts=T0)
+    ns.set("b", "2", ts=T0)  # version 3
+    ns.gc_marked_for_deletion(timedelta(0), ts=advance(T0, 1))  # watermark=2
+    assert ns.last_gc_version == 2
+    d = Digest()
+    d.add_node(N1, heartbeat=1, last_gc_version=0, max_version=1)
+    delta = cs.compute_partial_delta_respecting_mtu(d, 65_507, set())
+    (nd,) = delta.node_deltas
+    assert nd.from_version_excluded == 0  # reset: resend from scratch
+    assert {kv.key for kv in nd.key_values} == {"b"}
+
+
+def test_staleness_score():
+    ns = NodeState(N1)
+    ns.set("a", "1")
+    ns.set("b", "2")
+    assert staleness_score(ns, 2) is None
+    s = staleness_score(ns, 0)
+    assert s.is_unknown and s.num_stale_key_values == 2
+    s1 = staleness_score(ns, 1)
+    assert not s1.is_unknown and s1.num_stale_key_values == 1
+
+
+def test_cluster_apply_delta_creates_nodes():
+    cs = ClusterState()
+    delta = Delta(
+        node_deltas=[
+            NodeDelta(
+                N1, 0, 0, [KeyValueUpdate("a", "1", 1, VersionStatusEnum.SET)], 1
+            )
+        ]
+    )
+    cs.apply_delta(delta, ts=T0)
+    assert cs.node_state(N1).get("a").value == "1"
+    cs.remove_node(N1)
+    assert cs.node_state(N1) is None
