@@ -1,0 +1,42 @@
+"""aiocluster_tpu: TPU-native ScuttleButt gossip cluster membership.
+
+Two backends behind one data model (SURVEY.md §7):
+
+- ``aiocluster_tpu.runtime`` — asyncio TCP/TLS backend for real clusters,
+  wire-compatible with the reference jettify/aiocluster.
+- ``aiocluster_tpu.sim`` — JAX/XLA batched simulation backend running
+  whole-cluster gossip rounds as tensor kernels on TPU.
+
+The top-level exports mirror the reference package ``__init__`` (reference
+__init__.py:1-20) with its two export bugs fixed: ``NodeState`` is exported
+under its real name and ``HookStats`` is actually importable.
+"""
+
+from .core.config import Config, FailureDetectorConfig
+from .core.identity import Address, NodeId
+from .core.kvstate import NodeState
+from .core.values import VersionedValue, VersionStatusEnum
+from .runtime.cluster import (
+    Cluster,
+    ClusterSnapshot,
+    KeyChangeCallback,
+    NodeEventCallback,
+)
+from .runtime.hooks import HookStats
+
+__all__ = (
+    "Address",
+    "Cluster",
+    "ClusterSnapshot",
+    "Config",
+    "FailureDetectorConfig",
+    "HookStats",
+    "KeyChangeCallback",
+    "NodeEventCallback",
+    "NodeId",
+    "NodeState",
+    "VersionStatusEnum",
+    "VersionedValue",
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""Asyncio socket backend: real clusters over TCP/TLS with framed proto3
+packets, wire-compatible with the reference implementation."""
+
+from .cluster import Cluster, ClusterSnapshot, KeyChangeCallback, NodeEventCallback
+from .engine import GossipEngine
+from .hooks import HookDispatcher, HookStats
+from .peers import pick_dead_node, pick_seed_node, select_gossip_targets
+from .ticker import Ticker
+from .transport import GossipTransport
+
+__all__ = (
+    "Cluster",
+    "ClusterSnapshot",
+    "GossipEngine",
+    "GossipTransport",
+    "HookDispatcher",
+    "HookStats",
+    "KeyChangeCallback",
+    "NodeEventCallback",
+    "Ticker",
+    "pick_dead_node",
+    "pick_seed_node",
+    "select_gossip_targets",
+)

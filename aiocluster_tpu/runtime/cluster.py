@@ -1,0 +1,389 @@
+"""The Cluster runtime: lifecycle, gossip rounds, KV API, hooks, snapshots.
+
+Parity: reference server.py:74-653 (``Cluster``), decomposed over the
+engine/transport/hooks/peers/ticker modules. The public surface (method
+names, constructor signature, snapshot shape) matches the reference so
+applications port without changes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from asyncio import StreamReader, StreamWriter
+from collections.abc import Awaitable, Callable, Sequence
+from contextlib import suppress
+from dataclasses import dataclass
+from datetime import timedelta
+from random import Random
+from types import TracebackType
+from typing import Self
+
+from ..core.cluster_state import ClusterState
+from ..core.config import Config
+from ..core.failure import FailureDetector
+from ..core.identity import Address, NodeId
+from ..core.kvstate import NodeState
+from ..core.messages import Ack, BadCluster, Packet, Syn, SynAck
+from ..core.values import VersionedValue
+from ..utils.logging import node_logger
+from .engine import GossipEngine
+from .hooks import HookDispatcher, HookStats
+from .peers import select_gossip_targets
+from .ticker import Ticker
+from .transport import GossipTransport
+
+KeyChangeCallback = Callable[
+    [NodeId, str, VersionedValue | None, VersionedValue], Awaitable[None]
+]
+NodeEventCallback = Callable[[NodeId], Awaitable[None]]
+
+
+@dataclass(frozen=True, slots=True)
+class ClusterSnapshot:
+    cluster_id: str
+    self_node_id: NodeId
+    node_states: dict[NodeId, NodeState]
+    live_nodes: list[NodeId]
+    dead_nodes: list[NodeId]
+
+
+class Cluster:
+    """One gossip cluster member: owns its keyspace, replicates peers'."""
+
+    def __init__(
+        self,
+        config: Config,
+        initial_key_values: dict[str, str] | None = None,
+        rng: Random | None = None,
+    ) -> None:
+        self._config = config
+        self._rng = rng if rng is not None else Random()
+        self._log = node_logger(config.node_id.long_name())
+
+        self._cluster_state = ClusterState(seed_addrs=set(config.seed_nodes))
+        self._failure_detector = FailureDetector(config.failure_detector)
+        self._hooks = HookDispatcher(
+            config.hook_queue_maxsize,
+            drain_on_shutdown=config.drain_hooks_on_shutdown,
+            shutdown_timeout=config.hook_shutdown_timeout,
+            log=self._log,
+        )
+        self._engine = GossipEngine(
+            config,
+            self._cluster_state,
+            self._failure_detector,
+            on_key_change=self._emit_key_change,
+        )
+        self._transport = GossipTransport(
+            max_payload_size=config.max_payload_size,
+            connect_timeout=config.connect_timeout,
+            read_timeout=config.read_timeout,
+            write_timeout=config.write_timeout,
+            tls_server_context=config.tls_server_context,
+            tls_client_context=config.tls_client_context,
+            tls_server_hostname=config.tls_server_hostname,
+        )
+        initial_delay = (
+            self._rng.uniform(0, config.gossip_jitter * config.gossip_interval)
+            if config.gossip_jitter > 0
+            else 0.0
+        )
+        self._ticker = Ticker(
+            self._gossip_round,
+            config.gossip_interval,
+            initial_delay=initial_delay,
+            on_error=lambda exc: self._log.exception(f"Gossip round error: {exc}"),
+        )
+        self._gossip_semaphore = asyncio.Semaphore(
+            max(1, config.max_concurrent_gossip)
+        )
+
+        self._on_node_join: list[NodeEventCallback] = []
+        self._on_node_leave: list[NodeEventCallback] = []
+        self._on_key_change: list[KeyChangeCallback] = []
+        self._prev_live: set[NodeId] = set()
+
+        self._server: asyncio.Server | None = None
+        self._started = False
+        self._closing = False
+
+        # Seed our own state: one heartbeat + initial keys.
+        me = self.self_node_state()
+        me.inc_heartbeat()
+        for key, value in (initial_key_values or {}).items():
+            me.set(key, value)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def __aenter__(self) -> Self:
+        await self.start()
+        return self
+
+    async def __aexit__(
+        self,
+        et: type[BaseException] | None = None,
+        exc: BaseException | None = None,
+        tb: TracebackType | None = None,
+    ) -> None:
+        await self.close()
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        host, port = self._config.node_id.gossip_advertise_addr
+        self._log.debug(
+            f"Booting {self.self_node_id.long_name()} "
+            f"[{self._config.cluster_id}]"
+        )
+        # Bind before latching _started so a failed boot (e.g. EADDRINUSE)
+        # leaves the cluster retryable instead of permanently half-dead.
+        self._server = await self._transport.start_server(
+            host, port, self._handle_connection
+        )
+        self._started = True
+        self._hooks.start()
+        self._ticker.start()
+
+    async def close(self) -> None:
+        if self._closing or not self._started:
+            return
+        self._closing = True
+        await self._ticker.stop()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        await self._hooks.stop()
+
+    async def shutdown(self) -> None:
+        await self.close()
+
+    # -- observable surface ---------------------------------------------------
+
+    @property
+    def self_node_id(self) -> NodeId:
+        return self._config.node_id
+
+    def self_node_state(self) -> NodeState:
+        return self._cluster_state.node_state_or_default(self._config.node_id)
+
+    def live_nodes(self) -> Sequence[NodeId]:
+        return [self.self_node_id, *self._failure_detector.live_nodes()]
+
+    def dead_nodes(self) -> Sequence[NodeId]:
+        return self._failure_detector.dead_nodes()
+
+    def snapshot(self) -> ClusterSnapshot:
+        return ClusterSnapshot(
+            cluster_id=self._config.cluster_id,
+            self_node_id=self.self_node_id,
+            node_states=dict(self._cluster_state._node_states),
+            live_nodes=self._failure_detector.live_nodes(),
+            dead_nodes=self._failure_detector.dead_nodes(),
+        )
+
+    def hook_stats(self) -> HookStats:
+        return self._hooks.stats()
+
+    # -- hooks ----------------------------------------------------------------
+
+    def on_node_join(self, callback: NodeEventCallback) -> None:
+        self._on_node_join.append(callback)
+
+    def on_node_leave(self, callback: NodeEventCallback) -> None:
+        self._on_node_leave.append(callback)
+
+    def on_key_change(self, callback: KeyChangeCallback) -> None:
+        self._on_key_change.append(callback)
+
+    def _emit_key_change(
+        self,
+        node_id: NodeId,
+        key: str,
+        old_vv: VersionedValue | None,
+        new_vv: VersionedValue,
+    ) -> None:
+        self._hooks.emit(tuple(self._on_key_change), (node_id, key, old_vv, new_vv))
+
+    def _maybe_emit_key_change(
+        self, key: str, old_vv: VersionedValue | None, new_vv: VersionedValue | None
+    ) -> None:
+        if new_vv is None:
+            return
+        if (
+            old_vv is None
+            or old_vv.version != new_vv.version
+            or old_vv.status != new_vv.status
+            or old_vv.value != new_vv.value
+        ):
+            self._emit_key_change(self.self_node_id, key, old_vv, new_vv)
+
+    # -- owner KV API ---------------------------------------------------------
+
+    def get(self, key: str) -> str | None:
+        vv = self.self_node_state().get(key)
+        return None if vv is None else vv.value
+
+    def get_versioned(self, key: str) -> VersionedValue | None:
+        return self.self_node_state().get_versioned(key)
+
+    def set(self, key: str, value: str) -> None:
+        old = self.get_versioned(key)
+        self.self_node_state().set(key, value)
+        self._maybe_emit_key_change(key, old, self.get_versioned(key))
+
+    def delete(self, key: str) -> None:
+        old = self.get_versioned(key)
+        self.self_node_state().delete(key)
+        self._maybe_emit_key_change(key, old, self.get_versioned(key))
+
+    def set_with_ttl(self, key: str, value: str) -> None:
+        old = self.get_versioned(key)
+        self.self_node_state().set_with_ttl(key, value)
+        self._maybe_emit_key_change(key, old, self.get_versioned(key))
+
+    def delete_after_ttl(self, key: str) -> None:
+        old = self.get_versioned(key)
+        self.self_node_state().delete_after_ttl(key)
+        self._maybe_emit_key_change(key, old, self.get_versioned(key))
+
+    # -- gossip round (initiator) --------------------------------------------
+
+    async def _gossip_round(self) -> None:
+        tls_names: dict[Address, str | None] = {
+            n.gossip_advertise_addr: n.tls_name
+            for n in self._cluster_state.nodes()
+            if n != self.self_node_id
+        }
+        live = {n.gossip_advertise_addr for n in self._failure_detector.live_nodes()}
+        dead = {n.gossip_advertise_addr for n in self._failure_detector.dead_nodes()}
+        peers = {
+            n.gossip_advertise_addr
+            for n in self._cluster_state.nodes()
+            if n != self.self_node_id
+        }
+        seeds = set(self._config.seed_nodes)
+
+        targets, dead_target, seed_target = select_gossip_targets(
+            peers, live, dead, seeds, rng=self._rng,
+            gossip_count=self._config.gossip_count,
+        )
+
+        self.self_node_state().inc_heartbeat()
+        self._cluster_state.gc_marked_for_deletion(
+            timedelta(seconds=self._config.marked_for_deletion_grace_period)
+        )
+
+        async with asyncio.TaskGroup() as tg:
+            for host, port in targets:
+                tg.create_task(
+                    self._gossip_with(host, port, "live", tls_names.get((host, port)))
+                )
+            if dead_target is not None:
+                host, port = dead_target
+                tg.create_task(
+                    self._gossip_with(host, port, "dead", tls_names.get(dead_target))
+                )
+            if seed_target is not None:
+                host, port = seed_target
+                tg.create_task(
+                    self._gossip_with(host, port, "seed", tls_names.get(seed_target))
+                )
+
+        self._update_liveness()
+
+    async def _gossip_with(
+        self, host: str, port: int, label: str, tls_name: str | None = None
+    ) -> None:
+        syn = self._engine.make_syn()
+        writer: StreamWriter | None = None
+        async with self._gossip_semaphore:
+            try:
+                reader, writer = await self._transport.connect(host, port, tls_name)
+                await self._transport.write_packet(writer, syn)
+                reply = await self._transport.read_packet(reader)
+                if isinstance(reply.msg, BadCluster):
+                    self._log.warning(
+                        f"Peer {host}:{port} rejected us: wrong cluster "
+                        f"(ours={self._config.cluster_id!r})"
+                    )
+                elif isinstance(reply.msg, SynAck):
+                    ack = self._engine.handle_synack(reply)
+                    await self._transport.write_packet(writer, ack)
+                else:
+                    self._log.debug(
+                        f"Unexpected gossip reply from {label} {host}:{port}"
+                    )
+            except (TimeoutError, OSError, asyncio.IncompleteReadError, ValueError) as exc:
+                self._log.debug(f"Gossip with {label} {host}:{port} failed: {exc}")
+            except Exception as exc:
+                self._log.exception(f"Gossip with {label} {host}:{port} errored: {exc}")
+            finally:
+                if writer is not None:
+                    writer.close()
+                    with suppress(Exception):
+                        await writer.wait_closed()
+
+    # -- responder side -------------------------------------------------------
+
+    async def _handle_connection(
+        self, reader: StreamReader, writer: StreamWriter
+    ) -> None:
+        # Inbound traffic counts as activity for our own heartbeat.
+        self.self_node_state().inc_heartbeat()
+        try:
+            packet = await self._transport.read_packet(reader)
+            if not isinstance(packet.msg, Syn):
+                self._log.debug("Unexpected first gossip message type")
+                return
+            if not self._verify_peer_tls_name(packet, writer):
+                self._log.warning("TLS peer identity verification failed")
+                return
+            reply = self._engine.handle_syn(packet)
+            await self._transport.write_packet(writer, reply)
+            if isinstance(reply.msg, BadCluster):
+                return
+            ack = await self._transport.read_packet(reader)
+            if not isinstance(ack.msg, Ack):
+                self._log.debug("Unexpected gossip ack message type")
+                return
+            self._engine.handle_ack(ack)
+        except (TimeoutError, OSError, asyncio.IncompleteReadError, ValueError) as exc:
+            self._log.debug(f"Server gossip error: {exc}")
+        except Exception as exc:
+            self._log.exception(f"Server gossip exception: {exc}")
+        finally:
+            writer.close()
+            with suppress(Exception):
+                await writer.wait_closed()
+
+    def _verify_peer_tls_name(self, packet: Packet, writer: StreamWriter) -> bool:
+        """mTLS policy (reference server.py:585-597): when serving TLS and
+        the peer presented a cert, some node in its digest must claim a
+        tls_name matching the cert's SAN/CN set."""
+        if self._config.tls_server_context is None:
+            return True
+        cert_names = self._transport.peer_cert_names(writer)
+        if not cert_names:
+            return True
+        if not isinstance(packet.msg, Syn):
+            return False
+        return any(
+            node_id.tls_name and node_id.tls_name in cert_names
+            for node_id in packet.msg.digest.node_digests
+        )
+
+    # -- liveness -------------------------------------------------------------
+
+    def _update_liveness(self) -> None:
+        for node_id in self._cluster_state.nodes():
+            if node_id != self.self_node_id:
+                self._failure_detector.update_node_liveness(node_id)
+        live = set(self._failure_detector.live_nodes())
+        for node_id in live - self._prev_live:
+            self._hooks.emit(tuple(self._on_node_join), (node_id,))
+        for node_id in self._prev_live - live:
+            self._hooks.emit(tuple(self._on_node_leave), (node_id,))
+        self._prev_live = live
+        for node_id in self._failure_detector.garbage_collect():
+            self._cluster_state.remove_node(node_id)

@@ -1,0 +1,92 @@
+"""Socket-free gossip protocol engine.
+
+The 3-way ScuttleButt handshake as pure state-machine steps over
+``ClusterState`` + ``FailureDetector`` (parity: reference
+server.py:327-376,599-604, which interleaves this logic with socket code).
+Keeping it transport-free means the whole protocol is unit-testable by
+passing packets between two engines — and it is exactly the contract the
+JAX sim backend vectorises.
+"""
+
+from __future__ import annotations
+
+from ..core.cluster_state import ClusterState
+from ..core.config import Config
+from ..core.failure import FailureDetector
+from ..core.identity import NodeId
+from ..core.kvstate import KeyChangeFn
+from ..core.messages import Ack, BadCluster, Digest, Packet, Syn, SynAck
+
+
+class GossipEngine:
+    """Builds and consumes handshake packets for one node."""
+
+    def __init__(
+        self,
+        config: Config,
+        cluster_state: ClusterState,
+        failure_detector: FailureDetector,
+        on_key_change: KeyChangeFn | None = None,
+    ) -> None:
+        self._config = config
+        self._state = cluster_state
+        self._fd = failure_detector
+        self._on_key_change = on_key_change
+
+    # -- digest helpers -------------------------------------------------------
+
+    def _excluded(self) -> set[NodeId]:
+        return set(self._fd.scheduled_for_deletion_nodes())
+
+    def _self_digest(self, excluded: set[NodeId]) -> Digest:
+        return self._state.compute_digest(excluded)
+
+    def _observe_digest(self, digest: Digest) -> None:
+        """Heartbeats piggyback on digests; every one we see feeds the
+        failure detector (except our own)."""
+        for node_id, nd in digest.node_digests.items():
+            if node_id == self._config.node_id:
+                continue
+            ns = self._state.node_state_or_default(node_id)
+            if ns.apply_heartbeat(nd.heartbeat):
+                self._fd.report_heartbeat(node_id)
+
+    # -- handshake steps ------------------------------------------------------
+
+    def make_syn(self) -> Packet:
+        """Initiator step 1: advertise what we know."""
+        return Packet(
+            self._config.cluster_id, Syn(self._self_digest(self._excluded()))
+        )
+
+    def handle_syn(self, packet: Packet) -> Packet:
+        """Responder step: answer a Syn with our digest plus the delta the
+        initiator is missing — or BadCluster on cluster-id mismatch."""
+        if packet.cluster_id != self._config.cluster_id:
+            return Packet(self._config.cluster_id, BadCluster())
+        assert isinstance(packet.msg, Syn)
+        self._observe_digest(packet.msg.digest)
+        excluded = self._excluded()
+        delta = self._state.compute_partial_delta_respecting_mtu(
+            packet.msg.digest, self._config.max_payload_size, excluded
+        )
+        return Packet(
+            self._config.cluster_id, SynAck(self._self_digest(excluded), delta)
+        )
+
+    def handle_synack(self, packet: Packet) -> Packet:
+        """Initiator step 2: apply the responder's delta, reply with the
+        delta the responder is missing."""
+        assert isinstance(packet.msg, SynAck)
+        excluded = self._excluded()
+        self._observe_digest(packet.msg.digest)
+        self._state.apply_delta(packet.msg.delta, on_key_change=self._on_key_change)
+        delta = self._state.compute_partial_delta_respecting_mtu(
+            packet.msg.digest, self._config.max_payload_size, excluded
+        )
+        return Packet(self._config.cluster_id, Ack(delta))
+
+    def handle_ack(self, packet: Packet) -> None:
+        """Responder final step: apply the initiator's delta."""
+        assert isinstance(packet.msg, Ack)
+        self._state.apply_delta(packet.msg.delta, on_key_change=self._on_key_change)

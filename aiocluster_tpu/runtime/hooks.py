@@ -1,0 +1,125 @@
+"""Application event hooks: node-join, node-leave, key-change.
+
+Parity: reference server.py:50-56,177-322. Design contract: the caller's
+write path never blocks on hooks. Events go through a bounded queue into a
+single background worker; when the queue is full events are *dropped and
+counted*, and callback exceptions are counted and logged but never
+propagate. Shutdown optionally drains the queue under a timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections.abc import Awaitable, Callable
+from contextlib import suppress
+from dataclasses import dataclass
+
+HookCallback = Callable[..., Awaitable[None]]
+
+
+@dataclass(frozen=True, slots=True)
+class HookStats:
+    enqueued: int
+    processed: int
+    dropped: int
+    errors: int
+    queue_size: int
+
+
+@dataclass(frozen=True, slots=True)
+class _Event:
+    callbacks: tuple[HookCallback, ...]
+    args: tuple[object, ...]
+
+
+class HookDispatcher:
+    """Bounded-queue, single-worker async event dispatcher."""
+
+    def __init__(
+        self,
+        maxsize: int,
+        *,
+        drain_on_shutdown: bool = True,
+        shutdown_timeout: float = 5.0,
+        log: logging.Logger | logging.LoggerAdapter | None = None,
+    ) -> None:
+        if maxsize <= 0:
+            raise ValueError("hook_queue_maxsize must be > 0")
+        self._queue: asyncio.Queue[_Event | None] = asyncio.Queue(maxsize=maxsize)
+        self._drain_on_shutdown = drain_on_shutdown
+        self._shutdown_timeout = shutdown_timeout
+        self._log = log or logging.getLogger(__name__)
+        self._worker: asyncio.Task[None] | None = None
+        self._enqueued = 0
+        self._processed = 0
+        self._dropped = 0
+        self._errors = 0
+
+    def start(self) -> None:
+        if self._worker is None:
+            self._worker = asyncio.create_task(self._run())
+
+    def emit(self, callbacks: tuple[HookCallback, ...], args: tuple[object, ...]) -> None:
+        """Enqueue one event; drops (and counts) when the queue is full."""
+        if not callbacks:
+            return
+        try:
+            self._queue.put_nowait(_Event(callbacks, args))
+            self._enqueued += 1
+        except asyncio.QueueFull:
+            self._dropped += 1
+
+    def stats(self) -> HookStats:
+        return HookStats(
+            enqueued=self._enqueued,
+            processed=self._processed,
+            dropped=self._dropped,
+            errors=self._errors,
+            queue_size=self._queue.qsize(),
+        )
+
+    async def _run(self) -> None:
+        while True:
+            event = await self._queue.get()
+            if event is None:
+                self._queue.task_done()
+                return
+            try:
+                for callback in event.callbacks:
+                    try:
+                        await callback(*event.args)
+                    except Exception as exc:
+                        self._errors += 1
+                        self._log.exception(f"Hook callback error: {exc}")
+            finally:
+                self._processed += 1
+                self._queue.task_done()
+
+    async def stop(self) -> None:
+        if self._worker is None:
+            return
+        worker = self._worker
+        if self._drain_on_shutdown:
+            try:
+                await asyncio.wait_for(
+                    self._queue.join(), timeout=self._shutdown_timeout
+                )
+            except TimeoutError:
+                self._dropped += self._queue.qsize()
+        else:
+            self._dropped += self._queue.qsize()
+
+        if not worker.done():
+            if self._drain_on_shutdown:
+                with suppress(asyncio.QueueFull):
+                    self._queue.put_nowait(None)
+                try:
+                    await asyncio.wait_for(worker, timeout=self._shutdown_timeout)
+                except TimeoutError:
+                    worker.cancel()
+            else:
+                worker.cancel()
+        with suppress(asyncio.CancelledError):
+            await worker
+        self._worker = None

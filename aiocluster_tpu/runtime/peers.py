@@ -1,0 +1,75 @@
+"""Random peer selection for one gossip round.
+
+Parity: reference server.py:656-717. Three picks per round:
+
+- up to ``gossip_count`` targets sampled from live peers (from *all* known
+  peers during cold start, when nothing is live yet);
+- maybe one dead peer, with probability dead/(live+1) — so dead nodes keep
+  being probed and can rejoin;
+- maybe one seed, with probability seeds/(live+dead), forced when nothing
+  is live — guards against network partitions healing around stale views.
+
+All randomness flows through an injected ``random.Random`` (determinism
+seam for tests, reference server.py:79,122).
+"""
+
+from __future__ import annotations
+
+from random import Random
+
+from ..core.identity import Address
+
+
+def pick_dead_node(
+    dead_nodes: set[Address],
+    live_count: int,
+    dead_count: int,
+    rng: Random,
+) -> Address | None:
+    if not dead_nodes:
+        return None
+    if rng.random() < dead_count / (live_count + 1):
+        return rng.choice(sorted(dead_nodes))
+    return None
+
+
+def pick_seed_node(
+    seed_nodes: set[Address],
+    live_count: int,
+    dead_count: int,
+    rng: Random,
+) -> Address | None:
+    if not seed_nodes:
+        return None
+    known = live_count + dead_count
+    probability = 1.0 if known == 0 else len(seed_nodes) / known
+    if live_count == 0 or rng.random() <= probability:
+        return rng.choice(sorted(seed_nodes))
+    return None
+
+
+def select_gossip_targets(
+    peer_nodes: set[Address],
+    live_nodes: set[Address],
+    dead_nodes: set[Address],
+    seed_nodes: set[Address],
+    rng: Random,
+    gossip_count: int = 3,
+) -> tuple[list[Address], Address | None, Address | None]:
+    """Returns (live targets, optional dead target, optional seed target)."""
+    live_count = len(live_nodes)
+    dead_count = len(dead_nodes)
+
+    pool = sorted(peer_nodes if live_count == 0 else live_nodes)
+    targets = rng.sample(pool, min(gossip_count, len(pool)))
+
+    dead_target = pick_dead_node(dead_nodes, live_count, dead_count, rng)
+
+    # Skip the seed pick when this round already reaches a seed, unless the
+    # live set is still smaller than the seed list (bootstrap phase).
+    reaches_seed = any(t in seed_nodes for t in targets)
+    seed_target = None
+    if not reaches_seed or live_count < len(seed_nodes):
+        seed_target = pick_seed_node(seed_nodes, live_count, dead_count, rng)
+
+    return targets, dead_target, seed_target

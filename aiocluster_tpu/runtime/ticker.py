@@ -1,0 +1,75 @@
+"""Drift-compensated periodic driver for the gossip round.
+
+Parity: reference ticker.py:6-57, plus the startup jitter the reference left
+as a TODO (ticker.py:27-28): with many nodes booting together, a random
+initial delay desynchronises their rounds so gossip traffic spreads out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections.abc import Awaitable, Callable
+
+
+def drift_compensated_timeout(
+    interval: float, tick_start: float, tick_stop: float
+) -> float:
+    """Sleep for the remainder of the interval after the tick's own runtime."""
+    return max(interval - (tick_stop - tick_start), 0.0)
+
+
+class Ticker:
+    """Runs ``tick`` every ``interval`` seconds on the event loop until
+    stopped; tick errors go to ``on_error`` instead of killing the loop."""
+
+    def __init__(
+        self,
+        tick: Callable[[], Awaitable[None]],
+        interval: float,
+        *,
+        initial_delay: float = 0.0,
+        timeout_func: Callable[[float, float, float], float] | None = None,
+        on_error: Callable[[Exception], None] | None = None,
+    ) -> None:
+        self._tick = tick
+        self._interval = interval
+        self._initial_delay = initial_delay
+        self._timeout_func = timeout_func or drift_compensated_timeout
+        self._on_error = on_error
+        self._task: asyncio.Task[None] | None = None
+        self._stopping = False
+
+    @property
+    def closed(self) -> bool:
+        return self._task is None
+
+    async def _run(self) -> None:
+        loop = asyncio.get_event_loop()
+        if self._initial_delay > 0:
+            await asyncio.sleep(self._initial_delay)
+        while not self._stopping:
+            started = loop.time()
+            try:
+                await self._tick()
+            except Exception as exc:
+                if self._on_error is None:
+                    raise
+                self._on_error(exc)
+            await asyncio.sleep(
+                self._timeout_func(self._interval, started, loop.time())
+            )
+
+    def start(self) -> None:
+        self._stopping = False
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task is None:
+            return
+        self._task.cancel()
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass
+        self._task = None

@@ -1,0 +1,104 @@
+"""TCP gossip transport: framed packets over asyncio streams with optional
+TLS, per-operation timeouts, and size validation.
+
+Parity: reference server.py:389-405,502-521,570-583 + utils.py:9-20. Wire
+format: 4-byte big-endian length + proto3 packet (see wire/), identical to
+the reference so both implementations interoperate on one cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+from asyncio import StreamReader, StreamWriter
+from collections.abc import Awaitable, Callable
+
+from ..core.messages import Packet
+from ..utils.framing import HEADER_SIZE, frame, read_frame_size
+from ..wire import decode_packet, encode_packet
+
+
+class GossipTransport:
+    """Connection plumbing shared by the initiator and responder roles."""
+
+    def __init__(
+        self,
+        *,
+        max_payload_size: int,
+        connect_timeout: float,
+        read_timeout: float,
+        write_timeout: float,
+        tls_server_context: ssl.SSLContext | None = None,
+        tls_client_context: ssl.SSLContext | None = None,
+        tls_server_hostname: str | None = None,
+    ) -> None:
+        self._max_payload_size = max_payload_size
+        self._connect_timeout = connect_timeout
+        self._read_timeout = read_timeout
+        self._write_timeout = write_timeout
+        self._tls_server_context = tls_server_context
+        self._tls_client_context = tls_client_context
+        self._tls_server_hostname = tls_server_hostname
+
+    # -- client side ----------------------------------------------------------
+
+    async def connect(
+        self, host: str, port: int, tls_name: str | None = None
+    ) -> tuple[StreamReader, StreamWriter]:
+        if self._tls_client_context is None:
+            coro = asyncio.open_connection(host, port)
+        else:
+            coro = asyncio.open_connection(
+                host,
+                port,
+                ssl=self._tls_client_context,
+                server_hostname=tls_name or self._tls_server_hostname or host,
+            )
+        return await asyncio.wait_for(coro, timeout=self._connect_timeout)
+
+    # -- server side ----------------------------------------------------------
+
+    async def start_server(
+        self,
+        host: str,
+        port: int,
+        handler: Callable[[StreamReader, StreamWriter], Awaitable[None]],
+    ) -> asyncio.Server:
+        return await asyncio.start_server(
+            handler, host, port, ssl=self._tls_server_context
+        )
+
+    @staticmethod
+    def peer_cert_names(writer: StreamWriter) -> set[str]:
+        """DNS/IP SANs plus CN from the peer's TLS certificate (empty when
+        the connection is plaintext or no client cert was presented)."""
+        if writer.get_extra_info("ssl_object") is None:
+            return set()
+        cert = writer.get_extra_info("peercert") or {}
+        names: set[str] = set()
+        for kind, value in cert.get("subjectAltName", []):
+            if kind in {"DNS", "IP Address"}:
+                names.add(value)
+        for rdn in cert.get("subject", []):
+            for key, value in rdn:
+                if key == "commonName":
+                    names.add(value)
+        return names
+
+    # -- framed packet I/O ----------------------------------------------------
+
+    async def read_packet(self, reader: StreamReader) -> Packet:
+        header = await asyncio.wait_for(
+            reader.readexactly(HEADER_SIZE), timeout=self._read_timeout
+        )
+        size = read_frame_size(header)
+        if size <= 0 or size > self._max_payload_size:
+            raise ValueError(f"invalid message size: {size}")
+        raw = await asyncio.wait_for(
+            reader.readexactly(size), timeout=self._read_timeout
+        )
+        return decode_packet(raw)
+
+    async def write_packet(self, writer: StreamWriter, packet: Packet) -> None:
+        writer.write(frame(encode_packet(packet)))
+        await asyncio.wait_for(writer.drain(), timeout=self._write_timeout)

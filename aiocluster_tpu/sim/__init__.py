@@ -1,0 +1,5 @@
+"""JAX/XLA simulation backend (under construction this round).
+
+Recasts one gossip round for the whole cluster as a single jit'd tensor
+step over an (N, N) version-watermark matrix — see SURVEY.md §7 steps 6-8.
+"""

@@ -7,6 +7,15 @@ import logging
 logger = logging.getLogger("aiocluster_tpu")
 
 
+class _NodeLoggerAdapter(logging.LoggerAdapter):
+    """Adapter that merges per-call ``extra`` with the node tag (the 3.13
+    ``merge_extra=True`` behavior, reimplemented for 3.12)."""
+
+    def process(self, msg, kwargs):
+        kwargs["extra"] = {**(self.extra or {}), **(kwargs.get("extra") or {})}
+        return msg, kwargs
+
+
 def node_logger(node_name: str) -> logging.LoggerAdapter:
     """Per-node adapter tagging records with the node's long name."""
-    return logging.LoggerAdapter(logger, extra={"node": node_name}, merge_extra=True)
+    return _NodeLoggerAdapter(logger, extra={"node": node_name})

@@ -1,0 +1,111 @@
+"""Embed a cluster node in an HTTP service (parity with the reference's
+FastAPI example examples/api/app.py, built on stdlib asyncio only since this
+image ships no fastapi).
+
+Endpoints:
+  GET  /state            -> cluster snapshot as JSON
+  GET  /kv/<key>         -> this node's value for <key>
+  PUT  /kv/<key>?v=...   -> set <key> on this node (replicates via gossip)
+  DELETE /kv/<key>       -> tombstone <key>
+
+Run two nodes and watch state replicate:
+  python examples/http_api.py --port 8001 --gossip 7001 --seed 7002
+  python examples/http_api.py --port 8002 --gossip 7002 --seed 7001
+  curl -X PUT 'localhost:8001/kv/color?v=red'; sleep 2
+  curl localhost:8002/state
+"""
+
+import argparse
+import asyncio
+import dataclasses
+import json
+from urllib.parse import parse_qs, urlparse
+
+from aiocluster_tpu import Cluster, Config, NodeId
+
+
+def snapshot_json(cluster: Cluster) -> str:
+    snap = cluster.snapshot()
+    return json.dumps(
+        {
+            "cluster_id": snap.cluster_id,
+            "self": snap.self_node_id.name,
+            "live": [n.name for n in snap.live_nodes],
+            "dead": [n.name for n in snap.dead_nodes],
+            "nodes": {
+                n.name: {
+                    k: s.get(k).value for k in list(s.key_values) if s.get(k)
+                }
+                for n, s in snap.node_states.items()
+            },
+            "hook_stats": dataclasses.asdict(cluster.hook_stats()),
+        },
+        indent=2,
+    )
+
+
+async def serve_http(cluster: Cluster, port: int) -> None:
+    async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request = await reader.readline()
+            while (await reader.readline()).strip():
+                pass  # drain headers
+            try:
+                method, target, _ = request.decode().split()
+            except ValueError:
+                return
+            url = urlparse(target)
+            parts = url.path.strip("/").split("/")
+            status, body = "404 Not Found", "not found"
+            if url.path == "/state" and method == "GET":
+                status, body = "200 OK", snapshot_json(cluster)
+            elif len(parts) == 2 and parts[0] == "kv":
+                key = parts[1]
+                if method == "GET":
+                    value = cluster.get(key)
+                    if value is not None:
+                        status, body = "200 OK", value
+                elif method == "PUT":
+                    value = parse_qs(url.query).get("v", [""])[0]
+                    cluster.set(key, value)
+                    status, body = "200 OK", "ok"
+                elif method == "DELETE":
+                    cluster.delete(key)
+                    status, body = "200 OK", "ok"
+            payload = body.encode()
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Length: {len(payload)}\r\n"
+                f"Content-Type: text/plain\r\n\r\n".encode() + payload
+            )
+            await writer.drain()
+        finally:
+            writer.close()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", port)
+    async with server:
+        await server.serve_forever()
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8001, help="HTTP port")
+    ap.add_argument("--gossip", type=int, default=7001, help="gossip port")
+    ap.add_argument("--seed", type=int, action="append", default=[])
+    args = ap.parse_args()
+
+    config = Config(
+        node_id=NodeId(
+            name=f"api-{args.gossip}",
+            gossip_advertise_addr=("127.0.0.1", args.gossip),
+        ),
+        gossip_interval=1.0,
+        seed_nodes=[("127.0.0.1", p) for p in args.seed],
+        cluster_id="http-api-demo",
+    )
+    async with Cluster(config) as cluster:
+        print(f"http://127.0.0.1:{args.port}/state  (gossip on :{args.gossip})")
+        await serve_http(cluster, args.port)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,48 @@
+"""Three-node in-process cluster demo (parity: reference examples/simple.py).
+
+Each node seeds off the next in a ring, publishes one key, and after a few
+gossip rounds every node's snapshot contains all three keyspaces.
+
+Run: python examples/simple.py
+"""
+
+import asyncio
+import logging
+
+from aiocluster_tpu import Cluster, Config, NodeId
+
+
+async def main() -> None:
+    ports = [7000, 7001, 7002]
+    configs = [
+        Config(
+            node_id=NodeId(
+                name=f"simple{i + 1}",
+                gossip_advertise_addr=("127.0.0.1", ports[i]),
+            ),
+            gossip_interval=1.0,
+            seed_nodes=[("127.0.0.1", ports[(i + 1) % 3])],
+            cluster_id="simple-aiocluster-tpu",
+        )
+        for i in range(3)
+    ]
+    clusters = [
+        Cluster(cfg, initial_key_values={"cluster": str(i + 1)})
+        for i, cfg in enumerate(configs)
+    ]
+
+    async with clusters[0], clusters[1], clusters[2]:
+        await asyncio.sleep(5)
+        for c in clusters:
+            snap = c.snapshot()
+            known = {
+                n.name: {k: s.get(k).value for k in s.key_values if s.get(k)}
+                for n, s in snap.node_states.items()
+            }
+            print(f"{snap.self_node_id.name}: sees {known}, "
+                  f"live={[n.name for n in snap.live_nodes]}")
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(main())

@@ -1,0 +1,134 @@
+"""Hook semantics on a real single-node cluster: non-blocking writes,
+drop-on-full, error isolation, shutdown draining (reference
+tests/test_hooks.py coverage, rebuilt)."""
+
+import asyncio
+import time
+
+from aiocluster_tpu import Cluster, Config, NodeId
+
+
+def config_for(port: int, **kwargs) -> Config:
+    return Config(
+        node_id=NodeId(name="solo", gossip_advertise_addr=("127.0.0.1", port)),
+        gossip_interval=10.0,  # effectively no gossip during these tests
+        **kwargs,
+    )
+
+
+async def test_set_does_not_block_on_slow_hooks(free_port):
+    async with Cluster(config_for(free_port)) as cluster:
+        async def slow_hook(node_id, key, old, new):
+            await asyncio.sleep(1.0)
+
+        cluster.on_key_change(slow_hook)
+        start = time.perf_counter()
+        for i in range(50):
+            cluster.set(f"k{i}", "v")
+        elapsed = time.perf_counter() - start
+        assert elapsed < 0.02  # pure enqueue, microseconds per call
+
+
+async def test_drop_on_full_counts_drops(free_port):
+    cfg = config_for(free_port, hook_queue_maxsize=1, drain_hooks_on_shutdown=False)
+    async with Cluster(cfg) as cluster:
+        blocker = asyncio.Event()
+
+        async def blocking_hook(*args):
+            await blocker.wait()
+
+        cluster.on_key_change(blocking_hook)
+        for i in range(10):
+            cluster.set(f"k{i}", "v")
+        await asyncio.sleep(0.05)
+        stats = cluster.hook_stats()
+        assert stats.dropped > 0
+        assert stats.enqueued + stats.dropped == 10
+        blocker.set()
+
+
+async def test_hook_errors_are_isolated_and_counted(free_port):
+    async with Cluster(config_for(free_port)) as cluster:
+        seen = []
+
+        async def bad_hook(*args):
+            raise RuntimeError("hook boom")
+
+        async def good_hook(node_id, key, old, new):
+            seen.append(key)
+
+        cluster.on_key_change(bad_hook)
+        cluster.on_key_change(good_hook)
+        cluster.set("a", "1")
+        await asyncio.sleep(0.05)
+        stats = cluster.hook_stats()
+        assert stats.errors == 1
+        assert seen == ["a"]  # the failing hook didn't starve the good one
+
+
+async def test_shutdown_drains_pending_hooks(free_port):
+    cluster = Cluster(config_for(free_port))
+    await cluster.start()
+    processed = []
+
+    async def hook(node_id, key, old, new):
+        await asyncio.sleep(0.01)
+        processed.append(key)
+
+    cluster.on_key_change(hook)
+    for i in range(5):
+        cluster.set(f"k{i}", "v")
+    await cluster.close()
+    assert len(processed) == 5  # drained before shutdown completed
+
+
+async def test_no_drain_shutdown_is_fast(free_port):
+    cfg = config_for(free_port, drain_hooks_on_shutdown=False)
+    cluster = Cluster(cfg)
+    await cluster.start()
+
+    async def slow_hook(*args):
+        await asyncio.sleep(10)
+
+    cluster.on_key_change(slow_hook)
+    for i in range(5):
+        cluster.set(f"k{i}", "v")
+    start = time.perf_counter()
+    await cluster.close()
+    assert time.perf_counter() - start < 1.0
+
+
+async def test_join_and_key_hooks_fire_between_nodes(free_port_factory):
+    p1, p2 = free_port_factory(), free_port_factory()
+    cfg1 = Config(
+        node_id=NodeId(name="a", gossip_advertise_addr=("127.0.0.1", p1)),
+        gossip_interval=0.02,
+        seed_nodes=[("127.0.0.1", p2)],
+        cluster_id="hooky",
+    )
+    cfg2 = Config(
+        node_id=NodeId(name="b", gossip_advertise_addr=("127.0.0.1", p2)),
+        gossip_interval=0.02,
+        seed_nodes=[("127.0.0.1", p1)],
+        cluster_id="hooky",
+    )
+    joined: list[str] = []
+    changed: list[tuple[str, str]] = []
+    async with Cluster(cfg1, initial_key_values={"color": "red"}) as c1:
+        c1.on_node_join(lambda n: _collect(joined, n.name))
+        c1.on_key_change(lambda n, k, o, v: _collect(changed, (n.name, k)))
+        async with Cluster(cfg2, initial_key_values={"color": "blue"}) as c2:
+            async with asyncio.timeout(2.0):
+                while not joined or not any(name == "b" for name, _ in changed):
+                    await asyncio.sleep(0.01)
+    assert "b" in joined
+    assert ("b", "color") in changed
+
+
+def _collect(sink, item):
+    """Sync helper producing an awaitable hook result."""
+
+    async def _inner():
+        sink.append(item)
+
+    return _inner()

@@ -1,0 +1,152 @@
+"""Multi-node integration over loopback sockets: real clusters, fast gossip
+intervals, poll-until-converged bounded by a timeout (reference
+tests/test_integration.py + tests/test_basic.py coverage, rebuilt)."""
+
+import asyncio
+
+from aiocluster_tpu import Cluster, Config, NodeId
+
+
+def make_config(name: str, port: int, seed_ports: list[int], **kwargs) -> Config:
+    return Config(
+        node_id=NodeId(name=name, gossip_advertise_addr=("127.0.0.1", port)),
+        gossip_interval=0.02,
+        seed_nodes=[("127.0.0.1", p) for p in seed_ports],
+        cluster_id="itest",
+        **kwargs,
+    )
+
+
+async def wait_for(predicate, timeout: float = 2.0):
+    async with asyncio.timeout(timeout):
+        while not predicate():
+            await asyncio.sleep(0.01)
+
+
+async def test_two_nodes_replicate_keys(free_port_factory):
+    p1, p2 = free_port_factory(), free_port_factory()
+    c1 = Cluster(make_config("one", p1, [p2]), initial_key_values={"k1": "v1"})
+    c2 = Cluster(make_config("two", p2, [p1]), initial_key_values={"k2": "v2"})
+    async with c1, c2:
+        def converged():
+            s1, s2 = c1.snapshot(), c2.snapshot()
+            n1 = next((s for n, s in s1.node_states.items() if n.name == "two"), None)
+            n2 = next((s for n, s in s2.node_states.items() if n.name == "one"), None)
+            return (
+                n1 is not None
+                and n2 is not None
+                and n1.get("k2") is not None
+                and n2.get("k1") is not None
+            )
+
+        await wait_for(converged)
+        # Liveness needs at least one inter-heartbeat interval sample, so it
+        # may trail key convergence by a couple of rounds.
+        await wait_for(
+            lambda: any(n.name == "two" for n in c1.snapshot().live_nodes)
+        )
+
+
+async def test_late_write_propagates(free_port_factory):
+    p1, p2 = free_port_factory(), free_port_factory()
+    c1 = Cluster(make_config("one", p1, [p2]))
+    c2 = Cluster(make_config("two", p2, [p1]))
+    async with c1, c2:
+        await wait_for(
+            lambda: any(n.name == "two" for n in c1.snapshot().live_nodes)
+        )
+        c2.set("fresh", "hot")
+
+        def sees_fresh():
+            for n, s in c1.snapshot().node_states.items():
+                if n.name == "two" and s.get("fresh") is not None:
+                    return s.get("fresh").value == "hot"
+            return False
+
+        await wait_for(sees_fresh)
+
+
+async def test_delete_propagates_as_tombstone(free_port_factory):
+    p1, p2 = free_port_factory(), free_port_factory()
+    c1 = Cluster(make_config("one", p1, [p2]), initial_key_values={"doomed": "x"})
+    c2 = Cluster(make_config("two", p2, [p1]))
+    async with c1, c2:
+        def c2_sees(key_present: bool):
+            def check():
+                for n, s in c2.snapshot().node_states.items():
+                    if n.name == "one":
+                        return (s.get("doomed") is not None) == key_present
+                return False
+            return check
+
+        await wait_for(c2_sees(True))
+        c1.delete("doomed")
+        await wait_for(c2_sees(False))
+
+
+async def test_three_node_ring_converges(free_port_factory):
+    ports = [free_port_factory() for _ in range(3)]
+    names = ["a", "b", "c"]
+    clusters = [
+        Cluster(
+            make_config(names[i], ports[i], [ports[(i + 1) % 3]]),
+            initial_key_values={f"key-{names[i]}": names[i]},
+        )
+        for i in range(3)
+    ]
+    async with clusters[0], clusters[1], clusters[2]:
+        def all_see_all():
+            for c in clusters:
+                snap = c.snapshot()
+                seen = {n.name for n in snap.node_states}
+                if seen != {"a", "b", "c"}:
+                    return False
+                for n, s in snap.node_states.items():
+                    if s.get(f"key-{n.name}") is None:
+                        return False
+            return True
+
+        await wait_for(all_see_all, timeout=3.0)
+        await wait_for(
+            lambda: all(len(c.live_nodes()) == 3 for c in clusters), timeout=3.0
+        )
+
+
+async def test_failed_start_is_retryable(free_port_factory):
+    """A bind failure must not latch _started (review finding): retrying
+    start() after freeing the port has to fully boot the node."""
+    port = free_port_factory()
+    blocker_cfg = make_config("blocker", port, [])
+    victim_cfg = make_config("victim", port, [])
+    blocker = Cluster(blocker_cfg)
+    victim = Cluster(victim_cfg)
+    await blocker.start()
+    try:
+        import pytest
+
+        with pytest.raises(OSError):
+            await victim.start()
+    finally:
+        await blocker.close()
+    await victim.start()  # port is free now: must actually boot
+    try:
+        assert victim._server is not None
+    finally:
+        await victim.close()
+
+
+async def test_wrong_cluster_id_never_joins(free_port_factory):
+    p1, p2 = free_port_factory(), free_port_factory()
+    c1 = Cluster(make_config("one", p1, [p2]))
+    bad = Cluster(
+        Config(
+            node_id=NodeId(name="intruder", gossip_advertise_addr=("127.0.0.1", p2)),
+            gossip_interval=0.02,
+            seed_nodes=[("127.0.0.1", p1)],
+            cluster_id="other-cluster",
+        )
+    )
+    async with c1, bad:
+        await asyncio.sleep(0.3)
+        assert all(n.name != "intruder" for n in c1.snapshot().node_states)
+        assert all(n.name != "one" for n in bad.snapshot().node_states)

@@ -1,0 +1,207 @@
+"""Runtime units: ticker, peer selection, transport validation, engine
+handshake without sockets."""
+
+import asyncio
+from random import Random
+
+import pytest
+
+from aiocluster_tpu.core import (
+    BadCluster,
+    ClusterState,
+    Config,
+    FailureDetector,
+    FailureDetectorConfig,
+    NodeId,
+    Syn,
+    SynAck,
+)
+from aiocluster_tpu.runtime.engine import GossipEngine
+from aiocluster_tpu.runtime.peers import select_gossip_targets
+from aiocluster_tpu.runtime.ticker import Ticker, drift_compensated_timeout
+from aiocluster_tpu.runtime.transport import GossipTransport
+
+N1 = NodeId("n1", 1, ("127.0.0.1", 7001))
+N2 = NodeId("n2", 2, ("127.0.0.1", 7002))
+
+
+# -- ticker --------------------------------------------------------------------
+
+
+def test_drift_compensation_math():
+    assert drift_compensated_timeout(1.0, 10.0, 10.3) == pytest.approx(0.7)
+    assert drift_compensated_timeout(1.0, 10.0, 12.0) == 0.0
+
+
+async def test_ticker_runs_and_stops():
+    count = 0
+
+    async def tick():
+        nonlocal count
+        count += 1
+
+    t = Ticker(tick, interval=0.01)
+    t.start()
+    await asyncio.sleep(0.08)
+    await t.stop()
+    assert t.closed
+    assert count >= 3
+    final = count
+    await asyncio.sleep(0.03)
+    assert count == final  # no ticks after stop
+
+
+async def test_ticker_error_callback_keeps_ticking():
+    errors = []
+    count = 0
+
+    async def tick():
+        nonlocal count
+        count += 1
+        raise RuntimeError("boom")
+
+    t = Ticker(tick, interval=0.01, on_error=errors.append)
+    t.start()
+    await asyncio.sleep(0.05)
+    await t.stop()
+    assert count >= 2
+    assert len(errors) == count
+
+
+# -- peer selection ------------------------------------------------------------
+
+
+def addr(i: int) -> tuple[str, int]:
+    return ("10.0.0.1", 7000 + i)
+
+
+def test_select_samples_from_live_nodes():
+    live = {addr(i) for i in range(10)}
+    targets, _, _ = select_gossip_targets(
+        live, live, set(), set(), rng=Random(1), gossip_count=3
+    )
+    assert len(targets) == 3
+    assert set(targets) <= live
+
+
+def test_select_cold_start_uses_all_peers():
+    peers = {addr(1), addr(2)}
+    targets, dead, seed = select_gossip_targets(
+        peers, set(), set(), set(), rng=Random(1), gossip_count=3
+    )
+    assert set(targets) == peers  # fewer peers than gossip_count: all picked
+    assert dead is None and seed is None
+
+
+def test_select_forced_seed_when_no_live():
+    seeds = {addr(9)}
+    _, _, seed = select_gossip_targets(
+        set(), set(), set(), seeds, rng=Random(1), gossip_count=3
+    )
+    assert seed == addr(9)
+
+
+def test_select_dead_node_probability():
+    # With many dead and one live, p = dead/(live+1) > 1 → always picked.
+    dead = {addr(i) for i in range(5)}
+    live = {addr(10)}
+    _, dead_pick, _ = select_gossip_targets(
+        live | dead, live, dead, set(), rng=Random(3), gossip_count=3
+    )
+    assert dead_pick in dead
+
+
+def test_select_is_deterministic_with_seeded_rng():
+    live = {addr(i) for i in range(20)}
+    a = select_gossip_targets(live, live, set(), set(), rng=Random(7), gossip_count=3)
+    b = select_gossip_targets(live, live, set(), set(), rng=Random(7), gossip_count=3)
+    assert a == b
+
+
+# -- transport size validation -------------------------------------------------
+
+
+class FakeReader:
+    def __init__(self, chunks: bytes) -> None:
+        self._data = chunks
+        self._pos = 0
+
+    async def readexactly(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise asyncio.IncompleteReadError(self._data[self._pos :], n)
+        out = self._data[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+
+def make_transport(max_payload=100) -> GossipTransport:
+    return GossipTransport(
+        max_payload_size=max_payload,
+        connect_timeout=1,
+        read_timeout=1,
+        write_timeout=1,
+    )
+
+
+async def test_read_packet_rejects_zero_size():
+    with pytest.raises(ValueError, match="invalid message size"):
+        await make_transport().read_packet(FakeReader(b"\x00\x00\x00\x00"))
+
+
+async def test_read_packet_rejects_oversize():
+    header = (101).to_bytes(4, "big")
+    with pytest.raises(ValueError, match="invalid message size"):
+        await make_transport(100).read_packet(FakeReader(header + b"x" * 101))
+
+
+async def test_read_packet_rejects_truncated_body():
+    header = (10).to_bytes(4, "big")
+    with pytest.raises(asyncio.IncompleteReadError):
+        await make_transport().read_packet(FakeReader(header + b"abc"))
+
+
+# -- engine: full handshake without sockets ------------------------------------
+
+
+def engine_for(node: NodeId, cluster_id: str = "c1") -> GossipEngine:
+    cfg = Config(node_id=node, cluster_id=cluster_id)
+    cs = ClusterState()
+    fd = FailureDetector(FailureDetectorConfig())
+    ns = cs.node_state_or_default(node)
+    ns.inc_heartbeat()
+    ns.set("name", node.name)
+    return GossipEngine(cfg, cs, fd)
+
+
+def test_engine_three_way_handshake_converges_both_sides():
+    alice = engine_for(N1)
+    bob = engine_for(N2)
+
+    syn = alice.make_syn()
+    assert isinstance(syn.msg, Syn)
+    synack = bob.handle_syn(syn)
+    assert isinstance(synack.msg, SynAck)
+    ack = alice.handle_synack(synack)
+    bob.handle_ack(ack)
+
+    assert alice._state.node_state(N2).get("name").value == "n2"
+    assert bob._state.node_state(N1).get("name").value == "n1"
+
+
+def test_engine_rejects_wrong_cluster():
+    alice = engine_for(N1, "cluster-a")
+    bob = engine_for(N2, "cluster-b")
+    reply = bob.handle_syn(alice.make_syn())
+    assert isinstance(reply.msg, BadCluster)
+    # And no state leaked across clusters.
+    assert bob._state.node_state(N1) is None
+
+
+def test_engine_heartbeats_feed_failure_detector():
+    alice = engine_for(N1)
+    bob = engine_for(N2)
+    # Two exchanges with increasing heartbeats → bob has an interval sample.
+    for _ in range(3):
+        alice._state.node_state_or_default(N1).inc_heartbeat()
+        bob.handle_syn(alice.make_syn())
+    assert bob._state.node_state(N1).heartbeat > 0

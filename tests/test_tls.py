@@ -1,0 +1,106 @@
+"""TLS/mTLS integration: CA + per-node certs generated via openssl, mutual
+verification of cert SAN names against digest-claimed tls_names (reference
+tests/test_tls_mtls.py coverage, rebuilt)."""
+
+import asyncio
+import shutil
+import ssl
+import subprocess
+
+import pytest
+
+from aiocluster_tpu import Cluster, Config, NodeId
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("openssl") is None, reason="openssl not available"
+)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """One CA plus two node certs with DNS SANs node-a / node-b."""
+    d = tmp_path_factory.mktemp("certs")
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True, cwd=d)
+
+    run("openssl", "genrsa", "-out", "ca.key", "2048")
+    run(
+        "openssl", "req", "-x509", "-new", "-key", "ca.key", "-sha256",
+        "-days", "2", "-out", "ca.pem", "-subj", "/CN=test-ca",
+    )
+    for name in ("node-a", "node-b"):
+        run("openssl", "genrsa", "-out", f"{name}.key", "2048")
+        run(
+            "openssl", "req", "-new", "-key", f"{name}.key",
+            "-out", f"{name}.csr", "-subj", f"/CN={name}",
+        )
+        ext = d / f"{name}.ext"
+        ext.write_text(
+            f"subjectAltName=DNS:{name},IP:127.0.0.1\n"
+            "keyUsage=digitalSignature,keyEncipherment\n"
+            "extendedKeyUsage=serverAuth,clientAuth\n"
+        )
+        run(
+            "openssl", "x509", "-req", "-in", f"{name}.csr", "-CA", "ca.pem",
+            "-CAkey", "ca.key", "-CAcreateserial", "-out", f"{name}.pem",
+            "-days", "2", "-sha256", "-extfile", f"{name}.ext",
+        )
+    return d
+
+
+def tls_contexts(certs, name: str) -> tuple[ssl.SSLContext, ssl.SSLContext]:
+    server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server.load_cert_chain(certs / f"{name}.pem", certs / f"{name}.key")
+    server.load_verify_locations(certs / "ca.pem")
+    server.verify_mode = ssl.CERT_REQUIRED
+
+    client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client.load_cert_chain(certs / f"{name}.pem", certs / f"{name}.key")
+    client.load_verify_locations(certs / "ca.pem")
+    return server, client
+
+
+def tls_config(certs, name: str, tls_name: str, port: int, seed_port: int) -> Config:
+    server_ctx, client_ctx = tls_contexts(certs, name)
+    return Config(
+        node_id=NodeId(
+            name=name,
+            gossip_advertise_addr=("127.0.0.1", port),
+            tls_name=tls_name,
+        ),
+        cluster_id="tls-test",
+        gossip_interval=0.05,
+        seed_nodes=[("127.0.0.1", seed_port)],
+        tls_server_context=server_ctx,
+        tls_client_context=client_ctx,
+    )
+
+
+async def test_mtls_nodes_become_live(certs, free_port_factory):
+    pa, pb = free_port_factory(), free_port_factory()
+    ca = Cluster(tls_config(certs, "node-a", "node-a", pa, pb),
+                 initial_key_values={"who": "a"})
+    cb = Cluster(tls_config(certs, "node-b", "node-b", pb, pa),
+                 initial_key_values={"who": "b"})
+    async with ca, cb:
+        async with asyncio.timeout(3.0):
+            while not (
+                any(n.name == "node-b" for n in ca.snapshot().live_nodes)
+                and any(n.name == "node-a" for n in cb.snapshot().live_nodes)
+            ):
+                await asyncio.sleep(0.02)
+        # And the replicated keys crossed the TLS channel.
+        states = {n.name: s for n, s in ca.snapshot().node_states.items()}
+        assert states["node-b"].get("who").value == "b"
+
+
+async def test_mtls_wrong_claimed_name_is_rejected(certs, free_port_factory):
+    pa, pb = free_port_factory(), free_port_factory()
+    ca = Cluster(tls_config(certs, "node-a", "node-a", pa, pb))
+    # node-b presents its real cert but *claims* an identity its cert
+    # doesn't carry — the responder must refuse the handshake.
+    cb = Cluster(tls_config(certs, "node-b", "node-not-in-cert", pb, pa))
+    async with ca, cb:
+        await asyncio.sleep(0.6)
+        assert all(n.name != "node-b" for n in ca.snapshot().live_nodes)
