@@ -1,0 +1,5 @@
+"""Cluster topology models for the simulator (ring, scale-free, full)."""
+
+from .topology import Topology, ring, scale_free
+
+__all__ = ("Topology", "ring", "scale_free")

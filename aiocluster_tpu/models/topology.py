@@ -1,0 +1,92 @@
+"""Gossip topologies as padded adjacency tensors.
+
+The reference's peer pool is implicit (whoever state has been learned
+about); the benchmark configs (BASELINE.md) name explicit topologies —
+ring-seeded, random-fanout, scale-free — so the sim takes an optional
+``(N, max_degree)`` adjacency with a ``(N,)`` degree vector and samples
+uniform neighbors by gather (ops/gossip.py::select_peers). ``None`` means
+fully-connected random fanout, the reference's steady-state behavior.
+
+Static shapes: adjacency rows are padded to max_degree with self-loops
+(sampling a pad slot can't happen because degrees bounds the draw).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Topology:
+    """Padded adjacency: node i may gossip with adjacency[i, :degrees[i]]."""
+
+    adjacency: np.ndarray  # (N, max_degree) int32
+    degrees: np.ndarray  # (N,) int32
+
+    @property
+    def n_nodes(self) -> int:
+        return self.adjacency.shape[0]
+
+
+def ring(n: int, neighbors_each_side: int = 1) -> Topology:
+    """Ring lattice: each node sees the k nearest nodes on each side —
+    BASELINE config 2's 'ring-seeded' shape."""
+    offsets = np.concatenate(
+        [np.arange(1, neighbors_each_side + 1), -np.arange(1, neighbors_each_side + 1)]
+    )
+    idx = (np.arange(n)[:, None] + offsets[None, :]) % n
+    degrees = np.full(n, len(offsets), np.int32)
+    return Topology(idx.astype(np.int32), degrees)
+
+
+def scale_free(
+    n: int, attach: int = 3, max_degree: int | None = None, seed: int = 0
+) -> Topology:
+    """Barabási–Albert preferential attachment — BASELINE config 4's
+    'scale-free' shape. Degrees are capped at ``max_degree`` (default
+    16*attach) to keep the padded adjacency tensor dense-friendly; the cap
+    sheds only the heaviest hub edges."""
+    rng = np.random.default_rng(seed)
+    cap = max_degree or 16 * attach
+    if cap <= attach:
+        raise ValueError(f"max_degree ({cap}) must exceed attach ({attach})")
+    neighbors: list[set[int]] = [set() for _ in range(n)]
+    # Seed clique over the first attach+1 nodes.
+    for i in range(attach + 1):
+        for j in range(i + 1, attach + 1):
+            neighbors[i].add(j)
+            neighbors[j].add(i)
+    repeated: list[int] = [i for i in range(attach + 1) for _ in neighbors[i]]
+    for v in range(attach + 1, n):
+        targets: set[int] = set()
+        # Preferential picks, bounded; if the degree cap starves the pool
+        # (every candidate saturated), fall back to uniform under-cap nodes
+        # and accept fewer than ``attach`` edges rather than spinning.
+        for _ in range(20 * attach):
+            if len(targets) >= attach:
+                break
+            pick = repeated[rng.integers(len(repeated))] if repeated else int(
+                rng.integers(v)
+            )
+            if pick != v and pick not in targets and len(neighbors[pick]) < cap:
+                targets.add(pick)
+        if len(targets) < attach:
+            under_cap = [
+                u for u in range(v)
+                if u not in targets and len(neighbors[u]) < cap
+            ]
+            rng.shuffle(under_cap)
+            targets.update(under_cap[: attach - len(targets)])
+        for t in targets:
+            neighbors[v].add(t)
+            neighbors[t].add(v)
+            repeated.extend((v, t))
+    degrees = np.array([max(1, len(s)) for s in neighbors], np.int32)
+    width = int(degrees.max())
+    adjacency = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, width))
+    for i, s in enumerate(neighbors):
+        row = sorted(s) if s else [i]
+        adjacency[i, : len(row)] = row
+    return Topology(adjacency.astype(np.int32), degrees)

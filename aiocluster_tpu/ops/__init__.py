@@ -1,0 +1,5 @@
+"""JAX kernels: the batched gossip round and its convergence metrics."""
+
+from .gossip import convergence_metrics, select_peers, sim_step
+
+__all__ = ("convergence_metrics", "select_peers", "sim_step")

@@ -1,0 +1,250 @@
+"""The batched gossip round: one jit'd tensor step for the whole cluster.
+
+This is the TPU recast of the object model's hot loop
+(runtime/cluster.py::_gossip_round driving engine.py's 3-way handshake,
+reference server.py:378-495): all N nodes execute one ScuttleButt round in
+a single XLA computation.
+
+Correspondence (object model → tensor op):
+
+- peer selection (runtime/peers.py)        → categorical/adjacency gather (N, fanout)
+- digest heartbeat observation             → row gather + max / scatter-max on hb_known
+- MTU-bounded delta (core packer)          → budgeted watermark advance:
+  deficits d[i,j] = max(0, w[peer,j] - w[i,j]); greedy in owner order via
+  exclusive cumsum; advance = clip(budget - cumsum_excl, 0, d)
+- bidirectional SynAck/Ack application     → initiator row add + responder
+  scatter-max (the CRDT join: versions only grow)
+- phi-accrual liveness (core/failure.py)   → vectorized tick-time phi over
+  the (N, N) heartbeat-knowledge matrix
+
+Sharding contract: every (N, N) array is sharded on the OWNER axis
+(columns). Peer-row gathers are shard-local; the only collectives are the
+(N,)-sized budget block offsets (all_gather) and convergence reductions —
+they ride ICI, everything else is local HBM traffic. Pass ``axis_name``
+when calling under shard_map; ``None`` runs the identical math on one
+device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax, random
+
+from ..sim.config import SimConfig
+from ..sim.state import SimState
+
+NEG_INF = -1e30
+
+
+def _local_owner_ids(n_local: int, axis_name: str | None) -> jax.Array:
+    """Global owner indices of this shard's columns."""
+    base = 0 if axis_name is None else lax.axis_index(axis_name) * n_local
+    return base + jnp.arange(n_local, dtype=jnp.int32)
+
+
+def _global_cumsum_excl(d: jax.Array, axis_name: str | None) -> jax.Array:
+    """Exclusive cumsum of per-owner deficits in GLOBAL owner order, given
+    the local (N, n_local) block. Cross-shard part is one (N,)-per-shard
+    all_gather — the exact global greedy order is preserved, so a sharded
+    run advances watermarks identically to a single-device run."""
+    local_excl = jnp.cumsum(d, axis=1) - d
+    if axis_name is None:
+        return local_excl
+    block_totals = lax.all_gather(d.sum(axis=1), axis_name)  # (S, N)
+    shard = lax.axis_index(axis_name)
+    n_shards = block_totals.shape[0]
+    before = jnp.arange(n_shards)[:, None] < shard
+    offset = jnp.sum(jnp.where(before, block_totals, 0), axis=0)  # (N,)
+    return local_excl + offset[:, None]
+
+
+def _budgeted_advance(
+    w_recv: jax.Array,
+    w_send: jax.Array,
+    budget: int,
+    valid: jax.Array,
+    axis_name: str | None,
+) -> jax.Array:
+    """How far each receiver row may advance toward the sender row under
+    the per-exchange key-version budget (the MTU analogue)."""
+    d = jnp.maximum(w_send - w_recv, 0) * valid[:, None]
+    c = _global_cumsum_excl(d, axis_name)
+    return jnp.clip(budget - c, 0, d)
+
+
+def select_peers(
+    key: jax.Array,
+    alive: jax.Array,
+    live_view: jax.Array | None,
+    cfg: SimConfig,
+    adjacency: jax.Array | None = None,
+    degrees: jax.Array | None = None,
+) -> jax.Array:
+    """(N, fanout) peer indices for this round.
+
+    - topology mode: uniform over each node's adjacency list;
+    - "alive" mode: uniform over truly-alive nodes (scalable default);
+    - "view" mode: each node samples from its own live_view row
+      (FD-faithful; single-device only since live_view is column-sharded).
+
+    Self/dead picks are legal — they degenerate to no-op exchanges, which
+    also stands in for the reference's failed connections to dead peers.
+    """
+    n = cfg.n_nodes
+    if adjacency is not None:
+        assert degrees is not None
+        slot = random.randint(key, (n, cfg.fanout), 0, degrees[:, None])
+        return jnp.take_along_axis(adjacency, slot, axis=1)
+    if cfg.peer_mode == "view":
+        assert live_view is not None
+        logits = jnp.where(live_view, 0.0, NEG_INF)
+        return random.categorical(key, logits, axis=-1, shape=(cfg.fanout, n)).T
+    logits = jnp.where(alive, 0.0, NEG_INF)
+    return random.categorical(key, logits, shape=(n, cfg.fanout))
+
+
+@partial(jax.jit, static_argnames=("cfg", "axis_name"), donate_argnums=(0,))
+def sim_step(
+    state: SimState,
+    key: jax.Array,
+    cfg: SimConfig,
+    axis_name: str | None = None,
+    adjacency: jax.Array | None = None,
+    degrees: jax.Array | None = None,
+) -> SimState:
+    """Advance the whole cluster by one gossip round."""
+    n = cfg.n_nodes
+    n_local = state.w.shape[1]
+    cols = jnp.arange(n_local, dtype=jnp.int32)
+    owners = _local_owner_ids(n_local, axis_name)
+    tick = state.tick + 1
+    round_key = random.fold_in(key, tick)
+    churn_key, peer_key = random.split(round_key)
+
+    # -- churn (ground truth) -------------------------------------------------
+    alive = state.alive
+    if cfg.death_rate > 0 or cfg.revival_rate > 0:
+        dk, rk = random.split(churn_key)
+        dies = random.bernoulli(dk, cfg.death_rate, (n,))
+        revives = random.bernoulli(rk, cfg.revival_rate, (n,))
+        alive = jnp.where(alive, ~dies, revives)
+
+    # -- owner-side activity: heartbeat tick + workload writes ---------------
+    heartbeat = state.heartbeat + alive.astype(jnp.int32)
+    max_version = state.max_version + cfg.writes_per_round * alive.astype(jnp.int32)
+
+    w = state.w.at[owners, cols].set(max_version[owners])
+    hb = state.hb_known.at[owners, cols].set(heartbeat[owners])
+    hb_round_start = hb
+
+    # -- peer selection -------------------------------------------------------
+    live_view = state.live_view if cfg.track_failure_detector else None
+    peers = select_peers(peer_key, alive, live_view, cfg, adjacency, degrees)
+
+    # -- fanout sub-exchanges (both handshake directions per pair) -----------
+    def exchange(c: int, carry: tuple[jax.Array, jax.Array]):
+        w, hb = carry
+        p = peers[:, c]
+        valid = alive & alive[p]
+        w_peer = w[p, :]
+        adv_in = _budgeted_advance(w, w_peer, cfg.budget, valid, axis_name)
+        adv_out = _budgeted_advance(w_peer, w, cfg.budget, valid, axis_name)
+        w_next = w + adv_in  # initiator applies the responder's delta
+        w_next = w_next.at[p].max(w_peer + adv_out)  # responder applies ours
+        hb_peer = hb[p, :]
+        vcol = valid[:, None]
+        hb_next = jnp.maximum(hb, jnp.where(vcol, hb_peer, 0))
+        hb_next = hb_next.at[p].max(jnp.where(vcol, hb, 0))
+        return w_next, hb_next
+
+    w, hb = lax.fori_loop(0, cfg.fanout, exchange, (w, hb), unroll=True)
+
+    # -- vectorized phi-accrual failure detection ----------------------------
+    if cfg.track_failure_detector:
+        increased = hb > hb_round_start
+        never_seen = state.last_change == 0
+        interval = (tick - state.last_change).astype(jnp.float32)
+        sampled = increased & ~never_seen & (interval <= cfg.max_interval_ticks)
+        # Ring-buffer semantics at the window cap (core/failure.py
+        # BoundedWindow): a new sample evicts one old sample's worth of
+        # mass (the window mean) so isum stays a window sum instead of
+        # growing with total runtime.
+        at_cap = state.icount >= cfg.window_ticks
+        evicted = jnp.where(
+            sampled & at_cap,
+            state.isum / jnp.maximum(state.icount, 1.0),
+            0.0,
+        )
+        isum = state.isum + jnp.where(sampled, interval, 0.0) - evicted
+        icount = jnp.minimum(
+            state.icount + sampled.astype(jnp.float32), cfg.window_ticks
+        )
+        last_change = jnp.where(increased, tick, state.last_change)
+        mean = (isum + cfg.prior_weight * cfg.prior_mean_ticks) / (
+            icount + cfg.prior_weight
+        )
+        elapsed = (tick - last_change).astype(jnp.float32)
+        phi = elapsed / mean
+        live = (icount >= 1) & (phi <= cfg.phi_threshold)
+        live = live.at[owners, cols].set(True)  # self-belief
+        # Going (or staying) dead wipes the window: a returning node must
+        # re-earn liveness with fresh samples (core/failure.py reset rule).
+        isum = jnp.where(live, isum, 0.0)
+        icount = jnp.where(live, icount, 0.0)
+    else:
+        last_change, isum, icount, live = (
+            state.last_change,
+            state.isum,
+            state.icount,
+            state.live_view,
+        )
+
+    return SimState(
+        tick=tick,
+        max_version=max_version,
+        heartbeat=heartbeat,
+        alive=alive,
+        w=w,
+        hb_known=hb,
+        last_change=last_change,
+        isum=isum,
+        icount=icount,
+        live_view=live,
+    )
+
+
+def convergence_metrics(
+    state: SimState, axis_name: str | None = None
+) -> dict[str, jax.Array]:
+    """How replicated the cluster is right now.
+
+    An owner counts as converged when every alive node's watermark has
+    reached the owner's max_version (dead observers and dead owners are
+    excused). ``min_fraction`` is the worst watermark/max_version ratio
+    over alive pairs — the sim's staleness_score analogue.
+    """
+    n_local = state.w.shape[1]
+    owners = _local_owner_ids(n_local, axis_name)
+    needed = state.max_version[owners][None, :]
+    alive_rows = state.alive[:, None]
+    caught_up = (state.w >= needed) | ~alive_rows
+    owner_ok = caught_up.all(axis=0) | ~state.alive[owners]
+    frac = jnp.where(
+        alive_rows & state.alive[owners][None, :],
+        state.w / jnp.maximum(needed, 1),
+        1.0,
+    )
+    n_converged = owner_ok.sum()
+    min_frac = frac.min()
+    if axis_name is not None:
+        n_converged = lax.psum(n_converged, axis_name)
+        min_frac = lax.pmin(min_frac, axis_name)
+    total = state.alive.shape[0]
+    return {
+        "converged_owners": n_converged,
+        "all_converged": n_converged == total,
+        "min_fraction": jnp.minimum(min_frac, 1.0),
+    }

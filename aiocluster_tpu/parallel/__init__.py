@@ -1,0 +1,20 @@
+"""Device-mesh sharding for the simulator: owner-axis column sharding,
+shard_map'd steps, collective convergence checks."""
+
+from .mesh import (
+    AXIS,
+    make_mesh,
+    shard_state,
+    sharded_metrics_fn,
+    sharded_step_fn,
+    state_partition_spec,
+)
+
+__all__ = (
+    "AXIS",
+    "make_mesh",
+    "shard_state",
+    "sharded_metrics_fn",
+    "sharded_step_fn",
+    "state_partition_spec",
+)

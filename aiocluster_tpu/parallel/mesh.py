@@ -1,0 +1,80 @@
+"""Device-mesh plumbing for the sharded simulator.
+
+One 1-D mesh axis ``"owners"`` shards every (N, N) knowledge matrix along
+its column (owner) axis. Rows stay unsharded, so peer-row gathers inside
+the gossip step are shard-local; the step's only ICI traffic is the
+(N,)-per-shard all_gather for global budget order and the convergence
+psum/pmin (ops/gossip.py docstring).
+
+The same ``sim_step`` runs unsharded (axis_name=None) or under shard_map
+(axis_name="owners") with bit-identical results — tested in
+tests/test_sim_sharded.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..ops.gossip import convergence_metrics, sim_step
+from ..sim.config import SimConfig
+from ..sim.state import SimState
+
+AXIS = "owners"
+
+
+def make_mesh(devices: list[Any] | None = None) -> Mesh:
+    return Mesh(jax.devices() if devices is None else devices, (AXIS,))
+
+
+def state_partition_spec() -> SimState:
+    """PartitionSpec pytree matching SimState: matrices column-sharded,
+    vectors/scalars replicated."""
+    mat = P(None, AXIS)
+    rep = P()
+    return SimState(
+        tick=rep,
+        max_version=rep,
+        heartbeat=rep,
+        alive=rep,
+        w=mat,
+        hb_known=mat,
+        last_change=mat,
+        isum=mat,
+        icount=mat,
+        live_view=mat,
+    )
+
+
+def shard_state(state: SimState, mesh: Mesh) -> SimState:
+    spec = state_partition_spec()
+    return jax.device_put(
+        state, jax.tree.map(lambda s: NamedSharding(mesh, s), spec)
+    )
+
+
+def sharded_step_fn(cfg: SimConfig, mesh: Mesh):
+    """shard_map'd single-round step: (state, key) -> state."""
+    spec = state_partition_spec()
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, P()), out_specs=spec
+    )
+    def step(state: SimState, key: jax.Array) -> SimState:
+        return sim_step(state, key, cfg, axis_name=AXIS)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def sharded_metrics_fn(mesh: Mesh):
+    spec = state_partition_spec()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec,), out_specs=P())
+    def metrics(state: SimState):
+        return convergence_metrics(state, axis_name=AXIS)
+
+    return jax.jit(metrics)

@@ -1,5 +1,27 @@
-"""JAX/XLA simulation backend (under construction this round).
+"""JAX/XLA simulation backend.
 
 Recasts one gossip round for the whole cluster as a single jit'd tensor
-step over an (N, N) version-watermark matrix — see SURVEY.md §7 steps 6-8.
+step over an (N, N) version-watermark matrix (SURVEY.md §7 steps 6-8):
+``SimConfig``/``SimState`` hold the tensors, ``Simulator`` drives chunked
+device-resident rounds (optionally sharded over a mesh), and
+``SimCluster`` offers the Cluster-shaped API with host-side values.
 """
+
+from .config import SimConfig
+from .state import SimState, init_state
+
+__all__ = ("SimCluster", "SimConfig", "SimState", "Simulator", "init_state")
+
+
+def __getattr__(name: str):
+    # Simulator/SimCluster import ops.gossip, which imports sim.state —
+    # loading them lazily keeps `import aiocluster_tpu.ops` acyclic.
+    if name == "Simulator":
+        from .simulator import Simulator
+
+        return Simulator
+    if name == "SimCluster":
+        from .simcluster import SimCluster
+
+        return SimCluster
+    raise AttributeError(name)

@@ -1,0 +1,66 @@
+"""Static configuration for the TPU gossip simulator.
+
+Maps the object-model knobs (core/config.py, reference entities.py:85-115)
+into tick-time tensor equivalents. All fields are static (hashable) so the
+config can be a jit static argument; everything data-dependent lives in
+SimState.
+
+Key modeling decisions (SURVEY.md §7 "hard parts"):
+
+- **Time is measured in gossip ticks**, not wall-clock: one step = one
+  round for the entire cluster. The failure detector's intervals/means are
+  re-derived in tick units (``prior_mean_ticks`` defaults to the
+  reference's 5 s prior over its 1 s round interval).
+- **The MTU becomes a key-version budget**: the byte-accurate greedy
+  packer (core/cluster_state.py) sends versions in increasing order until
+  the MTU; the sim advances watermarks by at most ``budget`` versions per
+  exchange, allocated greedily in owner order — same observable shape,
+  documented divergence from byte-exact packing.
+- **Peer sampling is with replacement** (a gather of categorical draws);
+  the reference samples without replacement (server.py:699). For
+  fanout ≪ N the collision probability is negligible, and a self/dead
+  pick degenerates to a no-op exchange, which also models connection
+  failure.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class SimConfig:
+    """Static shape/tuning parameters for one simulated cluster."""
+
+    n_nodes: int
+    keys_per_node: int = 16
+    fanout: int = 3  # gossip_count
+    budget: int = 64  # key-versions per exchange (the "MTU")
+    writes_per_round: int = 0  # ongoing owner writes per node per tick
+
+    # Failure detection (tick-time phi-accrual). When False, the sim tracks
+    # only KV convergence — the memory-lean mode for 100k-node runs.
+    track_failure_detector: bool = True
+    phi_threshold: float = 8.0
+    prior_mean_ticks: float = 5.0  # initial_interval in rounds
+    prior_weight: float = 5.0
+    max_interval_ticks: int = 10
+    window_ticks: int = 1000  # caps the sample count like the ring buffer
+
+    # Churn: per-tick probability that an alive node dies / a dead node
+    # rejoins (BASELINE.json config 3: "5% node churn/round").
+    death_rate: float = 0.0
+    revival_rate: float = 0.0
+
+    # Peer selection: "alive" samples uniformly over truly-alive nodes
+    # (scalable, matches epidemic-sim practice); "view" samples from each
+    # node's own live_view row (FD-faithful, needs track_failure_detector).
+    peer_mode: str = "alive"
+
+    def __post_init__(self) -> None:
+        if self.n_nodes < 2:
+            raise ValueError("need at least 2 nodes")
+        if self.peer_mode not in ("alive", "view"):
+            raise ValueError(f"unknown peer_mode: {self.peer_mode}")
+        if self.peer_mode == "view" and not self.track_failure_detector:
+            raise ValueError("peer_mode='view' requires track_failure_detector")

@@ -1,0 +1,180 @@
+"""SimCluster: the Cluster-shaped API over the tensor simulator.
+
+Gives the sim backend the same observable surface as the asyncio runtime
+(runtime/cluster.py): named nodes, owner-side set/delete, replica views,
+liveness, and snapshots — while rounds execute as one jit'd step for the
+whole cluster.
+
+Values stay host-side. Each node keeps an append-only **write log**; entry
+``v-1`` is the write that created version ``v``. Because deltas ship in
+increasing version order (core/cluster_state.py packer), replica ``i``'s
+view of owner ``j`` is exactly the first ``w[i, j]`` log entries with
+last-writer-wins per key — so materialising a replica is a host-side
+prefix fold, no per-key device state needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+import numpy as np
+from jax.sharding import Mesh
+
+from ..core.values import KeyStatus
+from ..models.topology import Topology
+from .config import SimConfig
+from .simulator import Simulator
+from .state import init_state
+
+
+@dataclass(frozen=True, slots=True)
+class _LogEntry:
+    key: str
+    value: str
+    status: KeyStatus
+
+
+class SimCluster:
+    """A whole simulated cluster with per-node KV API parity."""
+
+    def __init__(
+        self,
+        cfg: SimConfig,
+        *,
+        names: list[str] | None = None,
+        initial_key_values: dict[str, dict[str, str]] | None = None,
+        seed: int = 0,
+        mesh: Mesh | None = None,
+        topology: Topology | None = None,
+    ) -> None:
+        n = cfg.n_nodes
+        self.cfg = cfg
+        self.names = names or [f"node-{i}" for i in range(n)]
+        if len(self.names) != n:
+            raise ValueError("names length != n_nodes")
+        self._index = {name: i for i, name in enumerate(self.names)}
+        self._logs: list[list[_LogEntry]] = [[] for _ in range(n)]
+        self._pending_writes = np.zeros(n, np.int32)
+
+        initial_key_values = initial_key_values or {}
+        for name, kvs in initial_key_values.items():
+            i = self._index[name]
+            for key, value in kvs.items():
+                self._logs[i].append(_LogEntry(key, value, KeyStatus.SET))
+        # Synthetic keyspace for nodes without explicit initial values, so
+        # benchmark configs ("16 KV per node") need no per-key setup.
+        if not initial_key_values and cfg.keys_per_node > 0:
+            for i in range(n):
+                self._logs[i] = [
+                    _LogEntry(f"key-{k:04d}", f"{self.names[i]}:{k}", KeyStatus.SET)
+                    for k in range(cfg.keys_per_node)
+                ]
+        versions = np.array([len(log) for log in self._logs], np.int32)
+        # Current owner-side view per node, maintained incrementally so
+        # writes stay O(1) (replica_view still folds the log prefix).
+        self._views: list[dict[str, tuple[str, KeyStatus]]] = [
+            self._materialize(log, None) for log in self._logs
+        ]
+
+        self.sim = Simulator(
+            cfg, seed=seed, mesh=mesh, topology=topology,
+            initial_versions=versions,
+        )
+
+    # -- owner-side writes (host log + deferred device bump) ------------------
+
+    def _log_write(self, node: str, entry: _LogEntry) -> None:
+        i = self._index[node]
+        self._logs[i].append(entry)
+        self._views[i][entry.key] = (entry.value, entry.status)
+        self._pending_writes[i] += 1
+
+    def set(self, node: str, key: str, value: str) -> None:
+        current = self._views[self._index[node]].get(key)
+        if current is not None and current[1] is KeyStatus.SET and current[0] == value:
+            return  # idempotent set, parity with NodeState.set
+        self._log_write(node, _LogEntry(key, value, KeyStatus.SET))
+
+    def delete(self, node: str, key: str) -> None:
+        if key not in self._views[self._index[node]]:
+            return
+        self._log_write(node, _LogEntry(key, "", KeyStatus.DELETED))
+
+    def set_with_ttl(self, node: str, key: str, value: str) -> None:
+        current = self._views[self._index[node]].get(key)
+        if (
+            current is not None
+            and current[1] is KeyStatus.DELETE_AFTER_TTL
+            and current[0] == value
+        ):
+            return  # idempotent TTL set, parity with NodeState.set_with_ttl
+        self._log_write(node, _LogEntry(key, value, KeyStatus.DELETE_AFTER_TTL))
+
+    def get(self, node: str, key: str) -> str | None:
+        entry = self._views[self._index[node]].get(key)
+        if entry is None or entry[1] in (KeyStatus.DELETED, KeyStatus.DELETE_AFTER_TTL):
+            return None
+        return entry[0]
+
+    # -- stepping -------------------------------------------------------------
+
+    def _flush_writes(self) -> None:
+        if self._pending_writes.any():
+            state = self.sim.state
+            self.sim.state = state.replace(
+                max_version=state.max_version + self._pending_writes
+            )
+            self._pending_writes[:] = 0
+
+    def step(self, rounds: int = 1) -> None:
+        """Advance gossip; owner writes issued since the last step become
+        visible to the cluster this round (the owner's digest advertises
+        the new max_version and peers pull the delta)."""
+        self._flush_writes()
+        self.sim.run(rounds)
+
+    def run_until_converged(self, max_rounds: int = 100_000) -> int | None:
+        self._flush_writes()
+        return self.sim.run_until_converged(max_rounds)
+
+    # -- replica observation --------------------------------------------------
+
+    @staticmethod
+    def _materialize(
+        log: list[_LogEntry], prefix: int | None
+    ) -> dict[str, tuple[str, KeyStatus]]:
+        entries = log if prefix is None else log[:prefix]
+        view: dict[str, tuple[str, KeyStatus]] = {}
+        for e in entries:
+            view[e.key] = (e.value, e.status)
+        return view
+
+    def replica_view(self, observer: str, owner: str) -> dict[str, str]:
+        """What ``observer`` currently knows of ``owner``'s live keys."""
+        i, j = self._index[observer], self._index[owner]
+        watermark = int(np.asarray(self.sim.state.w[i, j]))
+        view = self._materialize(self._logs[j], watermark)
+        return {
+            k: v for k, (v, status) in view.items() if status is KeyStatus.SET
+        }
+
+    def live_view(self, observer: str) -> list[str]:
+        """Node names ``observer`` currently believes are alive (requires
+        track_failure_detector)."""
+        if not self.cfg.track_failure_detector:
+            raise ValueError("failure detector disabled for this sim")
+        i = self._index[observer]
+        row = np.asarray(self.sim.state.live_view[i])
+        return [self.names[j] for j in np.flatnonzero(row)]
+
+    def alive_nodes(self) -> list[str]:
+        mask = np.asarray(self.sim.state.alive)
+        return [self.names[i] for i in np.flatnonzero(mask)]
+
+    @property
+    def tick(self) -> int:
+        return self.sim.tick
+
+    def metrics(self) -> dict[str, np.ndarray]:
+        return self.sim.metrics()

@@ -1,0 +1,140 @@
+"""Host-side driver for the batched gossip simulator.
+
+Keeps SimState resident on device (optionally sharded over a mesh), steps
+it in jit-compiled chunks to amortise dispatch, and polls convergence with
+cheap device-scalar reads. This is the sim-backend analogue of the
+runtime's Ticker + Cluster loop — except one "tick" advances all N nodes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax import lax, random
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from ..models.topology import Topology
+from ..ops.gossip import convergence_metrics, sim_step
+from ..parallel.mesh import (
+    AXIS,
+    shard_state,
+    sharded_metrics_fn,
+    state_partition_spec,
+)
+from .config import SimConfig
+from .state import SimState, init_state
+
+
+@partial(jax.jit, static_argnames=("cfg", "m"), donate_argnums=(0,))
+def _chunk(state: SimState, key: jax.Array, cfg: SimConfig, m: int,
+           adjacency=None, degrees=None) -> SimState:
+    return lax.fori_loop(
+        0,
+        m,
+        lambda _, s: sim_step(s, key, cfg, adjacency=adjacency, degrees=degrees),
+        state,
+    )
+
+
+class Simulator:
+    """Runs one simulated cluster to convergence (or for a fixed budget).
+
+    ``mesh=None`` runs on the default device; passing a Mesh shards the
+    owner axis across it. Both paths produce bit-identical trajectories
+    for the same seed (tests/test_sim_sharded.py).
+    """
+
+    def __init__(
+        self,
+        cfg: SimConfig,
+        *,
+        seed: int = 0,
+        mesh: Mesh | None = None,
+        topology: Topology | None = None,
+        chunk: int = 8,
+        initial_versions=None,
+    ) -> None:
+        if topology is not None and topology.n_nodes != cfg.n_nodes:
+            raise ValueError("topology size != cfg.n_nodes")
+        if topology is not None and mesh is not None:
+            raise NotImplementedError("sharded topology runs land later")
+        if mesh is not None and cfg.peer_mode == "view":
+            # live_view is column-sharded under the mesh; per-row sampling
+            # over it would silently produce shard-divergent local indices.
+            raise NotImplementedError("peer_mode='view' is single-device only")
+        self.cfg = cfg
+        self.chunk = chunk
+        self._key = random.key(seed)
+        self._adj = (
+            None if topology is None else jax.numpy.asarray(topology.adjacency)
+        )
+        self._deg = (
+            None if topology is None else jax.numpy.asarray(topology.degrees)
+        )
+        self.state: SimState = init_state(cfg, initial_versions)
+        self._mesh = mesh
+        if mesh is not None:
+            self.state = shard_state(self.state, mesh)
+            self._sharded_chunks: dict[int, object] = {}
+            self._sharded_metrics = sharded_metrics_fn(mesh)
+
+    def _sharded_chunk(self, m: int):
+        """shard_map'd m-round chunk, cached per chunk length."""
+        fn = self._sharded_chunks.get(m)
+        if fn is None:
+            spec = state_partition_spec()
+            cfg = self.cfg
+
+            def chunk(s: SimState, k: jax.Array) -> SimState:
+                return lax.fori_loop(
+                    0, m, lambda _, st: sim_step(st, k, cfg, axis_name=AXIS), s
+                )
+
+            fn = jax.jit(
+                jax.shard_map(
+                    chunk, mesh=self._mesh, in_specs=(spec, P()), out_specs=spec
+                ),
+                donate_argnums=(0,),
+            )
+            self._sharded_chunks[m] = fn
+        return fn
+
+    # -- stepping -------------------------------------------------------------
+
+    def run(self, rounds: int) -> None:
+        """Advance a fixed number of gossip rounds."""
+        done = 0
+        while done < rounds:
+            m = min(self.chunk, rounds - done)
+            if self._mesh is not None:
+                self.state = self._sharded_chunk(m)(self.state, self._key)
+            else:
+                self.state = _chunk(
+                    self.state, self._key, self.cfg, m, self._adj, self._deg
+                )
+            done += m
+
+    def run_until_converged(self, max_rounds: int = 100_000) -> int | None:
+        """Step until every alive node holds every alive owner's full
+        keyspace; returns the round count, or None if max_rounds elapsed."""
+        while int(self.state.tick) < max_rounds:
+            self.run(self.chunk)
+            if bool(self.metrics()["all_converged"]):
+                return int(self.state.tick)
+        return None
+
+    # -- observation ----------------------------------------------------------
+
+    def metrics(self) -> dict[str, np.ndarray]:
+        if self._mesh is not None:
+            m = self._sharded_metrics(self.state)
+        else:
+            m = convergence_metrics(self.state)
+        return {k: np.asarray(v) for k, v in m.items()}
+
+    @property
+    def tick(self) -> int:
+        return int(self.state.tick)

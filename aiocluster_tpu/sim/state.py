@@ -1,0 +1,68 @@
+"""Tensor state for the batched gossip simulator.
+
+The object model's per-replica ``NodeState`` maps (dict of versioned keys)
+collapse into a single **watermark matrix**: deltas are sent in increasing
+version order (core/cluster_state.py packer), so what replica ``i`` knows
+about owner ``j`` is always a version-prefix of ``j``'s history —
+completely described by one integer ``w[i, j]``. Values never need to live
+on device: convergence is a property of versions alone, and SimCluster
+rematerialises replica views host-side from the watermark.
+
+Sharding: all (N, N) matrices are sharded along the **owner axis (columns,
+axis 1)** over the device mesh. Every per-exchange update touches full
+columns of a shard only (gathering peer *rows* is shard-local because rows
+are unsharded), so gossip itself needs zero cross-device traffic; only the
+budget's owner-order cumsum offsets and convergence checks are collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .config import SimConfig
+
+
+@struct.dataclass
+class SimState:
+    """One cluster's complete simulated state (a pytree of arrays)."""
+
+    tick: jax.Array  # () int32 — gossip round counter
+    max_version: jax.Array  # (N,) int32 — owner version counters
+    heartbeat: jax.Array  # (N,) int32 — owner heartbeat counters
+    alive: jax.Array  # (N,) bool — ground-truth liveness (churn target)
+    w: jax.Array  # (N, N) int32 — w[i, j]: i's watermark on owner j
+    hb_known: jax.Array  # (N, N) int32 — highest heartbeat of j known to i
+
+    # Failure-detector state (zero-sized when disabled).
+    last_change: jax.Array  # (N, N) int32 — tick of last observed hb increase
+    isum: jax.Array  # (N, N) float32 — sum of sampled intervals (ticks)
+    icount: jax.Array  # (N, N) float32 — number of samples (window-capped)
+    live_view: jax.Array  # (N, N) bool — i's belief that j is alive
+
+
+def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> SimState:
+    """Fresh cluster: every node owns ``keys_per_node`` versions (versions
+    1..K) — or per-node counts via ``initial_versions`` — knows only
+    itself, and has heartbeat 1 (parity with the runtime seeding one
+    heartbeat at boot, runtime/cluster.py)."""
+    n = cfg.n_nodes
+    fd_shape = (n, n) if cfg.track_failure_detector else (0, 0)
+    eye = jnp.eye(n, dtype=bool)
+    if initial_versions is None:
+        initial_versions = jnp.full((n,), cfg.keys_per_node, jnp.int32)
+    return SimState(
+        tick=jnp.asarray(0, jnp.int32),
+        max_version=jnp.asarray(initial_versions, jnp.int32),
+        heartbeat=jnp.ones((n,), jnp.int32),
+        alive=jnp.ones((n,), bool),
+        w=jnp.where(eye, initial_versions[None, :], 0).astype(jnp.int32),
+        hb_known=eye.astype(jnp.int32),
+        last_change=jnp.zeros(fd_shape, jnp.int32),
+        isum=jnp.zeros(fd_shape, jnp.float32),
+        icount=jnp.zeros(fd_shape, jnp.float32),
+        live_view=jnp.eye(*fd_shape, dtype=bool)
+        if cfg.track_failure_detector
+        else jnp.zeros(fd_shape, bool),
+    )

@@ -1,0 +1,162 @@
+"""Benchmark: batched TPU gossip simulation vs the pure-Python object model.
+
+Headline metric (BASELINE.md): simulated gossip rounds/second at 10k nodes
+(BASELINE config 4 scale) on one chip, full failure-detector fidelity.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+baseline is the measured speed of the equivalent pure-Python gossip round —
+the reference's own execution model — extrapolated to the same cluster
+size: per-handshake cost is fit as t(N) = a + b*N over in-memory engine
+handshakes (digest size grows with N), and a full round costs
+N * fanout * t(N). The ratio is therefore "how many times faster one
+process simulates the cluster than the asyncio object model could".
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+
+Usage: python bench.py [--smoke] [--nodes N] [--rounds R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def measure_python_handshake_seconds(n_nodes: int) -> float:
+    """Mean wall-clock of one full in-memory 3-way handshake between two
+    nodes of an ``n_nodes``-sized cluster view (object model, no sockets)."""
+    from datetime import UTC, datetime
+
+    from aiocluster_tpu.core import (
+        ClusterState,
+        Config,
+        FailureDetector,
+        FailureDetectorConfig,
+        NodeId,
+    )
+    from aiocluster_tpu.runtime.engine import GossipEngine
+    from aiocluster_tpu.wire import decode_packet, encode_packet
+
+    ts = datetime(2026, 1, 1, tzinfo=UTC)
+    nodes = [NodeId(f"n{i}", i + 1, ("h", i + 1)) for i in range(n_nodes)]
+
+    def build_engine(self_idx: int, know_all: bool) -> GossipEngine:
+        cfg = Config(node_id=nodes[self_idx], cluster_id="bench")
+        cs = ClusterState()
+        fd = FailureDetector(FailureDetectorConfig())
+        population = nodes if know_all else [nodes[self_idx]]
+        for k, node in enumerate(population):
+            ns = cs.node_state_or_default(node)
+            ns.heartbeat = 5
+            for j in range(16):
+                ns.set_with_version(f"key-{j:04d}", f"v{k}:{j}", j + 1, ts=ts)
+        return GossipEngine(cfg, cs, fd)
+
+    # One side knows the cluster, the other is missing a couple of nodes'
+    # latest keys — the steady-state shape of a real round.
+    a = build_engine(0, know_all=True)
+    b = build_engine(1, know_all=True)
+    for i in range(2, 5):
+        ns = b._state.node_state_or_default(nodes[i])
+        ns.set_with_version("fresh", "x", 17, ts=ts)
+
+    reps = 5
+    start = time.perf_counter()
+    for _ in range(reps):
+        syn = decode_packet(encode_packet(a.make_syn()))
+        synack = decode_packet(encode_packet(b.handle_syn(syn)))
+        ack = decode_packet(encode_packet(a.handle_synack(synack)))
+        b.handle_ack(ack)
+    return (time.perf_counter() - start) / reps
+
+
+def python_rounds_per_sec(n_target: int) -> float:
+    """Extrapolated whole-cluster rounds/sec for the object model."""
+    n1, n2 = 128, 512
+    t1 = measure_python_handshake_seconds(n1)
+    t2 = measure_python_handshake_seconds(n2)
+    b = max((t2 - t1) / (n2 - n1), 0.0)
+    a = max(t1 - b * n1, 1e-9)
+    t_target = a + b * n_target
+    fanout = 3
+    round_time = n_target * fanout * t_target
+    return 1.0 / round_time
+
+
+BUDGET = 2048  # key-versions per exchange ~ 64KB MTU / ~30B per kv update
+
+
+def sim_rounds_per_sec(n_nodes: int, rounds: int, log) -> tuple[float, int | None]:
+    import jax
+    import numpy as np
+
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    cfg = SimConfig(n_nodes=n_nodes, keys_per_node=16, fanout=3, budget=BUDGET)
+    sim = Simulator(cfg, seed=0, chunk=min(rounds, 16))
+    log(f"devices: {jax.devices()}")
+
+    def sync() -> int:
+        # block_until_ready does not reliably block through the axon
+        # tunnel; a scalar device->host readback provably does.
+        return int(np.asarray(sim.state.tick))
+
+    # Warm-up: compile + first chunk.
+    t0 = time.perf_counter()
+    sim.run(sim.chunk)
+    sync()
+    log(f"compile+first chunk: {time.perf_counter() - t0:.1f}s")
+
+    start = time.perf_counter()
+    sim.run(rounds)
+    end_tick = sync()
+    elapsed = time.perf_counter() - start
+    rps = rounds / elapsed
+    log(f"{rounds} rounds in {elapsed:.2f}s -> {rps:.1f} rounds/s (tick={end_tick})")
+
+    t0 = time.perf_counter()
+    converged_at = sim.run_until_converged(max_rounds=4 * n_nodes)
+    log(
+        f"rounds to full convergence @ {n_nodes} nodes: {converged_at} "
+        f"({time.perf_counter() - t0:.1f}s wall)"
+    )
+    return rps, converged_at
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true", help="small CPU-friendly run")
+    parser.add_argument("--nodes", type=int, default=None)
+    parser.add_argument("--rounds", type=int, default=None)
+    args = parser.parse_args()
+
+    n_nodes = args.nodes or (512 if args.smoke else 10_000)
+    rounds = args.rounds or (32 if args.smoke else 64)
+
+    def log(msg: str) -> None:
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    rps, converged_at = sim_rounds_per_sec(n_nodes, rounds, log)
+    baseline_rps = python_rounds_per_sec(n_nodes)
+    log(f"python object-model estimate: {baseline_rps:.4f} rounds/s")
+    result = {
+        "metric": f"sim_gossip_rounds_per_sec@{n_nodes}_nodes",
+        "value": round(rps, 2),
+        "unit": "rounds/s",
+        "vs_baseline": round(rps / baseline_rps, 1),
+        "extra": {
+            "rounds_to_convergence": converged_at,
+            "python_object_model_rounds_per_sec_est": round(baseline_rps, 4),
+            "keys_per_node": 16,
+            "fanout": 3,
+            "budget": BUDGET,
+            "failure_detector": True,
+        },
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

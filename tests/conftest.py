@@ -17,12 +17,20 @@ import socket
 
 import pytest
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Override unconditionally: the driver environment presets JAX_PLATFORMS to
+# the real TPU (and the image's site hooks merge it back as "axon,cpu"), but
+# tests must run on the virtual 8-device CPU mesh. The config update below
+# beats the env merging as long as it lands before backend initialisation.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (must come after the env setup above)
+
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.hookimpl(tryfirst=True)
