@@ -5,15 +5,21 @@ This is the TPU recast of the object model's hot loop
 reference server.py:378-495): all N nodes execute one ScuttleButt round in
 a single XLA computation.
 
-Correspondence (object model → tensor op):
+Correspondence (object model → tensor op), with the default config:
 
-- peer selection (runtime/peers.py)        → categorical/adjacency gather (N, fanout)
-- digest heartbeat observation             → row gather + max / scatter-max on hb_known
+- peer selection (runtime/peers.py)        → a random matching per
+  sub-exchange (pairing="permutation"; the responder role is a pull
+  through the inverse permutation, so the round is gather-only), or
+  categorical/adjacency draws + responder scatter-max (pairing="choice",
+  the reference's independent-sampling semantics)
+- digest heartbeat observation             → row gather + max on hb_known
 - MTU-bounded delta (core packer)          → budgeted watermark advance:
-  deficits d[i,j] = max(0, w[peer,j] - w[i,j]); greedy in owner order via
-  exclusive cumsum; advance = clip(budget - cumsum_excl, 0, d)
-- bidirectional SynAck/Ack application     → initiator row add + responder
-  scatter-max (the CRDT join: versions only grow)
+  deficits d[i,j] = max(0, w[peer,j] - w[i,j]); either proportional
+  scaling with dithered rounding (budget_policy="proportional", default)
+  or exact greedy in owner order via exclusive cumsum ("greedy", the
+  reference packer's observable behavior)
+- bidirectional SynAck/Ack application     → two budgeted pulls per pair
+  (the CRDT join: versions only grow)
 - phi-accrual liveness (core/failure.py)   → vectorized tick-time phi over
   the (N, N) heartbeat-knowledge matrix
 
@@ -61,18 +67,59 @@ def _global_cumsum_excl(d: jax.Array, axis_name: str | None) -> jax.Array:
     return local_excl + offset[:, None]
 
 
+def _hash_uniform(salt: jax.Array, n_rows: int, owner_ids: jax.Array) -> jax.Array:
+    """Deterministic (row, global-owner, salt) -> [0,1) dither pattern.
+
+    A multiplicative integer hash rather than jax PRNG so the value of
+    every element depends only on GLOBAL indices — a column-sharded run
+    therefore produces bit-identical advances to a single-device run
+    (jax.random streams are shape-dependent and would diverge per shard).
+    """
+    i = jnp.arange(n_rows, dtype=jnp.uint32)[:, None]
+    j = owner_ids.astype(jnp.uint32)[None, :]
+    h = (
+        i * jnp.uint32(0x9E3779B1)
+        ^ j * jnp.uint32(0x85EBCA77)
+        ^ salt.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+    )
+    h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
+    h = h ^ (h >> 13)
+    return h.astype(jnp.float32) * (1.0 / 4294967296.0)
+
+
 def _budgeted_advance(
     w_recv: jax.Array,
     w_send: jax.Array,
     budget: int,
     valid: jax.Array,
     axis_name: str | None,
+    policy: str,
+    salt: jax.Array,
+    owner_ids: jax.Array,
 ) -> jax.Array:
     """How far each receiver row may advance toward the sender row under
-    the per-exchange key-version budget (the MTU analogue)."""
+    the per-exchange key-version budget (the MTU analogue).
+
+    "greedy" reproduces the reference packer's prefix allocation in owner
+    order; "proportional" scales every stale owner's deficit by the same
+    factor so the total fits — cheaper (no scan) and spreads the MTU
+    across owners instead of privileging low owner indices. Proportional
+    advances are rounded with a dithered Bernoulli so the expected total
+    matches the budget exactly and progress never stalls even when every
+    scaled deficit is below one key-version.
+    """
     d = jnp.maximum(w_send - w_recv, 0) * valid[:, None]
-    c = _global_cumsum_excl(d, axis_name)
-    return jnp.clip(budget - c, 0, d)
+    if policy == "greedy":
+        c = _global_cumsum_excl(d, axis_name)
+        return jnp.clip(budget - c, 0, d)
+    total = d.sum(axis=1).astype(jnp.float32)
+    if axis_name is not None:
+        total = lax.psum(total, axis_name)
+    scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
+    x = d.astype(jnp.float32) * scale[:, None]
+    floor = jnp.floor(x)
+    bump = _hash_uniform(salt, d.shape[0], owner_ids) < (x - floor)
+    return jnp.minimum(floor.astype(jnp.int32) + bump, d)
 
 
 def select_peers(
@@ -137,30 +184,71 @@ def sim_step(
     max_version = state.max_version + cfg.writes_per_round * alive.astype(jnp.int32)
 
     w = state.w.at[owners, cols].set(max_version[owners])
-    hb = state.hb_known.at[owners, cols].set(heartbeat[owners])
+    track_hb = cfg.track_heartbeats
+    hb = (
+        state.hb_known.at[owners, cols].set(heartbeat[owners])
+        if track_hb
+        else state.hb_known
+    )
     hb_round_start = hb
 
-    # -- peer selection -------------------------------------------------------
-    live_view = state.live_view if cfg.track_failure_detector else None
-    peers = select_peers(peer_key, alive, live_view, cfg, adjacency, degrees)
+    def pull(w, hb, peer, salt):
+        """One handshake direction: the receiver applies the peer's
+        budgeted delta and absorbs its heartbeat digest."""
+        valid = alive & alive[peer]
+        adv = _budgeted_advance(
+            w, w[peer, :], cfg.budget, valid, axis_name,
+            cfg.budget_policy, salt, owners,
+        )
+        w = w + adv
+        if track_hb:
+            hb = jnp.maximum(hb, jnp.where(valid[:, None], hb[peer, :], 0))
+        return w, hb
+
+    def sub_salt(c: int, direction: int) -> jax.Array:
+        return (tick * (2 * cfg.fanout) + 2 * c + direction).astype(jnp.int32)
 
     # -- fanout sub-exchanges (both handshake directions per pair) -----------
-    def exchange(c: int, carry: tuple[jax.Array, jax.Array]):
-        w, hb = carry
-        p = peers[:, c]
-        valid = alive & alive[p]
-        w_peer = w[p, :]
-        adv_in = _budgeted_advance(w, w_peer, cfg.budget, valid, axis_name)
-        adv_out = _budgeted_advance(w_peer, w, cfg.budget, valid, axis_name)
-        w_next = w + adv_in  # initiator applies the responder's delta
-        w_next = w_next.at[p].max(w_peer + adv_out)  # responder applies ours
-        hb_peer = hb[p, :]
-        vcol = valid[:, None]
-        hb_next = jnp.maximum(hb, jnp.where(vcol, hb_peer, 0))
-        hb_next = hb_next.at[p].max(jnp.where(vcol, hb, 0))
-        return w_next, hb_next
+    if cfg.pairing == "permutation" and adjacency is None:
+        # Random matching: initiator i talks to p[i]; the responder role is
+        # the pull through the inverse permutation. Gather-only — no
+        # scatter — which is the TPU fast path.
+        for c in range(cfg.fanout):
+            p = random.permutation(random.fold_in(peer_key, c), n)
+            inv = jnp.argsort(p)
+            w, hb = pull(w, hb, p, sub_salt(c, 0))
+            w, hb = pull(w, hb, inv, sub_salt(c, 1))
+    else:
+        # Independent choice (reference semantics: inbound load varies) or
+        # adjacency-constrained topology; responder side needs scatter-max.
+        live_view = state.live_view if cfg.track_failure_detector else None
+        peers = select_peers(peer_key, alive, live_view, cfg, adjacency, degrees)
 
-    w, hb = lax.fori_loop(0, cfg.fanout, exchange, (w, hb), unroll=True)
+        def exchange(c, carry: tuple[jax.Array, jax.Array]):
+            w, hb = carry
+            p = peers[:, c]
+            valid = alive & alive[p]
+            w_peer = w[p, :]
+            adv_in = _budgeted_advance(
+                w, w_peer, cfg.budget, valid, axis_name,
+                cfg.budget_policy, sub_salt(0, 0) + 2 * c, owners,
+            )
+            adv_out = _budgeted_advance(
+                w_peer, w, cfg.budget, valid, axis_name,
+                cfg.budget_policy, sub_salt(0, 1) + 2 * c, owners,
+            )
+            w_next = w + adv_in  # initiator applies the responder's delta
+            w_next = w_next.at[p].max(w_peer + adv_out)  # responder applies ours
+            if track_hb:
+                hb_peer = hb[p, :]
+                vcol = valid[:, None]
+                hb_next = jnp.maximum(hb, jnp.where(vcol, hb_peer, 0))
+                hb_next = hb_next.at[p].max(jnp.where(vcol, hb, 0))
+            else:
+                hb_next = hb
+            return w_next, hb_next
+
+        w, hb = lax.fori_loop(0, cfg.fanout, exchange, (w, hb), unroll=True)
 
     # -- vectorized phi-accrual failure detection ----------------------------
     if cfg.track_failure_detector:

@@ -57,6 +57,31 @@ class SimConfig:
     # node's own live_view row (FD-faithful, needs track_failure_detector).
     peer_mode: str = "alive"
 
+    # Pairing of one sub-exchange:
+    # - "permutation" (default): a random matching; each node initiates one
+    #   handshake and responds to exactly one. Gather-only on TPU (the
+    #   responder role is a pull through the inverse permutation) — the
+    #   fast path.
+    # - "choice": every node independently samples a peer (reference
+    #   server.py:699 semantics: inbound load varies); needs a scatter-max
+    #   for the responder side. Topology (adjacency) runs force this mode.
+    pairing: str = "permutation"
+
+    # How an exchange's key-version budget is split across stale owners:
+    # - "proportional" (default): every stale owner's deficit is scaled by
+    #   budget/total and rounded with a dithered Bernoulli — the total per
+    #   exchange equals the budget in expectation (overshoot is a
+    #   binomial O(sqrt(stale owners)) tail, not a hard cap). Two cheap
+    #   passes, no scan.
+    # - "greedy": exact prefix allocation in global owner order — the
+    #   reference packer's observable behavior (state.py:370-413), costs a
+    #   full cumsum per exchange.
+    budget_policy: str = "proportional"
+
+    # Heartbeat knowledge matrix; required by the failure detector. Turn
+    # off (with the FD) for memory-lean pure-convergence runs at 100k.
+    track_heartbeats: bool = True
+
     def __post_init__(self) -> None:
         if self.n_nodes < 2:
             raise ValueError("need at least 2 nodes")
@@ -64,3 +89,14 @@ class SimConfig:
             raise ValueError(f"unknown peer_mode: {self.peer_mode}")
         if self.peer_mode == "view" and not self.track_failure_detector:
             raise ValueError("peer_mode='view' requires track_failure_detector")
+        if self.pairing not in ("permutation", "choice"):
+            raise ValueError(f"unknown pairing: {self.pairing}")
+        if self.peer_mode == "view" and self.pairing != "choice":
+            raise ValueError(
+                "peer_mode='view' requires pairing='choice' (a matching "
+                "cannot honour per-node live views)"
+            )
+        if self.budget_policy not in ("proportional", "greedy"):
+            raise ValueError(f"unknown budget_policy: {self.budget_policy}")
+        if self.track_failure_detector and not self.track_heartbeats:
+            raise ValueError("failure detector requires track_heartbeats")

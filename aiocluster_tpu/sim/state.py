@@ -52,13 +52,15 @@ def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> Sim
     eye = jnp.eye(n, dtype=bool)
     if initial_versions is None:
         initial_versions = jnp.full((n,), cfg.keys_per_node, jnp.int32)
+    initial_versions = jnp.asarray(initial_versions, jnp.int32)
     return SimState(
         tick=jnp.asarray(0, jnp.int32),
-        max_version=jnp.asarray(initial_versions, jnp.int32),
+        max_version=initial_versions,
         heartbeat=jnp.ones((n,), jnp.int32),
         alive=jnp.ones((n,), bool),
         w=jnp.where(eye, initial_versions[None, :], 0).astype(jnp.int32),
-        hb_known=eye.astype(jnp.int32),
+        hb_known=eye.astype(jnp.int32) if cfg.track_heartbeats
+        else jnp.zeros((0, 0), jnp.int32),
         last_change=jnp.zeros(fd_shape, jnp.int32),
         isum=jnp.zeros(fd_shape, jnp.float32),
         icount=jnp.zeros(fd_shape, jnp.float32),

@@ -67,9 +67,9 @@ def test_budget_caps_per_round_progress():
     prev = np.asarray(s.w).sum(axis=1)
     s = sim_step(s, KEY, cfg)
     gain = np.asarray(s.w).sum(axis=1) - prev - 0  # includes diag self-set
-    # Each exchange moves at most 8 versions each direction; a node joins
-    # at most 1 initiated + N responded exchanges, but *per exchange* the
-    # inbound advance is <= budget.
+    # Each exchange moves ~8 versions each direction (exactly <= budget
+    # under the greedy policy; equal to it in expectation under the
+    # default dithered-proportional policy).
     # Tight per-exchange check: nobody can have learned more than
     # budget * (1 initiated + max_inbound) versions.
     assert gain.max() <= cfg.budget * cfg.n_nodes
@@ -129,9 +129,11 @@ def test_revived_node_reearns_liveness():
     s = run_rounds(s, cfg, 35)
     assert np.asarray(s.live_view)[1:, 0].mean() < 0.05
     s = s.replace(alive=s.alive.at[0].set(True))
-    s2 = run_rounds(s, cfg, 2)
-    # One heartbeat is not liveness (window was reset on death).
-    assert np.asarray(s2.live_view)[1:, 0].mean() < 0.5
+    s2 = run_rounds(s, cfg, 1)
+    # One heartbeat is not liveness (window was reset on death): a single
+    # post-revival round gives at most one observed increase, whose
+    # interval exceeds max_interval_ticks and is discarded.
+    assert np.asarray(s2.live_view)[1:, 0].mean() < 0.2
     s3 = run_rounds(s2, cfg, 15)
     assert np.asarray(s3.live_view)[np.asarray(s3.alive)][1:, 0].mean() > 0.9
 
@@ -260,10 +262,18 @@ def test_scale_free_respects_degree_cap_and_terminates():
         scale_free(12, attach=3, max_degree=3)
 
 
+def test_view_mode_requires_choice_pairing():
+    """Review regression: a permutation matching cannot honour per-node
+    live views — the combination must be rejected, not silently ignored."""
+    with pytest.raises(ValueError):
+        SimConfig(n_nodes=16, peer_mode="view")
+
+
 def test_sharded_view_mode_rejected():
     from aiocluster_tpu.parallel.mesh import make_mesh
 
-    cfg = SimConfig(n_nodes=16, keys_per_node=2, peer_mode="view")
+    cfg = SimConfig(n_nodes=16, keys_per_node=2, peer_mode="view",
+                    pairing="choice")
     with pytest.raises(NotImplementedError):
         Simulator(cfg, mesh=make_mesh())
 
