@@ -67,24 +67,38 @@ def _global_cumsum_excl(d: jax.Array, axis_name: str | None) -> jax.Array:
     return local_excl + offset[:, None]
 
 
-def _hash_uniform(salt: jax.Array, n_rows: int, owner_ids: jax.Array) -> jax.Array:
-    """Deterministic (row, global-owner, salt) -> [0,1) dither pattern.
+def _hash_uniform(
+    salt: jax.Array,
+    n_rows: int,
+    owner_ids: jax.Array,
+    run_salt: jax.Array | None = None,
+) -> jax.Array:
+    """Deterministic (row, global-owner, salt) -> [0, 1) dither pattern.
 
     A multiplicative integer hash rather than jax PRNG so the value of
     every element depends only on GLOBAL indices — a column-sharded run
     therefore produces bit-identical advances to a single-device run
     (jax.random streams are shape-dependent and would diverge per shard).
+    ``run_salt`` mixes the run's PRNG seed in so different seeds get
+    different dither/draw patterns. The output is clipped away from both
+    endpoints: u == 1.0 exactly (a ~2^-25 uint32->float32 rounding event)
+    would otherwise make the Gumbel transform +inf and let a fallback
+    peer outrank the live tier.
     """
     i = jnp.arange(n_rows, dtype=jnp.uint32)[:, None]
     j = owner_ids.astype(jnp.uint32)[None, :]
+    s = salt.astype(jnp.uint32)
+    if run_salt is not None:
+        s = s ^ run_salt.astype(jnp.uint32)
     h = (
         i * jnp.uint32(0x9E3779B1)
         ^ j * jnp.uint32(0x85EBCA77)
-        ^ salt.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+        ^ s * jnp.uint32(0xC2B2AE3D)
     )
     h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
     h = h ^ (h >> 13)
-    return h.astype(jnp.float32) * (1.0 / 4294967296.0)
+    u = h.astype(jnp.float32) * (1.0 / 4294967296.0)
+    return jnp.clip(u, 1e-12, 1.0 - 2.0**-24)
 
 
 def _budgeted_advance(
@@ -96,6 +110,7 @@ def _budgeted_advance(
     policy: str,
     salt: jax.Array,
     owner_ids: jax.Array,
+    run_salt: jax.Array | None = None,
 ) -> jax.Array:
     """How far each receiver row may advance toward the sender row under
     the per-exchange key-version budget (the MTU analogue).
@@ -118,8 +133,51 @@ def _budgeted_advance(
     scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
     x = d.astype(jnp.float32) * scale[:, None]
     floor = jnp.floor(x)
-    bump = _hash_uniform(salt, d.shape[0], owner_ids) < (x - floor)
+    bump = _hash_uniform(salt, d.shape[0], owner_ids, run_salt) < (x - floor)
     return jnp.minimum(floor.astype(jnp.int32) + bump, d)
+
+
+def _view_peer_choice(
+    live_view: jax.Array,
+    salt: jax.Array,
+    owners: jax.Array,
+    axis_name: str | None,
+    run_salt: jax.Array | None = None,
+) -> jax.Array:
+    """One global peer index per row, sampled uniformly from the row's
+    live view via deterministic Gumbel-max.
+
+    live_view is the (N, n_local) column-sharded belief matrix; the noise
+    is the global-index hash (not jax PRNG) so each shard's local argmax
+    composes into the exact single-device draw: take the local best per
+    row, then the best across shards (one small all_gather on ICI).
+    """
+    n = live_view.shape[0]
+    u = _hash_uniform(salt, n, owners, run_salt)
+    gumbel = -jnp.log(-jnp.log(u))
+    # Two-tier draw: a live non-self peer always beats a fallback pick
+    # (the +LIVE_BONUS tier), but when a row believes no one else is live
+    # — cold start, or total isolation — it samples uniformly over all
+    # other nodes instead, the reference's cold-start/forced-seed rule
+    # (server.py:692-697,709-716). The clipped u keeps gumbel inside
+    # (-3.4, 16.7), so a bonus of 64 separates the tiers with float32
+    # ulp 7.6e-6 — no quantization-tie bias toward low owner indices.
+    is_self = owners[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+    LIVE_BONUS = 64.0
+    score = jnp.where(
+        live_view & ~is_self,
+        gumbel + LIVE_BONUS,
+        jnp.where(~is_self, gumbel, NEG_INF),
+    )
+    local_best = jnp.argmax(score, axis=1)  # (N,) local column
+    local_score = jnp.max(score, axis=1)
+    local_idx = owners[local_best]  # global owner index
+    if axis_name is None:
+        return local_idx
+    scores = lax.all_gather(local_score, axis_name)  # (S, N)
+    idxs = lax.all_gather(local_idx, axis_name)  # (S, N)
+    shard_best = jnp.argmax(scores, axis=0)
+    return jnp.take_along_axis(idxs, shard_best[None, :], axis=0)[0]
 
 
 def select_peers(
@@ -129,13 +187,18 @@ def select_peers(
     cfg: SimConfig,
     adjacency: jax.Array | None = None,
     degrees: jax.Array | None = None,
+    *,
+    axis_name: str | None = None,
+    view_salt: jax.Array | None = None,
+    run_salt: jax.Array | None = None,
 ) -> jax.Array:
     """(N, fanout) peer indices for this round.
 
     - topology mode: uniform over each node's adjacency list;
     - "alive" mode: uniform over truly-alive nodes (scalable default);
     - "view" mode: each node samples from its own live_view row
-      (FD-faithful; single-device only since live_view is column-sharded).
+      (FD-faithful) via the deterministic Gumbel-max, which is
+      shard-exact under column sharding.
 
     Self/dead picks are legal — they degenerate to no-op exchanges, which
     also stands in for the reference's failed connections to dead peers.
@@ -146,9 +209,14 @@ def select_peers(
         slot = random.randint(key, (n, cfg.fanout), 0, degrees[:, None])
         return jnp.take_along_axis(adjacency, slot, axis=1)
     if cfg.peer_mode == "view":
-        assert live_view is not None
-        logits = jnp.where(live_view, 0.0, NEG_INF)
-        return random.categorical(key, logits, axis=-1, shape=(cfg.fanout, n)).T
+        assert live_view is not None and view_salt is not None
+        n_local = live_view.shape[1]
+        owners = _local_owner_ids(n_local, axis_name)
+        cols = [
+            _view_peer_choice(live_view, view_salt + c, owners, axis_name, run_salt)
+            for c in range(cfg.fanout)
+        ]
+        return jnp.stack(cols, axis=1)
     logits = jnp.where(alive, 0.0, NEG_INF)
     return random.categorical(key, logits, shape=(n, cfg.fanout))
 
@@ -170,6 +238,10 @@ def sim_step(
     tick = state.tick + 1
     round_key = random.fold_in(key, tick)
     churn_key, peer_key = random.split(round_key)
+    # Per-run constant mixed into every hash salt so different seeds give
+    # different dither and view-draw patterns (the key is replicated, so
+    # this stays identical across shards).
+    run_salt = random.bits(key, dtype=jnp.uint32)
 
     # -- churn (ground truth) -------------------------------------------------
     alive = state.alive
@@ -198,7 +270,7 @@ def sim_step(
         valid = alive & alive[peer]
         adv = _budgeted_advance(
             w, w[peer, :], cfg.budget, valid, axis_name,
-            cfg.budget_policy, salt, owners,
+            cfg.budget_policy, salt, owners, run_salt,
         )
         w = w + adv
         if track_hb:
@@ -222,7 +294,13 @@ def sim_step(
         # Independent choice (reference semantics: inbound load varies) or
         # adjacency-constrained topology; responder side needs scatter-max.
         live_view = state.live_view if cfg.track_failure_detector else None
-        peers = select_peers(peer_key, alive, live_view, cfg, adjacency, degrees)
+        # View-mode salts live in the negatives so they never collide with
+        # the budget dither's non-negative sub_salt space.
+        view_salt = (-(tick + 1) * cfg.fanout).astype(jnp.int32)
+        peers = select_peers(
+            peer_key, alive, live_view, cfg, adjacency, degrees,
+            axis_name=axis_name, view_salt=view_salt, run_salt=run_salt,
+        )
 
         def exchange(c, carry: tuple[jax.Array, jax.Array]):
             w, hb = carry
@@ -231,11 +309,11 @@ def sim_step(
             w_peer = w[p, :]
             adv_in = _budgeted_advance(
                 w, w_peer, cfg.budget, valid, axis_name,
-                cfg.budget_policy, sub_salt(0, 0) + 2 * c, owners,
+                cfg.budget_policy, sub_salt(0, 0) + 2 * c, owners, run_salt,
             )
             adv_out = _budgeted_advance(
                 w_peer, w, cfg.budget, valid, axis_name,
-                cfg.budget_policy, sub_salt(0, 1) + 2 * c, owners,
+                cfg.budget_policy, sub_salt(0, 1) + 2 * c, owners, run_salt,
             )
             w_next = w + adv_in  # initiator applies the responder's delta
             w_next = w_next.at[p].max(w_peer + adv_out)  # responder applies ours

@@ -57,17 +57,43 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
     )
 
 
-def sharded_step_fn(cfg: SimConfig, mesh: Mesh):
-    """shard_map'd single-round step: (state, key) -> state."""
+def sharded_chunk_fn(
+    cfg: SimConfig, mesh: Mesh, rounds: int = 1, *, topology: bool = False
+):
+    """shard_map'd fn advancing ``rounds`` gossip rounds:
+    (state, key[, adjacency, degrees]) -> state.
+
+    With ``topology=True`` adjacency/degrees are extra replicated args —
+    their entries are global row indices, and peer-row gathers/scatters
+    stay shard-local because rows of the column-sharded matrices are
+    unsharded.
+    """
+    from jax import lax
+
     spec = state_partition_spec()
+    extra_specs = (P(None, None), P(None)) if topology else ()
 
-    @partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, P()), out_specs=spec
+    def body(state: SimState, key: jax.Array, *topo) -> SimState:
+        adj, deg = topo if topology else (None, None)
+        return lax.fori_loop(
+            0,
+            rounds,
+            lambda _, st: sim_step(
+                st, key, cfg, axis_name=AXIS, adjacency=adj, degrees=deg
+            ),
+            state,
+            unroll=False,
+        )
+
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, P(), *extra_specs), out_specs=spec
     )
-    def step(state: SimState, key: jax.Array) -> SimState:
-        return sim_step(state, key, cfg, axis_name=AXIS)
+    return jax.jit(fn, donate_argnums=(0,))
 
-    return jax.jit(step, donate_argnums=(0,))
+
+def sharded_step_fn(cfg: SimConfig, mesh: Mesh, *, topology: bool = False):
+    """shard_map'd single-round step: (state, key[, adj, deg]) -> state."""
+    return sharded_chunk_fn(cfg, mesh, 1, topology=topology)
 
 
 def sharded_metrics_fn(mesh: Mesh):

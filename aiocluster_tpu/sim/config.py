@@ -52,9 +52,12 @@ class SimConfig:
     death_rate: float = 0.0
     revival_rate: float = 0.0
 
-    # Peer selection: "alive" samples uniformly over truly-alive nodes
-    # (scalable, matches epidemic-sim practice); "view" samples from each
-    # node's own live_view row (FD-faithful, needs track_failure_detector).
+    # Peer selection — only consulted when pairing="choice" (the default
+    # pairing="permutation" matches over ALL nodes; dead matches no-op,
+    # standing in for the reference's failed connections):
+    # "alive" samples uniformly over truly-alive nodes (scalable, matches
+    # epidemic-sim practice); "view" samples from each node's own
+    # live_view row (FD-faithful, needs track_failure_detector).
     peer_mode: str = "alive"
 
     # Pairing of one sub-exchange:

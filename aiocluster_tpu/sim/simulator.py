@@ -14,15 +14,13 @@ import jax
 import numpy as np
 from jax import lax, random
 from jax.sharding import Mesh
-from jax.sharding import PartitionSpec as P
 
 from ..models.topology import Topology
 from ..ops.gossip import convergence_metrics, sim_step
 from ..parallel.mesh import (
-    AXIS,
     shard_state,
+    sharded_chunk_fn,
     sharded_metrics_fn,
-    state_partition_spec,
 )
 from .config import SimConfig
 from .state import SimState, init_state
@@ -59,12 +57,6 @@ class Simulator:
     ) -> None:
         if topology is not None and topology.n_nodes != cfg.n_nodes:
             raise ValueError("topology size != cfg.n_nodes")
-        if topology is not None and mesh is not None:
-            raise NotImplementedError("sharded topology runs land later")
-        if mesh is not None and cfg.peer_mode == "view":
-            # live_view is column-sharded under the mesh; per-row sampling
-            # over it would silently produce shard-divergent local indices.
-            raise NotImplementedError("peer_mode='view' is single-device only")
         self.cfg = cfg
         self.chunk = chunk
         self._key = random.key(seed)
@@ -85,19 +77,8 @@ class Simulator:
         """shard_map'd m-round chunk, cached per chunk length."""
         fn = self._sharded_chunks.get(m)
         if fn is None:
-            spec = state_partition_spec()
-            cfg = self.cfg
-
-            def chunk(s: SimState, k: jax.Array) -> SimState:
-                return lax.fori_loop(
-                    0, m, lambda _, st: sim_step(st, k, cfg, axis_name=AXIS), s
-                )
-
-            fn = jax.jit(
-                jax.shard_map(
-                    chunk, mesh=self._mesh, in_specs=(spec, P()), out_specs=spec
-                ),
-                donate_argnums=(0,),
+            fn = sharded_chunk_fn(
+                self.cfg, self._mesh, m, topology=self._adj is not None
             )
             self._sharded_chunks[m] = fn
         return fn
@@ -110,7 +91,12 @@ class Simulator:
         while done < rounds:
             m = min(self.chunk, rounds - done)
             if self._mesh is not None:
-                self.state = self._sharded_chunk(m)(self.state, self._key)
+                if self._adj is not None:
+                    self.state = self._sharded_chunk(m)(
+                        self.state, self._key, self._adj, self._deg
+                    )
+                else:
+                    self.state = self._sharded_chunk(m)(self.state, self._key)
             else:
                 self.state = _chunk(
                     self.state, self._key, self.cfg, m, self._adj, self._deg

@@ -73,3 +73,39 @@ def test_sharded_state_actually_sharded():
     shard_shapes = {s.data.shape for s in state.w.addressable_shards}
     assert shard_shapes == {(64, 8)}
     assert len(sharding.device_set) == 8
+
+
+def test_sharded_topology_step_bit_identical_to_single_device():
+    from aiocluster_tpu.models.topology import ring
+
+    cfg = SimConfig(n_nodes=64, keys_per_node=8, budget=16)
+    topo = ring(64, neighbors_each_side=2)
+    adj = jax.numpy.asarray(topo.adjacency)
+    deg = jax.numpy.asarray(topo.degrees)
+    mesh = make_mesh()
+    step = sharded_step_fn(cfg, mesh, topology=True)
+
+    sharded = shard_state(init_state(cfg), mesh)
+    single = init_state(cfg)
+    for _ in range(10):
+        sharded = step(sharded, KEY, adj, deg)
+        single = sim_step(single, KEY, cfg, adjacency=adj, degrees=deg)
+
+    assert np.array_equal(np.asarray(sharded.w), np.asarray(single.w))
+    assert np.array_equal(
+        np.asarray(sharded.live_view), np.asarray(single.live_view)
+    )
+    assert int(sharded.tick) == int(single.tick) == 10
+
+
+def test_sharded_simulator_with_scale_free_topology():
+    from aiocluster_tpu.models.topology import scale_free
+
+    cfg = SimConfig(n_nodes=96, keys_per_node=8, track_failure_detector=False)
+    topo = scale_free(96, attach=3, seed=5)
+    sharded = Simulator(cfg, mesh=make_mesh(), seed=7, topology=topo)
+    single = Simulator(cfg, seed=7, topology=topo)
+    r_sharded = sharded.run_until_converged(2000)
+    r_single = single.run_until_converged(2000)
+    assert r_sharded is not None
+    assert r_sharded == r_single
