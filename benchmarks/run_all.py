@@ -1,0 +1,314 @@
+"""Run the five BASELINE.md benchmark configs and emit one JSON line each.
+
+| # | Config (BASELINE.md)                                   | Backend        |
+|---|--------------------------------------------------------|----------------|
+| 1 | 3-node in-proc cluster, 1 KV each (examples/simple.py) | asyncio sockets|
+| 2 | 64-node ring-seeded sim, 16 KV/node                    | JAX sim        |
+| 3 | 1k-node random-fanout(3), phi-accrual @ 5% churn/round | JAX sim        |
+| 4 | 10k-node scale-free topology, batched digest/delta     | one TPU chip   |
+| 5 | 100k-node epidemic, sharded over the device mesh       | TPU v5e-8      |
+
+Config 5 needs ~40 GB for the watermark matrix; it only runs when the
+visible mesh has enough devices x memory, otherwise it is scaled to the
+largest population that fits and flagged "scaled": true in its record.
+
+Usage: python benchmarks/run_all.py [--smoke] [--configs 1,2,3]
+Diagnostics to stderr; one JSON line per config to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def emit(record: dict) -> None:
+    print(json.dumps(record), flush=True)
+
+
+def _sync_tick(sim) -> int:
+    """Device->host scalar readback: the reliable barrier through the
+    axon tunnel (block_until_ready is not; see bench.py)."""
+    import numpy as np
+
+    return int(np.asarray(sim.state.tick))
+
+
+def _timed_rounds_per_sec(sim, rounds: int) -> float:
+    sim.run(sim.chunk)  # warm-up: compile + first chunk
+    _sync_tick(sim)
+    start = time.perf_counter()
+    sim.run(rounds)
+    _sync_tick(sim)
+    return rounds / (time.perf_counter() - start)
+
+
+# -- config 1: asyncio 3-node loopback cluster --------------------------------
+
+
+async def _config1(gossip_interval: float) -> dict:
+    """Wall-clock for a 3-node socket cluster to fully replicate one KV
+    per node (the reference's examples/simple.py shape, reference
+    examples/simple.py:14-48)."""
+    import socket
+
+    from aiocluster_tpu import Cluster, Config, NodeId
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port() for _ in range(3)]
+    configs = [
+        Config(
+            node_id=NodeId(
+                name=f"bench{i}", gossip_advertise_addr=("127.0.0.1", ports[i])
+            ),
+            gossip_interval=gossip_interval,
+            seed_nodes=[("127.0.0.1", ports[(i + 1) % 3])],
+            cluster_id="bench1",
+        )
+        for i in range(3)
+    ]
+    clusters = [
+        Cluster(cfg, initial_key_values={"kv": str(i)})
+        for i, cfg in enumerate(configs)
+    ]
+    for c in clusters:
+        await c.start()
+    start = time.perf_counter()
+    try:
+        async with asyncio.timeout(30.0):
+            while True:
+                done = all(
+                    len(c.snapshot().node_states) == 3
+                    and all(
+                        s.get("kv") is not None
+                        for s in c.snapshot().node_states.values()
+                    )
+                    for c in clusters
+                )
+                if done:
+                    break
+                await asyncio.sleep(gossip_interval / 4)
+    finally:
+        elapsed = time.perf_counter() - start
+        for c in clusters:
+            await c.close()
+    return {
+        "metric": "asyncio_3node_convergence_seconds",
+        "value": round(elapsed, 4),
+        "unit": "s",
+        "config": 1,
+        "extra": {"gossip_interval": gossip_interval, "backend": "asyncio"},
+    }
+
+
+def config1(smoke: bool) -> dict:
+    # 20 ms interval like the reference's own integration bound
+    # (tests/test_integration.py:18): convergence in a handful of rounds.
+    return asyncio.run(_config1(gossip_interval=0.02))
+
+
+# -- config 2: 64-node ring-seeded sim ----------------------------------------
+
+
+def config2(smoke: bool) -> dict:
+    from aiocluster_tpu.models.topology import ring
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    n = 64
+    cfg = SimConfig(n_nodes=n, keys_per_node=16, fanout=3, budget=2048)
+    sim = Simulator(cfg, seed=0, topology=ring(n, 1), chunk=8)
+    start = time.perf_counter()
+    rounds = sim.run_until_converged(max_rounds=4 * n)
+    wall = time.perf_counter() - start
+    return {
+        "metric": "ring64_rounds_to_convergence",
+        "value": rounds,
+        "unit": "rounds",
+        "config": 2,
+        "extra": {"wall_seconds": round(wall, 3), "topology": "ring(1)",
+                  "keys_per_node": 16},
+    }
+
+
+# -- config 3: 1k-node churn + failure detector -------------------------------
+
+
+def config3(smoke: bool) -> dict:
+    import numpy as np
+
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    n = 256 if smoke else 1000
+    rounds = 64 if smoke else 200
+    # 5% churn/round (BASELINE config 3); revival keeps an ~80% alive
+    # equilibrium so the FD sees both deaths and rejoins continuously.
+    cfg = SimConfig(
+        n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
+        death_rate=0.05, revival_rate=0.2, writes_per_round=1,
+    )
+    sim = Simulator(cfg, seed=0, chunk=16)
+    rps = _timed_rounds_per_sec(sim, rounds)
+
+    # Under continuous churn the mean dead stint (1/revival_rate = 5
+    # rounds) is shorter than phi-accrual detection latency (~18 rounds
+    # with the 5-tick prior), so live_view lags by design — same math as
+    # the reference's ~8 s detection at 1 s gossip. For a clean FD
+    # quality number, freeze churn, kill a 5% cohort for good, let
+    # detection settle, and measure both error directions.
+    frozen = SimConfig(
+        n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
+        writes_per_round=1,
+    )
+    sim2 = Simulator(frozen, seed=1, chunk=16)
+    sim2.run(32)  # build heartbeat history
+    k = max(1, n // 20)
+    sim2.state = sim2.state.replace(alive=sim2.state.alive.at[:k].set(False))
+    sim2.run(40)  # > detection latency
+    alive2 = np.asarray(sim2.state.alive)
+    lv2 = np.asarray(sim2.state.live_view)[alive2]
+    live_seen_live = lv2[:, alive2].mean()
+    dead_seen_live = lv2[:, ~alive2].mean()
+
+    alive = np.asarray(sim.state.alive)
+    return {
+        "metric": "churn1k_rounds_per_sec",
+        "value": round(rps, 2),
+        "unit": "rounds/s",
+        "config": 3,
+        "extra": {
+            "n_nodes": n,
+            "alive_fraction_under_churn": round(float(alive.mean()), 3),
+            "live_seen_live": round(float(live_seen_live), 4),
+            "dead_seen_live": round(float(dead_seen_live), 4),
+            "churn_per_round": 0.05,
+        },
+    }
+
+
+# -- config 4: 10k-node scale-free --------------------------------------------
+
+
+def config4(smoke: bool) -> dict:
+    from aiocluster_tpu.models.topology import scale_free
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    n = 512 if smoke else 10_000
+    rounds = 32 if smoke else 64
+    cfg = SimConfig(
+        n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
+        pairing="choice",  # adjacency-constrained
+    )
+    log(f"config4: building scale-free graph n={n}")
+    topo = scale_free(n, attach=3, seed=0)
+    sim = Simulator(cfg, seed=0, topology=topo, chunk=min(rounds, 16))
+    rps = _timed_rounds_per_sec(sim, rounds)
+    start = time.perf_counter()
+    converged = sim.run_until_converged(max_rounds=4 * n)
+    wall = time.perf_counter() - start
+    return {
+        "metric": f"scalefree{n}_rounds_per_sec",
+        "value": round(rps, 2),
+        "unit": "rounds/s",
+        "config": 4,
+        "extra": {
+            "rounds_to_convergence": converged,
+            "convergence_wall_seconds": round(wall, 2),
+            "topology": "scale_free(attach=3)",
+        },
+    }
+
+
+# -- config 5: 100k-node epidemic, sharded ------------------------------------
+
+
+def _fit_population(target: int, n_devices: int, bytes_per_device: int) -> int:
+    """Largest node count (multiple of n_devices) whose sharded state
+    fits: w is N*N int32 split over devices, plus ~2x slack for the
+    step's temporaries (gathered peer rows, advances)."""
+    n = target
+    while n > n_devices:
+        per_device = (n * n * 4 * 2) // n_devices
+        if per_device <= bytes_per_device:
+            break
+        n = int(n * 0.85)
+    return max(n_devices, (n // n_devices) * n_devices)
+
+
+def config5(smoke: bool) -> dict:
+    import jax
+
+    from aiocluster_tpu.parallel.mesh import make_mesh
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    target = 4096 if smoke else 100_000
+    # v5e: 16 GB HBM; CPU smoke: stay tiny.
+    per_dev_budget = (256 << 20) if smoke else (12 << 30)
+    n = _fit_population(target, n_dev, per_dev_budget)
+    scaled = n < target
+    rounds = 16 if smoke else 32
+    log(f"config5: {n} nodes over {n_dev} device(s) (target {target})")
+    cfg = SimConfig(
+        n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    mesh = make_mesh(devices)
+    sim = Simulator(cfg, seed=0, mesh=mesh, chunk=8)
+    rps = _timed_rounds_per_sec(sim, rounds)
+    start = time.perf_counter()
+    converged = sim.run_until_converged(max_rounds=512)
+    wall = time.perf_counter() - start
+    return {
+        "metric": f"epidemic{n}_sharded_rounds_per_sec",
+        "value": round(rps, 2),
+        "unit": "rounds/s",
+        "config": 5,
+        "extra": {
+            "n_nodes": n,
+            "target_nodes": target,
+            "scaled": scaled,
+            "n_devices": n_dev,
+            "rounds_to_convergence": converged,
+            "convergence_wall_seconds": round(wall, 2),
+        },
+    }
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--configs", default="1,2,3,4,5")
+    args = parser.parse_args()
+    wanted = [int(c) for c in args.configs.split(",")]
+    for c in wanted:
+        log(f"=== config {c} ===")
+        start = time.perf_counter()
+        try:
+            record = CONFIGS[c](args.smoke)
+        except Exception as exc:  # keep the suite going; record the failure
+            record = {"metric": f"config{c}", "value": None, "unit": "error",
+                      "config": c, "error": repr(exc)}
+        log(f"config {c} done in {time.perf_counter() - start:.1f}s")
+        emit(record)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""The benchmark suite must stay runnable: config records well-formed,
+and the fast asyncio config end-to-end."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+BENCH = Path(__file__).parent.parent / "benchmarks" / "run_all.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("bench_run_all", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_run_all"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_config1_asyncio_cluster_converges():
+    mod = _load()
+    record = mod.config1(smoke=True)
+    assert record["config"] == 1
+    assert record["unit"] == "s"
+    assert 0 < record["value"] < 30
+
+
+def test_all_configs_registered():
+    mod = _load()
+    assert sorted(mod.CONFIGS) == [1, 2, 3, 4, 5]
+
+
+def test_fit_population_respects_budget():
+    mod = _load()
+    n = mod._fit_population(100_000, 8, 12 << 30)
+    assert n % 8 == 0
+    assert (n * n * 4 * 2) // 8 <= (12 << 30)
+    # 100k over v5e-8 fits outright.
+    assert n == 100_000
