@@ -403,14 +403,21 @@ def convergence_metrics(
         state.w / jnp.maximum(needed, 1),
         1.0,
     )
+    pair_mask = alive_rows & state.alive[owners][None, :]
+    frac_sum = jnp.sum(jnp.where(pair_mask, jnp.minimum(frac, 1.0), 0.0))
+    pair_count = jnp.sum(pair_mask)
     n_converged = owner_ok.sum()
     min_frac = frac.min()
     if axis_name is not None:
         n_converged = lax.psum(n_converged, axis_name)
         min_frac = lax.pmin(min_frac, axis_name)
+        frac_sum = lax.psum(frac_sum, axis_name)
+        pair_count = lax.psum(pair_count, axis_name)
     total = state.alive.shape[0]
     return {
         "converged_owners": n_converged,
         "all_converged": n_converged == total,
         "min_fraction": jnp.minimum(min_frac, 1.0),
+        "mean_fraction": frac_sum / jnp.maximum(pair_count, 1),
+        "alive_count": state.alive.sum(),
     }

@@ -47,6 +47,7 @@ class SimCluster:
         seed: int = 0,
         mesh: Mesh | None = None,
         topology: Topology | None = None,
+        trace: bool = False,
     ) -> None:
         n = cfg.n_nodes
         self.cfg = cfg
@@ -79,7 +80,7 @@ class SimCluster:
 
         self.sim = Simulator(
             cfg, seed=seed, mesh=mesh, topology=topology,
-            initial_versions=versions,
+            initial_versions=versions, trace=trace,
         )
 
     # -- owner-side writes (host log + deferred device bump) ------------------

@@ -54,6 +54,7 @@ class Simulator:
         topology: Topology | None = None,
         chunk: int = 8,
         initial_versions=None,
+        trace: bool = False,
     ) -> None:
         if topology is not None and topology.n_nodes != cfg.n_nodes:
             raise ValueError("topology size != cfg.n_nodes")
@@ -66,6 +67,11 @@ class Simulator:
         self._deg = (
             None if topology is None else jax.numpy.asarray(topology.degrees)
         )
+        # Opt-in per-chunk observability (the sim analogue of the
+        # runtime's HookStats/snapshot counters, reference
+        # server.py:50-56,168-175): each entry is one sampled round.
+        self._trace_enabled = trace
+        self.trace: list[dict[str, float]] = []
         self.state: SimState = init_state(cfg, initial_versions)
         self._mesh = mesh
         if mesh is not None:
@@ -102,6 +108,8 @@ class Simulator:
                     self.state, self._key, self.cfg, m, self._adj, self._deg
                 )
             done += m
+            if self._trace_enabled:
+                self._record_trace()
 
     def run_until_converged(self, max_rounds: int = 100_000) -> int | None:
         """Step until every alive node holds every alive owner's full
@@ -113,6 +121,18 @@ class Simulator:
         return None
 
     # -- observation ----------------------------------------------------------
+
+    def _record_trace(self) -> None:
+        m = self.metrics()
+        self.trace.append(
+            {
+                "tick": float(self.tick),
+                "converged_owners": float(m["converged_owners"]),
+                "min_fraction": float(m["min_fraction"]),
+                "mean_fraction": float(m["mean_fraction"]),
+                "alive_count": float(m["alive_count"]),
+            }
+        )
 
     def metrics(self) -> dict[str, np.ndarray]:
         if self._mesh is not None:

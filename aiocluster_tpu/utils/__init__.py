@@ -1,10 +1,13 @@
-"""Shared utilities: clock, logging, wire framing."""
+"""Shared utilities: clock, logging, wire framing, profiling."""
 
 from .clock import utc_now
 from .framing import frame, read_frame_size, unframe
 from .logging import logger, node_logger
+from .profiling import SectionTimer, device_trace
 
 __all__ = (
+    "SectionTimer",
+    "device_trace",
     "frame",
     "logger",
     "node_logger",

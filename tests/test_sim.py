@@ -352,3 +352,77 @@ def test_different_seeds_give_different_trajectories():
     a.run(8)
     b.run(8)
     assert not np.array_equal(np.asarray(a.state.w), np.asarray(b.state.w))
+
+
+# -- observability -------------------------------------------------------------
+
+
+def test_trace_records_convergence_history():
+    cfg = SimConfig(n_nodes=32, keys_per_node=8, budget=8,
+                    track_failure_detector=False)
+    sim = Simulator(cfg, seed=0, chunk=4, trace=True)
+    sim.run(16)
+    assert len(sim.trace) == 4  # one entry per chunk
+    ticks = [e["tick"] for e in sim.trace]
+    assert ticks == sorted(ticks)
+    fracs = [e["mean_fraction"] for e in sim.trace]
+    assert fracs == sorted(fracs)  # convergence is monotone
+    assert all(e["alive_count"] == 32 for e in sim.trace)
+    assert 0.0 <= fracs[0] <= fracs[-1] <= 1.0
+
+
+def test_metrics_mean_fraction_bounds():
+    cfg = SimConfig(n_nodes=16, keys_per_node=4, track_failure_detector=False)
+    s = init_state(cfg)
+    m = convergence_metrics(s)
+    # Fresh cluster: each node knows only itself -> mean is 1/16 of pairs.
+    assert 0.0 < float(m["mean_fraction"]) < 0.2
+    assert int(m["alive_count"]) == 16
+    s = run_rounds(s, cfg, 20)
+    m = convergence_metrics(s)
+    assert float(m["mean_fraction"]) == 1.0
+
+
+def test_sharded_metrics_include_mean_fraction():
+    from aiocluster_tpu.parallel.mesh import (
+        make_mesh, shard_state, sharded_metrics_fn,
+    )
+
+    cfg = SimConfig(n_nodes=32, keys_per_node=4, track_failure_detector=False)
+    mesh = make_mesh()
+    state = shard_state(init_state(cfg), mesh)
+    m = sharded_metrics_fn(mesh)(state)
+    single = convergence_metrics(init_state(cfg))
+    assert abs(float(m["mean_fraction"]) - float(single["mean_fraction"])) < 1e-6
+    assert int(m["alive_count"]) == 32
+
+
+def test_section_timer():
+    from aiocluster_tpu.utils import SectionTimer
+
+    t = SectionTimer()
+    with t.section("a"):
+        pass
+    with t.section("a"):
+        pass
+    with t.section("b"):
+        pass
+    s = t.summary()
+    assert s["a"]["calls"] == 2 and s["b"]["calls"] == 1
+    assert s["a"]["seconds"] >= 0
+
+
+def test_device_trace_writes_profile(tmp_path):
+    from aiocluster_tpu.utils import device_trace
+
+    cfg = SimConfig(n_nodes=8, keys_per_node=2, track_failure_detector=False)
+    with device_trace(str(tmp_path)):
+        Simulator(cfg, seed=0).run(2)
+    import os
+
+    found = any(
+        f.endswith((".pb", ".json.gz", ".trace.json.gz"))
+        for _, _, files in os.walk(tmp_path)
+        for f in files
+    )
+    assert found
