@@ -1,0 +1,212 @@
+// Native bulk codec for the gossip wire format's hot path.
+//
+// The asyncio backend's per-handshake cost is dominated by the repeated
+// KeyValueUpdatePb loop of NodeDeltaPb (reference messages.proto:55-66):
+// a full 64KB MTU delta carries ~2000 kv updates. These two functions
+// move that loop into C++ — encoding from flat offset arrays and
+// decoding into span/scalar arrays — with byte-identical output to
+// wire/proto.py's pure-Python implementation (same proto3 emission
+// rules; parity-tested in tests/test_wire_native.py).
+//
+// Plain C ABI, loaded via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline long uvarint_size(unsigned long long v) {
+  long n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    ++n;
+  }
+  return n;
+}
+
+inline long put_uvarint(unsigned char* out, unsigned long long v) {
+  long n = 0;
+  while (v >= 0x80) {
+    out[n++] = static_cast<unsigned char>(v) | 0x80;
+    v >>= 7;
+  }
+  out[n++] = static_cast<unsigned char>(v);
+  return n;
+}
+
+// Returns -1 on truncation; advances *pos.
+inline long long get_uvarint(const unsigned char* buf, long len, long* pos) {
+  unsigned long long result = 0;
+  int shift = 0;
+  while (*pos < len) {
+    unsigned char b = buf[(*pos)++];
+    result |= static_cast<unsigned long long>(b & 0x7F) << shift;
+    if (!(b & 0x80)) return static_cast<long long>(result);
+    shift += 7;
+    if (shift > 63) return -1;
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encode n KeyValueUpdate submessages (field 4 of NodeDeltaPb) into out.
+// keys/vals are concatenated UTF-8 with (n+1)-element byte-offset arrays.
+// Emission matches proto3 rules: empty strings / zero varints omitted,
+// field order key(1), value(2), version(3), status(4).
+// Returns bytes written, or -1 if cap is too small.
+long acg_enc_kv_updates(const unsigned char* keys, const long* koff,
+                        const unsigned char* vals, const long* voff,
+                        const long long* versions, const int* statuses,
+                        long n, unsigned char* out, long cap) {
+  long w = 0;
+  for (long i = 0; i < n; ++i) {
+    long klen = koff[i + 1] - koff[i];
+    long vlen = voff[i + 1] - voff[i];
+    unsigned long long ver = static_cast<unsigned long long>(versions[i]);
+    unsigned long long st = static_cast<unsigned long long>(statuses[i]);
+
+    long body = 0;
+    if (klen > 0) body += 1 + uvarint_size(klen) + klen;
+    if (vlen > 0) body += 1 + uvarint_size(vlen) + vlen;
+    if (ver) body += 1 + uvarint_size(ver);
+    if (st) body += 1 + uvarint_size(st);
+
+    long need = 1 + uvarint_size(body) + body;
+    if (w + need > cap) return -1;
+
+    out[w++] = (4 << 3) | 2;  // NodeDeltaPb.key_values, length-delimited
+    w += put_uvarint(out + w, body);
+    if (klen > 0) {
+      out[w++] = (1 << 3) | 2;
+      w += put_uvarint(out + w, klen);
+      std::memcpy(out + w, keys + koff[i], klen);
+      w += klen;
+    }
+    if (vlen > 0) {
+      out[w++] = (2 << 3) | 2;
+      w += put_uvarint(out + w, vlen);
+      std::memcpy(out + w, vals + voff[i], vlen);
+      w += vlen;
+    }
+    if (ver) {
+      out[w++] = (3 << 3) | 0;
+      w += put_uvarint(out + w, ver);
+    }
+    if (st) {
+      out[w++] = (4 << 3) | 0;
+      w += put_uvarint(out + w, st);
+    }
+  }
+  return w;
+}
+
+// Parse a whole NodeDeltaPb body (reference messages.proto:55-66).
+//
+// Outputs:
+//   scalars[0..3] = from_version_excluded, last_gc_version, max_version,
+//                   has_max_version
+//   node_span[0..1] = [start, end) of the NodeIdPb submessage bytes
+//                     (or -1,-1 if absent)
+//   kv_spans: 4 longs per kv = key_off, key_len, val_off, val_len
+//             (offsets into buf; strings are substrings of the input)
+//   versions / statuses: per-kv
+// Unknown fields are skipped (forward compatibility), matching the
+// Python decoder.
+// Returns kv count, -1 on truncation/overflow, -2 if max_kvs exceeded,
+// -3 on unsupported wire type.
+long acg_dec_node_delta(const unsigned char* buf, long len,
+                        long long* scalars, long* node_span, long* kv_spans,
+                        long long* versions, int* statuses, long max_kvs) {
+  scalars[0] = scalars[1] = scalars[2] = 0;
+  scalars[3] = 0;
+  node_span[0] = node_span[1] = -1;
+  long nkv = 0;
+  long pos = 0;
+  while (pos < len) {
+    long long tag = get_uvarint(buf, len, &pos);
+    if (tag < 0) return -1;
+    long field = static_cast<long>(tag >> 3);
+    int wt = static_cast<int>(tag & 0x7);
+    if (wt == 2) {  // length-delimited
+      long long n = get_uvarint(buf, len, &pos);
+      if (n < 0 || pos + n > len) return -1;
+      if (field == 1) {
+        node_span[0] = pos;
+        node_span[1] = pos + static_cast<long>(n);
+      } else if (field == 4) {
+        if (nkv >= max_kvs) return -2;
+        // Parse the kv submessage in place.
+        long kend = pos + static_cast<long>(n);
+        long kp = pos;
+        long ko = -1, kl = 0, vo = -1, vl = 0;
+        long long ver = 0, st = 0;
+        while (kp < kend) {
+          long long ktag = get_uvarint(buf, kend, &kp);
+          if (ktag < 0) return -1;
+          long kf = static_cast<long>(ktag >> 3);
+          int kwt = static_cast<int>(ktag & 0x7);
+          if (kwt == 2) {
+            long long sn = get_uvarint(buf, kend, &kp);
+            if (sn < 0 || kp + sn > kend) return -1;
+            if (kf == 1) {
+              ko = kp;
+              kl = static_cast<long>(sn);
+            } else if (kf == 2) {
+              vo = kp;
+              vl = static_cast<long>(sn);
+            }
+            kp += static_cast<long>(sn);
+          } else if (kwt == 0) {
+            long long v = get_uvarint(buf, kend, &kp);
+            if (v < 0) return -1;
+            if (kf == 3)
+              ver = v;
+            else if (kf == 4)
+              st = v;
+          } else if (kwt == 5) {
+            kp += 4;
+            if (kp > kend) return -1;
+          } else if (kwt == 1) {
+            kp += 8;
+            if (kp > kend) return -1;
+          } else {
+            return -3;
+          }
+        }
+        kv_spans[4 * nkv + 0] = ko;
+        kv_spans[4 * nkv + 1] = kl;
+        kv_spans[4 * nkv + 2] = vo;
+        kv_spans[4 * nkv + 3] = vl;
+        versions[nkv] = ver;
+        statuses[nkv] = static_cast<int>(st);
+        ++nkv;
+      }
+      pos += static_cast<long>(n);
+    } else if (wt == 0) {  // varint
+      long long v = get_uvarint(buf, len, &pos);
+      if (v < 0) return -1;
+      if (field == 2) {
+        scalars[0] = v;
+      } else if (field == 3) {
+        scalars[1] = v;
+      } else if (field == 5) {
+        scalars[2] = v;
+        scalars[3] = 1;
+      }
+    } else if (wt == 5) {
+      pos += 4;
+      if (pos > len) return -1;
+    } else if (wt == 1) {
+      pos += 8;
+      if (pos > len) return -1;
+    } else {
+      return -3;
+    }
+  }
+  return nkv;
+}
+
+}  // extern "C"

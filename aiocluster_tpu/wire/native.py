@@ -1,0 +1,164 @@
+"""ctypes loader + wrappers for the native bulk wire codec.
+
+Compiles ``_native.cpp`` with g++ on first use (cached beside a content
+hash under ``~/.cache/aiocluster_tpu``), loads it via ctypes, and exposes
+bulk encode/decode for the repeated-kv hot path of NodeDeltaPb. When the
+toolchain or binary is unavailable — or ``AIOCLUSTER_TPU_NO_NATIVE`` is
+set — everything degrades to the pure-Python codec in proto.py.
+
+The native path only engages for deltas with >= ``NATIVE_THRESHOLD`` kv
+updates; below that, ctypes marshaling costs more than it saves.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+_SRC = Path(__file__).with_name("_native.cpp")
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+def _build_and_load() -> ctypes.CDLL | None:
+    src = _SRC.read_bytes()
+    digest = hashlib.sha256(src).hexdigest()[:16]
+    cache_dir = Path(
+        os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")
+    ) / "aiocluster_tpu"
+    so_path = cache_dir / f"_native-{digest}.so"
+    if not so_path.exists():
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        # Compile into a temp name then rename: atomic against races.
+        with tempfile.NamedTemporaryFile(
+            dir=cache_dir, suffix=".so", delete=False
+        ) as tmp:
+            tmp_path = Path(tmp.name)
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 str(_SRC), "-o", str(tmp_path)],
+                check=True, capture_output=True, timeout=120,
+            )
+            tmp_path.replace(so_path)
+        except Exception:
+            tmp_path.unlink(missing_ok=True)
+            return None
+    try:
+        return ctypes.CDLL(str(so_path))
+    except OSError:
+        return None
+
+
+def _lib() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("AIOCLUSTER_TPU_NO_NATIVE"):
+        return None
+    lib = _build_and_load()
+    if lib is not None:
+        lib.acg_enc_kv_updates.restype = ctypes.c_long
+        lib.acg_enc_kv_updates.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+        ]
+        lib.acg_dec_node_delta.restype = ctypes.c_long
+        lib.acg_dec_node_delta.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+        ]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+NATIVE_THRESHOLD = 16  # kv updates; below this ctypes overhead dominates
+
+
+def encode_kv_updates(kvs) -> bytes | None:
+    """Bulk-encode the repeated field-4 kv updates of a NodeDelta.
+    Returns None when the native path is unavailable (caller falls back)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    n = len(kvs)
+    keys_b = [kv.key.encode("utf-8") for kv in kvs]
+    vals_b = [kv.value.encode("utf-8") for kv in kvs]
+    koff = (ctypes.c_long * (n + 1))()
+    voff = (ctypes.c_long * (n + 1))()
+    for i in range(n):
+        koff[i + 1] = koff[i] + len(keys_b[i])
+        voff[i + 1] = voff[i] + len(vals_b[i])
+    keys = b"".join(keys_b)
+    vals = b"".join(vals_b)
+    versions = (ctypes.c_longlong * n)(*(kv.version for kv in kvs))
+    statuses = (ctypes.c_int * n)(*(int(kv.status) for kv in kvs))
+    # Worst case per kv: 2 tag+len headers (<=11B each) + payloads + 2
+    # varint fields (<=11B each); 44 covers all header bytes.
+    cap = koff[n] + voff[n] + 44 * n + 16
+    out = ctypes.create_string_buffer(cap)
+    written = lib.acg_enc_kv_updates(
+        keys, koff, vals, voff, versions, statuses, n, out, cap
+    )
+    if written < 0:  # pragma: no cover - cap math guarantees fit
+        return None
+    return out.raw[:written]
+
+
+class NativeDecodeError(ValueError):
+    pass
+
+
+def decode_node_delta_raw(body: bytes):
+    """Parse a NodeDelta body natively.
+
+    Returns (scalars, node_id_bytes | None, kv_tuples) where kv_tuples is
+    a list of (key, value, version, status_int); or None when the native
+    path is unavailable. Raises NativeDecodeError on malformed input
+    (the caller maps it to WireError).
+    """
+    lib = _lib()
+    if lib is None:
+        return None
+    blen = len(body)
+    # Every kv costs >= 2 bytes on the wire; +1 guards the empty body.
+    max_kvs = blen // 2 + 1
+    scalars = (ctypes.c_longlong * 4)()
+    node_span = (ctypes.c_long * 2)()
+    kv_spans = (ctypes.c_long * (4 * max_kvs))()
+    versions = (ctypes.c_longlong * max_kvs)()
+    statuses = (ctypes.c_int * max_kvs)()
+    nkv = lib.acg_dec_node_delta(
+        body, blen, scalars, node_span, kv_spans, versions, statuses, max_kvs
+    )
+    if nkv == -3:
+        raise NativeDecodeError("unsupported wire type")
+    if nkv < 0:
+        raise NativeDecodeError("truncated or malformed NodeDelta")
+    kvs = []
+    for i in range(nkv):
+        ko, kl, vo, vl = kv_spans[4 * i : 4 * i + 4]
+        key = body[ko : ko + kl].decode("utf-8") if ko >= 0 else ""
+        value = body[vo : vo + vl].decode("utf-8") if vo >= 0 else ""
+        kvs.append((key, value, versions[i], statuses[i]))
+    node_id_bytes = (
+        body[node_span[0] : node_span[1]] if node_span[0] >= 0 else None
+    )
+    return (
+        (scalars[0], scalars[1], scalars[2], bool(scalars[3])),
+        node_id_bytes,
+        kvs,
+    )

@@ -15,6 +15,7 @@ evolution by either side does not break the handshake.
 
 from __future__ import annotations
 
+from . import native as _native
 from ..core.identity import NodeId
 from ..core.messages import (
     Ack,
@@ -280,14 +281,48 @@ def encode_node_delta(nd: NodeDelta) -> bytes:
     _field_msg(out, 1, encode_node_id(nd.node_id))
     _field_varint(out, 2, nd.from_version_excluded)
     _field_varint(out, 3, nd.last_gc_version)
-    for kv in nd.key_values:
-        _field_msg(out, 4, encode_kv_update(kv))
+    if len(nd.key_values) >= _native.NATIVE_THRESHOLD:
+        bulk = _native.encode_kv_updates(nd.key_values)
+        if bulk is not None:
+            out += bulk
+        else:
+            for kv in nd.key_values:
+                _field_msg(out, 4, encode_kv_update(kv))
+    else:
+        for kv in nd.key_values:
+            _field_msg(out, 4, encode_kv_update(kv))
     if nd.max_version is not None:
         _field_varint_present(out, 5, nd.max_version)
     return bytes(out)
 
 
 def decode_node_delta(body: bytes) -> NodeDelta:
+    # Large bodies (MTU-full deltas, ~2000 kvs at 64KB) take the native
+    # bulk parser; output is identical to the Python loop below.
+    if len(body) >= 512:
+        try:
+            parsed = _native.decode_node_delta_raw(body)
+        except _native.NativeDecodeError as exc:
+            raise WireError(str(exc)) from exc
+        except UnicodeDecodeError as exc:
+            raise WireError(f"invalid utf-8 string field: {exc}") from exc
+        if parsed is not None:
+            (fve, lgc, maxv, has_max), node_id_bytes, raw_kvs = parsed
+            node_id = (
+                decode_node_id(node_id_bytes)
+                if node_id_bytes is not None
+                else NodeId("", 0, ("", 0))
+            )
+            kvs = []
+            for key, value, version, status in raw_kvs:
+                try:
+                    st = VersionStatusEnum(status)
+                except ValueError as exc:
+                    raise WireError(f"unknown version status {status}") from exc
+                kvs.append(KeyValueUpdate(key, value, version, st))
+            return NodeDelta(
+                node_id, fve, lgc, kvs, maxv if has_max else None
+            )
     r = _Reader(body)
     node_id = NodeId("", 0, ("", 0))
     fve = lgc = 0
