@@ -51,6 +51,20 @@ def _local_owner_ids(n_local: int, axis_name: str | None) -> jax.Array:
     return base + jnp.arange(n_local, dtype=jnp.int32)
 
 
+def _random_matching(key: jax.Array, n: int) -> jax.Array:
+    """A uniform random perfect matching as an involution p (p[p[i]] == i).
+
+    Shuffle, then pair the first half with the second; with odd n one node
+    is left self-paired (a no-op exchange). Cost is O(N) — negligible next
+    to the (N, N) pulls it halves.
+    """
+    perm = random.permutation(key, n)
+    half = n // 2
+    a, b = perm[:half], perm[half : 2 * half]
+    p = jnp.arange(n, dtype=perm.dtype)
+    return p.at[a].set(b).at[b].set(a)
+
+
 def _global_cumsum_excl(d: jax.Array, axis_name: str | None) -> jax.Array:
     """Exclusive cumsum of per-owner deficits in GLOBAL owner order, given
     the local (N, n_local) block. Cross-shard part is one (N,)-per-shard
@@ -123,18 +137,21 @@ def _budgeted_advance(
     matches the budget exactly and progress never stalls even when every
     scaled deficit is below one key-version.
     """
-    d = jnp.maximum(w_send - w_recv, 0) * valid[:, None]
+    dt = w_recv.dtype
+    d = jnp.maximum(w_send - w_recv, 0) * valid[:, None].astype(dt)
     if policy == "greedy":
-        c = _global_cumsum_excl(d, axis_name)
-        return jnp.clip(budget - c, 0, d)
-    total = d.sum(axis=1).astype(jnp.float32)
+        # Row totals/cumsums run in int32 even for int16 watermarks — a
+        # row's total deficit can exceed the element dtype's range.
+        c = _global_cumsum_excl(d.astype(jnp.int32), axis_name)
+        return jnp.clip(budget - c, 0, d.astype(jnp.int32)).astype(dt)
+    total = d.sum(axis=1, dtype=jnp.float32)
     if axis_name is not None:
         total = lax.psum(total, axis_name)
     scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
     x = d.astype(jnp.float32) * scale[:, None]
     floor = jnp.floor(x)
     bump = _hash_uniform(salt, d.shape[0], owner_ids, run_salt) < (x - floor)
-    return jnp.minimum(floor.astype(jnp.int32) + bump, d)
+    return jnp.minimum(floor.astype(jnp.int32) + bump, d.astype(jnp.int32)).astype(dt)
 
 
 def _view_peer_choice(
@@ -255,41 +272,64 @@ def sim_step(
     heartbeat = state.heartbeat + alive.astype(jnp.int32)
     max_version = state.max_version + cfg.writes_per_round * alive.astype(jnp.int32)
 
-    w = state.w.at[owners, cols].set(max_version[owners])
+    w = state.w.at[owners, cols].set(max_version[owners].astype(state.w.dtype))
     track_hb = cfg.track_heartbeats
     hb = (
-        state.hb_known.at[owners, cols].set(heartbeat[owners])
+        state.hb_known.at[owners, cols].set(
+            heartbeat[owners].astype(state.hb_known.dtype)
+        )
         if track_hb
         else state.hb_known
     )
     hb_round_start = hb
 
-    def pull(w, hb, peer, salt):
-        """One handshake direction: the receiver applies the peer's
-        budgeted delta and absorbs its heartbeat digest."""
+    def peer_adv(w, peer, salt):
+        """The budgeted watermark advance of each row toward its peer row
+        (one handshake direction), masked to alive pairs."""
         valid = alive & alive[peer]
         adv = _budgeted_advance(
             w, w[peer, :], cfg.budget, valid, axis_name,
             cfg.budget_policy, salt, owners, run_salt,
         )
-        w = w + adv
-        if track_hb:
-            hb = jnp.maximum(hb, jnp.where(valid[:, None], hb[peer, :], 0))
-        return w, hb
+        return adv, valid
+
+    def hb_absorb(hb, peer, valid):
+        return jnp.maximum(hb, jnp.where(valid[:, None], hb[peer, :], 0))
 
     def sub_salt(c: int, direction: int) -> jax.Array:
         return (tick * (2 * cfg.fanout) + 2 * c + direction).astype(jnp.int32)
 
     # -- fanout sub-exchanges (both handshake directions per pair) -----------
-    if cfg.pairing == "permutation" and adjacency is None:
-        # Random matching: initiator i talks to p[i]; the responder role is
-        # the pull through the inverse permutation. Gather-only — no
-        # scatter — which is the TPU fast path.
+    if cfg.pairing in ("permutation", "matching") and adjacency is None:
         for c in range(cfg.fanout):
-            p = random.permutation(random.fold_in(peer_key, c), n)
-            inv = jnp.argsort(p)
-            w, hb = pull(w, hb, p, sub_salt(c, 0))
-            w, hb = pull(w, hb, inv, sub_salt(c, 1))
+            ck = random.fold_in(peer_key, c)
+            if cfg.pairing == "matching":
+                # Random perfect matching (p an involution): one
+                # bidirectional handshake per node — i's pull from p[i]
+                # IS the pair's full exchange, because row p[i] pulls
+                # from i in the same vectorized op. Half the traffic of
+                # "permutation" per sub-exchange.
+                p = _random_matching(ck, n)
+                adv, valid = peer_adv(w, p, sub_salt(c, 0))
+                w = w + adv
+                if track_hb:
+                    hb = hb_absorb(hb, p, valid)
+            else:
+                # Initiator i talks to p[i]; the responder role is the
+                # pull through the inverse permutation. Both exchanges
+                # are computed from the pre-round state and joined with
+                # an elementwise max — as in the reference handshake,
+                # where both sides' deltas derive from the pre-handshake
+                # digests — so XLA fuses them into one pass over w.
+                p = random.permutation(ck, n)
+                inv = jnp.argsort(p)
+                adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0))
+                adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1))
+                w = w + jnp.maximum(adv_p, adv_i)
+                if track_hb:
+                    hb = jnp.maximum(
+                        hb_absorb(hb, p, valid_p), hb_absorb(hb, inv, valid_i)
+                    )
     else:
         # Independent choice (reference semantics: inbound load varies) or
         # adjacency-constrained topology; responder side needs scatter-max.
@@ -334,36 +374,39 @@ def sim_step(
         never_seen = state.last_change == 0
         interval = (tick - state.last_change).astype(jnp.float32)
         sampled = increased & ~never_seen & (interval <= cfg.max_interval_ticks)
-        # Ring-buffer semantics at the window cap (core/failure.py
-        # BoundedWindow): a new sample evicts one old sample's worth of
-        # mass (the window mean) so isum stays a window sum instead of
-        # growing with total runtime.
-        at_cap = state.icount >= cfg.window_ticks
-        evicted = jnp.where(
-            sampled & at_cap,
-            state.isum / jnp.maximum(state.icount, 1.0),
-            0.0,
-        )
-        isum = state.isum + jnp.where(sampled, interval, 0.0) - evicted
+        # Running (mean, count) form of the ring-buffer window
+        # (core/failure.py BoundedWindow): below the cap this is the exact
+        # running mean; at the cap the update mean += (x - mean)/window is
+        # exactly the old sum-form with one window-mean's worth of mass
+        # evicted per new sample.
         icount = jnp.minimum(
-            state.icount + sampled.astype(jnp.float32), cfg.window_ticks
+            state.icount + sampled.astype(jnp.int16),
+            jnp.int16(cfg.window_ticks),
         )
-        last_change = jnp.where(increased, tick, state.last_change)
-        mean = (isum + cfg.prior_weight * cfg.prior_mean_ticks) / (
-            icount + cfg.prior_weight
+        mean_f32 = state.imean.astype(jnp.float32)
+        denom = jnp.maximum(icount.astype(jnp.float32), 1.0)
+        imean = jnp.where(
+            sampled, mean_f32 + (interval - mean_f32) / denom, mean_f32
         )
+        last_change = jnp.where(
+            increased, tick.astype(state.last_change.dtype), state.last_change
+        )
+        count_f32 = icount.astype(jnp.float32)
+        prior_mean = (
+            imean * count_f32 + cfg.prior_weight * cfg.prior_mean_ticks
+        ) / (count_f32 + cfg.prior_weight)
         elapsed = (tick - last_change).astype(jnp.float32)
-        phi = elapsed / mean
+        phi = elapsed / prior_mean
         live = (icount >= 1) & (phi <= cfg.phi_threshold)
         live = live.at[owners, cols].set(True)  # self-belief
         # Going (or staying) dead wipes the window: a returning node must
         # re-earn liveness with fresh samples (core/failure.py reset rule).
-        isum = jnp.where(live, isum, 0.0)
-        icount = jnp.where(live, icount, 0.0)
+        imean = jnp.where(live, imean, 0.0).astype(state.imean.dtype)
+        icount = jnp.where(live, icount, jnp.int16(0))
     else:
-        last_change, isum, icount, live = (
+        last_change, imean, icount, live = (
             state.last_change,
-            state.isum,
+            state.imean,
             state.icount,
             state.live_view,
         )
@@ -376,7 +419,7 @@ def sim_step(
         w=w,
         hb_known=hb,
         last_change=last_change,
-        isum=isum,
+        imean=imean,
         icount=icount,
         live_view=live,
     )

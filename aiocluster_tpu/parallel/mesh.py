@@ -44,7 +44,7 @@ def state_partition_spec() -> SimState:
         w=mat,
         hb_known=mat,
         last_change=mat,
-        isum=mat,
+        imean=mat,
         icount=mat,
         live_view=mat,
     )

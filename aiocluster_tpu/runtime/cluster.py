@@ -26,6 +26,7 @@ from ..core.kvstate import NodeState
 from ..core.messages import Ack, BadCluster, Packet, Syn, SynAck
 from ..core.values import VersionedValue
 from ..utils.logging import node_logger
+from ..wire import native as wire_native
 from .engine import GossipEngine
 from .hooks import HookDispatcher, HookStats
 from .peers import select_gossip_targets
@@ -135,6 +136,9 @@ class Cluster:
             f"Booting {self.self_node_id.long_name()} "
             f"[{self._config.cluster_id}]"
         )
+        # Warm the native bulk codec off the event loop: its first use
+        # otherwise shells out to g++ inside a gossip handshake.
+        await asyncio.to_thread(wire_native.warmup)
         # Bind before latching _started so a failed boot (e.g. EADDRINUSE)
         # leaves the cluster retryable instead of permanently half-dead.
         self._server = await self._transport.start_server(

@@ -61,14 +61,36 @@ class SimConfig:
     peer_mode: str = "alive"
 
     # Pairing of one sub-exchange:
-    # - "permutation" (default): a random matching; each node initiates one
-    #   handshake and responds to exactly one. Gather-only on TPU (the
-    #   responder role is a pull through the inverse permutation) — the
-    #   fast path.
+    # - "permutation" (default): each node initiates one handshake (with
+    #   p[i]) and responds to exactly one (from inv[i]). Gather-only on
+    #   TPU; both exchanges are computed from the pre-round state and
+    #   joined with an elementwise max — the same semantics as the
+    #   reference's 3-way handshake, where both sides' deltas derive from
+    #   the pre-handshake digests.
+    # - "matching": a random perfect matching (p is an involution), so one
+    #   bidirectional handshake per node per sub-exchange — HALF the
+    #   full-matrix traffic of "permutation" per sub-exchange. The most
+    #   faithful model of the reference's paired Syn/SynAck/Ack exchange,
+    #   and the fastest per-round path.
     # - "choice": every node independently samples a peer (reference
     #   server.py:699 semantics: inbound load varies); needs a scatter-max
     #   for the responder side. Topology (adjacency) runs force this mode.
     pairing: str = "permutation"
+
+    # Dtypes for the big (N, N) knowledge matrices. "int32" is always
+    # safe; "int16" halves HBM traffic and footprint and is exact whenever
+    # the quantity fits: watermarks need max total versions per owner
+    # (initial + writes_per_round * horizon) < 32768; heartbeat knowledge
+    # needs the run horizon in ticks < 32768. init_state validates the
+    # initial versions; the horizon bound is the caller's contract.
+    version_dtype: str = "int32"
+    heartbeat_dtype: str = "int32"
+
+    # Storage dtype of the failure detector's interval means. "bfloat16"
+    # halves that matrix; the update math always runs in float32, so only
+    # the stored mean is rounded (≤0.4% relative) — far inside the
+    # phi-threshold's slack.
+    fd_dtype: str = "float32"
 
     # How an exchange's key-version budget is split across stale owners:
     # - "proportional" (default): every stale owner's deficit is scaled by
@@ -92,8 +114,16 @@ class SimConfig:
             raise ValueError(f"unknown peer_mode: {self.peer_mode}")
         if self.peer_mode == "view" and not self.track_failure_detector:
             raise ValueError("peer_mode='view' requires track_failure_detector")
-        if self.pairing not in ("permutation", "choice"):
+        if self.pairing not in ("permutation", "matching", "choice"):
             raise ValueError(f"unknown pairing: {self.pairing}")
+        if self.version_dtype not in ("int32", "int16"):
+            raise ValueError(f"unknown version_dtype: {self.version_dtype}")
+        if self.heartbeat_dtype not in ("int32", "int16"):
+            raise ValueError(f"unknown heartbeat_dtype: {self.heartbeat_dtype}")
+        if self.fd_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown fd_dtype: {self.fd_dtype}")
+        if self.window_ticks >= 2**15:
+            raise ValueError("window_ticks must fit the int16 sample counter")
         if self.peer_mode == "view" and self.pairing != "choice":
             raise ValueError(
                 "peer_mode='view' requires pairing='choice' (a matching "

@@ -32,13 +32,16 @@ class SimState:
     max_version: jax.Array  # (N,) int32 — owner version counters
     heartbeat: jax.Array  # (N,) int32 — owner heartbeat counters
     alive: jax.Array  # (N,) bool — ground-truth liveness (churn target)
-    w: jax.Array  # (N, N) int32 — w[i, j]: i's watermark on owner j
-    hb_known: jax.Array  # (N, N) int32 — highest heartbeat of j known to i
+    w: jax.Array  # (N, N) version_dtype — w[i, j]: i's watermark on owner j
+    hb_known: jax.Array  # (N, N) heartbeat_dtype — highest hb of j known to i
 
-    # Failure-detector state (zero-sized when disabled).
-    last_change: jax.Array  # (N, N) int32 — tick of last observed hb increase
-    isum: jax.Array  # (N, N) float32 — sum of sampled intervals (ticks)
-    icount: jax.Array  # (N, N) float32 — number of samples (window-capped)
+    # Failure-detector state (zero-sized when disabled). The sampling
+    # window is held as a running (mean, count) pair — algebraically
+    # identical to the object model's (window sum, count) with
+    # mean-eviction at the cap, but 6 bytes/pair lighter on HBM.
+    last_change: jax.Array  # (N, N) heartbeat_dtype — tick of last hb increase
+    imean: jax.Array  # (N, N) fd_dtype — mean of sampled intervals (ticks)
+    icount: jax.Array  # (N, N) int16 — number of samples (window-capped)
     live_view: jax.Array  # (N, N) bool — i's belief that j is alive
 
 
@@ -50,20 +53,24 @@ def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> Sim
     n = cfg.n_nodes
     fd_shape = (n, n) if cfg.track_failure_detector else (0, 0)
     eye = jnp.eye(n, dtype=bool)
+    vdt = jnp.dtype(cfg.version_dtype)
+    hdt = jnp.dtype(cfg.heartbeat_dtype)
     if initial_versions is None:
         initial_versions = jnp.full((n,), cfg.keys_per_node, jnp.int32)
     initial_versions = jnp.asarray(initial_versions, jnp.int32)
+    if vdt == jnp.int16 and int(jnp.max(initial_versions)) >= 2**15:
+        raise ValueError("initial versions overflow version_dtype=int16")
     return SimState(
         tick=jnp.asarray(0, jnp.int32),
         max_version=initial_versions,
         heartbeat=jnp.ones((n,), jnp.int32),
         alive=jnp.ones((n,), bool),
-        w=jnp.where(eye, initial_versions[None, :], 0).astype(jnp.int32),
-        hb_known=eye.astype(jnp.int32) if cfg.track_heartbeats
-        else jnp.zeros((0, 0), jnp.int32),
-        last_change=jnp.zeros(fd_shape, jnp.int32),
-        isum=jnp.zeros(fd_shape, jnp.float32),
-        icount=jnp.zeros(fd_shape, jnp.float32),
+        w=jnp.where(eye, initial_versions[None, :], 0).astype(vdt),
+        hb_known=eye.astype(hdt) if cfg.track_heartbeats
+        else jnp.zeros((0, 0), hdt),
+        last_change=jnp.zeros(fd_shape, hdt),
+        imean=jnp.zeros(fd_shape, jnp.dtype(cfg.fd_dtype)),
+        icount=jnp.zeros(fd_shape, jnp.int16),
         live_view=jnp.eye(*fd_shape, dtype=bool)
         if cfg.track_failure_detector
         else jnp.zeros(fd_shape, bool),

@@ -34,18 +34,23 @@ inline long put_uvarint(unsigned char* out, unsigned long long v) {
   return n;
 }
 
-// Returns -1 on truncation; advances *pos.
-inline long long get_uvarint(const unsigned char* buf, long len, long* pos) {
+// Full-range u64 varint read via out-param (values with bit 63 set are
+// legitimate); returns false on truncation/overlong. Advances *pos.
+inline bool get_uvarint(const unsigned char* buf, long len, long* pos,
+                        unsigned long long* out) {
   unsigned long long result = 0;
   int shift = 0;
   while (*pos < len) {
     unsigned char b = buf[(*pos)++];
     result |= static_cast<unsigned long long>(b & 0x7F) << shift;
-    if (!(b & 0x80)) return static_cast<long long>(result);
+    if (!(b & 0x80)) {
+      *out = result;
+      return true;
+    }
     shift += 7;
-    if (shift > 63) return -1;
+    if (shift > 63) return false;
   }
-  return -1;
+  return false;
 }
 
 }  // namespace
@@ -112,27 +117,32 @@ long acg_enc_kv_updates(const unsigned char* keys, const long* koff,
 //                     (or -1,-1 if absent)
 //   kv_spans: 4 longs per kv = key_off, key_len, val_off, val_len
 //             (offsets into buf; strings are substrings of the input)
-//   versions / statuses: per-kv
+//   versions / statuses: per-kv, full u64 bit patterns in long long
+//             slots (the Python side masks back to unsigned)
 // Unknown fields are skipped (forward compatibility), matching the
 // Python decoder.
 // Returns kv count, -1 on truncation/overflow, -2 if max_kvs exceeded,
 // -3 on unsupported wire type.
 long acg_dec_node_delta(const unsigned char* buf, long len,
                         long long* scalars, long* node_span, long* kv_spans,
-                        long long* versions, int* statuses, long max_kvs) {
+                        long long* versions, long long* statuses,
+                        long max_kvs) {
   scalars[0] = scalars[1] = scalars[2] = 0;
   scalars[3] = 0;
   node_span[0] = node_span[1] = -1;
   long nkv = 0;
   long pos = 0;
   while (pos < len) {
-    long long tag = get_uvarint(buf, len, &pos);
-    if (tag < 0) return -1;
-    long field = static_cast<long>(tag >> 3);
+    unsigned long long tag;
+    if (!get_uvarint(buf, len, &pos, &tag)) return -1;
+    unsigned long long field = tag >> 3;
     int wt = static_cast<int>(tag & 0x7);
     if (wt == 2) {  // length-delimited
-      long long n = get_uvarint(buf, len, &pos);
-      if (n < 0 || pos + n > len) return -1;
+      unsigned long long n;
+      if (!get_uvarint(buf, len, &pos, &n)) return -1;
+      // Unsigned compare against the REMAINING bytes: a huge declared
+      // length must not wrap the position arithmetic.
+      if (n > static_cast<unsigned long long>(len - pos)) return -1;
       if (field == 1) {
         node_span[0] = pos;
         node_span[1] = pos + static_cast<long>(n);
@@ -142,15 +152,16 @@ long acg_dec_node_delta(const unsigned char* buf, long len,
         long kend = pos + static_cast<long>(n);
         long kp = pos;
         long ko = -1, kl = 0, vo = -1, vl = 0;
-        long long ver = 0, st = 0;
+        unsigned long long ver = 0, st = 0;
         while (kp < kend) {
-          long long ktag = get_uvarint(buf, kend, &kp);
-          if (ktag < 0) return -1;
-          long kf = static_cast<long>(ktag >> 3);
+          unsigned long long ktag;
+          if (!get_uvarint(buf, kend, &kp, &ktag)) return -1;
+          unsigned long long kf = ktag >> 3;
           int kwt = static_cast<int>(ktag & 0x7);
           if (kwt == 2) {
-            long long sn = get_uvarint(buf, kend, &kp);
-            if (sn < 0 || kp + sn > kend) return -1;
+            unsigned long long sn;
+            if (!get_uvarint(buf, kend, &kp, &sn)) return -1;
+            if (sn > static_cast<unsigned long long>(kend - kp)) return -1;
             if (kf == 1) {
               ko = kp;
               kl = static_cast<long>(sn);
@@ -160,18 +171,18 @@ long acg_dec_node_delta(const unsigned char* buf, long len,
             }
             kp += static_cast<long>(sn);
           } else if (kwt == 0) {
-            long long v = get_uvarint(buf, kend, &kp);
-            if (v < 0) return -1;
+            unsigned long long v;
+            if (!get_uvarint(buf, kend, &kp, &v)) return -1;
             if (kf == 3)
               ver = v;
             else if (kf == 4)
               st = v;
           } else if (kwt == 5) {
+            if (kend - kp < 4) return -1;
             kp += 4;
-            if (kp > kend) return -1;
           } else if (kwt == 1) {
+            if (kend - kp < 8) return -1;
             kp += 8;
-            if (kp > kend) return -1;
           } else {
             return -3;
           }
@@ -180,28 +191,28 @@ long acg_dec_node_delta(const unsigned char* buf, long len,
         kv_spans[4 * nkv + 1] = kl;
         kv_spans[4 * nkv + 2] = vo;
         kv_spans[4 * nkv + 3] = vl;
-        versions[nkv] = ver;
-        statuses[nkv] = static_cast<int>(st);
+        versions[nkv] = static_cast<long long>(ver);
+        statuses[nkv] = static_cast<long long>(st);
         ++nkv;
       }
       pos += static_cast<long>(n);
     } else if (wt == 0) {  // varint
-      long long v = get_uvarint(buf, len, &pos);
-      if (v < 0) return -1;
+      unsigned long long v;
+      if (!get_uvarint(buf, len, &pos, &v)) return -1;
       if (field == 2) {
-        scalars[0] = v;
+        scalars[0] = static_cast<long long>(v);
       } else if (field == 3) {
-        scalars[1] = v;
+        scalars[1] = static_cast<long long>(v);
       } else if (field == 5) {
-        scalars[2] = v;
+        scalars[2] = static_cast<long long>(v);
         scalars[3] = 1;
       }
     } else if (wt == 5) {
+      if (len - pos < 4) return -1;
       pos += 4;
-      if (pos > len) return -1;
     } else if (wt == 1) {
+      if (len - pos < 8) return -1;
       pos += 8;
-      if (pos > len) return -1;
     } else {
       return -3;
     }

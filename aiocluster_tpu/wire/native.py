@@ -17,6 +17,7 @@ import hashlib
 import os
 import subprocess
 import tempfile
+import threading
 from pathlib import Path
 
 _SRC = Path(__file__).with_name("_native.cpp")
@@ -75,7 +76,7 @@ def _lib() -> ctypes.CDLL | None:
             ctypes.c_char_p, ctypes.c_long,
             ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_longlong),
-            ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_long,
         ]
     _LIB = lib
     return _LIB
@@ -83,6 +84,13 @@ def _lib() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return _lib() is not None
+
+
+def warmup() -> bool:
+    """Compile/load the native codec now. The first build shells out to
+    g++ (seconds); call this off the event loop (Cluster.start does, via a
+    thread) so the first MTU-full delta never stalls the gossip loop."""
+    return available()
 
 
 NATIVE_THRESHOLD = 16  # kv updates; below this ctypes overhead dominates
@@ -122,6 +130,28 @@ class NativeDecodeError(ValueError):
     pass
 
 
+_U64 = (1 << 64) - 1
+
+
+class _Scratch(threading.local):
+    """Grow-only per-thread decode buffers: a 64KB MTU delta would
+    otherwise allocate ~1.4MB of zeroed ctypes arrays per handshake."""
+
+    def __init__(self) -> None:
+        self.cap = 0
+
+    def ensure(self, max_kvs: int):
+        if max_kvs > self.cap:
+            self.cap = max(max_kvs, 2 * self.cap)
+            self.kv_spans = (ctypes.c_long * (4 * self.cap))()
+            self.versions = (ctypes.c_longlong * self.cap)()
+            self.statuses = (ctypes.c_longlong * self.cap)()
+        return self.kv_spans, self.versions, self.statuses
+
+
+_scratch = _Scratch()
+
+
 def decode_node_delta_raw(body: bytes):
     """Parse a NodeDelta body natively.
 
@@ -138,9 +168,7 @@ def decode_node_delta_raw(body: bytes):
     max_kvs = blen // 2 + 1
     scalars = (ctypes.c_longlong * 4)()
     node_span = (ctypes.c_long * 2)()
-    kv_spans = (ctypes.c_long * (4 * max_kvs))()
-    versions = (ctypes.c_longlong * max_kvs)()
-    statuses = (ctypes.c_int * max_kvs)()
+    kv_spans, versions, statuses = _scratch.ensure(max_kvs)
     nkv = lib.acg_dec_node_delta(
         body, blen, scalars, node_span, kv_spans, versions, statuses, max_kvs
     )
@@ -153,12 +181,15 @@ def decode_node_delta_raw(body: bytes):
         ko, kl, vo, vl = kv_spans[4 * i : 4 * i + 4]
         key = body[ko : ko + kl].decode("utf-8") if ko >= 0 else ""
         value = body[vo : vo + vl].decode("utf-8") if vo >= 0 else ""
-        kvs.append((key, value, versions[i], statuses[i]))
+        # The C side carries u64 varints as int64 bit patterns; mask back
+        # to the unsigned values the pure-Python decoder produces.
+        kvs.append((key, value, versions[i] & _U64, statuses[i] & _U64))
     node_id_bytes = (
         body[node_span[0] : node_span[1]] if node_span[0] >= 0 else None
     )
     return (
-        (scalars[0], scalars[1], scalars[2], bool(scalars[3])),
+        (scalars[0] & _U64, scalars[1] & _U64, scalars[2] & _U64,
+         bool(scalars[3])),
         node_id_bytes,
         kvs,
     )

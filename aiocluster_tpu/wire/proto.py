@@ -128,7 +128,9 @@ class _Reader:
             self.pos += 1
             result |= (b & 0x7F) << shift
             if not b & 0x80:
-                return result
+                # Truncate to u64 like protobuf (and the native decoder):
+                # a 10-byte varint's final byte may set bits above 63.
+                return result & 0xFFFFFFFFFFFFFFFF
             shift += 7
             if shift > 63:
                 raise WireError("varint too long")
@@ -281,13 +283,13 @@ def encode_node_delta(nd: NodeDelta) -> bytes:
     _field_msg(out, 1, encode_node_id(nd.node_id))
     _field_varint(out, 2, nd.from_version_excluded)
     _field_varint(out, 3, nd.last_gc_version)
-    if len(nd.key_values) >= _native.NATIVE_THRESHOLD:
-        bulk = _native.encode_kv_updates(nd.key_values)
-        if bulk is not None:
-            out += bulk
-        else:
-            for kv in nd.key_values:
-                _field_msg(out, 4, encode_kv_update(kv))
+    bulk = (
+        _native.encode_kv_updates(nd.key_values)
+        if len(nd.key_values) >= _native.NATIVE_THRESHOLD
+        else None
+    )
+    if bulk is not None:
+        out += bulk
     else:
         for kv in nd.key_values:
             _field_msg(out, 4, encode_kv_update(kv))

@@ -237,20 +237,19 @@ def test_simcluster_live_view():
     assert set(sc.live_view("node-0")) == {f"node-{i}" for i in range(8)}
 
 
-def test_fd_window_sum_stays_bounded():
-    """Review regression: isum must behave like a ring-buffer window sum,
+def test_fd_window_mean_stays_bounded():
+    """Review regression: the window mean must behave like a ring buffer's,
     not grow with total runtime (else detection latency diverges)."""
     cfg = SimConfig(n_nodes=8, keys_per_node=2, window_ticks=10)
     s = init_state(cfg)
     for _ in range(200):
         s = sim_step(s, KEY, cfg)
-    isum = np.asarray(s.isum)
+    imean = np.asarray(s.imean)
     icount = np.asarray(s.icount)
     mask = icount >= 10  # windows at the cap
     assert mask.any()
-    means = isum[mask] / icount[mask]
     # Intervals are ~1 tick; a runtime-growing sum would give means ~20.
-    assert means.max() < 3.0
+    assert imean[mask].max() < 3.0
 
 
 def test_scale_free_respects_degree_cap_and_terminates():
@@ -426,3 +425,67 @@ def test_device_trace_writes_profile(tmp_path):
         for f in files
     )
     assert found
+
+
+def test_matching_pairing_converges():
+    cfg = SimConfig(n_nodes=32, keys_per_node=8, pairing="matching")
+    s = run_rounds(init_state(cfg), cfg, 40)
+    assert bool(convergence_metrics(s)["all_converged"])
+    w = np.asarray(s.w)
+    assert (w == np.asarray(s.max_version)[None, :]).all()
+
+
+def test_matching_is_involution():
+    from aiocluster_tpu.ops.gossip import _random_matching
+
+    for n in (8, 9, 64):
+        p = np.asarray(_random_matching(KEY, n))
+        assert (p[p] == np.arange(n)).all()  # pairs are symmetric
+        # at most one self-pair, and only when n is odd
+        assert int((p == np.arange(n)).sum()) == (n % 2)
+
+
+def test_int16_dtypes_match_int32_convergence():
+    base = dict(n_nodes=24, keys_per_node=8, budget=16)
+    cfg32 = SimConfig(**base)
+    cfg16 = SimConfig(**base, version_dtype="int16", heartbeat_dtype="int16")
+    s32 = run_rounds(init_state(cfg32), cfg32, 12)
+    s16 = run_rounds(init_state(cfg16), cfg16, 12)
+    assert s16.w.dtype == np.int16 and s16.hb_known.dtype == np.int16
+    # identical trajectories: the kernel's dither/draws depend only on
+    # global indices and the seed, never on the storage dtype
+    assert (np.asarray(s16.w) == np.asarray(s32.w)).all()
+    assert (np.asarray(s16.hb_known) == np.asarray(s32.hb_known)).all()
+
+
+def test_int16_initial_version_overflow_rejected():
+    cfg = SimConfig(n_nodes=4, keys_per_node=40_000, version_dtype="int16")
+    with pytest.raises(ValueError, match="int16"):
+        init_state(cfg)
+
+
+def test_permutation_both_directions_applied():
+    # After ONE sub-exchange-heavy round every node must have learned at
+    # least one other owner's versions (initiator AND responder roles).
+    cfg = SimConfig(n_nodes=16, keys_per_node=4, fanout=1, budget=1000)
+    s = sim_step(init_state(cfg), KEY, cfg)
+    w = np.asarray(s.w)
+    off_diag = w * (1 - np.eye(16, dtype=w.dtype))
+    # a random permutation has ~1 expected fixed point (a self-pair learns
+    # nothing); everyone else plays both roles and must have learned
+    learned = (off_diag.sum(axis=1) > 0).sum()
+    assert learned >= 16 - 3
+
+
+def test_bfloat16_fd_matches_float32_liveness():
+    base = dict(n_nodes=16, keys_per_node=4, death_rate=0.05, revival_rate=0.2)
+    cfg32 = SimConfig(**base)
+    cfg16 = SimConfig(**base, fd_dtype="bfloat16")
+    s32, s16 = init_state(cfg32), init_state(cfg16)
+    for _ in range(30):
+        s32 = sim_step(s32, KEY, cfg32)
+        s16 = sim_step(s16, KEY, cfg16)
+    assert s16.imean.dtype == jax.numpy.bfloat16
+    # same churn draws (same key), and the rounded mean must not flip
+    # liveness verdicts at these magnitudes
+    assert (np.asarray(s16.live_view) == np.asarray(s32.live_view)).all()

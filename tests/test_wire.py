@@ -225,3 +225,15 @@ def test_wire_interop_with_reference_stubs():
     decoded = decode_packet(ref_packet.SerializeToString())
     assert decoded.cluster_id == "c1"
     assert decoded.msg.digest.node_digests == make_digest().node_digests
+
+
+def test_ten_byte_varint_truncates_to_u64():
+    """Review regression: both decoders must agree with protobuf's mod-2^64
+    truncation when a 10-byte varint's final byte sets bits above 63."""
+    from aiocluster_tpu.wire.proto import _Reader
+
+    # 2^63 encoded, then final byte 0x41 adds bits 64/69-ish garbage.
+    raw = b"\x80" * 9 + b"\x41"
+    r = _Reader(raw)
+    v = r.varint()
+    assert v == ((0x41 & 0x7F) << 63) & 0xFFFFFFFFFFFFFFFF == (1 << 63)

@@ -114,3 +114,77 @@ def test_fallback_when_native_disabled(monkeypatch):
     nd = big_delta(100, 5)
     data = encode_node_delta(nd)
     assert decode_node_delta(data) == nd
+
+
+def _raw_kv_field(body: bytes) -> bytes:
+    """A field-4 (kv) submessage wrapper around raw body bytes."""
+    out = bytearray([0x22])  # (4 << 3) | 2
+    n = len(body)
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out) + body
+
+
+def _pad_to_native(extra: bytes) -> bytes:
+    """Pad a delta body past the 512B native-path threshold with benign
+    kvs, then append the crafted bytes."""
+    filler = encode_node_delta(big_delta(30, 7))
+    assert len(filler) >= 512
+    return filler + extra
+
+
+def test_huge_declared_length_rejected_not_crash():
+    """Review regression: a varint length of 2^63-1 used to wrap the
+    signed bounds check and read out of bounds (SIGSEGV)."""
+    huge_len = b"\x22" + b"\xff" * 8 + b"\x7f"  # field 4, len 2^63-1
+    body = _pad_to_native(huge_len)
+    with pytest.raises(WireError):
+        decode_node_delta(body)
+    # Same inside a kv submessage: key field with huge declared length.
+    inner = b"\x0a" + b"\xff" * 8 + b"\x7f"
+    body = _pad_to_native(_raw_kv_field(inner))
+    with pytest.raises(WireError):
+        decode_node_delta(body)
+
+
+def test_status_not_truncated_mod_2_32():
+    """Review regression: status 2^32+1 used to decode natively as 1."""
+    # kv: status (field 4 varint) = 2^32 + 1
+    st = (1 << 32) + 1
+    enc = bytearray([0x20])  # (4 << 3) | 0
+    v = st
+    while v >= 0x80:
+        enc.append((v & 0x7F) | 0x80)
+        v >>= 7
+    enc.append(v)
+    body = _pad_to_native(_raw_kv_field(bytes(enc)))
+    with pytest.raises(WireError, match=str(st)):
+        decode_node_delta(body)
+
+
+def test_full_u64_version_accepted():
+    """Review regression: versions with bit 63 set are legal u64 varints
+    and used to be rejected on the native path only."""
+    ver = 1 << 63
+    enc = bytearray([0x18])  # (3 << 3) | 0
+    v = ver
+    while v >= 0x80:
+        enc.append((v & 0x7F) | 0x80)
+        v >>= 7
+    enc.append(v)
+    kv_bytes = _raw_kv_field(bytes(enc))
+    body = _pad_to_native(kv_bytes)
+    nd = decode_node_delta(body)
+    assert nd.key_values[-1].version == ver
+    # and parity with the python decoder on the same bytes
+    native_off = native
+    import aiocluster_tpu.wire.proto as proto_mod
+    orig = native_off.decode_node_delta_raw
+    try:
+        native_off.decode_node_delta_raw = lambda b: None
+        nd_py = decode_node_delta(body)
+    finally:
+        native_off.decode_node_delta_raw = orig
+    assert nd_py == nd
