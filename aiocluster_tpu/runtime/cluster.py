@@ -105,6 +105,7 @@ class Cluster:
         self._prev_live: set[NodeId] = set()
 
         self._server: asyncio.Server | None = None
+        self._codec_warmup: asyncio.Task | None = None
         self._started = False
         self._closing = False
 
@@ -136,15 +137,22 @@ class Cluster:
             f"Booting {self.self_node_id.long_name()} "
             f"[{self._config.cluster_id}]"
         )
-        # Warm the native bulk codec off the event loop: its first use
-        # otherwise shells out to g++ inside a gossip handshake.
-        await asyncio.to_thread(wire_native.warmup)
         # Bind before latching _started so a failed boot (e.g. EADDRINUSE)
         # leaves the cluster retryable instead of permanently half-dead.
         self._server = await self._transport.start_server(
             host, port, self._handle_connection
         )
         self._started = True
+        # Warm the native bulk codec in the background: its first use
+        # otherwise shells out to g++ inside a gossip handshake, and
+        # awaiting it here would serialize cold-cache boots behind the
+        # compile. Created only after a successful bind so a failed boot
+        # (where close() early-returns) cannot orphan the task; the codec
+        # no-ops to pure Python until the build lands.
+        if self._codec_warmup is None:
+            self._codec_warmup = asyncio.create_task(
+                asyncio.to_thread(wire_native.warmup)
+            )
         self._hooks.start()
         self._ticker.start()
 
@@ -153,6 +161,10 @@ class Cluster:
             return
         self._closing = True
         await self._ticker.stop()
+        if self._codec_warmup is not None:
+            with suppress(Exception):
+                await self._codec_warmup
+            self._codec_warmup = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()

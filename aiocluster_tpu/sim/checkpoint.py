@@ -1,0 +1,84 @@
+"""Checkpoint / resume for simulated clusters.
+
+The reference has no persistence at all (SURVEY.md §5: a restarted node
+rejoins empty and re-replicates over gossip). Long tensor-sim runs are a
+new capability, so they get one: the full SimState pytree plus the exact
+SimConfig and the run's PRNG seed round-trip through one ``.npz`` file,
+and a resumed run continues the trajectory (same state, same tick, same
+seed) on any device layout — single chip or a sharded mesh — because the
+kernel's randomness is keyed by (seed, tick), not by historical host
+state.
+
+Non-numpy dtypes (bfloat16 lives in ml_dtypes) are stored as raw bit
+patterns plus a dtype string; np.savez would otherwise round-trip them as
+void dtypes that refuse to load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SimConfig
+from .state import SimState
+
+_FIELDS = [f.name for f in dataclasses.fields(SimState)]
+
+
+def save_state(
+    path: str | Path,
+    state: SimState,
+    cfg: SimConfig,
+    *,
+    seed: int = 0,
+    has_topology: bool = False,
+) -> None:
+    """Write state + config + run metadata to ``path`` (.npz, atomic via
+    temp rename)."""
+    path = Path(path)
+    arrays = {}
+    dtypes: dict[str, str] = {}
+    for name in _FIELDS:
+        arr = np.asarray(getattr(state, name))
+        dtypes[name] = str(arr.dtype)
+        if arr.dtype.kind not in "biufc":  # e.g. bfloat16 -> void in npz
+            arr = arr.view(np.uint8).reshape(arr.shape + (arr.dtype.itemsize,))
+        arrays[name] = arr
+    meta = {
+        "config": dataclasses.asdict(cfg),
+        "dtypes": dtypes,
+        "seed": seed,
+        "has_topology": has_topology,
+    }
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(fh, **arrays)
+    tmp.replace(path)
+
+
+def load_state(
+    path: str | Path,
+) -> tuple[SimState, SimConfig, dict]:
+    """Read a checkpoint; returns (state, config, meta) where meta carries
+    ``seed`` and ``has_topology``. The caller re-shards with
+    parallel.shard_state when resuming on a mesh."""
+    with np.load(Path(path)) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode())
+        cfg = SimConfig(**meta["config"])
+        fields = {}
+        for name in _FIELDS:
+            arr = data[name]
+            want = jnp.dtype(meta["dtypes"][name])
+            if arr.dtype == np.uint8 and want.kind not in "biufc":
+                arr = arr.reshape(arr.shape[:-1] + (-1,)).view(want)
+                arr = arr.reshape(arr.shape[:-1])
+            fields[name] = jnp.asarray(arr, dtype=want)
+        state = SimState(**fields)
+    return state, cfg, meta

@@ -122,7 +122,9 @@ class SimConfig:
             raise ValueError(f"unknown heartbeat_dtype: {self.heartbeat_dtype}")
         if self.fd_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown fd_dtype: {self.fd_dtype}")
-        if self.window_ticks >= 2**15:
+        if self.window_ticks >= 2**15 - 1:
+            # The kernel increments the int16 counter BEFORE clamping to
+            # the cap, so window_ticks + 1 must also fit.
             raise ValueError("window_ticks must fit the int16 sample counter")
         if self.peer_mode == "view" and self.pairing != "choice":
             raise ValueError(

@@ -1,0 +1,77 @@
+"""Device-memory planning for simulated cluster sizes.
+
+The sim's footprint is dominated by the (N, N) knowledge matrices
+(sim/state.py). Which matrices exist — and how wide their elements are —
+depends on SimConfig, so feasibility at a target scale is a pure function
+of the config. This module answers "will it fit?" before any device
+allocation, and is what ``bench.py --probe`` and the 100k-node planning
+in BASELINE.md are computed from.
+
+Reference parity note: the object model (reference state.py) needs O(keys)
+host memory per node pair view; the tensor sim collapses each pair to a
+few bytes. A 100k-node convergence sim in the lean profile is
+2 B/pair * 100k^2 = 20 GB — sharded over a v5e-8's owner axis, 2.5 GB per
+chip plus one gathered operand.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .config import SimConfig
+
+
+@dataclass(frozen=True)
+class MemoryPlan:
+    """Estimated device bytes for one simulated cluster."""
+
+    n_nodes: int
+    state_bytes: int  # resident SimState matrices
+    transient_bytes: int  # largest gathered operand alive during a step
+    shards: int
+
+    @property
+    def per_shard_bytes(self) -> int:
+        return (self.state_bytes + self.transient_bytes) // self.shards
+
+    def fits(self, hbm_bytes_per_chip: int = 16 * 1024**3) -> bool:
+        # Leave 20% headroom for XLA scratch and fusion temporaries.
+        return self.per_shard_bytes <= int(hbm_bytes_per_chip * 0.8)
+
+
+def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
+    """Bytes needed for ``cfg`` sharded ``shards`` ways on the owner axis."""
+    n = cfg.n_nodes
+    pair = jnp.dtype(cfg.version_dtype).itemsize  # w
+    if cfg.track_heartbeats:
+        pair += jnp.dtype(cfg.heartbeat_dtype).itemsize  # hb_known
+    if cfg.track_failure_detector:
+        pair += jnp.dtype(cfg.heartbeat_dtype).itemsize  # last_change
+        pair += jnp.dtype(cfg.fd_dtype).itemsize  # imean
+        pair += 2  # icount int16
+        pair += 1  # live_view bool
+    state = pair * n * n
+    # One permuted gather of w (and hb when tracked) is live alongside the
+    # donated state during a pull.
+    transient = jnp.dtype(cfg.version_dtype).itemsize * n * n
+    if cfg.track_heartbeats:
+        transient += jnp.dtype(cfg.heartbeat_dtype).itemsize * n * n
+    return MemoryPlan(n, state, transient, shards)
+
+
+def lean_config(n_nodes: int, **overrides) -> SimConfig:
+    """The memory-lean convergence profile used for max-scale runs:
+    int16 watermarks, no heartbeat matrix, no failure detector."""
+    defaults = dict(
+        n_nodes=n_nodes,
+        keys_per_node=16,
+        fanout=3,
+        budget=2048,
+        version_dtype="int16",
+        track_failure_detector=False,
+        track_heartbeats=False,
+    )
+    defaults.update(overrides)
+    return SimConfig(**defaults)

@@ -55,11 +55,13 @@ class Simulator:
         chunk: int = 8,
         initial_versions=None,
         trace: bool = False,
+        state: SimState | None = None,
     ) -> None:
         if topology is not None and topology.n_nodes != cfg.n_nodes:
             raise ValueError("topology size != cfg.n_nodes")
         self.cfg = cfg
         self.chunk = chunk
+        self.seed = seed
         self._key = random.key(seed)
         self._adj = (
             None if topology is None else jax.numpy.asarray(topology.adjacency)
@@ -72,7 +74,11 @@ class Simulator:
         # server.py:50-56,168-175): each entry is one sampled round.
         self._trace_enabled = trace
         self.trace: list[dict[str, float]] = []
-        self.state: SimState = init_state(cfg, initial_versions)
+        # A provided state (checkpoint resume) skips init_state so peak
+        # memory stays at one state's worth, not two.
+        self.state: SimState = (
+            state if state is not None else init_state(cfg, initial_versions)
+        )
         self._mesh = mesh
         if mesh is not None:
             self.state = shard_state(self.state, mesh)
@@ -144,3 +150,50 @@ class Simulator:
     @property
     def tick(self) -> int:
         return int(self.state.tick)
+
+    # -- checkpoint / resume ---------------------------------------------------
+
+    def save(self, path) -> None:
+        """Checkpoint the full device state (gathers to host first), plus
+        the seed and topology flag needed to continue the trajectory."""
+        from .checkpoint import save_state
+
+        save_state(
+            path,
+            jax.device_get(self.state),
+            self.cfg,
+            seed=self.seed,
+            has_topology=self._adj is not None,
+        )
+
+    @classmethod
+    def resume(
+        cls,
+        path,
+        *,
+        seed: int | None = None,
+        mesh: Mesh | None = None,
+        topology: Topology | None = None,
+        chunk: int = 8,
+        trace: bool = False,
+    ) -> "Simulator":
+        """Continue a checkpointed run — on any device layout, since the
+        kernel's randomness depends only on (seed, tick). The original
+        seed is stored in the checkpoint and used unless overridden."""
+        from .checkpoint import load_state
+
+        state, cfg, meta = load_state(path)
+        if meta["has_topology"] and topology is None:
+            raise ValueError(
+                "checkpoint was taken with a topology; pass the same "
+                "topology to resume (adjacency is not persisted)"
+            )
+        return cls(
+            cfg,
+            seed=meta["seed"] if seed is None else seed,
+            mesh=mesh,
+            topology=topology,
+            chunk=chunk,
+            trace=trace,
+            state=state,  # __init__ shards it when mesh is not None
+        )

@@ -149,6 +149,34 @@ def sim_rounds_per_sec(n_nodes: int, rounds: int, log) -> tuple[float, int | Non
     return rps, converged_at
 
 
+def scale_probe(log, n_nodes: int = 32_768, rounds: int = 16) -> float:
+    """Max single-chip scale: the lean convergence profile (int16
+    watermarks, no FD matrices — sim/memory.py) at the largest N that fits
+    one chip's HBM. The 100k-node north star runs this profile sharded
+    over a v5e-8 (BASELINE.md config 5); this records the per-chip rate
+    the projection is built on."""
+    import numpy as np
+
+    from aiocluster_tpu.sim import Simulator
+    from aiocluster_tpu.sim.memory import lean_config, plan
+
+    cfg = lean_config(n_nodes)
+    assert plan(cfg).fits(), "probe config must fit one chip"
+    sim = Simulator(cfg, seed=0, chunk=8)
+    t0 = time.perf_counter()
+    sim.run(8)
+    int(np.asarray(sim.state.tick))
+    log(f"scale probe compile+first chunk: {time.perf_counter() - t0:.1f}s")
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        sim.run(rounds)
+        int(np.asarray(sim.state.tick))
+        best = max(best, rounds / (time.perf_counter() - t0))
+    log(f"scale probe @ {n_nodes} nodes (lean): {best:.1f} rounds/s")
+    return best
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true", help="small CPU-friendly run")
@@ -162,9 +190,21 @@ def main() -> None:
     def log(msg: str) -> None:
         print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
+    # int16 heartbeat contract: warmup + 3 timed trials must stay < 2^15
+    # ticks (SimConfig.heartbeat_dtype).
+    if rounds > 10_000:
+        log(f"--rounds {rounds} capped to 10000 (int16 tick horizon)")
+        rounds = 10_000
+
     rps, converged_at = sim_rounds_per_sec(n_nodes, rounds, log)
     baseline_rps = python_rounds_per_sec(n_nodes)
     log(f"python object-model estimate: {baseline_rps:.4f} rounds/s")
+    probe_rps = None
+    if not args.smoke:
+        try:
+            probe_rps = round(scale_probe(log), 2)
+        except Exception as exc:  # keep the headline even if the probe dies
+            log(f"scale probe failed: {exc!r}")
     result = {
         "metric": f"sim_gossip_rounds_per_sec@{n_nodes}_nodes",
         "value": round(rps, 2),
@@ -180,6 +220,11 @@ def main() -> None:
             "version_dtype": "int16",
             "heartbeat_dtype": "int16",
             "fd_dtype": "bfloat16",
+            "max_scale_single_chip": (
+                {"nodes": 32_768, "profile": "lean", "rounds_per_sec": probe_rps}
+                if probe_rps is not None
+                else None
+            ),
         },
     }
     print(json.dumps(result), flush=True)

@@ -21,6 +21,11 @@ import dataclasses
 import json
 from urllib.parse import parse_qs, urlparse
 
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root run
+
 from aiocluster_tpu import Cluster, Config, NodeId
 
 

@@ -9,6 +9,11 @@ Run: python examples/simple.py
 import asyncio
 import logging
 
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root run
+
 from aiocluster_tpu import Cluster, Config, NodeId
 
 

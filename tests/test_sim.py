@@ -489,3 +489,89 @@ def test_bfloat16_fd_matches_float32_liveness():
     # same churn draws (same key), and the rounded mean must not flip
     # liveness verdicts at these magnitudes
     assert (np.asarray(s16.live_view) == np.asarray(s32.live_view)).all()
+
+
+def test_checkpoint_resume_continues_trajectory(tmp_path):
+    from aiocluster_tpu.sim import Simulator
+
+    cfg = SimConfig(n_nodes=24, keys_per_node=4, budget=32)
+    a = Simulator(cfg, seed=7)
+    a.run(5)
+    ckpt = tmp_path / "sim.npz"
+    a.save(ckpt)
+    b = Simulator.resume(ckpt)  # seed comes from the checkpoint
+    assert b.tick == 5 and b.cfg == cfg and b.seed == 7
+    a.run(10)
+    b.run(10)
+    # resumed run reproduces the original trajectory exactly
+    assert (np.asarray(a.state.w) == np.asarray(b.state.w)).all()
+    assert (np.asarray(a.state.live_view) == np.asarray(b.state.live_view)).all()
+
+
+def test_checkpoint_resume_onto_mesh(tmp_path):
+    import jax
+    from aiocluster_tpu.parallel.mesh import make_mesh
+    from aiocluster_tpu.sim import Simulator
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    cfg = SimConfig(n_nodes=16, keys_per_node=4)
+    a = Simulator(cfg, seed=3)
+    a.run(4)
+    ckpt = tmp_path / "sim.npz"
+    a.save(ckpt)
+    mesh = make_mesh(jax.devices()[:2])
+    b = Simulator.resume(ckpt, seed=3, mesh=mesh)
+    a.run(6)
+    b.run(6)
+    assert (np.asarray(a.state.w) == np.asarray(b.state.w)).all()
+
+
+def test_memory_plan_profiles():
+    from aiocluster_tpu.sim.memory import lean_config, plan
+
+    full = SimConfig(n_nodes=10_000, version_dtype="int16",
+                     heartbeat_dtype="int16", fd_dtype="bfloat16")
+    assert plan(full).fits()  # 10k full-FD fits one chip
+    lean100k = lean_config(100_000)
+    assert not plan(lean100k).fits()  # 20 GB: not one chip...
+    assert plan(lean100k, shards=8).fits()  # ...but fits a v5e-8
+    # full-FD at 100k exceeds even 8 x 16 GB chips — documented limit
+    full100k = SimConfig(n_nodes=100_000, version_dtype="int16",
+                         heartbeat_dtype="int16", fd_dtype="bfloat16")
+    assert not plan(full100k, shards=8).fits()
+    assert plan(full100k, shards=16).fits()
+
+
+def test_checkpoint_bfloat16_roundtrip(tmp_path):
+    """Review regression: bfloat16 imean used to round-trip through npz as
+    a void dtype and fail to load."""
+    from aiocluster_tpu.sim import Simulator
+
+    cfg = SimConfig(n_nodes=12, keys_per_node=2, fd_dtype="bfloat16",
+                    version_dtype="int16", heartbeat_dtype="int16")
+    a = Simulator(cfg, seed=5)
+    a.run(6)
+    ckpt = tmp_path / "bf16.npz"
+    a.save(ckpt)
+    b = Simulator.resume(ckpt)
+    assert b.state.imean.dtype == jax.numpy.bfloat16
+    assert (np.asarray(b.state.imean) == np.asarray(a.state.imean)).all()
+    a.run(6), b.run(6)
+    assert (np.asarray(a.state.w) == np.asarray(b.state.w)).all()
+
+
+def test_checkpoint_topology_must_be_reprovided(tmp_path):
+    from aiocluster_tpu.sim import Simulator
+
+    topo = ring(16, 1)
+    cfg = SimConfig(n_nodes=16, keys_per_node=2, track_failure_detector=False)
+    a = Simulator(cfg, seed=1, topology=topo)
+    a.run(2)
+    ckpt = tmp_path / "topo.npz"
+    a.save(ckpt)
+    with pytest.raises(ValueError, match="topology"):
+        Simulator.resume(ckpt)
+    b = Simulator.resume(ckpt, topology=topo)
+    a.run(4), b.run(4)
+    assert (np.asarray(a.state.w) == np.asarray(b.state.w)).all()
