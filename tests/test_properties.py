@@ -1,0 +1,164 @@
+"""Property-style tests: randomized round-trips and CRDT convergence.
+
+SURVEY.md §7 step 2 calls for property-testing the reconciliation core:
+these drive randomized workloads through the public surfaces instead of
+hand-picked cases — seeded for reproducibility.
+"""
+
+import random as pyrandom
+import string
+from datetime import UTC, datetime, timedelta
+
+import pytest
+
+from aiocluster_tpu.core import (
+    ClusterState,
+    Config,
+    FailureDetector,
+    FailureDetectorConfig,
+    NodeId,
+)
+from aiocluster_tpu.core.messages import KeyValueUpdate, NodeDelta
+from aiocluster_tpu.core.values import VersionStatusEnum
+from aiocluster_tpu.runtime.engine import GossipEngine
+from aiocluster_tpu.wire import decode_packet, encode_packet
+from aiocluster_tpu.wire.proto import decode_node_delta, encode_node_delta
+
+TS = datetime(2026, 1, 1, tzinfo=UTC)
+
+
+def _rand_text(rng: pyrandom.Random, max_len: int = 24) -> str:
+    alphabet = string.ascii_letters + string.digits + "/-_.é☃"
+    return "".join(
+        rng.choice(alphabet) for _ in range(rng.randint(0, max_len))
+    )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_node_delta_roundtrip_fuzz(seed):
+    """encode -> decode is the identity for arbitrary well-formed deltas,
+    through whichever codec path (native engages above the size gate)."""
+    rng = pyrandom.Random(seed)
+    kvs = [
+        KeyValueUpdate(
+            key=_rand_text(rng),
+            value=_rand_text(rng, 60),
+            version=rng.randint(0, 2**63),
+            status=rng.choice(list(VersionStatusEnum)),
+        )
+        for _ in range(rng.randint(0, 120))
+    ]
+    nd = NodeDelta(
+        node_id=NodeId(_rand_text(rng) or "n", rng.randint(0, 2**62),
+                       ("host", rng.randint(1, 65535))),
+        from_version_excluded=rng.randint(0, 2**40),
+        last_gc_version=rng.randint(0, 2**40),
+        key_values=kvs,
+        max_version=rng.choice([None, rng.randint(0, 2**40)]),
+    )
+    body = encode_node_delta(nd)
+    assert decode_node_delta(body) == nd
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_workload_converges_and_agrees(seed):
+    """CRDT property: N engines applying random local writes + random
+    pairwise handshakes end up with identical, complete cluster state
+    once enough handshakes have run."""
+    rng = pyrandom.Random(100 + seed)
+    n = 4
+    nodes = [NodeId(f"n{i}", i + 1, ("h", i + 1)) for i in range(n)]
+
+    def mk(i: int) -> GossipEngine:
+        cfg = Config(node_id=nodes[i], cluster_id="prop")
+        cs = ClusterState()
+        cs.node_state_or_default(nodes[i]).inc_heartbeat()
+        return GossipEngine(cfg, cs, FailureDetector(FailureDetectorConfig()))
+
+    engines = [mk(i) for i in range(n)]
+    keys = [f"k{j}" for j in range(6)]
+
+    def handshake(a: GossipEngine, b: GossipEngine) -> None:
+        syn = decode_packet(encode_packet(a.make_syn()))
+        synack = decode_packet(encode_packet(b.handle_syn(syn)))
+        ack = decode_packet(encode_packet(a.handle_synack(synack)))
+        b.handle_ack(ack)
+
+    # Interleave random owner writes (sets, deletes, TTL) and handshakes.
+    for _ in range(120):
+        op = rng.random()
+        i = rng.randrange(n)
+        ns = engines[i]._state.node_state_or_default(nodes[i])
+        if op < 0.5:
+            ns.set(rng.choice(keys), _rand_text(rng, 8), ts=TS)
+        elif op < 0.6:
+            ns.delete(rng.choice(keys), ts=TS)
+        elif op < 0.65:
+            ns.set_with_ttl(rng.choice(keys), _rand_text(rng, 8), ts=TS)
+        else:
+            j = rng.randrange(n)
+            if j != i:
+                handshake(engines[i], engines[j])
+
+    # Quiesce: enough all-pairs rounds for every delta to land.
+    for _ in range(4):
+        for i in range(n):
+            for j in range(n):
+                if i != j:
+                    handshake(engines[i], engines[j])
+
+    # Every engine holds the identical keyspace for every owner.
+    for owner in range(n):
+        truth = engines[owner]._state.node_state_or_default(nodes[owner])
+        for other in range(n):
+            replica = engines[other]._state.node_state_or_default(nodes[owner])
+            assert replica.max_version == truth.max_version, (owner, other)
+            assert {
+                k: (v.value, v.version, v.status)
+                for k, v in replica.key_values.items()
+            } == {
+                k: (v.value, v.version, v.status)
+                for k, v in truth.key_values.items()
+            }, (owner, other)
+
+
+def test_gc_watermark_consistency_under_gossip():
+    """Tombstones GC'd at the owner disappear from replicas via the
+    watermark, never resurrecting."""
+    nodes = [NodeId(f"g{i}", i + 1, ("h", 50 + i)) for i in range(2)]
+
+    def mk(i):
+        cfg = Config(node_id=nodes[i], cluster_id="gc")
+        cs = ClusterState()
+        cs.node_state_or_default(nodes[i]).inc_heartbeat()
+        return GossipEngine(cfg, cs, FailureDetector(FailureDetectorConfig()))
+
+    a, b = mk(0), mk(1)
+
+    def handshake(x, y):
+        syn = decode_packet(encode_packet(x.make_syn()))
+        synack = decode_packet(encode_packet(y.handle_syn(syn)))
+        ack = decode_packet(encode_packet(x.handle_synack(synack)))
+        y.handle_ack(ack)
+
+    ns = a._state.node_state_or_default(nodes[0])
+    ns.set("keep", "v", ts=TS)
+    ns.set("gone", "v", ts=TS)
+    ns.delete("gone", ts=TS)
+    handshake(a, b)
+    replica = b._state.node_state_or_default(nodes[0])
+    assert replica.get("keep") is not None and replica.get("gone") is None
+
+    # Owner GCs the tombstone after the grace period. The watermark rides
+    # deltas, and a fully caught-up replica gets no delta (reference
+    # state.py:356-357 skips nodes with max_version <= digest's), so the
+    # purge reaches replicas with the owner's NEXT write — same contract
+    # as the reference.
+    ns.gc_marked_for_deletion(timedelta(hours=2), ts=TS + timedelta(hours=3))
+    assert "gone" not in ns.key_values
+    ns.set("fresh", "w", ts=TS + timedelta(hours=3))
+    handshake(a, b)
+    assert "gone" not in replica.key_values
+    assert replica.get("keep").value == "v"  # live key survives the watermark
+    assert replica.get("fresh").value == "w"
+    assert replica.last_gc_version == ns.last_gc_version

@@ -109,3 +109,38 @@ def test_sharded_simulator_with_scale_free_topology():
     r_single = single.run_until_converged(2000)
     assert r_sharded is not None
     assert r_sharded == r_single
+
+
+def test_sharded_matching_compact_dtypes_bit_identical():
+    """The new matching pairing and int16/bfloat16 storage must stay
+    shard-exact too (dither/draws key off GLOBAL indices only)."""
+    cfg = SimConfig(
+        n_nodes=64, keys_per_node=8, budget=24, pairing="matching",
+        version_dtype="int16", heartbeat_dtype="int16", fd_dtype="bfloat16",
+    )
+    mesh = make_mesh()
+    step = sharded_step_fn(cfg, mesh)
+    sharded = shard_state(init_state(cfg), mesh)
+    single = init_state(cfg)
+    for _ in range(10):
+        sharded = step(sharded, KEY)
+        single = sim_step(single, KEY, cfg)
+    assert np.array_equal(np.asarray(sharded.w), np.asarray(single.w))
+    assert np.array_equal(
+        np.asarray(sharded.imean), np.asarray(single.imean)
+    )
+    assert np.array_equal(
+        np.asarray(sharded.live_view), np.asarray(single.live_view)
+    )
+
+
+def test_sharded_resume_matches_single_device_resume(tmp_path):
+    cfg = SimConfig(n_nodes=32, keys_per_node=4, budget=16)
+    a = Simulator(cfg, seed=6)
+    a.run(5)
+    ckpt = tmp_path / "s.npz"
+    a.save(ckpt)
+    b = Simulator.resume(ckpt, mesh=make_mesh())
+    a.run(7)
+    b.run(7)
+    assert np.array_equal(np.asarray(a.state.w), np.asarray(b.state.w))
