@@ -301,28 +301,49 @@ def sim_step(
 
     # -- fanout sub-exchanges (both handshake directions per pair) -----------
     if cfg.pairing in ("permutation", "matching") and adjacency is None:
+        from . import pallas_pull
+
+        dual = cfg.pairing == "permutation"
+        use_pallas = (
+            cfg.use_pallas
+            and axis_name is None
+            and cfg.budget_policy == "proportional"
+            and track_hb
+            and pallas_pull.supported(
+                n, state.w.dtype.itemsize, dual, track_hb
+            )
+        )
+        # Interpreter mode off-TPU so the same config runs (slowly) in
+        # CPU tests; the axon platform is a TPU PJRT plugin.
+        interpret = jax.default_backend() not in ("tpu", "axon")
         for c in range(cfg.fanout):
             ck = random.fold_in(peer_key, c)
-            if cfg.pairing == "matching":
+            if dual:
+                # Initiator i talks to p[i]; the responder role is the
+                # pull through the inverse permutation. Both exchanges
+                # are computed from the pre-round state and joined with
+                # an elementwise max — as in the reference handshake,
+                # where both sides' deltas derive from the pre-handshake
+                # digests — so they fuse into one pass over w.
+                p = random.permutation(ck, n)
+                inv = jnp.argsort(p)
+            else:
                 # Random perfect matching (p an involution): one
                 # bidirectional handshake per node — i's pull from p[i]
                 # IS the pair's full exchange, because row p[i] pulls
                 # from i in the same vectorized op. Half the traffic of
                 # "permutation" per sub-exchange.
                 p = _random_matching(ck, n)
-                adv, valid = peer_adv(w, p, sub_salt(c, 0))
-                w = w + adv
-                if track_hb:
-                    hb = hb_absorb(hb, p, valid)
-            else:
-                # Initiator i talks to p[i]; the responder role is the
-                # pull through the inverse permutation. Both exchanges
-                # are computed from the pre-round state and joined with
-                # an elementwise max — as in the reference handshake,
-                # where both sides' deltas derive from the pre-handshake
-                # digests — so XLA fuses them into one pass over w.
-                p = random.permutation(ck, n)
-                inv = jnp.argsort(p)
+                inv = p
+            if use_pallas:
+                w, hb = pallas_pull.fused_pull(
+                    w, hb, p, inv,
+                    alive & alive[p], alive & alive[inv],
+                    sub_salt(c, 0), sub_salt(c, 1), run_salt,
+                    cfg.budget, track_hb=True, dual=dual,
+                    interpret=interpret,
+                )
+            elif dual:
                 adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0))
                 adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1))
                 w = w + jnp.maximum(adv_p, adv_i)
@@ -330,6 +351,11 @@ def sim_step(
                     hb = jnp.maximum(
                         hb_absorb(hb, p, valid_p), hb_absorb(hb, inv, valid_i)
                     )
+            else:
+                adv, valid = peer_adv(w, p, sub_salt(c, 0))
+                w = w + adv
+                if track_hb:
+                    hb = hb_absorb(hb, p, valid)
     else:
         # Independent choice (reference semantics: inbound load varies) or
         # adjacency-constrained topology; responder side needs scatter-max.

@@ -1,0 +1,290 @@
+"""Fused Pallas TPU kernel for one gossip sub-exchange.
+
+The XLA path of ops/gossip.py executes a sub-exchange as several separate
+passes over the (N, N) matrices: peer-row gathers for w and hb, a
+deficit-total reduction, the dithered advance, and the heartbeat absorb.
+This kernel performs the whole sub-exchange — both handshake directions —
+in ONE pass over HBM per matrix: each row block is read once, its peer
+rows are fetched by per-row DMA (sharing the same index for w and hb),
+and the budget math runs entirely in VMEM.
+
+Bit-compatibility: the advance formula and the (row, owner, salt) dither
+hash are the same arithmetic as gossip._budgeted_advance /
+gossip._hash_uniform, so the kernel's output is exactly equal to the XLA
+path's (asserted in tests/test_pallas_pull.py). Single-device,
+proportional-budget, permutation/matching pairing only — the sharded and
+greedy paths stay on XLA.
+
+Reference anchor: this is the hot loop of server.py:378-495 (the 3-way
+handshake fan-out) collapsed into one tensor pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _dither(rows: jax.Array, owners: jax.Array, salt, run_salt) -> jax.Array:
+    """Same hash as gossip._hash_uniform, on explicit index grids."""
+    i = rows.astype(jnp.uint32)
+    j = owners.astype(jnp.uint32)
+    s = salt.astype(jnp.uint32) ^ run_salt.astype(jnp.uint32)
+    h = (
+        i * jnp.uint32(0x9E3779B1)
+        ^ j * jnp.uint32(0x85EBCA77)
+        ^ s * jnp.uint32(0xC2B2AE3D)
+    )
+    h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
+    h = h ^ (h >> 13)
+    u = h.astype(jnp.float32) * (1.0 / 4294967296.0)
+    return jnp.clip(u, 1e-12, 1.0 - 2.0**-24)
+
+
+def _advance(w_self32, w_peer32, valid_col, budget, rows, owners, salt, run_salt):
+    """gossip._budgeted_advance, proportional policy, in int32/f32."""
+    d = jnp.maximum(w_peer32 - w_self32, 0) * valid_col
+    total = jnp.sum(d.astype(jnp.float32), axis=1, keepdims=True)
+    scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
+    x = d.astype(jnp.float32) * scale
+    floor = jnp.floor(x)
+    bump = _dither(rows, owners, salt, run_salt) < (x - floor)
+    return jnp.minimum(floor.astype(jnp.int32) + bump, d)
+
+
+def _pull_kernel(
+    # scalar prefetch
+    p_ref,
+    inv_ref,
+    meta_ref,  # [salt_p, salt_i, run_salt, budget]
+    # block inputs
+    w_ref,
+    hb_ref,
+    validp_ref,
+    validi_ref,
+    # HBM inputs for gathers
+    w_hbm,
+    hb_hbm,
+    # outputs
+    wout_ref,
+    hbout_ref,
+    # scratch
+    wp,
+    wi,
+    hbp,
+    hbi,
+    sems,
+    *,
+    block: int,
+    n: int,
+    track_hb: bool,
+    dual: bool,
+):
+    b0 = pl.program_id(0) * block
+
+    def gather(r, _):
+        pr = p_ref[b0 + r]
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(pr, 1), :], wp.at[pl.ds(r, 1), :], sems.at[0, r]
+        ).start()
+        if track_hb:
+            pltpu.make_async_copy(
+                hb_hbm.at[pl.ds(pr, 1), :], hbp.at[pl.ds(r, 1), :], sems.at[1, r]
+            ).start()
+        if dual:
+            ir = inv_ref[b0 + r]
+            pltpu.make_async_copy(
+                w_hbm.at[pl.ds(ir, 1), :], wi.at[pl.ds(r, 1), :], sems.at[2, r]
+            ).start()
+            if track_hb:
+                pltpu.make_async_copy(
+                    hb_hbm.at[pl.ds(ir, 1), :],
+                    hbi.at[pl.ds(r, 1), :],
+                    sems.at[3, r],
+                ).start()
+        return 0
+
+    def wait(r, _):
+        pr = p_ref[b0 + r]
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(pr, 1), :], wp.at[pl.ds(r, 1), :], sems.at[0, r]
+        ).wait()
+        if track_hb:
+            pltpu.make_async_copy(
+                hb_hbm.at[pl.ds(pr, 1), :], hbp.at[pl.ds(r, 1), :], sems.at[1, r]
+            ).wait()
+        if dual:
+            ir = inv_ref[b0 + r]
+            pltpu.make_async_copy(
+                w_hbm.at[pl.ds(ir, 1), :], wi.at[pl.ds(r, 1), :], sems.at[2, r]
+            ).wait()
+            if track_hb:
+                pltpu.make_async_copy(
+                    hb_hbm.at[pl.ds(ir, 1), :],
+                    hbi.at[pl.ds(r, 1), :],
+                    sems.at[3, r],
+                ).wait()
+        return 0
+
+    lax.fori_loop(0, block, gather, 0)
+    lax.fori_loop(0, block, wait, 0)
+
+    salt_p = meta_ref[0]
+    salt_i = meta_ref[1]
+    run_salt = meta_ref[2]
+    budget = meta_ref[3].astype(jnp.float32)
+
+    rows = b0 + lax.broadcasted_iota(jnp.int32, (block, n), 0)
+    owners = lax.broadcasted_iota(jnp.int32, (block, n), 1)
+
+    w_self = w_ref[:].astype(jnp.int32)
+    vp = validp_ref[:].astype(jnp.int32)  # (block, 1)
+    adv = _advance(
+        w_self, wp[:].astype(jnp.int32), vp, budget, rows, owners,
+        salt_p, run_salt,
+    )
+    if dual:
+        vi = validi_ref[:].astype(jnp.int32)
+        adv_i = _advance(
+            w_self, wi[:].astype(jnp.int32), vi, budget, rows, owners,
+            salt_i, run_salt,
+        )
+        adv = jnp.maximum(adv, adv_i)
+    wout_ref[:] = (w_self + adv).astype(wout_ref.dtype)
+
+    if track_hb:
+        hb_self = hb_ref[:].astype(jnp.int32)
+        hb_new = jnp.maximum(hb_self, hbp[:].astype(jnp.int32) * vp)
+        if dual:
+            hb_new = jnp.maximum(hb_new, hbi[:].astype(jnp.int32) * vi)
+        hbout_ref[:] = hb_new.astype(hbout_ref.dtype)
+    else:
+        hbout_ref[:] = hb_ref[:]
+
+
+VMEM_BUDGET = 12 * 1024 * 1024  # ~16 MB/core, minus headroom for Mosaic
+
+
+def _buffer_count(dual: bool, track_hb: bool) -> int:
+    """(block, n)-sized VMEM buffers the kernel needs: pipelined in/out
+    blocks are double-buffered (x2), gather scratch is single."""
+    per_matrix = 2 + 2 + 1 + (1 if dual else 0)  # in x2, out x2, peer scratch
+    return per_matrix * (2 if track_hb else 1)
+
+
+def _pick_block(
+    n: int,
+    itemsize: int = 4,
+    dual: bool = True,
+    track_hb: bool = True,
+    cap: int = 512,
+) -> int | None:
+    """Largest multiple-of-8 divisor of n such that every VMEM-resident
+    buffer set fits the per-core budget."""
+    per_row = _buffer_count(dual, track_hb) * n * itemsize
+    limit = min(cap, VMEM_BUDGET // max(per_row, 1))
+    best = None
+    for b in range(8, limit + 1, 8):
+        if n % b == 0:
+            best = b
+    return best
+
+
+def supported(n: int, itemsize: int, dual: bool, track_hb: bool) -> bool:
+    """Whether the fused kernel can run this shape (callers fall back to
+    the XLA path when not)."""
+    return _pick_block(n, itemsize, dual, track_hb) is not None
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("budget", "track_hb", "dual", "interpret"),
+)
+def fused_pull(
+    w: jax.Array,
+    hb: jax.Array,
+    p: jax.Array,
+    inv: jax.Array,
+    valid_p: jax.Array,
+    valid_i: jax.Array,
+    salt_p: jax.Array,
+    salt_i: jax.Array,
+    run_salt: jax.Array,
+    budget: int,
+    track_hb: bool = True,
+    dual: bool = True,
+    interpret: bool = False,
+):
+    """One fused sub-exchange. Returns (w', hb').
+
+    ``dual=True`` is permutation pairing (initiator via p + responder via
+    inv, joined by max); ``dual=False`` is matching pairing (p is an
+    involution). ``valid_*`` are per-row alive-pair masks.
+    """
+    n = w.shape[0]
+    itemsize = max(w.dtype.itemsize, hb.dtype.itemsize)
+    block = _pick_block(n, itemsize, dual, track_hb)
+    if block is None:
+        raise ValueError(f"no suitable row block for n={n}")
+    meta = jnp.stack(
+        [
+            salt_p.astype(jnp.int32),
+            salt_i.astype(jnp.int32),
+            run_salt.astype(jnp.int32),
+            jnp.asarray(budget, jnp.int32),
+        ]
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, n), lambda i, *_: (i, 0)),  # w block
+            pl.BlockSpec((block, n), lambda i, *_: (i, 0)),  # hb block
+            pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),  # valid_p col
+            pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),  # valid_i col
+            pl.BlockSpec(memory_space=pl.ANY),  # w HBM (gather source)
+            pl.BlockSpec(memory_space=pl.ANY),  # hb HBM
+        ],
+        out_specs=[
+            pl.BlockSpec((block, n), lambda i, *_: (i, 0)),
+            pl.BlockSpec((block, n), lambda i, *_: (i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, n), w.dtype),
+            # Unused directions/matrices get minimal-tile dummies so the
+            # kernel signature stays fixed without wasting VMEM.
+            pltpu.VMEM((block, n) if dual else (16, 128), w.dtype),
+            pltpu.VMEM((block, n) if track_hb else (16, 128), hb.dtype),
+            pltpu.VMEM(
+                (block, n) if (dual and track_hb) else (16, 128), hb.dtype
+            ),
+            pltpu.SemaphoreType.DMA((4, block)),
+        ],
+    )
+    kernel = functools.partial(
+        _pull_kernel, block=block, n=n, track_hb=track_hb, dual=dual
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(w.shape, w.dtype),
+            jax.ShapeDtypeStruct(hb.shape, hb.dtype),
+        ],
+        interpret=interpret,
+    )(
+        p.astype(jnp.int32),
+        inv.astype(jnp.int32),
+        meta,
+        w,
+        hb,
+        valid_p.astype(jnp.int8)[:, None],
+        valid_i.astype(jnp.int8)[:, None],
+        w,
+        hb,
+    )

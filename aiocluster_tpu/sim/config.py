@@ -107,6 +107,13 @@ class SimConfig:
     # off (with the FD) for memory-lean pure-convergence runs at 100k.
     track_heartbeats: bool = True
 
+    # Run each sub-exchange through the fused Pallas TPU kernel
+    # (ops/pallas_pull.py): one pass over HBM instead of several, exact
+    # same results. Single-device, permutation/matching pairing,
+    # proportional budget, track_heartbeats=True only — other configs
+    # ignore the flag and use the XLA path.
+    use_pallas: bool = False
+
     def __post_init__(self) -> None:
         if self.n_nodes < 2:
             raise ValueError("need at least 2 nodes")

@@ -36,7 +36,14 @@ class _LogEntry:
 
 
 class SimCluster:
-    """A whole simulated cluster with per-node KV API parity."""
+    """A whole simulated cluster with per-node KV API parity.
+
+    Long-running clusters compact their write logs with :meth:`compact` —
+    the sim analogue of the object model's tombstone GC + watermark
+    (core/kvstate.py gc_marked_for_deletion): entries every live replica
+    has already absorbed fold into a per-node base view, so host memory
+    tracks the live keyspace instead of the full write history.
+    """
 
     def __init__(
         self,
@@ -56,6 +63,13 @@ class SimCluster:
             raise ValueError("names length != n_nodes")
         self._index = {name: i for i, name in enumerate(self.names)}
         self._logs: list[list[_LogEntry]] = [[] for _ in range(n)]
+        # Compaction state: log_base[j] versions of owner j live in
+        # base_views[j] (a folded prefix); self._logs[j][k] is version
+        # log_base[j] + k + 1.
+        self._log_base = np.zeros(n, np.int64)
+        self._base_views: list[dict[str, tuple[str, KeyStatus]]] = [
+            {} for _ in range(n)
+        ]
         self._pending_writes = np.zeros(n, np.int32)
 
         initial_key_values = initial_key_values or {}
@@ -155,10 +169,49 @@ class SimCluster:
         """What ``observer`` currently knows of ``owner``'s live keys."""
         i, j = self._index[observer], self._index[owner]
         watermark = int(np.asarray(self.sim.state.w[i, j]))
-        view = self._materialize(self._logs[j], watermark)
+        # Entries below the compaction base are pre-folded; the watermark
+        # can never sit below it (compact() floors over every replica).
+        view = dict(self._base_views[j])
+        prefix = max(0, watermark - int(self._log_base[j]))
+        for e in self._logs[j][:prefix]:
+            view[e.key] = (e.value, e.status)
         return {
             k: v for k, (v, status) in view.items() if status is KeyStatus.SET
         }
+
+    # -- log compaction (the GC analogue) -------------------------------------
+
+    def compact(self) -> int:
+        """Fold every write-log prefix that ALL replicas (alive or dead,
+        any of whom may revive and resume pulling) have already absorbed
+        into the per-node base view, dropping tombstoned/TTL keys outright
+        — absence and tombstone are indistinguishable below the floor.
+        Returns the number of log entries folded away.
+
+        This is the sim's version of the object model's two-part GC
+        (owner purge + replicated watermark, core/kvstate.py): the
+        cluster-wide min watermark IS the safe GC horizon, available here
+        as one device reduction instead of a grace-period protocol.
+        """
+        self._flush_writes()
+        w = np.asarray(self.sim.state.w)
+        floors = w.min(axis=0).astype(np.int64)  # includes the owner diag
+        folded = 0
+        for j in range(len(self._logs)):
+            k = int(floors[j] - self._log_base[j])
+            if k <= 0:
+                continue
+            k = min(k, len(self._logs[j]))
+            base = self._base_views[j]
+            for e in self._logs[j][:k]:
+                if e.status is KeyStatus.SET:
+                    base[e.key] = (e.value, e.status)
+                else:
+                    base.pop(e.key, None)
+            self._logs[j] = self._logs[j][k:]
+            self._log_base[j] += k
+            folded += k
+        return folded
 
     def live_view(self, observer: str) -> list[str]:
         """Node names ``observer`` currently believes are alive (requires

@@ -575,3 +575,43 @@ def test_checkpoint_topology_must_be_reprovided(tmp_path):
     b = Simulator.resume(ckpt, topology=topo)
     a.run(4), b.run(4)
     assert (np.asarray(a.state.w) == np.asarray(b.state.w)).all()
+
+
+def test_simcluster_compact_preserves_views():
+    sc = SimCluster(SimConfig(n_nodes=8, keys_per_node=3), seed=4)
+    sc.set("node-1", "color", "teal")
+    sc.set("node-1", "color", "navy")  # supersedes
+    sc.set("node-2", "gone", "x")
+    sc.delete("node-2", "gone")
+    sc.run_until_converged(500)
+    before = {
+        (o, w): sc.replica_view(f"node-{o}", f"node-{w}")
+        for o in range(8) for w in range(8)
+    }
+    folded = sc.compact()
+    assert folded > 0
+    after = {
+        (o, w): sc.replica_view(f"node-{o}", f"node-{w}")
+        for o in range(8) for w in range(8)
+    }
+    assert after == before  # compaction is invisible to observers
+    # logs actually shrank: converged cluster folds everything
+    assert all(len(log) == 0 for log in sc._logs)
+    assert sc.replica_view("node-0", "node-2").get("gone") is None
+    # and the cluster keeps working after compaction
+    sc.set("node-3", "later", "z")
+    sc.step(30)
+    assert sc.replica_view("node-7", "node-3")["later"] == "z"
+
+
+def test_simcluster_compact_respects_laggards():
+    cfg = SimConfig(n_nodes=6, keys_per_node=4, track_failure_detector=False)
+    sc = SimCluster(cfg, seed=8)
+    # Kill node 5 before any gossip: its watermarks stay 0 and pin the floor.
+    sc.sim.state = sc.sim.state.replace(
+        alive=sc.sim.state.alive.at[5].set(False)
+    )
+    sc.step(30)
+    assert sc.compact() == 0  # the dead laggard pins every log
+    views = sc.replica_view("node-0", "node-1")
+    assert len(views) == 4
