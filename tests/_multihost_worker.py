@@ -1,0 +1,42 @@
+"""Worker for tests/test_multihost.py: one process of a 2-process mesh."""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    coordinator, nprocs, rank, rounds = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from aiocluster_tpu.parallel import multihost
+
+    multihost.initialize(coordinator, nprocs, rank)
+    assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+    import numpy as np
+
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    cfg = SimConfig(n_nodes=32, keys_per_node=4, budget=16)
+    sim = Simulator(cfg, seed=0, mesh=multihost.global_mesh())
+    sim.run(rounds)
+    from jax.experimental import multihost_utils
+
+    w = np.asarray(
+        multihost_utils.process_allgather(sim.state.w, tiled=True),
+        dtype=np.int64,
+    )
+    print(json.dumps({
+        "tick": sim.tick,
+        "checksum": int((w * w).sum() % (2**31)),
+        "process": rank,
+    }))
+
+
+if __name__ == "__main__":
+    main()

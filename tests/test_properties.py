@@ -162,3 +162,69 @@ def test_gc_watermark_consistency_under_gossip():
     assert replica.get("keep").value == "v"  # live key survives the watermark
     assert replica.get("fresh").value == "w"
     assert replica.last_gc_version == ns.last_gc_version
+
+
+def test_restart_with_new_generation_replaces_old_incarnation():
+    """A restarted node (same name/addr, fresh generation_id) is a NEW
+    member: its keyspace replicates independently, and the old
+    incarnation ages out through the FD's two-stage GC (reference
+    entities.py:58, failure_detector.py:108-128)."""
+    from datetime import timedelta
+
+    b_id = NodeId("b", 1, ("h", 2))
+    old = NodeId("r", 100, ("h", 9))
+    new = NodeId("r", 200, ("h", 9))  # same name + address, new generation
+
+    cfg = Config(node_id=b_id, cluster_id="gen")
+    cs = ClusterState()
+    cs.node_state_or_default(b_id).inc_heartbeat()
+    fd = FailureDetector(FailureDetectorConfig())
+    b = GossipEngine(cfg, cs, fd)
+
+    def handshake_from(peer_engine):
+        syn = decode_packet(encode_packet(peer_engine.make_syn()))
+        synack = decode_packet(encode_packet(b.handle_syn(syn)))
+        ack = decode_packet(encode_packet(peer_engine.handle_synack(synack)))
+        b.handle_ack(ack)
+
+    def mk_peer(nid):
+        pcfg = Config(node_id=nid, cluster_id="gen")
+        pcs = ClusterState()
+        ns = pcs.node_state_or_default(nid)
+        ns.inc_heartbeat()
+        return GossipEngine(pcfg, pcs, FailureDetector(FailureDetectorConfig()))
+
+    old_engine = mk_peer(old)
+    old_engine._state.node_state_or_default(old).set("epoch", "first", ts=TS)
+    for _ in range(3):
+        old_engine._state.node_state_or_default(old).inc_heartbeat()
+        handshake_from(old_engine)
+    assert b._state.node_state_or_default(old).get("epoch").value == "first"
+
+    # Restart: the new incarnation gossips; both NodeIds coexist at first.
+    new_engine = mk_peer(new)
+    new_engine._state.node_state_or_default(new).set("epoch", "second", ts=TS)
+    for _ in range(3):
+        new_engine._state.node_state_or_default(new).inc_heartbeat()
+        handshake_from(new_engine)
+    assert b._state.node_state_or_default(new).get("epoch").value == "second"
+    assert b._state.node_state_or_default(old).get("epoch").value == "first"
+
+    # The old generation falls silent: dead after the phi threshold,
+    # excluded from digests at half the grace period, GC'd at the full
+    # 24h (time-travel through the injectable clocks; handshakes sampled
+    # on the real clock, so travel starts from utc_now).
+    from aiocluster_tpu.utils.clock import utc_now
+
+    now = utc_now() + timedelta(seconds=60)
+    fd.update_node_liveness(old, ts=now)
+    assert old in fd.dead_nodes()
+    later = now + timedelta(hours=13)
+    assert old in fd.scheduled_for_deletion_nodes(ts=later)
+    assert new not in fd.scheduled_for_deletion_nodes(ts=later)
+    gone = fd.garbage_collect(ts=now + timedelta(hours=25))
+    assert old in gone
+    for nid in gone:
+        b._state.remove_node(nid)
+    assert b._state.node_state(old) is None
+    assert b._state.node_state(new).get("epoch").value == "second"
