@@ -55,8 +55,11 @@ def test_two_process_mesh_matches_single_process():
         assert p.returncode == 0, err.decode()[-2000:]
         outs.append(out)
     results = [json.loads(o.splitlines()[-1]) for o in outs]
-    # Both processes computed the same (replicated) global result.
-    assert results[0] == results[1]
+    # Both processes computed the same (replicated) global result; the
+    # worker JSON also carries a per-rank "process" field, so compare only
+    # the replicated outputs.
+    assert results[0]["checksum"] == results[1]["checksum"]
+    assert results[0]["tick"] == results[1]["tick"]
 
     single = Simulator(SimConfig(**CFG), seed=0, mesh=make_mesh())
     single.run(ROUNDS)
