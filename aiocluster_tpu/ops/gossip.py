@@ -310,7 +310,13 @@ def sim_step(
             and cfg.budget_policy == "proportional"
             and track_hb
             and pallas_pull.supported(
-                n, state.w.dtype.itemsize, dual, track_hb
+                # Same itemsize the kernel's own block choice uses
+                # (fused_pull sizes VMEM from the widest matrix), so the
+                # gate can never admit a shape the kernel then rejects.
+                n,
+                max(state.w.dtype.itemsize, state.hb_known.dtype.itemsize),
+                dual,
+                track_hb,
             )
         )
         # Interpreter mode off-TPU so the same config runs (slowly) in

@@ -162,7 +162,11 @@ class Cluster:
         self._closing = True
         await self._ticker.stop()
         if self._codec_warmup is not None:
-            with suppress(Exception):
+            # Don't wait for a cold-cache native build (g++, up to 120s)
+            # whose result nobody needs anymore — cancel and move on; the
+            # orphaned compile thread finishes harmlessly.
+            self._codec_warmup.cancel()
+            with suppress(Exception, asyncio.CancelledError):
                 await self._codec_warmup
             self._codec_warmup = None
         if self._server is not None:

@@ -53,11 +53,16 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
         pair += 2  # icount int16
         pair += 1  # live_view bool
     state = pair * n * n
-    # One permuted gather of w (and hb when tracked) is live alongside the
-    # donated state during a pull.
-    transient = jnp.dtype(cfg.version_dtype).itemsize * n * n
+    # Permuted gathers of w (and hb when tracked) are live alongside the
+    # donated state during a pull. The default 'permutation' pairing
+    # computes BOTH handshake directions from pre-round state, so two
+    # gathered peer matrices (plus their advance temporaries, bounded by
+    # the same size) can be live at peak; 'matching' needs only one.
+    gathered = jnp.dtype(cfg.version_dtype).itemsize * n * n
     if cfg.track_heartbeats:
-        transient += jnp.dtype(cfg.heartbeat_dtype).itemsize * n * n
+        gathered += jnp.dtype(cfg.heartbeat_dtype).itemsize * n * n
+    directions = 2 if cfg.pairing == "permutation" else 1
+    transient = directions * gathered
     return MemoryPlan(n, state, transient, shards)
 
 

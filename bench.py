@@ -105,11 +105,13 @@ PROBE_ATTEMPTS = 3
 PROBE_BACKOFF_S = (15.0, 45.0)  # waits between attempts
 
 
-def _probe_accelerator(log) -> bool:
-    """True iff the default backend initializes in a bounded time AND is a
-    real accelerator (a subprocess that quietly fell back to CPU does not
-    count). Runs in a subprocess because a down TPU tunnel makes
-    in-process backend init retry forever (uninterruptibly)."""
+def _probe_accelerator(log) -> str:
+    """Classify the default backend in a bounded time: ``"ok"`` (a real
+    accelerator initialized), ``"cpu"`` (deterministically resolved to
+    CPU — retrying is pointless), or ``"down"`` (timeout/crash — a flaky
+    tunnel, worth retrying). Runs in a subprocess because a down TPU
+    tunnel makes in-process backend init retry forever (uninterruptibly).
+    """
     code = (
         "import jax, jax.numpy as jnp; "
         "x = jnp.zeros((8, 8)); "
@@ -125,20 +127,20 @@ def _probe_accelerator(log) -> bool:
         )
     except subprocess.TimeoutExpired:
         log(f"backend probe timed out after {PROBE_TIMEOUT_S:.0f}s")
-        return False
+        return "down"
     if proc.returncode != 0:
         log(f"backend probe failed rc={proc.returncode}: "
             f"{proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else '?'}")
-        return False
+        return "down"
     # The probe's own print() is the LAST stdout line; site hooks may
     # emit noise before it.
     out = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
     backend = out.split()[0] if out else ""
     if backend in ("", "cpu"):
         log(f"backend probe resolved to CPU, not an accelerator: {out!r}")
-        return False
+        return "cpu"
     log(f"backend probe ok: {out}")
-    return True
+    return "ok"
 
 
 def resolve_platform(requested: str, log) -> None:
@@ -153,8 +155,11 @@ def resolve_platform(requested: str, log) -> None:
         jax.config.update("jax_platforms", "cpu")
         return
     for attempt in range(PROBE_ATTEMPTS):
-        if _probe_accelerator(log):
+        verdict = _probe_accelerator(log)
+        if verdict == "ok":
             return  # leave default platform selection alone
+        if verdict == "cpu":
+            break  # deterministic answer; backoff would be pointless
         if attempt < PROBE_ATTEMPTS - 1:
             wait = PROBE_BACKOFF_S[min(attempt, len(PROBE_BACKOFF_S) - 1)]
             log(f"retrying backend probe in {wait:.0f}s "
@@ -166,6 +171,37 @@ def resolve_platform(requested: str, log) -> None:
         )
     log("accelerator unavailable; falling back to CPU (--platform auto)")
     jax.config.update("jax_platforms", "cpu")
+
+
+WATCHDOG_S = float(os.environ.get("BENCH_WATCHDOG_S", "2400"))
+
+
+def _start_watchdog(metric: str) -> None:
+    """Guarantee the one-JSON-line contract even if the backend wedges
+    mid-run (e.g. the tunnel drops AFTER a successful probe and the
+    in-process plugin then retries forever): a daemon timer prints a
+    diagnosable error line and hard-exits."""
+    import threading
+
+    def fire() -> None:
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": None,
+                    "unit": "rounds/s",
+                    "vs_baseline": None,
+                    "error": f"watchdog: bench exceeded {WATCHDOG_S:.0f}s "
+                    "(backend wedged mid-run?)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(WATCHDOG_S, fire)
+    t.daemon = True
+    t.start()
 
 
 def anchored_asyncio_seconds(log) -> float | None:
@@ -303,6 +339,7 @@ def main() -> None:
         rounds = 10_000
 
     metric = f"sim_gossip_rounds_per_sec@{n_nodes}_nodes"
+    _start_watchdog(metric)
     try:
         requested = args.platform or ("cpu" if args.smoke else "auto")
         resolve_platform(requested, log)
