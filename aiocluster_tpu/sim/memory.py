@@ -11,7 +11,9 @@ Reference parity note: the object model (reference state.py) needs O(keys)
 host memory per node pair view; the tensor sim collapses each pair to a
 few bytes. A 100k-node convergence sim in the lean profile is
 2 B/pair * 100k^2 = 20 GB — sharded over a v5e-8's owner axis, 2.5 GB per
-chip plus one gathered operand.
+chip plus the gathered operands (two per step under the default
+'permutation' pairing — both handshake directions are computed from
+pre-round state — one under 'matching').
 """
 
 from __future__ import annotations

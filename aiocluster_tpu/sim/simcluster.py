@@ -16,7 +16,6 @@ prefix fold, no per-key device state needed.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from enum import Enum
 
 import numpy as np
 from jax.sharding import Mesh
@@ -25,7 +24,6 @@ from ..core.values import KeyStatus
 from ..models.topology import Topology
 from .config import SimConfig
 from .simulator import Simulator
-from .state import init_state
 
 
 @dataclass(frozen=True, slots=True)

@@ -8,7 +8,6 @@ enabled.
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 from jax import random
 

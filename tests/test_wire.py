@@ -16,7 +16,6 @@ from aiocluster_tpu.core import (
     Digest,
     KeyValueUpdate,
     NodeDelta,
-    NodeDigest,
     NodeId,
     Packet,
     Syn,
