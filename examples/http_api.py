@@ -6,7 +6,10 @@ Endpoints:
   GET  /state            -> cluster snapshot as JSON
   GET  /kv/<key>         -> this node's value for <key>
   PUT  /kv/<key>?v=...   -> set <key> on this node (replicates via gossip)
+  PUT  /kv/<key>?v=...&ttl=1 -> set <key> with the TTL mark already applied
   DELETE /kv/<key>       -> tombstone <key>
+  POST /kv_mark/<key>    -> mark <key> delete-after-TTL (reference
+                            examples/api/app.py:100-113 /kv_mark parity)
 
 Run two nodes and watch state replicate:
   python examples/http_api.py --port 8001 --gossip 7001 --seed 7002
@@ -71,11 +74,23 @@ async def serve_http(cluster: Cluster, port: int) -> None:
                     if value is not None:
                         status, body = "200 OK", value
                 elif method == "PUT":
-                    value = parse_qs(url.query).get("v", [""])[0]
-                    cluster.set(key, value)
+                    query = parse_qs(url.query)
+                    value = query.get("v", [""])[0]
+                    if query.get("ttl", ["0"])[0] in ("1", "true"):
+                        cluster.set_with_ttl(key, value)
+                    else:
+                        cluster.set(key, value)
                     status, body = "200 OK", "ok"
                 elif method == "DELETE":
                     cluster.delete(key)
+                    status, body = "200 OK", "ok"
+            elif (
+                len(parts) == 2 and parts[0] == "kv_mark" and method == "POST"
+            ):
+                # Grace-period delete: replicas keep serving the key until
+                # its TTL elapses, then it tombstones cluster-wide.
+                if cluster.get(parts[1]) is not None:
+                    cluster.delete_after_ttl(parts[1])
                     status, body = "200 OK", "ok"
             payload = body.encode()
             writer.write(

@@ -111,6 +111,32 @@ def test_select_dead_node_probability():
     assert dead_pick in dead
 
 
+def test_seed_skip_when_round_reaches_seed():
+    """Deliberate difference vs reference server.py:709-716 (documented in
+    docs/migration.md #6): when a sampled live target is already a seed
+    and the cluster is past bootstrap, the extra seed roll is skipped."""
+    # Every live node is a seed, live >= seeds: any sample reaches a seed.
+    nodes = {addr(i) for i in range(4)}
+    for trial in range(32):
+        _, _, seed = select_gossip_targets(
+            nodes, nodes, set(), nodes, rng=Random(trial), gossip_count=3
+        )
+        assert seed is None
+
+
+def test_seed_roll_kept_during_bootstrap():
+    """The skip does NOT apply while live < seeds (bootstrap): the seed
+    contact speeds initial discovery even if a target is already a seed."""
+    seeds = {addr(1), addr(2), addr(3)}
+    live = {addr(1)}  # the one live node IS a seed
+    for trial in range(32):
+        _, _, seed = select_gossip_targets(
+            live | seeds, live, set(), seeds, rng=Random(trial), gossip_count=3
+        )
+        # p = seeds/(live+dead) = 3/1 > 1 → the roll, once taken, always picks.
+        assert seed in seeds
+
+
 def test_select_is_deterministic_with_seeded_rng():
     live = {addr(i) for i in range(20)}
     a = select_gossip_targets(live, live, set(), set(), rng=Random(7), gossip_count=3)

@@ -25,6 +25,9 @@ def _iter_py(paths: list[str]):
         if path.is_dir():
             yield from sorted(path.rglob("*.py"))
         elif path.suffix == ".py":
+            if not path.is_file():
+                print(f"{path}: no such file", file=sys.stderr)
+                raise SystemExit(2)
             yield path
 
 
@@ -82,6 +85,7 @@ def check_file(path: Path) -> list[str]:
 
     # Collect module-scope imports: binding -> first line.
     imports: dict[str, int] = {}
+    seen_targets: set[str] = set()
     duplicate: list[tuple[str, int]] = []
     for node in tree.body:
         if isinstance(node, ast.ImportFrom) and node.module == "__future__":
@@ -91,10 +95,16 @@ def check_file(path: Path) -> list[str]:
                 if alias.name == "*":
                     continue
                 bound = (alias.asname or alias.name).split(".")[0]
-                if bound in imports:
+                # Dedup on the full dotted target: `import a.b` and
+                # `import a.c` both bind `a` but are not duplicates.
+                target = alias.asname or alias.name
+                if isinstance(node, ast.ImportFrom):
+                    target = f"{node.module}:{target}"
+                if target in seen_targets:
                     duplicate.append((bound, node.lineno))
                 else:
-                    imports[bound] = node.lineno
+                    seen_targets.add(target)
+                    imports.setdefault(bound, node.lineno)
     for name, lineno in duplicate:
         problems.append(f"{path}:{lineno}: duplicate import of '{name}'")
 
