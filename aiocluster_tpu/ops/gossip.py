@@ -125,6 +125,7 @@ def _budgeted_advance(
     salt: jax.Array,
     owner_ids: jax.Array,
     run_salt: jax.Array | None = None,
+    col_ok: jax.Array | None = None,
 ) -> jax.Array:
     """How far each receiver row may advance toward the sender row under
     the per-exchange key-version budget (the MTU analogue).
@@ -136,9 +137,15 @@ def _budgeted_advance(
     advances are rounded with a dithered Bernoulli so the expected total
     matches the budget exactly and progress never stalls even when every
     scaled deficit is below one key-version.
+
+    ``col_ok`` (N, n_local bool), when given, masks owner columns the
+    SENDER has scheduled for deletion — the digest-exclusion analogue
+    (reference state.py:346-348 skips scheduled nodes in the delta).
     """
     dt = w_recv.dtype
     d = jnp.maximum(w_send - w_recv, 0) * valid[:, None].astype(dt)
+    if col_ok is not None:
+        d = jnp.where(col_ok, d, 0)
     if policy == "greedy":
         # Row totals/cumsums run in int32 even for int16 watermarks — a
         # row's total deficit can exceed the element dtype's range.
@@ -283,18 +290,34 @@ def sim_step(
     )
     hb_round_start = hb
 
+    # Scheduled-for-deletion mask from the PRE-round belief (the reference
+    # recomputes it from the FD's dead set at syn time each round): rows
+    # that have believed owner j dead for >= half the grace stop sending
+    # j's state and stop advertising j's heartbeat in their digests.
+    lifecycle = cfg.track_failure_detector and cfg.dead_grace_ticks is not None
+    if lifecycle:
+        ds32 = state.dead_since.astype(jnp.int32)
+        sched = (ds32 > 0) & ((tick - ds32) >= cfg.dead_grace_ticks // 2)
+    else:
+        sched = None
+
     def peer_adv(w, peer, salt):
         """The budgeted watermark advance of each row toward its peer row
-        (one handshake direction), masked to alive pairs."""
+        (one handshake direction), masked to alive pairs and to owner
+        columns the sender has not scheduled for deletion."""
         valid = alive & alive[peer]
         adv = _budgeted_advance(
             w, w[peer, :], cfg.budget, valid, axis_name,
             cfg.budget_policy, salt, owners, run_salt,
+            col_ok=None if sched is None else ~sched[peer, :],
         )
         return adv, valid
 
     def hb_absorb(hb, peer, valid):
-        return jnp.maximum(hb, jnp.where(valid[:, None], hb[peer, :], 0))
+        ok = valid[:, None]
+        if sched is not None:
+            ok = ok & ~sched[peer, :]
+        return jnp.maximum(hb, jnp.where(ok, hb[peer, :], 0))
 
     def sub_salt(c: int, direction: int) -> jax.Array:
         return (tick * (2 * cfg.fanout) + 2 * c + direction).astype(jnp.int32)
@@ -309,6 +332,7 @@ def sim_step(
             and axis_name is None
             and cfg.budget_policy == "proportional"
             and track_hb
+            and not lifecycle  # the fused kernel has no sched-column mask
             and pallas_pull.supported(
                 # Same itemsize the kernel's own block choice uses
                 # (fused_pull sizes VMEM from the widest matrix), so the
@@ -379,21 +403,26 @@ def sim_step(
             p = peers[:, c]
             valid = alive & alive[p]
             w_peer = w[p, :]
+            ok_from_peer = None if sched is None else ~sched[p, :]
             adv_in = _budgeted_advance(
                 w, w_peer, cfg.budget, valid, axis_name,
                 cfg.budget_policy, sub_salt(0, 0) + 2 * c, owners, run_salt,
+                col_ok=ok_from_peer,
             )
             adv_out = _budgeted_advance(
                 w_peer, w, cfg.budget, valid, axis_name,
                 cfg.budget_policy, sub_salt(0, 1) + 2 * c, owners, run_salt,
+                col_ok=None if sched is None else ~sched,
             )
             w_next = w + adv_in  # initiator applies the responder's delta
             w_next = w_next.at[p].max(w_peer + adv_out)  # responder applies ours
             if track_hb:
                 hb_peer = hb[p, :]
                 vcol = valid[:, None]
-                hb_next = jnp.maximum(hb, jnp.where(vcol, hb_peer, 0))
-                hb_next = hb_next.at[p].max(jnp.where(vcol, hb, 0))
+                in_ok = vcol if sched is None else vcol & ok_from_peer
+                out_ok = vcol if sched is None else vcol & ~sched
+                hb_next = jnp.maximum(hb, jnp.where(in_ok, hb_peer, 0))
+                hb_next = hb_next.at[p].max(jnp.where(out_ok, hb, 0))
             else:
                 hb_next = hb
             return w_next, hb_next
@@ -435,12 +464,45 @@ def sim_step(
         # re-earn liveness with fresh samples (core/failure.py reset rule).
         imean = jnp.where(live, imean, 0.0).astype(state.imean.dtype)
         icount = jnp.where(live, icount, jnp.int16(0))
+        if lifecycle:
+            # Dead-stamp on the live->dead transition, but only for KNOWN
+            # nodes (present in the observer's "cluster state", i.e. some
+            # watermark or heartbeat observed) — the reference only runs
+            # liveness over nodes it has state for — and only for ALIVE
+            # observer rows: a dead node's process isn't running its FD,
+            # so its bookkeeping freezes until revival (otherwise a dead
+            # row would watch every heartbeat stall, stamp the whole
+            # cluster and garbage-collect its own state). Re-earning
+            # liveness discards the stamp (FD dead-set pop).
+            row_alive = alive[:, None]
+            known = ((w > 0) | (hb > 0)) & row_alive
+            ds = jnp.where(
+                live,
+                0,
+                jnp.where(
+                    (state.dead_since == 0) & known,
+                    tick,
+                    state.dead_since.astype(jnp.int32),
+                ),
+            )
+            # Full grace elapsed: forget the node — remove_node analogue.
+            # Watermark, heartbeat knowledge and FD bookkeeping all reset;
+            # if some straggler row later re-sends the state, the node is
+            # re-created from scratch, exactly like the reference.
+            gc_now = (ds > 0) & ((tick - ds) >= cfg.dead_grace_ticks) & row_alive
+            w = jnp.where(gc_now, 0, w)
+            hb = jnp.where(gc_now, 0, hb)
+            last_change = jnp.where(gc_now, 0, last_change)
+            dead_since = jnp.where(gc_now, 0, ds).astype(state.dead_since.dtype)
+        else:
+            dead_since = state.dead_since
     else:
-        last_change, imean, icount, live = (
+        last_change, imean, icount, live, dead_since = (
             state.last_change,
             state.imean,
             state.icount,
             state.live_view,
+            state.dead_since,
         )
 
     return SimState(
@@ -454,6 +516,7 @@ def sim_step(
         imean=imean,
         icount=icount,
         live_view=live,
+        dead_since=dead_since,
     )
 
 

@@ -47,6 +47,7 @@ def state_partition_spec() -> SimState:
         imean=mat,
         icount=mat,
         live_view=mat,
+        dead_since=mat,
     )
 
 

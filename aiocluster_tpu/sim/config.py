@@ -52,6 +52,23 @@ class SimConfig:
     death_rate: float = 0.0
     revival_rate: float = 0.0
 
+    # Two-stage dead-node lifecycle in ticks (reference
+    # failure_detector.py:108-128 + server.py:328-329, our
+    # core/failure.py). When set (requires the failure detector), each
+    # OBSERVER row runs the reference's per-node FD lifecycle against its
+    # own belief: once it has believed a node dead for
+    # ``dead_grace_ticks // 2`` it stops propagating that node's state
+    # (the digest-exclusion analogue — its exchanges mask that owner's
+    # column), and at ``dead_grace_ticks`` it forgets the node entirely
+    # (watermark, heartbeat knowledge and FD window reset — the
+    # ClusterState.remove_node analogue). A node revived in time re-earns
+    # liveness with fresh heartbeat samples and is un-scheduled, exactly
+    # like the reference's dead-set discard. None disables the lifecycle
+    # (dead state is kept and re-propagated forever). The tick values
+    # stored in dead_since must fit heartbeat_dtype — same horizon
+    # contract as heartbeats.
+    dead_grace_ticks: int | None = None
+
     # Peer selection — only consulted when pairing="choice" (the default
     # pairing="permutation" matches over ALL nodes; dead matches no-op,
     # standing in for the reference's failed connections):
@@ -142,3 +159,10 @@ class SimConfig:
             raise ValueError(f"unknown budget_policy: {self.budget_policy}")
         if self.track_failure_detector and not self.track_heartbeats:
             raise ValueError("failure detector requires track_heartbeats")
+        if self.dead_grace_ticks is not None:
+            if not self.track_failure_detector:
+                raise ValueError(
+                    "dead_grace_ticks requires track_failure_detector"
+                )
+            if self.dead_grace_ticks < 2:
+                raise ValueError("dead_grace_ticks must be >= 2")

@@ -43,6 +43,10 @@ class SimState:
     imean: jax.Array  # (N, N) fd_dtype — mean of sampled intervals (ticks)
     icount: jax.Array  # (N, N) int16 — number of samples (window-capped)
     live_view: jax.Array  # (N, N) bool — i's belief that j is alive
+    # Tick at which observer i stamped owner j dead (0 = believed alive /
+    # never stamped / forgotten). Drives the two-stage lifecycle when
+    # SimConfig.dead_grace_ticks is set; zero-sized when the FD is off.
+    dead_since: jax.Array  # (N, N) heartbeat_dtype
 
 
 def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> SimState:
@@ -74,4 +78,5 @@ def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> Sim
         live_view=jnp.eye(*fd_shape, dtype=bool)
         if cfg.track_failure_detector
         else jnp.zeros(fd_shape, bool),
+        dead_since=jnp.zeros(fd_shape, hdt),
     )

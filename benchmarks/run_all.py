@@ -156,9 +156,15 @@ def config3(smoke: bool) -> dict:
     rounds = 64 if smoke else 200
     # 5% churn/round (BASELINE config 3); revival keeps an ~80% alive
     # equilibrium so the FD sees both deaths and rejoins continuously.
+    # Churn runs FD-faithful end to end (VERDICT r1 item 5): peers drawn
+    # from each node's own live_view and the full two-stage dead-node
+    # lifecycle on — a node dead past half the grace stops being
+    # propagated, past the full grace it is forgotten. Grace = 40 rounds
+    # (~the reference's 24 h at its 1 s round scaled into the sim horizon).
     cfg = SimConfig(
         n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
         death_rate=0.05, revival_rate=0.2, writes_per_round=1,
+        peer_mode="view", pairing="choice", dead_grace_ticks=40,
     )
     sim = Simulator(cfg, seed=0, chunk=16)
     rps = _timed_rounds_per_sec(sim, rounds)

@@ -1,0 +1,125 @@
+"""Two-stage dead-node lifecycle in the tensor sim (SimConfig.dead_grace_ticks).
+
+Mirrors the reference's per-observer FD lifecycle (failure_detector.py:108-128
+driven from server.py:328-329, our core/failure.py): a node believed dead for
+half the grace period stops being propagated (digest exclusion), and at the
+full grace period is forgotten entirely (remove_node). Asserted in tick-time
+against the batched kernel, per VERDICT round-1 item 5.
+"""
+
+import numpy as np
+from jax import random
+
+from aiocluster_tpu.ops.gossip import convergence_metrics, sim_step
+from aiocluster_tpu.sim import SimConfig, init_state
+
+KEY = random.key(3)
+
+GRACE = 40  # ticks; scheduled-for-deletion at 20
+
+CFG = SimConfig(
+    n_nodes=12,
+    keys_per_node=4,
+    fanout=2,
+    budget=64,
+    dead_grace_ticks=GRACE,
+)
+
+
+def run_ticks(state, n, cfg=CFG):
+    for _ in range(n):
+        state = sim_step(state, KEY, cfg)
+    return state
+
+
+def kill(state, idx):
+    return state.replace(alive=state.alive.at[idx].set(False))
+
+
+def warmed_up_with_dead_node():
+    """30 warm-up ticks (tight FD windows, full replication), then node 0
+    dies; run until every other observer has dead-stamped it. Returns
+    (state, ds_max) where ds_max is the latest dead-stamp tick."""
+    state = run_ticks(init_state(CFG), 30)
+    assert bool(np.asarray(state.live_view).all())
+    assert np.asarray(state.w).min() == CFG.keys_per_node  # fully replicated
+
+    state = kill(state, 0)
+    for _ in range(40):
+        state = sim_step(state, KEY, CFG)
+        ds = np.asarray(state.dead_since)[:, 0]
+        if (ds[1:] > 0).all():
+            break
+    ds = np.asarray(state.dead_since)[:, 0]
+    assert (ds[1:] > 0).all(), "every observer must dead-stamp node 0"
+    assert ds[0] == 0  # self-belief never goes dead
+    assert not np.asarray(state.live_view)[1:, 0].any()
+    return state, int(ds[1:].max())
+
+
+def test_state_repropagates_before_half_grace():
+    """Control: before any observer schedules the dead node, an amnesiac
+    replica is fully re-fed by its peers (dead state still propagates)."""
+    state, ds_max = warmed_up_with_dead_node()
+    # Detection takes >10 ticks (phi must clear 8 tight means), so no row
+    # is within half grace of its stamp yet.
+    assert int(state.tick) < ds_max + GRACE // 2
+    state = state.replace(
+        w=state.w.at[5, 0].set(0), hb_known=state.hb_known.at[5, 0].set(0)
+    )
+    state = run_ticks(state, 6)
+    assert np.asarray(state.w)[5, 0] == CFG.keys_per_node
+
+
+def test_scheduled_nodes_stop_propagating_and_then_gc():
+    state, ds_max = warmed_up_with_dead_node()
+
+    # Advance until every observer is past half grace => scheduled.
+    state = run_ticks(state, ds_max + GRACE // 2 + 1 - int(state.tick))
+    # An amnesiac replica now stays empty: no peer sends node 0's state.
+    state = state.replace(
+        w=state.w.at[5, 0].set(0), hb_known=state.hb_known.at[5, 0].set(0)
+    )
+    probe = run_ticks(state, 6)
+    assert np.asarray(probe.w)[5, 0] == 0, "scheduled node re-propagated"
+
+    # Full grace: everyone forgets node 0 (remove_node analogue).
+    probe = run_ticks(probe, ds_max + GRACE + 1 - int(probe.tick))
+    w = np.asarray(probe.w)
+    assert (w[1:, 0] == 0).all()
+    assert (np.asarray(probe.hb_known)[1:, 0] == 0).all()
+    assert (np.asarray(probe.dead_since)[:, 0] == 0).all()  # forgotten
+    # Node 0's own state and the rest of the cluster are untouched.
+    assert w[0, 0] == CFG.keys_per_node
+    assert (w[:, 1:] == CFG.keys_per_node).all()
+    m = convergence_metrics(probe)
+    assert bool(m["all_converged"])  # dead owners are excused
+
+
+def test_revival_before_half_grace_recovers():
+    state, _ = warmed_up_with_dead_node()
+    state = state.replace(alive=state.alive.at[0].set(True))
+    state = run_ticks(state, 10)
+    lv = np.asarray(state.live_view)
+    assert lv[:, 0].all(), "revived node must re-earn liveness"
+    assert (np.asarray(state.dead_since)[:, 0] == 0).all()
+
+
+def test_lifecycle_disabled_keeps_dead_state_forever():
+    cfg = SimConfig(n_nodes=12, keys_per_node=4, fanout=2, budget=64)
+    state = run_ticks(init_state(cfg), 30, cfg)
+    state = kill(state, 0)
+    state = run_ticks(state, 80, cfg)
+    w = np.asarray(state.w)
+    assert (w[:, 0] == cfg.keys_per_node).all()  # never forgotten
+    assert (np.asarray(state.dead_since) == 0).all()
+
+
+def test_config_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="track_failure_detector"):
+        SimConfig(n_nodes=4, track_failure_detector=False,
+                  track_heartbeats=False, dead_grace_ticks=10)
+    with pytest.raises(ValueError, match=">= 2"):
+        SimConfig(n_nodes=4, dead_grace_ticks=1)

@@ -41,6 +41,32 @@ def test_sharded_step_bit_identical_to_single_device():
     assert int(sharded.tick) == int(single.tick) == 12
 
 
+def test_sharded_lifecycle_bit_identical_to_single_device():
+    """The dead-node lifecycle (stamp / schedule / GC) is pure elementwise
+    + shard-local row-gather math, so a churning sharded run must stay
+    bit-identical through detection, digest exclusion and removal."""
+    cfg = SimConfig(n_nodes=64, keys_per_node=8, budget=32,
+                    death_rate=0.02, revival_rate=0.05, dead_grace_ticks=16)
+    mesh = make_mesh()
+    step = sharded_step_fn(cfg, mesh)
+
+    sharded = shard_state(init_state(cfg), mesh)
+    single = init_state(cfg)
+    for _ in range(40):
+        sharded = step(sharded, KEY)
+        single = sim_step(single, KEY, cfg)
+
+    assert np.array_equal(np.asarray(sharded.w), np.asarray(single.w))
+    assert np.array_equal(
+        np.asarray(sharded.dead_since), np.asarray(single.dead_since)
+    )
+    assert np.array_equal(
+        np.asarray(sharded.live_view), np.asarray(single.live_view)
+    )
+    # The churn actually exercised the lifecycle in this window.
+    assert np.asarray(single.dead_since).any()
+
+
 def test_sharded_metrics_match():
     cfg = SimConfig(n_nodes=64, keys_per_node=16, track_failure_detector=False)
     mesh = make_mesh()
