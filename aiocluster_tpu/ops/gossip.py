@@ -111,7 +111,11 @@ def _hash_uniform(
     )
     h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
     h = h ^ (h >> 13)
-    u = h.astype(jnp.float32) * (1.0 / 4294967296.0)
+    # Top 24 bits via an int32 cast: float32 holds 24 bits exactly, and
+    # Mosaic (the Pallas TPU compiler) has no uint32->float32 lowering, so
+    # the same arithmetic must be expressible in int32 for the fused
+    # kernel to stay bit-identical to this path.
+    u = (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
     return jnp.clip(u, 1e-12, 1.0 - 2.0**-24)
 
 

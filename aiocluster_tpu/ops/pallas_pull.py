@@ -42,7 +42,10 @@ def _dither(rows: jax.Array, owners: jax.Array, salt, run_salt) -> jax.Array:
     )
     h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
     h = h ^ (h >> 13)
-    u = h.astype(jnp.float32) * (1.0 / 4294967296.0)
+    # Top 24 bits through int32: Mosaic has no uint32->float32 cast, and
+    # float32 represents 24-bit integers exactly (same math as
+    # gossip._hash_uniform — the paths must stay bit-identical).
+    u = (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
     return jnp.clip(u, 1e-12, 1.0 - 2.0**-24)
 
 

@@ -78,21 +78,23 @@ class SimConfig:
     peer_mode: str = "alive"
 
     # Pairing of one sub-exchange:
-    # - "permutation" (default): each node initiates one handshake (with
-    #   p[i]) and responds to exactly one (from inv[i]). Gather-only on
-    #   TPU; both exchanges are computed from the pre-round state and
-    #   joined with an elementwise max — the same semantics as the
-    #   reference's 3-way handshake, where both sides' deltas derive from
-    #   the pre-handshake digests.
-    # - "matching": a random perfect matching (p is an involution), so one
-    #   bidirectional handshake per node per sub-exchange — HALF the
-    #   full-matrix traffic of "permutation" per sub-exchange. The most
-    #   faithful model of the reference's paired Syn/SynAck/Ack exchange,
-    #   and the fastest per-round path.
+    # - "matching" (default): a random perfect matching (p is an
+    #   involution), so one bidirectional handshake per node per
+    #   sub-exchange — HALF the full-matrix traffic of "permutation" per
+    #   sub-exchange. The most faithful model of the reference's paired
+    #   Syn/SynAck/Ack exchange, and the fastest per-round path; measured
+    #   on a v5e chip at 10k nodes it converges in the same number of
+    #   rounds as "permutation" at 1.3x the round rate.
+    # - "permutation": each node initiates one handshake (with p[i]) and
+    #   responds to exactly one (from inv[i]). Gather-only on TPU; both
+    #   exchanges are computed from the pre-round state and joined with an
+    #   elementwise max — the same semantics as the reference's 3-way
+    #   handshake, where both sides' deltas derive from the pre-handshake
+    #   digests.
     # - "choice": every node independently samples a peer (reference
     #   server.py:699 semantics: inbound load varies); needs a scatter-max
     #   for the responder side. Topology (adjacency) runs force this mode.
-    pairing: str = "permutation"
+    pairing: str = "matching"
 
     # Dtypes for the big (N, N) knowledge matrices. "int32" is always
     # safe; "int16" halves HBM traffic and footprint and is exact whenever

@@ -11,9 +11,9 @@ Reference parity note: the object model (reference state.py) needs O(keys)
 host memory per node pair view; the tensor sim collapses each pair to a
 few bytes. A 100k-node convergence sim in the lean profile is
 2 B/pair * 100k^2 = 20 GB — sharded over a v5e-8's owner axis, 2.5 GB per
-chip plus the gathered operands (two per step under the default
-'permutation' pairing — both handshake directions are computed from
-pre-round state — one under 'matching').
+chip plus the gathered operands (two per step under 'permutation'
+pairing — both handshake directions are computed from pre-round state —
+one under the default 'matching').
 """
 
 from __future__ import annotations
@@ -56,7 +56,7 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
         pair += 1  # live_view bool
     state = pair * n * n
     # Permuted gathers of w (and hb when tracked) are live alongside the
-    # donated state during a pull. The default 'permutation' pairing
+    # donated state during a pull. The 'permutation' pairing
     # computes BOTH handshake directions from pre-round state, so two
     # gathered peer matrices (plus their advance temporaries, bounded by
     # the same size) can be live at peak; 'matching' needs only one.
