@@ -7,11 +7,14 @@ a single XLA computation.
 
 Correspondence (object model → tensor op), with the default config:
 
-- peer selection (runtime/peers.py)        → a random matching per
-  sub-exchange (pairing="permutation"; the responder role is a pull
-  through the inverse permutation, so the round is gather-only), or
-  categorical/adjacency draws + responder scatter-max (pairing="choice",
-  the reference's independent-sampling semantics)
+- peer selection (runtime/peers.py)        → a random perfect matching
+  per sub-exchange (pairing="matching", default: one bidirectional
+  handshake per pair, a single involution pull — drawn from the
+  8-row-group family on the fused kernel's domain), a random
+  permutation (pairing="permutation": initiate to p[i], respond via the
+  inverse permutation, still gather-only), or categorical/adjacency
+  draws + responder scatter-max (pairing="choice", the reference's
+  independent-sampling semantics)
 - digest heartbeat observation             → row gather + max on hb_known
 - MTU-bounded delta (core packer)          → budgeted watermark advance:
   deficits d[i,j] = max(0, w[peer,j] - w[i,j]); either proportional
@@ -65,6 +68,44 @@ def _random_matching(key: jax.Array, n: int) -> jax.Array:
     return p.at[a].set(b).at[b].set(a)
 
 
+def _grouped_matching(
+    key: jax.Array, n: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """A random involution from the 8-row-GROUP matching family:
+    ``p[8g + r] = 8*gm[g] + (r - c[g]) % 8`` — groups of 8 rows matched
+    uniformly (``gm`` an involution over n/8 groups), rows within a
+    matched pair assigned by a per-pair rotation ``c``.
+
+    This is the TPU-shaped matching: Mosaic can only DMA row slices
+    aligned to the 8-sublane tile, so drawing the matching from this
+    family makes every peer fetch in the fused Pallas kernel an aligned
+    (8, n) copy, with the rotation applied in VMEM. Used for ALL matching
+    sub-exchanges on the fused kernel's domain (n % 128 == 0) so the XLA
+    and Pallas paths share one
+    trajectory. Mixing quality: each node's peer is a uniformly random
+    group times a uniform rotation — marginally uniform over non-self
+    groups, fresh independent draw every sub-exchange; measured
+    rounds-to-convergence matches the unrestricted family (see
+    tests/test_sim.py::test_grouped_matching_convergence_parity).
+
+    Involution: partners g < h get rotations c and (8-c) % 8; self-matched
+    groups (odd group count) rotate by 0 or 4, the self-inverse rotations.
+    Returns (gm, c, p) with p the row-level involution.
+    """
+    n_groups = n // 8
+    kg, kc = random.split(key)
+    gm = _random_matching(kg, n_groups)
+    u = random.randint(kc, (n_groups,), 0, 8)
+    gid = jnp.arange(n_groups)
+    c = jnp.where(
+        gid < gm, u, jnp.where(gid > gm, (8 - u[gm]) % 8, 4 * (u % 2))
+    ).astype(jnp.int32)
+    g = jnp.arange(n, dtype=jnp.int32) // 8
+    r = jnp.arange(n, dtype=jnp.int32) % 8
+    p = 8 * gm[g].astype(jnp.int32) + (r - c[g]) % 8
+    return gm, c, p
+
+
 def _global_cumsum_excl(d: jax.Array, axis_name: str | None) -> jax.Array:
     """Exclusive cumsum of per-owner deficits in GLOBAL owner order, given
     the local (N, n_local) block. Cross-shard part is one (N,)-per-shard
@@ -86,6 +127,7 @@ def _hash_uniform(
     n_rows: int,
     owner_ids: jax.Array,
     run_salt: jax.Array | None = None,
+    bits: int = 24,
 ) -> jax.Array:
     """Deterministic (row, global-owner, salt) -> [0, 1) dither pattern.
 
@@ -94,10 +136,19 @@ def _hash_uniform(
     therefore produces bit-identical advances to a single-device run
     (jax.random streams are shape-dependent and would diverge per shard).
     ``run_salt`` mixes the run's PRNG seed in so different seeds get
-    different dither/draw patterns. The output is clipped away from both
-    endpoints: u == 1.0 exactly (a ~2^-25 uint32->float32 rounding event)
-    would otherwise make the Gumbel transform +inf and let a fallback
-    peer outrank the live tier.
+    different dither/draw patterns.
+
+    ``bits=24`` (the dither default) maps the top 24 hash bits through an
+    int32 cast — float32 holds 24-bit integers exactly, and Mosaic (the
+    Pallas TPU compiler) has no uint32->float32 lowering, so this is the
+    form the fused kernel reproduces bit-identically; its maximum is
+    exactly 1 - 2^-24, making the upper clip a no-op kept only as a
+    safety net. ``bits=32`` keeps the full-entropy mapping for consumers
+    that never run in the kernel and care about tie probability (the
+    Gumbel-max peer draw); there the upper clip is load-bearing — u ==
+    1.0 (a ~2^-25 uint32->float32 rounding event) would make the Gumbel
+    transform +inf and let a fallback peer outrank the live tier. The
+    lower clip guards log(0) in both modes.
     """
     i = jnp.arange(n_rows, dtype=jnp.uint32)[:, None]
     j = owner_ids.astype(jnp.uint32)[None, :]
@@ -111,11 +162,10 @@ def _hash_uniform(
     )
     h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
     h = h ^ (h >> 13)
-    # Top 24 bits via an int32 cast: float32 holds 24 bits exactly, and
-    # Mosaic (the Pallas TPU compiler) has no uint32->float32 lowering, so
-    # the same arithmetic must be expressible in int32 for the fused
-    # kernel to stay bit-identical to this path.
-    u = (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
+    if bits == 32:
+        u = h.astype(jnp.float32) * (1.0 / 4294967296.0)
+    else:
+        u = (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
     return jnp.clip(u, 1e-12, 1.0 - 2.0**-24)
 
 
@@ -181,7 +231,10 @@ def _view_peer_choice(
     row, then the best across shards (one small all_gather on ICI).
     """
     n = live_view.shape[0]
-    u = _hash_uniform(salt, n, owners, run_salt)
+    # Full 32-bit entropy: this draw never runs in the Pallas kernel, and
+    # the argmax tie probability (~n/2^bits per row) must stay negligible
+    # — 24 bits would re-introduce a low-owner-index tie bias at large n.
+    u = _hash_uniform(salt, n, owners, run_salt, bits=32)
     gumbel = -jnp.log(-jnp.log(u))
     # Two-tier draw: a live non-self peer always beats a fallback pick
     # (the +LIVE_BONUS tier), but when a row believes no one else is live
@@ -247,6 +300,41 @@ def select_peers(
         return jnp.stack(cols, axis=1)
     logits = jnp.where(alive, 0.0, NEG_INF)
     return random.categorical(key, logits, shape=(n, cfg.fanout))
+
+
+def pallas_path_engaged(cfg: SimConfig, axis_name: str | None = None) -> bool:
+    """Single source of truth for whether sim_step routes matching
+    sub-exchanges through the fused Pallas kernel for this config —
+    consumed by sim_step AND by bench.py's speedup/roofline labelling, so
+    the two can never drift (the ADVICE.md r1 itemsize-gate bug class).
+
+    "auto" resolves by backend: the compiled kernel on a real TPU, plain
+    XLA elsewhere (interpret mode is for tests only — forcing
+    use_pallas=True off-TPU runs it interpreted). The remaining terms
+    mirror the kernel's hard requirements: grouped-matching domain
+    (n % 128 == 0), single device, proportional budget, heartbeats
+    tracked, no dead-node lifecycle (the kernel has no
+    scheduled-for-deletion column mask), and a legal VMEM block for the
+    widest matrix dtype (fused_pull_m8 sizes VMEM from the same)."""
+    from . import pallas_pull
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    wanted = cfg.use_pallas is True or (cfg.use_pallas == "auto" and on_tpu)
+    itemsize = max(
+        jnp.dtype(cfg.version_dtype).itemsize,
+        jnp.dtype(cfg.heartbeat_dtype).itemsize,
+    )
+    lifecycle = cfg.track_failure_detector and cfg.dead_grace_ticks is not None
+    return (
+        wanted
+        and cfg.pairing == "matching"
+        and cfg.n_nodes % 128 == 0
+        and axis_name is None
+        and cfg.budget_policy == "proportional"
+        and cfg.track_heartbeats
+        and not lifecycle
+        and pallas_pull.supported(cfg.n_nodes, itemsize)
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg", "axis_name"), donate_argnums=(0,))
@@ -331,27 +419,19 @@ def sim_step(
         from . import pallas_pull
 
         dual = cfg.pairing == "permutation"
-        use_pallas = (
-            cfg.use_pallas
-            and axis_name is None
-            and cfg.budget_policy == "proportional"
-            and track_hb
-            and not lifecycle  # the fused kernel has no sched-column mask
-            and pallas_pull.supported(
-                # Same itemsize the kernel's own block choice uses
-                # (fused_pull sizes VMEM from the widest matrix), so the
-                # gate can never admit a shape the kernel then rejects.
-                n,
-                max(state.w.dtype.itemsize, state.hb_known.dtype.itemsize),
-                dual,
-                track_hb,
-            )
-        )
+        # The grouped family is used exactly on the kernel's domain so
+        # flipping use_pallas never changes a trajectory; off it (or at
+        # tiny n, where few groups would throttle mixing — one
+        # self-matched group's only involution rotations are 0 and 4,
+        # which disconnect the pairs) matching stays unrestricted.
+        grouped = cfg.pairing == "matching" and n % 128 == 0
+        use_pallas = pallas_path_engaged(cfg, axis_name)
         # Interpreter mode off-TPU so the same config runs (slowly) in
         # CPU tests; the axon platform is a TPU PJRT plugin.
         interpret = jax.default_backend() not in ("tpu", "axon")
         for c in range(cfg.fanout):
             ck = random.fold_in(peer_key, c)
+            gm8 = c8 = None
             if dual:
                 # Initiator i talks to p[i]; the responder role is the
                 # pull through the inverse permutation. Both exchanges
@@ -366,15 +446,18 @@ def sim_step(
                 # bidirectional handshake per node — i's pull from p[i]
                 # IS the pair's full exchange, because row p[i] pulls
                 # from i in the same vectorized op. Half the traffic of
-                # "permutation" per sub-exchange.
-                p = _random_matching(ck, n)
+                # "permutation" per sub-exchange. Drawn from the
+                # 8-row-group family when shapes allow so the XLA and
+                # Pallas paths share one trajectory.
+                if grouped:
+                    gm8, c8, p = _grouped_matching(ck, n)
+                else:
+                    p = _random_matching(ck, n)
                 inv = p
             if use_pallas:
-                w, hb = pallas_pull.fused_pull(
-                    w, hb, p, inv,
-                    alive & alive[p], alive & alive[inv],
-                    sub_salt(c, 0), sub_salt(c, 1), run_salt,
-                    cfg.budget, track_hb=True, dual=dual,
+                w, hb = pallas_pull.fused_pull_m8(
+                    w, hb, gm8, c8, alive & alive[p],
+                    sub_salt(c, 0), run_salt, cfg.budget,
                     interpret=interpret,
                 )
             elif dual:

@@ -1,19 +1,35 @@
-"""Fused Pallas TPU kernel for one gossip sub-exchange.
+"""Fused Pallas TPU kernel for one gossip sub-exchange (grouped matching).
 
-The XLA path of ops/gossip.py executes a sub-exchange as several separate
-passes over the (N, N) matrices: peer-row gathers for w and hb, a
+The XLA path of ops/gossip.py executes a matching sub-exchange as several
+separate passes over the (N, N) matrices: peer-row gathers for w and hb
+(each a full-matrix read AND write of the materialized gather), a
 deficit-total reduction, the dithered advance, and the heartbeat absorb.
-This kernel performs the whole sub-exchange — both handshake directions —
-in ONE pass over HBM per matrix: each row block is read once, its peer
-rows are fetched by per-row DMA (sharing the same index for w and hb),
-and the budget math runs entirely in VMEM.
+This kernel performs the whole sub-exchange in ONE pass over HBM per
+matrix: each row block is read once, peer rows arrive by direct
+HBM->VMEM DMA (never materialized in HBM), and the budget math runs
+entirely in VMEM.
+
+Why GROUPED matching: Mosaic (the Pallas TPU compiler) can only DMA row
+slices aligned to the 8-sublane tile — a single random row of an int16
+matrix is not a legal copy. So the matching is drawn from the
+8-row-group family (gossip._grouped_matching): groups of 8 rows are
+matched uniformly, and rows within a matched group pair are assigned by
+a per-pair rotation. Every peer fetch is then an aligned (8, n) slice,
+and the rotation is applied in VMEM with the TPU's dynamic sublane
+rotate. 8-row alignment suffices for BOTH int32 and int16: narrow
+dtypes pack pairs within the 8-sublane tile ((8,128)(2,1) tiling), so
+any multiple-of-8 row offset is a whole-tile boundary — verified on
+hardware with odd multiples of 8 into int16 memrefs, exact results. The XLA path uses the same family on the kernel's whole domain
+(n % 128 == 0), so the
+kernel's output is exactly equal to the XLA path's (asserted in
+tests/test_pallas_pull.py) and flipping use_pallas never changes a
+trajectory.
 
 Bit-compatibility: the advance formula and the (row, owner, salt) dither
 hash are the same arithmetic as gossip._budgeted_advance /
-gossip._hash_uniform, so the kernel's output is exactly equal to the XLA
-path's (asserted in tests/test_pallas_pull.py). Single-device,
-proportional-budget, permutation/matching pairing only — the sharded and
-greedy paths stay on XLA.
+gossip._hash_uniform. Single-device, proportional-budget, matching
+pairing, heartbeats tracked, no dead-node lifecycle — other configs stay
+on XLA (the sim_step gate enforces this).
 
 Reference anchor: this is the hot loop of server.py:378-495 (the 3-way
 handshake fan-out) collapsed into one tensor pass.
@@ -60,17 +76,16 @@ def _advance(w_self32, w_peer32, valid_col, budget, rows, owners, salt, run_salt
     return jnp.minimum(floor.astype(jnp.int32) + bump, d)
 
 
-def _pull_kernel(
+def _m8_kernel(
     # scalar prefetch
-    p_ref,
-    inv_ref,
-    meta_ref,  # [salt_p, salt_i, run_salt, budget]
+    gm_ref,  # (n/8,) partner group per group (involution)
+    c_ref,  # (n/8,) within-pair row rotation
+    meta_ref,  # [salt, run_salt, budget]
     # block inputs
     w_ref,
     hb_ref,
-    validp_ref,
-    validi_ref,
-    # HBM inputs for gathers
+    valid_ref,  # (block, 1) int8 alive-pair mask per row
+    # HBM gather sources
     w_hbm,
     hb_hbm,
     # outputs
@@ -78,118 +93,79 @@ def _pull_kernel(
     hbout_ref,
     # scratch
     wp,
-    wi,
     hbp,
-    hbi,
     sems,
     *,
     block: int,
     n: int,
-    track_hb: bool,
-    dual: bool,
 ):
-    b0 = pl.program_id(0) * block
+    gpb = block // 8  # groups per block
+    g0 = pl.program_id(0) * gpb
 
-    def gather(r, _):
-        pr = p_ref[b0 + r]
+    def gather(g, _):
+        src = gm_ref[g0 + g] * 8
         pltpu.make_async_copy(
-            w_hbm.at[pl.ds(pr, 1), :], wp.at[pl.ds(r, 1), :], sems.at[0, r]
+            w_hbm.at[pl.ds(src, 8), :], wp.at[pl.ds(g * 8, 8), :], sems.at[0, g]
         ).start()
-        if track_hb:
-            pltpu.make_async_copy(
-                hb_hbm.at[pl.ds(pr, 1), :], hbp.at[pl.ds(r, 1), :], sems.at[1, r]
-            ).start()
-        if dual:
-            ir = inv_ref[b0 + r]
-            pltpu.make_async_copy(
-                w_hbm.at[pl.ds(ir, 1), :], wi.at[pl.ds(r, 1), :], sems.at[2, r]
-            ).start()
-            if track_hb:
-                pltpu.make_async_copy(
-                    hb_hbm.at[pl.ds(ir, 1), :],
-                    hbi.at[pl.ds(r, 1), :],
-                    sems.at[3, r],
-                ).start()
-        return 0
-
-    def wait(r, _):
-        pr = p_ref[b0 + r]
         pltpu.make_async_copy(
-            w_hbm.at[pl.ds(pr, 1), :], wp.at[pl.ds(r, 1), :], sems.at[0, r]
-        ).wait()
-        if track_hb:
-            pltpu.make_async_copy(
-                hb_hbm.at[pl.ds(pr, 1), :], hbp.at[pl.ds(r, 1), :], sems.at[1, r]
-            ).wait()
-        if dual:
-            ir = inv_ref[b0 + r]
-            pltpu.make_async_copy(
-                w_hbm.at[pl.ds(ir, 1), :], wi.at[pl.ds(r, 1), :], sems.at[2, r]
-            ).wait()
-            if track_hb:
-                pltpu.make_async_copy(
-                    hb_hbm.at[pl.ds(ir, 1), :],
-                    hbi.at[pl.ds(r, 1), :],
-                    sems.at[3, r],
-                ).wait()
+            hb_hbm.at[pl.ds(src, 8), :], hbp.at[pl.ds(g * 8, 8), :], sems.at[1, g]
+        ).start()
         return 0
 
-    lax.fori_loop(0, block, gather, 0)
-    lax.fori_loop(0, block, wait, 0)
+    def wait(g, _):
+        src = gm_ref[g0 + g] * 8
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(src, 8), :], wp.at[pl.ds(g * 8, 8), :], sems.at[0, g]
+        ).wait()
+        pltpu.make_async_copy(
+            hb_hbm.at[pl.ds(src, 8), :], hbp.at[pl.ds(g * 8, 8), :], sems.at[1, g]
+        ).wait()
+        return 0
 
-    salt_p = meta_ref[0]
-    salt_i = meta_ref[1]
-    run_salt = meta_ref[2]
-    budget = meta_ref[3].astype(jnp.float32)
+    lax.fori_loop(0, gpb, gather, 0)
 
-    rows = b0 + lax.broadcasted_iota(jnp.int32, (block, n), 0)
-    owners = lax.broadcasted_iota(jnp.int32, (block, n), 1)
+    salt = meta_ref[0]
+    run_salt = meta_ref[1]
+    budget = meta_ref[2].astype(jnp.float32)
+    owners = lax.broadcasted_iota(jnp.int32, (8, n), 1)
+    row_iota = lax.broadcasted_iota(jnp.int32, (8, n), 0)
 
-    w_self = w_ref[:].astype(jnp.int32)
-    vp = validp_ref[:].astype(jnp.int32)  # (block, 1)
-    adv = _advance(
-        w_self, wp[:].astype(jnp.int32), vp, budget, rows, owners,
-        salt_p, run_salt,
-    )
-    if dual:
-        vi = validi_ref[:].astype(jnp.int32)
-        adv_i = _advance(
-            w_self, wi[:].astype(jnp.int32), vi, budget, rows, owners,
-            salt_i, run_salt,
+    # Per 8-row group: wait for its DMA just-in-time (later groups'
+    # copies keep streaming behind this group's compute), rotate the
+    # fetched partner group into row-pair order (w_peer[r] =
+    # fetched[(r - c) % 8], i.e. roll by +c), then the row-independent
+    # advance/absorb math on the (8, n) tile.
+    for g in range(gpb):
+        wait(g, 0)
+        sl = slice(g * 8, (g + 1) * 8)
+        cg = c_ref[g0 + g]
+        rows = (pl.program_id(0) * block + g * 8) + row_iota
+        vcol = valid_ref[sl, :].astype(jnp.int32)  # (8, 1)
+        w_self = w_ref[sl, :].astype(jnp.int32)
+        w_peer = pltpu.roll(wp[sl, :].astype(jnp.int32), cg, 0)
+        adv = _advance(
+            w_self, w_peer, vcol, budget, rows, owners, salt, run_salt
         )
-        adv = jnp.maximum(adv, adv_i)
-    wout_ref[:] = (w_self + adv).astype(wout_ref.dtype)
-
-    if track_hb:
-        hb_self = hb_ref[:].astype(jnp.int32)
-        hb_new = jnp.maximum(hb_self, hbp[:].astype(jnp.int32) * vp)
-        if dual:
-            hb_new = jnp.maximum(hb_new, hbi[:].astype(jnp.int32) * vi)
-        hbout_ref[:] = hb_new.astype(hbout_ref.dtype)
-    else:
-        hbout_ref[:] = hb_ref[:]
+        wout_ref[sl, :] = (w_self + adv).astype(wout_ref.dtype)
+        hb_self = hb_ref[sl, :].astype(jnp.int32)
+        hb_peer = pltpu.roll(hbp[sl, :].astype(jnp.int32), cg, 0)
+        hbout_ref[sl, :] = jnp.maximum(hb_self, hb_peer * vcol).astype(
+            hbout_ref.dtype
+        )
 
 
 VMEM_BUDGET = 12 * 1024 * 1024  # ~16 MB/core, minus headroom for Mosaic
 
-
-def _buffer_count(dual: bool, track_hb: bool) -> int:
-    """(block, n)-sized VMEM buffers the kernel needs: pipelined in/out
-    blocks are double-buffered (x2), gather scratch is single."""
-    per_matrix = 2 + 2 + 1 + (1 if dual else 0)  # in x2, out x2, peer scratch
-    return per_matrix * (2 if track_hb else 1)
+# (block, n)-sized VMEM buffers: w and hb each have pipelined in + out
+# blocks (double-buffered, x2 each) plus one gather scratch -> 5 per
+# matrix, 10 total.
+_BUFFERS = 10
 
 
-def _pick_block(
-    n: int,
-    itemsize: int = 4,
-    dual: bool = True,
-    track_hb: bool = True,
-    cap: int = 512,
-) -> int | None:
+def _pick_block(n: int, itemsize: int = 4, cap: int = 512) -> int | None:
     """Largest multiple-of-8 divisor of n such that every VMEM-resident
     buffer set fits the per-core budget."""
-    per_row = _buffer_count(dual, track_hb) * n * itemsize
+    per_row = _BUFFERS * n * itemsize
     limit = min(cap, VMEM_BUDGET // max(per_row, 1))
     best = None
     for b in range(8, limit + 1, 8):
@@ -198,46 +174,41 @@ def _pick_block(
     return best
 
 
-def supported(n: int, itemsize: int, dual: bool, track_hb: bool) -> bool:
+def supported(n: int, itemsize: int) -> bool:
     """Whether the fused kernel can run this shape (callers fall back to
-    the XLA path when not)."""
-    return _pick_block(n, itemsize, dual, track_hb) is not None
+    the XLA path when not). Requires the grouped-matching family
+    (n % 8 == 0 rows), lane-aligned manual DMA (n % 128 == 0 columns —
+    Mosaic rejects copies of partial 128-lane tiles, and a non-multiple
+    column count is a partial tile of the padded memref), and a legal
+    VMEM block."""
+    return n % 128 == 0 and _pick_block(n, itemsize) is not None
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("budget", "track_hb", "dual", "interpret"),
-)
-def fused_pull(
+@functools.partial(jax.jit, static_argnames=("budget", "interpret"))
+def fused_pull_m8(
     w: jax.Array,
     hb: jax.Array,
-    p: jax.Array,
-    inv: jax.Array,
-    valid_p: jax.Array,
-    valid_i: jax.Array,
-    salt_p: jax.Array,
-    salt_i: jax.Array,
+    gm: jax.Array,
+    c: jax.Array,
+    valid: jax.Array,
+    salt: jax.Array,
     run_salt: jax.Array,
     budget: int,
-    track_hb: bool = True,
-    dual: bool = True,
     interpret: bool = False,
 ):
-    """One fused sub-exchange. Returns (w', hb').
+    """One fused grouped-matching sub-exchange. Returns (w', hb').
 
-    ``dual=True`` is permutation pairing (initiator via p + responder via
-    inv, joined by max); ``dual=False`` is matching pairing (p is an
-    involution). ``valid_*`` are per-row alive-pair masks.
+    ``gm``/``c`` come from gossip._grouped_matching; ``valid`` is the
+    per-row alive-pair mask (alive & alive[p]).
     """
     n = w.shape[0]
     itemsize = max(w.dtype.itemsize, hb.dtype.itemsize)
-    block = _pick_block(n, itemsize, dual, track_hb)
-    if block is None:
+    block = _pick_block(n, itemsize)
+    if block is None or n % 128 != 0:
         raise ValueError(f"no suitable row block for n={n}")
     meta = jnp.stack(
         [
-            salt_p.astype(jnp.int32),
-            salt_i.astype(jnp.int32),
+            salt.astype(jnp.int32),
             run_salt.astype(jnp.int32),
             jnp.asarray(budget, jnp.int32),
         ]
@@ -248,8 +219,7 @@ def fused_pull(
         in_specs=[
             pl.BlockSpec((block, n), lambda i, *_: (i, 0)),  # w block
             pl.BlockSpec((block, n), lambda i, *_: (i, 0)),  # hb block
-            pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),  # valid_p col
-            pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),  # valid_i col
+            pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),  # valid col
             pl.BlockSpec(memory_space=pl.ANY),  # w HBM (gather source)
             pl.BlockSpec(memory_space=pl.ANY),  # hb HBM
         ],
@@ -259,19 +229,11 @@ def fused_pull(
         ],
         scratch_shapes=[
             pltpu.VMEM((block, n), w.dtype),
-            # Unused directions/matrices get minimal-tile dummies so the
-            # kernel signature stays fixed without wasting VMEM.
-            pltpu.VMEM((block, n) if dual else (16, 128), w.dtype),
-            pltpu.VMEM((block, n) if track_hb else (16, 128), hb.dtype),
-            pltpu.VMEM(
-                (block, n) if (dual and track_hb) else (16, 128), hb.dtype
-            ),
-            pltpu.SemaphoreType.DMA((4, block)),
+            pltpu.VMEM((block, n), hb.dtype),
+            pltpu.SemaphoreType.DMA((2, block // 8)),
         ],
     )
-    kernel = functools.partial(
-        _pull_kernel, block=block, n=n, track_hb=track_hb, dual=dual
-    )
+    kernel = functools.partial(_m8_kernel, block=block, n=n)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -281,13 +243,12 @@ def fused_pull(
         ],
         interpret=interpret,
     )(
-        p.astype(jnp.int32),
-        inv.astype(jnp.int32),
+        gm.astype(jnp.int32),
+        c.astype(jnp.int32),
         meta,
         w,
         hb,
-        valid_p.astype(jnp.int8)[:, None],
-        valid_i.astype(jnp.int8)[:, None],
+        valid.astype(jnp.int8)[:, None],
         w,
         hb,
     )

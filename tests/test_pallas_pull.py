@@ -1,8 +1,7 @@
-"""Fused Pallas sub-exchange kernel: exact parity with the XLA path.
+"""Fused Pallas grouped-matching kernel: exact parity with the XLA path.
 
 Runs in interpreter mode on CPU (tests/conftest.py forces the CPU
-platform); the compiled path is exercised on real TPU by bench.py when
-enabled.
+platform); the compiled path is exercised on real TPU by bench.py.
 """
 
 import numpy as np
@@ -13,88 +12,89 @@ from jax import random
 
 from aiocluster_tpu.ops.gossip import (
     _budgeted_advance,
+    _grouped_matching,
     _local_owner_ids,
-    _random_matching,
 )
-from aiocluster_tpu.ops.pallas_pull import _pick_block, fused_pull
+from aiocluster_tpu.ops.pallas_pull import _pick_block, fused_pull_m8, supported
 
 
-def _xla_reference(w, hb, p, inv, valid_p, valid_i, salt_p, salt_i,
-                   run_salt, budget, dual):
+def test_grouped_matching_is_group_aligned_involution():
+    for seed in range(5):
+        n = 64
+        gm, c, p = _grouped_matching(random.key(seed), n)
+        p = np.asarray(p)
+        assert sorted(p) == list(range(n))  # a permutation
+        assert (p[p] == np.arange(n)).all()  # an involution
+        # Group-structured: all rows of a group map into one partner group.
+        assert (p // 8 == np.asarray(gm)[np.arange(n) // 8]).all()
+        gm = np.asarray(gm)
+        assert (gm[gm] == np.arange(n // 8)).all()  # group involution
+
+
+def test_grouped_matching_odd_group_count():
+    # 9 groups: one self-matched group whose rotation must self-invert.
+    gm, c, p = _grouped_matching(random.key(2), 72)
+    p = np.asarray(p)
+    assert (p[p] == np.arange(72)).all()
+    gm = np.asarray(gm)
+    self_groups = np.flatnonzero(gm == np.arange(9))
+    assert len(self_groups) == 1
+    assert int(np.asarray(c)[self_groups[0]]) in (0, 4)
+
+
+def _xla_reference(w, hb, p, valid, salt, run_salt, budget):
     owners = _local_owner_ids(w.shape[1], None)
-    adv_p = _budgeted_advance(
-        w, w[p, :], budget, valid_p, None, "proportional", salt_p, owners,
+    adv = _budgeted_advance(
+        w, w[p, :], budget, valid, None, "proportional", salt, owners,
         run_salt,
     )
-    adv = adv_p
-    if dual:
-        adv_i = _budgeted_advance(
-            w, w[inv, :], budget, valid_i, None, "proportional", salt_i,
-            owners, run_salt,
-        )
-        adv = jnp.maximum(adv_p, adv_i)
     w_new = w + adv
-    hb_new = jnp.maximum(hb, jnp.where(valid_p[:, None], hb[p, :], 0))
-    if dual:
-        hb_new = jnp.maximum(
-            hb_new, jnp.where(valid_i[:, None], hb[inv, :], 0)
-        )
+    hb_new = jnp.maximum(hb, jnp.where(valid[:, None], hb[p, :], 0))
     return w_new, hb_new
 
 
 @pytest.mark.parametrize("dtype", [jnp.int32, jnp.int16])
-@pytest.mark.parametrize("dual", [True, False])
-def test_fused_pull_matches_xla(dtype, dual):
-    n = 64
+def test_fused_pull_m8_matches_xla(dtype):
+    n = 128
     key = random.key(3)
     kw, kp, ka = random.split(key, 3)
     w = random.randint(kw, (n, n), 0, 50).astype(dtype)
     hb = random.randint(kw, (n, n), 0, 30).astype(dtype)
-    if dual:
-        p = random.permutation(kp, n)
-        inv = jnp.argsort(p)
-    else:
-        p = _random_matching(kp, n)
-        inv = p
+    gm, c, p = _grouped_matching(kp, n)
     alive = random.bernoulli(ka, 0.85, (n,))
-    valid_p = alive & alive[p]
-    valid_i = alive & alive[inv]
-    salt_p = jnp.asarray(7, jnp.int32)
-    salt_i = jnp.asarray(8, jnp.int32)
+    valid = alive & alive[p]
+    salt = jnp.asarray(7, jnp.int32)
     run_salt = jnp.asarray(0x12345678, jnp.uint32)
-    budget = 40
 
-    w_ref, hb_ref = _xla_reference(
-        w, hb, p, inv, valid_p, valid_i, salt_p, salt_i, run_salt, budget,
-        dual,
+    w_k, hb_k = fused_pull_m8(
+        w, hb, gm, c, valid, salt, run_salt, budget=40, interpret=True
     )
-    w_k, hb_k = fused_pull(
-        w, hb, p, inv, valid_p, valid_i, salt_p, salt_i, run_salt,
-        budget, track_hb=True, dual=dual, interpret=True,
-    )
+    w_x, hb_x = _xla_reference(w, hb, p, valid, salt, run_salt, budget=40)
     assert w_k.dtype == dtype
-    np.testing.assert_array_equal(np.asarray(w_k), np.asarray(w_ref))
-    np.testing.assert_array_equal(np.asarray(hb_k), np.asarray(hb_ref))
+    np.testing.assert_array_equal(np.asarray(w_k), np.asarray(w_x))
+    np.testing.assert_array_equal(np.asarray(hb_k), np.asarray(hb_x))
 
 
 def test_pick_block_respects_vmem():
-    from aiocluster_tpu.ops.pallas_pull import VMEM_BUDGET, _buffer_count
+    from aiocluster_tpu.ops.pallas_pull import _BUFFERS, VMEM_BUDGET
 
     # Small n: capped by the 512-row ceiling, not VMEM.
-    assert _pick_block(64, 2, True, True) == 64
+    assert _pick_block(64, 2) == 64
     # Large n: every chosen block must fit the VMEM budget.
     for n, isz in [(10_000, 2), (10_000, 4), (32_768, 2)]:
-        b = _pick_block(n, isz, True, True)
+        b = _pick_block(n, isz)
         assert b is not None and n % b == 0 and b % 8 == 0
-        assert _buffer_count(True, True) * b * n * isz <= VMEM_BUDGET
-    # Matching pairing needs fewer buffers -> same or bigger blocks.
-    assert _pick_block(10_000, 2, False, True) >= _pick_block(10_000, 2, True, True)
-    assert _pick_block(7, 2, True, True) is None
+        assert _BUFFERS * b * n * isz <= VMEM_BUDGET
+    assert _pick_block(7, 2) is None
+    # Manual DMA needs lane-aligned columns: n % 128 == 0.
+    assert not supported(100, 2)
+    assert not supported(96, 2)
+    assert supported(128, 2)
 
 
 def test_unsupported_n_falls_back_to_xla():
-    """n without a multiple-of-8 divisor must silently use the XLA path
-    (the config documents the flag as ignored), not raise."""
+    """n off the kernel domain (n % 128 != 0) must silently use the
+    XLA path (the config documents the flag as ignored), not raise."""
     from aiocluster_tpu.ops.gossip import sim_step
     from aiocluster_tpu.sim import SimConfig, init_state
 
@@ -103,12 +103,14 @@ def test_unsupported_n_falls_back_to_xla():
     assert int(s.tick) == 1
 
 
-@pytest.mark.parametrize("pairing", ["permutation", "matching"])
-def test_sim_step_pallas_path_matches_xla(pairing):
+def test_sim_step_pallas_path_matches_xla():
+    """Flipping use_pallas must not change the trajectory: both paths run
+    the grouped-matching family on the kernel domain (n % 128 == 0),
+    churn included."""
     from aiocluster_tpu.ops.gossip import sim_step
     from aiocluster_tpu.sim import SimConfig, init_state
 
-    base = dict(n_nodes=48, keys_per_node=6, budget=24, pairing=pairing,
+    base = dict(n_nodes=128, keys_per_node=6, budget=24,
                 death_rate=0.05, revival_rate=0.2)
     cfg_x = SimConfig(**base)
     cfg_p = SimConfig(**base, use_pallas=True)

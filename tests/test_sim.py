@@ -615,3 +615,18 @@ def test_simcluster_compact_respects_laggards():
     assert sc.compact() == 0  # the dead laggard pins every log
     views = sc.replica_view("node-0", "node-1")
     assert len(views) == 4
+
+
+def test_grouped_matching_convergence_parity():
+    """The TPU-shaped grouped-matching family (used when n % 128 == 0)
+    must mix like the unrestricted matching family: comparable rounds to
+    convergence at comparable scale (grouped engages at n=128; n=136 is
+    off the kernel domain and uses plain matching)."""
+    def rounds(n):
+        cfg = SimConfig(n_nodes=n, keys_per_node=8, budget=1024,
+                        track_failure_detector=False)
+        return Simulator(cfg, seed=4, chunk=4).run_until_converged(500)
+
+    grouped, plain = rounds(128), rounds(136)
+    assert grouped is not None and plain is not None
+    assert grouped <= 2 * plain  # no mixing collapse from the family
