@@ -302,7 +302,12 @@ def select_peers(
     return random.categorical(key, logits, shape=(n, cfg.fanout))
 
 
-def pallas_path_engaged(cfg: SimConfig, axis_name: str | None = None) -> bool:
+def pallas_path_engaged(
+    cfg: SimConfig,
+    axis_name: str | None = None,
+    *,
+    has_topology: bool = False,
+) -> bool:
     """Single source of truth for whether sim_step routes matching
     sub-exchanges through the fused Pallas kernel for this config —
     consumed by sim_step AND by bench.py's speedup/roofline labelling, so
@@ -315,7 +320,10 @@ def pallas_path_engaged(cfg: SimConfig, axis_name: str | None = None) -> bool:
     (n % 128 == 0), single device, proportional budget, heartbeats
     tracked, no dead-node lifecycle (the kernel has no
     scheduled-for-deletion column mask), and a legal VMEM block for the
-    widest matrix dtype (fused_pull_m8 sizes VMEM from the same)."""
+    widest matrix dtype (fused_pull_m8 sizes VMEM from the same).
+    ``has_topology``: adjacency-constrained runs force the choice path,
+    so callers labelling a Simulator(..., topology=...) run must pass
+    True (sim_step itself never consults the gate on that path)."""
     from . import pallas_pull
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
@@ -327,6 +335,7 @@ def pallas_path_engaged(cfg: SimConfig, axis_name: str | None = None) -> bool:
     lifecycle = cfg.track_failure_detector and cfg.dead_grace_ticks is not None
     return (
         wanted
+        and not has_topology  # adjacency runs force the choice path
         and cfg.pairing == "matching"
         and cfg.n_nodes % 128 == 0
         and axis_name is None

@@ -7,10 +7,12 @@ device-resident rounds (optionally sharded over a mesh), and
 ``SimCluster`` offers the Cluster-shaped API with host-side values.
 """
 
+from .bytes import budget_from_mtu
 from .config import SimConfig
 from .state import SimState, init_state
 
-__all__ = ("SimCluster", "SimConfig", "SimState", "Simulator", "init_state")
+__all__ = ("SimCluster", "SimConfig", "SimState", "Simulator",
+           "budget_from_mtu", "init_state")
 
 
 def __getattr__(name: str):

@@ -1,0 +1,72 @@
+"""Bytes-budget mode: tie the sim's key-version budget to the real MTU.
+
+The tensor sim bounds each exchange by ``SimConfig.budget`` key-versions —
+an abstraction of the object model's byte-exact MTU packer (reference
+state.py:392-398, our core/cluster_state.py). This module closes the loop:
+``budget_from_mtu`` converts a wire MTU (e.g. the reference's 65,507-byte
+``max_payload_size``, entities.py:105) into the equivalent key-version
+budget using the SAME exact proto3 size accounting the asyncio backend
+packs with (wire/sizes.DeltaSizeModel), so sim rounds-to-convergence is
+directly comparable to a socket-backend run at a given MTU
+(tests/test_sim.py::test_sim_matches_object_model_at_matched_mtu).
+
+The conversion needs a representative workload shape — key/value byte
+lengths and how many stale owners a delta typically spans — because the
+real packer's overhead is per-node-delta while the kv cost is per
+key-version. Defaults mirror the bench workload.
+"""
+
+from __future__ import annotations
+
+from ..core.identity import NodeId
+from ..core.messages import KeyValueUpdate, VersionStatusEnum
+from ..wire.sizes import DeltaSizeModel
+
+__all__ = ("budget_from_mtu",)
+
+
+def budget_from_mtu(
+    mtu_bytes: int,
+    *,
+    key_bytes: int = 8,
+    value_bytes: int = 8,
+    stale_owners: int = 1,
+    node_name_bytes: int = 8,
+    version_scale: int = 1000,
+) -> int:
+    """Key-versions that fit one ``mtu_bytes`` delta for this workload.
+
+    ``stale_owners`` is how many distinct owners' updates share the delta
+    (each adds one NodeDelta envelope); ``version_scale`` sets the varint
+    width of representative version numbers. Raises if not even one
+    key-version fits (the packer would make no progress at that MTU — the
+    object model's pathological-MTU case, reference state.py:412-413).
+    """
+    if mtu_bytes <= 0:
+        raise ValueError("mtu_bytes must be positive")
+    node = NodeId("n" * node_name_bytes, version_scale, ("h" * 9, 65_000))
+    kv = KeyValueUpdate(
+        key="k" * key_bytes,
+        value="v" * value_bytes,
+        version=version_scale,
+        status=VersionStatusEnum.SET,
+    )
+    model = DeltaSizeModel()
+    base = model.node_delta_base(
+        node,
+        from_version_excluded=version_scale,
+        last_gc_version=0,
+        max_version=version_scale,
+    )
+    kv_inc = model.kv_increment(kv)
+    # Total delta = committed node-deltas; reserve each owner's envelope
+    # (base + length framing) via the same accounting the packer uses.
+    envelope = model.delta_total_with(base) - model.total()
+    overhead = stale_owners * envelope
+    budget = (mtu_bytes - overhead) // kv_inc
+    if budget < 1:
+        raise ValueError(
+            f"mtu_bytes={mtu_bytes} cannot carry one key-version "
+            f"(overhead {overhead}B + {kv_inc}B per key-version)"
+        )
+    return int(budget)
