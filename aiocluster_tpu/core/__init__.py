@@ -4,7 +4,8 @@ that lets the asyncio socket backend and the JAX sim backend share one
 source of truth (SURVEY.md §7)."""
 
 from .cluster_state import ClusterState, Staleness, staleness_score
-from .config import Config, FailureDetectorConfig
+from .config import (DEFAULT_MAX_PAYLOAD_SIZE, Config,
+                     FailureDetectorConfig)
 from .failure import BoundedWindow, FailureDetector, HeartbeatWindow
 from .identity import Address, NodeId
 from .kvstate import NodeState

@@ -13,6 +13,11 @@ from datetime import timedelta
 
 from .identity import Address, NodeId
 
+# The reference's default delta MTU (entities.py:105): the UDP-sized cap
+# on one encoded DeltaPb. Shared by Config, the benchmarks, and the sim's
+# bytes-budget conversion so there is exactly one copy of the number.
+DEFAULT_MAX_PAYLOAD_SIZE = 65_507
+
 
 @dataclass(frozen=True, slots=True, eq=True)
 class FailureDetectorConfig:
@@ -39,7 +44,7 @@ class Config:
     failure_detector: FailureDetectorConfig = field(
         default_factory=FailureDetectorConfig,
     )
-    max_payload_size: int = 65_507  # delta MTU in encoded bytes
+    max_payload_size: int = DEFAULT_MAX_PAYLOAD_SIZE  # delta MTU, encoded bytes
     connect_timeout: float = 3.0
     read_timeout: float = 3.0
     write_timeout: float = 3.0

@@ -98,17 +98,23 @@ def python_rounds_per_sec(n_target: int) -> float:
     return 1.0 / round_time
 
 
-# Key-versions per exchange, derived from the reference's 65,507-byte
-# max_payload_size (entities.py:105) by the exact wire-size accounting
-# (sim.bytes.budget_from_mtu — 2,618 for the bench's 8-byte keys/values),
-# so the sim's per-exchange bound IS the reference MTU, not an estimate.
-MTU_BYTES = 65_507
+# Key-versions per exchange, derived from the reference's default
+# max_payload_size (entities.py:105, core.DEFAULT_MAX_PAYLOAD_SIZE) by
+# the exact wire-size accounting (sim.bytes.budget_from_mtu — 2,618 for
+# the bench's 8-byte keys/values), so the sim's per-exchange bound IS the
+# reference MTU, not an estimate.
+
+
+def _mtu_bytes() -> int:
+    from aiocluster_tpu.core import DEFAULT_MAX_PAYLOAD_SIZE
+
+    return DEFAULT_MAX_PAYLOAD_SIZE
 
 
 def _budget() -> int:
     from aiocluster_tpu.sim import budget_from_mtu
 
-    return budget_from_mtu(MTU_BYTES)
+    return budget_from_mtu(_mtu_bytes())
 
 PROBE_TIMEOUT_S = 120.0  # first TPU init+compile can take 20-40s; be generous
 PROBE_ATTEMPTS = 3
@@ -477,7 +483,7 @@ def main() -> None:
                 "keys_per_node": 16,
                 "fanout": 3,
                 "budget": _budget(),
-                "budget_source": f"exact wire-size budget of the reference {MTU_BYTES}B MTU",
+                "budget_source": f"exact wire-size budget of the reference {_mtu_bytes()}B MTU",
                 "failure_detector": True,
                 "version_dtype": "int16",
                 "heartbeat_dtype": "int16",

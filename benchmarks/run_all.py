@@ -53,6 +53,22 @@ def _timed_rounds_per_sec(sim, rounds: int) -> float:
     return rounds / (time.perf_counter() - start)
 
 
+# Per-exchange key-version budget = the reference's default
+# max_payload_size converted by the exact wire-size accounting
+# (sim.budget_from_mtu), so every sim config is bounded by the real MTU.
+# Lazy + memoized: config 1 is asyncio-only and must not import jax, and
+# a failed import must surface as a per-config error record, not a crash
+# before main().
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def MTU_BUDGET() -> int:
+    from aiocluster_tpu.core import DEFAULT_MAX_PAYLOAD_SIZE
+    from aiocluster_tpu.sim import budget_from_mtu
+
+    return budget_from_mtu(DEFAULT_MAX_PAYLOAD_SIZE)
+
 # -- config 1: asyncio 3-node loopback cluster --------------------------------
 
 
@@ -129,7 +145,7 @@ def config2(smoke: bool) -> dict:
     from aiocluster_tpu.sim import SimConfig, Simulator
 
     n = 64
-    cfg = SimConfig(n_nodes=n, keys_per_node=16, fanout=3, budget=2048)
+    cfg = SimConfig(n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET())
     sim = Simulator(cfg, seed=0, topology=ring(n, 1), chunk=8)
     start = time.perf_counter()
     rounds = sim.run_until_converged(max_rounds=4 * n)
@@ -162,7 +178,7 @@ def config3(smoke: bool) -> dict:
     # propagated, past the full grace it is forgotten. Grace = 40 rounds
     # (~the reference's 24 h at its 1 s round scaled into the sim horizon).
     cfg = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
+        n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET(),
         death_rate=0.05, revival_rate=0.2, writes_per_round=1,
         peer_mode="view", pairing="choice", dead_grace_ticks=40,
     )
@@ -176,7 +192,7 @@ def config3(smoke: bool) -> dict:
     # quality number, freeze churn, kill a 5% cohort for good, let
     # detection settle, and measure both error directions.
     frozen = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
+        n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET(),
         writes_per_round=1,
     )
     sim2 = Simulator(frozen, seed=1, chunk=16)
@@ -215,7 +231,7 @@ def config4(smoke: bool) -> dict:
     n = 512 if smoke else 10_000
     rounds = 32 if smoke else 64
     cfg = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
+        n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET(),
         pairing="choice",  # adjacency-constrained
     )
     log(f"config4: building scale-free graph n={n}")
@@ -270,7 +286,7 @@ def config5(smoke: bool) -> dict:
     rounds = 16 if smoke else 32
     log(f"config5: {n} nodes over {n_dev} device(s) (target {target})")
     cfg = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=2048,
+        n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET(),
         track_failure_detector=False, track_heartbeats=False,
     )
     mesh = make_mesh(devices)

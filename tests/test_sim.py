@@ -711,3 +711,30 @@ def test_sim_matches_object_model_at_matched_mtu():
     assert sim_rounds is not None
     assert obj_rounds > 3  # genuinely MTU-bound on both sides
     assert abs(sim_rounds - obj_rounds) <= 1
+
+
+def test_checkpoint_roundtrips_lifecycle_state(tmp_path):
+    """dead_since (the lifecycle's bookkeeping) survives save/resume and
+    the resumed run continues the identical trajectory through churn."""
+    from aiocluster_tpu.sim.checkpoint import load_state
+
+    cfg = SimConfig(n_nodes=32, keys_per_node=4, budget=16,
+                    death_rate=0.05, revival_rate=0.1, dead_grace_ticks=12)
+    sim = Simulator(cfg, seed=3, chunk=4)
+    sim.run(32)
+    ds = np.asarray(sim.state.dead_since)
+    assert (ds > 0).any()  # churn has produced stamps
+
+    path = tmp_path / "life.npz"
+    sim.save(path)
+    state2, cfg2, _ = load_state(path)
+    assert cfg2 == cfg
+    assert np.array_equal(np.asarray(state2.dead_since), ds)
+
+    twin = Simulator.resume(path)
+    sim.run(12)
+    twin.run(12)
+    assert np.array_equal(np.asarray(sim.state.w), np.asarray(twin.state.w))
+    assert np.array_equal(
+        np.asarray(sim.state.dead_since), np.asarray(twin.state.dead_since)
+    )
