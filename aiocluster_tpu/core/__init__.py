@@ -32,6 +32,7 @@ __all__ = (
     "FailureDetector",
     "HeartbeatWindow",
     "Config",
+    "DEFAULT_MAX_PAYLOAD_SIZE",
     "Delta",
     "Digest",
     "FailureDetectorConfig",

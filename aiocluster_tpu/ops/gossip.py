@@ -47,6 +47,15 @@ from ..sim.state import SimState
 
 NEG_INF = -1e30
 
+# Backend names that mean "a real TPU chip" (axon is the tunnel's PJRT
+# plugin name). Single-sourced: the pallas gate, interpret-mode choice,
+# and bench.py's CPU-fallback logic all consult this.
+ACCEL_BACKENDS = ("tpu", "axon")
+
+
+def on_accelerator() -> bool:
+    return jax.default_backend() in ACCEL_BACKENDS
+
 
 def _local_owner_ids(n_local: int, axis_name: str | None) -> jax.Array:
     """Global owner indices of this shard's columns."""
@@ -326,7 +335,7 @@ def pallas_path_engaged(
     True (sim_step itself never consults the gate on that path)."""
     from . import pallas_pull
 
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    on_tpu = on_accelerator()
     wanted = cfg.use_pallas is True or (cfg.use_pallas == "auto" and on_tpu)
     itemsize = max(
         jnp.dtype(cfg.version_dtype).itemsize,
@@ -437,7 +446,7 @@ def sim_step(
         use_pallas = pallas_path_engaged(cfg, axis_name)
         # Interpreter mode off-TPU so the same config runs (slowly) in
         # CPU tests; the axon platform is a TPU PJRT plugin.
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = not on_accelerator()
         for c in range(cfg.fanout):
             ck = random.fold_in(peer_key, c)
             gm8 = c8 = None

@@ -273,7 +273,12 @@ def estimate_bytes_per_round(cfg) -> int:
     return int(total)
 
 
-def sim_rounds_per_sec(n_nodes: int, rounds: int, log) -> tuple[float, int | None, dict]:
+def sim_rounds_per_sec(
+    n_nodes: int,
+    rounds: int,
+    log,
+    max_converge_rounds: int | None = None,
+) -> tuple[float, int | None, dict]:
     import jax
     import numpy as np
 
@@ -380,9 +385,11 @@ def sim_rounds_per_sec(n_nodes: int, rounds: int, log) -> tuple[float, int | Non
     # converged this one).
     t0 = time.perf_counter()
     fresh = Simulator(cfg, seed=1, chunk=sim.chunk)
-    # Cap the horizon inside the int16 heartbeat/tick contract (< 2^15).
+    # Cap the horizon inside the int16 heartbeat/tick contract (< 2^15);
+    # the caller lowers the cap further on a CPU fallback, where this
+    # probe is the dominant cost (watchdog budget).
     converged_at = fresh.run_until_converged(
-        max_rounds=min(4 * n_nodes, 30_000)
+        max_rounds=min(4 * n_nodes, 30_000, max_converge_rounds or 30_000)
     )
     log(
         f"rounds to full convergence @ {n_nodes} nodes: {converged_at} "
@@ -459,16 +466,43 @@ def main() -> None:
         platform = jax.default_backend()
         log(f"platform: {platform}")
 
-        rps, converged_at, sim_extra = sim_rounds_per_sec(n_nodes, rounds, log)
+        from aiocluster_tpu.ops.gossip import on_accelerator
+
+        on_accel = on_accelerator()
+        if not on_accel and not args.smoke and args.rounds is None:
+            # CPU fallback of the full config: keep the record diagnosable
+            # without racing the watchdog (a 10k-node CPU round is ~2-3
+            # orders slower than the chip's).
+            rounds = min(rounds, 16)
+            log(f"CPU fallback: rounds capped to {rounds}")
+
+        rps, converged_at, sim_extra = sim_rounds_per_sec(
+            n_nodes, rounds, log,
+            # The convergence probe dominates a CPU fallback; 64 rounds
+            # is twice the chip-measured convergence point at 10k, so a
+            # non-null answer is still possible without racing the
+            # watchdog.
+            max_converge_rounds=None if on_accel or args.smoke else 64,
+        )
         baseline_rps = python_rounds_per_sec(n_nodes)
         log(f"python object-model estimate: {baseline_rps:.4f} rounds/s")
         probe_rps = None
-        if not args.smoke:
+        if not args.smoke and on_accel:
             try:
                 probe_rps = round(scale_probe(log), 2)
             except Exception as exc:  # keep the headline even if the probe dies
                 log(f"scale probe failed: {exc!r}")
         anchored = None if args.smoke else anchored_asyncio_seconds(log)
+        # A CPU-fallback record is still a valid run, but its headline is
+        # not the chip's — point the reader at the preserved on-chip
+        # measurement so a down tunnel can't erase the evidence again
+        # (round-1 failure mode).
+        tpu_note = None
+        if not on_accel and not args.smoke and requested == "auto":
+            tpu_note = (
+                "accelerator unreachable at run time; last on-chip record: "
+                "benchmarks/records/ (see its README for provenance)"
+            )
         result = {
             "metric": metric,
             "value": round(rps, 2),
@@ -476,6 +510,7 @@ def main() -> None:
             "vs_baseline": round(rps / baseline_rps, 1),
             "extra": {
                 "platform": platform,
+                **({"tpu_note": tpu_note} if tpu_note else {}),
                 "rounds_to_convergence": converged_at,
                 "baseline_kind": "extrapolated_python_object_model_estimate",
                 "python_object_model_rounds_per_sec_est": round(baseline_rps, 4),

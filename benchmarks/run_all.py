@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import functools
 import json
 import os
 import sys
@@ -59,11 +60,8 @@ def _timed_rounds_per_sec(sim, rounds: int) -> float:
 # Lazy + memoized: config 1 is asyncio-only and must not import jax, and
 # a failed import must surface as a per-config error record, not a crash
 # before main().
-import functools
-
-
 @functools.lru_cache(maxsize=1)
-def MTU_BUDGET() -> int:
+def _mtu_budget() -> int:
     from aiocluster_tpu.core import DEFAULT_MAX_PAYLOAD_SIZE
     from aiocluster_tpu.sim import budget_from_mtu
 
@@ -145,7 +143,7 @@ def config2(smoke: bool) -> dict:
     from aiocluster_tpu.sim import SimConfig, Simulator
 
     n = 64
-    cfg = SimConfig(n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET())
+    cfg = SimConfig(n_nodes=n, keys_per_node=16, fanout=3, budget=_mtu_budget())
     sim = Simulator(cfg, seed=0, topology=ring(n, 1), chunk=8)
     start = time.perf_counter()
     rounds = sim.run_until_converged(max_rounds=4 * n)
@@ -178,7 +176,7 @@ def config3(smoke: bool) -> dict:
     # propagated, past the full grace it is forgotten. Grace = 40 rounds
     # (~the reference's 24 h at its 1 s round scaled into the sim horizon).
     cfg = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET(),
+        n_nodes=n, keys_per_node=16, fanout=3, budget=_mtu_budget(),
         death_rate=0.05, revival_rate=0.2, writes_per_round=1,
         peer_mode="view", pairing="choice", dead_grace_ticks=40,
     )
@@ -192,7 +190,7 @@ def config3(smoke: bool) -> dict:
     # quality number, freeze churn, kill a 5% cohort for good, let
     # detection settle, and measure both error directions.
     frozen = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET(),
+        n_nodes=n, keys_per_node=16, fanout=3, budget=_mtu_budget(),
         writes_per_round=1,
     )
     sim2 = Simulator(frozen, seed=1, chunk=16)
@@ -231,7 +229,7 @@ def config4(smoke: bool) -> dict:
     n = 512 if smoke else 10_000
     rounds = 32 if smoke else 64
     cfg = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET(),
+        n_nodes=n, keys_per_node=16, fanout=3, budget=_mtu_budget(),
         pairing="choice",  # adjacency-constrained
     )
     log(f"config4: building scale-free graph n={n}")
@@ -286,7 +284,7 @@ def config5(smoke: bool) -> dict:
     rounds = 16 if smoke else 32
     log(f"config5: {n} nodes over {n_dev} device(s) (target {target})")
     cfg = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=MTU_BUDGET(),
+        n_nodes=n, keys_per_node=16, fanout=3, budget=_mtu_budget(),
         track_failure_detector=False, track_heartbeats=False,
     )
     mesh = make_mesh(devices)
