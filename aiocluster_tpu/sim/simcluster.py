@@ -65,7 +65,12 @@ class SimCluster:
         # base_views[j] (a folded prefix); self._logs[j][k] is version
         # log_base[j] + k + 1.
         self._log_base = np.zeros(n, np.int64)
-        self._base_views: list[dict[str, tuple[str, KeyStatus]]] = [
+        # base view entry: key -> (value, status, version at fold time).
+        # The version is kept so replica_view stays correct for observers
+        # whose watermark sits BELOW the compaction base — possible after
+        # the dead-node lifecycle forgets an owner (w reset to 0) and a
+        # revival re-replicates it from scratch.
+        self._base_views: list[dict[str, tuple[str, KeyStatus, int]]] = [
             {} for _ in range(n)
         ]
         self._pending_writes = np.zeros(n, np.int32)
@@ -164,12 +169,21 @@ class SimCluster:
         return view
 
     def replica_view(self, observer: str, owner: str) -> dict[str, str]:
-        """What ``observer`` currently knows of ``owner``'s live keys."""
+        """What ``observer`` currently knows of ``owner``'s live keys.
+
+        A watermark below the compaction base happens when the dead-node
+        lifecycle has forgotten the owner (w = 0 -> empty view) or while
+        a revived owner is being re-replicated from scratch; folded
+        entries then apply only once the watermark reaches their fold
+        version — the same prefix-of-current-state a reference re-learner
+        receives from a from_version_excluded=0 delta."""
         i, j = self._index[observer], self._index[owner]
         watermark = int(np.asarray(self.sim.state.w[i, j]))
-        # Entries below the compaction base are pre-folded; the watermark
-        # can never sit below it (compact() floors over every replica).
-        view = dict(self._base_views[j])
+        view: dict[str, tuple[str, KeyStatus]] = {
+            k: (v, status)
+            for k, (v, status, ver) in self._base_views[j].items()
+            if ver <= watermark
+        }
         prefix = max(0, watermark - int(self._log_base[j]))
         for e in self._logs[j][:prefix]:
             view[e.key] = (e.value, e.status)
@@ -201,9 +215,10 @@ class SimCluster:
                 continue
             k = min(k, len(self._logs[j]))
             base = self._base_views[j]
-            for e in self._logs[j][:k]:
+            for idx, e in enumerate(self._logs[j][:k]):
                 if e.status is KeyStatus.SET:
-                    base[e.key] = (e.value, e.status)
+                    version = int(self._log_base[j]) + idx + 1
+                    base[e.key] = (e.value, e.status, version)
                 else:
                     base.pop(e.key, None)
             self._logs[j] = self._logs[j][k:]
@@ -223,6 +238,24 @@ class SimCluster:
     def alive_nodes(self) -> list[str]:
         mask = np.asarray(self.sim.state.alive)
         return [self.names[i] for i in np.flatnonzero(mask)]
+
+    def kill(self, node: str) -> None:
+        """Crash ``node``: it stops heartbeating and exchanging. Peers'
+        failure detectors notice over the following rounds; with
+        SimConfig.dead_grace_ticks set, its state is eventually excluded
+        from digests and then forgotten (the reference's two-stage GC).
+        The sim analogue of stopping a reference process."""
+        i = self._index[node]
+        st = self.sim.state
+        self.sim.state = st.replace(alive=st.alive.at[i].set(False))
+
+    def revive(self, node: str) -> None:
+        """Restart a killed ``node`` with its state intact. It resumes
+        heartbeating and must re-earn liveness at each observer with
+        fresh heartbeat samples (the FD window was reset on death)."""
+        i = self._index[node]
+        st = self.sim.state
+        self.sim.state = st.replace(alive=st.alive.at[i].set(True))
 
     @property
     def tick(self) -> int:

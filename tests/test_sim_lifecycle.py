@@ -123,3 +123,53 @@ def test_config_validation():
                   track_heartbeats=False, dead_grace_ticks=10)
     with pytest.raises(ValueError, match=">= 2"):
         SimConfig(n_nodes=4, dead_grace_ticks=1)
+
+
+def test_simcluster_kill_revive_lifecycle():
+    """The named-node API drives the full story: kill -> peers notice ->
+    state stops propagating -> forgotten after the grace; revive -> the
+    node re-earns liveness."""
+    from aiocluster_tpu.sim import SimCluster, SimConfig
+
+    cfg = SimConfig(n_nodes=16, keys_per_node=2, fanout=2, budget=64,
+                    dead_grace_ticks=30)
+    sc = SimCluster(cfg, seed=5)
+    sc.set("node-0", "role", "leader")
+    sc.run_until_converged(200)
+    assert sc.replica_view("node-7", "node-0")["role"] == "leader"
+
+    sc.kill("node-0")
+    sc.step(90)  # detection (~20-40 on a barely-warmed FD) + full grace (30)
+    assert "node-0" not in sc.live_view("node-7")
+    assert "node-0" not in sc.alive_nodes()
+    # Forgotten: the replica's copy of the dead node's state is gone.
+    assert sc.replica_view("node-7", "node-0") == {}
+
+    # A revived node re-replicates its own (intact) state back out.
+    sc.revive("node-0")
+    sc.step(40)
+    assert "node-0" in sc.live_view("node-7")
+    assert sc.replica_view("node-7", "node-0")["role"] == "leader"
+
+
+def test_forgotten_after_compaction_still_reads_empty():
+    """Regression (review find): lifecycle GC resets watermarks BELOW the
+    compaction base; replica_view must serve the folded base only up to
+    the observer's watermark, so a forgotten owner reads {} and a revived
+    one re-materializes correctly through the base."""
+    from aiocluster_tpu.sim import SimCluster, SimConfig
+
+    cfg = SimConfig(n_nodes=16, keys_per_node=2, fanout=2, budget=64,
+                    dead_grace_ticks=30)
+    sc = SimCluster(cfg, seed=5)
+    sc.set("node-0", "role", "leader")
+    sc.run_until_converged(200)
+    assert sc.compact() > 0  # base now holds node-0's folded history
+
+    sc.kill("node-0")
+    sc.step(90)
+    assert sc.replica_view("node-7", "node-0") == {}
+
+    sc.revive("node-0")
+    sc.step(60)
+    assert sc.replica_view("node-7", "node-0").get("role") == "leader"
