@@ -1,5 +1,7 @@
-"""Cluster topology models for the simulator (ring, scale-free, full)."""
+"""Cluster topology models for the simulator (ring, small-world,
+scale-free, hierarchical racks, full)."""
 
-from .topology import Topology, ring, scale_free
+from .topology import (Topology, hierarchical, ring, scale_free,
+                       small_world)
 
-__all__ = ("Topology", "ring", "scale_free")
+__all__ = ("Topology", "hierarchical", "ring", "scale_free", "small_world")

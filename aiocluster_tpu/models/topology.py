@@ -41,6 +41,18 @@ def ring(n: int, neighbors_each_side: int = 1) -> Topology:
     return Topology(idx.astype(np.int32), degrees)
 
 
+def _from_neighbor_sets(n: int, neighbors: list[set[int]]) -> Topology:
+    """Pad per-node neighbor sets into the dense Topology layout
+    (self-loop padding; isolated nodes get a degree-1 self edge)."""
+    degrees = np.array([max(1, len(s)) for s in neighbors], np.int32)
+    width = int(degrees.max())
+    adjacency = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, width))
+    for i, s in enumerate(neighbors):
+        row = sorted(s) if s else [i]
+        adjacency[i, : len(row)] = row
+    return Topology(adjacency.astype(np.int32), degrees)
+
+
 def scale_free(
     n: int, attach: int = 3, max_degree: int | None = None, seed: int = 0
 ) -> Topology:
@@ -83,10 +95,65 @@ def scale_free(
             neighbors[v].add(t)
             neighbors[t].add(v)
             repeated.extend((v, t))
-    degrees = np.array([max(1, len(s)) for s in neighbors], np.int32)
-    width = int(degrees.max())
-    adjacency = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, width))
-    for i, s in enumerate(neighbors):
-        row = sorted(s) if s else [i]
-        adjacency[i, : len(row)] = row
-    return Topology(adjacency.astype(np.int32), degrees)
+    return _from_neighbor_sets(n, neighbors)
+
+
+def small_world(
+    n: int, neighbors_each_side: int = 2, rewire_p: float = 0.1, seed: int = 0
+) -> Topology:
+    """Watts–Strogatz small-world graph: a ring lattice with each edge
+    rewired to a uniform random endpoint with probability ``rewire_p``.
+    Interpolates between config 2's ring (p=0) and random-fanout (p=1) —
+    the shape where gossip latency drops from O(N) hops to O(log N) with
+    only a few long links, a useful fidelity point between the two
+    BASELINE extremes."""
+    if not 0.0 <= rewire_p <= 1.0:
+        raise ValueError("rewire_p must be in [0, 1]")
+    if neighbors_each_side < 1 or 2 * neighbors_each_side >= n:
+        raise ValueError(
+            "need 1 <= neighbors_each_side and 2*neighbors_each_side < n"
+        )
+    rng = np.random.default_rng(seed)
+    neighbors: list[set[int]] = [set() for _ in range(n)]
+    for k in range(1, neighbors_each_side + 1):
+        for i in range(n):
+            j = (i + k) % n
+            if rng.random() < rewire_p:
+                # Rewire i--(i+k) to i--random, avoiding self/duplicates.
+                for _ in range(8):
+                    cand = int(rng.integers(n))
+                    if cand != i and cand not in neighbors[i]:
+                        j = cand
+                        break
+            neighbors[i].add(j)
+            neighbors[j].add(i)
+    return _from_neighbor_sets(n, neighbors)
+
+
+def hierarchical(
+    n: int, rack_size: int = 16, uplinks_per_node: int = 1, seed: int = 0
+) -> Topology:
+    """Two-level datacenter shape: full connectivity inside each rack of
+    ``rack_size`` nodes plus ``uplinks_per_node`` random cross-rack
+    links per node. Models gossip whose fast path is rack-local (ToR
+    switch) with sparse inter-rack spillover — the regime where the
+    reference's seed-node re-gossip (server.py:670-682) matters most,
+    because cross-partition links are scarce."""
+    if rack_size < 2:
+        raise ValueError("rack_size must be >= 2")
+    rng = np.random.default_rng(seed)
+    neighbors: list[set[int]] = [set() for _ in range(n)]
+    for i in range(n):
+        rack = i // rack_size
+        lo, hi = rack * rack_size, min((rack + 1) * rack_size, n)
+        for j in range(lo, hi):
+            if j != i:
+                neighbors[i].add(j)
+        for _ in range(uplinks_per_node):
+            for _ in range(16):
+                cand = int(rng.integers(n))
+                if cand // rack_size != rack and cand not in neighbors[i]:
+                    neighbors[i].add(cand)
+                    neighbors[cand].add(i)
+                    break
+    return _from_neighbor_sets(n, neighbors)

@@ -194,6 +194,43 @@ def test_scale_free_topology_valid_and_converges():
     assert sim.run_until_converged(2000) is not None
 
 
+def test_small_world_topology_valid_and_converges():
+    from aiocluster_tpu.models.topology import small_world
+
+    for p_rw in (0.0, 0.15, 1.0):
+        topo = small_world(96, neighbors_each_side=2, rewire_p=p_rw, seed=2)
+        assert (topo.degrees >= 1).all()
+        assert (topo.adjacency >= 0).all() and (topo.adjacency < 96).all()
+        # Symmetry: every edge appears in both endpoint rows.
+        for i in range(96):
+            for j in topo.adjacency[i, : topo.degrees[i]]:
+                row = topo.adjacency[j, : topo.degrees[j]]
+                assert i in row
+    topo = small_world(96, rewire_p=0.15, seed=2)
+    cfg = SimConfig(n_nodes=96, keys_per_node=4, track_failure_detector=False)
+    sim = Simulator(cfg, topology=topo, seed=6)
+    r_sw = sim.run_until_converged(2000)
+    assert r_sw is not None
+    # A few long links beat the pure ring's O(N)-hop spread.
+    ring_cfg = SimConfig(n_nodes=96, keys_per_node=4,
+                         track_failure_detector=False)
+    from aiocluster_tpu.models.topology import ring as ring_topo
+    r_ring = Simulator(ring_cfg, topology=ring_topo(96, 2), seed=6)\
+        .run_until_converged(2000)
+    assert r_ring is not None and r_sw < r_ring
+
+
+def test_hierarchical_topology_valid_and_converges():
+    from aiocluster_tpu.models.topology import hierarchical
+
+    topo = hierarchical(128, rack_size=16, uplinks_per_node=1, seed=3)
+    assert (topo.degrees >= 15).all()  # full rack connectivity at least
+    assert (topo.adjacency >= 0).all() and (topo.adjacency < 128).all()
+    cfg = SimConfig(n_nodes=128, keys_per_node=4, track_failure_detector=False)
+    sim = Simulator(cfg, topology=topo, seed=6)
+    assert sim.run_until_converged(2000) is not None
+
+
 # -- SimCluster API ------------------------------------------------------------
 
 
