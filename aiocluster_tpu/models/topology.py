@@ -141,6 +141,8 @@ def hierarchical(
     because cross-partition links are scarce."""
     if rack_size < 2:
         raise ValueError("rack_size must be >= 2")
+    if uplinks_per_node > 0 and n <= rack_size:
+        raise ValueError("cross-rack uplinks need more than one rack")
     rng = np.random.default_rng(seed)
     neighbors: list[set[int]] = [set() for _ in range(n)]
     for i in range(n):

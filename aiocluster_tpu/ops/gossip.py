@@ -311,6 +311,20 @@ def select_peers(
     return random.categorical(key, logits, shape=(n, cfg.fanout))
 
 
+def scheduled_for_deletion_mask(
+    state: SimState, cfg: SimConfig, tick: jax.Array | None = None
+) -> jax.Array | None:
+    """(N, n_local) bool: observer i has had owner j scheduled for
+    deletion for at least half the grace — the digest-exclusion stage.
+    Single source of the formula for sim_step AND for tests/tooling that
+    inspect lifecycle state; None when the lifecycle is disabled."""
+    if not (cfg.track_failure_detector and cfg.dead_grace_ticks is not None):
+        return None
+    t = state.tick if tick is None else tick
+    ds32 = state.dead_since.astype(jnp.int32)
+    return (ds32 > 0) & ((t - ds32) >= cfg.dead_grace_ticks // 2)
+
+
 def pallas_path_engaged(
     cfg: SimConfig,
     axis_name: str | None = None,
@@ -405,11 +419,7 @@ def sim_step(
     # that have believed owner j dead for >= half the grace stop sending
     # j's state and stop advertising j's heartbeat in their digests.
     lifecycle = cfg.track_failure_detector and cfg.dead_grace_ticks is not None
-    if lifecycle:
-        ds32 = state.dead_since.astype(jnp.int32)
-        sched = (ds32 > 0) & ((tick - ds32) >= cfg.dead_grace_ticks // 2)
-    else:
-        sched = None
+    sched = scheduled_for_deletion_mask(state, cfg, tick)
 
     def peer_adv(w, peer, salt):
         """The budgeted watermark advance of each row toward its peer row

@@ -197,6 +197,7 @@ def test_scale_free_topology_valid_and_converges():
 def test_small_world_topology_valid_and_converges():
     from aiocluster_tpu.models.topology import small_world
 
+    mid = None
     for p_rw in (0.0, 0.15, 1.0):
         topo = small_world(96, neighbors_each_side=2, rewire_p=p_rw, seed=2)
         assert (topo.degrees >= 1).all()
@@ -206,7 +207,9 @@ def test_small_world_topology_valid_and_converges():
             for j in topo.adjacency[i, : topo.degrees[i]]:
                 row = topo.adjacency[j, : topo.degrees[j]]
                 assert i in row
-    topo = small_world(96, rewire_p=0.15, seed=2)
+        if p_rw == 0.15:
+            mid = topo
+    topo = mid
     cfg = SimConfig(n_nodes=96, keys_per_node=4, track_failure_detector=False)
     sim = Simulator(cfg, topology=topo, seed=6)
     r_sw = sim.run_until_converged(2000)
