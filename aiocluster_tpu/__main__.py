@@ -1,0 +1,140 @@
+"""Command-line entry points: ``python -m aiocluster_tpu {node,sim}``.
+
+The reference is library-only (no CLI); these two subcommands make both
+backends usable without writing code:
+
+- ``node`` boots one asyncio cluster node (reference examples/simple.py
+  shape) and prints a snapshot line per gossip interval until Ctrl-C.
+- ``sim`` runs a tensor-sim convergence study and prints one JSON line
+  of results (metrics + rounds to convergence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _parse_addr(text: str) -> tuple[str, int]:
+    host, _, port = text.rpartition(":")
+    if not host or not port.isdigit():
+        raise argparse.ArgumentTypeError(f"expected HOST:PORT, got {text!r}")
+    return host, int(port)
+
+
+def _parse_kv(text: str) -> tuple[str, str]:
+    key, sep, value = text.partition("=")
+    if not sep or not key:
+        raise argparse.ArgumentTypeError(f"expected KEY=VALUE, got {text!r}")
+    return key, value
+
+
+async def _run_node(args: argparse.Namespace) -> int:
+    from . import Cluster, Config, NodeId
+
+    cfg = Config(
+        node_id=NodeId(name=args.name, gossip_advertise_addr=args.listen),
+        cluster_id=args.cluster_id,
+        seed_nodes=args.seed,
+        gossip_interval=args.interval,
+    )
+    async with Cluster(
+        cfg, initial_key_values=dict(args.set or [])
+    ) as cluster:
+        print(f"[{args.name}] listening on {args.listen[0]}:{args.listen[1]}",
+              file=sys.stderr, flush=True)
+        try:
+            while True:
+                await asyncio.sleep(args.interval)
+                snap = cluster.snapshot()
+                live = sorted(n.name for n in snap.live_nodes)
+                print(json.dumps({
+                    "node": args.name,
+                    "live": live,
+                    "nodes_known": len(snap.node_states),
+                }), flush=True)
+        except asyncio.CancelledError:
+            pass
+    return 0
+
+
+def _run_sim(args: argparse.Namespace) -> int:
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from .core import DEFAULT_MAX_PAYLOAD_SIZE
+    from .sim import SimConfig, Simulator, budget_from_mtu
+
+    cfg = SimConfig(
+        n_nodes=args.nodes,
+        keys_per_node=args.keys,
+        fanout=args.fanout,
+        budget=budget_from_mtu(
+            args.mtu if args.mtu is not None else DEFAULT_MAX_PAYLOAD_SIZE
+        ),
+        death_rate=args.churn,
+        revival_rate=4 * args.churn,
+        track_failure_detector=not args.lean,
+        track_heartbeats=not args.lean,
+        dead_grace_ticks=args.grace if args.churn and not args.lean else None,
+    )
+    sim = Simulator(cfg, seed=args.seed, chunk=8)
+    converged = sim.run_until_converged(max_rounds=args.max_rounds)
+    m = {k: v.tolist() for k, v in sim.metrics().items()}
+    print(json.dumps({
+        "nodes": args.nodes,
+        "rounds_to_convergence": converged,
+        "tick": sim.tick,
+        "metrics": m,
+    }), flush=True)
+    return 0 if converged is not None else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m aiocluster_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    node = sub.add_parser("node", help="run one asyncio cluster node")
+    node.add_argument("--name", required=True)
+    node.add_argument("--listen", type=_parse_addr, required=True,
+                      metavar="HOST:PORT")
+    node.add_argument("--seed", type=_parse_addr, action="append",
+                      default=[], metavar="HOST:PORT",
+                      help="seed node address (repeatable)")
+    node.add_argument("--cluster-id", default="default-cluster")
+    node.add_argument("--interval", type=float, default=1.0)
+    node.add_argument("--set", type=_parse_kv, action="append",
+                      metavar="KEY=VALUE", help="initial key (repeatable)")
+
+    sim = sub.add_parser("sim", help="run a tensor-sim convergence study")
+    sim.add_argument("--nodes", type=int, default=1024)
+    sim.add_argument("--keys", type=int, default=16)
+    sim.add_argument("--fanout", type=int, default=3)
+    sim.add_argument("--mtu", type=int, default=None,
+                     help="per-exchange budget as a wire MTU in bytes "
+                     "(default: the reference's 65,507)")
+    sim.add_argument("--churn", type=float, default=0.0,
+                     help="per-round death probability (revival = 4x)")
+    sim.add_argument("--grace", type=int, default=40,
+                     help="dead-node grace in rounds (with --churn)")
+    sim.add_argument("--lean", action="store_true",
+                     help="convergence-only profile (no FD matrices)")
+    sim.add_argument("--seed", type=int, default=0)
+    sim.add_argument("--max-rounds", type=int, default=10_000)
+    sim.add_argument("--cpu", action="store_true",
+                     help="pin the CPU backend")
+
+    args = parser.parse_args(argv)
+    if args.command == "node":
+        try:
+            return asyncio.run(_run_node(args))
+        except KeyboardInterrupt:
+            return 0
+    return _run_sim(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""CLI entry points (python -m aiocluster_tpu {node,sim})."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_cli_sim_runs_to_convergence():
+    proc = subprocess.run(
+        [sys.executable, "-m", "aiocluster_tpu", "sim",
+         "--nodes", "128", "--cpu", "--max-rounds", "500"],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["rounds_to_convergence"] is not None
+    assert record["metrics"]["all_converged"] is True
+
+
+def test_cli_sim_bad_args():
+    proc = subprocess.run(
+        [sys.executable, "-m", "aiocluster_tpu", "sim", "--mtu", "10",
+         "--cpu"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode != 0  # mtu too small for one key-version
+
+
+def test_cli_two_nodes_converge_over_loopback():
+    ports = [_free_port(), _free_port()]
+    procs = []
+    try:
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "aiocluster_tpu", "node",
+                 "--name", f"cli{i}",
+                 "--listen", f"127.0.0.1:{ports[i]}",
+                 "--seed", f"127.0.0.1:{ports[1 - i]}",
+                 "--interval", "0.05",
+                 "--set", f"origin=node{i}"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, cwd=REPO,
+            ))
+        deadline = time.monotonic() + 20
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            assert procs[0].poll() is None, "node 0 exited early"
+            assert procs[1].poll() is None, "node 1 exited early"
+            line = procs[0].stdout.readline()
+            if not line.strip():
+                time.sleep(0.05)  # EOF after a crash: don't busy-spin
+                continue
+            snap = json.loads(line)
+            ok = snap["nodes_known"] == 2 and "cli1" in snap["live"]
+        assert ok, "nodes never saw each other over loopback"
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
