@@ -133,7 +133,10 @@ def main(argv: list[str] | None = None) -> int:
             return asyncio.run(_run_node(args))
         except KeyboardInterrupt:
             return 0
-    return _run_sim(args)
+    try:
+        return _run_sim(args)
+    except ValueError as exc:  # bad --mtu/--nodes/--grace combinations
+        parser.error(str(exc))
 
 
 if __name__ == "__main__":

@@ -2,19 +2,13 @@
 
 import json
 import os
+import select
 import signal
-import socket
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def test_cli_sim_runs_to_convergence():
@@ -38,8 +32,8 @@ def test_cli_sim_bad_args():
     assert proc.returncode != 0  # mtu too small for one key-version
 
 
-def test_cli_two_nodes_converge_over_loopback():
-    ports = [_free_port(), _free_port()]
+def test_cli_two_nodes_converge_over_loopback(free_port_factory):
+    ports = [free_port_factory(), free_port_factory()]
     procs = []
     try:
         for i in range(2):
@@ -58,6 +52,11 @@ def test_cli_two_nodes_converge_over_loopback():
         while time.monotonic() < deadline and not ok:
             assert procs[0].poll() is None, "node 0 exited early"
             assert procs[1].poll() is None, "node 1 exited early"
+            # Bounded read: a wedged-but-alive node must not hang the
+            # suite past the deadline (readline alone would block).
+            ready, _, _ = select.select([procs[0].stdout], [], [], 0.2)
+            if not ready:
+                continue
             line = procs[0].stdout.readline()
             if not line.strip():
                 time.sleep(0.05)  # EOF after a crash: don't busy-spin

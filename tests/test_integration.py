@@ -150,3 +150,50 @@ async def test_wrong_cluster_id_never_joins(free_port_factory):
         await asyncio.sleep(0.3)
         assert all(n.name != "intruder" for n in c1.snapshot().node_states)
         assert all(n.name != "one" for n in bad.snapshot().node_states)
+
+
+async def test_dead_node_lifecycle_over_sockets(free_port_factory):
+    """The socket backend's full dead-node story (reference
+    failure_detector.py:108-128 + server.py:618-620): a stopped node goes
+    live -> dead at its peers via phi, and after the (shortened) grace
+    period its state is garbage-collected from their cluster state."""
+    from datetime import timedelta
+
+    from aiocluster_tpu import FailureDetectorConfig
+
+    fd = FailureDetectorConfig(
+        # Tight windows so detection and both grace stages fit in seconds.
+        max_interval=timedelta(seconds=0.5),
+        initial_interval=timedelta(seconds=0.1),
+        dead_node_grace_period=timedelta(seconds=2.0),
+    )
+    p1, p2, p3 = (free_port_factory() for _ in range(3))
+    c1 = Cluster(make_config("a", p1, [p2, p3], failure_detector=fd),
+                 initial_key_values={"ka": "va"})
+    c2 = Cluster(make_config("b", p2, [p1, p3], failure_detector=fd))
+    c3 = Cluster(make_config("c", p3, [p1, p2], failure_detector=fd))
+
+    # close() is idempotent, so the explicit mid-test close composes with
+    # the context manager's unconditional cleanup on any failure path.
+    async with c1, c2, c3:
+        await wait_for(lambda: sum(
+            1 for n in c1.snapshot().live_nodes if n.name in ("b", "c")
+        ) == 2, timeout=5.0)
+        assert any(n.name == "c" for n in c2.snapshot().node_states)
+
+        await c3.close()  # the process "crashes"
+
+        # Phi flips c dead at both survivors...
+        await wait_for(lambda: any(
+            n.name == "c" for n in c1.snapshot().dead_nodes
+        ) and any(
+            n.name == "c" for n in c2.snapshot().dead_nodes
+        ), timeout=8.0)
+        # ...and after the grace period its state is removed entirely.
+        await wait_for(lambda: not any(
+            n.name == "c" for n in c1.snapshot().node_states
+        ) and not any(
+            n.name == "c" for n in c2.snapshot().node_states
+        ), timeout=8.0)
+        # The survivors keep replicating fine without it.
+        assert any(n.name == "b" for n in c1.snapshot().live_nodes)
