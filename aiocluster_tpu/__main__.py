@@ -60,15 +60,15 @@ async def _run_node(args: argparse.Namespace) -> int:
     return 0
 
 
-def _run_sim(args: argparse.Namespace) -> int:
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+def _sim_config(args: argparse.Namespace):
+    """Build the SimConfig from CLI flags. ValueErrors raised here are
+    user errors (bad --mtu/--nodes/--grace combinations) and surface as
+    clean parser errors; anything raised later in the run is a real bug
+    and keeps its traceback."""
     from .core import DEFAULT_MAX_PAYLOAD_SIZE
-    from .sim import SimConfig, Simulator, budget_from_mtu
+    from .sim import SimConfig, budget_from_mtu
 
-    cfg = SimConfig(
+    return SimConfig(
         n_nodes=args.nodes,
         keys_per_node=args.keys,
         fanout=args.fanout,
@@ -81,6 +81,15 @@ def _run_sim(args: argparse.Namespace) -> int:
         track_heartbeats=not args.lean,
         dead_grace_ticks=args.grace if args.churn and not args.lean else None,
     )
+
+
+def _run_sim(args: argparse.Namespace, cfg) -> int:
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from .sim import Simulator
+
     sim = Simulator(cfg, seed=args.seed, chunk=8)
     converged = sim.run_until_converged(max_rounds=args.max_rounds)
     m = {k: v.tolist() for k, v in sim.metrics().items()}
@@ -134,9 +143,10 @@ def main(argv: list[str] | None = None) -> int:
         except KeyboardInterrupt:
             return 0
     try:
-        return _run_sim(args)
+        cfg = _sim_config(args)
     except ValueError as exc:  # bad --mtu/--nodes/--grace combinations
         parser.error(str(exc))
+    return _run_sim(args, cfg)
 
 
 if __name__ == "__main__":

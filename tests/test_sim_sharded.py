@@ -4,6 +4,7 @@ preserved via the block-offset all_gather)."""
 
 import numpy as np
 import jax
+import pytest
 from jax import random
 
 from aiocluster_tpu.ops.gossip import sim_step
@@ -41,12 +42,20 @@ def test_sharded_step_bit_identical_to_single_device():
     assert int(sharded.tick) == int(single.tick) == 12
 
 
-def test_sharded_lifecycle_bit_identical_to_single_device():
+@pytest.mark.parametrize("extra", [
+    # Default matching pairing under churn + lifecycle.
+    {},
+    # Benchmark config 3's FD-faithful combination: choice pairing and
+    # view-mode peer draws (the Gumbel-max composes across shards).
+    {"pairing": "choice", "peer_mode": "view"},
+])
+def test_sharded_lifecycle_bit_identical_to_single_device(extra):
     """The dead-node lifecycle (stamp / schedule / GC) is pure elementwise
     + shard-local row-gather math, so a churning sharded run must stay
     bit-identical through detection, digest exclusion and removal."""
     cfg = SimConfig(n_nodes=64, keys_per_node=8, budget=32,
-                    death_rate=0.02, revival_rate=0.05, dead_grace_ticks=16)
+                    death_rate=0.03, revival_rate=0.08,
+                    dead_grace_ticks=16, **extra)
     mesh = make_mesh()
     step = sharded_step_fn(cfg, mesh)
 
@@ -56,13 +65,11 @@ def test_sharded_lifecycle_bit_identical_to_single_device():
         sharded = step(sharded, KEY)
         single = sim_step(single, KEY, cfg)
 
-    assert np.array_equal(np.asarray(sharded.w), np.asarray(single.w))
-    assert np.array_equal(
-        np.asarray(sharded.dead_since), np.asarray(single.dead_since)
-    )
-    assert np.array_equal(
-        np.asarray(sharded.live_view), np.asarray(single.live_view)
-    )
+    for field in ("w", "hb_known", "live_view", "dead_since"):
+        assert np.array_equal(
+            np.asarray(getattr(sharded, field)),
+            np.asarray(getattr(single, field)),
+        ), field
     # The churn actually exercised the lifecycle in this window.
     assert np.asarray(single.dead_since).any()
 
@@ -170,3 +177,4 @@ def test_sharded_resume_matches_single_device_resume(tmp_path):
     a.run(7)
     b.run(7)
     assert np.array_equal(np.asarray(a.state.w), np.asarray(b.state.w))
+
