@@ -340,10 +340,12 @@ def pallas_path_engaged(
     XLA elsewhere (interpret mode is for tests only — forcing
     use_pallas=True off-TPU runs it interpreted). The remaining terms
     mirror the kernel's hard requirements: grouped-matching domain
-    (n % 128 == 0), single device, proportional budget, heartbeats
-    tracked, no dead-node lifecycle (the kernel has no
-    scheduled-for-deletion column mask), and a legal VMEM block for the
-    widest matrix dtype (fused_pull_m8 sizes VMEM from the same).
+    (n % 128 == 0), single device, proportional budget, no dead-node
+    lifecycle (the kernel has no scheduled-for-deletion column mask),
+    and a legal VMEM block for the widest matrix dtype (fused_pull_m8
+    sizes VMEM from the same). Both profiles qualify: with heartbeats
+    the kernel fuses w and hb; the lean convergence-only profile runs
+    the w-only variant.
     ``has_topology``: adjacency-constrained runs force the choice path,
     so callers labelling a Simulator(..., topology=...) run must pass
     True (sim_step itself never consults the gate on that path)."""
@@ -351,10 +353,9 @@ def pallas_path_engaged(
 
     on_tpu = on_accelerator()
     wanted = cfg.use_pallas is True or (cfg.use_pallas == "auto" and on_tpu)
-    itemsize = max(
-        jnp.dtype(cfg.version_dtype).itemsize,
-        jnp.dtype(cfg.heartbeat_dtype).itemsize,
-    )
+    itemsize = jnp.dtype(cfg.version_dtype).itemsize
+    if cfg.track_heartbeats:
+        itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
     lifecycle = cfg.track_failure_detector and cfg.dead_grace_ticks is not None
     return (
         wanted
@@ -363,9 +364,10 @@ def pallas_path_engaged(
         and cfg.n_nodes % 128 == 0
         and axis_name is None
         and cfg.budget_policy == "proportional"
-        and cfg.track_heartbeats
         and not lifecycle
-        and pallas_pull.supported(cfg.n_nodes, itemsize)
+        and pallas_pull.supported(
+            cfg.n_nodes, itemsize, track_hb=cfg.track_heartbeats
+        )
     )
 
 
@@ -483,11 +485,12 @@ def sim_step(
                     p = _random_matching(ck, n)
                 inv = p
             if use_pallas:
-                w, hb = pallas_pull.fused_pull_m8(
-                    w, hb, gm8, c8, alive & alive[p],
-                    sub_salt(c, 0), run_salt, cfg.budget,
-                    interpret=interpret,
+                pulled = pallas_pull.fused_pull_m8(
+                    w, hb if track_hb else None, gm8, c8,
+                    alive & alive[p], sub_salt(c, 0), run_salt,
+                    cfg.budget, interpret=interpret,
                 )
+                w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
                 adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0))
                 adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1))

@@ -134,8 +134,10 @@ class SimConfig:
     # on real TPU backends and stays on XLA elsewhere (interpret mode is
     # only for tests); True forces it (interpreted off-TPU), False
     # disables. Only single-device, matching pairing, n % 128 == 0,
-    # proportional budget, track_heartbeats=True, no dead-node lifecycle
-    # qualify — other configs use the XLA path regardless.
+    # proportional budget, no dead-node lifecycle qualify — other
+    # configs use the XLA path regardless. Both storage profiles do:
+    # with heartbeats the kernel fuses w and hb; the lean
+    # convergence-only profile runs a w-only variant.
     use_pallas: bool | str = "auto"
 
     def __post_init__(self) -> None:

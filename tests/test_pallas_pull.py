@@ -76,7 +76,7 @@ def test_fused_pull_m8_matches_xla(dtype):
 
 
 def test_pick_block_respects_vmem():
-    from aiocluster_tpu.ops.pallas_pull import _BUFFERS, VMEM_BUDGET
+    from aiocluster_tpu.ops.pallas_pull import VMEM_BUDGET, _buffers
 
     # Small n: capped by the 512-row ceiling, not VMEM.
     assert _pick_block(64, 2) == 64
@@ -84,8 +84,11 @@ def test_pick_block_respects_vmem():
     for n, isz in [(10_000, 2), (10_000, 4), (32_768, 2)]:
         b = _pick_block(n, isz)
         assert b is not None and n % b == 0 and b % 8 == 0
-        assert _BUFFERS * b * n * isz <= VMEM_BUDGET
+        assert _buffers(True) * b * n * isz <= VMEM_BUDGET
     assert _pick_block(7, 2) is None
+    # The lean (w-only) profile halves the buffer set -> same or larger
+    # blocks at any shape.
+    assert _pick_block(32_768, 2, track_hb=False) >= _pick_block(32_768, 2)
     # Manual DMA needs lane-aligned columns: n % 128 == 0.
     assert not supported(100, 2)
     assert not supported(96, 2)
@@ -101,6 +104,44 @@ def test_unsupported_n_falls_back_to_xla():
     cfg = SimConfig(n_nodes=100, keys_per_node=2, use_pallas=True)
     s = sim_step(init_state(cfg), random.key(0), cfg)
     assert int(s.tick) == 1
+
+
+def test_fused_pull_m8_lean_matches_xla():
+    """The w-only (lean) kernel variant must equal the XLA advance."""
+    n = 128
+    kw, kp, ka = random.split(random.key(5), 3)
+    w = random.randint(kw, (n, n), 0, 50).astype(jnp.int16)
+    gm, c, p = _grouped_matching(kp, n)
+    alive = random.bernoulli(ka, 0.9, (n,))
+    valid = alive & alive[p]
+    salt = jnp.asarray(11, jnp.int32)
+    run_salt = jnp.asarray(0xBEEF, jnp.uint32)
+
+    w_k = fused_pull_m8(
+        w, None, gm, c, valid, salt, run_salt, budget=32, interpret=True
+    )
+    owners = _local_owner_ids(n, None)
+    adv = _budgeted_advance(
+        w, w[p, :], 32, valid, None, "proportional", salt, owners, run_salt
+    )
+    np.testing.assert_array_equal(np.asarray(w_k), np.asarray(w + adv))
+
+
+def test_sim_step_lean_pallas_path_matches_xla():
+    """Lean-profile sim trajectories are identical with the kernel on."""
+    from aiocluster_tpu.ops.gossip import sim_step
+    from aiocluster_tpu.sim import SimConfig, init_state
+
+    kw = dict(n_nodes=128, keys_per_node=6, budget=24,
+              track_failure_detector=False, track_heartbeats=False)
+    cfg_x = SimConfig(**kw)
+    cfg_p = SimConfig(**kw, use_pallas=True)
+    sx, sp = init_state(cfg_x), init_state(cfg_p)
+    key = random.key(4)
+    for _ in range(6):
+        sx = sim_step(sx, key, cfg_x)
+        sp = sim_step(sp, key, cfg_p)
+    np.testing.assert_array_equal(np.asarray(sp.w), np.asarray(sx.w))
 
 
 def test_sim_step_pallas_path_matches_xla():
