@@ -28,8 +28,11 @@ trajectory.
 Bit-compatibility: the advance formula and the (row, owner, salt) dither
 hash are the same arithmetic as gossip._budgeted_advance /
 gossip._hash_uniform. Single-device, proportional-budget, matching
-pairing, heartbeats tracked, no dead-node lifecycle — other configs stay
-on XLA (the sim_step gate enforces this).
+pairing, no dead-node lifecycle — other configs stay on XLA (the
+sim_step gate enforces this). Both storage profiles qualify: with
+heartbeats the kernel fuses w and hb in one pass; the lean
+convergence-only profile (hb=None) runs the w-only variant with half
+the VMEM footprint.
 
 Reference anchor: this is the hot loop of server.py:378-495 (the 3-way
 handshake fan-out) collapsed into one tensor pass.

@@ -228,3 +228,47 @@ def test_restart_with_new_generation_replaces_old_incarnation():
         b._state.remove_node(nid)
     assert b._state.node_state(old) is None
     assert b._state.node_state(new).get("epoch").value == "second"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_budget_from_mtu_predicts_real_packer_capacity(seed):
+    """Property: for uniform key/value sizes, budget_from_mtu's prediction
+    equals what the REAL byte-exact packer fits into one delta at that
+    MTU (within one key-version: a fresh receiver's zero
+    from_version_excluded varint is omitted on the wire, which
+    budget_from_mtu conservatively prices in)."""
+    import random as pyrandom
+
+    from aiocluster_tpu.core import ClusterState, Digest, NodeId
+    from aiocluster_tpu.sim.bytes import budget_from_mtu
+
+    rng = pyrandom.Random(seed)
+    key_len = rng.randint(4, 16)
+    value_len = rng.randint(1, 24)
+    # MTUs small enough that every packed version fits a 1-byte varint
+    # (<= 127), so version_scale=100 prices the wire exactly and the
+    # only modelling slack left is the omitted zero from_version_excluded.
+    mtu = rng.randint(300, 2000)
+    k_total = 200  # more versions than any tested MTU can carry
+
+    owner = NodeId("n" * 8, 1000, ("h" * 9, 65_000))
+    cs = ClusterState()
+    ns = cs.node_state_or_default(owner)
+    for j in range(k_total):
+        ns.set_with_version(
+            f"{j:0{key_len}d}"[:key_len], "v" * value_len, j + 1
+        )
+
+    delta = cs.compute_partial_delta_respecting_mtu(Digest({}), mtu, set())
+    packed = sum(len(nd.key_values) for nd in delta.node_deltas)
+
+    predicted = budget_from_mtu(
+        mtu, key_bytes=key_len, value_bytes=value_len,
+        node_name_bytes=8, version_scale=100,
+    )
+    assert packed > 0
+    assert packed <= 127  # inside the 1-byte varint regime priced above
+    assert abs(packed - predicted) <= 1, (
+        f"packer fit {packed}, budget_from_mtu said {predicted} "
+        f"(key={key_len} value={value_len} mtu={mtu})"
+    )
