@@ -383,7 +383,6 @@ def sim_step(
     """Advance the whole cluster by one gossip round."""
     n = cfg.n_nodes
     n_local = state.w.shape[1]
-    cols = jnp.arange(n_local, dtype=jnp.int32)
     owners = _local_owner_ids(n_local, axis_name)
     tick = state.tick + 1
     round_key = random.fold_in(key, tick)
@@ -405,11 +404,22 @@ def sim_step(
     heartbeat = state.heartbeat + alive.astype(jnp.int32)
     max_version = state.max_version + cfg.writes_per_round * alive.astype(jnp.int32)
 
-    w = state.w.at[owners, cols].set(max_version[owners].astype(state.w.dtype))
+    # Owner diagonal refresh as a broadcast-iota select, NOT a scatter:
+    # w[j_owner, j] = max_version[j_owner]. The where is elementwise, so
+    # XLA fuses it into the adjacent passes; the equivalent
+    # ``w.at[owners, cols].set(...)`` lowers to a scatter that costs a
+    # full serialized pass over both matrices (~5 ms/round at 10k on a
+    # v5e — measured, round 2).
+    diag = jnp.arange(n, dtype=jnp.int32)[:, None] == owners[None, :]
+    w = jnp.where(
+        diag, max_version[owners][None, :].astype(state.w.dtype), state.w
+    )
     track_hb = cfg.track_heartbeats
     hb = (
-        state.hb_known.at[owners, cols].set(
-            heartbeat[owners].astype(state.hb_known.dtype)
+        jnp.where(
+            diag,
+            heartbeat[owners][None, :].astype(state.hb_known.dtype),
+            state.hb_known,
         )
         if track_hb
         else state.hb_known
@@ -577,7 +587,7 @@ def sim_step(
         elapsed = (tick - last_change).astype(jnp.float32)
         phi = elapsed / prior_mean
         live = (icount >= 1) & (phi <= cfg.phi_threshold)
-        live = live.at[owners, cols].set(True)  # self-belief
+        live = live | diag  # self-belief (elementwise, not a scatter)
         # Going (or staying) dead wipes the window: a returning node must
         # re-earn liveness with fresh samples (core/failure.py reset rule).
         imean = jnp.where(live, imean, 0.0).astype(state.imean.dtype)
