@@ -325,6 +325,19 @@ def scheduled_for_deletion_mask(
     return (ds32 > 0) & ((t - ds32) >= cfg.dead_grace_ticks // 2)
 
 
+def _pallas_wanted(cfg: SimConfig) -> bool:
+    """Resolution of ``use_pallas`` shared by both kernel gates:
+    True forces the kernels (interpret mode off-TPU — tests), "auto"
+    engages them on a real TPU backend only."""
+    return cfg.use_pallas is True or (
+        cfg.use_pallas == "auto" and on_accelerator()
+    )
+
+
+def _lifecycle_enabled(cfg: SimConfig) -> bool:
+    return cfg.track_failure_detector and cfg.dead_grace_ticks is not None
+
+
 def pallas_path_engaged(
     cfg: SimConfig,
     axis_name: str | None = None,
@@ -351,22 +364,41 @@ def pallas_path_engaged(
     True (sim_step itself never consults the gate on that path)."""
     from . import pallas_pull
 
-    on_tpu = on_accelerator()
-    wanted = cfg.use_pallas is True or (cfg.use_pallas == "auto" and on_tpu)
     itemsize = jnp.dtype(cfg.version_dtype).itemsize
     if cfg.track_heartbeats:
         itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
-    lifecycle = cfg.track_failure_detector and cfg.dead_grace_ticks is not None
     return (
-        wanted
+        _pallas_wanted(cfg)
         and not has_topology  # adjacency runs force the choice path
         and cfg.pairing == "matching"
         and cfg.n_nodes % 128 == 0
         and axis_name is None
         and cfg.budget_policy == "proportional"
-        and not lifecycle
+        and not _lifecycle_enabled(cfg)
         and pallas_pull.supported(
             cfg.n_nodes, itemsize, track_hb=cfg.track_heartbeats
+        )
+    )
+
+
+def pallas_fd_engaged(cfg: SimConfig, axis_name: str | None = None) -> bool:
+    """Whether the streaming FD kernel (ops/pallas_fd.py) replaces the
+    XLA failure-detection block for this config. Mirrors
+    ``pallas_path_engaged``'s resolution of ``use_pallas`` ("auto" = on a
+    real TPU; forcing True off-TPU runs interpreted, for tests). The
+    dead-node lifecycle stays on XLA: its branch rewrites w/hb and
+    carries dead_since, none of which the kernel models."""
+    from . import pallas_fd
+
+    return (
+        _pallas_wanted(cfg)
+        and cfg.track_failure_detector
+        and not _lifecycle_enabled(cfg)
+        and axis_name is None
+        and pallas_fd.supported(
+            cfg.n_nodes,
+            jnp.dtype(cfg.heartbeat_dtype).itemsize,
+            jnp.dtype(cfg.fd_dtype).itemsize,
         )
     )
 
@@ -430,7 +462,7 @@ def sim_step(
     # recomputes it from the FD's dead set at syn time each round): rows
     # that have believed owner j dead for >= half the grace stop sending
     # j's state and stop advertising j's heartbeat in their digests.
-    lifecycle = cfg.track_failure_detector and cfg.dead_grace_ticks is not None
+    lifecycle = _lifecycle_enabled(cfg)
     sched = scheduled_for_deletion_mask(state, cfg, tick)
 
     def peer_adv(w, peer, salt):
@@ -558,7 +590,27 @@ def sim_step(
         w, hb = lax.fori_loop(0, cfg.fanout, exchange, (w, hb), unroll=True)
 
     # -- vectorized phi-accrual failure detection ----------------------------
-    if cfg.track_failure_detector:
+    if pallas_fd_engaged(cfg, axis_name):
+        # One streaming pass over the five FD operands (bit-identical to
+        # the XLA block below — tests/test_pallas_fd.py).
+        from . import pallas_fd
+
+        last_change, imean, icount, live = pallas_fd.fused_fd(
+            tick,
+            hb,
+            hb_round_start,
+            state.last_change,
+            state.imean,
+            state.icount,
+            max_interval=cfg.max_interval_ticks,
+            window=cfg.window_ticks,
+            prior_weight=cfg.prior_weight,
+            prior_mean=cfg.prior_mean_ticks,
+            phi_threshold=cfg.phi_threshold,
+            interpret=not on_accelerator(),
+        )
+        dead_since = state.dead_since
+    elif cfg.track_failure_detector:
         increased = hb > hb_round_start
         never_seen = state.last_change == 0
         interval = (tick - state.last_change).astype(jnp.float32)
@@ -581,12 +633,18 @@ def sim_step(
             increased, tick.astype(state.last_change.dtype), state.last_change
         )
         count_f32 = icount.astype(jnp.float32)
-        prior_mean = (
-            imean * count_f32 + cfg.prior_weight * cfg.prior_mean_ticks
-        ) / (count_f32 + cfg.prior_weight)
+        # live ⟺ phi = elapsed / prior_mean <= threshold, tested in
+        # cross-multiplied form (prior_mean > 0 always): two f32 divides
+        # per element become multiplies — the FD phase is VPU-bound, and
+        # divides are its dominant cost (measured on v5e, round 2). The
+        # ~1-ulp boundary shift vs the divide form is inside the noise of
+        # an 8.0 heuristic threshold.
         elapsed = (tick - last_change).astype(jnp.float32)
-        phi = elapsed / prior_mean
-        live = (icount >= 1) & (phi <= cfg.phi_threshold)
+        live = (icount >= 1) & (
+            elapsed * (count_f32 + cfg.prior_weight)
+            <= cfg.phi_threshold
+            * (imean * count_f32 + cfg.prior_weight * cfg.prior_mean_ticks)
+        )
         live = live | diag  # self-belief (elementwise, not a scatter)
         # Going (or staying) dead wipes the window: a returning node must
         # re-earn liveness with fresh samples (core/failure.py reset rule).

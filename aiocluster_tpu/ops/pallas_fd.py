@@ -1,0 +1,193 @@
+"""Fused Pallas TPU kernel for the vectorized phi-accrual FD phase.
+
+The XLA path of ops/gossip.py's failure-detection block is a chain of
+elementwise ops over five (N, N) matrices (hb, round-start hb,
+last_change, imean, icount) producing four (last_change', imean',
+icount', live'). XLA fuses the chain but, measured on a v5e at
+N=10,240, still spends ~5.4 ms against a ~2.3 ms analytic-traffic
+floor. This kernel streams row blocks through VMEM once — every matrix
+read exactly once, every output written exactly once, all math on
+registers in between.
+
+Bit-compatibility: the arithmetic is the same f32 ops in the same order
+as the XLA block in gossip.sim_step (loads widen int16->int32 /
+bfloat16->float32 exactly; stores round exactly once, at the end, as
+the XLA path does), so flipping the kernel on never changes a
+trajectory — asserted in tests/test_pallas_fd.py. Gated like the pull
+kernel (ops/gossip.py::pallas_fd_engaged): real TPU, single device,
+failure detector on, dead-node lifecycle off (the lifecycle branch
+rewrites w/hb and is XLA-only).
+
+Reference anchor: this is failure_detector.py:43-106 (phi +
+update_node_liveness over every observer) collapsed into one pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_pull import largest_fitting_block
+
+
+def _fd_kernel(
+    tick_ref,  # scalar prefetch: (1,) int32 — this round's tick
+    hb_ref,  # (block, n) heartbeat_dtype — post-exchange hb knowledge
+    hb0_ref,  # (block, n) heartbeat_dtype — round-start hb knowledge
+    lc_ref,  # (block, n) heartbeat_dtype — tick of last observed increase
+    im_ref,  # (block, n) fd_dtype — running interval mean
+    ic_ref,  # (block, n) int16 — interval sample count
+    lc_out,
+    im_out,
+    ic_out,
+    live_out,  # (block, n) bool
+    *,
+    block: int,
+    max_interval: float,
+    window: int,
+    prior_weight: float,
+    prior_mean: float,
+    phi_threshold: float,
+):
+    tick = tick_ref[0]
+    hb = hb_ref[:].astype(jnp.int32)
+    hb0 = hb0_ref[:].astype(jnp.int32)
+    lc = lc_ref[:].astype(jnp.int32)
+    increased = hb > hb0
+    never_seen = lc == 0
+    interval = (tick - lc).astype(jnp.float32)
+    sampled = increased & ~never_seen & (interval <= max_interval)
+    icount = jnp.minimum(
+        ic_ref[:].astype(jnp.int32) + sampled.astype(jnp.int32), window
+    )
+    mean_f32 = im_ref[:].astype(jnp.float32)
+    denom = jnp.maximum(icount.astype(jnp.float32), 1.0)
+    imean = jnp.where(sampled, mean_f32 + (interval - mean_f32) / denom, mean_f32)
+    lc2 = jnp.where(increased, tick, lc)
+    count_f32 = icount.astype(jnp.float32)
+    # Cross-multiplied phi test — same arithmetic as the XLA block in
+    # gossip.sim_step (two divides per element saved; the FD pass is
+    # VPU-bound).
+    elapsed = (tick - lc2).astype(jnp.float32)
+    live = (icount >= 1) & (
+        elapsed * (count_f32 + prior_weight)
+        <= phi_threshold * (imean * count_f32 + prior_weight * prior_mean)
+    )
+    # Self-belief diagonal (single-device: global row == global column).
+    shape = live.shape
+    rows = pl.program_id(0) * block + lax.broadcasted_iota(jnp.int32, shape, 0)
+    live = live | (rows == lax.broadcasted_iota(jnp.int32, shape, 1))
+    # Death wipes the window (re-earn liveness with fresh samples).
+    lc_out[:] = lc2.astype(lc_out.dtype)
+    im_out[:] = jnp.where(live, imean, 0.0).astype(im_out.dtype)
+    ic_out[:] = jnp.where(live, icount, 0).astype(ic_out.dtype)
+    live_out[:] = live
+
+
+def _per_row_bytes(n: int, hb_size: int, fd_size: int) -> int:
+    """Double-buffered VMEM bytes per block row: inputs hb + hb0 +
+    last_change (heartbeat dtype) and imean (fd dtype) and icount
+    (int16); outputs last_change + imean + icount and the bool live
+    output — whose VMEM block Mosaic holds as s32 (4 B/elem; observed in
+    the compiled custom-call layout), even though its HBM form is 1 B."""
+    inputs = 3 * hb_size + fd_size + 2
+    outputs = hb_size + fd_size + 2 + 4
+    return 2 * (inputs + outputs) * n
+
+
+def _pick_block(n: int, hb_size: int, fd_size: int) -> int | None:
+    """Largest multiple-of-8 divisor of n whose double-buffered block set
+    fits the VMEM budget at the given element sizes (required — the
+    compact int16/bfloat16 and default int32/float32 profiles differ
+    ~1.9x in footprint, so there is no safe default)."""
+    return largest_fitting_block(n, _per_row_bytes(n, hb_size, fd_size))
+
+
+def supported(n: int, hb_size: int, fd_size: int) -> bool:
+    """Whether the streaming FD kernel can run this shape and dtype mix
+    (callers fall back to the XLA block when not). Lane-aligned columns
+    keep the padded memref whole-tile (as in pallas_pull.supported)."""
+    return n % 128 == 0 and _pick_block(n, hb_size, fd_size) is not None
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_interval",
+        "window",
+        "prior_weight",
+        "prior_mean",
+        "phi_threshold",
+        "interpret",
+    ),
+)
+def fused_fd(
+    tick: jax.Array,
+    hb: jax.Array,
+    hb0: jax.Array,
+    last_change: jax.Array,
+    imean: jax.Array,
+    icount: jax.Array,
+    *,
+    max_interval: float,
+    window: int,
+    prior_weight: float,
+    prior_mean: float,
+    phi_threshold: float,
+    interpret: bool = False,
+):
+    """One streaming FD pass. Returns (last_change', imean', icount',
+    live'). Inputs are the post-exchange and round-start heartbeat
+    matrices plus the FD bookkeeping; constants come from SimConfig."""
+    n = hb.shape[0]
+    block = _pick_block(n, hb.dtype.itemsize, imean.dtype.itemsize)
+    if block is None or n % 128 != 0:
+        raise ValueError(f"no suitable row block for n={n}")
+    spec = pl.BlockSpec((block, n), lambda i, *_: (i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // block,),
+        in_specs=[spec] * 5,
+        out_specs=[spec] * 4,
+    )
+    kernel = functools.partial(
+        _fd_kernel,
+        block=block,
+        max_interval=float(max_interval),
+        window=int(window),
+        prior_weight=float(prior_weight),
+        prior_mean=float(prior_mean),
+        phi_threshold=float(phi_threshold),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(last_change.shape, last_change.dtype),
+            jax.ShapeDtypeStruct(imean.shape, imean.dtype),
+            jax.ShapeDtypeStruct(icount.shape, icount.dtype),
+            jax.ShapeDtypeStruct(hb.shape, jnp.bool_),
+        ],
+        # In-place bookkeeping: each block of last_change/imean/icount is
+        # read exactly once before its updated block is written, so the
+        # outputs can alias the inputs. Without this, every round pays
+        # three (N, N) copies re-homing the results into the fori_loop
+        # carry buffers (~2 ms each at 10k on a v5e — the dominant FD
+        # cost, found via the compiled HLO's copy instructions). Indices
+        # are over the flattened operand list: 0 = the scalar-prefetch
+        # tick, then hb, hb0, last_change (3), imean (4), icount (5).
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=interpret,
+    )(
+        jnp.reshape(tick.astype(jnp.int32), (1,)),
+        hb,
+        hb0,
+        last_change,
+        imean,
+        icount,
+    )

@@ -49,16 +49,29 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _dither(rows: jax.Array, owners: jax.Array, salt, run_salt) -> jax.Array:
-    """Same hash as gossip._hash_uniform, on explicit index grids."""
-    i = rows.astype(jnp.uint32)
-    j = owners.astype(jnp.uint32)
+def _dither_base(shape, salt, run_salt) -> tuple[jax.Array, jax.Array]:
+    """The group-invariant parts of gossip._hash_uniform's input mix,
+    computed ONCE per kernel invocation and shared by every group (the
+    uint32 multiplies are the expensive part of the hash on the VPU):
+    ``r_k1 = r * K1`` for within-group row r, and ``js = j * K2 ^ s *
+    K3`` for global column j. They stay separate because the global-row
+    term folds in by ADDITION (``(row0 + r) * K1 = row0 * K1 + r * K1``
+    mod 2^32) which does not distribute over the xor with ``js``."""
     s = salt.astype(jnp.uint32) ^ run_salt.astype(jnp.uint32)
-    h = (
-        i * jnp.uint32(0x9E3779B1)
-        ^ j * jnp.uint32(0x85EBCA77)
-        ^ s * jnp.uint32(0xC2B2AE3D)
+    i = lax.broadcasted_iota(jnp.uint32, shape, 0)
+    j = lax.broadcasted_iota(jnp.uint32, shape, 1)
+    return (
+        i * jnp.uint32(0x9E3779B1),
+        j * jnp.uint32(0x85EBCA77) ^ s * jnp.uint32(0xC2B2AE3D),
     )
+
+
+def _dither(r_k1: jax.Array, js: jax.Array, row0: jax.Array) -> jax.Array:
+    """Same bits as gossip._hash_uniform for rows ``row0..row0+7``: one
+    wrapping add + one xor per element recovers the full input mix from
+    the precomputed parts; the avalanche + 24-bit mapping run per
+    element as in the XLA path."""
+    h = (r_k1 + row0.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)) ^ js
     h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
     h = h ^ (h >> 13)
     # Top 24 bits through int32: Mosaic has no uint32->float32 cast, and
@@ -68,14 +81,14 @@ def _dither(rows: jax.Array, owners: jax.Array, salt, run_salt) -> jax.Array:
     return jnp.clip(u, 1e-12, 1.0 - 2.0**-24)
 
 
-def _advance(w_self32, w_peer32, valid_col, budget, rows, owners, salt, run_salt):
+def _advance(w_self32, w_peer32, valid_col, budget, r_k1, js, row0):
     """gossip._budgeted_advance, proportional policy, in int32/f32."""
     d = jnp.maximum(w_peer32 - w_self32, 0) * valid_col
     total = jnp.sum(d.astype(jnp.float32), axis=1, keepdims=True)
     scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
     x = d.astype(jnp.float32) * scale
     floor = jnp.floor(x)
-    bump = _dither(rows, owners, salt, run_salt) < (x - floor)
+    bump = _dither(r_k1, js, row0) < (x - floor)
     return jnp.minimum(floor.astype(jnp.int32) + bump, d)
 
 
@@ -135,8 +148,7 @@ def _m8_kernel(
     salt = meta_ref[0]
     run_salt = meta_ref[1]
     budget = meta_ref[2].astype(jnp.float32)
-    owners = lax.broadcasted_iota(jnp.int32, (8, n), 1)
-    row_iota = lax.broadcasted_iota(jnp.int32, (8, n), 0)
+    r_k1, js = _dither_base((8, n), salt, run_salt)
 
     # Per 8-row group: wait for its DMA just-in-time (later groups'
     # copies keep streaming behind this group's compute), rotate the
@@ -147,13 +159,11 @@ def _m8_kernel(
         wait(g, 0)
         sl = slice(g * 8, (g + 1) * 8)
         cg = c_ref[g0 + g]
-        rows = (pl.program_id(0) * block + g * 8) + row_iota
+        row0 = pl.program_id(0) * block + g * 8
         vcol = valid_ref[sl, :].astype(jnp.int32)  # (8, 1)
         w_self = w_ref[sl, :].astype(jnp.int32)
         w_peer = pltpu.roll(wp[sl, :].astype(jnp.int32), cg, 0)
-        adv = _advance(
-            w_self, w_peer, vcol, budget, rows, owners, salt, run_salt
-        )
+        adv = _advance(w_self, w_peer, vcol, budget, r_k1, js, row0)
         wout_ref[sl, :] = (w_self + adv).astype(wout_ref.dtype)
         if track_hb:
             hb_self = hb_ref[sl, :].astype(jnp.int32)
@@ -174,18 +184,24 @@ def _buffers(track_hb: bool) -> int:
     return 10 if track_hb else 5
 
 
-def _pick_block(
-    n: int, itemsize: int = 4, cap: int = 512, track_hb: bool = True
-) -> int | None:
-    """Largest multiple-of-8 divisor of n such that every VMEM-resident
-    buffer set fits the per-core budget."""
-    per_row = _buffers(track_hb) * n * itemsize
-    limit = min(cap, VMEM_BUDGET // max(per_row, 1))
+def largest_fitting_block(n: int, per_row_bytes: int, cap: int = 512) -> int | None:
+    """Largest multiple-of-8 divisor of n whose row count times
+    ``per_row_bytes`` fits the VMEM budget. Shared block-search scaffold
+    for every streaming kernel (this one and pallas_fd)."""
+    limit = min(cap, VMEM_BUDGET // max(per_row_bytes, 1))
     best = None
     for b in range(8, limit + 1, 8):
         if n % b == 0:
             best = b
     return best
+
+
+def _pick_block(
+    n: int, itemsize: int = 4, cap: int = 512, track_hb: bool = True
+) -> int | None:
+    """Largest multiple-of-8 divisor of n such that every VMEM-resident
+    buffer set fits the per-core budget."""
+    return largest_fitting_block(n, _buffers(track_hb) * n * itemsize, cap)
 
 
 def supported(n: int, itemsize: int, track_hb: bool = True) -> bool:

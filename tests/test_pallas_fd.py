@@ -1,0 +1,159 @@
+"""Streaming Pallas FD kernel: exact parity with the XLA block.
+
+Runs in interpreter mode on CPU (tests/conftest.py forces the CPU
+platform); the compiled path is exercised on real TPU by bench.py.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import random
+
+from aiocluster_tpu.ops.pallas_fd import _pick_block, fused_fd, supported
+
+
+def _xla_fd(tick, hb, hb0, lc, im, ic, cfg):
+    """The FD block of ops/gossip.py::sim_step, extracted verbatim
+    (minus the lifecycle branch, which the kernel never handles)."""
+    increased = hb > hb0
+    never_seen = lc == 0
+    interval = (tick - lc).astype(jnp.float32)
+    sampled = increased & ~never_seen & (interval <= cfg.max_interval_ticks)
+    icount = jnp.minimum(
+        ic + sampled.astype(jnp.int16), jnp.int16(cfg.window_ticks)
+    )
+    mean_f32 = im.astype(jnp.float32)
+    denom = jnp.maximum(icount.astype(jnp.float32), 1.0)
+    imean = jnp.where(sampled, mean_f32 + (interval - mean_f32) / denom, mean_f32)
+    last_change = jnp.where(increased, tick.astype(lc.dtype), lc)
+    count_f32 = icount.astype(jnp.float32)
+    elapsed = (tick - last_change).astype(jnp.float32)
+    live = (icount >= 1) & (
+        elapsed * (count_f32 + cfg.prior_weight)
+        <= cfg.phi_threshold
+        * (imean * count_f32 + cfg.prior_weight * cfg.prior_mean_ticks)
+    )
+    n = hb.shape[0]
+    live = live | (jnp.arange(n)[:, None] == jnp.arange(n)[None, :])
+    imean = jnp.where(live, imean, 0.0).astype(im.dtype)
+    icount = jnp.where(live, icount, jnp.int16(0))
+    return last_change, imean, icount, live
+
+
+def test_fused_fd_matches_xla_block():
+    from aiocluster_tpu.sim import SimConfig
+
+    cfg = SimConfig(n_nodes=128, keys_per_node=4)
+    n = cfg.n_nodes
+    k1, k2, k3, k4, k5 = random.split(random.key(0), 5)
+    tick = jnp.asarray(37, jnp.int32)
+    # Exercise every branch: fresh (lc=0), stale (interval > max), at the
+    # window cap, recently-alive, long-dead.
+    hb0 = random.randint(k1, (n, n), 0, 30).astype(jnp.int16)
+    hb = hb0 + random.randint(k2, (n, n), 0, 2).astype(jnp.int16)
+    lc = random.randint(k3, (n, n), 0, 37).astype(jnp.int16)
+    im = (random.uniform(k4, (n, n)) * 6).astype(jnp.bfloat16)
+    ic = random.randint(k5, (n, n), 0, cfg.window_ticks + 1).astype(jnp.int16)
+
+    got = fused_fd(
+        tick, hb, hb0, lc, im, ic,
+        max_interval=cfg.max_interval_ticks,
+        window=cfg.window_ticks,
+        prior_weight=cfg.prior_weight,
+        prior_mean=cfg.prior_mean_ticks,
+        phi_threshold=cfg.phi_threshold,
+        interpret=True,
+    )
+    want = _xla_fd(tick, hb, hb0, lc, im, ic, cfg)
+    for g, w, name in zip(got, want, ("last_change", "imean", "icount", "live")):
+        assert g.dtype == w.dtype, name
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_sim_step_fd_state_matches_xla():
+    """Flipping use_pallas must not change FD bookkeeping either — the
+    full-fidelity trajectory (watermarks AND all four FD outputs) is
+    bit-identical, churn included."""
+    from aiocluster_tpu.ops.gossip import pallas_fd_engaged, sim_step
+    from aiocluster_tpu.sim import SimConfig, init_state
+
+    base = dict(n_nodes=128, keys_per_node=6, budget=24,
+                death_rate=0.08, revival_rate=0.2)
+    cfg_x = SimConfig(**base)
+    cfg_p = SimConfig(**base, use_pallas=True)
+    assert pallas_fd_engaged(cfg_p) and not pallas_fd_engaged(cfg_x)
+    sx, sp = init_state(cfg_x), init_state(cfg_p)
+    key = random.key(11)
+    for _ in range(8):
+        sx = sim_step(sx, key, cfg_x)
+        sp = sim_step(sp, key, cfg_p)
+    for field in ("w", "hb_known", "last_change", "imean", "icount", "live_view"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sp, field)),
+            np.asarray(getattr(sx, field)),
+            err_msg=field,
+        )
+
+
+def test_fd_kernel_gate():
+    """Lifecycle configs and off-domain shapes stay on the XLA block."""
+    from aiocluster_tpu.ops.gossip import pallas_fd_engaged
+    from aiocluster_tpu.sim import SimConfig
+
+    assert pallas_fd_engaged(SimConfig(n_nodes=128, use_pallas=True))
+    assert not pallas_fd_engaged(
+        SimConfig(n_nodes=128, use_pallas=True, dead_grace_ticks=20)
+    )
+    assert not pallas_fd_engaged(SimConfig(n_nodes=100, use_pallas=True))
+    assert not pallas_fd_engaged(
+        SimConfig(n_nodes=128, use_pallas=True, track_failure_detector=False,
+                  peer_mode="alive")
+    )
+    assert not pallas_fd_engaged(
+        SimConfig(n_nodes=128, use_pallas=True), axis_name="owners"
+    )
+
+
+def test_pick_block_fits_vmem():
+    from aiocluster_tpu.ops.pallas_fd import _per_row_bytes
+    from aiocluster_tpu.ops.pallas_pull import VMEM_BUDGET
+
+    # Wide (default int32/float32) and compact (int16/bfloat16) dtype
+    # mixes must both produce blocks that fit — the estimate must track
+    # the element sizes, not assume the compact profile.
+    for hb_size, fd_size in ((4, 4), (2, 2), (4, 2)):
+        for n in (128, 2048, 10_240, 16_384):
+            b = _pick_block(n, hb_size, fd_size)
+            assert b is not None and n % b == 0 and b % 8 == 0
+            assert _per_row_bytes(n, hb_size, fd_size) * b <= VMEM_BUDGET
+    assert supported(128, 4, 4)
+    assert not supported(100, 2, 2)
+
+
+def test_fused_fd_wide_dtypes_match_xla():
+    """Default-profile dtypes (int32 heartbeats, float32 FD) through the
+    kernel — the dtype mix the VMEM sizing must survive on hardware."""
+    from aiocluster_tpu.sim import SimConfig
+
+    cfg = SimConfig(n_nodes=128, keys_per_node=4)
+    n = cfg.n_nodes
+    k1, k2, k3, k4, k5 = random.split(random.key(7), 5)
+    tick = jnp.asarray(21, jnp.int32)
+    hb0 = random.randint(k1, (n, n), 0, 20).astype(jnp.int32)
+    hb = hb0 + random.randint(k2, (n, n), 0, 2).astype(jnp.int32)
+    lc = random.randint(k3, (n, n), 0, 21).astype(jnp.int32)
+    im = (random.uniform(k4, (n, n)) * 6).astype(jnp.float32)
+    ic = random.randint(k5, (n, n), 0, 50).astype(jnp.int16)
+    got = fused_fd(
+        tick, hb, hb0, lc, im, ic,
+        max_interval=cfg.max_interval_ticks,
+        window=cfg.window_ticks,
+        prior_weight=cfg.prior_weight,
+        prior_mean=cfg.prior_mean_ticks,
+        phi_threshold=cfg.phi_threshold,
+        interpret=True,
+    )
+    want = _xla_fd(tick, hb, hb0, lc, im, ic, cfg)
+    for g, w, name in zip(got, want, ("last_change", "imean", "icount", "live")):
+        assert g.dtype == w.dtype, name
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
