@@ -46,6 +46,15 @@ def pytest_pyfunc_call(pyfuncitem):
     return None
 
 
+async def wait_for(predicate, timeout: float = 2.0):
+    """Poll-until-true with a hard deadline — the reference's test seam
+    for loopback-cluster assertions (SURVEY.md §4). Shared by every
+    socket-backend test (``from conftest import wait_for``)."""
+    async with asyncio.timeout(timeout):
+        while not predicate():
+            await asyncio.sleep(0.02)
+
+
 @pytest.fixture
 def free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:

@@ -4,6 +4,8 @@ tests/test_integration.py + tests/test_basic.py coverage, rebuilt)."""
 
 import asyncio
 
+from conftest import wait_for
+
 from aiocluster_tpu import Cluster, Config, NodeId
 
 
@@ -15,12 +17,6 @@ def make_config(name: str, port: int, seed_ports: list[int], **kwargs) -> Config
         cluster_id="itest",
         **kwargs,
     )
-
-
-async def wait_for(predicate, timeout: float = 2.0):
-    async with asyncio.timeout(timeout):
-        while not predicate():
-            await asyncio.sleep(0.01)
 
 
 async def test_two_nodes_replicate_keys(free_port_factory):

@@ -12,10 +12,10 @@ Skipped cleanly if the reference package can't import in this
 environment (it targets Python 3.13+; it happens to run on 3.12).
 """
 
-import asyncio
 import sys
 
 import pytest
+from conftest import wait_for
 
 _REF_PATH = "/root/reference"
 _REF_IMPORT_ERROR = ""
@@ -57,10 +57,15 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-async def _wait_for(predicate, timeout: float = 8.0):
-    async with asyncio.timeout(timeout):
-        while not predicate():
-            await asyncio.sleep(0.02)
+def _sees(node_states, node_name: str, key: str, expected: str) -> bool:
+    """True when ``node_states`` (a NodeId -> NodeState snapshot mapping,
+    either implementation's) holds a replica of ``node_name`` whose
+    ``key`` equals ``expected``. Both implementations return a
+    VersionedValue (ours a frozen dataclass, the reference's its own) —
+    ``.value`` reads the payload on either."""
+    ns = next((s for n, s in node_states.items() if n.name == node_name), None)
+    vv = ns.get(key) if ns is not None else None
+    return vv is not None and vv.value == expected
 
 
 async def test_ours_and_reference_replicate_both_ways(free_port_factory):
@@ -90,54 +95,36 @@ async def test_ours_and_reference_replicate_both_ways(free_port_factory):
     )
 
     async with ref, ours:
-        # Our replica of the reference node's keyspace.
-        def ours_sees_ref():
-            snap = ours.snapshot()
-            ns = next(
-                (s for n, s in snap.node_states.items() if n.name == "refnode"),
-                None,
-            )
-            vv = ns.get("from-ref") if ns is not None else None
-            return vv is not None and vv.value == "hello"
-
-        # The reference's replica of ours.
-        def ref_sees_ours():
-            snap = ref.snapshot()
-            ns = next(
-                (
-                    s
-                    for n, s in snap.node_states.items()
-                    if n.name == "ournode"
-                ),
-                None,
-            )
-            value = ns.get("from-ours") if ns is not None else None
-            # reference NodeState.get returns a VersionedValue or None
-            return value is not None and getattr(value, "value", value) == "world"
-
-        await _wait_for(ours_sees_ref)
-        await _wait_for(ref_sees_ours)
+        # Replication both ways: our replica of the reference node's
+        # keyspace, and the reference's replica of ours.
+        await wait_for(
+            lambda: _sees(
+                ours.snapshot().node_states, "refnode", "from-ref", "hello"
+            ),
+            timeout=8.0,
+        )
+        await wait_for(
+            lambda: _sees(
+                ref.snapshot().node_states, "ournode", "from-ours", "world"
+            ),
+            timeout=8.0,
+        )
 
         # Liveness both ways (heartbeats ride the digests).
-        await _wait_for(
-            lambda: any(n.name == "refnode" for n in ours.snapshot().live_nodes)
+        await wait_for(
+            lambda: any(n.name == "refnode" for n in ours.snapshot().live_nodes),
+            timeout=8.0,
         )
-        await _wait_for(
-            lambda: any(n.name == "ournode" for n in ref.live_nodes())
+        await wait_for(
+            lambda: any(n.name == "ournode" for n in ref.live_nodes()),
+            timeout=8.0,
         )
 
         # A LIVE write after boot propagates across implementations too.
         ours.set("late-key", "late-value")
-        def ref_sees_late():
-            ns = next(
-                (
-                    s
-                    for n, s in ref.snapshot().node_states.items()
-                    if n.name == "ournode"
-                ),
-                None,
-            )
-            v = ns.get("late-key") if ns is not None else None
-            return v is not None and getattr(v, "value", v) == "late-value"
-
-        await _wait_for(ref_sees_late)
+        await wait_for(
+            lambda: _sees(
+                ref.snapshot().node_states, "ournode", "late-key", "late-value"
+            ),
+            timeout=8.0,
+        )
