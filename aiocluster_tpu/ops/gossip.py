@@ -371,6 +371,10 @@ def pallas_path_engaged(
         _pallas_wanted(cfg)
         and not has_topology  # adjacency runs force the choice path
         and cfg.pairing == "matching"
+        # fanout >= 1 so the round's first kernel call exists to carry
+        # the owner-diagonal refresh (a fanout=0 round must still
+        # refresh diagonals, which the XLA path does unconditionally).
+        and cfg.fanout >= 1
         and cfg.n_nodes % 128 == 0
         and axis_name is None
         and cfg.budget_policy == "proportional"
@@ -436,26 +440,36 @@ def sim_step(
     heartbeat = state.heartbeat + alive.astype(jnp.int32)
     max_version = state.max_version + cfg.writes_per_round * alive.astype(jnp.int32)
 
-    # Owner diagonal refresh as a broadcast-iota select, NOT a scatter:
-    # w[j_owner, j] = max_version[j_owner]. The where is elementwise, so
-    # XLA fuses it into the adjacent passes; the equivalent
-    # ``w.at[owners, cols].set(...)`` lowers to a scatter that costs a
-    # full serialized pass over both matrices (~5 ms/round at 10k on a
-    # v5e — measured, round 2).
-    diag = jnp.arange(n, dtype=jnp.int32)[:, None] == owners[None, :]
-    w = jnp.where(
-        diag, max_version[owners][None, :].astype(state.w.dtype), state.w
-    )
+    # Owner diagonal refresh: w[j_owner, j] = max_version[j_owner] (and
+    # the heartbeat analogue). On the fused-kernel path the refresh rides
+    # the round's FIRST pull kernel and the FD kernel re-derives hb0's
+    # diagonal, so nothing is materialized. Elsewhere it is a
+    # broadcast-iota select, NOT a scatter: the where is elementwise, so
+    # XLA fuses it into the adjacent passes, while the equivalent
+    # ``w.at[owners, cols].set(...)`` lowers to a scatter costing a full
+    # serialized pass over both matrices (~5 ms/round at 10k on a v5e —
+    # measured, round 2).
     track_hb = cfg.track_heartbeats
-    hb = (
-        jnp.where(
-            diag,
-            heartbeat[owners][None, :].astype(state.hb_known.dtype),
-            state.hb_known,
-        )
-        if track_hb
-        else state.hb_known
+    mv_vec = max_version[owners]
+    hbv_vec = heartbeat[owners]
+    use_pallas = pallas_path_engaged(
+        cfg, axis_name, has_topology=adjacency is not None
     )
+    if use_pallas:
+        diag = None
+        w, hb = state.w, state.hb_known
+    else:
+        diag = jnp.arange(n, dtype=jnp.int32)[:, None] == owners[None, :]
+        w = jnp.where(diag, mv_vec[None, :].astype(state.w.dtype), state.w)
+        hb = (
+            jnp.where(
+                diag,
+                hbv_vec[None, :].astype(state.hb_known.dtype),
+                state.hb_known,
+            )
+            if track_hb
+            else state.hb_known
+        )
     hb_round_start = hb
 
     # Scheduled-for-deletion mask from the PRE-round belief (the reference
@@ -497,7 +511,6 @@ def sim_step(
         # self-matched group's only involution rotations are 0 and 4,
         # which disconnect the pairs) matching stays unrestricted.
         grouped = cfg.pairing == "matching" and n % 128 == 0
-        use_pallas = pallas_path_engaged(cfg, axis_name)
         # Interpreter mode off-TPU so the same config runs (slowly) in
         # CPU tests; the axon platform is a TPU PJRT plugin.
         interpret = not on_accelerator()
@@ -527,10 +540,15 @@ def sim_step(
                     p = _random_matching(ck, n)
                 inv = p
             if use_pallas:
+                # The first sub-exchange carries the diagonal refresh
+                # (later ones see it in w/hb themselves).
+                first = c == 0
                 pulled = pallas_pull.fused_pull_m8(
                     w, hb if track_hb else None, gm8, c8,
                     alive & alive[p], sub_salt(c, 0), run_salt,
                     cfg.budget, interpret=interpret,
+                    mv=mv_vec if first else None,
+                    hbv=hbv_vec if first and track_hb else None,
                 )
                 w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
@@ -599,6 +617,7 @@ def sim_step(
             tick,
             hb,
             hb_round_start,
+            hbv_vec,
             state.last_change,
             state.imean,
             state.icount,
@@ -611,6 +630,14 @@ def sim_step(
         )
         dead_since = state.dead_since
     elif cfg.track_failure_detector:
+        if diag is None:
+            # The pull kernel carried the diagonal refresh, so the saved
+            # round-start matrix is missing it — re-derive here (the
+            # where fuses into this block's elementwise chain).
+            diag = jnp.arange(n, dtype=jnp.int32)[:, None] == owners[None, :]
+            hb_round_start = jnp.where(
+                diag, hbv_vec[None, :].astype(hb.dtype), hb_round_start
+            )
         increased = hb > hb_round_start
         never_seen = state.last_change == 0
         interval = (tick - state.last_change).astype(jnp.float32)

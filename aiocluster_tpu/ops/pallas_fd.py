@@ -39,6 +39,7 @@ def _fd_kernel(
     tick_ref,  # scalar prefetch: (1,) int32 — this round's tick
     hb_ref,  # (block, n) heartbeat_dtype — post-exchange hb knowledge
     hb0_ref,  # (block, n) heartbeat_dtype — round-start hb knowledge
+    hbv_ref,  # (1, n) int32 — owner heartbeats (diagonal refresh of hb0)
     lc_ref,  # (block, n) heartbeat_dtype — tick of last observed increase
     im_ref,  # (block, n) fd_dtype — running interval mean
     ic_ref,  # (block, n) int16 — interval sample count
@@ -55,8 +56,16 @@ def _fd_kernel(
     phi_threshold: float,
 ):
     tick = tick_ref[0]
+    shape = hb_ref.shape
+    rows = pl.program_id(0) * block + lax.broadcasted_iota(jnp.int32, shape, 0)
+    cols = lax.broadcasted_iota(jnp.int32, shape, 1)
+    diag = rows == cols
     hb = hb_ref[:].astype(jnp.int32)
-    hb0 = hb0_ref[:].astype(jnp.int32)
+    # Round-start knowledge carries the round's owner-diagonal refresh
+    # (hb0[i, i] = heartbeat[i]); applied here from the broadcast row so
+    # the caller never materializes a diagonal-select pass. Idempotent
+    # when the caller already applied it (the XLA pull path does).
+    hb0 = jnp.where(diag, hbv_ref[:], hb0_ref[:].astype(jnp.int32))
     lc = lc_ref[:].astype(jnp.int32)
     increased = hb > hb0
     never_seen = lc == 0
@@ -79,9 +88,7 @@ def _fd_kernel(
         <= phi_threshold * (imean * count_f32 + prior_weight * prior_mean)
     )
     # Self-belief diagonal (single-device: global row == global column).
-    shape = live.shape
-    rows = pl.program_id(0) * block + lax.broadcasted_iota(jnp.int32, shape, 0)
-    live = live | (rows == lax.broadcasted_iota(jnp.int32, shape, 1))
+    live = live | diag
     # Death wipes the window (re-earn liveness with fresh samples).
     lc_out[:] = lc2.astype(lc_out.dtype)
     im_out[:] = jnp.where(live, imean, 0.0).astype(im_out.dtype)
@@ -130,6 +137,7 @@ def fused_fd(
     tick: jax.Array,
     hb: jax.Array,
     hb0: jax.Array,
+    hbv: jax.Array,
     last_change: jax.Array,
     imean: jax.Array,
     icount: jax.Array,
@@ -143,16 +151,18 @@ def fused_fd(
 ):
     """One streaming FD pass. Returns (last_change', imean', icount',
     live'). Inputs are the post-exchange and round-start heartbeat
-    matrices plus the FD bookkeeping; constants come from SimConfig."""
+    matrices, the (N,) owner-heartbeat vector (hb0's diagonal refresh —
+    see _fd_kernel), and the FD bookkeeping; constants from SimConfig."""
     n = hb.shape[0]
     block = _pick_block(n, hb.dtype.itemsize, imean.dtype.itemsize)
     if block is None or n % 128 != 0:
         raise ValueError(f"no suitable row block for n={n}")
     spec = pl.BlockSpec((block, n), lambda i, *_: (i, 0))
+    vec_spec = pl.BlockSpec((1, n), lambda i, *_: (0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n // block,),
-        in_specs=[spec] * 5,
+        in_specs=[spec, spec, vec_spec, spec, spec, spec],
         out_specs=[spec] * 4,
     )
     kernel = functools.partial(
@@ -180,13 +190,14 @@ def fused_fd(
         # carry buffers (~2 ms each at 10k on a v5e — the dominant FD
         # cost, found via the compiled HLO's copy instructions). Indices
         # are over the flattened operand list: 0 = the scalar-prefetch
-        # tick, then hb, hb0, last_change (3), imean (4), icount (5).
-        input_output_aliases={3: 0, 4: 1, 5: 2},
+        # tick, then hb, hb0, hbv, last_change (4), imean (5), icount (6).
+        input_output_aliases={4: 0, 5: 1, 6: 2},
         interpret=interpret,
     )(
         jnp.reshape(tick.astype(jnp.int32), (1,)),
         hb,
         hb0,
+        hbv.astype(jnp.int32)[None, :],
         last_change,
         imean,
         icount,

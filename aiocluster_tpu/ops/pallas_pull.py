@@ -101,6 +101,8 @@ def _m8_kernel(
     w_ref,
     hb_ref,
     valid_ref,  # (block, 1) int8 alive-pair mask per row
+    mv_ref,  # (1, n) int32 owner max_version (diag refresh; dummy if off)
+    hbv_ref,  # (1, n) int32 owner heartbeat (diag refresh; dummy if off)
     # HBM gather sources
     w_hbm,
     hb_hbm,
@@ -115,6 +117,7 @@ def _m8_kernel(
     block: int,
     n: int,
     track_hb: bool,
+    apply_diag: bool,
 ):
     gpb = block // 8  # groups per block
     g0 = pl.program_id(0) * gpb
@@ -149,6 +152,8 @@ def _m8_kernel(
     run_salt = meta_ref[1]
     budget = meta_ref[2].astype(jnp.float32)
     r_k1, js = _dither_base((8, n), salt, run_salt)
+    col = lax.broadcasted_iota(jnp.int32, (8, n), 1)
+    r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
 
     # Per 8-row group: wait for its DMA just-in-time (later groups'
     # copies keep streaming behind this group's compute), rotate the
@@ -163,11 +168,27 @@ def _m8_kernel(
         vcol = valid_ref[sl, :].astype(jnp.int32)  # (8, 1)
         w_self = w_ref[sl, :].astype(jnp.int32)
         w_peer = pltpu.roll(wp[sl, :].astype(jnp.int32), cg, 0)
+        if apply_diag:
+            # Owner diagonal refresh, applied in VMEM instead of as a
+            # separate materialized pass over HBM (the first sub-exchange
+            # of the round carries it): at any (row, col=c) the diagonal
+            # value IS mv[c], so one broadcast row + a column-iota
+            # compare fixes the self tile; the peer tile's rows are
+            # global rows 8*gm + (r - c) % 8, fixed the same way.
+            self_rows = row0 + r8
+            peer_rows = 8 * gm_ref[g0 + g] + ((r8 + 8 - cg) & 7)
+            mv_b = mv_ref[:]
+            w_self = jnp.where(col == self_rows, mv_b, w_self)
+            w_peer = jnp.where(col == peer_rows, mv_b, w_peer)
         adv = _advance(w_self, w_peer, vcol, budget, r_k1, js, row0)
         wout_ref[sl, :] = (w_self + adv).astype(wout_ref.dtype)
         if track_hb:
             hb_self = hb_ref[sl, :].astype(jnp.int32)
             hb_peer = pltpu.roll(hbp[sl, :].astype(jnp.int32), cg, 0)
+            if apply_diag:
+                hbv_b = hbv_ref[:]
+                hb_self = jnp.where(col == self_rows, hbv_b, hb_self)
+                hb_peer = jnp.where(col == peer_rows, hbv_b, hb_peer)
             hbout_ref[sl, :] = jnp.maximum(hb_self, hb_peer * vcol).astype(
                 hbout_ref.dtype
             )
@@ -225,6 +246,8 @@ def fused_pull_m8(
     run_salt: jax.Array,
     budget: int,
     interpret: bool = False,
+    mv: jax.Array | None = None,
+    hbv: jax.Array | None = None,
 ):
     """One fused grouped-matching sub-exchange. Returns (w', hb'), or
     just w' when ``hb`` is None (the lean convergence-only profile: no
@@ -232,9 +255,17 @@ def fused_pull_m8(
     row blocks).
 
     ``gm``/``c`` come from gossip._grouped_matching; ``valid`` is the
-    per-row alive-pair mask (alive & alive[p]).
+    per-row alive-pair mask (alive & alive[p]). Passing ``mv`` (owner
+    max_version, (N,) int32; plus ``hbv``, owner heartbeats, when hb is
+    tracked) folds the round's owner-diagonal refresh into this call —
+    the caller must then NOT pre-apply the diagonal select, and should
+    pass the vectors only on the round's FIRST sub-exchange (later ones
+    see the refreshed diagonal in w itself).
     """
     track_hb = hb is not None
+    apply_diag = mv is not None
+    if apply_diag and track_hb and hbv is None:
+        raise ValueError("hbv required when mv is given and hb is tracked")
     n = w.shape[0]
     itemsize = w.dtype.itemsize
     if track_hb:
@@ -258,6 +289,21 @@ def fused_pull_m8(
             jnp.asarray(budget, jnp.int32),
         ]
     )
+    if apply_diag:
+        mv = mv.astype(jnp.int32)[None, :]
+        hbv = (
+            hbv.astype(jnp.int32)[None, :]
+            if track_hb
+            else jnp.zeros((1, 128), jnp.int32)
+        )
+        vec_spec = pl.BlockSpec((1, n), lambda i, *_: (0, 0))
+        hbv_spec = vec_spec if track_hb else pl.BlockSpec(
+            (1, 128), lambda i, *_: (0, 0)
+        )
+    else:
+        mv = jnp.zeros((1, 128), jnp.int32)
+        hbv = jnp.zeros((1, 128), jnp.int32)
+        vec_spec = hbv_spec = pl.BlockSpec((1, 128), lambda i, *_: (0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(n // block,),
@@ -265,6 +311,8 @@ def fused_pull_m8(
             pl.BlockSpec((block, n), lambda i, *_: (i, 0)),  # w block
             hb_spec,  # hb block (dummy tile when lean)
             pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),  # valid col
+            vec_spec,  # mv row (dummy tile when diag off)
+            hbv_spec,  # heartbeat row (dummy tile when diag off / lean)
             pl.BlockSpec(memory_space=pl.ANY),  # w HBM (gather source)
             pl.BlockSpec(memory_space=pl.ANY),  # hb HBM
         ],
@@ -279,7 +327,7 @@ def fused_pull_m8(
         ],
     )
     kernel = functools.partial(
-        _m8_kernel, block=block, n=n, track_hb=track_hb
+        _m8_kernel, block=block, n=n, track_hb=track_hb, apply_diag=apply_diag
     )
     w_new, hb_new = pl.pallas_call(
         kernel,
@@ -296,6 +344,8 @@ def fused_pull_m8(
         w,
         hb,
         valid.astype(jnp.int8)[:, None],
+        mv,
+        hbv,
         w,
         hb,
     )

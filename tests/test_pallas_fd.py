@@ -55,8 +55,10 @@ def test_fused_fd_matches_xla_block():
     im = (random.uniform(k4, (n, n)) * 6).astype(jnp.bfloat16)
     ic = random.randint(k5, (n, n), 0, cfg.window_ticks + 1).astype(jnp.int16)
 
+    # hbv = hb0's own diagonal makes the kernel's diagonal refresh a
+    # no-op, isolating the FD math for the comparison.
     got = fused_fd(
-        tick, hb, hb0, lc, im, ic,
+        tick, hb, hb0, jnp.diagonal(hb0), lc, im, ic,
         max_interval=cfg.max_interval_ticks,
         window=cfg.window_ticks,
         prior_weight=cfg.prior_weight,
@@ -68,6 +70,38 @@ def test_fused_fd_matches_xla_block():
     for g, w, name in zip(got, want, ("last_change", "imean", "icount", "live")):
         assert g.dtype == w.dtype, name
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_fused_fd_refreshes_hb0_diagonal():
+    """The owner-heartbeat vector overrides hb0's diagonal: a stale
+    diagonal plus the current vector must equal passing the refreshed
+    matrix outright (what the XLA pull path materializes)."""
+    from aiocluster_tpu.sim import SimConfig
+
+    cfg = SimConfig(n_nodes=128, keys_per_node=4)
+    n = cfg.n_nodes
+    k1, k2 = random.split(random.key(3), 2)
+    tick = jnp.asarray(9, jnp.int32)
+    hb0_stale = random.randint(k1, (n, n), 0, 8).astype(jnp.int16)
+    hbv = random.randint(k2, (n,), 8, 12).astype(jnp.int32)
+    hb0_fresh = jnp.where(
+        jnp.eye(n, dtype=bool), hbv[None, :].astype(jnp.int16), hb0_stale
+    )
+    hb = jnp.maximum(hb0_fresh, 6).astype(jnp.int16)
+    lc = jnp.ones((n, n), jnp.int16)
+    im = jnp.ones((n, n), jnp.bfloat16)
+    ic = jnp.ones((n, n), jnp.int16)
+    kwargs = dict(
+        max_interval=cfg.max_interval_ticks, window=cfg.window_ticks,
+        prior_weight=cfg.prior_weight, prior_mean=cfg.prior_mean_ticks,
+        phi_threshold=cfg.phi_threshold, interpret=True,
+    )
+    got = fused_fd(tick, hb, hb0_stale, hbv, lc, im, ic, **kwargs)
+    want = fused_fd(
+        tick, hb, hb0_fresh, jnp.diagonal(hb0_fresh), lc, im, ic, **kwargs
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
 
 
 def test_sim_step_fd_state_matches_xla():
@@ -145,7 +179,7 @@ def test_fused_fd_wide_dtypes_match_xla():
     im = (random.uniform(k4, (n, n)) * 6).astype(jnp.float32)
     ic = random.randint(k5, (n, n), 0, 50).astype(jnp.int16)
     got = fused_fd(
-        tick, hb, hb0, lc, im, ic,
+        tick, hb, hb0, jnp.diagonal(hb0), lc, im, ic,
         max_interval=cfg.max_interval_ticks,
         window=cfg.window_ticks,
         prior_weight=cfg.prior_weight,

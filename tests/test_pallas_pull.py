@@ -75,6 +75,47 @@ def test_fused_pull_m8_matches_xla(dtype):
     np.testing.assert_array_equal(np.asarray(hb_k), np.asarray(hb_x))
 
 
+def test_fused_pull_m8_diag_fold_matches_prematerialized():
+    """Passing mv/hbv must equal pre-applying the owner-diagonal select
+    and calling the kernel without them (what the XLA path does)."""
+    n = 128
+    kw, kh, kp, ka, kv = random.split(random.key(8), 5)
+    w = random.randint(kw, (n, n), 0, 40).astype(jnp.int16)
+    hb = random.randint(kh, (n, n), 0, 20).astype(jnp.int16)
+    mv = random.randint(kv, (n,), 40, 50)
+    hbv = random.randint(kv, (n,), 20, 25)
+    gm, c, p = _grouped_matching(kp, n)
+    alive = random.bernoulli(ka, 0.9, (n,))
+    valid = alive & alive[p]
+    salt = jnp.asarray(5, jnp.int32)
+    run_salt = jnp.asarray(0xABC, jnp.uint32)
+
+    eye = jnp.eye(n, dtype=bool)
+    w_fixed = jnp.where(eye, mv[None, :].astype(w.dtype), w)
+    hb_fixed = jnp.where(eye, hbv[None, :].astype(hb.dtype), hb)
+
+    got = fused_pull_m8(
+        w, hb, gm, c, valid, salt, run_salt, budget=40, interpret=True,
+        mv=mv, hbv=hbv,
+    )
+    want = fused_pull_m8(
+        w_fixed, hb_fixed, gm, c, valid, salt, run_salt, budget=40,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+    # Lean (w-only) variant too.
+    got_w = fused_pull_m8(
+        w, None, gm, c, valid, salt, run_salt, budget=40, interpret=True,
+        mv=mv,
+    )
+    want_w = fused_pull_m8(
+        w_fixed, None, gm, c, valid, salt, run_salt, budget=40, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_w), np.asarray(want_w))
+
+
 def test_pick_block_respects_vmem():
     from aiocluster_tpu.ops.pallas_pull import VMEM_BUDGET, _buffers
 
@@ -93,6 +134,18 @@ def test_pick_block_respects_vmem():
     assert not supported(100, 2)
     assert not supported(96, 2)
     assert supported(128, 2)
+
+
+def test_fanout_zero_stays_on_xla():
+    """fanout=0 must not engage the kernel: the round's first kernel
+    call is what carries the owner-diagonal refresh, and with no
+    sub-exchanges the XLA path's unconditional refresh must run."""
+    from aiocluster_tpu.ops.gossip import pallas_path_engaged
+    from aiocluster_tpu.sim import SimConfig
+
+    assert not pallas_path_engaged(
+        SimConfig(n_nodes=128, keys_per_node=4, fanout=0, use_pallas=True)
+    )
 
 
 def test_unsupported_n_falls_back_to_xla():
