@@ -266,6 +266,8 @@ def fused_pull_m8(
     apply_diag = mv is not None
     if apply_diag and track_hb and hbv is None:
         raise ValueError("hbv required when mv is given and hb is tracked")
+    if hbv is not None and not track_hb:
+        raise ValueError("hbv given but no hb matrix to refresh (lean mode)")
     n = w.shape[0]
     itemsize = w.dtype.itemsize
     if track_hb:

@@ -328,11 +328,12 @@ def sim_rounds_per_sec(
     # kernel's measured speedup (VERDICT r1 item 3) without trusting the
     # default gate to have engaged.
     extra: dict = {}
-    from aiocluster_tpu.ops.gossip import pallas_path_engaged
+    from aiocluster_tpu.ops.gossip import pallas_fd_engaged, pallas_path_engaged
 
-    # The exact gate sim_step used: only claim fused-path numbers when
-    # the kernel actually engaged for this run.
+    # The exact gates sim_step used: only claim fused-path numbers when
+    # the kernels actually engaged for this run.
     fused = pallas_path_engaged(cfg)
+    extra["fd_kernel"] = pallas_fd_engaged(cfg)
     if fused:
         try:
             import dataclasses

@@ -316,8 +316,23 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true")
     parser.add_argument("--configs", default="1,2,3,4,5")
+    parser.add_argument(
+        "--platform",
+        choices=("auto", "tpu", "cpu"),
+        default=None,
+        help="as in bench.py; default: cpu when --smoke, else auto",
+    )
     args = parser.parse_args()
     wanted = [int(c) for c in args.configs.split(",")]
+    if any(c != 1 for c in wanted) or args.platform:
+        # Pin the JAX platform BEFORE any sim config touches a device:
+        # in-process backend init retries forever against a down TPU
+        # tunnel (bench.py's round-1 lesson). Config 1 is asyncio-only
+        # and skips this unless --platform is explicit (honoring its
+        # fail-fast contract even when no sim config runs).
+        from bench import resolve_platform
+
+        resolve_platform(args.platform or ("cpu" if args.smoke else "auto"), log)
     for c in wanted:
         log(f"=== config {c} ===")
         start = time.perf_counter()
