@@ -268,6 +268,8 @@ def fused_pull_m8(
         raise ValueError("hbv required when mv is given and hb is tracked")
     if hbv is not None and not track_hb:
         raise ValueError("hbv given but no hb matrix to refresh (lean mode)")
+    if hbv is not None and mv is None:
+        raise ValueError("hbv given without mv: the diagonal refresh is all-or-none")
     n = w.shape[0]
     itemsize = w.dtype.itemsize
     if track_hb:

@@ -1,0 +1,122 @@
+"""One-shot measurement battery for the round-2 continuation session.
+
+Probes the TPU tunnel (subprocess, bounded) in a loop; the first time it
+is reachable, measures the full-fidelity 10,240-node config (fused vs
+XLA), the 32,768-node lean probe, and convergence, then writes
+r02_session2_raw.json next to this file and exits 0. Exits 3 if the
+tunnel never comes up within the deadline.
+
+Builder-side tooling (not part of the shipped package).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+DEADLINE_S = float(os.environ.get("MEASURE_DEADLINE_S", 6 * 3600))
+PROBE_EVERY_S = 240.0
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+
+
+def log(msg: str) -> None:
+    print(f"[measure] {msg}", file=sys.stderr, flush=True)
+
+
+def tunnel_up() -> bool:
+    code = "import jax, jax.numpy as jnp; print(float(jnp.ones((8,8)).sum()))"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "64.0" in proc.stdout
+
+
+def measure() -> dict:
+    import dataclasses
+
+    import numpy as np
+
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    N = 10_240
+    cfg = SimConfig(
+        n_nodes=N, keys_per_node=16, fanout=3, budget=2618,
+        version_dtype="int16", heartbeat_dtype="int16", fd_dtype="bfloat16",
+    )
+
+    def rate(cfg, rounds=128, chunk=16):
+        sim = Simulator(cfg, seed=0, chunk=chunk)
+        sim.run(chunk)
+        int(np.asarray(sim.state.tick))
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sim.run(rounds)
+            int(np.asarray(sim.state.tick))
+            best = max(best, rounds / (time.perf_counter() - t0))
+        return round(best, 2)
+
+    out: dict = {"n_nodes": N, "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    out["full_fused_rounds_per_sec"] = rate(cfg)
+    log(f"full fused: {out['full_fused_rounds_per_sec']}")
+    out["full_xla_rounds_per_sec"] = rate(dataclasses.replace(cfg, use_pallas=False))
+    log(f"full XLA: {out['full_xla_rounds_per_sec']}")
+    out["nofd_fused_rounds_per_sec"] = rate(
+        dataclasses.replace(cfg, track_failure_detector=False)
+    )
+    fresh = Simulator(cfg, seed=1, chunk=16)
+    out["rounds_to_convergence"] = fresh.run_until_converged(max_rounds=256)
+    log(f"convergence: {out['rounds_to_convergence']}")
+
+    from aiocluster_tpu.sim.memory import lean_config
+
+    lean = lean_config(32_768)
+    out["lean32k_rounds_per_sec"] = rate(lean, rounds=32, chunk=8)
+    log(f"lean 32k: {out['lean32k_rounds_per_sec']}")
+    return out
+
+
+def main() -> None:
+    start = time.time()
+    while time.time() - start < DEADLINE_S:
+        if tunnel_up():
+            log("tunnel is up; measuring")
+            # Hard watchdog: if the tunnel drops mid-measure, the
+            # in-process plugin retries forever (MULTICHIP_r01 lesson) —
+            # an exception never surfaces, so a timer is the only way to
+            # honor the deadline contract.
+            import threading
+
+            guard = threading.Timer(1800.0, lambda: os._exit(3))
+            guard.daemon = True
+            guard.start()
+            try:
+                result = measure()
+            except Exception as exc:
+                log(f"measurement failed: {exc!r}; retrying in {PROBE_EVERY_S}s")
+                time.sleep(PROBE_EVERY_S)
+                continue
+            finally:
+                guard.cancel()
+            path = os.path.join(HERE, "r02_session2_raw.json")
+            with open(path, "w") as f:
+                json.dump(result, f, indent=1)
+            log(f"wrote {path}")
+            return
+        log("tunnel down; sleeping")
+        time.sleep(PROBE_EVERY_S)
+    log("deadline reached without a reachable tunnel")
+    sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

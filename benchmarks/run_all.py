@@ -324,18 +324,33 @@ def main() -> None:
     )
     args = parser.parse_args()
     wanted = [int(c) for c in args.configs.split(",")]
+    platform_error = None
     if any(c != 1 for c in wanted) or args.platform:
         # Pin the JAX platform BEFORE any sim config touches a device:
         # in-process backend init retries forever against a down TPU
         # tunnel (bench.py's round-1 lesson). Config 1 is asyncio-only
         # and skips this unless --platform is explicit (honoring its
-        # fail-fast contract even when no sim config runs).
-        from bench import resolve_platform
+        # fail-fast contract even when no sim config runs). A resolution
+        # failure must not cost the jax-free config its record — it
+        # becomes a per-config error record below, preserving the
+        # one-JSON-line-per-config contract.
+        try:
+            from bench import resolve_platform
 
-        resolve_platform(args.platform or ("cpu" if args.smoke else "auto"), log)
+            resolve_platform(
+                args.platform or ("cpu" if args.smoke else "auto"), log
+            )
+        except Exception as exc:
+            platform_error = repr(exc)
+            log(f"platform resolution failed: {platform_error}")
     for c in wanted:
         log(f"=== config {c} ===")
         start = time.perf_counter()
+        if platform_error is not None and c != 1:
+            record = {"metric": f"config{c}", "value": None, "unit": "error",
+                      "config": c, "error": platform_error}
+            emit(record)
+            continue
         try:
             record = CONFIGS[c](args.smoke)
         except Exception as exc:  # keep the suite going; record the failure
