@@ -1,0 +1,87 @@
+"""Smoke test for examples/http_api.py (reference examples/api/app.py
+parity surface): a two-node cluster embedded in the stdlib HTTP server,
+exercised over real sockets — state view, PUT/GET replication across
+nodes, DELETE, and the /kv_mark grace-period delete."""
+
+import asyncio
+import json
+import os
+import sys
+
+from aiocluster_tpu import Cluster, Config, NodeId
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+import http_api  # noqa: E402
+
+sys.path.pop(0)
+
+
+async def _request(port: int, method: str, path: str) -> tuple[str, str]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"{method} {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    status_line = (await reader.readline()).decode()
+    length = 0
+    while True:
+        line = (await reader.readline()).decode().strip()
+        if not line:
+            break
+        if line.lower().startswith("content-length:"):
+            length = int(line.split(":")[1])
+    body = (await reader.readexactly(length)).decode()
+    writer.close()
+    return status_line.split(" ", 1)[1].strip(), body
+
+
+async def test_http_api_two_nodes(free_port_factory):
+    g1, g2 = free_port_factory(), free_port_factory()
+    h1, h2 = free_port_factory(), free_port_factory()
+
+    def make(gossip: int, seed: int) -> Cluster:
+        return Cluster(Config(
+            node_id=NodeId(
+                name=f"api-{gossip}",
+                gossip_advertise_addr=("127.0.0.1", gossip),
+            ),
+            gossip_interval=0.02,
+            seed_nodes=[("127.0.0.1", seed)],
+            cluster_id="http-api-test",
+        ))
+
+    async with make(g1, g2) as c1, make(g2, g1) as c2:
+        t1 = asyncio.create_task(http_api.serve_http(c1, h1))
+        t2 = asyncio.create_task(http_api.serve_http(c2, h2))
+        try:
+            await asyncio.sleep(0.05)  # let the HTTP servers bind
+
+            status, _ = await _request(h1, "PUT", "/kv/color?v=red")
+            assert status == "200 OK"
+            status, body = await _request(h1, "GET", "/kv/color")
+            assert (status, body) == ("200 OK", "red")
+
+            # Replicates to node 2 (visible in its /state).
+            async def replicated() -> bool:
+                _, body = await _request(h2, "GET", "/state")
+                snap = json.loads(body)
+                return snap["nodes"].get(f"api-{g1}", {}).get("color") == "red"
+
+            async with asyncio.timeout(4.0):
+                while not await replicated():
+                    await asyncio.sleep(0.05)
+
+            # TTL-mark endpoint (reference /kv_mark parity): marking an
+            # existing key succeeds, a missing key 404s.
+            status, _ = await _request(h1, "POST", "/kv_mark/color")
+            assert status == "200 OK"
+            status, _ = await _request(h1, "POST", "/kv_mark/nope")
+            assert status == "404 Not Found"
+
+            status, _ = await _request(h1, "DELETE", "/kv/color")
+            assert status == "200 OK"
+            status, _ = await _request(h1, "GET", "/kv/color")
+            assert status == "404 Not Found"
+        finally:
+            for t in (t1, t2):
+                t.cancel()
+            await asyncio.gather(t1, t2, return_exceptions=True)
