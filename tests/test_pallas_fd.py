@@ -129,6 +129,34 @@ def test_sim_step_fd_state_matches_xla():
         )
 
 
+def test_sim_step_choice_path_fd_kernel_matches_xla():
+    """pairing="choice" keeps the pulls on XLA but the FD kernel still
+    engages — the mixed combination must also be trajectory-exact."""
+    from aiocluster_tpu.ops.gossip import (
+        pallas_fd_engaged,
+        pallas_path_engaged,
+        sim_step,
+    )
+    from aiocluster_tpu.sim import SimConfig, init_state
+
+    base = dict(n_nodes=128, keys_per_node=5, budget=24, pairing="choice",
+                peer_mode="view", death_rate=0.05, revival_rate=0.2)
+    cfg_x = SimConfig(**base)
+    cfg_p = SimConfig(**base, use_pallas=True)
+    assert pallas_fd_engaged(cfg_p) and not pallas_path_engaged(cfg_p)
+    sx, sp = init_state(cfg_x), init_state(cfg_p)
+    key = random.key(6)
+    for _ in range(6):
+        sx = sim_step(sx, key, cfg_x)
+        sp = sim_step(sp, key, cfg_p)
+    for field in ("w", "hb_known", "last_change", "imean", "icount", "live_view"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sp, field)),
+            np.asarray(getattr(sx, field)),
+            err_msg=field,
+        )
+
+
 def test_fd_kernel_gate():
     """Lifecycle configs and off-domain shapes stay on the XLA block."""
     from aiocluster_tpu.ops.gossip import pallas_fd_engaged
