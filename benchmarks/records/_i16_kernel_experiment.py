@@ -1,0 +1,209 @@
+"""On-chip experiment: can the pull kernel's int32 widening be avoided?
+
+The fused pull kernel is VPU-bound. Mosaic rejects arith.maxsi on
+vector<i16>, but cmp+select may be legal — if so, the deficit
+d = max(w_peer - w_self, 0) and the hb absorb can run in native i16
+(values < 2^15, so i16 subtraction cannot wrap), and the f32 budget
+math can be fed straight from i16, skipping the widening casts.
+
+Times three candidates on the real chip at the bench shape, each
+checked bit-exact against the shipped kernel first:
+  a) shipped kernel (i32 widening everywhere)
+  b) i16 cmp+select for d and the hb absorb; i32 stage kept for the
+     advance arithmetic
+  c) b + the advance entirely in f32 fed from i16 (no i32 stage at all;
+     every quantity is an integer < 2^15, exact in f32)
+
+Builder-side tooling; results inform whether to port the winner into
+ops/pallas_pull.py (with parity tests) — not shipped as-is.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, random
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+from aiocluster_tpu.ops.gossip import _grouped_matching  # noqa: E402
+from aiocluster_tpu.ops import pallas_pull as pp  # noqa: E402
+
+
+def _kernel_variant(
+    gm_ref, c_ref, meta_ref, w_ref, hb_ref, valid_ref, w_hbm, hb_hbm,
+    wout_ref, hbout_ref, wp, hbp, sems, *, block, n, variant,
+):
+    gpb = block // 8
+    g0 = pl.program_id(0) * gpb
+
+    def gather(g, _):
+        src = gm_ref[g0 + g] * 8
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(src, 8), :], wp.at[pl.ds(g * 8, 8), :], sems.at[0, g]
+        ).start()
+        pltpu.make_async_copy(
+            hb_hbm.at[pl.ds(src, 8), :], hbp.at[pl.ds(g * 8, 8), :], sems.at[1, g]
+        ).start()
+        return 0
+
+    lax.fori_loop(0, gpb, gather, 0)
+    salt = meta_ref[0]
+    run_salt = meta_ref[1]
+    budget = meta_ref[2].astype(jnp.float32)
+    r_k1, js = pp._dither_base((8, n), salt, run_salt)
+
+    for g in range(gpb):
+        src = gm_ref[g0 + g] * 8
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(src, 8), :], wp.at[pl.ds(g * 8, 8), :], sems.at[0, g]
+        ).wait()
+        pltpu.make_async_copy(
+            hb_hbm.at[pl.ds(src, 8), :], hbp.at[pl.ds(g * 8, 8), :], sems.at[1, g]
+        ).wait()
+        sl = slice(g * 8, (g + 1) * 8)
+        cg = c_ref[g0 + g]
+        row0 = pl.program_id(0) * block + g * 8
+        vcol8 = valid_ref[sl, :]  # (8, 1) int8
+        w_self16 = w_ref[sl, :]
+        w_peer16 = pltpu.roll(wp[sl, :], cg, 0)
+        # i16 cmp+select deficit (both variants): no maxsi, no widening.
+        d16 = jnp.where(
+            (w_peer16 > w_self16) & (vcol8 > 0), w_peer16 - w_self16,
+            jnp.asarray(0, w_self16.dtype),
+        )
+        if variant == "b":
+            d = d16.astype(jnp.int32)
+            total = jnp.sum(d.astype(jnp.float32), axis=1, keepdims=True)
+            scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
+            x = d.astype(jnp.float32) * scale
+            floor = jnp.floor(x)
+            bump = pp._dither(r_k1, js, row0) < (x - floor)
+            adv = jnp.minimum(floor.astype(jnp.int32) + bump, d)
+            wout_ref[sl, :] = (w_self16.astype(jnp.int32) + adv).astype(
+                wout_ref.dtype
+            )
+        else:  # variant "c": no i32 stage at all
+            d_f = d16.astype(jnp.float32)
+            total = jnp.sum(d_f, axis=1, keepdims=True)
+            scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
+            x = d_f * scale
+            floor = jnp.floor(x)
+            bump_f = (pp._dither(r_k1, js, row0) < (x - floor)).astype(
+                jnp.float32
+            )
+            adv_f = jnp.minimum(floor + bump_f, d_f)
+            wout_ref[sl, :] = (
+                w_self16.astype(jnp.float32) + adv_f
+            ).astype(wout_ref.dtype)
+        hb_self16 = hb_ref[sl, :]
+        hb_peer16 = pltpu.roll(hbp[sl, :], cg, 0)
+        hbout_ref[sl, :] = jnp.where(
+            (hb_peer16 > hb_self16) & (vcol8 > 0), hb_peer16, hb_self16
+        )
+
+
+def variant_pull(w, hb, gm, c, valid, salt, run_salt, budget, variant):
+    n = w.shape[0]
+    block = pp._pick_block(n, 2, track_hb=True)
+    spec = pl.BlockSpec((block, n), lambda i, *_: (i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n // block,),
+        in_specs=[
+            spec, spec,
+            pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[spec, spec],
+        scratch_shapes=[
+            pltpu.VMEM((block, n), w.dtype),
+            pltpu.VMEM((block, n), hb.dtype),
+            pltpu.SemaphoreType.DMA((2, block // 8)),
+        ],
+    )
+    meta = jnp.stack([
+        salt.astype(jnp.int32), run_salt.astype(jnp.int32),
+        jnp.asarray(budget, jnp.int32),
+    ])
+    kernel = functools.partial(
+        _kernel_variant, block=block, n=n, variant=variant
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(w.shape, w.dtype)] * 2,
+    )(gm.astype(jnp.int32), c.astype(jnp.int32), meta, w, hb,
+      valid.astype(jnp.int8)[:, None], w, hb)
+
+
+def main() -> None:
+    N = 10_240
+    key = random.key(0)
+    kw, kh, kp = random.split(key, 3)
+    w0 = random.randint(kw, (N, N), 0, 2000).astype(jnp.int16)
+    hb0 = random.randint(kh, (N, N), 0, 500).astype(jnp.int16)
+    gm, c, p = _grouped_matching(kp, N)
+    valid = jnp.ones((N,), bool)
+    salt = jnp.asarray(3, jnp.int32)
+    run_salt = jnp.asarray(0xDEAD, jnp.uint32)
+    budget = 2618
+
+    ref_w, ref_hb = pp.fused_pull_m8(
+        w0, hb0, gm, c, valid, salt, run_salt, budget
+    )
+    int(np.asarray(ref_w[0, 0]))
+
+    def timeit(fn, label):
+        # Thread the carry through so every iteration depends on the
+        # previous one — a loop-invariant body would let XLA hoist the
+        # kernel call and under-report by the iteration count.
+        @jax.jit
+        def loop(w, hb):
+            return lax.fori_loop(0, 64, lambda i, carry: fn(*carry), (w, hb))
+        o = loop(w0, hb0)
+        int(np.asarray(o[0][0, 0]))
+        best = 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            o = loop(w0, hb0)
+            int(np.asarray(o[0][0, 0]))
+            best = min(best, (time.perf_counter() - t0) / 64)
+        print(f"{label}: {best * 1000:.2f} ms/call")
+        return best
+
+    timeit(
+        lambda w, hb: pp.fused_pull_m8(w, hb, gm, c, valid, salt, run_salt,
+                                       budget),
+        "shipped (i32 widening)",
+    )
+    for variant in ("b", "c"):
+        try:
+            vw, vhb = variant_pull(w0, hb0, gm, c, valid, salt, run_salt,
+                                   budget, variant)
+            ok_w = bool(jnp.array_equal(vw, ref_w))
+            ok_hb = bool(jnp.array_equal(vhb, ref_hb))
+            print(f"variant {variant}: bit-exact w={ok_w} hb={ok_hb}")
+            if ok_w and ok_hb:
+                timeit(
+                    lambda w, hb, v=variant: variant_pull(
+                        w, hb, gm, c, valid, salt, run_salt, budget, v
+                    ),
+                    f"variant {variant}",
+                )
+        except Exception as exc:
+            print(f"variant {variant}: FAILED {str(exc)[:300]}")
+
+
+if __name__ == "__main__":
+    main()
