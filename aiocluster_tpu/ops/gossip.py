@@ -385,22 +385,28 @@ def pallas_path_engaged(
     )
 
 
-def pallas_fd_engaged(cfg: SimConfig, axis_name: str | None = None) -> bool:
+def pallas_fd_engaged(cfg: SimConfig, n_local: int | None = None) -> bool:
     """Whether the streaming FD kernel (ops/pallas_fd.py) replaces the
     XLA failure-detection block for this config. Mirrors
     ``pallas_path_engaged``'s resolution of ``use_pallas`` ("auto" = on a
     real TPU; forcing True off-TPU runs interpreted, for tests). The
     dead-node lifecycle stays on XLA: its branch rewrites w/hb and
-    carries dead_since, none of which the kernel models."""
+    carries dead_since, none of which the kernel models.
+
+    Unlike the pull kernel, the FD math is purely per-element, so it
+    also engages under shard_map (each shard runs the kernel on its
+    (N, n_local) column block with its owner offset); pass the shard's
+    ``n_local`` so the lane-width check sees the LOCAL column count
+    (default: unsharded, n_local = n_nodes)."""
     from . import pallas_fd
 
     return (
         _pallas_wanted(cfg)
         and cfg.track_failure_detector
         and not _lifecycle_enabled(cfg)
-        and axis_name is None
         and pallas_fd.supported(
             cfg.n_nodes,
+            cfg.n_nodes if n_local is None else n_local,
             jnp.dtype(cfg.heartbeat_dtype).itemsize,
             jnp.dtype(cfg.fd_dtype).itemsize,
         )
@@ -608,9 +614,10 @@ def sim_step(
         w, hb = lax.fori_loop(0, cfg.fanout, exchange, (w, hb), unroll=True)
 
     # -- vectorized phi-accrual failure detection ----------------------------
-    if pallas_fd_engaged(cfg, axis_name):
+    if pallas_fd_engaged(cfg, n_local):
         # One streaming pass over the five FD operands (bit-identical to
-        # the XLA block below — tests/test_pallas_fd.py).
+        # the XLA block below — tests/test_pallas_fd.py). Runs per shard
+        # under shard_map, with this shard's owner offset.
         from . import pallas_fd
 
         last_change, imean, icount, live = pallas_fd.fused_fd(
@@ -627,6 +634,7 @@ def sim_step(
             prior_mean=cfg.prior_mean_ticks,
             phi_threshold=cfg.phi_threshold,
             interpret=not on_accelerator(),
+            owner_offset=owners[0],
         )
         dead_since = state.dead_since
     elif cfg.track_failure_detector:

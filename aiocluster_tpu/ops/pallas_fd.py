@@ -14,9 +14,12 @@ as the XLA block in gossip.sim_step (loads widen int16->int32 /
 bfloat16->float32 exactly; stores round exactly once, at the end, as
 the XLA path does), so flipping the kernel on never changes a
 trajectory — asserted in tests/test_pallas_fd.py. Gated like the pull
-kernel (ops/gossip.py::pallas_fd_engaged): real TPU, single device,
-failure detector on, dead-node lifecycle off (the lifecycle branch
-rewrites w/hb and is XLA-only).
+kernel (ops/gossip.py::pallas_fd_engaged): real TPU, failure detector
+on, dead-node lifecycle off (the lifecycle branch rewrites w/hb and is
+XLA-only). Unlike the pull kernel the math is purely per-element, so
+it also runs under shard_map: each shard streams its (N, n_local)
+column block with its global owner offset (bit-identical to the
+single-device run, tests/test_pallas_fd.py).
 
 Reference anchor: this is failure_detector.py:43-106 (phi +
 update_node_liveness over every observer) collapsed into one pass.
@@ -36,7 +39,7 @@ from .pallas_pull import largest_fitting_block
 
 
 def _fd_kernel(
-    tick_ref,  # scalar prefetch: (1,) int32 — this round's tick
+    meta_ref,  # scalar prefetch: (2,) int32 — [tick, owner offset]
     hb_ref,  # (block, n) heartbeat_dtype — post-exchange hb knowledge
     hb0_ref,  # (block, n) heartbeat_dtype — round-start hb knowledge
     hbv_ref,  # (1, n) int32 — owner heartbeats (diagonal refresh of hb0)
@@ -55,10 +58,12 @@ def _fd_kernel(
     prior_mean: float,
     phi_threshold: float,
 ):
-    tick = tick_ref[0]
+    tick = meta_ref[0]
     shape = hb_ref.shape
     rows = pl.program_id(0) * block + lax.broadcasted_iota(jnp.int32, shape, 0)
-    cols = lax.broadcasted_iota(jnp.int32, shape, 1)
+    # Column c of this (column-sharded) block is GLOBAL owner
+    # offset + c; single-device callers pass offset 0.
+    cols = meta_ref[1] + lax.broadcasted_iota(jnp.int32, shape, 1)
     diag = rows == cols
     hb = hb_ref[:].astype(jnp.int32)
     # Round-start knowledge carries the round's owner-diagonal refresh
@@ -87,7 +92,8 @@ def _fd_kernel(
         elapsed * (count_f32 + prior_weight)
         <= phi_threshold * (imean * count_f32 + prior_weight * prior_mean)
     )
-    # Self-belief diagonal (single-device: global row == global column).
+    # Self-belief diagonal (global row == global owner column — the
+    # offset above makes this exact on every shard).
     live = live | diag
     # Death wipes the window (re-earn liveness with fresh samples).
     lc_out[:] = lc2.astype(lc_out.dtype)
@@ -96,7 +102,7 @@ def _fd_kernel(
     live_out[:] = live
 
 
-def _per_row_bytes(n: int, hb_size: int, fd_size: int) -> int:
+def _per_row_bytes(n_cols: int, hb_size: int, fd_size: int) -> int:
     """Double-buffered VMEM bytes per block row: inputs hb + hb0 +
     last_change (heartbeat dtype) and imean (fd dtype) and icount
     (int16); outputs last_change + imean + icount and the bool live
@@ -104,22 +110,30 @@ def _per_row_bytes(n: int, hb_size: int, fd_size: int) -> int:
     the compiled custom-call layout), even though its HBM form is 1 B."""
     inputs = 3 * hb_size + fd_size + 2
     outputs = hb_size + fd_size + 2 + 4
-    return 2 * (inputs + outputs) * n
+    return 2 * (inputs + outputs) * n_cols
 
 
-def _pick_block(n: int, hb_size: int, fd_size: int) -> int | None:
-    """Largest multiple-of-8 divisor of n whose double-buffered block set
-    fits the VMEM budget at the given element sizes (required — the
-    compact int16/bfloat16 and default int32/float32 profiles differ
-    ~1.9x in footprint, so there is no safe default)."""
-    return largest_fitting_block(n, _per_row_bytes(n, hb_size, fd_size))
+def _pick_block(
+    n_rows: int, n_cols: int, hb_size: int, fd_size: int
+) -> int | None:
+    """Largest multiple-of-8 divisor of n_rows whose double-buffered
+    block set fits the VMEM budget at the given element sizes (required
+    — the compact int16/bfloat16 and default int32/float32 profiles
+    differ ~1.9x in footprint, so there is no safe default). n_cols may
+    be a column shard's width under shard_map."""
+    return largest_fitting_block(
+        n_rows, _per_row_bytes(n_cols, hb_size, fd_size)
+    )
 
 
-def supported(n: int, hb_size: int, fd_size: int) -> bool:
+def supported(n_rows: int, n_cols: int, hb_size: int, fd_size: int) -> bool:
     """Whether the streaming FD kernel can run this shape and dtype mix
     (callers fall back to the XLA block when not). Lane-aligned columns
     keep the padded memref whole-tile (as in pallas_pull.supported)."""
-    return n % 128 == 0 and _pick_block(n, hb_size, fd_size) is not None
+    return (
+        n_cols % 128 == 0
+        and _pick_block(n_rows, n_cols, hb_size, fd_size) is not None
+    )
 
 
 @functools.partial(
@@ -148,20 +162,31 @@ def fused_fd(
     prior_mean: float,
     phi_threshold: float,
     interpret: bool = False,
+    owner_offset: jax.Array | None = None,
 ):
     """One streaming FD pass. Returns (last_change', imean', icount',
     live'). Inputs are the post-exchange and round-start heartbeat
-    matrices, the (N,) owner-heartbeat vector (hb0's diagonal refresh —
-    see _fd_kernel), and the FD bookkeeping; constants from SimConfig."""
-    n = hb.shape[0]
-    block = _pick_block(n, hb.dtype.itemsize, imean.dtype.itemsize)
-    if block is None or n % 128 != 0:
-        raise ValueError(f"no suitable row block for n={n}")
-    spec = pl.BlockSpec((block, n), lambda i, *_: (i, 0))
-    vec_spec = pl.BlockSpec((1, n), lambda i, *_: (0, 0))
+    matrices, the owner-heartbeat vector for the LOCAL columns (hb0's
+    diagonal refresh — see _fd_kernel), and the FD bookkeeping;
+    constants from SimConfig.
+
+    Works under shard_map: matrices are (N, n_local) column shards, and
+    ``owner_offset`` (default 0) is the global owner index of local
+    column 0 — the FD math is purely per-element, so each shard runs the
+    identical kernel on its block (unlike the pull kernel, whose global
+    budget total would need a cross-shard psum between two passes —
+    that one stays single-device)."""
+    n_rows, n_cols = hb.shape
+    block = _pick_block(
+        n_rows, n_cols, hb.dtype.itemsize, imean.dtype.itemsize
+    )
+    if block is None or n_cols % 128 != 0:
+        raise ValueError(f"no suitable row block for shape {hb.shape}")
+    spec = pl.BlockSpec((block, n_cols), lambda i, *_: (i, 0))
+    vec_spec = pl.BlockSpec((1, n_cols), lambda i, *_: (0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n // block,),
+        grid=(n_rows // block,),
         in_specs=[spec, spec, vec_spec, spec, spec, spec],
         out_specs=[spec] * 4,
     )
@@ -190,11 +215,18 @@ def fused_fd(
         # carry buffers (~2 ms each at 10k on a v5e — the dominant FD
         # cost, found via the compiled HLO's copy instructions). Indices
         # are over the flattened operand list: 0 = the scalar-prefetch
-        # tick, then hb, hb0, hbv, last_change (4), imean (5), icount (6).
+        # meta, then hb, hb0, hbv, last_change (4), imean (5), icount (6).
         input_output_aliases={4: 0, 5: 1, 6: 2},
         interpret=interpret,
     )(
-        jnp.reshape(tick.astype(jnp.int32), (1,)),
+        jnp.stack(
+            [
+                tick.astype(jnp.int32),
+                jnp.asarray(0, jnp.int32)
+                if owner_offset is None
+                else owner_offset.astype(jnp.int32),
+            ]
+        ),
         hb,
         hb0,
         hbv.astype(jnp.int32)[None, :],

@@ -86,8 +86,18 @@ def sharded_chunk_fn(
             unroll=False,
         )
 
+    # check_vma=False: the varying-mesh-axes checker cannot see through
+    # pallas_call's internal block slicing (interpret mode trips
+    # "dynamic_slice requires varying manual axes to match"; the JAX
+    # error text itself prescribes this workaround). Shard correctness
+    # is asserted far more strongly by the bit-identity tests
+    # (tests/test_sim_sharded.py, tests/test_pallas_fd.py).
     fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, P(), *extra_specs), out_specs=spec
+        body,
+        mesh=mesh,
+        in_specs=(spec, P(), *extra_specs),
+        out_specs=spec,
+        check_vma=False,
     )
     return jax.jit(fn, donate_argnums=(0,))
 

@@ -157,6 +157,41 @@ def test_sim_step_choice_path_fd_kernel_matches_xla():
         )
 
 
+def test_sharded_fd_kernel_matches_single_device():
+    """The FD kernel engages under shard_map (per-shard blocks + owner
+    offsets); a 2-shard kernel run must equal the single-device kernel
+    run AND the plain XLA run bit-for-bit."""
+    import jax
+    from jax.sharding import Mesh
+
+    from aiocluster_tpu.ops.gossip import pallas_fd_engaged
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    base = dict(n_nodes=256, keys_per_node=5, budget=48,
+                death_rate=0.05, revival_rate=0.2)
+    cfg_p = SimConfig(**base, use_pallas=True)
+    assert pallas_fd_engaged(cfg_p, n_local=128)
+    mesh = Mesh(jax.devices("cpu")[:2], ("owners",))
+
+    runs = {
+        "sharded-kernel": Simulator(cfg_p, seed=5, mesh=mesh, chunk=4),
+        "single-kernel": Simulator(cfg_p, seed=5, chunk=4),
+        "single-xla": Simulator(SimConfig(**base), seed=5, chunk=4),
+    }
+    for sim in runs.values():
+        sim.run(8)
+    ref = jax.device_get(runs["single-xla"].state)
+    for name, sim in runs.items():
+        got = jax.device_get(sim.state)
+        for field in ("w", "hb_known", "last_change", "imean", "icount",
+                      "live_view"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got, field)),
+                np.asarray(getattr(ref, field)),
+                err_msg=f"{name}:{field}",
+            )
+
+
 def test_fd_kernel_gate():
     """Lifecycle configs and off-domain shapes stay on the XLA block."""
     from aiocluster_tpu.ops.gossip import pallas_fd_engaged
@@ -171,8 +206,10 @@ def test_fd_kernel_gate():
         SimConfig(n_nodes=128, use_pallas=True, track_failure_detector=False,
                   peer_mode="alive")
     )
+    # Sharded: engages when the LOCAL column width stays lane-aligned.
+    assert pallas_fd_engaged(SimConfig(n_nodes=256, use_pallas=True), n_local=128)
     assert not pallas_fd_engaged(
-        SimConfig(n_nodes=128, use_pallas=True), axis_name="owners"
+        SimConfig(n_nodes=256, use_pallas=True), n_local=64
     )
 
 
@@ -185,11 +222,14 @@ def test_pick_block_fits_vmem():
     # the element sizes, not assume the compact profile.
     for hb_size, fd_size in ((4, 4), (2, 2), (4, 2)):
         for n in (128, 2048, 10_240, 16_384):
-            b = _pick_block(n, hb_size, fd_size)
+            b = _pick_block(n, n, hb_size, fd_size)
             assert b is not None and n % b == 0 and b % 8 == 0
             assert _per_row_bytes(n, hb_size, fd_size) * b <= VMEM_BUDGET
-    assert supported(128, 4, 4)
-    assert not supported(100, 2, 2)
+    assert supported(128, 128, 4, 4)
+    assert not supported(100, 100, 2, 2)
+    # Column shards: rows stay global, lane check sees the local width.
+    assert supported(1024, 128, 2, 2)
+    assert not supported(1024, 64, 2, 2)
 
 
 def test_fused_fd_wide_dtypes_match_xla():
