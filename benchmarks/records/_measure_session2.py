@@ -66,16 +66,31 @@ def measure() -> dict:
         return round(best, 2)
 
     out: dict = {"n_nodes": N, "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+    def checkpoint() -> None:
+        # Partial results survive a mid-battery tunnel drop (the
+        # watchdog hard-exits; whatever phases completed are kept).
+        # Atomic write (tmp + rename): the hard exit can land mid-dump,
+        # and a truncated checkpoint would defeat the point.
+        path = os.path.join(HERE, "r02_session2_partial.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(path + ".tmp", path)
+
     out["full_fused_rounds_per_sec"] = rate(cfg)
     log(f"full fused: {out['full_fused_rounds_per_sec']}")
+    checkpoint()
     out["full_xla_rounds_per_sec"] = rate(dataclasses.replace(cfg, use_pallas=False))
     log(f"full XLA: {out['full_xla_rounds_per_sec']}")
+    checkpoint()
     out["nofd_fused_rounds_per_sec"] = rate(
         dataclasses.replace(cfg, track_failure_detector=False)
     )
+    checkpoint()
     fresh = Simulator(cfg, seed=1, chunk=16)
     out["rounds_to_convergence"] = fresh.run_until_converged(max_rounds=256)
     log(f"convergence: {out['rounds_to_convergence']}")
+    checkpoint()
 
     from aiocluster_tpu.sim.memory import lean_config
 
@@ -110,6 +125,12 @@ def main() -> None:
             path = os.path.join(HERE, "r02_session2_raw.json")
             with open(path, "w") as f:
                 json.dump(result, f, indent=1)
+            # The raw file is authoritative; drop the phase checkpoint so
+            # a stale partial can't be mistaken for current results.
+            try:
+                os.remove(os.path.join(HERE, "r02_session2_partial.json"))
+            except FileNotFoundError:
+                pass
             log(f"wrote {path}")
             return
         log("tunnel down; sleeping")
