@@ -27,11 +27,12 @@ Correspondence (object model → tensor op), with the default config:
   the (N, N) heartbeat-knowledge matrix
 
 Sharding contract: every (N, N) array is sharded on the OWNER axis
-(columns). Peer-row gathers are shard-local; the only collectives are the
-(N,)-sized budget block offsets (all_gather) and convergence reductions —
-they ride ICI, everything else is local HBM traffic. Pass ``axis_name``
-when calling under shard_map; ``None`` runs the identical math on one
-device.
+(columns). Peer-row gathers are shard-local; the only collectives are
+(N,)-sized per-row reductions — deficit totals (psum; also between the
+two passes of the sharded Pallas pull), greedy budget block offsets
+(all_gather) and convergence reductions — they ride ICI, everything
+else is local HBM traffic. Pass ``axis_name`` when calling under
+shard_map; ``None`` runs the identical math on one device.
 """
 
 from __future__ import annotations
@@ -343,6 +344,7 @@ def pallas_path_engaged(
     axis_name: str | None = None,
     *,
     has_topology: bool = False,
+    n_local: int | None = None,
 ) -> bool:
     """Single source of truth for whether sim_step routes matching
     sub-exchanges through the fused Pallas kernel for this config —
@@ -353,12 +355,19 @@ def pallas_path_engaged(
     XLA elsewhere (interpret mode is for tests only — forcing
     use_pallas=True off-TPU runs it interpreted). The remaining terms
     mirror the kernel's hard requirements: grouped-matching domain
-    (n % 128 == 0), single device, proportional budget, no dead-node
-    lifecycle (the kernel has no scheduled-for-deletion column mask),
-    and a legal VMEM block for the widest matrix dtype (fused_pull_m8
-    sizes VMEM from the same). Both profiles qualify: with heartbeats
-    the kernel fuses w and hb; the lean convergence-only profile runs
-    the w-only variant.
+    (n % 128 == 0), proportional budget, no dead-node lifecycle (the
+    kernel has no scheduled-for-deletion column mask), and a legal VMEM
+    block for the widest matrix dtype (fused_pull_m8 sizes VMEM from the
+    same). Both profiles qualify: with heartbeats the kernel fuses w and
+    hb; the lean convergence-only profile runs the w-only variant.
+
+    Column-sharded runs engage too (the north-star config): the kernel
+    runs per shard on its (N, n_local) block — peer DMA is shard-local
+    because rows are unsharded — with the rows' global deficit totals
+    computed by a first streaming pass and one psum (sim_step wires the
+    two passes). Callers under shard_map must pass the shard's
+    ``n_local`` so the lane-width check sees the LOCAL column count.
+
     ``has_topology``: adjacency-constrained runs force the choice path,
     so callers labelling a Simulator(..., topology=...) run must pass
     True (sim_step itself never consults the gate on that path)."""
@@ -367,6 +376,8 @@ def pallas_path_engaged(
     itemsize = jnp.dtype(cfg.version_dtype).itemsize
     if cfg.track_heartbeats:
         itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
+    if axis_name is not None and n_local is None:
+        return False  # sharded callers must say how wide a shard is
     return (
         _pallas_wanted(cfg)
         and not has_topology  # adjacency runs force the choice path
@@ -376,11 +387,13 @@ def pallas_path_engaged(
         # refresh diagonals, which the XLA path does unconditionally).
         and cfg.fanout >= 1
         and cfg.n_nodes % 128 == 0
-        and axis_name is None
         and cfg.budget_policy == "proportional"
         and not _lifecycle_enabled(cfg)
         and pallas_pull.supported(
-            cfg.n_nodes, itemsize, track_hb=cfg.track_heartbeats
+            cfg.n_nodes,
+            itemsize,
+            track_hb=cfg.track_heartbeats,
+            n_local=cfg.n_nodes if axis_name is None else n_local,
         )
     )
 
@@ -459,7 +472,7 @@ def sim_step(
     mv_vec = max_version[owners]
     hbv_vec = heartbeat[owners]
     use_pallas = pallas_path_engaged(
-        cfg, axis_name, has_topology=adjacency is not None
+        cfg, axis_name, has_topology=adjacency is not None, n_local=n_local
     )
     if use_pallas:
         diag = None
@@ -549,12 +562,34 @@ def sim_step(
                 # The first sub-exchange carries the diagonal refresh
                 # (later ones see it in w/hb themselves).
                 first = c == 0
+                valid_pair = alive & alive[p]
+                # shards is STATIC (both n and n_local are trace-time
+                # shapes): a one-shard mesh runs the plain single-pass
+                # kernel — its in-kernel row sum IS the global total —
+                # so single-chip "sharded" runs pay no two-pass tax.
+                shards = n // n_local
+                if axis_name is not None and shards > 1:
+                    # Two-pass sharded form: local deficit totals
+                    # (streaming pass, no writes), one psum — the only
+                    # ICI traffic — then the apply pass with the global
+                    # totals. Bit-identical to the XLA sharded path's
+                    # psum(d.sum(axis=1)) pipeline.
+                    tot = pallas_pull.fused_pull_totals_m8(
+                        w, gm8, c8, valid_pair, interpret=interpret,
+                        mv=mv_vec if first else None,
+                        owner_offset=owners[0],
+                    )
+                    tot = lax.psum(tot, axis_name)
+                else:
+                    tot = None
                 pulled = pallas_pull.fused_pull_m8(
                     w, hb if track_hb else None, gm8, c8,
-                    alive & alive[p], sub_salt(c, 0), run_salt,
+                    valid_pair, sub_salt(c, 0), run_salt,
                     cfg.budget, interpret=interpret,
                     mv=mv_vec if first else None,
                     hbv=hbv_vec if first and track_hb else None,
+                    owner_offset=owners[0],
+                    totals=tot,
                 )
                 w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
@@ -739,6 +774,29 @@ def sim_step(
         live_view=live,
         dead_since=dead_since,
     )
+
+
+def all_converged_flag(
+    state: SimState, axis_name: str | None = None
+) -> jax.Array:
+    """Scalar bool: every alive node's watermark has reached every alive
+    owner's max_version — the cheap single-pass form of
+    ``convergence_metrics()["all_converged"]`` (same excusals: dead
+    observers and dead owners). Used by the in-chunk exact convergence
+    tracker, where it runs once per ROUND, so it must stay one fused
+    read of w (no fraction/mean reductions)."""
+    n_local = state.w.shape[1]
+    owners = _local_owner_ids(n_local, axis_name)
+    needed = state.max_version[owners][None, :]
+    ok = (
+        (state.w >= needed)
+        | ~state.alive[:, None]
+        | ~state.alive[owners][None, :]
+    )
+    flag = ok.all()
+    if axis_name is not None:
+        flag = lax.pmin(flag.astype(jnp.int32), axis_name) > 0
+    return flag
 
 
 def convergence_metrics(

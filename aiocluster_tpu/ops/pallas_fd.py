@@ -113,6 +113,15 @@ def _per_row_bytes(n_cols: int, hb_size: int, fd_size: int) -> int:
     return 2 * (inputs + outputs) * n_cols
 
 
+def _fixed_bytes(n_cols: int) -> int:
+    """Block-size-independent VMEM: the (1, n_cols) int32 hbv broadcast
+    row, double-buffered and sublane-padded to 8 rows (ADVICE r2 — at
+    the boundary block size the budget must include it so the search
+    stays strictly conservative). The scalar-prefetch meta lives in
+    SMEM, not VMEM."""
+    return 2 * 8 * 4 * n_cols
+
+
 def _pick_block(
     n_rows: int, n_cols: int, hb_size: int, fd_size: int
 ) -> int | None:
@@ -122,7 +131,9 @@ def _pick_block(
     differ ~1.9x in footprint, so there is no safe default). n_cols may
     be a column shard's width under shard_map."""
     return largest_fitting_block(
-        n_rows, _per_row_bytes(n_cols, hb_size, fd_size)
+        n_rows,
+        _per_row_bytes(n_cols, hb_size, fd_size),
+        fixed_bytes=_fixed_bytes(n_cols),
     )
 
 

@@ -27,12 +27,20 @@ trajectory.
 
 Bit-compatibility: the advance formula and the (row, owner, salt) dither
 hash are the same arithmetic as gossip._budgeted_advance /
-gossip._hash_uniform. Single-device, proportional-budget, matching
-pairing, no dead-node lifecycle — other configs stay on XLA (the
-sim_step gate enforces this). Both storage profiles qualify: with
-heartbeats the kernel fuses w and hb in one pass; the lean
-convergence-only profile (hb=None) runs the w-only variant with half
-the VMEM footprint.
+gossip._hash_uniform. Proportional-budget, matching pairing, no
+dead-node lifecycle — other configs stay on XLA (the sim_step gate
+enforces this). Both storage profiles qualify: with heartbeats the
+kernel fuses w and hb in one pass; the lean convergence-only profile
+(hb=None) runs the w-only variant with half the VMEM footprint.
+
+Column sharding (the BASELINE config-5 north star): rows are unsharded,
+so each shard's peer DMA stays local to its (N, n_local) block; the one
+cross-shard quantity is each row's global deficit total. The sharded
+form is two passes — fused_pull_totals_m8 streams the block once for
+LOCAL row totals, the caller psums them over ICI, and fused_pull_m8
+applies the advance with the global totals (skipping its in-kernel
+sum). A one-shard mesh short-circuits to the single-pass form
+(ops/gossip.py sim_step wires both).
 
 Reference anchor: this is the hot loop of server.py:378-495 (the 3-way
 handshake fan-out) collapsed into one tensor pass.
@@ -49,17 +57,20 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _dither_base(shape, salt, run_salt) -> tuple[jax.Array, jax.Array]:
+def _dither_base(shape, salt, run_salt, col0) -> tuple[jax.Array, jax.Array]:
     """The group-invariant parts of gossip._hash_uniform's input mix,
     computed ONCE per kernel invocation and shared by every group (the
     uint32 multiplies are the expensive part of the hash on the VPU):
     ``r_k1 = r * K1`` for within-group row r, and ``js = j * K2 ^ s *
-    K3`` for global column j. They stay separate because the global-row
-    term folds in by ADDITION (``(row0 + r) * K1 = row0 * K1 + r * K1``
-    mod 2^32) which does not distribute over the xor with ``js``."""
+    K3`` for GLOBAL column j (``col0`` is this shard's owner offset —
+    the hash must key off global indices so a column-sharded run
+    reproduces the single-device dither bits). They stay separate
+    because the global-row term folds in by ADDITION
+    (``(row0 + r) * K1 = row0 * K1 + r * K1`` mod 2^32) which does not
+    distribute over the xor with ``js``."""
     s = salt.astype(jnp.uint32) ^ run_salt.astype(jnp.uint32)
     i = lax.broadcasted_iota(jnp.uint32, shape, 0)
-    j = lax.broadcasted_iota(jnp.uint32, shape, 1)
+    j = lax.broadcasted_iota(jnp.uint32, shape, 1) + col0.astype(jnp.uint32)
     return (
         i * jnp.uint32(0x9E3779B1),
         j * jnp.uint32(0x85EBCA77) ^ s * jnp.uint32(0xC2B2AE3D),
@@ -81,10 +92,18 @@ def _dither(r_k1: jax.Array, js: jax.Array, row0: jax.Array) -> jax.Array:
     return jnp.clip(u, 1e-12, 1.0 - 2.0**-24)
 
 
-def _advance(w_self32, w_peer32, valid_col, budget, r_k1, js, row0):
-    """gossip._budgeted_advance, proportional policy, in int32/f32."""
+def _advance(w_self32, w_peer32, valid_col, budget, r_k1, js, row0, totals=None):
+    """gossip._budgeted_advance, proportional policy, in int32/f32.
+
+    ``totals`` ((8, 1) f32), when given, is the rows' GLOBAL deficit
+    total (psum'd across shards between the two kernel passes of the
+    sharded path); None means the local row sum IS the global total
+    (single device, or a one-shard mesh)."""
     d = jnp.maximum(w_peer32 - w_self32, 0) * valid_col
-    total = jnp.sum(d.astype(jnp.float32), axis=1, keepdims=True)
+    if totals is None:
+        total = jnp.sum(d.astype(jnp.float32), axis=1, keepdims=True)
+    else:
+        total = totals
     scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
     x = d.astype(jnp.float32) * scale
     floor = jnp.floor(x)
@@ -96,11 +115,12 @@ def _m8_kernel(
     # scalar prefetch
     gm_ref,  # (n/8,) partner group per group (involution)
     c_ref,  # (n/8,) within-pair row rotation
-    meta_ref,  # [salt, run_salt, budget]
+    meta_ref,  # [salt, run_salt, budget, owner_offset]
     # block inputs
     w_ref,
     hb_ref,
     valid_ref,  # (block, 1) int8 alive-pair mask per row
+    totals_ref,  # (block, 1) f32 global deficit totals (dummy if unused)
     mv_ref,  # (1, n) int32 owner max_version (diag refresh; dummy if off)
     hbv_ref,  # (1, n) int32 owner heartbeat (diag refresh; dummy if off)
     # HBM gather sources
@@ -118,6 +138,7 @@ def _m8_kernel(
     n: int,
     track_hb: bool,
     apply_diag: bool,
+    use_totals: bool,
 ):
     gpb = block // 8  # groups per block
     g0 = pl.program_id(0) * gpb
@@ -151,8 +172,12 @@ def _m8_kernel(
     salt = meta_ref[0]
     run_salt = meta_ref[1]
     budget = meta_ref[2].astype(jnp.float32)
-    r_k1, js = _dither_base((8, n), salt, run_salt)
-    col = lax.broadcasted_iota(jnp.int32, (8, n), 1)
+    owner_off = meta_ref[3]
+    r_k1, js = _dither_base((8, n), salt, run_salt, owner_off)
+    # Global column (owner) ids: the diag compares and the dither hash
+    # both key off global indices, so a column-sharded block (owner_off
+    # = shard * n_local) reproduces the single-device bits exactly.
+    col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
     r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
 
     # Per 8-row group: wait for its DMA just-in-time (later groups'
@@ -180,7 +205,8 @@ def _m8_kernel(
             mv_b = mv_ref[:]
             w_self = jnp.where(col == self_rows, mv_b, w_self)
             w_peer = jnp.where(col == peer_rows, mv_b, w_peer)
-        adv = _advance(w_self, w_peer, vcol, budget, r_k1, js, row0)
+        tot = totals_ref[sl, :] if use_totals else None
+        adv = _advance(w_self, w_peer, vcol, budget, r_k1, js, row0, tot)
         wout_ref[sl, :] = (w_self + adv).astype(wout_ref.dtype)
         if track_hb:
             hb_self = hb_ref[sl, :].astype(jnp.int32)
@@ -196,20 +222,87 @@ def _m8_kernel(
         hbout_ref[:] = hb_ref[:]  # dummy tile; outputs must be written
 
 
+def _m8_totals_kernel(
+    # scalar prefetch
+    gm_ref,
+    c_ref,
+    meta_ref,  # [owner_offset]
+    # block inputs
+    w_ref,
+    valid_ref,  # (block, 1) int8
+    mv_ref,  # (1, n) int32 (diag refresh; dummy if off)
+    # HBM gather source
+    w_hbm,
+    # output
+    tot_ref,  # (block, 1) f32 local deficit row totals
+    # scratch
+    wp,
+    sems,
+    *,
+    block: int,
+    n: int,
+    apply_diag: bool,
+):
+    """Pass A of the sharded fused pull: each row's LOCAL deficit total,
+    one streamed read of w + its peer rows, no writes of either. The
+    caller psums the (N,) result across shards and feeds it back to
+    _m8_kernel as ``totals`` — the only cross-shard quantity in a
+    matching sub-exchange (rows are unsharded, so peer DMA stays
+    shard-local)."""
+    gpb = block // 8
+    g0 = pl.program_id(0) * gpb
+
+    def gather(g, _):
+        src = gm_ref[g0 + g] * 8
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(src, 8), :], wp.at[pl.ds(g * 8, 8), :], sems.at[g]
+        ).start()
+        return 0
+
+    lax.fori_loop(0, gpb, gather, 0)
+
+    owner_off = meta_ref[0]
+    col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
+    r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
+    for g in range(gpb):
+        src = gm_ref[g0 + g] * 8
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(src, 8), :], wp.at[pl.ds(g * 8, 8), :], sems.at[g]
+        ).wait()
+        sl = slice(g * 8, (g + 1) * 8)
+        cg = c_ref[g0 + g]
+        row0 = pl.program_id(0) * block + g * 8
+        vcol = valid_ref[sl, :].astype(jnp.int32)
+        w_self = w_ref[sl, :].astype(jnp.int32)
+        w_peer = pltpu.roll(wp[sl, :].astype(jnp.int32), cg, 0)
+        if apply_diag:
+            self_rows = row0 + r8
+            peer_rows = 8 * gm_ref[g0 + g] + ((r8 + 8 - cg) & 7)
+            mv_b = mv_ref[:]
+            w_self = jnp.where(col == self_rows, mv_b, w_self)
+            w_peer = jnp.where(col == peer_rows, mv_b, w_peer)
+        d = jnp.maximum(w_peer - w_self, 0) * vcol
+        tot_ref[sl, :] = jnp.sum(d.astype(jnp.float32), axis=1, keepdims=True)
+
+
 VMEM_BUDGET = 12 * 1024 * 1024  # ~16 MB/core, minus headroom for Mosaic
 
-# (block, n)-sized VMEM buffers per matrix: pipelined in + out blocks
+# (block, n_cols)-sized VMEM buffers per matrix: pipelined in + out blocks
 # (double-buffered, x2 each) plus one gather scratch -> 5; the lean
 # (w-only) mode halves the total.
 def _buffers(track_hb: bool) -> int:
     return 10 if track_hb else 5
 
 
-def largest_fitting_block(n: int, per_row_bytes: int, cap: int = 512) -> int | None:
+def largest_fitting_block(
+    n: int, per_row_bytes: int, cap: int = 512, fixed_bytes: int = 0
+) -> int | None:
     """Largest multiple-of-8 divisor of n whose row count times
-    ``per_row_bytes`` fits the VMEM budget. Shared block-search scaffold
-    for every streaming kernel (this one and pallas_fd)."""
-    limit = min(cap, VMEM_BUDGET // max(per_row_bytes, 1))
+    ``per_row_bytes`` (plus block-size-independent ``fixed_bytes`` —
+    broadcast vector rows and the like) fits the VMEM budget. Shared
+    block-search scaffold for every streaming kernel (this one and
+    pallas_fd)."""
+    limit = min(cap, max(VMEM_BUDGET - fixed_bytes, 0) // max(per_row_bytes, 1))
     best = None
     for b in range(8, limit + 1, 8):
         if n % b == 0:
@@ -218,21 +311,47 @@ def largest_fitting_block(n: int, per_row_bytes: int, cap: int = 512) -> int | N
 
 
 def _pick_block(
-    n: int, itemsize: int = 4, cap: int = 512, track_hb: bool = True
+    n: int,
+    itemsize: int = 4,
+    cap: int = 512,
+    track_hb: bool = True,
+    n_cols: int | None = None,
 ) -> int | None:
-    """Largest multiple-of-8 divisor of n such that every VMEM-resident
-    buffer set fits the per-core budget."""
-    return largest_fitting_block(n, _buffers(track_hb) * n * itemsize, cap)
+    """Largest multiple-of-8 divisor of the ROW count ``n`` such that
+    every VMEM-resident buffer set fits the per-core budget. ``n_cols``
+    is the block width (the shard's local column count; defaults to the
+    unsharded square case n_cols = n).
+
+    Beyond the (block, n_cols) matrix buffers, the search budgets the
+    small operands too (same strict-conservatism rule as
+    pallas_fd._fixed_bytes): the valid and totals columns are
+    lane-padded to (block, 128) — per-row bytes — and the mv/hbv
+    broadcast rows are sublane-padded (1 -> 8 rows) int32, a
+    block-size-independent fixed cost. All double-buffered."""
+    width = n if n_cols is None else n_cols
+    # valid (int8) + totals (f32) columns, padded to 128 lanes, x2.
+    per_row = _buffers(track_hb) * width * itemsize + 2 * 128 * (1 + 4)
+    # mv (+hbv when heartbeats ride along) broadcast rows, 8-sublane
+    # padded int32, x2 — counted unconditionally (worst case: diag on).
+    fixed = (2 if track_hb else 1) * 2 * 8 * 4 * width
+    return largest_fitting_block(n, per_row, cap, fixed)
 
 
-def supported(n: int, itemsize: int, track_hb: bool = True) -> bool:
+def supported(
+    n: int, itemsize: int, track_hb: bool = True, n_local: int | None = None
+) -> bool:
     """Whether the fused kernel can run this shape (callers fall back to
     the XLA path when not). Requires the grouped-matching family
-    (n % 8 == 0 rows), lane-aligned manual DMA (n % 128 == 0 columns —
-    Mosaic rejects copies of partial 128-lane tiles, and a non-multiple
-    column count is a partial tile of the padded memref), and a legal
-    VMEM block."""
-    return n % 128 == 0 and _pick_block(n, itemsize, track_hb=track_hb) is not None
+    (n % 8 == 0 rows), lane-aligned manual DMA on the LOCAL column count
+    (n_local % 128 == 0 — Mosaic rejects copies of partial 128-lane
+    tiles, and a non-multiple column count is a partial tile of the
+    padded memref; n_local = n unsharded), and a legal VMEM block."""
+    width = n if n_local is None else n_local
+    return (
+        n % 128 == 0
+        and width % 128 == 0
+        and _pick_block(n, itemsize, track_hb=track_hb, n_cols=width) is not None
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("budget", "interpret"))
@@ -248,6 +367,8 @@ def fused_pull_m8(
     interpret: bool = False,
     mv: jax.Array | None = None,
     hbv: jax.Array | None = None,
+    owner_offset: jax.Array | int = 0,
+    totals: jax.Array | None = None,
 ):
     """One fused grouped-matching sub-exchange. Returns (w', hb'), or
     just w' when ``hb`` is None (the lean convergence-only profile: no
@@ -256,11 +377,18 @@ def fused_pull_m8(
 
     ``gm``/``c`` come from gossip._grouped_matching; ``valid`` is the
     per-row alive-pair mask (alive & alive[p]). Passing ``mv`` (owner
-    max_version, (N,) int32; plus ``hbv``, owner heartbeats, when hb is
-    tracked) folds the round's owner-diagonal refresh into this call —
-    the caller must then NOT pre-apply the diagonal select, and should
-    pass the vectors only on the round's FIRST sub-exchange (later ones
-    see the refreshed diagonal in w itself).
+    max_version, (n_local,) int32; plus ``hbv``, owner heartbeats, when
+    hb is tracked) folds the round's owner-diagonal refresh into this
+    call — the caller must then NOT pre-apply the diagonal select, and
+    should pass the vectors only on the round's FIRST sub-exchange
+    (later ones see the refreshed diagonal in w itself).
+
+    Column sharding (the two-pass sharded path): ``w`` may be a
+    (N, n_local) column block of the global matrix. Pass this shard's
+    ``owner_offset`` (global owner id of local column 0) and ``totals``
+    — the rows' GLOBAL deficit totals from fused_pull_totals_m8, psum'd
+    across shards. Rows stay unsharded, so the peer DMA never leaves
+    the shard.
     """
     track_hb = hb is not None
     apply_diag = mv is not None
@@ -270,19 +398,20 @@ def fused_pull_m8(
         raise ValueError("hbv given but no hb matrix to refresh (lean mode)")
     if hbv is not None and mv is None:
         raise ValueError("hbv given without mv: the diagonal refresh is all-or-none")
-    n = w.shape[0]
+    n_rows, n_cols = w.shape
+    use_totals = totals is not None
     itemsize = w.dtype.itemsize
     if track_hb:
         itemsize = max(itemsize, hb.dtype.itemsize)
-    block = _pick_block(n, itemsize, track_hb=track_hb)
-    if block is None or n % 128 != 0:
-        raise ValueError(f"no suitable row block for n={n}")
+    block = _pick_block(n_rows, itemsize, track_hb=track_hb, n_cols=n_cols)
+    if block is None or n_rows % 128 != 0 or n_cols % 128 != 0:
+        raise ValueError(f"no suitable row block for shape {w.shape}")
     if not track_hb:
         # Minimal-tile dummies keep the kernel signature fixed without
         # spending VMEM (same trick the round-1 kernel used).
         hb = jnp.zeros((16, 128), w.dtype)
     hb_spec = (
-        pl.BlockSpec((block, n), lambda i, *_: (i, 0))
+        pl.BlockSpec((block, n_cols), lambda i, *_: (i, 0))
         if track_hb
         else pl.BlockSpec((16, 128), lambda i, *_: (0, 0))
     )
@@ -291,8 +420,15 @@ def fused_pull_m8(
             salt.astype(jnp.int32),
             run_salt.astype(jnp.int32),
             jnp.asarray(budget, jnp.int32),
+            jnp.asarray(owner_offset, jnp.int32),
         ]
     )
+    if use_totals:
+        totals = totals.astype(jnp.float32).reshape(n_rows, 1)
+        tot_spec = pl.BlockSpec((block, 1), lambda i, *_: (i, 0))
+    else:
+        totals = jnp.zeros((16, 128), jnp.float32)
+        tot_spec = pl.BlockSpec((16, 128), lambda i, *_: (0, 0))
     if apply_diag:
         mv = mv.astype(jnp.int32)[None, :]
         hbv = (
@@ -300,7 +436,7 @@ def fused_pull_m8(
             if track_hb
             else jnp.zeros((1, 128), jnp.int32)
         )
-        vec_spec = pl.BlockSpec((1, n), lambda i, *_: (0, 0))
+        vec_spec = pl.BlockSpec((1, n_cols), lambda i, *_: (0, 0))
         hbv_spec = vec_spec if track_hb else pl.BlockSpec(
             (1, 128), lambda i, *_: (0, 0)
         )
@@ -310,28 +446,34 @@ def fused_pull_m8(
         vec_spec = hbv_spec = pl.BlockSpec((1, 128), lambda i, *_: (0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(n // block,),
+        grid=(n_rows // block,),
         in_specs=[
-            pl.BlockSpec((block, n), lambda i, *_: (i, 0)),  # w block
+            pl.BlockSpec((block, n_cols), lambda i, *_: (i, 0)),  # w block
             hb_spec,  # hb block (dummy tile when lean)
             pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),  # valid col
+            tot_spec,  # global totals col (dummy tile when unused)
             vec_spec,  # mv row (dummy tile when diag off)
             hbv_spec,  # heartbeat row (dummy tile when diag off / lean)
             pl.BlockSpec(memory_space=pl.ANY),  # w HBM (gather source)
             pl.BlockSpec(memory_space=pl.ANY),  # hb HBM
         ],
         out_specs=[
-            pl.BlockSpec((block, n), lambda i, *_: (i, 0)),
+            pl.BlockSpec((block, n_cols), lambda i, *_: (i, 0)),
             hb_spec,
         ],
         scratch_shapes=[
-            pltpu.VMEM((block, n), w.dtype),
-            pltpu.VMEM((block, n) if track_hb else (16, 128), hb.dtype),
+            pltpu.VMEM((block, n_cols), w.dtype),
+            pltpu.VMEM((block, n_cols) if track_hb else (16, 128), hb.dtype),
             pltpu.SemaphoreType.DMA((2, block // 8)),
         ],
     )
     kernel = functools.partial(
-        _m8_kernel, block=block, n=n, track_hb=track_hb, apply_diag=apply_diag
+        _m8_kernel,
+        block=block,
+        n=n_cols,
+        track_hb=track_hb,
+        apply_diag=apply_diag,
+        use_totals=use_totals,
     )
     w_new, hb_new = pl.pallas_call(
         kernel,
@@ -348,9 +490,76 @@ def fused_pull_m8(
         w,
         hb,
         valid.astype(jnp.int8)[:, None],
+        totals,
         mv,
         hbv,
         w,
         hb,
     )
     return (w_new, hb_new) if track_hb else w_new
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_pull_totals_m8(
+    w: jax.Array,
+    gm: jax.Array,
+    c: jax.Array,
+    valid: jax.Array,
+    interpret: bool = False,
+    mv: jax.Array | None = None,
+    owner_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Pass A of the sharded fused pull: (N,) f32 LOCAL deficit row
+    totals for this shard's (N, n_local) column block, one streamed
+    read. The caller psums the result across shards and passes it to
+    fused_pull_m8 as ``totals``; between them they reproduce the XLA
+    sharded path's ``psum(d.sum(axis=1))`` bit-for-bit (integer-valued
+    f32 sums are exact below 2^24).
+
+    Pass ``mv`` on the round's first sub-exchange so the totals see the
+    owner-diagonal refresh, exactly as the apply pass will."""
+    apply_diag = mv is not None
+    n_rows, n_cols = w.shape
+    block = _pick_block(n_rows, w.dtype.itemsize, track_hb=False, n_cols=n_cols)
+    if block is None or n_rows % 128 != 0 or n_cols % 128 != 0:
+        raise ValueError(f"no suitable row block for shape {w.shape}")
+    meta = jnp.asarray(owner_offset, jnp.int32)[None]
+    if apply_diag:
+        mv = mv.astype(jnp.int32)[None, :]
+        vec_spec = pl.BlockSpec((1, n_cols), lambda i, *_: (0, 0))
+    else:
+        mv = jnp.zeros((1, 128), jnp.int32)
+        vec_spec = pl.BlockSpec((1, 128), lambda i, *_: (0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n_rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, n_cols), lambda i, *_: (i, 0)),  # w block
+            pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),  # valid col
+            vec_spec,  # mv row (dummy tile when diag off)
+            pl.BlockSpec(memory_space=pl.ANY),  # w HBM (gather source)
+        ],
+        out_specs=[pl.BlockSpec((block, 1), lambda i, *_: (i, 0))],
+        scratch_shapes=[
+            pltpu.VMEM((block, n_cols), w.dtype),
+            pltpu.SemaphoreType.DMA((block // 8,)),
+        ],
+    )
+    kernel = functools.partial(
+        _m8_totals_kernel, block=block, n=n_cols, apply_diag=apply_diag
+    )
+    (tot,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((n_rows, 1), jnp.float32)],
+        interpret=interpret,
+    )(
+        gm.astype(jnp.int32),
+        c.astype(jnp.int32),
+        meta,
+        w,
+        valid.astype(jnp.int8)[:, None],
+        mv,
+        w,
+    )
+    return tot[:, 0]

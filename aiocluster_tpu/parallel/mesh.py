@@ -20,7 +20,13 @@ import jax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..ops.gossip import convergence_metrics, sim_step
+from ..ops.gossip import (
+    all_converged_flag,
+    convergence_metrics,
+    pallas_fd_engaged,
+    pallas_path_engaged,
+    sim_step,
+)
 from ..sim.config import SimConfig
 from ..sim.state import SimState
 
@@ -58,6 +64,24 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
     )
 
 
+def _check_vma(cfg: SimConfig, mesh: Mesh, topology: bool) -> bool:
+    """Keep shard_map's varying-manual-axes checker ON except when a
+    Pallas kernel engages for this config: the checker cannot see
+    through pallas_call's internal block slicing (interpret mode trips
+    "dynamic_slice requires varying manual axes to match"; the JAX
+    error text itself prescribes check_vma=False). Pure-XLA sharded
+    runs keep the static safety net (ADVICE r2); kernel configs rely on
+    the stronger bit-identity tests (tests/test_sim_sharded.py,
+    tests/test_pallas_fd.py, tests/test_pallas_sharded.py)."""
+    n_local = cfg.n_nodes // mesh.size
+    return not (
+        pallas_fd_engaged(cfg, n_local)
+        or pallas_path_engaged(
+            cfg, AXIS, has_topology=topology, n_local=n_local
+        )
+    )
+
+
 def sharded_chunk_fn(
     cfg: SimConfig, mesh: Mesh, rounds: int = 1, *, topology: bool = False
 ):
@@ -86,18 +110,12 @@ def sharded_chunk_fn(
             unroll=False,
         )
 
-    # check_vma=False: the varying-mesh-axes checker cannot see through
-    # pallas_call's internal block slicing (interpret mode trips
-    # "dynamic_slice requires varying manual axes to match"; the JAX
-    # error text itself prescribes this workaround). Shard correctness
-    # is asserted far more strongly by the bit-identity tests
-    # (tests/test_sim_sharded.py, tests/test_pallas_fd.py).
     fn = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, P(), *extra_specs),
         out_specs=spec,
-        check_vma=False,
+        check_vma=_check_vma(cfg, mesh, topology),
     )
     return jax.jit(fn, donate_argnums=(0,))
 
@@ -105,6 +123,47 @@ def sharded_chunk_fn(
 def sharded_step_fn(cfg: SimConfig, mesh: Mesh, *, topology: bool = False):
     """shard_map'd single-round step: (state, key[, adj, deg]) -> state."""
     return sharded_chunk_fn(cfg, mesh, 1, topology=topology)
+
+
+def sharded_tracked_chunk_fn(
+    cfg: SimConfig, mesh: Mesh, rounds: int = 1, *, topology: bool = False
+):
+    """Like sharded_chunk_fn, but the chunk also returns the EXACT tick
+    at which full convergence was first observed inside it (0 = not in
+    this chunk) — the sharded half of the chunk-invariant
+    rounds-to-convergence contract (Simulator.run_until_converged).
+    The per-round check is one fused read of w plus a scalar pmin."""
+    from jax import lax
+
+    import jax.numpy as jnp
+
+    spec = state_partition_spec()
+    extra_specs = (P(None, None), P(None)) if topology else ()
+
+    def body(state: SimState, key: jax.Array, *topo):
+        adj, deg = topo if topology else (None, None)
+
+        def one(_, carry):
+            st, first = carry
+            st = sim_step(
+                st, key, cfg, axis_name=AXIS, adjacency=adj, degrees=deg
+            )
+            conv = all_converged_flag(st, AXIS)
+            first = jnp.where((first == 0) & conv, st.tick, first)
+            return st, first
+
+        return lax.fori_loop(
+            0, rounds, one, (state, jnp.zeros((), jnp.int32)), unroll=False
+        )
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, P(), *extra_specs),
+        out_specs=(spec, P()),
+        check_vma=_check_vma(cfg, mesh, topology),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 def sharded_metrics_fn(mesh: Mesh):

@@ -133,11 +133,14 @@ class SimConfig:
     # round rate at 10k nodes on a v5e chip. "auto" (default) enables it
     # on real TPU backends and stays on XLA elsewhere (interpret mode is
     # only for tests); True forces it (interpreted off-TPU), False
-    # disables. Only single-device, matching pairing, n % 128 == 0,
-    # proportional budget, no dead-node lifecycle qualify — other
-    # configs use the XLA path regardless. Both storage profiles do:
-    # with heartbeats the kernel fuses w and hb; the lean
-    # convergence-only profile runs a w-only variant.
+    # disables. Matching pairing, n % 128 == 0, proportional budget, no
+    # dead-node lifecycle qualify — other configs use the XLA path
+    # regardless. Column-sharded runs qualify too when every shard's
+    # column block is lane-aligned (n_local % 128 == 0): a two-pass
+    # kernel + one psum reproduces the global budget exactly, and a
+    # one-shard mesh short-circuits to the single-pass form. Both
+    # storage profiles do: with heartbeats the kernel fuses w and hb;
+    # the lean convergence-only profile runs a w-only variant.
     use_pallas: bool | str = "auto"
 
     def __post_init__(self) -> None:

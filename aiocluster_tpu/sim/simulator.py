@@ -16,11 +16,12 @@ from jax import lax, random
 from jax.sharding import Mesh
 
 from ..models.topology import Topology
-from ..ops.gossip import convergence_metrics, sim_step
+from ..ops.gossip import all_converged_flag, convergence_metrics, sim_step
 from ..parallel.mesh import (
     shard_state,
     sharded_chunk_fn,
     sharded_metrics_fn,
+    sharded_tracked_chunk_fn,
 )
 from .config import SimConfig
 from .state import SimState, init_state
@@ -35,6 +36,24 @@ def _chunk(state: SimState, key: jax.Array, cfg: SimConfig, m: int,
         lambda _, s: sim_step(s, key, cfg, adjacency=adjacency, degrees=degrees),
         state,
     )
+
+
+@partial(jax.jit, static_argnames=("cfg", "m"), donate_argnums=(0,))
+def _chunk_tracked(state: SimState, key: jax.Array, cfg: SimConfig, m: int,
+                   adjacency=None, degrees=None):
+    """m rounds + the EXACT tick at which full convergence first held
+    inside the chunk (0 = didn't). One extra fused read of w per round
+    — only run_until_converged pays it; rate measurement (run) doesn't."""
+    import jax.numpy as jnp
+
+    def one(_, carry):
+        s, first = carry
+        s = sim_step(s, key, cfg, adjacency=adjacency, degrees=degrees)
+        conv = all_converged_flag(s)
+        first = jnp.where((first == 0) & conv, s.tick, first)
+        return s, first
+
+    return lax.fori_loop(0, m, one, (state, jnp.zeros((), jnp.int32)))
 
 
 class Simulator:
@@ -83,6 +102,7 @@ class Simulator:
         if mesh is not None:
             self.state = shard_state(self.state, mesh)
             self._sharded_chunks: dict[int, object] = {}
+            self._sharded_tracked: dict[int, object] = {}
             self._sharded_metrics = sharded_metrics_fn(mesh)
 
     def _sharded_chunk(self, m: int):
@@ -93,6 +113,16 @@ class Simulator:
                 self.cfg, self._mesh, m, topology=self._adj is not None
             )
             self._sharded_chunks[m] = fn
+        return fn
+
+    def _sharded_tracked_chunk(self, m: int):
+        """Convergence-tracking variant, cached per chunk length."""
+        fn = self._sharded_tracked.get(m)
+        if fn is None:
+            fn = sharded_tracked_chunk_fn(
+                self.cfg, self._mesh, m, topology=self._adj is not None
+            )
+            self._sharded_tracked[m] = fn
         return fn
 
     # -- stepping -------------------------------------------------------------
@@ -119,11 +149,29 @@ class Simulator:
 
     def run_until_converged(self, max_rounds: int = 100_000) -> int | None:
         """Step until every alive node holds every alive owner's full
-        keyspace; returns the round count, or None if max_rounds elapsed."""
+        keyspace; returns the EXACT first round at which that held (the
+        check runs inside the chunk every round, so the count is
+        invariant to ``chunk``), or None if max_rounds elapsed."""
+        if bool(self.metrics()["all_converged"]):
+            return int(self.state.tick)  # converged before any stepping
         while int(self.state.tick) < max_rounds:
-            self.run(self.chunk)
-            if bool(self.metrics()["all_converged"]):
-                return int(self.state.tick)
+            m = min(self.chunk, max_rounds - int(self.state.tick))
+            if self._mesh is not None:
+                args = (
+                    (self.state, self._key, self._adj, self._deg)
+                    if self._adj is not None
+                    else (self.state, self._key)
+                )
+                self.state, first = self._sharded_tracked_chunk(m)(*args)
+            else:
+                self.state, first = _chunk_tracked(
+                    self.state, self._key, self.cfg, m, self._adj, self._deg
+                )
+            if self._trace_enabled:
+                self._record_trace()
+            first = int(first)
+            if first:
+                return first
         return None
 
     # -- observation ----------------------------------------------------------

@@ -220,23 +220,45 @@ def _start_watchdog(metric: str) -> None:
     t.start()
 
 
-def anchored_asyncio_seconds(log) -> float | None:
-    """Real measured socket-backend anchor: 3-node loopback convergence
-    (BASELINE.md config 1, reference examples/simple.py shape)."""
+def _run_benchmarks_helper(module: str, func: str, log, *args, **kwargs):
+    """Import ``benchmarks/<module>.py`` under a temporary sys.path entry
+    and call ``func`` — the one scaffold for every measured-anchor probe
+    below; a failure logs and returns None (the bench record reports
+    what it could measure, never dies on an anchor)."""
     bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "benchmarks")
     sys.path.insert(0, bench_dir)
     try:
-        from run_all import config1
+        import importlib
 
-        record = config1(smoke=False)
-        log(f"anchored asyncio 3-node convergence: {record['value']}s")
-        return float(record["value"])
+        fn = getattr(importlib.import_module(module), func)
+        return fn(*args, **kwargs)
     except Exception as exc:
-        log(f"anchored asyncio measurement failed: {exc!r}")
+        log(f"{module}.{func} measurement failed: {exc!r}")
         return None
     finally:
         sys.path.remove(bench_dir)
+
+
+def anchored_asyncio_seconds(log) -> float | None:
+    """Real measured socket-backend anchor: 3-node loopback convergence
+    (BASELINE.md config 1, reference examples/simple.py shape)."""
+    record = _run_benchmarks_helper("run_all", "config1", log, smoke=False)
+    if record is None:
+        return None
+    log(f"anchored asyncio 3-node convergence: {record['value']}s")
+    return float(record["value"])
+
+
+def measured_reference_baseline(log) -> dict | None:
+    """The ACTUAL reference library (/root/reference), run live as a
+    64-node loopback cluster, measured in sim-equivalent rounds/s and
+    time-to-convergence (VERDICT r2 item 6: report a measured datum
+    next to the extrapolation, using the same interop machinery that
+    already gossips with the reference in tests)."""
+    return _run_benchmarks_helper(
+        "reference_baseline", "measure", log, 64, log=log
+    )
 
 
 # Published HBM bandwidth by PJRT device_kind (the axon tunnel reports
@@ -494,16 +516,40 @@ def main() -> None:
             except Exception as exc:  # keep the headline even if the probe dies
                 log(f"scale probe failed: {exc!r}")
         anchored = None if args.smoke else anchored_asyncio_seconds(log)
+        ref_measured = None if args.smoke else measured_reference_baseline(log)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
         # (round-1 failure mode).
         tpu_note = None
+        last_onchip = None
         if not on_accel and not args.smoke and requested == "auto":
             tpu_note = (
                 "accelerator unreachable at run time; last on-chip record: "
                 "benchmarks/records/ (see its README for provenance)"
             )
+            # Embed the last committed on-chip bench record VERBATIM so a
+            # down tunnel can never reduce the certified artifact to a
+            # CPU number with a prose pointer (round-1/2 failure mode):
+            # the machine-readable on-chip evidence rides every fallback
+            # record, with its commit + timestamp provenance.
+            # latest_onchip.json is refreshed by every on-chip battery
+            # run (_r3_measure.py) and seeded from the round-2 certified
+            # record, so the chain never goes empty.
+            records_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks", "records",
+            )
+            for name in ("latest_onchip.json", "r02_builder_tpu_10240.json"):
+                try:
+                    with open(os.path.join(records_dir, name)) as f:
+                        last_onchip = json.load(f)
+                    break
+                except Exception as exc:
+                    log(f"on-chip record {name} unavailable: {exc!r}")
+            if last_onchip is None:
+                log("NO on-chip record embedded — fallback artifact is "
+                    "CPU-only (should not happen: records/ is committed)")
         result = {
             "metric": metric,
             "value": round(rps, 2),
@@ -512,10 +558,16 @@ def main() -> None:
             "extra": {
                 "platform": platform,
                 **({"tpu_note": tpu_note} if tpu_note else {}),
+                **({"last_onchip": last_onchip} if last_onchip else {}),
                 "rounds_to_convergence": converged_at,
                 "baseline_kind": "extrapolated_python_object_model_estimate",
                 "python_object_model_rounds_per_sec_est": round(baseline_rps, 4),
                 "anchored_asyncio_3node_convergence_s": anchored,
+                # The real reference library, measured live (64-node
+                # loopback): both its test-interval behavior and its
+                # compute-bound ceiling — the extrapolated vs_baseline
+                # above now sits next to a measured datum.
+                "measured_reference_library": ref_measured,
                 "keys_per_node": 16,
                 "fanout": 3,
                 "budget": _budget(),

@@ -66,7 +66,13 @@ def main() -> None:
     from aiocluster_tpu.sim import Simulator
     from aiocluster_tpu.sim.memory import lean_config, plan
 
-    n = args.nodes - args.nodes % args.devices  # even shards
+    # Same population quantum as benchmarks/run_all.py config 5: round
+    # UP to a multiple of 128 * devices so every shard's column block is
+    # lane-aligned — the executed shapes are config 5 exactly as the
+    # bench scripts it (the kernel gate resolves to XLA on CPU; the
+    # sharded kernel path itself is interpret-verified in tests).
+    quantum = 128 * args.devices
+    n = max(quantum, ((args.nodes + quantum - 1) // quantum) * quantum)
     cfg = lean_config(n)
     mem = plan(cfg, shards=args.devices)
     devices = jax.devices()[: args.devices]

@@ -61,7 +61,7 @@ def _kernel_variant(
     salt = meta_ref[0]
     run_salt = meta_ref[1]
     budget = meta_ref[2].astype(jnp.float32)
-    r_k1, js = pp._dither_base((8, n), salt, run_salt)
+    r_k1, js = pp._dither_base((8, n), salt, run_salt, jnp.uint32(0))
 
     for g in range(gpb):
         src = gm_ref[g0 + g] * 8

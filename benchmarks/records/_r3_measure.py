@@ -1,0 +1,413 @@
+"""Round-3 on-chip measurement battery (one-shot; run when the tunnel
+is up — benchmarks/records/_r3_tunnel_watch.py spawns it on the
+down->up transition, or run it by hand after kernel changes).
+
+Phases (each independently checkpointed to r3_measurements.json so a
+mid-battery tunnel drop keeps everything finished so far):
+
+1. bench_full     — `python bench.py` at HEAD (headline, pallas
+                    speedup, FD kernel, roofline, 32k lean probe,
+                    measured reference baseline, exact convergence).
+2. lean_scaling   — exact rounds-to-convergence + rounds/s at
+                    1k/4k/10k/32k (+ largest single-chip N), lean
+                    profile, MTU budget: the measured curve the
+                    <60 s @ 100k projection is anchored to
+                    (VERDICT r2 item 3).
+3. sharded_1dev   — the BASELINE config-5 script path on a 1-device
+                    mesh at 32k lean: proves the sharded code path
+                    engages the fused kernel on the real chip
+                    (VERDICT r2 item 1's measured half).
+4. i16_experiment — the parked i16-arithmetic kernel experiment
+                    (VERDICT r2 item 2 tail).
+5. churn_kernel_ceiling — how much a kernel could possibly win at the
+                    config-3 scale (n=1024): fused vs XLA on the
+                    matching/no-lifecycle config, plus the actual
+                    config-3 (choice+view+lifecycle) rate
+                    (VERDICT r2 item 5).
+6. scatter_share  — the choice-path responder scatter-max's share of a
+                    config-4 style round at 10,240 (VERDICT r2 item 7).
+
+Timing discipline (memory: axon-tunnel-measurement): subprocess probes,
+pipelined chunks, scalar-readback barriers, best-of-N trials.
+
+Builder-side tooling (not part of the shipped package).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(HERE, "r3_measurements.json")
+
+
+def log(msg: str) -> None:
+    print(f"[r3measure] {msg}", file=sys.stderr, flush=True)
+
+
+def _git_head() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:
+        return "?"
+
+
+out: dict = {}
+
+
+def checkpoint() -> None:
+    with open(OUT + ".tmp", "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(OUT + ".tmp", OUT)
+
+
+def _sync(x) -> int:
+    import numpy as np
+
+    return int(np.asarray(x))
+
+
+def _rate(sim, rounds=128, chunk=16, trials=3) -> float:
+    """Best-of-N pipelined rounds/s with scalar-readback barriers."""
+    sim.run(chunk)
+    _sync(sim.state.tick)
+    best = 0.0
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        sim.run(rounds)
+        _sync(sim.state.tick)
+        best = max(best, rounds / (time.perf_counter() - t0))
+    return round(best, 2)
+
+
+# -- phase 1: full bench.py ---------------------------------------------------
+
+
+def phase_bench_full() -> dict:
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=2400, cwd=REPO,
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    rec = {"rc": proc.returncode, "stderr_tail": proc.stderr[-1500:]}
+    try:
+        rec["record"] = json.loads(line)
+    except Exception:
+        rec["stdout_tail"] = proc.stdout[-1500:]
+    # A real on-chip run also refreshes the stable pointer bench.py
+    # embeds into CPU-fallback records (the headline must survive a
+    # down tunnel — VERDICT r2 weak item 1).
+    if (
+        proc.returncode == 0
+        and rec.get("record", {}).get("extra", {}).get("platform")
+        not in (None, "cpu")
+    ):
+        latest = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "head": _git_head(),
+            "source": "full bench.py run on the real chip "
+                      "(benchmarks/records/_r3_measure.py phase 1)",
+            "record": rec["record"],
+        }
+        path = os.path.join(HERE, "latest_onchip.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(latest, f, indent=1)
+        os.replace(path + ".tmp", path)
+        log(f"refreshed {path}")
+    return rec
+
+
+# -- phase 2: lean scaling curve ----------------------------------------------
+
+
+def _lean(n, **kw):
+    from aiocluster_tpu.sim import budget_from_mtu
+    from aiocluster_tpu.sim.memory import lean_config
+
+    return lean_config(n, budget=budget_from_mtu(65_507), **kw)
+
+
+def phase_lean_scaling() -> dict:
+    from aiocluster_tpu.sim import Simulator
+    from aiocluster_tpu.sim.memory import plan
+
+    # Largest single-chip-fitting lean N on the kernel domain (mirrors
+    # run_all._fit_population for 1 device / 12 GiB).
+    n_max = 52_096
+    assert plan(_lean(n_max)).per_shard_bytes <= (12 << 30)
+    points = []
+    for n in (1024, 4096, 10_240, 32_768, n_max):
+        t0 = time.perf_counter()
+        sim = Simulator(_lean(n), seed=1, chunk=16)
+        rounds = sim.run_until_converged(max_rounds=2048)
+        wall = time.perf_counter() - t0
+        rate = _rate(Simulator(_lean(n), seed=0, chunk=16),
+                     rounds=64 if n >= 32_768 else 128)
+        points.append(
+            {"n": n, "rounds_to_convergence": rounds,
+             "convergence_wall_s": round(wall, 2),
+             "rounds_per_sec": rate}
+        )
+        log(f"lean n={n}: converged {rounds} rounds, {rate} rounds/s")
+        out["lean_scaling"] = {"points": points}  # partial
+        checkpoint()
+    return {"points": points, **_northstar_projection(points)}
+
+
+def _northstar_projection(points: list[dict]) -> dict:
+    """The explicit <60 s @ 100k arithmetic from the measured curve
+    (VERDICT r2 item 3): rounds@100k from a least-squares linear fit of
+    the EXACT convergence counts (the budget-bound regime is linear in
+    N: total deficit/row = 16(N-1) against a fixed per-round budget),
+    times a per-round time derived from the measured achieved HBM
+    throughput at the largest single-chip point — each v5e-8 shard
+    handles 1/8 of the per-round traffic over its own HBM; the psum is
+    (N,) f32, noise by comparison."""
+    import numpy as np
+
+    pts = [p for p in points if p["rounds_to_convergence"] is not None]
+    if len(pts) < 2:
+        return {"projection": None}
+    ns = np.array([p["n"] for p in pts], float)
+    rs = np.array([p["rounds_to_convergence"] for p in pts], float)
+    b, a = np.polyfit(ns, rs, 1)  # rounds ~ b*n + a
+    n_star = 100_352  # config 5's 128x8-aligned 100k population
+    rounds_100k = float(b * n_star + a)
+    # Measured achieved throughput at the largest point: lean matching
+    # traffic = fanout x 3 passes x N^2 x 2 B per round.
+    big = max(pts, key=lambda p: p["n"])
+    bytes_per_round = 3 * 3 * big["n"] ** 2 * 2
+    achieved_gbps = bytes_per_round * big["rounds_per_sec"] / 1e9
+    shard_bytes_100k = 3 * 3 * n_star**2 * 2 / 8
+    s_per_round_8shard = shard_bytes_100k / (achieved_gbps * 1e9)
+    total_s = rounds_100k * s_per_round_8shard
+    return {
+        "projection": {
+            "fit_rounds_per_node": round(b, 6),
+            "fit_intercept": round(a, 2),
+            "n_star": n_star,
+            "predicted_rounds_to_convergence": round(rounds_100k, 1),
+            "measured_achieved_gb_per_sec@largest": round(achieved_gbps, 1),
+            "projected_seconds_per_round_v5e8": round(s_per_round_8shard, 4),
+            "projected_total_seconds_v5e8": round(total_s, 1),
+            "north_star_target_seconds": 60.0,
+            "meets_target": bool(total_s < 60.0),
+            "arithmetic": (
+                f"rounds({n_star}) = {b:.3e}*N + {a:.1f} = "
+                f"{rounds_100k:.0f}; bytes/round/shard = 9*N^2*2/8 = "
+                f"{shard_bytes_100k / 1e9:.1f} GB at the measured "
+                f"{achieved_gbps:.0f} GB/s -> "
+                f"{s_per_round_8shard * 1e3:.0f} ms/round; total "
+                f"{total_s:.0f} s"
+            ),
+        }
+    }
+
+
+# -- phase 3: config-5 path on one device -------------------------------------
+
+
+def phase_sharded_1dev() -> dict:
+    import jax
+
+    from aiocluster_tpu.ops.gossip import pallas_path_engaged
+    from aiocluster_tpu.parallel.mesh import make_mesh
+    from aiocluster_tpu.sim import Simulator
+
+    n = 32_768
+    cfg = _lean(n)
+    mesh = make_mesh(jax.devices()[:1])
+    engaged = pallas_path_engaged(cfg, "owners", n_local=n)
+    sim = Simulator(cfg, seed=0, mesh=mesh, chunk=16)
+    rate = _rate(sim, rounds=64)
+    # Same through the unsharded path for the apples-to-apples delta.
+    rate_unsharded = _rate(Simulator(cfg, seed=0, chunk=16), rounds=64)
+    # And the XLA sharded path (kernel off) for the kernel's win here.
+    rate_xla = _rate(
+        Simulator(dataclasses.replace(cfg, use_pallas=False), seed=0,
+                  mesh=mesh, chunk=16),
+        rounds=64,
+    )
+    return {
+        "n": n,
+        "kernel_engaged_sharded": engaged,
+        "rounds_per_sec_sharded_mesh1": rate,
+        "rounds_per_sec_unsharded": rate_unsharded,
+        "rounds_per_sec_sharded_xla": rate_xla,
+        "note": "mesh(1): shard_map path with the single-pass kernel "
+                "(S==1 short-circuit); the multi-shard two-pass is "
+                "interpret-verified bit-identical in tests",
+    }
+
+
+# -- phase 4: i16 kernel experiment -------------------------------------------
+
+
+def phase_i16() -> dict:
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "_i16_kernel_experiment.py")],
+        capture_output=True, text=True, timeout=1200, cwd=REPO,
+    )
+    return {
+        "rc": proc.returncode,
+        "stdout": proc.stdout[-3000:],
+        "stderr_tail": proc.stderr[-800:],
+    }
+
+
+# -- phase 5: kernel ceiling at the churn scale -------------------------------
+
+
+def phase_churn_kernel_ceiling() -> dict:
+    from aiocluster_tpu.sim import SimConfig, Simulator, budget_from_mtu
+
+    budget = budget_from_mtu(65_507)
+    # The actual config-3 shape (choice + view + lifecycle; XLA-only).
+    churn = SimConfig(
+        n_nodes=1000, keys_per_node=16, fanout=3, budget=budget,
+        death_rate=0.05, revival_rate=0.2, writes_per_round=1,
+        peer_mode="view", pairing="choice", dead_grace_ticks=40,
+    )
+    churn_rate = _rate(Simulator(churn, seed=0, chunk=16))
+    # Kernel-eligible twin at n=1024 (matching, no lifecycle): fused vs
+    # XLA bounds what ANY kernel work could buy at this scale.
+    base = dict(n_nodes=1024, keys_per_node=16, fanout=3, budget=budget,
+                death_rate=0.05, revival_rate=0.2, writes_per_round=1)
+    fused = _rate(Simulator(SimConfig(**base), seed=0, chunk=16))
+    xla = _rate(
+        Simulator(SimConfig(**base, use_pallas=False), seed=0, chunk=16)
+    )
+    win = (fused - xla) / xla if xla else None
+    return {
+        "config3_choice_view_lifecycle_rounds_per_sec": churn_rate,
+        "matching_1024_fused_rounds_per_sec": fused,
+        "matching_1024_xla_rounds_per_sec": xla,
+        "kernel_win_at_1k_scale": round(win, 4) if win is not None else None,
+        "note": "if the fused/XLA gap at 1k is <10%, extending the "
+                "kernels to the lifecycle path cannot pay at the "
+                "config-3 scale (VERDICT r2 item 5 justification)",
+    }
+
+
+# -- phase 6: choice-path scatter share ---------------------------------------
+
+
+def phase_scatter_share() -> dict:
+    """Time one (N, N) responder scatter-max (`w.at[p].max(x)`) against
+    one elementwise pass at the config-4 scale, and a config-4 style
+    round, attributing round time to the scatter (VERDICT r2 item 7)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random
+
+    from aiocluster_tpu.models.topology import scale_free
+    from aiocluster_tpu.sim import SimConfig, Simulator, budget_from_mtu
+
+    n = 10_240
+    w = jnp.zeros((n, n), jnp.int16)
+    x = jnp.ones((n, n), jnp.int16)
+    p = random.permutation(random.key(0), n)
+
+    @jax.jit
+    def scatter_loop(w, x):
+        def body(i, carry):
+            w, x = carry
+            w = w.at[p].max(x + i.astype(jnp.int16))
+            return w, x
+        return jax.lax.fori_loop(0, 32, body, (w, x))
+
+    @jax.jit
+    def elementwise_loop(w, x):
+        def body(i, carry):
+            w, x = carry
+            return jnp.maximum(w, x + i.astype(jnp.int16)), x
+        return jax.lax.fori_loop(0, 32, body, (w, x))
+
+    def timeit(fn):
+        r = fn(w, x)
+        int(np.asarray(r[0][0, 0]))
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = fn(w, x)
+            int(np.asarray(r[0][0, 0]))
+            best = min(best, (time.perf_counter() - t0) / 32)
+        return best
+
+    scatter_ms = timeit(scatter_loop) * 1e3
+    elem_ms = timeit(elementwise_loop) * 1e3
+
+    cfg = SimConfig(
+        n_nodes=n, keys_per_node=16, fanout=3,
+        budget=budget_from_mtu(65_507), pairing="choice",
+        version_dtype="int16", heartbeat_dtype="int16", fd_dtype="bfloat16",
+    )
+    topo = scale_free(n, attach=3, seed=0)
+    sim = Simulator(cfg, seed=0, topology=topo, chunk=16)
+    cfg4_rate = _rate(sim, rounds=64)
+    round_ms = 1e3 / cfg4_rate if cfg4_rate else None
+    # One scatter-max per sub-exchange direction x fanout.
+    scatter_total = cfg.fanout * scatter_ms
+    return {
+        "scatter_max_ms_per_pass@10240": round(scatter_ms, 3),
+        "elementwise_ms_per_pass@10240": round(elem_ms, 3),
+        "config4_scalefree_rounds_per_sec": cfg4_rate,
+        "config4_round_ms": round(round_ms, 2) if round_ms else None,
+        "scatter_share_of_round": (
+            round(scatter_total / round_ms, 3) if round_ms else None
+        ),
+    }
+
+
+PHASES = [
+    ("bench_full", phase_bench_full),
+    ("lean_scaling", phase_lean_scaling),
+    ("sharded_1dev", phase_sharded_1dev),
+    ("i16_experiment", phase_i16),
+    ("churn_kernel_ceiling", phase_churn_kernel_ceiling),
+    ("scatter_share", phase_scatter_share),
+]
+
+
+def main() -> None:
+    out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    out["head"] = _git_head()
+    # Hard watchdog: a mid-phase tunnel drop wedges the in-process
+    # plugin forever; the deadline keeps the battery from zombifying.
+    import threading
+
+    guard = threading.Timer(7200.0, lambda: os._exit(3))
+    guard.daemon = True
+    guard.start()
+    only = sys.argv[1:] or None
+    for name, fn in PHASES:
+        if only and name not in only:
+            continue
+        log(f"=== {name} ===")
+        t0 = time.perf_counter()
+        try:
+            out[name] = fn()
+        except Exception as exc:
+            out[name] = {"error": repr(exc)}
+            log(f"{name} FAILED: {exc!r}")
+        out[name + "_seconds"] = round(time.perf_counter() - t0, 1)
+        checkpoint()
+        log(f"{name} done in {out[name + '_seconds']}s")
+    guard.cancel()
+    log(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Round-3 tunnel watcher.
+
+Probes the TPU tunnel in a bounded subprocess every PROBE_EVERY_S and
+appends one JSON line per state *transition* (and a heartbeat every 30
+min) to r3_tunnel_log.jsonl next to this file. On a down->up
+transition it spawns the measurement battery (_r3_measure.py) at
+whatever HEAD is current, once per watcher lifetime — the builder
+re-runs the battery by hand after later kernel changes.
+
+Builder-side tooling (not part of the shipped package).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+PROBE_EVERY_S = 180.0
+HEARTBEAT_EVERY_S = 1800.0
+HERE = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(HERE, "r3_tunnel_log.jsonl")
+
+
+def tunnel_up() -> bool:
+    code = "import jax, jax.numpy as jnp; print(float(jnp.ones((8,8)).sum()), jax.default_backend())"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    out = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    return proc.returncode == 0 and out.startswith("64.0") and "cpu" not in out
+
+
+def emit(state: str) -> None:
+    line = json.dumps(
+        {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), "tunnel": state}
+    )
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def main() -> None:
+    last_state = None
+    last_emit = 0.0
+    battery_launched = False
+    while True:
+        state = "up" if tunnel_up() else "down"
+        now = time.time()
+        if state != last_state or now - last_emit >= HEARTBEAT_EVERY_S:
+            emit(state)
+            last_state, last_emit = state, now
+        if state == "up" and not battery_launched:
+            battery_launched = True
+            emit("battery-start")
+            with open(os.path.join(HERE, "r3_battery.out"), "ab") as f:
+                subprocess.Popen(
+                    [sys.executable, os.path.join(HERE, "_r3_measure.py")],
+                    stdout=f, stderr=f,
+                )
+        time.sleep(PROBE_EVERY_S)
+
+
+if __name__ == "__main__":
+    main()

@@ -256,23 +256,36 @@ def config4(smoke: bool) -> dict:
 
 
 def _fit_population(target: int, n_devices: int, bytes_per_device: int) -> int:
-    """Largest node count (multiple of n_devices) whose sharded state
-    fits: w is N*N int32 split over devices, plus ~2x slack for the
-    step's temporaries (gathered peer rows, advances)."""
-    n = target
-    while n > n_devices:
-        per_device = (n * n * 4 * 2) // n_devices
-        if per_device <= bytes_per_device:
+    """Largest node count whose LEAN-profile sharded state fits,
+    consulting the memory planner (sim/memory.py) rather than a
+    hard-coded bytes/pair (VERDICT r2: the flagship config must run the
+    repo's own best profile). Node counts are quantized to
+    128 * n_devices so every shard's column block is lane-aligned and
+    the sharded fused Pallas kernel engages; the first aligned count at
+    or above the target is preferred (the north star says 100k nodes,
+    not 99.9k), falling back below only when memory demands it."""
+    from aiocluster_tpu.sim.memory import lean_config, plan
+
+    quantum = 128 * n_devices
+
+    def aligned(m: int) -> int:
+        return max(quantum, ((m + quantum - 1) // quantum) * quantum)
+
+    n = aligned(target)
+    while n > quantum:
+        if plan(lean_config(n), shards=n_devices).per_shard_bytes <= bytes_per_device:
             break
-        n = int(n * 0.85)
-    return max(n_devices, (n // n_devices) * n_devices)
+        n = aligned(int(n * 0.85) - quantum + 1)
+    return n
 
 
 def config5(smoke: bool) -> dict:
     import jax
 
+    from aiocluster_tpu.ops.gossip import pallas_path_engaged
     from aiocluster_tpu.parallel.mesh import make_mesh
-    from aiocluster_tpu.sim import SimConfig, Simulator
+    from aiocluster_tpu.sim import Simulator
+    from aiocluster_tpu.sim.memory import lean_config
 
     devices = jax.devices()
     n_dev = len(devices)
@@ -283,15 +296,15 @@ def config5(smoke: bool) -> dict:
     scaled = n < target
     rounds = 16 if smoke else 32
     log(f"config5: {n} nodes over {n_dev} device(s) (target {target})")
-    cfg = SimConfig(
-        n_nodes=n, keys_per_node=16, fanout=3, budget=_mtu_budget(),
-        track_failure_detector=False, track_heartbeats=False,
-    )
+    # The repo's memory-lean convergence profile (int16 watermarks, no
+    # heartbeat/FD matrices) — half the HBM traffic and footprint of the
+    # old int32 scripting, and the profile every max-scale claim quotes.
+    cfg = lean_config(n, budget=_mtu_budget())
     mesh = make_mesh(devices)
     sim = Simulator(cfg, seed=0, mesh=mesh, chunk=8)
     rps = _timed_rounds_per_sec(sim, rounds)
     start = time.perf_counter()
-    converged = sim.run_until_converged(max_rounds=512)
+    converged = sim.run_until_converged(max_rounds=1024)
     wall = time.perf_counter() - start
     return {
         "metric": f"epidemic{n}_sharded_rounds_per_sec",
@@ -305,6 +318,10 @@ def config5(smoke: bool) -> dict:
             "n_devices": n_dev,
             "rounds_to_convergence": converged,
             "convergence_wall_seconds": round(wall, 2),
+            "profile": "lean(int16, no FD/heartbeats)",
+            "pallas_kernel": pallas_path_engaged(
+                cfg, "owners", n_local=n // n_dev
+            ),
         },
     }
 

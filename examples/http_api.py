@@ -52,7 +52,12 @@ def snapshot_json(cluster: Cluster) -> str:
     )
 
 
-async def serve_http(cluster: Cluster, port: int) -> None:
+async def serve_http(
+    cluster: Cluster, port: int, started: asyncio.Event | None = None
+) -> None:
+    """Serve the HTTP API until cancelled. ``started`` (when given) is
+    set once the listening socket is bound — callers that fire requests
+    immediately (tests) wait on it instead of sleeping."""
     async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             request = await reader.readline()
@@ -102,6 +107,8 @@ async def serve_http(cluster: Cluster, port: int) -> None:
             writer.close()
 
     server = await asyncio.start_server(handle, "127.0.0.1", port)
+    if started is not None:
+        started.set()
     async with server:
         await server.serve_forever()
 

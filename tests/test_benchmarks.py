@@ -30,9 +30,19 @@ def test_all_configs_registered():
 
 
 def test_fit_population_respects_budget():
+    from aiocluster_tpu.sim.memory import lean_config, plan
+
     mod = _load()
     n = mod._fit_population(100_000, 8, 12 << 30)
-    assert n % 8 == 0
-    assert (n * n * 4 * 2) // 8 <= (12 << 30)
-    # 100k over v5e-8 fits outright.
-    assert n == 100_000
+    # Quantized to 128 * n_devices so every shard's column block is
+    # lane-aligned (the sharded fused kernel's domain), and rounded UP:
+    # the north star says 100k nodes.
+    assert n % (128 * 8) == 0
+    assert n >= 100_000
+    assert plan(lean_config(n), shards=8).per_shard_bytes <= (12 << 30)
+    # A single chip can't hold 100k even lean; the fit must scale down
+    # yet stay lane-aligned and inside budget.
+    n1 = mod._fit_population(100_000, 1, 12 << 30)
+    assert n1 % 128 == 0 and n1 < 100_000
+    assert plan(lean_config(n1), shards=1).per_shard_bytes <= (12 << 30)
+    assert n1 >= 40_000  # lean profile buys real scale on one chip

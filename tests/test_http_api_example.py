@@ -50,10 +50,15 @@ async def test_http_api_two_nodes(free_port_factory):
         ))
 
     async with make(g1, g2) as c1, make(g2, g1) as c2:
-        t1 = asyncio.create_task(http_api.serve_http(c1, h1))
-        t2 = asyncio.create_task(http_api.serve_http(c2, h2))
+        up1, up2 = asyncio.Event(), asyncio.Event()
+        t1 = asyncio.create_task(http_api.serve_http(c1, h1, started=up1))
+        t2 = asyncio.create_task(http_api.serve_http(c2, h2, started=up2))
         try:
-            await asyncio.sleep(0.05)  # let the HTTP servers bind
+            # Bind is signalled, not slept for: the first PUT below must
+            # never race the listening socket on a loaded host.
+            async with asyncio.timeout(5.0):
+                await up1.wait()
+                await up2.wait()
 
             status, _ = await _request(h1, "PUT", "/kv/color?v=red")
             assert status == "200 OK"
