@@ -250,6 +250,28 @@ def anchored_asyncio_seconds(log) -> float | None:
     return float(record["value"])
 
 
+def load_last_onchip_record(log) -> dict | None:
+    """The last committed on-chip bench record, embedded VERBATIM in
+    CPU-fallback artifacts so a down tunnel can never reduce the
+    certified evidence to a prose pointer (round-1/2 failure mode).
+    latest_onchip.json is refreshed by every on-chip battery run
+    (benchmarks/records/_r3_measure.py) and was seeded from the round-2
+    certified record, so the chain never goes empty; the certified
+    record itself is the fallback of the fallback."""
+    records_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "records"
+    )
+    for name in ("latest_onchip.json", "r02_builder_tpu_10240.json"):
+        try:
+            with open(os.path.join(records_dir, name)) as f:
+                return json.load(f)
+        except Exception as exc:
+            log(f"on-chip record {name} unavailable: {exc!r}")
+    log("NO on-chip record embedded — fallback artifact is CPU-only "
+        "(should not happen: records/ is committed)")
+    return None
+
+
 def measured_reference_baseline(log) -> dict | None:
     """The ACTUAL reference library (/root/reference), run live as a
     64-node loopback cluster, measured in sim-equivalent rounds/s and
@@ -421,6 +443,13 @@ def sim_rounds_per_sec(
     return rps, converged_at, extra
 
 
+# The largest lane-aligned lean population whose memory plan fits one
+# v5e chip's HBM (state + gathered transient under the 12 GiB working
+# budget; benchmarks/run_all.py::_fit_population arrives at the same
+# number for n_devices=1).
+MAX_LEAN_SINGLE_CHIP = 52_096
+
+
 def scale_probe(log, n_nodes: int = 32_768, rounds: int = 16) -> float:
     """Max single-chip scale: the lean convergence profile (int16
     watermarks, no FD matrices — sim/memory.py) at the largest N that fits
@@ -510,11 +539,20 @@ def main() -> None:
         baseline_rps = python_rounds_per_sec(n_nodes)
         log(f"python object-model estimate: {baseline_rps:.4f} rounds/s")
         probe_rps = None
+        probe_max_rps = None
         if not args.smoke and on_accel:
             try:
                 probe_rps = round(scale_probe(log), 2)
             except Exception as exc:  # keep the headline even if the probe dies
                 log(f"scale probe failed: {exc!r}")
+            try:
+                # The planner's true single-chip maximum (the lean int16
+                # profile fits ~52k, not the old 4 B/pair arithmetic's 38k).
+                probe_max_rps = round(
+                    scale_probe(log, n_nodes=MAX_LEAN_SINGLE_CHIP), 2
+                )
+            except Exception as exc:
+                log(f"max-scale probe failed: {exc!r}")
         anchored = None if args.smoke else anchored_asyncio_seconds(log)
         ref_measured = None if args.smoke else measured_reference_baseline(log)
         # A CPU-fallback record is still a valid run, but its headline is
@@ -528,28 +566,7 @@ def main() -> None:
                 "accelerator unreachable at run time; last on-chip record: "
                 "benchmarks/records/ (see its README for provenance)"
             )
-            # Embed the last committed on-chip bench record VERBATIM so a
-            # down tunnel can never reduce the certified artifact to a
-            # CPU number with a prose pointer (round-1/2 failure mode):
-            # the machine-readable on-chip evidence rides every fallback
-            # record, with its commit + timestamp provenance.
-            # latest_onchip.json is refreshed by every on-chip battery
-            # run (_r3_measure.py) and seeded from the round-2 certified
-            # record, so the chain never goes empty.
-            records_dir = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "benchmarks", "records",
-            )
-            for name in ("latest_onchip.json", "r02_builder_tpu_10240.json"):
-                try:
-                    with open(os.path.join(records_dir, name)) as f:
-                        last_onchip = json.load(f)
-                    break
-                except Exception as exc:
-                    log(f"on-chip record {name} unavailable: {exc!r}")
-            if last_onchip is None:
-                log("NO on-chip record embedded — fallback artifact is "
-                    "CPU-only (should not happen: records/ is committed)")
+            last_onchip = load_last_onchip_record(log)
         result = {
             "metric": metric,
             "value": round(rps, 2),
@@ -579,6 +596,15 @@ def main() -> None:
                 "max_scale_single_chip": (
                     {"nodes": 32_768, "profile": "lean", "rounds_per_sec": probe_rps}
                     if probe_rps is not None
+                    else None
+                ),
+                "max_scale_single_chip_planner_limit": (
+                    {
+                        "nodes": MAX_LEAN_SINGLE_CHIP,
+                        "profile": "lean",
+                        "rounds_per_sec": probe_max_rps,
+                    }
+                    if probe_max_rps is not None
                     else None
                 ),
                 **sim_extra,

@@ -1,0 +1,40 @@
+"""The bench artifact must survive a down tunnel: a CPU-fallback record
+embeds the last committed on-chip record verbatim (VERDICT r2 weak
+item 1 — two rounds lost their headline to outage timing)."""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import bench  # noqa: E402
+
+sys.path.remove(REPO)
+
+
+def test_last_onchip_record_loads_at_head():
+    """The committed chain (latest_onchip.json, seeded from the round-2
+    certified record) must resolve at HEAD — a silent None here is the
+    exact failure the embed exists to prevent."""
+    msgs = []
+    rec = bench.load_last_onchip_record(msgs.append)
+    assert rec is not None, msgs
+    # Whichever file won, it must carry a real on-chip bench record.
+    inner = rec.get("record", rec)
+    assert inner["unit"] == "rounds/s"
+    assert inner["value"] and inner["value"] > 1  # an on-chip rate, not CPU
+    assert inner["extra"]["platform"] not in ("cpu", None)
+
+
+def test_latest_onchip_has_provenance():
+    path = os.path.join(REPO, "benchmarks", "records", "latest_onchip.json")
+    with open(path) as f:
+        latest = json.load(f)
+    # The stable pointer names its source commit and origin so the
+    # embedded evidence is auditable.
+    assert latest["head"]
+    assert "source" in latest and latest["source"]
+    # The tunnel's PJRT plugin reports "axon"; older jax builds said
+    # "tpu" — either way, a real accelerator platform.
+    assert latest["record"]["extra"]["platform"] in ("axon", "tpu")

@@ -46,3 +46,8 @@ def test_fit_population_respects_budget():
     assert n1 % 128 == 0 and n1 < 100_000
     assert plan(lean_config(n1), shards=1).per_shard_bytes <= (12 << 30)
     assert n1 >= 40_000  # lean profile buys real scale on one chip
+    # bench.py's max-scale probe constant must be the same number the
+    # fit arrives at (one source of truth for "largest single-chip N").
+    import bench
+
+    assert bench.MAX_LEAN_SINGLE_CHIP == n1

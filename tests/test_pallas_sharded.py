@@ -7,9 +7,7 @@ the compiled path is exercised on real TPU by bench.py.
 """
 
 import numpy as np
-import pytest
 
-import jax
 import jax.numpy as jnp
 from jax import random
 
@@ -150,12 +148,17 @@ def test_sharded_full_fidelity_kernel_bit_identical():
 
 def test_sharded_simulator_lean_kernel_converges_like_xla():
     """Driver-level: Simulator(mesh=...) with the kernel on reaches
-    convergence at the identical round as the unsharded XLA run."""
-    cfg_p = _lean_cfg(True)
-    cfg_x = _lean_cfg(False)
+    convergence at the identical round as the unsharded XLA run. An
+    ample budget keeps the interpret-mode round count small — the
+    bit-identity tests above already pin every round's equality; this
+    asserts the tracked-convergence plumbing end to end."""
+    import dataclasses
+
+    cfg_p = dataclasses.replace(_lean_cfg(True), budget=512)
+    cfg_x = dataclasses.replace(_lean_cfg(False), budget=512)
     sharded = Simulator(cfg_p, mesh=make_mesh(), seed=3, chunk=4)
     single = Simulator(cfg_x, seed=3, chunk=4)
-    r_sharded = sharded.run_until_converged(400)
-    r_single = single.run_until_converged(400)
+    r_sharded = sharded.run_until_converged(100)
+    r_single = single.run_until_converged(100)
     assert r_sharded is not None
     assert r_sharded == r_single
