@@ -68,6 +68,14 @@ def _sim_config(args: argparse.Namespace):
     from .core import DEFAULT_MAX_PAYLOAD_SIZE
     from .sim import SimConfig, budget_from_mtu
 
+    if args.lean and args.keys >= 2**15:
+        # The lean profile's int16 watermarks cap initial versions; catch
+        # it here so it surfaces as a clean parser error, not a traceback
+        # from init_state.
+        raise ValueError(
+            f"--lean stores int16 watermarks: --keys {args.keys} >= 32768 "
+            "overflows (drop --lean or lower --keys)"
+        )
     return SimConfig(
         n_nodes=args.nodes,
         keys_per_node=args.keys,
@@ -79,22 +87,48 @@ def _sim_config(args: argparse.Namespace):
         revival_rate=4 * args.churn,
         track_failure_detector=not args.lean,
         track_heartbeats=not args.lean,
+        # The same profile sim.memory.lean_config prescribes: int16
+        # watermarks are what buy the memory headroom at max scale.
+        version_dtype="int16" if args.lean else "int32",
         dead_grace_ticks=args.grace if args.churn and not args.lean else None,
     )
 
 
 def _run_sim(args: argparse.Namespace, cfg) -> int:
-    if args.cpu:
-        import jax
+    import jax
 
+    if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     from .sim import Simulator
 
-    sim = Simulator(cfg, seed=args.seed, chunk=8)
+    mesh = None
+    if args.shards:
+        from .parallel.mesh import make_mesh
+
+        devices = jax.devices()
+        if args.shards < 0:
+            print(f"--shards {args.shards} must be positive", file=sys.stderr)
+            return 2
+        if args.shards > len(devices):
+            print(
+                f"--shards {args.shards} > {len(devices)} visible device(s)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.nodes % args.shards:
+            print(
+                f"--nodes {args.nodes} must divide evenly into "
+                f"--shards {args.shards}",
+                file=sys.stderr,
+            )
+            return 2
+        mesh = make_mesh(devices[: args.shards])
+    sim = Simulator(cfg, seed=args.seed, mesh=mesh, chunk=8)
     converged = sim.run_until_converged(max_rounds=args.max_rounds)
     m = {k: v.tolist() for k, v in sim.metrics().items()}
     print(json.dumps({
         "nodes": args.nodes,
+        "shards": args.shards or 1,
         "rounds_to_convergence": converged,
         "tick": sim.tick,
         "metrics": m,
@@ -135,6 +169,10 @@ def main(argv: list[str] | None = None) -> int:
     sim.add_argument("--max-rounds", type=int, default=10_000)
     sim.add_argument("--cpu", action="store_true",
                      help="pin the CPU backend")
+    sim.add_argument("--shards", type=int, default=0,
+                     help="column-shard the owner axis over this many "
+                     "devices (the BASELINE config-5 shape; 0 = one "
+                     "device, no mesh)")
 
     args = parser.parse_args(argv)
     if args.command == "node":

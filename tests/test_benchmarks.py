@@ -48,6 +48,14 @@ def test_fit_population_respects_budget():
     assert n1 >= 40_000  # lean profile buys real scale on one chip
     # bench.py's max-scale probe constant must be the same number the
     # fit arrives at (one source of truth for "largest single-chip N").
-    import bench
+    # Repo root on sys.path explicitly: bare `import bench` would
+    # otherwise depend on the runner's cwd or on another test having
+    # cached the module first.
+    repo = str(Path(__file__).parent.parent)
+    sys.path.insert(0, repo)
+    try:
+        import bench
+    finally:
+        sys.path.remove(repo)
 
     assert bench.MAX_LEAN_SINGLE_CHIP == n1

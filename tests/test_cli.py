@@ -23,6 +23,38 @@ def test_cli_sim_runs_to_convergence():
     assert record["metrics"]["all_converged"] is True
 
 
+def test_cli_sim_sharded_lean():
+    """--shards runs the column-sharded (config-5 shape) path from the
+    CLI, and --lean uses the real lean profile (int16 watermarks)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=4"]
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "aiocluster_tpu", "sim",
+         "--nodes", "128", "--lean", "--shards", "4", "--cpu",
+         "--max-rounds", "500"],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["shards"] == 4
+    assert record["rounds_to_convergence"] is not None
+    # Bad shard counts are clean CLI errors, not tracebacks.
+    bad = subprocess.run(
+        [sys.executable, "-m", "aiocluster_tpu", "sim",
+         "--nodes", "100", "--shards", "3", "--cpu"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert bad.returncode == 2
+    assert "divide evenly" in bad.stderr
+
+
 def test_cli_sim_bad_args():
     proc = subprocess.run(
         [sys.executable, "-m", "aiocluster_tpu", "sim", "--mtu", "10",
