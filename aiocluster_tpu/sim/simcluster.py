@@ -143,6 +143,11 @@ class SimCluster:
             self.sim.state = state.replace(
                 max_version=state.max_version + self._pending_writes
             )
+            # Keep the int16 horizon guard sound: the largest per-node
+            # bump bounds how much the global max can have grown
+            # (conservative — the most-written node may not be the
+            # max-version node).
+            self.sim.note_max_version_increase(int(self._pending_writes.max()))
             self._pending_writes[:] = 0
 
     def step(self, rounds: int = 1) -> None:

@@ -98,6 +98,18 @@ class Simulator:
         self.state: SimState = (
             state if state is not None else init_state(cfg, initial_versions)
         )
+        # Compact-dtype horizon guard (host arithmetic only — run() must
+        # never add a device sync to the hot loop): record the largest
+        # version and the tick once, at construction, where a sync is
+        # free. _host_tick advances with each run(); _version_base_tick
+        # stays frozen so the growth bound charges writes_per_round only
+        # for ticks run SINCE the recorded max (a resumed checkpoint's
+        # max already contains its past writes). Host-side writers
+        # (SimCluster) report direct version bumps via
+        # note_max_version_increase.
+        self._known_max_version = int(np.asarray(self.state.max_version).max())
+        self._host_tick = int(np.asarray(self.state.tick))
+        self._version_base_tick = self._host_tick
         self._mesh = mesh
         if mesh is not None:
             self.state = shard_state(self.state, mesh)
@@ -127,8 +139,45 @@ class Simulator:
 
     # -- stepping -------------------------------------------------------------
 
+    def note_max_version_increase(self, delta: int) -> None:
+        """Host-side writers that raise ``max_version`` directly on the
+        state (SimCluster's write flush) report the largest per-node
+        bump here so the int16 horizon guard stays sound. Direct state
+        surgery that bypasses this is outside the guard's coverage."""
+        self._known_max_version += int(delta)
+
+    def _check_horizon(self, rounds: int) -> None:
+        """Raise before an int16 profile silently wraps: heartbeats store
+        the tick (horizon < 2^15), and int16 watermarks store versions
+        (known max + writes_per_round per tick run < 2^15). Host-side
+        arithmetic from construction-time facts (the dtype knobs are the
+        validated literal strings "int16"/"int32") — zero device
+        traffic, so timing loops see no sync."""
+        end_tick = self._host_tick + rounds
+        if (
+            self.cfg.track_heartbeats
+            and self.cfg.heartbeat_dtype == "int16"
+            and end_tick >= 2**15
+        ):
+            raise ValueError(
+                f"running to tick {end_tick} overflows int16 heartbeats "
+                "(heartbeat_dtype='int16' stores the tick; use int32 for "
+                "horizons >= 32768 rounds)"
+            )
+        if self.cfg.version_dtype == "int16":
+            bound = self._known_max_version + self.cfg.writes_per_round * (
+                end_tick - self._version_base_tick
+            )
+            if bound >= 2**15:
+                raise ValueError(
+                    f"versions may reach {bound} by tick {end_tick}, "
+                    "overflowing version_dtype='int16' (lower "
+                    "writes_per_round/horizon or use int32)"
+                )
+
     def run(self, rounds: int) -> None:
         """Advance a fixed number of gossip rounds."""
+        self._check_horizon(rounds)
         done = 0
         while done < rounds:
             m = min(self.chunk, rounds - done)
@@ -144,6 +193,7 @@ class Simulator:
                     self.state, self._key, self.cfg, m, self._adj, self._deg
                 )
             done += m
+            self._host_tick += m
             if self._trace_enabled:
                 self._record_trace()
 
@@ -156,6 +206,7 @@ class Simulator:
             return int(self.state.tick)  # converged before any stepping
         while int(self.state.tick) < max_rounds:
             m = min(self.chunk, max_rounds - int(self.state.tick))
+            self._check_horizon(m)
             if self._mesh is not None:
                 args = (
                     (self.state, self._key, self._adj, self._deg)
@@ -167,6 +218,7 @@ class Simulator:
                 self.state, first = _chunk_tracked(
                     self.state, self._key, self.cfg, m, self._adj, self._deg
                 )
+            self._host_tick += m
             if self._trace_enabled:
                 self._record_trace()
             first = int(first)

@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 import time
 
@@ -58,6 +59,18 @@ def _import_reference():
         sys.path.remove(_REF_PATH)
 
 
+def _import_ours():
+    """Our socket backend under the same harness: the public API is
+    deliberately signature-compatible with the reference's, so the one
+    measurement procedure drives both implementations head-to-head."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from aiocluster_tpu import Cluster, Config, NodeId
+
+    return Cluster, Config, NodeId
+
+
 def _free_ports(n: int) -> list[int]:
     import socket
 
@@ -73,14 +86,30 @@ def _free_ports(n: int) -> list[int]:
             s.close()
 
 
+def _wrap_ticker(cluster, fn) -> None:
+    """Swap the gossip-round coroutine the cluster's Ticker drives for a
+    counting wrapper. Both implementations hold it as ``_ticker._tick``
+    (ours) / ``_ticker._ticker`` (reference) — instance attributes, the
+    measurement seam."""
+    t = cluster._ticker
+    if hasattr(t, "_ticker"):  # reference naming
+        t._ticker = fn(t._ticker)
+    else:  # ours
+        t._tick = fn(t._tick)
+
+
 async def _measure(
     n_nodes: int,
     keys_per_node: int,
     gossip_interval: float,
     rate_seconds: float,
     converge_timeout: float,
+    impl: str = "reference",
 ) -> dict:
-    RefCluster, RefConfig, RefNodeId = _import_reference()
+    if impl == "reference":
+        RefCluster, RefConfig, RefNodeId = _import_reference()
+    else:
+        RefCluster, RefConfig, RefNodeId = _import_ours()
     ports = _free_ports(n_nodes)
     clusters = [
         RefCluster(
@@ -103,15 +132,18 @@ async def _measure(
     # (captured at Cluster.__init__; the instance attribute is the seam).
     ticks = [0] * n_nodes
 
-    def counted(i, orig):
-        async def tick():
-            ticks[i] += 1
-            await orig()
+    def counted(i):
+        def wrap(orig):
+            async def tick():
+                ticks[i] += 1
+                await orig()
 
-        return tick
+            return tick
+
+        return wrap
 
     for i, c in enumerate(clusters):
-        c._ticker._ticker = counted(i, c._ticker._ticker)
+        _wrap_ticker(c, counted(i))
 
     last_key = f"k{keys_per_node - 1}"
 
@@ -161,11 +193,15 @@ async def _measure(
     }
 
 
-def measure(n_nodes: int = 64, log=lambda m: None) -> dict | None:
-    """The datum bench.py embeds: the reference library measured at the
-    BASELINE config-2 shape (its own integration-test interval), plus
-    the floored-interval ceiling. Returns None if the reference can't
-    run here."""
+def measure(
+    n_nodes: int = 64, log=lambda m: None, impl: str = "reference"
+) -> dict | None:
+    """The datum bench.py embeds: a library measured at the BASELINE
+    config-2 shape (the reference's own integration-test interval),
+    plus the floored-interval ceiling. ``impl`` selects the reference
+    library or our socket backend — identical harness, so the two
+    records compare head-to-head. Returns None if the implementation
+    can't run here."""
     try:
         at_test_interval = asyncio.run(
             _measure(
@@ -174,10 +210,11 @@ def measure(n_nodes: int = 64, log=lambda m: None) -> dict | None:
                 gossip_interval=0.02,
                 rate_seconds=3.0,
                 converge_timeout=60.0,
+                impl=impl,
             )
         )
         log(
-            f"reference {n_nodes}-node: converged in "
+            f"{impl} {n_nodes}-node: converged in "
             f"{at_test_interval['convergence_seconds']}s @ 20ms, "
             f"{at_test_interval['sim_equivalent_rounds_per_sec']} rounds/s"
         )
@@ -190,28 +227,50 @@ def measure(n_nodes: int = 64, log=lambda m: None) -> dict | None:
                 gossip_interval=0.001,
                 rate_seconds=5.0,
                 converge_timeout=60.0,
+                impl=impl,
             )
         )
         log(
-            f"reference {n_nodes}-node floored-interval ceiling: "
+            f"{impl} {n_nodes}-node floored-interval ceiling: "
             f"{ceiling['sim_equivalent_rounds_per_sec']} rounds/s"
         )
         return {
-            "kind": "measured_reference_library",
-            "source": "/root/reference run live in-process (loopback TCP)",
+            "kind": (
+                "measured_reference_library"
+                if impl == "reference"
+                else "measured_our_socket_backend"
+            ),
+            "source": (
+                "/root/reference run live in-process (loopback TCP)"
+                if impl == "reference"
+                else "aiocluster_tpu asyncio backend, same harness"
+            ),
             "at_test_interval": at_test_interval,
             "compute_bound_ceiling": ceiling,
         }
     except Exception as exc:
-        log(f"reference baseline measurement failed: {exc!r}")
+        log(f"{impl} baseline measurement failed: {exc!r}")
         return None
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--nodes", type=int, default=64)
+    parser.add_argument(
+        "--impl", choices=("reference", "ours", "both"), default="reference"
+    )
     args = parser.parse_args()
-    record = measure(args.nodes, log=lambda m: print(f"[refbase] {m}", file=sys.stderr, flush=True))
+
+    def log(m: str) -> None:
+        print(f"[refbase] {m}", file=sys.stderr, flush=True)
+
+    if args.impl == "both":
+        record = {
+            "reference": measure(args.nodes, log=log, impl="reference"),
+            "ours": measure(args.nodes, log=log, impl="ours"),
+        }
+    else:
+        record = measure(args.nodes, log=log, impl=args.impl)
     print(json.dumps(record, indent=1))
 
 

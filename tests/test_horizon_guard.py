@@ -1,0 +1,86 @@
+"""Compact-dtype horizon guard: int16 profiles must refuse to run past
+the point where heartbeats (tick-valued) or watermarks (version-valued)
+would silently wrap."""
+
+import pytest
+
+from aiocluster_tpu.sim import SimConfig, Simulator
+
+
+def test_int16_heartbeat_horizon_refused():
+    cfg = SimConfig(
+        n_nodes=8, keys_per_node=2, heartbeat_dtype="int16",
+    )
+    sim = Simulator(cfg, seed=0)
+    with pytest.raises(ValueError, match="int16 heartbeats"):
+        sim.run(2**15)
+    sim.run(4)  # inside the horizon: fine
+    assert sim.tick == 4
+
+
+def test_int16_version_growth_refused():
+    cfg = SimConfig(
+        n_nodes=8, keys_per_node=2, version_dtype="int16",
+        heartbeat_dtype="int32", writes_per_round=100,
+        track_failure_detector=False,
+    )
+    sim = Simulator(cfg, seed=0)
+    with pytest.raises(ValueError, match="int16"):
+        sim.run(400)  # 2 + 100*400 = 40,002 >= 2^15
+    sim.run(8)
+    assert sim.tick == 8
+
+
+def test_int32_profiles_unguarded():
+    cfg = SimConfig(n_nodes=8, keys_per_node=2, writes_per_round=100)
+    Simulator(cfg, seed=0).run(4)  # int32 everywhere: no horizon errors
+
+
+def test_simcluster_writes_keep_guard_sound():
+    """Host-side writes raise max_version after construction; the guard
+    must see that growth (review r3: a stale construction-time snapshot
+    would let int16 watermarks wrap silently)."""
+    from aiocluster_tpu.sim import SimCluster
+
+    cfg = SimConfig(
+        n_nodes=8, keys_per_node=2, version_dtype="int16",
+        heartbeat_dtype="int32", track_failure_detector=False,
+    )
+    sc = SimCluster(cfg, seed=0)
+    node = sc.names[0]
+    for i in range(40_000):
+        sc.set(node, "k", str(i))
+    with pytest.raises(ValueError, match="int16"):
+        sc.step(1)
+
+
+def test_resume_does_not_double_count_past_writes():
+    """A state built at tick T with versions reflecting T ticks of
+    writes must only be charged for NEW ticks (review r3: charging
+    writes_per_round * end_tick refused valid resumed runs)."""
+    import dataclasses
+
+    cfg = SimConfig(
+        n_nodes=8, keys_per_node=2, version_dtype="int16",
+        heartbeat_dtype="int32", writes_per_round=100,
+        track_failure_detector=False,
+    )
+    sim = Simulator(cfg, seed=0)
+    sim.run(200)  # versions ~ 2 + 20,000
+    resumed = Simulator(cfg, seed=0, state=sim.state)
+    resumed.run(100)  # +10,000 -> ~30,002 < 2^15: must be allowed
+    assert resumed.tick == 300
+    with pytest.raises(ValueError, match="int16"):
+        resumed.run(30)  # +3,000 more would cross 2^15
+
+
+def test_guard_costs_no_device_sync_per_run():
+    """The guard must be host arithmetic: _host_tick advances with
+    run() and never re-reads the device scalar."""
+    cfg = SimConfig(n_nodes=8, keys_per_node=2,
+                    track_failure_detector=False)
+    sim = Simulator(cfg, seed=0)
+    sim.run(6)
+    assert sim._host_tick == 6 == sim.tick
+    sim.run_until_converged(64)
+    assert sim._host_tick == sim.tick
