@@ -184,12 +184,18 @@ def _northstar_projection(points: list[dict]) -> dict:
     b, a = np.polyfit(ns, rs, 1)  # rounds ~ b*n + a
     n_star = 100_352  # config 5's 128x8-aligned 100k population
     rounds_100k = float(b * n_star + a)
-    # Measured achieved throughput at the largest point: lean matching
-    # traffic = fanout x 3 passes x N^2 x 2 B per round.
+    # Measured achieved throughput at the largest single-chip point:
+    # lean matching traffic there = fanout x 3 passes x N^2 x 2 B per
+    # round (single-pass kernel).
     big = max(pts, key=lambda p: p["n"])
     bytes_per_round = 3 * 3 * big["n"] ** 2 * 2
     achieved_gbps = bytes_per_round * big["rounds_per_sec"] / 1e9
-    shard_bytes_100k = 3 * 3 * n_star**2 * 2 / 8
+    # The MULTI-shard config runs the two-pass sharded kernel: per
+    # sub-exchange per matrix, pass A reads the block + peer rows and
+    # pass B reads both again and writes — 5 passes, not 3. Charge the
+    # projection for that honestly; the (N,) f32 psum between passes is
+    # noise next to the N^2/8 block traffic.
+    shard_bytes_100k = 3 * 5 * n_star**2 * 2 / 8
     s_per_round_8shard = shard_bytes_100k / (achieved_gbps * 1e9)
     total_s = rounds_100k * s_per_round_8shard
     return {
@@ -205,9 +211,10 @@ def _northstar_projection(points: list[dict]) -> dict:
             "meets_target": bool(total_s < 60.0),
             "arithmetic": (
                 f"rounds({n_star}) = {b:.3e}*N + {a:.1f} = "
-                f"{rounds_100k:.0f}; bytes/round/shard = 9*N^2*2/8 = "
-                f"{shard_bytes_100k / 1e9:.1f} GB at the measured "
-                f"{achieved_gbps:.0f} GB/s -> "
+                f"{rounds_100k:.0f}; two-pass sharded kernel: "
+                f"bytes/round/shard = fanout(3) x 5 passes x N^2 x 2B "
+                f"/ 8 = {shard_bytes_100k / 1e9:.1f} GB at the "
+                f"measured {achieved_gbps:.0f} GB/s -> "
                 f"{s_per_round_8shard * 1e3:.0f} ms/round; total "
                 f"{total_s:.0f} s"
             ),
@@ -381,9 +388,28 @@ PHASES = [
 ]
 
 
+def _wait_for_idle_host(max_wait_s: float = 3600.0) -> bool:
+    """Timing on a loaded 1-core host is garbage (the reference-baseline
+    review lesson: a suite running concurrently skewed a measurement
+    2.7x). Wait until 1-min loadavg drops below 0.5 before measuring;
+    True when idle, False if the wait expires (measure anyway, but the
+    record says so)."""
+    t0 = time.time()
+    while time.time() - t0 < max_wait_s:
+        load = os.getloadavg()[0]
+        # 1-core host: ~0.8 still leaves the big background jobs (test
+        # suite, northstar compile) clearly distinguishable at 1.5+.
+        if load < 0.8:
+            return True
+        log(f"host busy (load {load:.2f}); waiting for idle")
+        time.sleep(60.0)
+    return False
+
+
 def main() -> None:
     out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     out["head"] = _git_head()
+    out["host_idle_at_start"] = _wait_for_idle_host()
     # Hard watchdog: a mid-phase tunnel drop wedges the in-process
     # plugin forever; the deadline keeps the battery from zombifying.
     import threading
