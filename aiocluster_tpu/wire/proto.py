@@ -15,6 +15,8 @@ evolution by either side does not break the handshake.
 
 from __future__ import annotations
 
+import functools
+
 from . import native as _native
 from ..core.identity import NodeId
 from ..core.messages import (
@@ -174,7 +176,17 @@ def _utf8(raw: bytes) -> str:
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=65536)
 def encode_node_id(node: NodeId) -> bytes:
+    """NodeId is a frozen, hashable dataclass and its encoding is pure,
+    so the bytes are memoized: every digest and delta a node sends
+    re-serializes the same ~N node ids each round (the asyncio
+    backend's profiled hot path — the cache turns that into dict
+    hits). The cap must sit ABOVE any plausible cluster population: a
+    per-round sequential sweep over more ids than the cap is the
+    classic LRU-thrash pattern (every call misses AND pays an
+    eviction). 65,536 entries ≈ a few MB; beyond that the cache
+    degrades to the uncached cost plus a dict probe, never worse."""
     addr = bytearray()
     host, port = node.gossip_advertise_addr
     _field_str(addr, 1, host)
@@ -188,7 +200,33 @@ def encode_node_id(node: NodeId) -> bytes:
     return bytes(out)
 
 
+# Only small bodies are cache-eligible: the decode cache is keyed on
+# PEER-CONTROLLED bytes (the codec interoperates with untrusted
+# reference nodes), and unknown fields mean infinitely many distinct
+# encodings can map to one NodeId. Honest node-id submessages are tens
+# of bytes; the bound caps worst-case pinned memory at
+# 65,536 x ~(256 + object) ≈ tens of MB, and junk traffic can at worst
+# evict entries — degrading to the uncached baseline, never beyond.
+_NODE_ID_CACHE_MAX_BODY = 256
+
+
 def decode_node_id(body: bytes) -> NodeId:
+    """Memoized for small bodies (see _NODE_ID_CACHE_MAX_BODY): the
+    same node-id byte strings arrive in every digest/delta from every
+    peer, every round; NodeId is immutable so sharing one object per
+    distinct encoding is safe (and makes snapshot dict lookups cheaper
+    via pointer-equal keys)."""
+    if len(body) <= _NODE_ID_CACHE_MAX_BODY:
+        return _decode_node_id_cached(bytes(body))
+    return _decode_node_id(body)
+
+
+@functools.lru_cache(maxsize=65536)
+def _decode_node_id_cached(body: bytes) -> NodeId:
+    return _decode_node_id(body)
+
+
+def _decode_node_id(body: bytes) -> NodeId:
     r = _Reader(body)
     name = ""
     generation_id = 0

@@ -236,3 +236,30 @@ def test_ten_byte_varint_truncates_to_u64():
     r = _Reader(raw)
     v = r.varint()
     assert v == ((0x41 & 0x7F) << 63) & 0xFFFFFFFFFFFFFFFF == (1 << 63)
+
+
+def test_node_id_codec_caches_are_sound():
+    """r3: encode/decode node-id memoization — same bytes give the same
+    (shared) NodeId for small bodies, oversized bodies bypass the cache
+    but still decode identically, and encode round-trips through the
+    cache unchanged."""
+    from aiocluster_tpu.core.identity import NodeId
+    from aiocluster_tpu.wire.proto import (
+        _NODE_ID_CACHE_MAX_BODY,
+        decode_node_id,
+        encode_node_id,
+    )
+
+    small = NodeId("n1", 7, ("10.0.0.1", 9000), "tls-a")
+    b = encode_node_id(small)
+    assert encode_node_id(small) is encode_node_id(small)  # cached bytes
+    d1, d2 = decode_node_id(b), decode_node_id(bytes(b))
+    assert d1 == small and d1 is d2  # shared object for equal bytes
+
+    big_name = "x" * (_NODE_ID_CACHE_MAX_BODY + 64)
+    big = NodeId(big_name, 9, ("host", 1), None)
+    raw = encode_node_id(big)
+    assert len(raw) > _NODE_ID_CACHE_MAX_BODY
+    out1, out2 = decode_node_id(raw), decode_node_id(raw)
+    assert out1 == big == out2
+    assert out1 is not out2  # oversized: uncached path, fresh objects
