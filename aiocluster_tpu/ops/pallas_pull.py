@@ -316,21 +316,25 @@ def _pick_block(
     cap: int = 512,
     track_hb: bool = True,
     n_cols: int | None = None,
+    n_buffers: int | None = None,
 ) -> int | None:
     """Largest multiple-of-8 divisor of the ROW count ``n`` such that
     every VMEM-resident buffer set fits the per-core budget. ``n_cols``
     is the block width (the shard's local column count; defaults to the
-    unsharded square case n_cols = n).
+    unsharded square case n_cols = n); ``n_buffers`` overrides the
+    (block, n_cols)-sized buffer count for kernels with a different
+    residency set (the totals pass holds 3: w-in x2 + gather scratch).
 
-    Beyond the (block, n_cols) matrix buffers, the search budgets the
-    small operands too (same strict-conservatism rule as
-    pallas_fd._fixed_bytes): the valid and totals columns are
-    lane-padded to (block, 128) — per-row bytes — and the mv/hbv
-    broadcast rows are sublane-padded (1 -> 8 rows) int32, a
-    block-size-independent fixed cost. All double-buffered."""
+    Beyond the matrix buffers, the search budgets the small operands
+    too (same strict-conservatism rule as pallas_fd._fixed_bytes): the
+    valid and totals columns are lane-padded to (block, 128) — per-row
+    bytes — and the mv/hbv broadcast rows are sublane-padded (1 -> 8
+    rows) int32, a block-size-independent fixed cost. All
+    double-buffered."""
     width = n if n_cols is None else n_cols
+    buffers = _buffers(track_hb) if n_buffers is None else n_buffers
     # valid (int8) + totals (f32) columns, padded to 128 lanes, x2.
-    per_row = _buffers(track_hb) * width * itemsize + 2 * 128 * (1 + 4)
+    per_row = buffers * width * itemsize + 2 * 128 * (1 + 4)
     # mv (+hbv when heartbeats ride along) broadcast rows, 8-sublane
     # padded int32, x2 — counted unconditionally (worst case: diag on).
     fixed = (2 if track_hb else 1) * 2 * 8 * 4 * width
@@ -520,7 +524,13 @@ def fused_pull_totals_m8(
     owner-diagonal refresh, exactly as the apply pass will."""
     apply_diag = mv is not None
     n_rows, n_cols = w.shape
-    block = _pick_block(n_rows, w.dtype.itemsize, track_hb=False, n_cols=n_cols)
+    # This pass holds only w-in (double-buffered) + the gather scratch
+    # — 3 (block, n_cols) buffers, not the apply pass's 5 — plus the
+    # tiny (block, 1) totals out and broadcast rows, so it can afford
+    # larger row blocks (one shared accounting in _pick_block).
+    block = _pick_block(
+        n_rows, w.dtype.itemsize, track_hb=False, n_cols=n_cols, n_buffers=3
+    )
     if block is None or n_rows % 128 != 0 or n_cols % 128 != 0:
         raise ValueError(f"no suitable row block for shape {w.shape}")
     meta = jnp.asarray(owner_offset, jnp.int32)[None]
