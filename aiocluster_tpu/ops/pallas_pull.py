@@ -317,13 +317,17 @@ def _pick_block(
     track_hb: bool = True,
     n_cols: int | None = None,
     n_buffers: int | None = None,
+    diag_rows: bool = True,
 ) -> int | None:
     """Largest multiple-of-8 divisor of the ROW count ``n`` such that
     every VMEM-resident buffer set fits the per-core budget. ``n_cols``
     is the block width (the shard's local column count; defaults to the
     unsharded square case n_cols = n); ``n_buffers`` overrides the
     (block, n_cols)-sized buffer count for kernels with a different
-    residency set (the totals pass holds 3: w-in x2 + gather scratch).
+    residency set (the totals pass holds 3: w-in x2 + gather scratch);
+    ``diag_rows`` says whether this kernel variant carries the mv/hbv
+    broadcast rows (the default True is the conservative worst case
+    ``supported()`` gates on).
 
     Beyond the matrix buffers, the search budgets the small operands
     too (same strict-conservatism rule as pallas_fd._fixed_bytes): the
@@ -336,8 +340,11 @@ def _pick_block(
     # valid (int8) + totals (f32) columns, padded to 128 lanes, x2.
     per_row = buffers * width * itemsize + 2 * 128 * (1 + 4)
     # mv (+hbv when heartbeats ride along) broadcast rows, 8-sublane
-    # padded int32, x2 — counted unconditionally (worst case: diag on).
-    fixed = (2 if track_hb else 1) * 2 * 8 * 4 * width
+    # padded int32, x2 — a real (and at 32k-wide, megabyte-scale) cost,
+    # but only for the kernel variant that carries the diagonal refresh
+    # (the round's FIRST sub-exchange); callers pass diag_rows=False
+    # for the refresh-free variants so those keep the larger block.
+    fixed = (2 if track_hb else 1) * 2 * 8 * 4 * width if diag_rows else 0
     return largest_fitting_block(n, per_row, cap, fixed)
 
 
@@ -407,7 +414,10 @@ def fused_pull_m8(
     itemsize = w.dtype.itemsize
     if track_hb:
         itemsize = max(itemsize, hb.dtype.itemsize)
-    block = _pick_block(n_rows, itemsize, track_hb=track_hb, n_cols=n_cols)
+    block = _pick_block(
+        n_rows, itemsize, track_hb=track_hb, n_cols=n_cols,
+        diag_rows=apply_diag,
+    )
     if block is None or n_rows % 128 != 0 or n_cols % 128 != 0:
         raise ValueError(f"no suitable row block for shape {w.shape}")
     if not track_hb:
@@ -529,7 +539,8 @@ def fused_pull_totals_m8(
     # tiny (block, 1) totals out and broadcast rows, so it can afford
     # larger row blocks (one shared accounting in _pick_block).
     block = _pick_block(
-        n_rows, w.dtype.itemsize, track_hb=False, n_cols=n_cols, n_buffers=3
+        n_rows, w.dtype.itemsize, track_hb=False, n_cols=n_cols,
+        n_buffers=3, diag_rows=apply_diag,
     )
     if block is None or n_rows % 128 != 0 or n_cols % 128 != 0:
         raise ValueError(f"no suitable row block for shape {w.shape}")
