@@ -392,14 +392,48 @@ def encode_digest(digest: Digest) -> bytes:
     return bytes(out)
 
 
+# Shared default for digest entries that carry no node_id submessage
+# (degenerate but legal); NodeId is frozen, so one instance is safe.
+_EMPTY_NODE_ID = NodeId("", 0, ("", 0))
+
+
 def decode_digest(body: bytes) -> Digest:
+    """Hot path: every handshake carries one or two digests with an
+    entry per known node. Entries are parsed in a WINDOW of the one
+    top-level reader (no per-entry bytes copy, no second _Reader
+    object) — ~equivalent bytes-in to the generic decode_node_digest,
+    whose behavior this mirrors exactly (same _Reader primitives, same
+    WireError cases; decode_node_digest remains the single-entry API
+    and the differential-test oracle)."""
     r = _Reader(body)
     digests: dict[NodeId, NodeDigest] = {}
     while not r.at_end():
         field, wt = r.field()
         if field == 1 and wt == _LEN:
-            nd = decode_node_digest(r.chunk())
-            digests[nd.node_id] = nd
+            n = r.varint()
+            entry_end = r.pos + n
+            if entry_end > r.end:
+                raise WireError("truncated length-delimited field")
+            node_id = _EMPTY_NODE_ID
+            heartbeat = last_gc = max_version = 0
+            outer_end = r.end
+            r.end = entry_end
+            while r.pos < entry_end:
+                ef, ewt = r.field()
+                if ef == 1 and ewt == _LEN:
+                    node_id = decode_node_id(r.chunk())
+                elif ef == 2 and ewt == _VARINT:
+                    heartbeat = r.varint()
+                elif ef == 3 and ewt == _VARINT:
+                    last_gc = r.varint()
+                elif ef == 4 and ewt == _VARINT:
+                    max_version = r.varint()
+                else:
+                    r.skip(ewt)
+            r.end = outer_end
+            digests[node_id] = NodeDigest(
+                node_id, heartbeat, last_gc, max_version
+            )
         else:
             r.skip(wt)
     return Digest(digests)

@@ -33,14 +33,18 @@ from aiocluster_tpu.wire import (
     encode_packet,
 )
 from aiocluster_tpu.wire.proto import (
+    WireError,
     decode_kv_update,
     decode_node_delta,
+    decode_node_digest,
     decode_node_id,
     encode_kv_update,
     encode_node_delta,
+    encode_node_digest,
     encode_node_id,
     varint_size,
 )
+from aiocluster_tpu.core.messages import NodeDigest
 
 N1 = NodeId("alpha", 123456789, ("10.1.2.3", 7001), None)
 N2 = NodeId("beta", 42, ("host.example", 65535), "beta.tls")
@@ -263,3 +267,39 @@ def test_node_id_codec_caches_are_sound():
     out1, out2 = decode_node_id(raw), decode_node_id(raw)
     assert out1 == big == out2
     assert out1 is not out2  # oversized: uncached path, fresh objects
+
+
+def test_decode_digest_windowed_matches_per_entry_oracle():
+    """r3: the windowed digest fast path must agree with the
+    single-entry decoder (decode_node_digest) on every entry, including
+    unknown fields and a missing node_id, and reject the same
+    truncations."""
+    nds = [
+        NodeDigest(NodeId(f"n{i}", i * 7, ("h", 1000 + i), None),
+                   heartbeat=i, last_gc_version=i // 2, max_version=3 * i)
+        for i in range(9)
+    ]
+    body = encode_digest(Digest({nd.node_id: nd for nd in nds}))
+    got = decode_digest(body)
+    for nd in nds:
+        assert got.node_digests[nd.node_id] == decode_node_digest(
+            encode_node_digest(nd)
+        )
+
+    # Unknown field (tag 9, varint) inside an entry is skipped by both.
+    entry = encode_node_digest(nds[0]) + bytes([9 << 3 | 0, 0x05])
+    framed = bytes([1 << 3 | 2, len(entry)]) + entry
+    assert decode_digest(framed).node_digests[nds[0].node_id] == \
+        decode_node_digest(entry)
+
+    # Entry with no node_id at all: default identity, not a crash.
+    anon = bytes([2 << 3 | 0, 0x2A])  # heartbeat=42 only
+    framed = bytes([1 << 3 | 2, len(anon)]) + anon
+    (only,) = decode_digest(framed).node_digests.values()
+    assert only.heartbeat == 42 and only.node_id.name == ""
+
+    # Truncation inside the declared entry window raises, same as the
+    # per-entry oracle on the same bytes.
+    bad = bytes([1 << 3 | 2, 10, 2 << 3 | 0])  # declares 10B, has 1
+    with pytest.raises(WireError):
+        decode_digest(bad)
