@@ -386,9 +386,28 @@ def decode_node_delta(body: bytes) -> NodeDelta:
 
 
 def encode_digest(digest: Digest) -> bytes:
+    """Hot path (the decode_digest note applies): each entry's length
+    is computed arithmetically and the fields are emitted straight into
+    ONE output buffer — no per-entry bytearray or bytes copy. Emission
+    is byte-identical to _field_msg(out, 1, encode_node_digest(nd)),
+    which remains the single-entry oracle (differential-tested)."""
     out = bytearray()
     for nd in digest.node_digests.values():
-        _field_msg(out, 1, encode_node_digest(nd))
+        nid = encode_node_id(nd.node_id)  # memoized bytes
+        hb, lgc, mv = nd.heartbeat, nd.last_gc_version, nd.max_version
+        body_len = 1 + varint_size(len(nid)) + len(nid)
+        if hb:
+            body_len += 1 + varint_size(hb)
+        if lgc:
+            body_len += 1 + varint_size(lgc)
+        if mv:
+            body_len += 1 + varint_size(mv)
+        out.append(1 << 3 | _LEN)
+        out += _uvarint(body_len)
+        _field_msg(out, 1, nid)
+        _field_varint(out, 2, hb)
+        _field_varint(out, 3, lgc)
+        _field_varint(out, 4, mv)
     return bytes(out)
 
 

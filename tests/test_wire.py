@@ -303,3 +303,21 @@ def test_decode_digest_windowed_matches_per_entry_oracle():
     bad = bytes([1 << 3 | 2, 10, 2 << 3 | 0])  # declares 10B, has 1
     with pytest.raises(WireError):
         decode_digest(bad)
+
+
+def test_encode_digest_inline_matches_per_entry_oracle():
+    """r3: the inline digest encoder's bytes must equal the single-entry
+    oracle's framing exactly, zero-valued fields (omitted) included."""
+    nds = [
+        NodeDigest(N1, heartbeat=0, last_gc_version=0, max_version=0),
+        NodeDigest(N2, heartbeat=1, last_gc_version=300, max_version=2**40),
+    ]
+    from aiocluster_tpu.wire.proto import _field_msg
+
+    d = Digest({nd.node_id: nd for nd in nds})
+    want = bytearray()
+    for nd in nds:
+        _field_msg(want, 1, encode_node_digest(nd))  # the stated oracle
+    assert encode_digest(d) == bytes(want)
+    # Round-trip through the windowed decoder agrees too.
+    assert decode_digest(encode_digest(d)).node_digests == d.node_digests
