@@ -224,7 +224,7 @@ def _start_watchdog(metric: str) -> None:
     t.start()
 
 
-def _run_benchmarks_helper(module: str, func: str, log, *args, **kwargs):
+def _run_benchmarks_helper(module: str, func: str, log, /, *args, **kwargs):
     """Import ``benchmarks/<module>.py`` under a temporary sys.path entry
     and call ``func`` — the one scaffold for every measured-anchor probe
     below; a failure logs and returns None (the bench record reports

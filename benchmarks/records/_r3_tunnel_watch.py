@@ -2,10 +2,11 @@
 
 Probes the TPU tunnel in a bounded subprocess every PROBE_EVERY_S and
 appends one JSON line per state *transition* (and a heartbeat every 30
-min) to r3_tunnel_log.jsonl next to this file. On a down->up
-transition it spawns the measurement battery (_r3_measure.py) at
-whatever HEAD is current, once per watcher lifetime — the builder
-re-runs the battery by hand after later kernel changes.
+min) to r3_tunnel_log.jsonl next to this file. Whenever the tunnel is
+observed up with no battery running it spawns the measurement battery
+(_r3_measure.py) at whatever HEAD is current — the battery skips
+phases an earlier window already captured, so re-fires are cheap and
+short windows accumulate coverage instead of restarting it.
 
 Builder-side tooling (not part of the shipped package).
 """
@@ -38,6 +39,51 @@ def tunnel_up() -> bool:
     return proc.returncode == 0 and out.startswith("64.0") and "cpu" not in out
 
 
+sys.path.insert(0, HERE)
+from _r3_measure import PHASES, _git_head  # noqa: E402  (stdlib-only import)
+
+PHASE_NAMES = tuple(name for name, _fn, _t in PHASES)
+# Long enough that a persistently-failing phase isn't hammered every
+# probe tick, short enough that a tunnel window re-opening after a
+# mid-battery drop isn't wasted waiting.
+BATTERY_COOLDOWN_S = 900.0
+
+
+def battery_running_anywhere() -> bool:
+    """True if ANY _r3_measure.py process exists — including an orphan
+    from a previous watcher incarnation. Two concurrent batteries would
+    contend for the one chip (skewing every best-of-N trial) and
+    interleave checkpoint writes."""
+    me = os.getpid()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace")
+        except OSError:
+            continue
+        if "_r3_measure.py" in cmd:
+            return True
+    return False
+
+
+def battery_needed() -> bool:
+    """Fire only when there is work: an unmeasured/incomplete phase, or
+    HEAD moved since the last battery (re-certify new code). Without
+    this gate a long up-window loops bench_full every 3 minutes."""
+    try:
+        with open(os.path.join(HERE, "r3_measurements.json")) as f:
+            rec = json.load(f)
+    except Exception:
+        return True
+    for name in PHASE_NAMES:
+        phase = rec.get(name)
+        if not (isinstance(phase, dict) and phase.get("_complete")):
+            return True
+    return rec.get("head") != _git_head()
+
+
 def emit(state: str) -> None:
     line = json.dumps(
         {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), "tunnel": state}
@@ -50,18 +96,29 @@ def emit(state: str) -> None:
 def main() -> None:
     last_state = None
     last_emit = 0.0
-    battery_launched = False
+    battery_started = -BATTERY_COOLDOWN_S
+    battery: subprocess.Popen | None = None
     while True:
         state = "up" if tunnel_up() else "down"
         now = time.time()
         if state != last_state or now - last_emit >= HEARTBEAT_EVERY_S:
             emit(state)
             last_state, last_emit = state, now
-        if state == "up" and not battery_launched:
-            battery_launched = True
+        # Windows can be minutes long (window 1: 12 min) — fire the
+        # battery whenever the tunnel is up, none is running, and there
+        # is actual work (incomplete phase or HEAD moved); the cooldown
+        # stops a failing phase from being hammered every probe tick.
+        if (
+            state == "up"
+            and (battery is None or battery.poll() is not None)
+            and not battery_running_anywhere()
+            and now - battery_started >= BATTERY_COOLDOWN_S
+            and battery_needed()
+        ):
+            battery_started = now
             emit("battery-start")
             with open(os.path.join(HERE, "r3_battery.out"), "ab") as f:
-                subprocess.Popen(
+                battery = subprocess.Popen(
                     [sys.executable, os.path.join(HERE, "_r3_measure.py")],
                     stdout=f, stderr=f,
                 )

@@ -27,6 +27,22 @@ def test_last_onchip_record_loads_at_head():
     assert inner["extra"]["platform"] not in ("cpu", None)
 
 
+def test_helper_accepts_log_kwarg_for_target():
+    """Regression: measured_reference_baseline forwards ``log=`` to the
+    target function while the helper itself takes ``log`` positionally —
+    the helper's leading params must be positional-only or the kwarg
+    collides (TypeError: multiple values for 'log'), which nulled the
+    first on-chip bench record of round 3."""
+    import inspect
+
+    sig = inspect.signature(bench._run_benchmarks_helper)
+    params = list(sig.parameters.values())
+    assert all(
+        p.kind is inspect.Parameter.POSITIONAL_ONLY for p in params[:3]
+    ), "module/func/log must be positional-only so kwargs may carry 'log'"
+    sig.bind("m", "f", print, 64, log=print)  # raises on the collision
+
+
 def test_latest_onchip_has_provenance():
     path = os.path.join(REPO, "benchmarks", "records", "latest_onchip.json")
     with open(path) as f:
