@@ -37,6 +37,7 @@ shard_map; ``None`` runs the identical math on one device.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -582,15 +583,48 @@ def sim_step(
                     tot = lax.psum(tot, axis_name)
                 else:
                     tot = None
-                pulled = pallas_pull.fused_pull_m8(
-                    w, hb if track_hb else None, gm8, c8,
-                    valid_pair, sub_salt(c, 0), run_salt,
-                    cfg.budget, interpret=interpret,
-                    mv=mv_vec if first else None,
-                    hbv=hbv_vec if first and track_hb else None,
-                    owner_offset=owners[0],
-                    totals=tot,
+                # Full-row shapes prefer the pair-fused kernel: both
+                # sides of each matched pair in one visit, 2/3 the HBM
+                # traffic (bit-identical; tests/test_pallas_pairs.py).
+                # The env override exists for benchmark A/B and as the
+                # measurement harness's kill-switch (variants never
+                # differ in results, only in speed). It is read at
+                # TRACE time: flipping it does not invalidate already-
+                # compiled executables for the same (cfg, shapes).
+                variant = (
+                    os.environ.get("AIOCLUSTER_TPU_PALLAS_VARIANT")
+                    or cfg.pallas_variant
                 )
+                if variant not in ("auto", "m8", "pairs"):
+                    raise ValueError(
+                        "AIOCLUSTER_TPU_PALLAS_VARIANT must be auto/m8/"
+                        f"pairs, got {variant!r}"
+                    )
+                use_pairs = (
+                    tot is None
+                    and variant in ("auto", "pairs")
+                    and pallas_pull.pairs_supported_for(
+                        n, w, hb if track_hb else None
+                    )
+                )
+                if use_pairs:
+                    pulled = pallas_pull.fused_pull_pairs(
+                        w, hb if track_hb else None, gm8, c8,
+                        valid_pair, sub_salt(c, 0), run_salt,
+                        cfg.budget, interpret=interpret,
+                        mv=mv_vec if first else None,
+                        hbv=hbv_vec if first and track_hb else None,
+                    )
+                else:
+                    pulled = pallas_pull.fused_pull_m8(
+                        w, hb if track_hb else None, gm8, c8,
+                        valid_pair, sub_salt(c, 0), run_salt,
+                        cfg.budget, interpret=interpret,
+                        mv=mv_vec if first else None,
+                        hbv=hbv_vec if first and track_hb else None,
+                        owner_offset=owners[0],
+                        totals=tot,
+                    )
                 w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
                 adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0))

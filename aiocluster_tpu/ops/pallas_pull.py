@@ -285,6 +285,181 @@ def _m8_totals_kernel(
         tot_ref[sl, :] = jnp.sum(d.astype(jnp.float32), axis=1, keepdims=True)
 
 
+def _pairs_kernel(
+    # scalar prefetch
+    ld_ref,  # (n/8,) slot -> leader group (padded past `count`)
+    gm_ref,  # (n/8,) partner group per group (involution)
+    c_ref,  # (n/8,) within-pair row rotation
+    vb_ref,  # (n/8,) alive-pair mask, one bit per row, packed per group
+    meta_ref,  # [salt, run_salt, budget, count]
+    # VMEM inputs (whole-array blocks, loaded once)
+    mv_ref,  # (1, n) int32 owner max_version (diag refresh; dummy if off)
+    hbv_ref,  # (1, n) int32 owner heartbeat (diag refresh; dummy if off)
+    # HBM operands
+    w_hbm,
+    hb_hbm,
+    # HBM outputs
+    wout_hbm,
+    hbout_hbm,
+    # scratch
+    win,  # (32, n): [buf 0/1] x [side 0/1] x 8 rows
+    wo,
+    hbin,
+    hbo,
+    insems,  # (2, 2, 2): [buf, side, matrix]
+    outsems,
+    *,
+    n: int,
+    track_hb: bool,
+    apply_diag: bool,
+):
+    """Both sides of every matched group pair in ONE visit (the
+    pair-fused pull). The matching is an involution, so the single-pass
+    kernel (_m8_kernel) touches each row of w three times per
+    sub-exchange: the in-spec stream reads it as "self", a gather DMA
+    reads it again as its partner's peer, and the out stream writes it.
+    Processing the pair (g, gm[g]) together needs each row only twice —
+    one read, one write — cutting the sub-exchange's HBM traffic by a
+    third. Both directions compute from the pre-sub-exchange tiles, which
+    is exactly the XLA matching path's semantics (one vectorized pull
+    through the involution covers both sides), so the bits are identical.
+
+    Single program (grid=(1,)): all streaming is manual double-buffered
+    DMA over a fori_loop of pair slots; scratch persists across the loop.
+    Slots [0, count) hold the leader groups (g <= gm[g]); self-matched
+    groups fetch their own tile into the peer slot (one redundant 8-row
+    read for at most one group per matching) and skip the side-1 write."""
+    salt = meta_ref[0]
+    run_salt = meta_ref[1]
+    budget = meta_ref[2].astype(jnp.float32)
+    count = meta_ref[3]
+    r_k1, js = _dither_base((8, n), salt, run_salt, jnp.int32(0))
+    col = lax.broadcasted_iota(jnp.int32, (8, n), 1)
+    r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
+    # The per-row alive-pair mask arrives as one PACKED int32 per group
+    # (bit r = row 8g+r): a (n, 1) VMEM column would lane-pad to 128
+    # bytes/row; a vectorized shift rebuilds the (8, 1) column from the
+    # scalar for free on the VPU.
+    sub8 = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+
+    def vmask(g):
+        return (vb_ref[g] >> sub8) & 1
+
+    mats = [(w_hbm, wout_hbm, win, wo, 0)]
+    if track_hb:
+        mats.append((hb_hbm, hbout_hbm, hbin, hbo, 1))
+
+    def in_copy(slot, side, mat):
+        src_hbm, _, scr, _, m = mats[mat]
+        g = ld_ref[slot]
+        src = (g if side == 0 else gm_ref[g]) * 8
+        row = (slot % 2) * 16 + side * 8
+        return pltpu.make_async_copy(
+            src_hbm.at[pl.ds(src, 8), :],
+            scr.at[pl.ds(row, 8), :],
+            insems.at[slot % 2, side, m],
+        )
+
+    def out_copy(slot, side, mat):
+        _, dst_hbm, _, scr, m = mats[mat]
+        g = ld_ref[slot]
+        dst = (g if side == 0 else gm_ref[g]) * 8
+        row = (slot % 2) * 16 + side * 8
+        return pltpu.make_async_copy(
+            scr.at[pl.ds(row, 8), :],
+            dst_hbm.at[pl.ds(dst, 8), :],
+            outsems.at[slot % 2, side, m],
+        )
+
+    def start_in(slot):
+        for mat in range(len(mats)):
+            in_copy(slot, 0, mat).start()
+            in_copy(slot, 1, mat).start()
+
+    def wait_in(slot):
+        for mat in range(len(mats)):
+            in_copy(slot, 0, mat).wait()
+            in_copy(slot, 1, mat).wait()
+
+    def start_out(slot):
+        for mat in range(len(mats)):
+            out_copy(slot, 0, mat).start()
+
+        @pl.when(gm_ref[ld_ref[slot]] != ld_ref[slot])
+        def _():
+            for mat in range(len(mats)):
+                out_copy(slot, 1, mat).start()
+
+    def wait_out(slot):
+        for mat in range(len(mats)):
+            out_copy(slot, 0, mat).wait()
+
+        @pl.when(gm_ref[ld_ref[slot]] != ld_ref[slot])
+        def _():
+            for mat in range(len(mats)):
+                out_copy(slot, 1, mat).wait()
+
+    def body(s, _):
+        base = (s % 2) * 16
+
+        @pl.when(s + 1 < count)
+        def _():
+            start_in(s + 1)
+
+        wait_in(s)
+        # The out DMA that streamed this buffer's previous occupant
+        # (slot s-2) must land before the computes below overwrite it.
+        @pl.when(s >= 2)
+        def _():
+            wait_out(s - 2)
+
+        g = ld_ref[s]
+        h = gm_ref[g]
+        cg = c_ref[g]
+        ch = c_ref[h]
+        vg = vmask(g)
+        vh = vmask(h)
+        w_g = win[pl.ds(base, 8), :].astype(jnp.int32)
+        w_h = win[pl.ds(base + 8, 8), :].astype(jnp.int32)
+        if apply_diag:
+            mv_b = mv_ref[:]
+            w_g = jnp.where(col == 8 * g + r8, mv_b, w_g)
+            w_h = jnp.where(col == 8 * h + r8, mv_b, w_h)
+        adv_g = _advance(w_g, pltpu.roll(w_h, cg, 0), vg, budget, r_k1, js, 8 * g)
+        adv_h = _advance(w_h, pltpu.roll(w_g, ch, 0), vh, budget, r_k1, js, 8 * h)
+        wo[pl.ds(base, 8), :] = (w_g + adv_g).astype(wo.dtype)
+        wo[pl.ds(base + 8, 8), :] = (w_h + adv_h).astype(wo.dtype)
+        if track_hb:
+            hb_g = hbin[pl.ds(base, 8), :].astype(jnp.int32)
+            hb_h = hbin[pl.ds(base + 8, 8), :].astype(jnp.int32)
+            if apply_diag:
+                hbv_b = hbv_ref[:]
+                hb_g = jnp.where(col == 8 * g + r8, hbv_b, hb_g)
+                hb_h = jnp.where(col == 8 * h + r8, hbv_b, hb_h)
+            hbo[pl.ds(base, 8), :] = jnp.maximum(
+                hb_g, pltpu.roll(hb_h, cg, 0) * vg
+            ).astype(hbo.dtype)
+            hbo[pl.ds(base + 8, 8), :] = jnp.maximum(
+                hb_h, pltpu.roll(hb_g, ch, 0) * vh
+            ).astype(hbo.dtype)
+        start_out(s)
+        return 0
+
+    start_in(0)
+    lax.fori_loop(0, count, body, 0)
+    # Drain: the last two slots' out DMAs are still in flight.
+    @pl.when(count >= 2)
+    def _():
+        wait_out(count - 2)
+
+    wait_out(count - 1)
+    if not track_hb:
+        # Lean mode: the dummy hb output still must be defined bytes.
+        cp = pltpu.make_async_copy(hb_hbm, hbout_hbm, outsems.at[0, 0, 1])
+        cp.start()
+        cp.wait()
+
+
 VMEM_BUDGET = 12 * 1024 * 1024  # ~16 MB/core, minus headroom for Mosaic
 
 # (block, n_cols)-sized VMEM buffers per matrix: pipelined in + out blocks
@@ -505,6 +680,153 @@ def fused_pull_m8(
         hb,
         valid.astype(jnp.int8)[:, None],
         totals,
+        mv,
+        hbv,
+        w,
+        hb,
+    )
+    return (w_new, hb_new) if track_hb else w_new
+
+
+def pairs_supported(n: int, itemsize: int, track_hb: bool = True) -> bool:
+    """Whether the pair-fused kernel can run this shape. Same matching
+    domain as the m8 kernel (n % 128 == 0); the VMEM residency differs —
+    no in-spec streaming, so the budget covers the four (or two, lean)
+    (32, n) double-buffered tiles, the two (8, n) uint32 dither bases,
+    and the sublane-padded mv/hbv broadcast rows."""
+    tiles = (4 if track_hb else 2) * 32 * n * itemsize
+    bases = 2 * 8 * n * 4
+    vecs = (2 if track_hb else 1) * 8 * n * 4
+    return n % 128 == 0 and tiles + bases + vecs <= VMEM_BUDGET
+
+
+def pairs_supported_for(n: int, w: jax.Array, hb: jax.Array | None) -> bool:
+    """pairs_supported with the itemsize derived from the operands —
+    the one eligibility rule shared by the sim_step dispatch and the
+    fused_pull_pairs wrapper."""
+    itemsize = w.dtype.itemsize
+    if hb is not None:
+        itemsize = max(itemsize, hb.dtype.itemsize)
+    return pairs_supported(n, itemsize, track_hb=hb is not None)
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "interpret"))
+def fused_pull_pairs(
+    w: jax.Array,
+    hb: jax.Array | None,
+    gm: jax.Array,
+    c: jax.Array,
+    valid: jax.Array,
+    salt: jax.Array,
+    run_salt: jax.Array,
+    budget: int,
+    interpret: bool = False,
+    mv: jax.Array | None = None,
+    hbv: jax.Array | None = None,
+):
+    """One fused grouped-matching sub-exchange, pair-at-a-time: 4 bytes
+    of HBM traffic per pair per matrix instead of the single-pass
+    kernel's 6 (each row read once and written once — the involution
+    means visiting pair (g, gm[g]) covers both directions). Same
+    signature contract as fused_pull_m8 minus the sharding arguments:
+    this variant requires the full rows (unsharded, or a one-shard
+    mesh). Bit-identical to fused_pull_m8 and to the XLA matching path
+    (asserted in tests/test_pallas_pairs.py).
+
+    Reference anchor: the same server.py:378-495 hot loop; the pairing
+    insight is that the reference's Syn/SynAck/Ack already computes both
+    directions from the pre-handshake digests, so one visit per pair is
+    semantically exact."""
+    track_hb = hb is not None
+    apply_diag = mv is not None
+    if apply_diag and track_hb and hbv is None:
+        raise ValueError("hbv required when mv is given and hb is tracked")
+    if hbv is not None and not track_hb:
+        raise ValueError("hbv given but no hb matrix to refresh (lean mode)")
+    if hbv is not None and mv is None:
+        raise ValueError("hbv given without mv: the diagonal refresh is all-or-none")
+    n, n_cols = w.shape
+    if n != n_cols:
+        raise ValueError("pair-fused kernel needs the full (n, n) matrix")
+    if not pairs_supported_for(n, w, hb):
+        raise ValueError(f"pair-fused kernel cannot run shape {w.shape}")
+    n_groups = n // 8
+    gm = gm.astype(jnp.int32)
+    gid = jnp.arange(n_groups, dtype=jnp.int32)
+    is_leader = gid <= gm
+    count = jnp.sum(is_leader.astype(jnp.int32))
+    (leaders,) = jnp.nonzero(is_leader, size=n_groups, fill_value=0)
+    # One alive-pair bit per row, packed per group (bit r = row 8g+r).
+    vbits = jnp.sum(
+        valid.astype(jnp.int32).reshape(n_groups, 8)
+        * (1 << jnp.arange(8, dtype=jnp.int32))[None, :],
+        axis=1,
+    )
+    meta = jnp.stack(
+        [
+            salt.astype(jnp.int32),
+            run_salt.astype(jnp.int32),
+            jnp.asarray(budget, jnp.int32),
+            count,
+        ]
+    )
+    if not track_hb:
+        hb = jnp.zeros((8, 128), w.dtype)
+    if apply_diag:
+        mv = mv.astype(jnp.int32)[None, :]
+        hbv = (
+            hbv.astype(jnp.int32)[None, :]
+            if track_hb
+            else jnp.zeros((1, 128), jnp.int32)
+        )
+        vec_spec = pl.BlockSpec((1, n), lambda *_: (0, 0))
+        hbv_spec = vec_spec if track_hb else pl.BlockSpec(
+            (1, 128), lambda *_: (0, 0)
+        )
+    else:
+        mv = jnp.zeros((1, 128), jnp.int32)
+        hbv = jnp.zeros((1, 128), jnp.int32)
+        vec_spec = hbv_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
+    hb_scr = (32, n) if track_hb else (8, 128)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(1,),
+        in_specs=[
+            vec_spec,  # mv row (dummy tile when diag off)
+            hbv_spec,  # heartbeat row (dummy tile when diag off / lean)
+            pl.BlockSpec(memory_space=pl.ANY),  # w (HBM operand)
+            pl.BlockSpec(memory_space=pl.ANY),  # hb
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # w out
+            pl.BlockSpec(memory_space=pl.ANY),  # hb out
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((32, n), w.dtype),  # win
+            pltpu.VMEM((32, n), w.dtype),  # wo
+            pltpu.VMEM(hb_scr, hb.dtype),  # hbin
+            pltpu.VMEM(hb_scr, hb.dtype),  # hbo
+            pltpu.SemaphoreType.DMA((2, 2, 2)),  # in [buf, side, mat]
+            pltpu.SemaphoreType.DMA((2, 2, 2)),  # out
+        ],
+    )
+    kernel = functools.partial(
+        _pairs_kernel, n=n, track_hb=track_hb, apply_diag=apply_diag
+    )
+    w_new, hb_new = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(w.shape, w.dtype),
+            jax.ShapeDtypeStruct(hb.shape, hb.dtype),
+        ],
+        interpret=interpret,
+    )(
+        leaders.astype(jnp.int32),
+        gm,
+        c.astype(jnp.int32),
+        vbits,
+        meta,
         mv,
         hbv,
         w,

@@ -143,6 +143,19 @@ class SimConfig:
     # the lean convergence-only profile runs a w-only variant.
     use_pallas: bool | str = "auto"
 
+    # Which fused-pull kernel implementation serves eligible matching
+    # sub-exchanges (only consulted when the Pallas path is engaged):
+    # - "auto" (default): the pair-fused kernel (fused_pull_pairs — each
+    #   row read once and written once per sub-exchange, 2/3 the HBM
+    #   traffic of the single-pass form) whenever the shape allows,
+    #   falling back to the single-pass kernel ("m8") otherwise — e.g.
+    #   multi-shard meshes, or shapes whose pair tiles exceed VMEM.
+    # - "m8" / "pairs": pin one implementation (benchmark A/B). "pairs"
+    #   still falls back to m8 off its domain. All variants are
+    #   bit-identical (tests/test_pallas_pairs.py), so this knob never
+    #   changes a trajectory.
+    pallas_variant: str = "auto"
+
     def __post_init__(self) -> None:
         if self.n_nodes < 2:
             raise ValueError("need at least 2 nodes")
@@ -187,3 +200,5 @@ class SimConfig:
             or self.use_pallas == "auto"
         ):
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
+        if self.pallas_variant not in ("auto", "m8", "pairs"):
+            raise ValueError(f"unknown pallas_variant: {self.pallas_variant!r}")

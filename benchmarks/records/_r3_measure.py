@@ -10,6 +10,10 @@ it is the certification point and must be at current HEAD.
 
 Phases, ordered by value-per-minute (short windows capture the front):
 
+0. pairs_canary   — first real-Mosaic A/B of the pair-fused pull kernel
+                    at the headline shape; a failure pins the battery to
+                    the proven single-pass kernel (bit-identical) so
+                    certification still lands.
 1. bench_full     — `python bench.py` at HEAD (headline, pallas
                     speedup, FD kernel, roofline, 32k lean probe,
                     measured reference baseline, exact convergence).
@@ -108,6 +112,50 @@ def _rate(sim, rounds=128, chunk=16, trials=3) -> float:
         _sync(sim.state.tick)
         best = max(best, rounds / (time.perf_counter() - t0))
     return round(best, 2)
+
+
+# -- phase 0: pair-fused kernel canary ----------------------------------------
+
+
+def phase_pairs_canary() -> dict:
+    """The pair-fused pull kernel (ops/pallas_pull.py::fused_pull_pairs,
+    2/3 the HBM traffic of the single-pass kernel) is interpret-verified
+    bit-identical but lands on real Mosaic for the first time here. A/B
+    it against the single-pass kernel at the headline shape BEFORE
+    bench_full: if it fails to compile or run, the orchestrator pins
+    AIOCLUSTER_TPU_PALLAS_VARIANT=m8 so the certification run still
+    lands (the variants are bit-identical, only speed differs)."""
+    import dataclasses
+
+    from aiocluster_tpu.sim import SimConfig, Simulator, budget_from_mtu
+
+    # The A/B is controlled by cfg.pallas_variant; a pin left over from
+    # a previous failure record must not silently turn the pairs arm
+    # into a second m8 run (false pairs_ok=True would un-pin a kernel
+    # known to fail).
+    os.environ.pop("AIOCLUSTER_TPU_PALLAS_VARIANT", None)
+    cfg = SimConfig(
+        n_nodes=10_240, keys_per_node=16, fanout=3,
+        budget=budget_from_mtu(65_507), writes_per_round=1,
+        version_dtype="int16", heartbeat_dtype="int16", fd_dtype="bfloat16",
+        pallas_variant="m8",
+    )
+    rec: dict = {}
+    rate_m8 = _rate(Simulator(cfg, seed=0, chunk=16), rounds=64)
+    rec["m8_rounds_per_sec"] = rate_m8
+    try:
+        pairs_cfg = dataclasses.replace(cfg, pallas_variant="pairs")
+        rate_pairs = _rate(Simulator(pairs_cfg, seed=0, chunk=16), rounds=64)
+        rec["pairs_rounds_per_sec"] = rate_pairs
+        rec["pairs_ok"] = True
+        rec["pairs_speedup_vs_m8"] = round(rate_pairs / rate_m8, 3)
+    except Exception as exc:
+        rec["pairs_ok"] = False
+        rec["pairs_error"] = repr(exc)[:600]
+        # NOT out["..."]["error"]: a Mosaic rejection is a measured
+        # RESULT (retrying won't change it); the m8 pin handles it.
+    log(f"pairs canary: {rec}")
+    return rec
 
 
 # -- phase 1: full bench.py ---------------------------------------------------
@@ -454,6 +502,7 @@ def phase_scatter_share() -> dict:
 # phases a short window MUST capture come first, and the long
 # convergence runs come last. (name, fn, subprocess timeout seconds).
 PHASES = [
+    ("pairs_canary", phase_pairs_canary, 900),
     ("bench_full", phase_bench_full, 2700),
     ("sharded_1dev", phase_sharded_1dev, 1200),
     ("i16_experiment", phase_i16, 1500),
@@ -526,6 +575,27 @@ def _run_phase_inprocess(name: str) -> None:
     log(f"{name} done in {out[name + '_seconds']}s")
 
 
+def _apply_canary_pin() -> None:
+    """If the pair-fused kernel is on record as failing real Mosaic, pin
+    this battery's phase children (they inherit our env) to the proven
+    single-pass kernel. Bit-identical either way — this trades speed for
+    a guaranteed certification record. Applied at battery start (the
+    canary phase may be skipped as already-complete) and again right
+    after the canary runs."""
+    canary = out.get("pairs_canary")
+    if isinstance(canary, dict) and (
+        canary.get("pairs_ok") is False
+        # A hard child death (segfault/abort/timeout) leaves only an
+        # error record with no pairs_ok — the likely first-on-chip
+        # Mosaic/DMA failure mode, and exactly the case the pin must
+        # cover. Pinning on a transient error is harmless (m8 is
+        # bit-identical, just the slower proven kernel).
+        or ("error" in canary and "pairs_ok" not in canary)
+    ):
+        os.environ["AIOCLUSTER_TPU_PALLAS_VARIANT"] = "m8"
+        log("pairs kernel not proven on chip — pinning variant m8")
+
+
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--phase":
         _run_phase_inprocess(sys.argv[2])
@@ -534,6 +604,7 @@ def main() -> None:
     out["head"] = _git_head()
     out["host_idle_at_start"] = _wait_for_idle_host()
     checkpoint()
+    _apply_canary_pin()
     only = sys.argv[1:] or None
     for name, _fn, phase_timeout in PHASES:
         if only and name not in only:
@@ -565,6 +636,8 @@ def main() -> None:
         # The child checkpoints its own result; reload it for later
         # phases that read prior ones (lean_scaling <- max_scale).
         out.update(_load_existing())
+        if name == "pairs_canary":
+            _apply_canary_pin()
         unchanged = json.dumps(
             out.get(name), sort_keys=True, default=str
         ) == before

@@ -1,0 +1,157 @@
+"""Pair-fused pull kernel: exact parity with the single-pass kernel and
+the XLA path.
+
+The pair-fused variant (ops/pallas_pull.py::fused_pull_pairs) visits
+both sides of each matched group pair in one program step, reading and
+writing every row of w (and hb) exactly once per sub-exchange — 4 bytes
+of HBM traffic per pair per matrix instead of the single-pass kernel's
+6. Both directions compute from the pre-sub-exchange tiles, which is the
+XLA matching path's semantics too, so all three implementations must be
+bit-identical. Interpreter mode on CPU (tests/conftest.py); the compiled
+path is measured on real TPU by bench.py.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import random
+
+from aiocluster_tpu.ops.gossip import _grouped_matching, sim_step
+from aiocluster_tpu.ops.pallas_pull import (
+    fused_pull_m8,
+    fused_pull_pairs,
+    pairs_supported,
+)
+from aiocluster_tpu.sim import SimConfig
+from aiocluster_tpu.sim.state import init_state
+
+
+def _case(n, dtype, seed, alive_p=0.85):
+    key = random.key(seed)
+    kw, kh, kp, ka = random.split(key, 4)
+    w = random.randint(kw, (n, n), 0, 50).astype(dtype)
+    hb = random.randint(kh, (n, n), 0, 30).astype(dtype)
+    gm, c, p = _grouped_matching(kp, n)
+    alive = random.bernoulli(ka, alive_p, (n,))
+    valid = alive & alive[p]
+    salt = jnp.asarray(7, jnp.int32)
+    run_salt = jnp.asarray(0x12345678, jnp.uint32)
+    return w, hb, gm, c, valid, salt, run_salt
+
+
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.int16])
+@pytest.mark.parametrize("seed", [3, 11])
+def test_pairs_matches_m8(dtype, seed):
+    n = 128
+    w, hb, gm, c, valid, salt, run_salt = _case(n, dtype, seed)
+    w_m8, hb_m8 = fused_pull_m8(
+        w, hb, gm, c, valid, salt, run_salt, budget=40, interpret=True
+    )
+    w_pr, hb_pr = fused_pull_pairs(
+        w, hb, gm, c, valid, salt, run_salt, budget=40, interpret=True
+    )
+    assert w_pr.dtype == dtype
+    np.testing.assert_array_equal(np.asarray(w_pr), np.asarray(w_m8))
+    np.testing.assert_array_equal(np.asarray(hb_pr), np.asarray(hb_m8))
+
+
+def test_pairs_lean_matches_m8():
+    n = 128
+    w, _hb, gm, c, valid, salt, run_salt = _case(n, jnp.int16, 5)
+    w_m8 = fused_pull_m8(
+        w, None, gm, c, valid, salt, run_salt, budget=24, interpret=True
+    )
+    w_pr = fused_pull_pairs(
+        w, None, gm, c, valid, salt, run_salt, budget=24, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(w_pr), np.asarray(w_m8))
+
+
+def test_pairs_diag_fold_matches_m8():
+    n = 128
+    w, hb, gm, c, valid, salt, run_salt = _case(n, jnp.int32, 9)
+    mv = random.randint(random.key(21), (n,), 40, 90).astype(jnp.int32)
+    hbv = random.randint(random.key(22), (n,), 20, 60).astype(jnp.int32)
+    w_m8, hb_m8 = fused_pull_m8(
+        w, hb, gm, c, valid, salt, run_salt, budget=40, interpret=True,
+        mv=mv, hbv=hbv,
+    )
+    w_pr, hb_pr = fused_pull_pairs(
+        w, hb, gm, c, valid, salt, run_salt, budget=40, interpret=True,
+        mv=mv, hbv=hbv,
+    )
+    np.testing.assert_array_equal(np.asarray(w_pr), np.asarray(w_m8))
+    np.testing.assert_array_equal(np.asarray(hb_pr), np.asarray(hb_m8))
+
+
+def test_pairs_odd_group_count_self_match():
+    """One self-matched group (odd group count lives off the kernel's
+    n % 128 domain, so force it through the wrapper directly): the
+    self-matched group's rows pair within the group and its side-1
+    write is skipped — every row still written exactly once."""
+    # 136 = 17 groups -> one self-matched group. Off the sim_step gate's
+    # n % 128 domain but fine for the kernel itself (n % 8 == 0 rows);
+    # the lane dimension is what must be 128-aligned, and 136 is not —
+    # so build the case at 1024 with a hand-forced self-match instead.
+    n = 1024
+    w, hb, gm, c, valid, salt, run_salt = _case(n, jnp.int16, 13)
+    gm = np.asarray(gm).copy()
+    c = np.asarray(c).copy()
+    # Re-pair: make groups 0 and 1 self-matched (their previous partners
+    # pair with each other), keeping gm an involution.
+    a, b = gm[0], gm[1]
+    if a != 0 and b != 1 and a != 1:
+        gm[0], gm[1] = 0, 1
+        gm[a], gm[b] = b, a
+        c[0], c[1] = 0, 4
+        c[a], c[b] = 3, 5
+    # The coverage this test exists for: at least one self-matched group.
+    assert (gm == np.arange(len(gm))).any()
+    gm = jnp.asarray(gm)
+    c = jnp.asarray(c)
+    p = (8 * gm[jnp.arange(n) // 8] + (jnp.arange(n) - c[jnp.arange(n) // 8]) % 8).astype(jnp.int32)
+    assert (np.asarray(p)[np.asarray(p)] == np.arange(n)).all()
+    valid = valid & valid[p]
+    w_m8, hb_m8 = fused_pull_m8(
+        w, hb, gm, c, valid, salt, run_salt, budget=32, interpret=True
+    )
+    w_pr, hb_pr = fused_pull_pairs(
+        w, hb, gm, c, valid, salt, run_salt, budget=32, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(w_pr), np.asarray(w_m8))
+    np.testing.assert_array_equal(np.asarray(hb_pr), np.asarray(hb_m8))
+
+
+def test_pairs_supported_domain():
+    assert pairs_supported(1024, 2, track_hb=True)
+    assert pairs_supported(32_768, 2, track_hb=False)
+    assert not pairs_supported(1000, 2)  # off the matching domain
+    assert not pairs_supported(65_536, 4, track_hb=True)  # VMEM
+
+
+def test_sim_step_variant_trajectories_identical():
+    """Full sim_step trajectories: pallas_variant='pairs' must reproduce
+    'm8' (and therefore the XLA path, which m8 is tested against) bit
+    for bit over several rounds with churn."""
+    cfg = SimConfig(
+        n_nodes=256, keys_per_node=4, fanout=2, budget=24,
+        writes_per_round=1, death_rate=0.02, revival_rate=0.1,
+        use_pallas=True,
+    )
+    key = random.key(0)
+    states = {}
+    for variant in ("m8", "pairs"):
+        vcfg = dataclasses.replace(cfg, pallas_variant=variant)
+        st = init_state(vcfg)
+        for _ in range(4):
+            st = sim_step(st, key, vcfg)
+        states[variant] = st
+    np.testing.assert_array_equal(
+        np.asarray(states["m8"].w), np.asarray(states["pairs"].w)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(states["m8"].hb_known), np.asarray(states["pairs"].hb_known)
+    )
