@@ -399,6 +399,47 @@ def pallas_path_engaged(
     )
 
 
+def pallas_variant_engaged(
+    cfg: SimConfig,
+    axis_name: str | None = None,
+    n_local: int | None = None,
+) -> str:
+    """Which pull-kernel implementation serves matching sub-exchanges
+    when the Pallas path is engaged: "pairs" (the pair-fused kernel —
+    2 passes per matrix per sub-exchange) or "m8" (the single-pass
+    kernel — 3). Single source of truth consumed by sim_step's dispatch
+    AND by bench.py's variant provenance + analytic bytes/round, so the
+    recorded roofline can never drift from what the kernel actually did
+    (same drift class pallas_path_engaged guards against). Resolves the
+    AIOCLUSTER_TPU_PALLAS_VARIANT env override (the benchmark A/B /
+    kill-switch knob; read at trace time) over cfg.pallas_variant, and
+    validates it loudly — a typo'd override must not silently measure
+    the wrong kernel."""
+    from . import pallas_pull
+
+    variant = (
+        os.environ.get("AIOCLUSTER_TPU_PALLAS_VARIANT") or cfg.pallas_variant
+    )
+    if variant not in ("auto", "m8", "pairs"):
+        raise ValueError(
+            "AIOCLUSTER_TPU_PALLAS_VARIANT must be auto/m8/pairs, "
+            f"got {variant!r}"
+        )
+    n = cfg.n_nodes
+    sharded = (
+        axis_name is not None and n_local is not None and n // n_local > 1
+    )
+    itemsize = jnp.dtype(cfg.version_dtype).itemsize
+    if cfg.track_heartbeats:
+        itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
+    use_pairs = (
+        not sharded
+        and variant in ("auto", "pairs")
+        and pallas_pull.pairs_supported(n, itemsize, cfg.track_heartbeats)
+    )
+    return "pairs" if use_pairs else "m8"
+
+
 def pallas_fd_engaged(cfg: SimConfig, n_local: int | None = None) -> bool:
     """Whether the streaming FD kernel (ops/pallas_fd.py) replaces the
     XLA failure-detection block for this config. Mirrors
@@ -586,26 +627,12 @@ def sim_step(
                 # Full-row shapes prefer the pair-fused kernel: both
                 # sides of each matched pair in one visit, 2/3 the HBM
                 # traffic (bit-identical; tests/test_pallas_pairs.py).
-                # The env override exists for benchmark A/B and as the
-                # measurement harness's kill-switch (variants never
-                # differ in results, only in speed). It is read at
-                # TRACE time: flipping it does not invalidate already-
-                # compiled executables for the same (cfg, shapes).
-                variant = (
-                    os.environ.get("AIOCLUSTER_TPU_PALLAS_VARIANT")
-                    or cfg.pallas_variant
-                )
-                if variant not in ("auto", "m8", "pairs"):
-                    raise ValueError(
-                        "AIOCLUSTER_TPU_PALLAS_VARIANT must be auto/m8/"
-                        f"pairs, got {variant!r}"
-                    )
-                use_pairs = (
-                    tot is None
-                    and variant in ("auto", "pairs")
-                    and pallas_pull.pairs_supported_for(
-                        n, w, hb if track_hb else None
-                    )
+                # One decision function shared with bench's provenance;
+                # `tot is None` re-asserts the unsharded precondition at
+                # the call site (the helper derives it from n_local).
+                use_pairs = tot is None and (
+                    pallas_variant_engaged(cfg, axis_name, n_local)
+                    == "pairs"
                 )
                 if use_pairs:
                     pulled = pallas_pull.fused_pull_pairs(

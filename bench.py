@@ -196,6 +196,15 @@ def resolve_platform(requested: str, log) -> None:
 WATCHDOG_S = float(os.environ.get("BENCH_WATCHDOG_S", "2400"))
 
 
+def _is_oom(exc: BaseException) -> bool:
+    """XLA spells device OOM several ways ('RESOURCE_EXHAUSTED',
+    'Resource exhausted: Out of memory while trying to allocate ...')."""
+    msg = repr(exc).lower()
+    return "resource_exhausted" in msg or "resource exhausted" in msg or (
+        "out of memory" in msg
+    )
+
+
 def _start_watchdog(metric: str) -> None:
     """Guarantee the one-JSON-line contract even if the backend wedges
     mid-run (e.g. the tunnel drops AFTER a successful probe and the
@@ -298,19 +307,22 @@ HBM_PEAK_GBPS = {
 }
 
 
-def estimate_bytes_per_round(cfg) -> int:
+def estimate_bytes_per_round(cfg, variant: str = "m8") -> int:
     """Analytic HBM traffic of one round under the fused-kernel matching
-    path: per sub-exchange each (N, N) matrix is read once as blocks,
-    read once as DMA'd peer rows, and written once (3 passes); the FD
-    phase reads/writes its bookkeeping matrices once each plus the two
-    heartbeat operands. Used to report achieved GB/s vs the chip's peak
-    in the bench record (the roofline the kernel work chases)."""
+    path. Single-pass kernel ("m8"): per sub-exchange each (N, N) matrix
+    is read once as blocks, read once as DMA'd peer rows, and written
+    once (3 passes). Pair-fused kernel ("pairs"): each row is read once
+    and written once (2 passes). The FD phase reads/writes its
+    bookkeeping matrices once each plus the two heartbeat operands.
+    Used to report achieved GB/s vs the chip's peak in the bench record
+    (the roofline the kernel work chases)."""
     import jax.numpy as jnp
 
     n2 = cfg.n_nodes * cfg.n_nodes
     m_w = n2 * jnp.dtype(cfg.version_dtype).itemsize
     m_hb = n2 * jnp.dtype(cfg.heartbeat_dtype).itemsize if cfg.track_heartbeats else 0
-    total = cfg.fanout * 3 * (m_w + m_hb)
+    passes = 2 if variant == "pairs" else 3
+    total = cfg.fanout * passes * (m_w + m_hb)
     if cfg.track_failure_detector:
         m_fd = n2 * jnp.dtype(cfg.fd_dtype).itemsize
         total += 2 * m_hb  # hb + round-start hb reads
@@ -409,11 +421,20 @@ def sim_rounds_per_sec(
         except Exception as exc:
             log(f"XLA-path comparison failed: {exc!r}")
 
+        # Which pull-kernel implementation served the run — THE decision
+        # function sim_step dispatches on, so the recorded variant and
+        # the analytic bytes/round below (pairs: 2 passes per matrix per
+        # sub-exchange; m8: 3) can never drift from what actually ran.
+        from aiocluster_tpu.ops.gossip import pallas_variant_engaged
+
+        variant = pallas_variant_engaged(cfg)
+        extra["pallas_variant_engaged"] = variant
+
         # Roofline: analytic fused-path bytes/round vs the chip's HBM peak
         # (only meaningful when the fused path ran on the real chip). The
         # peak is keyed by device kind; unknown chips get the number
         # without a fraction rather than a wrong one.
-        bpr = estimate_bytes_per_round(cfg)
+        bpr = estimate_bytes_per_round(cfg, variant)
         achieved = bpr * rps / 1e9
         kind = jax.devices()[0].device_kind
         peak = HBM_PEAK_GBPS.get(kind)
@@ -513,6 +534,7 @@ def main() -> None:
         rounds = 10_000
 
     metric = f"sim_gossip_rounds_per_sec@{n_nodes}_nodes"
+    t_main = time.perf_counter()  # the watchdog's clock, for probe budgets
     _start_watchdog(metric)
     try:
         requested = args.platform or ("cpu" if args.smoke else "auto")
@@ -544,19 +566,30 @@ def main() -> None:
         log(f"python object-model estimate: {baseline_rps:.4f} rounds/s")
         probe_rps = None
         probe_max_rps = None
+        probe_max_n = None
         if not args.smoke and on_accel:
             try:
                 probe_rps = round(scale_probe(log), 2)
             except Exception as exc:  # keep the headline even if the probe dies
                 log(f"scale probe failed: {exc!r}")
-            try:
-                # The planner's true single-chip maximum (the lean int16
-                # profile fits ~52k, not the old 4 B/pair arithmetic's 38k).
-                probe_max_rps = round(
-                    scale_probe(log, n_nodes=MAX_LEAN_SINGLE_CHIP), 2
-                )
-            except Exception as exc:
-                log(f"max-scale probe failed: {exc!r}")
+            # The planner claims the lean int16 profile fits ~52k, but
+            # the chip OOM'd there (round-3 window 1) — walk the
+            # 128-aligned ladder down to the largest N that actually
+            # executes and record that boundary. Each rung pays a full
+            # compile, so stop while the watchdog still has room to
+            # emit the measurements already taken.
+            for probe_n in (MAX_LEAN_SINGLE_CHIP, 49_152, 45_056, 40_960):
+                if time.perf_counter() - t_main > WATCHDOG_S - 600:
+                    log("max-scale ladder stopped: watchdog budget low")
+                    break
+                try:
+                    probe_max_rps = round(scale_probe(log, n_nodes=probe_n), 2)
+                    probe_max_n = probe_n
+                    break
+                except Exception as exc:
+                    log(f"max-scale probe at {probe_n} failed: {exc!r}")
+                    if not _is_oom(exc):
+                        break  # not an OOM — don't hammer a sick tunnel
         anchored = None if args.smoke else anchored_asyncio_seconds(log)
         ref_measured = None if args.smoke else measured_reference_baseline(log)
         # A CPU-fallback record is still a valid run, but its headline is
@@ -602,9 +635,10 @@ def main() -> None:
                     if probe_rps is not None
                     else None
                 ),
-                "max_scale_single_chip_planner_limit": (
+                "max_scale_single_chip_measured_boundary": (
                     {
-                        "nodes": MAX_LEAN_SINGLE_CHIP,
+                        "nodes": probe_max_n,
+                        "planner_limit_nodes": MAX_LEAN_SINGLE_CHIP,
                         "profile": "lean",
                         "rounds_per_sec": probe_max_rps,
                     }

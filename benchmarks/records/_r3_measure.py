@@ -272,7 +272,12 @@ def phase_max_scale() -> dict:
             msg = repr(exc)
             tried.append({"n": n, "ok": False, "error": msg[:300]})
             log(f"max-scale: n={n} failed: {msg[:120]}")
-            if "RESOURCE_EXHAUSTED" not in msg and "Resource" not in msg:
+            low = msg.lower()
+            if (
+                "resource_exhausted" not in low
+                and "resource exhausted" not in low
+                and "out of memory" not in low
+            ):
                 break  # not an OOM — don't keep hammering a down tunnel
     if largest is None:
         # No rung executed (all OOM, or a transient non-OOM failure):
