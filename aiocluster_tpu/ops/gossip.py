@@ -426,16 +426,14 @@ def pallas_variant_engaged(
             f"got {variant!r}"
         )
     n = cfg.n_nodes
-    sharded = (
-        axis_name is not None and n_local is not None and n // n_local > 1
-    )
+    if axis_name is not None and n_local is None:
+        return "m8"  # sharded callers must say how wide a shard is
+    width = n if axis_name is None else n_local
     itemsize = jnp.dtype(cfg.version_dtype).itemsize
     if cfg.track_heartbeats:
         itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
-    use_pairs = (
-        not sharded
-        and variant in ("auto", "pairs")
-        and pallas_pull.pairs_supported(n, itemsize, cfg.track_heartbeats)
+    use_pairs = variant in ("auto", "pairs") and pallas_pull.pairs_supported(
+        n, itemsize, cfg.track_heartbeats, n_local=width
     )
     return "pairs" if use_pairs else "m8"
 
@@ -610,13 +608,27 @@ def sim_step(
                 # kernel — its in-kernel row sum IS the global total —
                 # so single-chip "sharded" runs pay no two-pass tax.
                 shards = n // n_local
+                # The pair-fused kernels visit both sides of each
+                # matched pair in one pass — 2/3 the HBM traffic of the
+                # single-pass form, bit-identical
+                # (tests/test_pallas_pairs.py). One decision function
+                # shared with bench's provenance labelling.
+                use_pairs = (
+                    pallas_variant_engaged(cfg, axis_name, n_local)
+                    == "pairs"
+                )
                 if axis_name is not None and shards > 1:
                     # Two-pass sharded form: local deficit totals
                     # (streaming pass, no writes), one psum — the only
                     # ICI traffic — then the apply pass with the global
                     # totals. Bit-identical to the XLA sharded path's
                     # psum(d.sum(axis=1)) pipeline.
-                    tot = pallas_pull.fused_pull_totals_m8(
+                    totals_fn = (
+                        pallas_pull.fused_pull_pairs_totals
+                        if use_pairs
+                        else pallas_pull.fused_pull_totals_m8
+                    )
+                    tot = totals_fn(
                         w, gm8, c8, valid_pair, interpret=interpret,
                         mv=mv_vec if first else None,
                         owner_offset=owners[0],
@@ -624,34 +636,20 @@ def sim_step(
                     tot = lax.psum(tot, axis_name)
                 else:
                     tot = None
-                # Full-row shapes prefer the pair-fused kernel: both
-                # sides of each matched pair in one visit, 2/3 the HBM
-                # traffic (bit-identical; tests/test_pallas_pairs.py).
-                # One decision function shared with bench's provenance;
-                # `tot is None` re-asserts the unsharded precondition at
-                # the call site (the helper derives it from n_local).
-                use_pairs = tot is None and (
-                    pallas_variant_engaged(cfg, axis_name, n_local)
-                    == "pairs"
+                pull_fn = (
+                    pallas_pull.fused_pull_pairs
+                    if use_pairs
+                    else pallas_pull.fused_pull_m8
                 )
-                if use_pairs:
-                    pulled = pallas_pull.fused_pull_pairs(
-                        w, hb if track_hb else None, gm8, c8,
-                        valid_pair, sub_salt(c, 0), run_salt,
-                        cfg.budget, interpret=interpret,
-                        mv=mv_vec if first else None,
-                        hbv=hbv_vec if first and track_hb else None,
-                    )
-                else:
-                    pulled = pallas_pull.fused_pull_m8(
-                        w, hb if track_hb else None, gm8, c8,
-                        valid_pair, sub_salt(c, 0), run_salt,
-                        cfg.budget, interpret=interpret,
-                        mv=mv_vec if first else None,
-                        hbv=hbv_vec if first and track_hb else None,
-                        owner_offset=owners[0],
-                        totals=tot,
-                    )
+                pulled = pull_fn(
+                    w, hb if track_hb else None, gm8, c8,
+                    valid_pair, sub_salt(c, 0), run_salt,
+                    cfg.budget, interpret=interpret,
+                    mv=mv_vec if first else None,
+                    hbv=hbv_vec if first and track_hb else None,
+                    owner_offset=owners[0],
+                    totals=tot,
+                )
                 w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
                 adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0))

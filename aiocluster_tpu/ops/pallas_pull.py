@@ -291,13 +291,14 @@ def _pairs_kernel(
     gm_ref,  # (n/8,) partner group per group (involution)
     c_ref,  # (n/8,) within-pair row rotation
     vb_ref,  # (n/8,) alive-pair mask, one bit per row, packed per group
-    meta_ref,  # [salt, run_salt, budget, count]
+    meta_ref,  # [salt, run_salt, budget, count, owner_offset]
     # VMEM inputs (whole-array blocks, loaded once)
     mv_ref,  # (1, n) int32 owner max_version (diag refresh; dummy if off)
     hbv_ref,  # (1, n) int32 owner heartbeat (diag refresh; dummy if off)
     # HBM operands
     w_hbm,
     hb_hbm,
+    tot_hbm,  # (n_rows, 1) f32 global deficit totals (dummy if unused)
     # HBM outputs
     wout_hbm,
     hbout_hbm,
@@ -306,12 +307,14 @@ def _pairs_kernel(
     wo,
     hbin,
     hbo,
-    insems,  # (2, 2, 2): [buf, side, matrix]
-    outsems,
+    tscr,  # (32, 1) f32 totals rows (dummy if unused)
+    insems,  # (2, 2, 3): [buf, side, matrix(w/hb/totals)]
+    outsems,  # (2, 2, 2): [buf, side, matrix(w/hb)]
     *,
     n: int,
     track_hb: bool,
     apply_diag: bool,
+    use_totals: bool,
 ):
     """Both sides of every matched group pair in ONE visit (the
     pair-fused pull). The matching is an involution, so the single-pass
@@ -328,13 +331,22 @@ def _pairs_kernel(
     DMA over a fori_loop of pair slots; scratch persists across the loop.
     Slots [0, count) hold the leader groups (g <= gm[g]); self-matched
     groups fetch their own tile into the peer slot (one redundant 8-row
-    read for at most one group per matching) and skip the side-1 write."""
+    read for at most one group per matching) and skip the side-1 write.
+
+    Column sharding: w may be an (N, n_local) block — rows stay global
+    (the pairing is over rows, and peer rows are shard-local), columns
+    are this shard's owners. ``owner_offset`` keys the dither hash and
+    the diagonal compares off GLOBAL column ids, and ``use_totals``
+    feeds the rows' global deficit totals (psum'd between the kernel
+    passes) in place of the in-kernel local sum — together they make
+    the sharded bits exactly the single-device bits."""
     salt = meta_ref[0]
     run_salt = meta_ref[1]
     budget = meta_ref[2].astype(jnp.float32)
     count = meta_ref[3]
-    r_k1, js = _dither_base((8, n), salt, run_salt, jnp.int32(0))
-    col = lax.broadcasted_iota(jnp.int32, (8, n), 1)
+    owner_off = meta_ref[4]
+    r_k1, js = _dither_base((8, n), salt, run_salt, owner_off)
+    col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
     r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
     # The per-row alive-pair mask arrives as one PACKED int32 per group
     # (bit r = row 8g+r): a (n, 1) VMEM column would lane-pad to 128
@@ -371,15 +383,31 @@ def _pairs_kernel(
             outsems.at[slot % 2, side, m],
         )
 
+    def tot_copy(slot, side):
+        g = ld_ref[slot]
+        src = (g if side == 0 else gm_ref[g]) * 8
+        row = (slot % 2) * 16 + side * 8
+        return pltpu.make_async_copy(
+            tot_hbm.at[pl.ds(src, 8), :],
+            tscr.at[pl.ds(row, 8), :],
+            insems.at[slot % 2, side, 2],
+        )
+
     def start_in(slot):
         for mat in range(len(mats)):
             in_copy(slot, 0, mat).start()
             in_copy(slot, 1, mat).start()
+        if use_totals:
+            tot_copy(slot, 0).start()
+            tot_copy(slot, 1).start()
 
     def wait_in(slot):
         for mat in range(len(mats)):
             in_copy(slot, 0, mat).wait()
             in_copy(slot, 1, mat).wait()
+        if use_totals:
+            tot_copy(slot, 0).wait()
+            tot_copy(slot, 1).wait()
 
     def start_out(slot):
         for mat in range(len(mats)):
@@ -425,8 +453,14 @@ def _pairs_kernel(
             mv_b = mv_ref[:]
             w_g = jnp.where(col == 8 * g + r8, mv_b, w_g)
             w_h = jnp.where(col == 8 * h + r8, mv_b, w_h)
-        adv_g = _advance(w_g, pltpu.roll(w_h, cg, 0), vg, budget, r_k1, js, 8 * g)
-        adv_h = _advance(w_h, pltpu.roll(w_g, ch, 0), vh, budget, r_k1, js, 8 * h)
+        tg = tscr[pl.ds(base, 8), :] if use_totals else None
+        th = tscr[pl.ds(base + 8, 8), :] if use_totals else None
+        adv_g = _advance(
+            w_g, pltpu.roll(w_h, cg, 0), vg, budget, r_k1, js, 8 * g, tg
+        )
+        adv_h = _advance(
+            w_h, pltpu.roll(w_g, ch, 0), vh, budget, r_k1, js, 8 * h, th
+        )
         wo[pl.ds(base, 8), :] = (w_g + adv_g).astype(wo.dtype)
         wo[pl.ds(base + 8, 8), :] = (w_h + adv_h).astype(wo.dtype)
         if track_hb:
@@ -458,6 +492,126 @@ def _pairs_kernel(
         cp = pltpu.make_async_copy(hb_hbm, hbout_hbm, outsems.at[0, 0, 1])
         cp.start()
         cp.wait()
+
+
+def _pairs_totals_kernel(
+    # scalar prefetch
+    ld_ref,
+    gm_ref,
+    c_ref,
+    vb_ref,
+    meta_ref,  # [count, owner_offset]
+    # VMEM input
+    mv_ref,  # (1, n) int32 (diag refresh; dummy if off)
+    # HBM operand
+    w_hbm,
+    # HBM output
+    tot_hbm,  # (n_rows, 1) f32 local deficit row totals
+    # scratch
+    win,  # (32, n)
+    tout,  # (32, 1) f32
+    insems,  # (2, 2): [buf, side]
+    outsems,  # (2, 2)
+    *,
+    n: int,
+    apply_diag: bool,
+):
+    """Pass A of the sharded pair-fused pull: LOCAL deficit row totals
+    for this shard's (N, n_local) block, visiting each matched group
+    pair once — every row read ONCE (the m8 totals pass reads each row
+    twice: streamed as self, gathered as its partner's peer). The
+    caller psums the (N,) result across shards and feeds it to
+    fused_pull_pairs as ``totals``."""
+    count = meta_ref[0]
+    owner_off = meta_ref[1]
+    col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
+    r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
+    sub8 = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+
+    def vmask(g):
+        return (vb_ref[g] >> sub8) & 1
+
+    def in_copy(slot, side):
+        g = ld_ref[slot]
+        src = (g if side == 0 else gm_ref[g]) * 8
+        row = (slot % 2) * 16 + side * 8
+        return pltpu.make_async_copy(
+            w_hbm.at[pl.ds(src, 8), :],
+            win.at[pl.ds(row, 8), :],
+            insems.at[slot % 2, side],
+        )
+
+    def out_copy(slot, side):
+        g = ld_ref[slot]
+        dst = (g if side == 0 else gm_ref[g]) * 8
+        row = (slot % 2) * 16 + side * 8
+        return pltpu.make_async_copy(
+            tout.at[pl.ds(row, 8), :],
+            tot_hbm.at[pl.ds(dst, 8), :],
+            outsems.at[slot % 2, side],
+        )
+
+    def start_in(slot):
+        in_copy(slot, 0).start()
+        in_copy(slot, 1).start()
+
+    def start_out(slot):
+        out_copy(slot, 0).start()
+
+        @pl.when(gm_ref[ld_ref[slot]] != ld_ref[slot])
+        def _():
+            out_copy(slot, 1).start()
+
+    def wait_out(slot):
+        out_copy(slot, 0).wait()
+
+        @pl.when(gm_ref[ld_ref[slot]] != ld_ref[slot])
+        def _():
+            out_copy(slot, 1).wait()
+
+    def body(s, _):
+        base = (s % 2) * 16
+
+        @pl.when(s + 1 < count)
+        def _():
+            start_in(s + 1)
+
+        in_copy(s, 0).wait()
+        in_copy(s, 1).wait()
+
+        @pl.when(s >= 2)
+        def _():
+            wait_out(s - 2)
+
+        g = ld_ref[s]
+        h = gm_ref[g]
+        cg = c_ref[g]
+        ch = c_ref[h]
+        w_g = win[pl.ds(base, 8), :].astype(jnp.int32)
+        w_h = win[pl.ds(base + 8, 8), :].astype(jnp.int32)
+        if apply_diag:
+            mv_b = mv_ref[:]
+            w_g = jnp.where(col == 8 * g + r8, mv_b, w_g)
+            w_h = jnp.where(col == 8 * h + r8, mv_b, w_h)
+        d_g = jnp.maximum(pltpu.roll(w_h, cg, 0) - w_g, 0) * vmask(g)
+        d_h = jnp.maximum(pltpu.roll(w_g, ch, 0) - w_h, 0) * vmask(h)
+        tout[pl.ds(base, 8), :] = jnp.sum(
+            d_g.astype(jnp.float32), axis=1, keepdims=True
+        )
+        tout[pl.ds(base + 8, 8), :] = jnp.sum(
+            d_h.astype(jnp.float32), axis=1, keepdims=True
+        )
+        start_out(s)
+        return 0
+
+    start_in(0)
+    lax.fori_loop(0, count, body, 0)
+
+    @pl.when(count >= 2)
+    def _():
+        wait_out(count - 2)
+
+    wait_out(count - 1)
 
 
 VMEM_BUDGET = 12 * 1024 * 1024  # ~16 MB/core, minus headroom for Mosaic
@@ -688,26 +842,37 @@ def fused_pull_m8(
     return (w_new, hb_new) if track_hb else w_new
 
 
-def pairs_supported(n: int, itemsize: int, track_hb: bool = True) -> bool:
+def pairs_supported(
+    n: int, itemsize: int, track_hb: bool = True, n_local: int | None = None
+) -> bool:
     """Whether the pair-fused kernel can run this shape. Same matching
-    domain as the m8 kernel (n % 128 == 0); the VMEM residency differs —
-    no in-spec streaming, so the budget covers the four (or two, lean)
-    (32, n) double-buffered tiles, the two (8, n) uint32 dither bases,
-    and the sublane-padded mv/hbv broadcast rows."""
-    tiles = (4 if track_hb else 2) * 32 * n * itemsize
-    bases = 2 * 8 * n * 4
-    vecs = (2 if track_hb else 1) * 8 * n * 4
-    return n % 128 == 0 and tiles + bases + vecs <= VMEM_BUDGET
+    domain as the m8 kernel (n % 128 == 0 rows, lane-aligned LOCAL
+    column count); the VMEM residency differs — no in-spec streaming,
+    so the budget covers the four (or two, lean) (32, width)
+    double-buffered tiles, the two (8, width) uint32 dither bases, and
+    the sublane-padded mv/hbv broadcast rows (the sharded form adds
+    only the tiny (32, 1) totals scratch)."""
+    width = n if n_local is None else n_local
+    tiles = (4 if track_hb else 2) * 32 * width * itemsize
+    bases = 2 * 8 * width * 4
+    vecs = (2 if track_hb else 1) * 8 * width * 4
+    return (
+        n % 128 == 0
+        and width % 128 == 0
+        and tiles + bases + vecs <= VMEM_BUDGET
+    )
 
 
 def pairs_supported_for(n: int, w: jax.Array, hb: jax.Array | None) -> bool:
-    """pairs_supported with the itemsize derived from the operands —
-    the one eligibility rule shared by the sim_step dispatch and the
-    fused_pull_pairs wrapper."""
+    """pairs_supported with itemsize and local width derived from the
+    operands — the one eligibility rule shared by the sim_step dispatch
+    and the fused_pull_pairs wrapper."""
     itemsize = w.dtype.itemsize
     if hb is not None:
         itemsize = max(itemsize, hb.dtype.itemsize)
-    return pairs_supported(n, itemsize, track_hb=hb is not None)
+    return pairs_supported(
+        n, itemsize, track_hb=hb is not None, n_local=w.shape[1]
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("budget", "interpret"))
@@ -723,15 +888,20 @@ def fused_pull_pairs(
     interpret: bool = False,
     mv: jax.Array | None = None,
     hbv: jax.Array | None = None,
+    owner_offset: jax.Array | int = 0,
+    totals: jax.Array | None = None,
 ):
     """One fused grouped-matching sub-exchange, pair-at-a-time: 4 bytes
     of HBM traffic per pair per matrix instead of the single-pass
     kernel's 6 (each row read once and written once — the involution
-    means visiting pair (g, gm[g]) covers both directions). Same
-    signature contract as fused_pull_m8 minus the sharding arguments:
-    this variant requires the full rows (unsharded, or a one-shard
-    mesh). Bit-identical to fused_pull_m8 and to the XLA matching path
-    (asserted in tests/test_pallas_pairs.py).
+    means visiting pair (g, gm[g]) covers both directions). Bit-identical
+    to fused_pull_m8 and to the XLA matching path (asserted in
+    tests/test_pallas_pairs.py).
+
+    Column sharding: ``w`` may be an (N, n_local) block. Pass this
+    shard's ``owner_offset`` and ``totals`` — the rows' GLOBAL deficit
+    totals from fused_pull_pairs_totals, psum'd across shards — exactly
+    the fused_pull_m8 two-pass contract.
 
     Reference anchor: the same server.py:378-495 hot loop; the pairing
     insight is that the reference's Syn/SynAck/Ack already computes both
@@ -739,6 +909,7 @@ def fused_pull_pairs(
     semantically exact."""
     track_hb = hb is not None
     apply_diag = mv is not None
+    use_totals = totals is not None
     if apply_diag and track_hb and hbv is None:
         raise ValueError("hbv required when mv is given and hb is tracked")
     if hbv is not None and not track_hb:
@@ -746,32 +917,25 @@ def fused_pull_pairs(
     if hbv is not None and mv is None:
         raise ValueError("hbv given without mv: the diagonal refresh is all-or-none")
     n, n_cols = w.shape
-    if n != n_cols:
-        raise ValueError("pair-fused kernel needs the full (n, n) matrix")
     if not pairs_supported_for(n, w, hb):
         raise ValueError(f"pair-fused kernel cannot run shape {w.shape}")
-    n_groups = n // 8
+    leaders, count, vbits = _pairs_slots(n, gm, valid)
     gm = gm.astype(jnp.int32)
-    gid = jnp.arange(n_groups, dtype=jnp.int32)
-    is_leader = gid <= gm
-    count = jnp.sum(is_leader.astype(jnp.int32))
-    (leaders,) = jnp.nonzero(is_leader, size=n_groups, fill_value=0)
-    # One alive-pair bit per row, packed per group (bit r = row 8g+r).
-    vbits = jnp.sum(
-        valid.astype(jnp.int32).reshape(n_groups, 8)
-        * (1 << jnp.arange(8, dtype=jnp.int32))[None, :],
-        axis=1,
-    )
     meta = jnp.stack(
         [
             salt.astype(jnp.int32),
             run_salt.astype(jnp.int32),
             jnp.asarray(budget, jnp.int32),
             count,
+            jnp.asarray(owner_offset, jnp.int32),
         ]
     )
     if not track_hb:
         hb = jnp.zeros((8, 128), w.dtype)
+    if use_totals:
+        totals = totals.astype(jnp.float32).reshape(n, 1)
+    else:
+        totals = jnp.zeros((8, 128), jnp.float32)
     if apply_diag:
         mv = mv.astype(jnp.int32)[None, :]
         hbv = (
@@ -779,7 +943,7 @@ def fused_pull_pairs(
             if track_hb
             else jnp.zeros((1, 128), jnp.int32)
         )
-        vec_spec = pl.BlockSpec((1, n), lambda *_: (0, 0))
+        vec_spec = pl.BlockSpec((1, n_cols), lambda *_: (0, 0))
         hbv_spec = vec_spec if track_hb else pl.BlockSpec(
             (1, 128), lambda *_: (0, 0)
         )
@@ -787,7 +951,7 @@ def fused_pull_pairs(
         mv = jnp.zeros((1, 128), jnp.int32)
         hbv = jnp.zeros((1, 128), jnp.int32)
         vec_spec = hbv_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
-    hb_scr = (32, n) if track_hb else (8, 128)
+    hb_scr = (32, n_cols) if track_hb else (8, 128)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(1,),
@@ -796,22 +960,28 @@ def fused_pull_pairs(
             hbv_spec,  # heartbeat row (dummy tile when diag off / lean)
             pl.BlockSpec(memory_space=pl.ANY),  # w (HBM operand)
             pl.BlockSpec(memory_space=pl.ANY),  # hb
+            pl.BlockSpec(memory_space=pl.ANY),  # totals (dummy if unused)
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # w out
             pl.BlockSpec(memory_space=pl.ANY),  # hb out
         ],
         scratch_shapes=[
-            pltpu.VMEM((32, n), w.dtype),  # win
-            pltpu.VMEM((32, n), w.dtype),  # wo
+            pltpu.VMEM((32, n_cols), w.dtype),  # win
+            pltpu.VMEM((32, n_cols), w.dtype),  # wo
             pltpu.VMEM(hb_scr, hb.dtype),  # hbin
             pltpu.VMEM(hb_scr, hb.dtype),  # hbo
-            pltpu.SemaphoreType.DMA((2, 2, 2)),  # in [buf, side, mat]
-            pltpu.SemaphoreType.DMA((2, 2, 2)),  # out
+            pltpu.VMEM((32, 1), jnp.float32),  # tscr
+            pltpu.SemaphoreType.DMA((2, 2, 3)),  # in [buf, side, w/hb/tot]
+            pltpu.SemaphoreType.DMA((2, 2, 2)),  # out [buf, side, w/hb]
         ],
     )
     kernel = functools.partial(
-        _pairs_kernel, n=n, track_hb=track_hb, apply_diag=apply_diag
+        _pairs_kernel,
+        n=n_cols,
+        track_hb=track_hb,
+        apply_diag=apply_diag,
+        use_totals=use_totals,
     )
     w_new, hb_new = pl.pallas_call(
         kernel,
@@ -822,7 +992,7 @@ def fused_pull_pairs(
         ],
         interpret=interpret,
     )(
-        leaders.astype(jnp.int32),
+        leaders,
         gm,
         c.astype(jnp.int32),
         vbits,
@@ -831,8 +1001,90 @@ def fused_pull_pairs(
         hbv,
         w,
         hb,
+        totals,
     )
     return (w_new, hb_new) if track_hb else w_new
+
+
+def _pairs_slots(n: int, gm: jax.Array, valid: jax.Array):
+    """Slot table for the pair-fused kernels: leader groups (g <= gm[g],
+    padded to n/8 with 0 past ``count`` — never executed), the slot
+    count, and the per-group packed alive-pair bits."""
+    n_groups = n // 8
+    gm = gm.astype(jnp.int32)
+    gid = jnp.arange(n_groups, dtype=jnp.int32)
+    is_leader = gid <= gm
+    count = jnp.sum(is_leader.astype(jnp.int32))
+    (leaders,) = jnp.nonzero(is_leader, size=n_groups, fill_value=0)
+    vbits = jnp.sum(
+        valid.astype(jnp.int32).reshape(n_groups, 8)
+        * (1 << jnp.arange(8, dtype=jnp.int32))[None, :],
+        axis=1,
+    )
+    return leaders.astype(jnp.int32), count, vbits
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_pull_pairs_totals(
+    w: jax.Array,
+    gm: jax.Array,
+    c: jax.Array,
+    valid: jax.Array,
+    interpret: bool = False,
+    mv: jax.Array | None = None,
+    owner_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Pass A of the sharded pair-fused pull: (N,) f32 LOCAL deficit row
+    totals for this shard's (N, n_local) block, every row read ONCE
+    (fused_pull_totals_m8 reads each row twice). The caller psums the
+    result across shards and passes it to fused_pull_pairs as
+    ``totals``; f32 sums of integer deficits are exact below 2^24, so
+    the two-pass result is bit-identical to the single-pass kernel's."""
+    apply_diag = mv is not None
+    n, n_cols = w.shape
+    if not pairs_supported_for(n, w, None):
+        raise ValueError(f"pair-fused totals cannot run shape {w.shape}")
+    leaders, count, vbits = _pairs_slots(n, gm, valid)
+    meta = jnp.stack([count, jnp.asarray(owner_offset, jnp.int32)])
+    if apply_diag:
+        mv = mv.astype(jnp.int32)[None, :]
+        vec_spec = pl.BlockSpec((1, n_cols), lambda *_: (0, 0))
+    else:
+        mv = jnp.zeros((1, 128), jnp.int32)
+        vec_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(1,),
+        in_specs=[
+            vec_spec,  # mv row (dummy tile when diag off)
+            pl.BlockSpec(memory_space=pl.ANY),  # w (HBM operand)
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # totals out
+        scratch_shapes=[
+            pltpu.VMEM((32, n_cols), w.dtype),  # win
+            pltpu.VMEM((32, 1), jnp.float32),  # tout
+            pltpu.SemaphoreType.DMA((2, 2)),  # in [buf, side]
+            pltpu.SemaphoreType.DMA((2, 2)),  # out
+        ],
+    )
+    kernel = functools.partial(
+        _pairs_totals_kernel, n=n_cols, apply_diag=apply_diag
+    )
+    (tot,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+        interpret=interpret,
+    )(
+        leaders,
+        gm.astype(jnp.int32),
+        c.astype(jnp.int32),
+        vbits,
+        meta,
+        mv,
+        w,
+    )
+    return tot[:, 0]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

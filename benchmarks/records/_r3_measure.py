@@ -229,10 +229,17 @@ def phase_lean_scaling() -> dict:
         wall = time.perf_counter() - t0
         rate = _rate(Simulator(_lean(n), seed=0, chunk=16),
                      rounds=64 if n >= 32_768 else 128)
+        from aiocluster_tpu.ops.gossip import pallas_variant_engaged
+
         points.append(
             {"n": n, "rounds_to_convergence": rounds,
              "convergence_wall_s": round(wall, 2),
-             "rounds_per_sec": rate}
+             "rounds_per_sec": rate,
+             # Recorded AT measurement time: a later window may resolve
+             # a different variant (canary pin lifted/applied) and the
+             # projection must charge the pass count that actually
+             # produced this rate.
+             "kernel_variant": pallas_variant_engaged(_lean(n))}
         )
         log(f"lean n={n}: converged {rounds} rounds, {rate} rounds/s")
         out["lean_scaling"] = {"points": points}  # partial
@@ -295,8 +302,13 @@ def _northstar_projection(points: list[dict]) -> dict:
     times a per-round time derived from the measured achieved HBM
     throughput at the largest single-chip point — each v5e-8 shard
     handles 1/8 of the per-round traffic over its own HBM; the psum is
-    (N,) f32, noise by comparison."""
+    (N,) f32, noise by comparison. Pass counts come from the variant
+    decision function: the pair-fused kernels move 2 passes per matrix
+    per sub-exchange single-device and 3 sharded (totals + apply
+    read/write); the single-pass m8 family moves 3 and 5."""
     import numpy as np
+
+    from aiocluster_tpu.ops.gossip import pallas_variant_engaged
 
     pts = [p for p in points if p["rounds_to_convergence"] is not None]
     if len(pts) < 2:
@@ -306,18 +318,22 @@ def _northstar_projection(points: list[dict]) -> dict:
     b, a = np.polyfit(ns, rs, 1)  # rounds ~ b*n + a
     n_star = 100_352  # config 5's 128x8-aligned 100k population
     rounds_100k = float(b * n_star + a)
-    # Measured achieved throughput at the largest single-chip point:
-    # lean matching traffic there = fanout x 3 passes x N^2 x 2 B per
-    # round (single-pass kernel).
+    # Measured achieved throughput at the largest single-chip point,
+    # charged at the pass count of the variant that PRODUCED the rate
+    # (recorded in the point; pre-variant checkpoints ran m8).
     big = max(pts, key=lambda p: p["n"])
-    bytes_per_round = 3 * 3 * big["n"] ** 2 * 2
+    big_variant = big.get("kernel_variant", "m8")
+    big_passes = 2 if big_variant == "pairs" else 3
+    bytes_per_round = 3 * big_passes * big["n"] ** 2 * 2
     achieved_gbps = bytes_per_round * big["rounds_per_sec"] / 1e9
-    # The MULTI-shard config runs the two-pass sharded kernel: per
-    # sub-exchange per matrix, pass A reads the block + peer rows and
-    # pass B reads both again and writes — 5 passes, not 3. Charge the
-    # projection for that honestly; the (N,) f32 psum between passes is
-    # noise next to the N^2/8 block traffic.
-    shard_bytes_100k = 3 * 5 * n_star**2 * 2 / 8
+    # The MULTI-shard config runs the two-pass sharded form; charge the
+    # projection its pass count honestly. The (N,) f32 psum between
+    # passes is noise next to the N^2/8 block traffic.
+    star_variant = pallas_variant_engaged(
+        _lean(n_star), "owners", n_star // 8
+    )
+    star_passes = 3 if star_variant == "pairs" else 5
+    shard_bytes_100k = 3 * star_passes * n_star**2 * 2 / 8
     s_per_round_8shard = shard_bytes_100k / (achieved_gbps * 1e9)
     total_s = rounds_100k * s_per_round_8shard
     return {
@@ -326,6 +342,8 @@ def _northstar_projection(points: list[dict]) -> dict:
             "fit_intercept": round(a, 2),
             "n_star": n_star,
             "predicted_rounds_to_convergence": round(rounds_100k, 1),
+            "kernel_variant@largest_single_chip": big_variant,
+            "kernel_variant@n_star_sharded": star_variant,
             "measured_achieved_gb_per_sec@largest": round(achieved_gbps, 1),
             "projected_seconds_per_round_v5e8": round(s_per_round_8shard, 4),
             "projected_total_seconds_v5e8": round(total_s, 1),
@@ -333,10 +351,10 @@ def _northstar_projection(points: list[dict]) -> dict:
             "meets_target": bool(total_s < 60.0),
             "arithmetic": (
                 f"rounds({n_star}) = {b:.3e}*N + {a:.1f} = "
-                f"{rounds_100k:.0f}; two-pass sharded kernel: "
-                f"bytes/round/shard = fanout(3) x 5 passes x N^2 x 2B "
-                f"/ 8 = {shard_bytes_100k / 1e9:.1f} GB at the "
-                f"measured {achieved_gbps:.0f} GB/s -> "
+                f"{rounds_100k:.0f}; {star_variant} two-pass sharded "
+                f"kernel: bytes/round/shard = fanout(3) x {star_passes} "
+                f"passes x N^2 x 2B / 8 = {shard_bytes_100k / 1e9:.1f} "
+                f"GB at the measured {achieved_gbps:.0f} GB/s -> "
                 f"{s_per_round_8shard * 1e3:.0f} ms/round; total "
                 f"{total_s:.0f} s"
             ),

@@ -132,6 +132,50 @@ def test_pairs_supported_domain():
     assert not pairs_supported(65_536, 4, track_hb=True)  # VMEM
 
 
+def test_pairs_totals_matches_m8_totals():
+    """Pass A of the sharded pair-fused pull on a column block must give
+    the exact totals fused_pull_totals_m8 gives (which are themselves
+    pinned to the XLA local sum in tests/test_pallas_sharded.py) —
+    including with the owner-diagonal refresh folded in."""
+    from aiocluster_tpu.ops.pallas_pull import (
+        fused_pull_pairs_totals,
+        fused_pull_totals_m8,
+    )
+
+    n = 256
+    w, _hb, gm, c, valid, _salt, _run = _case(n, jnp.int16, 17)
+    mv = (jnp.arange(n, dtype=jnp.int32) % 37) + 50
+    for off in (0, 128):
+        blockw = w[:, off : off + 128]
+        for kw in ({}, {"mv": mv[off : off + 128]}):
+            t_m8 = fused_pull_totals_m8(
+                blockw, gm, c, valid, interpret=True, owner_offset=off, **kw
+            )
+            t_pr = fused_pull_pairs_totals(
+                blockw, gm, c, valid, interpret=True, owner_offset=off, **kw
+            )
+            np.testing.assert_array_equal(np.asarray(t_pr), np.asarray(t_m8))
+
+
+def test_pairs_two_pass_matches_single_pass():
+    """Feeding the pairs apply kernel its own globally-summed totals
+    must reproduce the one-pass pairs result exactly (offset 0, one
+    shard covering all columns) — the sharded-path contract."""
+    from aiocluster_tpu.ops.pallas_pull import fused_pull_pairs_totals
+
+    n = 256
+    w, _hb, gm, c, valid, salt, run_salt = _case(n, jnp.int16, 19)
+    tot = fused_pull_pairs_totals(w, gm, c, valid, interpret=True)
+    two = fused_pull_pairs(
+        w, None, gm, c, valid, salt, run_salt, budget=48, interpret=True,
+        totals=tot,
+    )
+    one = fused_pull_pairs(
+        w, None, gm, c, valid, salt, run_salt, budget=48, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(two), np.asarray(one))
+
+
 def test_sim_step_variant_trajectories_identical():
     """Full sim_step trajectories: pallas_variant='pairs' must reproduce
     'm8' (and therefore the XLA path, which m8 is tested against) bit

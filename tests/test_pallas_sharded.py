@@ -7,6 +7,7 @@ the compiled path is exercised on real TPU by bench.py.
 """
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 from jax import random
@@ -93,20 +94,23 @@ def test_apply_pass_with_totals_matches_single_pass():
     np.testing.assert_array_equal(np.asarray(two_pass), np.asarray(one_pass))
 
 
-def _lean_cfg(use_pallas):
+def _lean_cfg(use_pallas, variant="auto"):
     return SimConfig(
         n_nodes=N, keys_per_node=8, fanout=3, budget=64,
         version_dtype="int16",
         track_failure_detector=False, track_heartbeats=False,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, pallas_variant=variant,
     )
 
 
-def test_sharded_lean_kernel_bit_identical_to_single_device_xla():
+@pytest.mark.parametrize("variant", ["m8", "pairs"])
+def test_sharded_lean_kernel_bit_identical_to_single_device_xla(variant):
     """The north-star shape (lean, column-sharded 8 ways) with the
     kernel forced on must reproduce the single-device XLA trajectory
-    exactly — mirrors tests/test_sim_sharded.py's contract."""
-    cfg_p = _lean_cfg(True)
+    exactly — mirrors tests/test_sim_sharded.py's contract. Both
+    two-pass kernel families (single-pass m8 and pair-fused) are pinned
+    here; 'auto' resolves to pairs on this shape."""
+    cfg_p = _lean_cfg(True, variant)
     cfg_x = _lean_cfg(False)
     mesh = make_mesh()
     step = sharded_step_fn(cfg_p, mesh)
