@@ -466,7 +466,11 @@ def pallas_fd_engaged(cfg: SimConfig, n_local: int | None = None) -> bool:
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "axis_name"), donate_argnums=(0,))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "axis_name", "return_converged"),
+    donate_argnums=(0,),
+)
 def sim_step(
     state: SimState,
     key: jax.Array,
@@ -474,8 +478,15 @@ def sim_step(
     axis_name: str | None = None,
     adjacency: jax.Array | None = None,
     degrees: jax.Array | None = None,
-) -> SimState:
-    """Advance the whole cluster by one gossip round."""
+    return_converged: bool = False,
+) -> SimState | tuple[SimState, jax.Array]:
+    """Advance the whole cluster by one gossip round.
+
+    ``return_converged=True`` also returns the all-converged flag for
+    the POST-round state (exactly ``all_converged_flag(new_state)``).
+    On the pair-fused kernel path the flag rides the round's last
+    sub-exchange for free — convergence-tracked runs pay no extra pass
+    over w; other paths compute the separate (XLA-fused) check."""
     n = cfg.n_nodes
     n_local = state.w.shape[1]
     owners = _local_owner_ids(n_local, axis_name)
@@ -537,6 +548,7 @@ def sim_step(
     # j's state and stop advertising j's heartbeat in their digests.
     lifecycle = _lifecycle_enabled(cfg)
     sched = scheduled_for_deletion_mask(state, cfg, tick)
+    kernel_flag = None  # set when the pairs kernel carries the check
 
     def peer_adv(w, peer, salt):
         """The budgeted watermark advance of each row toward its peer row
@@ -641,6 +653,16 @@ def sim_step(
                     if use_pairs
                     else pallas_pull.fused_pull_m8
                 )
+                # The round's LAST pairs call can also evaluate the
+                # convergence flag on its output tiles (w is final
+                # after the sub-exchanges on this path — no lifecycle),
+                # so tracked runs pay no separate full read of w.
+                carry_check = (
+                    use_pairs and return_converged and c == cfg.fanout - 1
+                )
+                kw = {}
+                if carry_check:
+                    kw["check"] = (mv_vec, alive, alive[owners])
                 pulled = pull_fn(
                     w, hb if track_hb else None, gm8, c8,
                     valid_pair, sub_salt(c, 0), run_salt,
@@ -649,7 +671,10 @@ def sim_step(
                     hbv=hbv_vec if first and track_hb else None,
                     owner_offset=owners[0],
                     totals=tot,
+                    **kw,
                 )
+                if carry_check:
+                    pulled, kernel_flag = pulled
                 w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
                 adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0))
@@ -820,7 +845,7 @@ def sim_step(
             state.dead_since,
         )
 
-    return SimState(
+    new_state = SimState(
         tick=tick,
         max_version=max_version,
         heartbeat=heartbeat,
@@ -833,6 +858,18 @@ def sim_step(
         live_view=live,
         dead_since=dead_since,
     )
+    if not return_converged:
+        return new_state
+    if kernel_flag is not None:
+        # The pairs kernel evaluated the check on its output tiles
+        # (nothing after the sub-exchanges touches w/alive/max_version
+        # on that path); reduce across shards exactly like
+        # all_converged_flag.
+        f = kernel_flag
+        if axis_name is not None:
+            f = lax.pmin(f, axis_name)
+        return new_state, f > 0
+    return new_state, all_converged_flag(new_state, axis_name)
 
 
 def all_converged_flag(

@@ -291,10 +291,12 @@ def _pairs_kernel(
     gm_ref,  # (n/8,) partner group per group (involution)
     c_ref,  # (n/8,) within-pair row rotation
     vb_ref,  # (n/8,) alive-pair mask, one bit per row, packed per group
+    ab_ref,  # (n/8,) alive mask, one bit per row (convergence; dummy if off)
     meta_ref,  # [salt, run_salt, budget, count, owner_offset]
     # VMEM inputs (whole-array blocks, loaded once)
     mv_ref,  # (1, n) int32 owner max_version (diag refresh; dummy if off)
     hbv_ref,  # (1, n) int32 owner heartbeat (diag refresh; dummy if off)
+    need_ref,  # (1, n) int32 convergence target, 0 at dead owners
     # HBM operands
     w_hbm,
     hb_hbm,
@@ -302,12 +304,14 @@ def _pairs_kernel(
     # HBM outputs
     wout_hbm,
     hbout_hbm,
+    flag_out,  # (1, 1) int32 all-converged flag (written 1 if check off)
     # scratch
     win,  # (32, n): [buf 0/1] x [side 0/1] x 8 rows
     wo,
     hbin,
     hbo,
     tscr,  # (32, 1) f32 totals rows (dummy if unused)
+    fscr,  # (1, 1) int32 running converged flag
     insems,  # (2, 2, 3): [buf, side, matrix(w/hb/totals)]
     outsems,  # (2, 2, 2): [buf, side, matrix(w/hb)]
     *,
@@ -315,6 +319,7 @@ def _pairs_kernel(
     track_hb: bool,
     apply_diag: bool,
     use_totals: bool,
+    check: bool,
 ):
     """Both sides of every matched group pair in ONE visit (the
     pair-fused pull). The matching is an involution, so the single-pass
@@ -339,7 +344,13 @@ def _pairs_kernel(
     the diagonal compares off GLOBAL column ids, and ``use_totals``
     feeds the rows' global deficit totals (psum'd between the kernel
     passes) in place of the in-kernel local sum — together they make
-    the sharded bits exactly the single-device bits."""
+    the sharded bits exactly the single-device bits.
+
+    ``check``: the round's LAST sub-exchange can carry the convergence
+    test (w' >= max_version[owner], dead rows and dead owners excused)
+    on the output tiles it already holds, so convergence-tracked runs
+    pay ZERO extra HBM traffic for the check (the separate
+    all_converged_flag pass reads the whole matrix again)."""
     salt = meta_ref[0]
     run_salt = meta_ref[1]
     budget = meta_ref[2].astype(jnp.float32)
@@ -463,6 +474,23 @@ def _pairs_kernel(
         )
         wo[pl.ds(base, 8), :] = (w_g + adv_g).astype(wo.dtype)
         wo[pl.ds(base + 8, 8), :] = (w_h + adv_h).astype(wo.dtype)
+        if check:
+            # Convergence on the freshly-computed output tiles (int32,
+            # pre-cast — same values): a row passes where it has caught
+            # up to the owner's target or the row is dead; dead OWNERS
+            # are excused by the wrapper zeroing their target
+            # (watermarks are non-negative, so w >= 0 always holds).
+            # AND-accumulated across slots; side 1 skipped for
+            # self-matched pairs (those rows were side 0).
+            need = need_ref[:]
+            ag = (ab_ref[g] >> sub8) & 1
+            ah = (ab_ref[h] >> sub8) & 1
+            ok_g = jnp.all((w_g + adv_g >= need) | (ag == 0))
+            ok_h = jnp.all((w_h + adv_h >= need) | (ah == 0))
+            ok_h = jnp.where(g == h, True, ok_h)
+            fscr[0, 0] = fscr[0, 0] * ok_g.astype(jnp.int32) * ok_h.astype(
+                jnp.int32
+            )
         if track_hb:
             hb_g = hbin[pl.ds(base, 8), :].astype(jnp.int32)
             hb_h = hbin[pl.ds(base + 8, 8), :].astype(jnp.int32)
@@ -479,6 +507,7 @@ def _pairs_kernel(
         start_out(s)
         return 0
 
+    fscr[0, 0] = jnp.int32(1)
     start_in(0)
     lax.fori_loop(0, count, body, 0)
     # Drain: the last two slots' out DMAs are still in flight.
@@ -487,6 +516,7 @@ def _pairs_kernel(
         wait_out(count - 2)
 
     wait_out(count - 1)
+    flag_out[0, 0] = fscr[0, 0]
     if not track_hb:
         # Lean mode: the dummy hb output still must be defined bytes.
         cp = pltpu.make_async_copy(hb_hbm, hbout_hbm, outsems.at[0, 0, 1])
@@ -855,7 +885,12 @@ def pairs_supported(
     width = n if n_local is None else n_local
     tiles = (4 if track_hb else 2) * 32 * width * itemsize
     bases = 2 * 8 * width * 4
-    vecs = (2 if track_hb else 1) * 8 * width * 4
+    # mv (+hbv) diag rows, plus the convergence-target row a tracked
+    # run's last sub-exchange carries (worst case fanout=1: diag AND
+    # check ride the same call) — all 8-sublane-padded int32, charged
+    # unconditionally so the gate never admits a shape whose tracked
+    # instance exceeds VMEM.
+    vecs = ((2 if track_hb else 1) + 1) * 8 * width * 4
     return (
         n % 128 == 0
         and width % 128 == 0
@@ -890,6 +925,7 @@ def fused_pull_pairs(
     hbv: jax.Array | None = None,
     owner_offset: jax.Array | int = 0,
     totals: jax.Array | None = None,
+    check: tuple[jax.Array, jax.Array, jax.Array] | None = None,
 ):
     """One fused grouped-matching sub-exchange, pair-at-a-time: 4 bytes
     of HBM traffic per pair per matrix instead of the single-pass
@@ -903,6 +939,15 @@ def fused_pull_pairs(
     totals from fused_pull_pairs_totals, psum'd across shards — exactly
     the fused_pull_m8 two-pass contract.
 
+    ``check`` = (needed, alive, alive_owner) asks the round's last
+    sub-exchange to also evaluate the convergence flag on its output
+    tiles — ``needed`` is this shard's (n_local,) target
+    (max_version[owners]), ``alive`` the (N,) row liveness,
+    ``alive_owner`` the (n_local,) owner liveness. The flag (0/1 int32
+    scalar, local to this shard) is appended to the return value;
+    ops/gossip.py::all_converged_flag is the semantics being reproduced
+    — same excusals, zero extra HBM traffic.
+
     Reference anchor: the same server.py:378-495 hot loop; the pairing
     insight is that the reference's Syn/SynAck/Ack already computes both
     directions from the pre-handshake digests, so one visit per pair is
@@ -910,6 +955,7 @@ def fused_pull_pairs(
     track_hb = hb is not None
     apply_diag = mv is not None
     use_totals = totals is not None
+    do_check = check is not None
     if apply_diag and track_hb and hbv is None:
         raise ValueError("hbv required when mv is given and hb is tracked")
     if hbv is not None and not track_hb:
@@ -936,6 +982,20 @@ def fused_pull_pairs(
         totals = totals.astype(jnp.float32).reshape(n, 1)
     else:
         totals = jnp.zeros((8, 128), jnp.float32)
+    if do_check:
+        needed, alive, alive_owner = check
+        abits = _pack_row_bits(alive, n)
+        # Dead owners are excused by zeroing their target: watermarks
+        # are non-negative, so w >= 0 holds everywhere — one broadcast
+        # row instead of a separate alive-owner mask row.
+        need = jnp.where(
+            alive_owner, needed.astype(jnp.int32), 0
+        )[None, :]
+        need_spec = pl.BlockSpec((1, n_cols), lambda *_: (0, 0))
+    else:
+        abits = jnp.zeros((n // 8,), jnp.int32)
+        need = jnp.zeros((1, 128), jnp.int32)
+        need_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
     if apply_diag:
         mv = mv.astype(jnp.int32)[None, :]
         hbv = (
@@ -953,11 +1013,12 @@ def fused_pull_pairs(
         vec_spec = hbv_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
     hb_scr = (32, n_cols) if track_hb else (8, 128)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
+        num_scalar_prefetch=6,
         grid=(1,),
         in_specs=[
             vec_spec,  # mv row (dummy tile when diag off)
             hbv_spec,  # heartbeat row (dummy tile when diag off / lean)
+            need_spec,  # convergence target row (dummy when check off)
             pl.BlockSpec(memory_space=pl.ANY),  # w (HBM operand)
             pl.BlockSpec(memory_space=pl.ANY),  # hb
             pl.BlockSpec(memory_space=pl.ANY),  # totals (dummy if unused)
@@ -965,6 +1026,7 @@ def fused_pull_pairs(
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # w out
             pl.BlockSpec(memory_space=pl.ANY),  # hb out
+            pl.BlockSpec((1, 1), lambda *_: (0, 0)),  # converged flag
         ],
         scratch_shapes=[
             pltpu.VMEM((32, n_cols), w.dtype),  # win
@@ -972,6 +1034,7 @@ def fused_pull_pairs(
             pltpu.VMEM(hb_scr, hb.dtype),  # hbin
             pltpu.VMEM(hb_scr, hb.dtype),  # hbo
             pltpu.VMEM((32, 1), jnp.float32),  # tscr
+            pltpu.VMEM((1, 1), jnp.int32),  # fscr
             pltpu.SemaphoreType.DMA((2, 2, 3)),  # in [buf, side, w/hb/tot]
             pltpu.SemaphoreType.DMA((2, 2, 2)),  # out [buf, side, w/hb]
         ],
@@ -982,13 +1045,15 @@ def fused_pull_pairs(
         track_hb=track_hb,
         apply_diag=apply_diag,
         use_totals=use_totals,
+        check=do_check,
     )
-    w_new, hb_new = pl.pallas_call(
+    w_new, hb_new, flag = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(w.shape, w.dtype),
             jax.ShapeDtypeStruct(hb.shape, hb.dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
         interpret=interpret,
     )(
@@ -996,14 +1061,29 @@ def fused_pull_pairs(
         gm,
         c.astype(jnp.int32),
         vbits,
+        abits,
         meta,
         mv,
         hbv,
+        need,
         w,
         hb,
         totals,
     )
-    return (w_new, hb_new) if track_hb else w_new
+    out = (w_new, hb_new) if track_hb else w_new
+    if do_check:
+        return out, flag[0, 0]
+    return out
+
+
+def _pack_row_bits(mask: jax.Array, n: int) -> jax.Array:
+    """(n,) boolean row mask -> (n/8,) int32, bit r = row 8g+r. The one
+    packing the kernels' (8, 1) shift-unpack decodes."""
+    return jnp.sum(
+        mask.astype(jnp.int32).reshape(n // 8, 8)
+        * (1 << jnp.arange(8, dtype=jnp.int32))[None, :],
+        axis=1,
+    )
 
 
 def _pairs_slots(n: int, gm: jax.Array, valid: jax.Array):
@@ -1016,12 +1096,7 @@ def _pairs_slots(n: int, gm: jax.Array, valid: jax.Array):
     is_leader = gid <= gm
     count = jnp.sum(is_leader.astype(jnp.int32))
     (leaders,) = jnp.nonzero(is_leader, size=n_groups, fill_value=0)
-    vbits = jnp.sum(
-        valid.astype(jnp.int32).reshape(n_groups, 8)
-        * (1 << jnp.arange(8, dtype=jnp.int32))[None, :],
-        axis=1,
-    )
-    return leaders.astype(jnp.int32), count, vbits
+    return leaders.astype(jnp.int32), count, _pack_row_bits(valid, n)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -21,7 +21,6 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..ops.gossip import (
-    all_converged_flag,
     convergence_metrics,
     pallas_fd_engaged,
     pallas_path_engaged,
@@ -145,10 +144,13 @@ def sharded_tracked_chunk_fn(
 
         def one(_, carry):
             st, first = carry
-            st = sim_step(
-                st, key, cfg, axis_name=AXIS, adjacency=adj, degrees=deg
+            # Pairs-kernel configs get the flag from the round's last
+            # sub-exchange (pmin'd inside sim_step); others run the
+            # same separate all_converged_flag check as before.
+            st, conv = sim_step(
+                st, key, cfg, axis_name=AXIS, adjacency=adj, degrees=deg,
+                return_converged=True,
             )
-            conv = all_converged_flag(st, AXIS)
             first = jnp.where((first == 0) & conv, st.tick, first)
             return st, first
 

@@ -16,7 +16,7 @@ from jax import lax, random
 from jax.sharding import Mesh
 
 from ..models.topology import Topology
-from ..ops.gossip import all_converged_flag, convergence_metrics, sim_step
+from ..ops.gossip import convergence_metrics, sim_step
 from ..parallel.mesh import (
     shard_state,
     sharded_chunk_fn,
@@ -48,8 +48,13 @@ def _chunk_tracked(state: SimState, key: jax.Array, cfg: SimConfig, m: int,
 
     def one(_, carry):
         s, first = carry
-        s = sim_step(s, key, cfg, adjacency=adjacency, degrees=degrees)
-        conv = all_converged_flag(s)
+        # On the pair-fused kernel path the flag rides the round's last
+        # sub-exchange (zero extra HBM traffic); elsewhere this is the
+        # same separate check as before.
+        s, conv = sim_step(
+            s, key, cfg, adjacency=adjacency, degrees=degrees,
+            return_converged=True,
+        )
         first = jnp.where((first == 0) & conv, s.tick, first)
         return s, first
 

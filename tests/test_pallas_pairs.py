@@ -176,6 +176,37 @@ def test_pairs_two_pass_matches_single_pass():
     np.testing.assert_array_equal(np.asarray(two), np.asarray(one))
 
 
+def test_converged_flag_rides_pairs_kernel():
+    """sim_step(return_converged=True): on the pairs path the flag comes
+    from the kernel's last sub-exchange; it must equal the XLA path's
+    separate all_converged_flag check every round, through convergence,
+    with churn-free and churned configs."""
+    from aiocluster_tpu.ops.gossip import all_converged_flag
+
+    for over in (
+        {},  # full fidelity, hb + FD
+        {"track_failure_detector": False, "track_heartbeats": False},
+        {"death_rate": 0.05, "revival_rate": 0.3},
+    ):
+        cfg_p = SimConfig(
+            n_nodes=128, keys_per_node=4, fanout=2, budget=4096,
+            writes_per_round=0, use_pallas=True, pallas_variant="pairs",
+            version_dtype="int16", **over,
+        )
+        cfg_x = dataclasses.replace(cfg_p, use_pallas=False)
+        key = random.key(4)
+        sp, sx = init_state(cfg_p), init_state(cfg_x)
+        saw_converged = False
+        for _ in range(6):
+            sp, fp = sim_step(sp, key, cfg_p, return_converged=True)
+            sx, fx = sim_step(sx, key, cfg_x, return_converged=True)
+            assert bool(fp) == bool(fx) == bool(all_converged_flag(sx))
+            np.testing.assert_array_equal(np.asarray(sp.w), np.asarray(sx.w))
+            saw_converged = saw_converged or bool(fp)
+        if not over.get("death_rate"):
+            assert saw_converged  # ample budget: flag must flip within 6
+
+
 def test_sim_step_variant_trajectories_identical():
     """Full sim_step trajectories: pallas_variant='pairs' must reproduce
     'm8' (and therefore the XLA path, which m8 is tested against) bit
