@@ -327,12 +327,18 @@ def scheduled_for_deletion_mask(
     return (ds32 > 0) & ((t - ds32) >= cfg.dead_grace_ticks // 2)
 
 
-def _pallas_wanted(cfg: SimConfig) -> bool:
+def _pallas_wanted(cfg: SimConfig, assume_accelerator: bool = False) -> bool:
     """Resolution of ``use_pallas`` shared by both kernel gates:
     True forces the kernels (interpret mode off-TPU — tests), "auto"
-    engages them on a real TPU backend only."""
+    engages them on a real TPU backend only. ``assume_accelerator``
+    resolves "auto" as if on TPU regardless of the current backend —
+    for capacity planning (sim/memory.py), which must give the same
+    answer on a CPU planning host as on the chip."""
+    # assume_accelerator first: planner calls must not force JAX backend
+    # initialization (on a planning host with a down tunnel, backend
+    # init can block for minutes).
     return cfg.use_pallas is True or (
-        cfg.use_pallas == "auto" and on_accelerator()
+        cfg.use_pallas == "auto" and (assume_accelerator or on_accelerator())
     )
 
 
@@ -346,6 +352,7 @@ def pallas_path_engaged(
     *,
     has_topology: bool = False,
     n_local: int | None = None,
+    assume_accelerator: bool = False,
 ) -> bool:
     """Single source of truth for whether sim_step routes matching
     sub-exchanges through the fused Pallas kernel for this config —
@@ -374,13 +381,10 @@ def pallas_path_engaged(
     True (sim_step itself never consults the gate on that path)."""
     from . import pallas_pull
 
-    itemsize = jnp.dtype(cfg.version_dtype).itemsize
-    if cfg.track_heartbeats:
-        itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
     if axis_name is not None and n_local is None:
         return False  # sharded callers must say how wide a shard is
-    return (
-        _pallas_wanted(cfg)
+    if not (
+        _pallas_wanted(cfg, assume_accelerator)
         and not has_topology  # adjacency runs force the choice path
         and cfg.pairing == "matching"
         # fanout >= 1 so the round's first kernel call exists to carry
@@ -390,12 +394,23 @@ def pallas_path_engaged(
         and cfg.n_nodes % 128 == 0
         and cfg.budget_policy == "proportional"
         and not _lifecycle_enabled(cfg)
-        and pallas_pull.supported(
-            cfg.n_nodes,
-            itemsize,
-            track_hb=cfg.track_heartbeats,
-            n_local=cfg.n_nodes if axis_name is None else n_local,
-        )
+    ):
+        return False
+    # The VMEM-fit term follows the variant that would actually serve
+    # the shape (evaluated only past the cheap gates, so an invalid
+    # variant override cannot raise from configs whose kernel path is
+    # off anyway): the pair-fused kernel's domain extends past the
+    # single-pass kernel's (one in-place tile per matrix instead of
+    # five streamed buffers), so a pairs-served width must not be
+    # rejected by the m8 block search.
+    if pallas_variant_engaged(cfg, axis_name, n_local) == "pairs":
+        return True  # pairs_supported held inside the variant decision
+    itemsize = jnp.dtype(cfg.version_dtype).itemsize
+    if cfg.track_heartbeats:
+        itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
+    return pallas_pull.supported(
+        cfg.n_nodes, itemsize, track_hb=cfg.track_heartbeats,
+        n_local=cfg.n_nodes if axis_name is None else n_local,
     )
 
 

@@ -306,10 +306,8 @@ def _pairs_kernel(
     hbout_hbm,
     flag_out,  # (1, 1) int32 all-converged flag (written 1 if check off)
     # scratch
-    win,  # (32, n): [buf 0/1] x [side 0/1] x 8 rows
-    wo,
+    win,  # (32, n): [buf 0/1] x [side 0/1] x 8 rows; outputs OVERWRITE it
     hbin,
-    hbo,
     tscr,  # (32, 1) f32 totals rows (dummy if unused)
     fscr,  # (1, 1) int32 running converged flag
     insems,  # (2, 2, 3): [buf, side, matrix(w/hb/totals)]
@@ -337,6 +335,12 @@ def _pairs_kernel(
     Slots [0, count) hold the leader groups (g <= gm[g]); self-matched
     groups fetch their own tile into the peer slot (one redundant 8-row
     read for at most one group per matching) and skip the side-1 write.
+    The compute OVERWRITES the input tiles in VMEM and the out DMA
+    streams from the same buffer — no separate out scratch, which
+    halves the VMEM tiles and doubles the width this kernel serves; the
+    price is that a slot's out DMA must land before the buffer's next
+    occupant streams in (wait_out(s-1) precedes start_in(s+1) — a
+    sub-microsecond serialization against a multi-microsecond compute).
 
     Column sharding: w may be an (N, n_local) block — rows stay global
     (the pairing is over rows, and peer rows are shard-local), columns
@@ -368,12 +372,12 @@ def _pairs_kernel(
     def vmask(g):
         return (vb_ref[g] >> sub8) & 1
 
-    mats = [(w_hbm, wout_hbm, win, wo, 0)]
+    mats = [(w_hbm, wout_hbm, win, 0)]
     if track_hb:
-        mats.append((hb_hbm, hbout_hbm, hbin, hbo, 1))
+        mats.append((hb_hbm, hbout_hbm, hbin, 1))
 
     def in_copy(slot, side, mat):
-        src_hbm, _, scr, _, m = mats[mat]
+        src_hbm, _, scr, m = mats[mat]
         g = ld_ref[slot]
         src = (g if side == 0 else gm_ref[g]) * 8
         row = (slot % 2) * 16 + side * 8
@@ -384,7 +388,7 @@ def _pairs_kernel(
         )
 
     def out_copy(slot, side, mat):
-        _, dst_hbm, _, scr, m = mats[mat]
+        _, dst_hbm, scr, m = mats[mat]
         g = ld_ref[slot]
         dst = (g if side == 0 else gm_ref[g]) * 8
         row = (slot % 2) * 16 + side * 8
@@ -441,17 +445,17 @@ def _pairs_kernel(
     def body(s, _):
         base = (s % 2) * 16
 
+        # Slot s+1 streams into the buffer slot s-1 computed AND wrote
+        # from: its out DMA must land first (in-place VMEM reuse).
+        @pl.when(s >= 1)
+        def _():
+            wait_out(s - 1)
+
         @pl.when(s + 1 < count)
         def _():
             start_in(s + 1)
 
         wait_in(s)
-        # The out DMA that streamed this buffer's previous occupant
-        # (slot s-2) must land before the computes below overwrite it.
-        @pl.when(s >= 2)
-        def _():
-            wait_out(s - 2)
-
         g = ld_ref[s]
         h = gm_ref[g]
         cg = c_ref[g]
@@ -472,8 +476,9 @@ def _pairs_kernel(
         adv_h = _advance(
             w_h, pltpu.roll(w_g, ch, 0), vh, budget, r_k1, js, 8 * h, th
         )
-        wo[pl.ds(base, 8), :] = (w_g + adv_g).astype(wo.dtype)
-        wo[pl.ds(base + 8, 8), :] = (w_h + adv_h).astype(wo.dtype)
+        # w_g/w_h are loaded VALUES; overwriting their tiles is safe.
+        win[pl.ds(base, 8), :] = (w_g + adv_g).astype(win.dtype)
+        win[pl.ds(base + 8, 8), :] = (w_h + adv_h).astype(win.dtype)
         if check:
             # Convergence on the freshly-computed output tiles (int32,
             # pre-cast — same values): a row passes where it has caught
@@ -498,23 +503,20 @@ def _pairs_kernel(
                 hbv_b = hbv_ref[:]
                 hb_g = jnp.where(col == 8 * g + r8, hbv_b, hb_g)
                 hb_h = jnp.where(col == 8 * h + r8, hbv_b, hb_h)
-            hbo[pl.ds(base, 8), :] = jnp.maximum(
+            hbin[pl.ds(base, 8), :] = jnp.maximum(
                 hb_g, pltpu.roll(hb_h, cg, 0) * vg
-            ).astype(hbo.dtype)
-            hbo[pl.ds(base + 8, 8), :] = jnp.maximum(
+            ).astype(hbin.dtype)
+            hbin[pl.ds(base + 8, 8), :] = jnp.maximum(
                 hb_h, pltpu.roll(hb_g, ch, 0) * vh
-            ).astype(hbo.dtype)
+            ).astype(hbin.dtype)
         start_out(s)
         return 0
 
     fscr[0, 0] = jnp.int32(1)
     start_in(0)
     lax.fori_loop(0, count, body, 0)
-    # Drain: the last two slots' out DMAs are still in flight.
-    @pl.when(count >= 2)
-    def _():
-        wait_out(count - 2)
-
+    # Drain: only the last slot's out DMA can still be in flight (the
+    # body waits out(s-1) before reusing its buffer).
     wait_out(count - 1)
     flag_out[0, 0] = fscr[0, 0]
     # Lean mode's dummy hb output needs no write: the wrapper aliases
@@ -875,12 +877,15 @@ def pairs_supported(
     """Whether the pair-fused kernel can run this shape. Same matching
     domain as the m8 kernel (n % 128 == 0 rows, lane-aligned LOCAL
     column count); the VMEM residency differs — no in-spec streaming,
-    so the budget covers the four (or two, lean) (32, width)
-    double-buffered tiles, the two (8, width) uint32 dither bases, and
-    the sublane-padded mv/hbv broadcast rows (the sharded form adds
-    only the tiny (32, 1) totals scratch)."""
+    so the budget covers one double-buffered (32, width) tile per
+    matrix, the two (8, width) uint32 dither bases, and the
+    sublane-padded broadcast rows (the sharded form adds only the tiny
+    (32, 1) totals scratch)."""
     width = n if n_local is None else n_local
-    tiles = (4 if track_hb else 2) * 32 * width * itemsize
+    # One double-buffered (32, width) tile per matrix: the compute
+    # overwrites the input tiles in place and the out DMA streams from
+    # the same buffer (no separate out scratch).
+    tiles = (2 if track_hb else 1) * 32 * width * itemsize
     bases = 2 * 8 * width * 4
     # mv (+hbv) diag rows, plus the convergence-target row a tracked
     # run's last sub-exchange carries (worst case fanout=1: diag AND
@@ -1026,10 +1031,8 @@ def fused_pull_pairs(
             pl.BlockSpec((1, 1), lambda *_: (0, 0)),  # converged flag
         ],
         scratch_shapes=[
-            pltpu.VMEM((32, n_cols), w.dtype),  # win
-            pltpu.VMEM((32, n_cols), w.dtype),  # wo
-            pltpu.VMEM(hb_scr, hb.dtype),  # hbin
-            pltpu.VMEM(hb_scr, hb.dtype),  # hbo
+            pltpu.VMEM((32, n_cols), w.dtype),  # win (outputs overwrite it)
+            pltpu.VMEM(hb_scr, hb.dtype),  # hbin (ditto)
             pltpu.VMEM((32, 1), jnp.float32),  # tscr
             pltpu.VMEM((1, 1), jnp.int32),  # fscr
             pltpu.SemaphoreType.DMA((2, 2, 3)),  # in [buf, side, w/hb/tot]

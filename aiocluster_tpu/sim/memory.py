@@ -65,6 +65,21 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
         gathered += jnp.dtype(cfg.heartbeat_dtype).itemsize * n * n
     directions = 2 if cfg.pairing == "permutation" else 1
     transient = directions * gathered
+    # The pair-fused kernel path updates w/hb IN PLACE
+    # (input_output_aliases) and never materializes a gather: its
+    # steady-state peak is the resident state alone. Decided by the
+    # same gates sim_step dispatches on, resolving "auto" AS IF on the
+    # accelerator — the planner answers "will it fit the chip?" and
+    # must give the same answer from a CPU planning host
+    # (tests/test_benchmarks.py pins it to bench's constant).
+    from ..ops.gossip import pallas_path_engaged, pallas_variant_engaged
+
+    axis = None if shards == 1 else "owners"
+    n_local = n // shards
+    if pallas_path_engaged(
+        cfg, axis, n_local=n_local, assume_accelerator=True
+    ) and pallas_variant_engaged(cfg, axis, n_local) == "pairs":
+        transient = 0
     return MemoryPlan(n, state, transient, shards)
 
 

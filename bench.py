@@ -468,11 +468,15 @@ def sim_rounds_per_sec(
     return rps, converged_at, extra
 
 
-# The largest lane-aligned lean population whose memory plan fits one
-# v5e chip's HBM (state + gathered transient under the 12 GiB working
-# budget; benchmarks/run_all.py::_fit_population arrives at the same
-# number for n_devices=1).
-MAX_LEAN_SINGLE_CHIP = 52_096
+# Largest 128-aligned lean population a single 16 GB chip should hold:
+# the pair-fused kernel updates in place (one resident copy, 2 B/pair =
+# 8.6 GB at this N) and its VMEM tile budget caps the width at 65,536.
+# benchmarks/run_all.py::_fit_population arrives at the same number for
+# n_devices=1 (pinned by tests/test_benchmarks.py). The old 52,096
+# figure assumed the non-aliased two-copy path — which the chip refuted
+# by OOM (round-3 window 1); the measured-boundary ladder walks down
+# from this ceiling to whatever actually executes.
+MAX_LEAN_SINGLE_CHIP = 65_536
 
 
 def scale_probe(log, n_nodes: int = 32_768, rounds: int = 16) -> float:
@@ -572,13 +576,14 @@ def main() -> None:
                 probe_rps = round(scale_probe(log), 2)
             except Exception as exc:  # keep the headline even if the probe dies
                 log(f"scale probe failed: {exc!r}")
-            # The planner claims the lean int16 profile fits ~52k, but
-            # the chip OOM'd there (round-3 window 1) — walk the
-            # 128-aligned ladder down to the largest N that actually
-            # executes and record that boundary. Each rung pays a full
-            # compile, so stop while the watchdog still has room to
-            # emit the measurements already taken.
-            for probe_n in (MAX_LEAN_SINGLE_CHIP, 49_152, 45_056, 40_960):
+            # Walk the 128-aligned ladder down from the in-place pairs
+            # ceiling (65,536 — one resident copy) to the largest N
+            # that actually executes and record that boundary; 52,096
+            # is the old two-copy claim the chip OOM'd on. Each rung
+            # pays a full compile, so stop while the watchdog still
+            # has room to emit the measurements already taken.
+            for probe_n in (MAX_LEAN_SINGLE_CHIP, 61_440, 57_344, 52_096,
+                            45_056):
                 if time.perf_counter() - t_main > WATCHDOG_S - 600:
                     log("max-scale ladder stopped: watchdog budget low")
                     break

@@ -265,7 +265,10 @@ def phase_max_scale() -> dict:
 
     tried = []
     largest = None
-    for n in (52_096, 49_152, 45_056, 40_960, 36_864):
+    # Top rung = the pair-fused in-place ceiling (one resident copy,
+    # VMEM tile budget caps the width at 65,536); the 52,096 rung is
+    # the old two-copy planner claim the chip OOM'd on in window 1.
+    for n in (65_536, 61_440, 57_344, 52_096, 45_056, 40_960):
         try:
             sim = Simulator(_lean(n), seed=0, chunk=8)
             sim.run(8)

@@ -271,11 +271,22 @@ def _fit_population(target: int, n_devices: int, bytes_per_device: int) -> int:
     def aligned(m: int) -> int:
         return max(quantum, ((m + quantum - 1) // quantum) * quantum)
 
+    def fits(m: int) -> bool:
+        return (
+            plan(lean_config(m), shards=n_devices).per_shard_bytes
+            <= bytes_per_device
+        )
+
     n = aligned(target)
     while n > quantum:
-        if plan(lean_config(n), shards=n_devices).per_shard_bytes <= bytes_per_device:
+        if fits(n):
             break
         n = aligned(int(n * 0.85) - quantum + 1)
+    # The geometric descent overshoots; climb back to the LARGEST
+    # fitting aligned count below the target (bench's max-scale
+    # constant is pinned to this boundary by tests/test_benchmarks.py).
+    while n + quantum <= aligned(target) and fits(n + quantum):
+        n += quantum
     return n
 
 
