@@ -207,6 +207,59 @@ def test_converged_flag_rides_pairs_kernel():
             assert saw_converged  # ample budget: flag must flip within 6
 
 
+def test_pairs_random_config_sweep_matches_xla():
+    """Seeded sweep over config corners (fanout, writes, churn, dtypes,
+    budgets, profiles): two rounds of the pairs path must equal the XLA
+    path bit-for-bit on every draw. Curated cases elsewhere pin depth;
+    this pins breadth against dispatch-level edge interactions."""
+    import random as pyrandom
+
+    rng = pyrandom.Random(0xA10C)
+    for trial in range(6):
+        lean = rng.random() < 0.4
+        over = dict(
+            n_nodes=128,
+            keys_per_node=rng.choice([1, 4, 16]),
+            fanout=rng.choice([1, 2, 3]),
+            budget=rng.choice([1, 17, 300, 4096]),
+            writes_per_round=rng.choice([0, 1, 3]),
+            death_rate=rng.choice([0.0, 0.1]),
+            revival_rate=0.2,
+            version_dtype=rng.choice(["int16", "int32"]),
+        )
+        if lean:
+            over.update(track_failure_detector=False, track_heartbeats=False)
+        else:
+            over.update(
+                heartbeat_dtype=rng.choice(["int16", "int32"]),
+                fd_dtype=rng.choice(["float32", "bfloat16"]),
+            )
+        key = random.key(100 + trial)
+        cfg_p = SimConfig(**over, use_pallas=True, pallas_variant="pairs")
+        cfg_x = SimConfig(**over, use_pallas=False)
+        # The sweep is vacuous if a future gate change quietly degrades
+        # cfg_p to the XLA path or the m8 kernel — pin the engagement.
+        from aiocluster_tpu.ops.gossip import (
+            pallas_path_engaged,
+            pallas_variant_engaged,
+        )
+
+        assert pallas_path_engaged(cfg_p), over
+        assert pallas_variant_engaged(cfg_p) == "pairs", over
+        sp, sx = init_state(cfg_p), init_state(cfg_x)
+        for _ in range(2):
+            sp = sim_step(sp, key, cfg_p)
+            sx = sim_step(sx, key, cfg_x)
+        fields = ("w",) if lean else (
+            "w", "hb_known", "last_change", "imean", "icount", "live_view"
+        )
+        for f in fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(sp, f)), np.asarray(getattr(sx, f)),
+                err_msg=f"trial {trial} field {f}: {over}",
+            )
+
+
 def test_sim_step_variant_trajectories_identical():
     """Full sim_step trajectories: pallas_variant='pairs' must reproduce
     'm8' (and therefore the XLA path, which m8 is tested against) bit
