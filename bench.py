@@ -364,10 +364,48 @@ def sim_rounds_per_sec(
         # tunnel; a scalar device->host readback provably does.
         return int(np.asarray(sim.state.tick))
 
-    # Warm-up: compile + first chunk.
+    # Warm-up: compile + first chunk. If the pair-fused kernel's first
+    # real-Mosaic compile fails HERE (the driver runs bench.py outside
+    # the battery's canary pin), fall back to the proven single-pass
+    # kernel rather than losing the certification record — the variants
+    # are bit-identical, only speed differs. One same-variant retry
+    # first separates a transient tunnel blip from a deterministic
+    # Mosaic rejection, and the guard requires the Pallas path to have
+    # actually engaged (a CPU fallback's host-side error is not the
+    # kernel's fault).
     t0 = time.perf_counter()
-    sim.run(sim.chunk)
-    sync()
+    try:
+        sim.run(sim.chunk)
+        sync()
+    except Exception as first_exc:
+        from aiocluster_tpu.ops.gossip import (
+            pallas_path_engaged,
+            pallas_variant_engaged,
+        )
+
+        if (
+            _is_oom(first_exc)
+            or not pallas_path_engaged(cfg)
+            or pallas_variant_engaged(cfg) != "pairs"
+        ):
+            raise
+        log(f"warm-up failed with the pairs kernel ({first_exc!r}); "
+            "retrying same-variant once")
+        try:
+            sim = Simulator(cfg, seed=0, chunk=min(rounds, 16))
+            sim.run(sim.chunk)
+            sync()
+        except Exception as second_exc:
+            if _is_oom(second_exc):
+                raise
+            log(f"pairs kernel failed twice ({second_exc!r}); "
+                "falling back to the single-pass kernel")
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, pallas_variant="m8")
+            sim = Simulator(cfg, seed=0, chunk=min(rounds, 16))
+            sim.run(sim.chunk)
+            sync()
     log(f"compile+first chunk: {time.perf_counter() - t0:.1f}s")
 
     # The tunnel to the TPU is shared and noisy; take the best of three

@@ -154,6 +154,23 @@ def phase_pairs_canary() -> dict:
         rec["pairs_error"] = repr(exc)[:600]
         # NOT out["..."]["error"]: a Mosaic rejection is a measured
         # RESULT (retrying won't change it); the m8 pin handles it.
+    if rec.get("pairs_ok"):
+        # Also prove the FLAGSHIP specialization (the driver's entry()
+        # compile check: n=256, default int32 dtypes, full fidelity) —
+        # __graft_entry__ unpins to "auto" only when this exact shape
+        # has compiled under Mosaic at current HEAD.
+        try:
+            flag_cfg = SimConfig(
+                n_nodes=256, keys_per_node=16, fanout=3, budget=64,
+                pallas_variant="pairs",
+            )
+            fsim = Simulator(flag_cfg, seed=0, chunk=4)
+            fsim.run(4)
+            _sync(fsim.state.tick)
+            rec["flagship_ok"] = True
+        except Exception as exc:
+            rec["flagship_ok"] = False
+            rec["flagship_error"] = repr(exc)[:600]
     log(f"pairs canary: {rec}")
     return rec
 
@@ -636,12 +653,14 @@ def main() -> None:
         if only and name not in only:
             continue
         # A short window must not be spent re-measuring what an earlier
-        # window already captured. bench_full is the exception: it is
-        # the certification point and always re-runs at current HEAD.
+        # window already captured. Exceptions that always re-run at
+        # current HEAD: bench_full (the certification point) and
+        # pairs_canary (the proof __graft_entry__'s head-matched unpin
+        # gate consumes — stale evidence must refresh with the code).
         prior = out.get(name)
         if (
             only is None
-            and name != "bench_full"
+            and name not in ("bench_full", "pairs_canary")
             and isinstance(prior, dict)
             and prior.get("_complete")
         ):
