@@ -678,6 +678,15 @@ def sim_step(
                 kw = {}
                 if carry_check:
                     kw["check"] = (mv_vec, alive, alive[owners])
+                if use_pairs:
+                    # The FD reads the round-start hb after the loop
+                    # (hb_round_start): aliasing hb on the first
+                    # sub-exchange would make XLA copy the retained
+                    # buffer — two extra hb passes, worse than the
+                    # plain write. Later sub-exchanges flow linearly.
+                    kw["alias_hb"] = not (
+                        first and cfg.track_failure_detector
+                    )
                 pulled = pull_fn(
                     w, hb if track_hb else None, gm8, c8,
                     valid_pair, sub_salt(c, 0), run_salt,

@@ -912,7 +912,7 @@ def pairs_supported_for(n: int, w: jax.Array, hb: jax.Array | None) -> bool:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("budget", "interpret"))
+@functools.partial(jax.jit, static_argnames=("budget", "interpret", "alias_hb"))
 def fused_pull_pairs(
     w: jax.Array,
     hb: jax.Array | None,
@@ -928,6 +928,7 @@ def fused_pull_pairs(
     owner_offset: jax.Array | int = 0,
     totals: jax.Array | None = None,
     check: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+    alias_hb: bool = True,
 ):
     """One fused grouped-matching sub-exchange, pair-at-a-time: 4 bytes
     of HBM traffic per pair per matrix instead of the single-pass
@@ -1055,16 +1056,23 @@ def fused_pull_pairs(
             jax.ShapeDtypeStruct(hb.shape, hb.dtype),
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
-        # w and hb update IN PLACE: every row is read exactly once
-        # (wait_in of its own slot) strictly before its out DMA starts,
-        # and rows across slots are disjoint, so the aliasing has no
-        # read-after-write hazard — unlike the m8 kernel, whose peer
-        # gather may read rows whose output block already streamed out.
-        # Halves the path's peak HBM (one resident copy per matrix).
-        # Indices are over the flattened operand list: 0-4 scalar
-        # prefetch (leaders, gm, c, vbits, abits), 5 meta is prefetch
-        # too, then 6 mv, 7 hbv, 8 need, 9 w, 10 hb, 11 totals.
-        input_output_aliases={9: 0, 10: 1},
+        # w (and usually hb) update IN PLACE: every row is read exactly
+        # once (wait_in of its own slot) strictly before its out DMA
+        # starts, and rows across slots are disjoint, so the aliasing
+        # has no read-after-write hazard — unlike the m8 kernel, whose
+        # peer gather may read rows whose output block already streamed
+        # out. Halves the path's peak HBM (one resident copy per
+        # matrix). ``alias_hb=False`` exists for callers that RETAIN
+        # the input hb (the FD's round-start matrix on the round's
+        # first sub-exchange): aliasing a still-live operand makes XLA
+        # insert a full copy — two extra hb passes, worse than the
+        # unaliased write. Indices are over the flattened operand
+        # list: 0-4 scalar prefetch (leaders, gm, c, vbits, abits),
+        # 5 meta is prefetch too, then 6 mv, 7 hbv, 8 need, 9 w,
+        # 10 hb, 11 totals.
+        input_output_aliases=(
+            {9: 0, 10: 1} if alias_hb else {9: 0}
+        ),
         interpret=interpret,
     )(
         leaders,
