@@ -583,6 +583,40 @@ def test_memory_plan_profiles():
     assert plan(full100k, shards=16).fits()
 
 
+def test_checkpoint_loads_config_missing_new_fields(tmp_path):
+    """A checkpoint saved before a SimConfig field existed must still
+    load (the loader rebuilds SimConfig(**stored_dict); new fields take
+    their defaults). Guards every future field addition — exercised
+    here by stripping pallas_variant, added in round 3."""
+    import dataclasses
+
+    import numpy as np
+
+    from aiocluster_tpu.sim import Simulator
+    from aiocluster_tpu.sim.checkpoint import load_state, save_state
+
+    cfg = SimConfig(n_nodes=64, keys_per_node=4)
+    sim = Simulator(cfg, seed=0, chunk=2)
+    sim.run(2)
+    path = tmp_path / "ck.npz"
+    save_state(path, sim.state, cfg)
+    # Simulate an old-format checkpoint: rewrite with the field absent.
+    import json
+
+    with np.load(path, allow_pickle=False) as z:
+        data = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(data["__meta__"]).decode())
+    del meta["config"]["pallas_variant"]
+    data["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    np.savez(path, **data)
+    state, cfg2, _meta = load_state(path)
+    assert cfg2.pallas_variant == "auto"  # default restored
+    assert dataclasses.replace(cfg2, pallas_variant=cfg.pallas_variant) == cfg
+    assert int(state.tick) == 2
+
+
 def test_checkpoint_bfloat16_roundtrip(tmp_path):
     """Review regression: bfloat16 imean used to round-trip through npz as
     a void dtype and fail to load."""
