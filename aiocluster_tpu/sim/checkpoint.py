@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import warnings
 from pathlib import Path
 
 import jax.numpy as jnp
@@ -71,7 +72,21 @@ def load_state(
     parallel.shard_state when resuming on a mesh."""
     with np.load(Path(path)) as data:
         meta = json.loads(bytes(data["__meta__"]).decode())
-        cfg = SimConfig(**meta["config"])
+        # Tolerate config keys this code version doesn't know (a NEWER
+        # writer's fields): unknown knobs can't influence a build that
+        # lacks them, and refusing the load would strand otherwise
+        # readable state. Missing keys take their defaults (the OLDER
+        # writer case, pinned by the forward-compat test).
+        known = {f.name for f in dataclasses.fields(SimConfig)}
+        raw = dict(meta["config"])
+        unknown = sorted(set(raw) - known)
+        if unknown:
+            warnings.warn(
+                f"checkpoint config has unknown keys {unknown} "
+                "(written by a newer version?); ignoring them",
+                stacklevel=2,
+            )
+        cfg = SimConfig(**{k: v for k, v in raw.items() if k in known})
         fields = {}
         for name in _FIELDS:
             arr = data[name]

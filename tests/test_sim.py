@@ -615,6 +615,22 @@ def test_checkpoint_loads_config_missing_new_fields(tmp_path):
     assert cfg2.pallas_variant == "auto"  # default restored
     assert dataclasses.replace(cfg2, pallas_variant=cfg.pallas_variant) == cfg
     assert int(state.tick) == 2
+    # And the reverse direction: a NEWER writer's unknown config key is
+    # ignored with a warning instead of stranding the checkpoint.
+    meta["config"]["pallas_variant"] = "auto"
+    meta["config"]["future_knob"] = 7
+    data["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    np.savez(path, **data)
+    import warnings as _warnings
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        state3, cfg3, _ = load_state(path)
+    assert cfg3 == cfg2
+    assert int(state3.tick) == 2
+    assert any("future_knob" in str(w.message) for w in caught)
 
 
 def test_checkpoint_bfloat16_roundtrip(tmp_path):
