@@ -306,18 +306,19 @@ def _pairs_kernel(
     hbout_hbm,
     flag_out,  # (1, 1) int32 all-converged flag (written 1 if check off)
     # scratch
-    win,  # (32, n): [buf 0/1] x [side 0/1] x 8 rows; outputs OVERWRITE it
+    win,  # (16*nbuf, n): [buf] x [side 0/1] x 8 rows; outputs OVERWRITE it
     hbin,
-    tscr,  # (32, 1) f32 totals rows (dummy if unused)
+    tscr,  # (16*nbuf, 1) f32 totals rows (dummy if unused)
     fscr,  # (1, 1) int32 running converged flag
-    insems,  # (2, 2, 3): [buf, side, matrix(w/hb/totals)]
-    outsems,  # (2, 2, 2): [buf, side, matrix(w/hb)]
+    insems,  # (nbuf, 2, 3): [buf, side, matrix(w/hb/totals)]
+    outsems,  # (nbuf, 2, 2): [buf, side, matrix(w/hb)]
     *,
     n: int,
     track_hb: bool,
     apply_diag: bool,
     use_totals: bool,
     check: bool,
+    nbuf: int,
 ):
     """Both sides of every matched group pair in ONE visit (the
     pair-fused pull). The matching is an involution, so the single-pass
@@ -336,11 +337,13 @@ def _pairs_kernel(
     groups fetch their own tile into the peer slot (one redundant 8-row
     read for at most one group per matching) and skip the side-1 write.
     The compute OVERWRITES the input tiles in VMEM and the out DMA
-    streams from the same buffer — no separate out scratch, which
-    halves the VMEM tiles and doubles the width this kernel serves; the
-    price is that a slot's out DMA must land before the buffer's next
-    occupant streams in (wait_out(s-1) precedes start_in(s+1) — a
-    sub-microsecond serialization against a multi-microsecond compute).
+    streams from the same buffer — no separate out scratch. With
+    ``nbuf=3`` (the default whenever VMEM allows) a slot's out DMA has
+    a FULL later slot's compute to land before its buffer's next
+    occupant streams in — the classic overlap schedule; ``nbuf=2``
+    (the fallback that buys the widest shapes) must wait each out DMA
+    immediately before the next prefetch, serializing ~1 row-pair DMA
+    against each slot's compute.
 
     Column sharding: w may be an (N, n_local) block — rows stay global
     (the pairing is over rows, and peer rows are shard-local), columns
@@ -380,32 +383,32 @@ def _pairs_kernel(
         src_hbm, _, scr, m = mats[mat]
         g = ld_ref[slot]
         src = (g if side == 0 else gm_ref[g]) * 8
-        row = (slot % 2) * 16 + side * 8
+        row = (slot % nbuf) * 16 + side * 8
         return pltpu.make_async_copy(
             src_hbm.at[pl.ds(src, 8), :],
             scr.at[pl.ds(row, 8), :],
-            insems.at[slot % 2, side, m],
+            insems.at[slot % nbuf, side, m],
         )
 
     def out_copy(slot, side, mat):
         _, dst_hbm, scr, m = mats[mat]
         g = ld_ref[slot]
         dst = (g if side == 0 else gm_ref[g]) * 8
-        row = (slot % 2) * 16 + side * 8
+        row = (slot % nbuf) * 16 + side * 8
         return pltpu.make_async_copy(
             scr.at[pl.ds(row, 8), :],
             dst_hbm.at[pl.ds(dst, 8), :],
-            outsems.at[slot % 2, side, m],
+            outsems.at[slot % nbuf, side, m],
         )
 
     def tot_copy(slot, side):
         g = ld_ref[slot]
         src = (g if side == 0 else gm_ref[g]) * 8
-        row = (slot % 2) * 16 + side * 8
+        row = (slot % nbuf) * 16 + side * 8
         return pltpu.make_async_copy(
             tot_hbm.at[pl.ds(src, 8), :],
             tscr.at[pl.ds(row, 8), :],
-            insems.at[slot % 2, side, 2],
+            insems.at[slot % nbuf, side, 2],
         )
 
     def start_in(slot):
@@ -443,13 +446,15 @@ def _pairs_kernel(
                 out_copy(slot, 1, mat).wait()
 
     def body(s, _):
-        base = (s % 2) * 16
+        base = (s % nbuf) * 16
 
-        # Slot s+1 streams into the buffer slot s-1 computed AND wrote
-        # from: its out DMA must land first (in-place VMEM reuse).
-        @pl.when(s >= 1)
+        # Slot s+1 streams into the buffer slot s-(nbuf-1) computed AND
+        # wrote from: its out DMA must land first (in-place VMEM
+        # reuse). With nbuf=3 that DMA had all of slot s-1's compute to
+        # land — no stall; nbuf=2 waits it here, hot.
+        @pl.when(s >= nbuf - 1)
         def _():
-            wait_out(s - 1)
+            wait_out(s - (nbuf - 1))
 
         @pl.when(s + 1 < count)
         def _():
@@ -515,8 +520,15 @@ def _pairs_kernel(
     fscr[0, 0] = jnp.int32(1)
     start_in(0)
     lax.fori_loop(0, count, body, 0)
-    # Drain: only the last slot's out DMA can still be in flight (the
-    # body waits out(s-1) before reusing its buffer).
+    # Drain: the last nbuf-1 slots' out DMAs can still be in flight
+    # (the body waits out(s-(nbuf-1)), so slots count-nbuf+1..count-1
+    # are outstanding) — derived from nbuf so a future depth cannot
+    # silently under-drain.
+    for j in range(nbuf - 1, 1, -1):
+        @pl.when(count >= j)
+        def _(j=j):
+            wait_out(count - j)
+
     wait_out(count - 1)
     flag_out[0, 0] = fscr[0, 0]
     # Lean mode's dummy hb output needs no write: the wrapper aliases
@@ -871,33 +883,42 @@ def fused_pull_m8(
     return (w_new, hb_new) if track_hb else w_new
 
 
+def pairs_nbuf(
+    n: int, itemsize: int, track_hb: bool = True, n_local: int | None = None
+) -> int | None:
+    """Scratch-buffer rotation depth for the pair-fused kernel at this
+    shape, or None when it cannot run. 3 whenever VMEM allows — each
+    slot's out DMA then has a full later slot's compute to land before
+    its buffer is reused (no stall); 2 buys the widest shapes at the
+    price of one hot out-DMA wait per slot. One accounting shared by
+    the wrapper and the dispatch gate.
+
+    The VMEM residency (no in-spec streaming): nbuf (16, width) tile
+    pairs per matrix (outputs overwrite them in place), the two
+    (8, width) uint32 dither bases, and the sublane-padded broadcast
+    rows — mv (+hbv) diag rows plus the convergence-target row a
+    tracked run's last sub-exchange carries (worst case fanout=1: diag
+    AND check ride the same call), charged unconditionally so the gate
+    never admits a shape whose tracked instance exceeds VMEM. The
+    sharded form adds only the tiny (16*nbuf, 1) totals scratch."""
+    width = n if n_local is None else n_local
+    if n % 128 != 0 or width % 128 != 0:
+        return None
+    bases = 2 * 8 * width * 4
+    vecs = ((2 if track_hb else 1) + 1) * 8 * width * 4
+    for nbuf in (3, 2):
+        tiles = (2 if track_hb else 1) * 16 * nbuf * width * itemsize
+        if tiles + bases + vecs <= VMEM_BUDGET:
+            return nbuf
+    return None
+
+
 def pairs_supported(
     n: int, itemsize: int, track_hb: bool = True, n_local: int | None = None
 ) -> bool:
-    """Whether the pair-fused kernel can run this shape. Same matching
-    domain as the m8 kernel (n % 128 == 0 rows, lane-aligned LOCAL
-    column count); the VMEM residency differs — no in-spec streaming,
-    so the budget covers one double-buffered (32, width) tile per
-    matrix, the two (8, width) uint32 dither bases, and the
-    sublane-padded broadcast rows (the sharded form adds only the tiny
-    (32, 1) totals scratch)."""
-    width = n if n_local is None else n_local
-    # One double-buffered (32, width) tile per matrix: the compute
-    # overwrites the input tiles in place and the out DMA streams from
-    # the same buffer (no separate out scratch).
-    tiles = (2 if track_hb else 1) * 32 * width * itemsize
-    bases = 2 * 8 * width * 4
-    # mv (+hbv) diag rows, plus the convergence-target row a tracked
-    # run's last sub-exchange carries (worst case fanout=1: diag AND
-    # check ride the same call) — all 8-sublane-padded int32, charged
-    # unconditionally so the gate never admits a shape whose tracked
-    # instance exceeds VMEM.
-    vecs = ((2 if track_hb else 1) + 1) * 8 * width * 4
-    return (
-        n % 128 == 0
-        and width % 128 == 0
-        and tiles + bases + vecs <= VMEM_BUDGET
-    )
+    """Whether the pair-fused kernel can run this shape (see
+    pairs_nbuf for the accounting)."""
+    return pairs_nbuf(n, itemsize, track_hb, n_local) is not None
 
 
 def pairs_supported_for(n: int, w: jax.Array, hb: jax.Array | None) -> bool:
@@ -966,7 +987,11 @@ def fused_pull_pairs(
     if hbv is not None and mv is None:
         raise ValueError("hbv given without mv: the diagonal refresh is all-or-none")
     n, n_cols = w.shape
-    if not pairs_supported_for(n, w, hb):
+    itemsize = w.dtype.itemsize
+    if track_hb:
+        itemsize = max(itemsize, hb.dtype.itemsize)
+    nbuf = pairs_nbuf(n, itemsize, track_hb, n_local=n_cols)
+    if nbuf is None:
         raise ValueError(f"pair-fused kernel cannot run shape {w.shape}")
     leaders, count, vbits = _pairs_slots(n, gm, valid)
     gm = gm.astype(jnp.int32)
@@ -1014,7 +1039,7 @@ def fused_pull_pairs(
         mv = jnp.zeros((1, 128), jnp.int32)
         hbv = jnp.zeros((1, 128), jnp.int32)
         vec_spec = hbv_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
-    hb_scr = (32, n_cols) if track_hb else (8, 128)
+    hb_scr = (16 * nbuf, n_cols) if track_hb else (8, 128)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
         grid=(1,),
@@ -1032,12 +1057,12 @@ def fused_pull_pairs(
             pl.BlockSpec((1, 1), lambda *_: (0, 0)),  # converged flag
         ],
         scratch_shapes=[
-            pltpu.VMEM((32, n_cols), w.dtype),  # win (outputs overwrite it)
+            pltpu.VMEM((16 * nbuf, n_cols), w.dtype),  # win (in-place out)
             pltpu.VMEM(hb_scr, hb.dtype),  # hbin (ditto)
-            pltpu.VMEM((32, 1), jnp.float32),  # tscr
+            pltpu.VMEM((16 * nbuf, 1), jnp.float32),  # tscr
             pltpu.VMEM((1, 1), jnp.int32),  # fscr
-            pltpu.SemaphoreType.DMA((2, 2, 3)),  # in [buf, side, w/hb/tot]
-            pltpu.SemaphoreType.DMA((2, 2, 2)),  # out [buf, side, w/hb]
+            pltpu.SemaphoreType.DMA((nbuf, 2, 3)),  # in [buf, side, mat]
+            pltpu.SemaphoreType.DMA((nbuf, 2, 2)),  # out [buf, side, mat]
         ],
     )
     kernel = functools.partial(
@@ -1047,6 +1072,7 @@ def fused_pull_pairs(
         apply_diag=apply_diag,
         use_totals=use_totals,
         check=do_check,
+        nbuf=nbuf,
     )
     w_new, hb_new, flag = pl.pallas_call(
         kernel,

@@ -235,18 +235,38 @@ def phase_lean_scaling() -> dict:
     done = {p["n"] for p in points}
     # The top point is whatever the max_scale phase (or the bench probe)
     # found actually fits — the planner's 52,096 claim OOM'd on chip.
+    # 56,064 is the widest 3-buffer (full-overlap) lean shape: the 100k
+    # config's 12,544-wide shards run that schedule, so the projection
+    # wants an anchor in the same regime even when the max point runs
+    # the 2-buffer fallback — but only when the measured boundary says
+    # it fits (points above n_top would OOM deterministically).
     n_top = out.get("max_scale", {}).get("largest_fitting_n")
-    for n in (1024, 4096, 10_240, 32_768, n_top or 32_768):
+    ladder = [1024, 4096, 10_240, 32_768]
+    if n_top:
+        if n_top >= 56_064:
+            ladder.append(56_064)
+        ladder.append(n_top)
+    failures = []
+    for n in ladder:
         if n in done:
             continue
         done.add(n)
-        t0 = time.perf_counter()
-        sim = Simulator(_lean(n), seed=1, chunk=16)
-        rounds = sim.run_until_converged(max_rounds=2048)
-        wall = time.perf_counter() - t0
-        rate = _rate(Simulator(_lean(n), seed=0, chunk=16),
-                     rounds=64 if n >= 32_768 else 128)
+        try:
+            t0 = time.perf_counter()
+            sim = Simulator(_lean(n), seed=1, chunk=16)
+            rounds = sim.run_until_converged(max_rounds=2048)
+            wall = time.perf_counter() - t0
+            rate = _rate(Simulator(_lean(n), seed=0, chunk=16),
+                         rounds=64 if n >= 32_768 else 128)
+        except Exception as exc:
+            # One bad point (OOM, tunnel drop mid-point) must not
+            # clobber the points already measured this or prior
+            # windows; record and stop — the tunnel is probably gone.
+            failures.append({"n": n, "error": repr(exc)[:300]})
+            log(f"lean n={n} FAILED: {exc!r}")
+            break
         from aiocluster_tpu.ops.gossip import pallas_variant_engaged
+        from aiocluster_tpu.ops.pallas_pull import pairs_nbuf
 
         points.append(
             {"n": n, "rounds_to_convergence": rounds,
@@ -254,16 +274,21 @@ def phase_lean_scaling() -> dict:
              "rounds_per_sec": rate,
              # Recorded AT measurement time: a later window may resolve
              # a different variant (canary pin lifted/applied) and the
-             # projection must charge the pass count that actually
-             # produced this rate.
-             "kernel_variant": pallas_variant_engaged(_lean(n))}
+             # projection must charge the pass count — and anchor on
+             # the scratch-rotation regime — that actually produced
+             # this rate.
+             "kernel_variant": pallas_variant_engaged(_lean(n)),
+             "kernel_nbuf": pairs_nbuf(n, 2, track_hb=False)}
         )
         log(f"lean n={n}: converged {rounds} rounds, {rate} rounds/s")
         out["lean_scaling"] = {"points": points}  # partial
         checkpoint()
     points.sort(key=lambda p: p["n"])
     result = {"points": points, **_northstar_projection(points)}
-    if n_top is None:
+    if failures:
+        result["point_failures"] = failures
+        result["error"] = f"{len(failures)} point(s) failed; retry next window"
+    elif n_top is None:
         # The max-N anchor point is the phase's stated purpose — without
         # a measured max_scale boundary this is a partial curve; the
         # error keeps the phase retried (merged points make that cheap)
@@ -338,10 +363,23 @@ def _northstar_projection(points: list[dict]) -> dict:
     b, a = np.polyfit(ns, rs, 1)  # rounds ~ b*n + a
     n_star = 100_352  # config 5's 128x8-aligned 100k population
     rounds_100k = float(b * n_star + a)
-    # Measured achieved throughput at the largest single-chip point,
-    # charged at the pass count of the variant that PRODUCED the rate
-    # (recorded in the point; pre-variant checkpoints ran m8).
-    big = max(pts, key=lambda p: p["n"])
+    # Measured achieved throughput at the largest single-chip point IN
+    # THE SAME KERNEL REGIME as the 100k config's shards (pairs, 3-buf
+    # full-overlap at 12,544-wide blocks): a 2-buffer fallback point
+    # serializes one out-DMA per slot and would understate the
+    # bandwidth the sharded run actually gets. Charged at the pass
+    # count of the variant that PRODUCED the rate (recorded in the
+    # point; pre-variant checkpoints ran m8). Falls back to the
+    # largest point when no regime-matched one exists.
+    from aiocluster_tpu.ops.pallas_pull import pairs_nbuf as _nbuf
+
+    star_nbuf = _nbuf(n_star, 2, track_hb=False, n_local=n_star // 8)
+    matched = [
+        p for p in pts
+        if p.get("kernel_variant") == "pairs"
+        and p.get("kernel_nbuf") == star_nbuf
+    ]
+    big = max(matched or pts, key=lambda p: p["n"])
     big_variant = big.get("kernel_variant", "m8")
     big_passes = 2 if big_variant == "pairs" else 3
     bytes_per_round = 3 * big_passes * big["n"] ** 2 * 2

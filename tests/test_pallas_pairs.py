@@ -126,10 +126,39 @@ def test_pairs_odd_group_count_self_match():
 
 
 def test_pairs_supported_domain():
+    from aiocluster_tpu.ops.pallas_pull import pairs_nbuf
+
     assert pairs_supported(1024, 2, track_hb=True)
     assert pairs_supported(32_768, 2, track_hb=False)
     assert not pairs_supported(1000, 2)  # off the matching domain
     assert not pairs_supported(65_536, 4, track_hb=True)  # VMEM
+    # Rotation depth: 3 (full overlap) until VMEM forces the 2-buffer
+    # fallback, which carries the widest lean shapes to 65,536.
+    assert pairs_nbuf(56_064, 2, track_hb=False) == 3
+    assert pairs_nbuf(65_536, 2, track_hb=False) == 2
+    assert pairs_nbuf(65_664, 2, track_hb=False) is None
+    # The 100k config's 12,544-wide shards run the full-overlap depth.
+    assert pairs_nbuf(100_352, 2, track_hb=False, n_local=12_544) == 3
+
+
+def test_pairs_two_buffer_fallback_matches_m8(monkeypatch):
+    """The nbuf=2 schedule (widest shapes) waits each slot's out DMA
+    before the next prefetch — a different pipeline than the default
+    3-buffer rotation, so its bit-identity is pinned separately by
+    shrinking the VMEM budget until n=128 takes the fallback."""
+    from aiocluster_tpu.ops import pallas_pull
+
+    n = 128
+    w, _hb, gm, c, valid, salt, run_salt = _case(n, jnp.int16, 23)
+    want = fused_pull_m8(
+        w, None, gm, c, valid, salt, run_salt, budget=32, interpret=True
+    )
+    monkeypatch.setattr(pallas_pull, "VMEM_BUDGET", 25_000)
+    assert pallas_pull.pairs_nbuf(n, 2, track_hb=False) == 2
+    got = fused_pull_pairs(
+        w, None, gm, c, valid, salt, run_salt, budget=32, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_pairs_totals_matches_m8_totals():
