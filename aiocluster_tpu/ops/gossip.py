@@ -414,6 +414,42 @@ def pallas_path_engaged(
     )
 
 
+def resolve_variant_env(cfg: SimConfig) -> SimConfig:
+    """Fold the AIOCLUSTER_TPU_PALLAS_VARIANT env override (the
+    benchmark A/B / kill-switch knob) into the config ONCE, at
+    construction time. Returns ``cfg`` unchanged unless the override
+    applies.
+
+    The override is resolved here — not inside the (jitted) decision
+    functions — because cfg is the jit static argument: an env var read
+    at trace time is invisible to the jit cache key, so flipping it
+    between runs in one process would silently reuse the previously
+    compiled kernel variant while Python-level provenance reported the
+    new value (ADVICE r3). ``Simulator.__init__`` applies this, making
+    the resolved variant part of every cache key.
+
+    Precedence: an EXPLICIT cfg.pallas_variant ("m8"/"pairs") wins over
+    the env var — the override steers configs that left the choice to
+    "auto" (the battery's canary pin, bench's default config) without
+    defeating code that deliberately pinned a variant (bench's warm-up
+    fallback to the proven kernel, the canary's own A/B arms). The env
+    value is validated loudly whenever set — a typo'd override must not
+    silently measure the wrong kernel."""
+    env = os.environ.get("AIOCLUSTER_TPU_PALLAS_VARIANT")
+    if not env:
+        return cfg
+    if env not in ("auto", "m8", "pairs"):
+        raise ValueError(
+            "AIOCLUSTER_TPU_PALLAS_VARIANT must be auto/m8/pairs, "
+            f"got {env!r}"
+        )
+    if env == "auto" or cfg.pallas_variant != "auto":
+        return cfg
+    import dataclasses
+
+    return dataclasses.replace(cfg, pallas_variant=env)
+
+
 def pallas_variant_engaged(
     cfg: SimConfig,
     axis_name: str | None = None,
@@ -425,21 +461,13 @@ def pallas_variant_engaged(
     kernel — 3). Single source of truth consumed by sim_step's dispatch
     AND by bench.py's variant provenance + analytic bytes/round, so the
     recorded roofline can never drift from what the kernel actually did
-    (same drift class pallas_path_engaged guards against). Resolves the
-    AIOCLUSTER_TPU_PALLAS_VARIANT env override (the benchmark A/B /
-    kill-switch knob; read at trace time) over cfg.pallas_variant, and
-    validates it loudly — a typo'd override must not silently measure
-    the wrong kernel."""
+    (same drift class pallas_path_engaged guards against). A pure
+    function of cfg: the env override is folded into cfg up front by
+    ``resolve_variant_env`` (Simulator construction), never read at
+    trace time."""
     from . import pallas_pull
 
-    variant = (
-        os.environ.get("AIOCLUSTER_TPU_PALLAS_VARIANT") or cfg.pallas_variant
-    )
-    if variant not in ("auto", "m8", "pairs"):
-        raise ValueError(
-            "AIOCLUSTER_TPU_PALLAS_VARIANT must be auto/m8/pairs, "
-            f"got {variant!r}"
-        )
+    variant = cfg.pallas_variant
     n = cfg.n_nodes
     if axis_name is not None and n_local is None:
         return "m8"  # sharded callers must say how wide a shard is

@@ -68,18 +68,32 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
     # The pair-fused kernel path updates w/hb IN PLACE
     # (input_output_aliases) and never materializes a gather: its
     # steady-state peak is the resident state alone. Decided by the
-    # same gates sim_step dispatches on, resolving "auto" AS IF on the
-    # accelerator — the planner answers "will it fit the chip?" and
-    # must give the same answer from a CPU planning host
-    # (tests/test_benchmarks.py pins it to bench's constant).
-    from ..ops.gossip import pallas_path_engaged, pallas_variant_engaged
+    # same gates sim_step dispatches on (env override folded in first,
+    # so the plan matches what would actually dispatch), resolving
+    # "auto" AS IF on the accelerator — the planner answers "will it
+    # fit the chip?" and must give the same answer from a CPU planning
+    # host (tests/test_benchmarks.py pins it to bench's constant).
+    from ..ops.gossip import (
+        pallas_path_engaged,
+        pallas_variant_engaged,
+        resolve_variant_env,
+    )
 
+    cfg = resolve_variant_env(cfg)
     axis = None if shards == 1 else "owners"
     n_local = n // shards
     if pallas_path_engaged(
         cfg, axis, n_local=n_local, assume_accelerator=True
     ) and pallas_variant_engaged(cfg, axis, n_local) == "pairs":
-        transient = 0
+        # FD configs retain the round-start heartbeat matrix for the
+        # phi phase, so the first sub-exchange does NOT alias hb
+        # (gossip.py alias_hb) — a second full (N, N) heartbeat matrix
+        # is live at peak alongside the resident state (ADVICE r3).
+        # Only heartbeat-free profiles earn the zero-transient claim.
+        if cfg.track_failure_detector and cfg.track_heartbeats:
+            transient = jnp.dtype(cfg.heartbeat_dtype).itemsize * n * n
+        else:
+            transient = 0
     return MemoryPlan(n, state, transient, shards)
 
 

@@ -83,7 +83,15 @@ class Simulator:
     ) -> None:
         if topology is not None and topology.n_nodes != cfg.n_nodes:
             raise ValueError("topology size != cfg.n_nodes")
-        self.cfg = cfg
+        from ..ops.gossip import resolve_variant_env
+
+        # Fold the AIOCLUSTER_TPU_PALLAS_VARIANT override into the config
+        # HERE so the resolved variant is part of the jit static argument
+        # (= the compile cache key): flipping the env var mid-process can
+        # then never reuse a stale compiled variant while provenance
+        # reports the new one (ADVICE r3). Consumers reading provenance
+        # must read ``sim.cfg``, not the cfg they passed in.
+        self.cfg = cfg = resolve_variant_env(cfg)
         self.chunk = chunk
         self.seed = seed
         self._key = random.key(seed)

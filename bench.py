@@ -23,6 +23,14 @@ then (``--platform auto``) an explicit CPU fallback. Exactly ONE JSON
 line is printed on stdout even on failure (with an ``error`` field);
 diagnostics go to stderr.
 
+Artifact shape (round-3 lesson): the stdout line is COMPACT — headline
+scalars only, hard-capped at ``STDOUT_LINE_CAP`` bytes — because the
+driver's capture truncated round 3's grown record into an unparseable
+tail (BENCH_r03.json ``"parsed": null``). The full record, including
+the embedded on-chip provenance chain and the measured reference
+baseline, goes to ``benchmarks/records/bench_last_run.json``; the
+stdout line carries a pointer to it.
+
 Usage: python bench.py [--smoke] [--nodes N] [--rounds R]
                        [--platform {auto,tpu,cpu}]
 """
@@ -296,6 +304,108 @@ def measured_reference_baseline(log) -> dict | None:
     )
 
 
+# Hard cap on the stdout record line. Round 3's full record grew to
+# ~4.5 KB and the driver's capture kept only an unparseable tail
+# (BENCH_r03.json "parsed": null); the compact line stays ~an order of
+# magnitude under this, and the cap is enforced (with a documented
+# sacrifice order) so growth can never break the contract again.
+STDOUT_LINE_CAP = 2000
+
+# Keys dropped (in order) if the compact line somehow exceeds the cap —
+# least-essential provenance first; the headline fields
+# (metric/value/unit/vs_baseline) and platform are never dropped.
+_SACRIFICE_ORDER = (
+    "budget",
+    "reference_measured_rounds_per_sec",
+    "xla_path_rounds_per_sec",
+    "max_scale_rounds_per_sec",
+    "roofline_gb_per_sec",
+    "last_onchip_head",
+    "max_scale_nodes",
+    "last_onchip_value",
+    "tpu_note",
+    "full_record",
+    "pallas_variant",
+    "fd_kernel",
+    "pallas_speedup",
+    "roofline_fraction_of_peak",
+    "rounds_to_convergence",
+)
+
+
+def compact_record(result: dict, record_path: str | None = None) -> dict:
+    """The driver-facing stdout record: required headline fields plus a
+    flat, scalar-only ``extra`` (no nested records — those live in the
+    full-record file this points at)."""
+    ex = result.get("extra", {})
+    roof = ex.get("roofline") or {}
+    ms = ex.get("max_scale_single_chip") or {}
+    msb = ex.get("max_scale_single_chip_measured_boundary") or {}
+    ref = (ex.get("measured_reference_library") or {}).get(
+        "at_test_interval"
+    ) or {}
+    lo = ex.get("last_onchip") or {}
+    lo_rec = lo.get("record") or {}
+    extra = {
+        "platform": ex.get("platform"),
+        "rounds_to_convergence": ex.get("rounds_to_convergence"),
+        "pallas_variant": ex.get("pallas_variant_engaged"),
+        "pallas_speedup": ex.get("pallas_speedup"),
+        "xla_path_rounds_per_sec": ex.get("xla_path_rounds_per_sec"),
+        "fd_kernel": ex.get("fd_kernel"),
+        "roofline_gb_per_sec": roof.get("achieved_gb_per_sec"),
+        "roofline_fraction_of_peak": roof.get("fraction_of_peak"),
+        "max_scale_nodes": msb.get("nodes") or ms.get("nodes"),
+        "max_scale_rounds_per_sec": (
+            msb.get("rounds_per_sec") or ms.get("rounds_per_sec")
+        ),
+        "reference_measured_rounds_per_sec": ref.get(
+            "sim_equivalent_rounds_per_sec"
+        ),
+        "budget": ex.get("budget"),
+        "tpu_note": ex.get("tpu_note"),
+        # A CPU fallback still points at (and summarizes) the certified
+        # on-chip evidence; the verbatim record is in the full file.
+        "last_onchip_value": lo_rec.get("value"),
+        "last_onchip_head": lo.get("head"),
+        "full_record": record_path,
+    }
+    extra = {k: v for k, v in extra.items() if v is not None}
+    line = {
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "vs_baseline": result["vs_baseline"],
+        "extra": extra,
+    }
+    for key in _SACRIFICE_ORDER:
+        if len(json.dumps(line)) <= STDOUT_LINE_CAP:
+            break
+        extra.pop(key, None)
+    return line
+
+
+def write_full_record(result: dict, log) -> str | None:
+    """Persist the complete record (nested provenance and all) next to
+    the other committed measurement records; returns the repo-relative
+    path for the stdout pointer, or None if the write failed (the
+    compact line must still be emitted)."""
+    rel = os.path.join("benchmarks", "records", "bench_last_run.json")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), rel)
+    payload = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "record": result,
+    }
+    try:
+        with open(path + ".tmp", "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(path + ".tmp", path)
+        return rel
+    except Exception as exc:
+        log(f"full-record write failed: {exc!r}")
+        return None
+
+
 # Published HBM bandwidth by PJRT device_kind (the axon tunnel reports
 # "TPU v5 lite" for v5e).
 HBM_PEAK_GBPS = {
@@ -357,6 +467,10 @@ def sim_rounds_per_sec(
         fd_dtype="bfloat16",
     )
     sim = Simulator(cfg, seed=0, chunk=min(rounds, 16))
+    # The Simulator folds the AIOCLUSTER_TPU_PALLAS_VARIANT override into
+    # its config (jit-cache-key correctness, ADVICE r3); all provenance
+    # below must describe THAT config, not the one we passed in.
+    cfg = sim.cfg
     log(f"devices: {jax.devices()}")
 
     def sync() -> int:
@@ -402,8 +516,13 @@ def sim_rounds_per_sec(
                 "falling back to the single-pass kernel")
             import dataclasses
 
+            # The explicit pin beats any AIOCLUSTER_TPU_PALLAS_VARIANT
+            # override (resolve_variant_env precedence), so the rebuilt
+            # Simulator really dispatches m8 even when the env exported
+            # "pairs" (ADVICE r3).
             cfg = dataclasses.replace(cfg, pallas_variant="m8")
             sim = Simulator(cfg, seed=0, chunk=min(rounds, 16))
+            cfg = sim.cfg
             sim.run(sim.chunk)
             sync()
     log(f"compile+first chunk: {time.perf_counter() - t0:.1f}s")
@@ -691,7 +810,8 @@ def main() -> None:
                 **sim_extra,
             },
         }
-        print(json.dumps(result), flush=True)
+        record_path = write_full_record(result, log)
+        print(json.dumps(compact_record(result, record_path)), flush=True)
     except Exception as exc:
         # One diagnosable JSON line even on failure (round-1 lesson).
         print(

@@ -190,23 +190,51 @@ def phase_bench_full() -> dict:
         # keeps the skip/needed logic treating this phase as unmeasured.
         rec["error"] = f"bench.py exited rc={proc.returncode}"
     try:
-        rec["record"] = json.loads(line)
+        rec["record"] = json.loads(line)  # the compact driver-facing line
     except Exception:
         rec["stdout_tail"] = proc.stdout[-1500:]
+    # bench.py now emits a compact stdout line (round-3's full record
+    # outgrew the driver's capture) and writes the complete record to
+    # bench_last_run.json; the provenance chain wants the FULL one.
+    # Only trust the file when THIS run's compact line points at it AND
+    # the headline matches — a stale file from an earlier run must not
+    # be re-stamped as this head's provenance (nor may the flat compact
+    # record be promoted in the full record's place).
+    full = None
+    compact = rec.get("record") or {}
+    if isinstance(compact, dict) and compact.get("extra", {}).get(
+        "full_record"
+    ):
+        try:
+            with open(os.path.join(HERE, "bench_last_run.json")) as f:
+                candidate = json.load(f)["record"]
+            if (
+                candidate.get("metric") == compact.get("metric")
+                and candidate.get("value") == compact.get("value")
+            ):
+                full = candidate
+                rec["full_record"] = full
+            else:
+                log("bench_last_run.json does not match this run's "
+                    "stdout line — ignoring as stale")
+        except Exception as exc:
+            log(f"bench_last_run.json unavailable: {exc!r}")
     # A real on-chip run also refreshes the stable pointer bench.py
     # embeds into CPU-fallback records (the headline must survive a
-    # down tunnel — VERDICT r2 weak item 1).
+    # down tunnel — VERDICT r2 weak item 1). Requires the verified FULL
+    # record: the compact stdout shape must never land in
+    # latest_onchip.json (its consumers read the nested extras).
     if (
         proc.returncode == 0
-        and rec.get("record", {}).get("extra", {}).get("platform")
-        not in (None, "cpu")
+        and isinstance(full, dict)
+        and full.get("extra", {}).get("platform") not in (None, "cpu")
     ):
         latest = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "head": _git_head(),
             "source": "full bench.py run on the real chip "
                       "(benchmarks/records/_r3_measure.py phase 1)",
-            "record": rec["record"],
+            "record": full,
         }
         path = os.path.join(HERE, "latest_onchip.json")
         with open(path + ".tmp", "w") as f:
@@ -265,7 +293,10 @@ def phase_lean_scaling() -> dict:
             failures.append({"n": n, "error": repr(exc)[:300]})
             log(f"lean n={n} FAILED: {exc!r}")
             break
-        from aiocluster_tpu.ops.gossip import pallas_variant_engaged
+        from aiocluster_tpu.ops.gossip import (
+            pallas_variant_engaged,
+            resolve_variant_env,
+        )
         from aiocluster_tpu.ops.pallas_pull import pairs_nbuf
 
         points.append(
@@ -276,8 +307,10 @@ def phase_lean_scaling() -> dict:
              # a different variant (canary pin lifted/applied) and the
              # projection must charge the pass count — and anchor on
              # the scratch-rotation regime — that actually produced
-             # this rate.
-             "kernel_variant": pallas_variant_engaged(_lean(n)),
+             # this rate. The env pin resolves at Simulator
+             # construction, so the record applies the same resolution.
+             "kernel_variant": pallas_variant_engaged(
+                 resolve_variant_env(_lean(n))),
              "kernel_nbuf": pairs_nbuf(n, 2, track_hb=False)}
         )
         log(f"lean n={n}: converged {rounds} rounds, {rate} rounds/s")
@@ -387,8 +420,12 @@ def _northstar_projection(points: list[dict]) -> dict:
     # The MULTI-shard config runs the two-pass sharded form; charge the
     # projection its pass count honestly. The (N,) f32 psum between
     # passes is noise next to the N^2/8 block traffic.
+    from aiocluster_tpu.ops.gossip import resolve_variant_env as _resolve
+
+    # Resolved through the env pin: a canary-pinned battery must project
+    # the pinned (proven) kernel's pass count, not the aspirational one.
     star_variant = pallas_variant_engaged(
-        _lean(n_star), "owners", n_star // 8
+        _resolve(_lean(n_star)), "owners", n_star // 8
     )
     star_passes = 3 if star_variant == "pairs" else 5
     shard_bytes_100k = 3 * star_passes * n_star**2 * 2 / 8

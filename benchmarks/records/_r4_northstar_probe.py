@@ -1,0 +1,79 @@
+"""Round-4 probe: per-round cost of the UNSHARDED single-device XLA path
+at the config-5 population (100,352 lean) on this CPU host.
+
+Rationale: the trajectory is bit-identical between the 8-way mesh and a
+single device (tests/test_sim_sharded.py), so the exact
+rounds-to-convergence R for BASELINE config 5 can be measured on
+whichever layout steps fastest on a 1-core host. The mesh path measured
+~960 s/round (r3_northstar_100k_execution.json: 2 rounds + compile =
+3121 s, collectives rendezvous across 8 time-shared virtual devices);
+this probe times the same math without the virtual-device tax.
+
+Prints one JSON line; builder-side tooling (not part of the package).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop any forced virtual device count: this probe is single-device.
+os.environ["XLA_FLAGS"] = " ".join(
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if not f.startswith("--xla_force_host_platform_device_count")
+)
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+cache_dir = os.environ.get("NORTHSTAR_CACHE", "/tmp/northstar_xla_cache")
+os.makedirs(cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
+import numpy as np  # noqa: E402
+
+from aiocluster_tpu.sim import Simulator, budget_from_mtu  # noqa: E402
+from aiocluster_tpu.sim.memory import lean_config  # noqa: E402
+
+
+def main() -> None:
+    n = 100_352
+    cfg = lean_config(n, budget=budget_from_mtu(65_507))
+    t0 = time.perf_counter()
+    # chunk=1 so each run(1) is one round; tracked path comes later.
+    sim = Simulator(cfg, seed=1, chunk=1)
+    init_s = time.perf_counter() - t0
+    print(f"[probe] init {init_s:.1f}s", file=sys.stderr, flush=True)
+
+    times = []
+    for r in range(4):
+        t0 = time.perf_counter()
+        sim.run(1)
+        int(np.asarray(sim.state.tick))
+        dt = time.perf_counter() - t0
+        times.append(round(dt, 1))
+        print(f"[probe] round {r + 1}: {dt:.1f}s", file=sys.stderr, flush=True)
+
+    # One tracked round (the convergence run pays the extra read of w).
+    t0 = time.perf_counter()
+    first = sim.run_until_converged(max_rounds=int(sim.state.tick) + 1)
+    tracked_s = time.perf_counter() - t0
+    print(json.dumps({
+        "n": n,
+        "init_s": round(init_s, 1),
+        "round_s": times,
+        "tracked_round_s": round(tracked_s, 1),
+        "mean_fraction_after": float(sim.metrics()["mean_fraction"]),
+        "first": first,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,117 @@ def test_helper_accepts_log_kwarg_for_target():
     sig.bind("m", "f", print, 64, log=print)  # raises on the collision
 
 
+def _worst_case_result():
+    """A full record bloated the way round 3's actually was: embedded
+    on-chip record, measured reference baseline, long notes — everything
+    that overgrew the stdout line into BENCH_r03.json's unparseable
+    tail."""
+    onchip = bench.load_last_onchip_record(lambda _m: None)
+    return {
+        "metric": "sim_gossip_rounds_per_sec@10240_nodes",
+        "value": 12.3,
+        "unit": "rounds/s",
+        "vs_baseline": 61728.4,
+        "extra": {
+            "platform": "cpu",
+            "tpu_note": (
+                "accelerator unreachable at run time; last on-chip "
+                "record: benchmarks/records/ (see its README for "
+                "provenance)"
+            ),
+            "last_onchip": onchip,
+            "rounds_to_convergence": 24,
+            "baseline_kind": "extrapolated_python_object_model_estimate",
+            "python_object_model_rounds_per_sec_est": 0.0002,
+            "anchored_asyncio_3node_convergence_s": 0.0274,
+            "measured_reference_library": {
+                "kind": "measured_reference_library",
+                "source": "/root/reference run live in-process",
+                "at_test_interval": {
+                    "n_nodes": 64,
+                    "keys_per_node": 16,
+                    "gossip_interval_s": 0.02,
+                    "convergence_seconds": 10.5,
+                    "sim_equivalent_rounds_per_sec": 1.44,
+                    "node_rounds_counted": 286,
+                },
+                "compute_bound_ceiling": {
+                    "n_nodes": 64,
+                    "gossip_interval_s": 0.001,
+                    "convergence_seconds": 3.5,
+                    "sim_equivalent_rounds_per_sec": 1.12,
+                },
+            },
+            "keys_per_node": 16,
+            "fanout": 3,
+            "budget": 2618,
+            "budget_source": "exact wire-size budget of the reference 65507B MTU",
+            "failure_detector": True,
+            "version_dtype": "int16",
+            "heartbeat_dtype": "int16",
+            "fd_dtype": "bfloat16",
+            "max_scale_single_chip": {
+                "nodes": 32_768, "profile": "lean", "rounds_per_sec": 14.6,
+            },
+            "max_scale_single_chip_measured_boundary": {
+                "nodes": 65_536, "planner_limit_nodes": 65_536,
+                "profile": "lean", "rounds_per_sec": 6.1,
+            },
+            "fd_kernel": False,
+            "xla_path_rounds_per_sec": 43.2,
+            "pallas_speedup": 1.56,
+            "pallas_variant_engaged": "pairs",
+            "roofline": {
+                "bytes_per_round": 5_662_310_400,
+                "achieved_gb_per_sec": 382.2,
+                "device_kind": "TPU v5 lite",
+                "hbm_peak_gb_per_sec": 819.0,
+                "fraction_of_peak": 0.467,
+            },
+        },
+    }
+
+
+def test_stdout_line_stays_under_cap():
+    """Round-3 failure mode: the stdout record outgrew the driver's
+    capture and the round's official artifact had no parseable headline
+    (BENCH_r03.json "parsed": null). The compact line must stay under
+    the cap even for the most bloated record bench can produce, and
+    must keep the required headline fields."""
+    line_obj = bench.compact_record(
+        _worst_case_result(), "benchmarks/records/bench_last_run.json"
+    )
+    line = json.dumps(line_obj)
+    assert len(line) <= bench.STDOUT_LINE_CAP, len(line)
+    parsed = json.loads(line)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in parsed, key
+    # The essentials of the compact extra survive.
+    ex = parsed["extra"]
+    assert ex["platform"] == "cpu"
+    assert ex["pallas_speedup"] == 1.56
+    assert ex["roofline_fraction_of_peak"] == 0.467
+    assert ex["max_scale_nodes"] == 65_536
+    assert ex["full_record"] == "benchmarks/records/bench_last_run.json"
+    # The on-chip pointer survives a CPU fallback as scalars.
+    assert ex["last_onchip_value"] > 1
+    # And no nested structures sneak back in (flat extras only).
+    assert all(not isinstance(v, (dict, list)) for v in ex.values())
+
+
+def test_cap_enforcement_sacrifices_not_headline():
+    """Even a pathologically bloated extra cannot push the line past the
+    cap or drop the headline fields — the sacrifice order sheds
+    provenance keys instead."""
+    result = _worst_case_result()
+    result["extra"]["tpu_note"] = "x" * 3000  # absurd, but must not break
+    line_obj = bench.compact_record(result, "p")
+    line = json.dumps(line_obj)
+    assert len(line) <= bench.STDOUT_LINE_CAP, len(line)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in line_obj
+
+
 def test_latest_onchip_has_provenance():
     path = os.path.join(REPO, "benchmarks", "records", "latest_onchip.json")
     with open(path) as f:

@@ -59,3 +59,33 @@ def test_fit_population_respects_budget():
         sys.path.remove(repo)
 
     assert bench.MAX_LEAN_SINGLE_CHIP == n1
+
+
+def test_plan_charges_hb_transient_on_fd_pairs_path(monkeypatch):
+    """On the pairs kernel path the planner may claim zero transients
+    only for heartbeat-free profiles: FD configs retain the round-start
+    heartbeat matrix (gossip.py skips alias_hb on the round's first
+    sub-exchange), so a second full (N, N) hb matrix is live at peak
+    (ADVICE r3, medium)."""
+    # plan() folds the env override; a leftover battery pin must not
+    # steer this test off the pairs path.
+    monkeypatch.delenv("AIOCLUSTER_TPU_PALLAS_VARIANT", raising=False)
+    from aiocluster_tpu.ops.gossip import (
+        pallas_path_engaged,
+        pallas_variant_engaged,
+    )
+    from aiocluster_tpu.sim import SimConfig
+    from aiocluster_tpu.sim.memory import lean_config, plan
+
+    n = 10_240
+    cfg = SimConfig(
+        n_nodes=n, keys_per_node=16, fanout=3, budget=2618,
+        version_dtype="int16", heartbeat_dtype="int16", fd_dtype="bfloat16",
+    )
+    # The headline config must actually be on the pairs path for this
+    # test to pin anything.
+    assert pallas_path_engaged(cfg, assume_accelerator=True)
+    assert pallas_variant_engaged(cfg) == "pairs"
+    assert plan(cfg).transient_bytes == n * n * 2  # retained hb, int16
+    # The lean (no-FD, no-hb) profile keeps the zero-transient claim.
+    assert plan(lean_config(n)).transient_bytes == 0

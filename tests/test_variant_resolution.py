@@ -1,0 +1,97 @@
+"""The AIOCLUSTER_TPU_PALLAS_VARIANT override is folded into the config
+once, at construction (ops/gossip.py::resolve_variant_env) — never read
+at trace time — so the resolved kernel variant is always part of the jit
+static cache key and provenance can't drift from dispatch (ADVICE r3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from aiocluster_tpu.ops.gossip import (
+    pallas_variant_engaged,
+    resolve_variant_env,
+)
+from aiocluster_tpu.sim import SimConfig, Simulator
+
+ENV = "AIOCLUSTER_TPU_PALLAS_VARIANT"
+
+
+def _cfg(**kw):
+    base = dict(n_nodes=256, keys_per_node=4, fanout=2, budget=24)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def test_no_env_is_identity(monkeypatch):
+    monkeypatch.delenv(ENV, raising=False)
+    cfg = _cfg()
+    assert resolve_variant_env(cfg) is cfg
+
+
+def test_env_overrides_auto(monkeypatch):
+    monkeypatch.setenv(ENV, "m8")
+    assert resolve_variant_env(_cfg()).pallas_variant == "m8"
+    monkeypatch.setenv(ENV, "pairs")
+    assert resolve_variant_env(_cfg()).pallas_variant == "pairs"
+
+
+def test_explicit_cfg_beats_env(monkeypatch):
+    """bench.py's warm-up fallback pins pallas_variant='m8' explicitly;
+    an exported 'pairs' override must not silently re-dispatch the
+    kernel the fallback is escaping from (ADVICE r3, low)."""
+    monkeypatch.setenv(ENV, "pairs")
+    cfg = _cfg(pallas_variant="m8")
+    assert resolve_variant_env(cfg) is cfg
+
+
+def test_env_auto_is_identity(monkeypatch):
+    monkeypatch.setenv(ENV, "auto")
+    cfg = _cfg()
+    assert resolve_variant_env(cfg) is cfg
+
+
+def test_bogus_env_raises_loudly(monkeypatch):
+    monkeypatch.setenv(ENV, "par1s")
+    with pytest.raises(ValueError, match="must be auto/m8/pairs"):
+        resolve_variant_env(_cfg())
+
+
+def test_simulator_folds_env_into_cfg(monkeypatch):
+    """The Simulator's stored config — the jit static argument — carries
+    the resolved variant, so flipping the env var after construction
+    cannot desynchronise the compiled kernel from recorded provenance."""
+    monkeypatch.setenv(ENV, "m8")
+    sim = Simulator(_cfg(), seed=0, chunk=2)
+    assert sim.cfg.pallas_variant == "m8"
+    monkeypatch.setenv(ENV, "pairs")  # too late by design
+    assert sim.cfg.pallas_variant == "m8"
+    assert pallas_variant_engaged(sim.cfg) == "m8"
+
+
+def test_variant_engaged_is_pure_wrt_env(monkeypatch):
+    """pallas_variant_engaged (called at trace time inside sim_step) must
+    not consult the environment at all."""
+    cfg = _cfg(use_pallas=True)
+    monkeypatch.delenv(ENV, raising=False)
+    base = pallas_variant_engaged(cfg)
+    monkeypatch.setenv(ENV, "m8" if base == "pairs" else "pairs")
+    assert pallas_variant_engaged(cfg) == base
+
+
+def test_pinned_simulator_trajectory_matches_explicit(monkeypatch):
+    """End-to-end: an env-pinned 'm8' run equals an explicitly configured
+    m8 run bit-for-bit (they are the same static config now)."""
+    monkeypatch.setenv(ENV, "m8")
+    pinned = Simulator(_cfg(use_pallas=True), seed=3, chunk=2)
+    monkeypatch.delenv(ENV, raising=False)
+    explicit = Simulator(
+        _cfg(use_pallas=True, pallas_variant="m8"), seed=3, chunk=2
+    )
+    assert pinned.cfg == explicit.cfg
+    pinned.run(4)
+    explicit.run(4)
+    np.testing.assert_array_equal(
+        np.asarray(pinned.state.w), np.asarray(explicit.state.w)
+    )
