@@ -18,6 +18,8 @@ one under the default 'matching').
 
 from __future__ import annotations
 
+import json
+import os
 from dataclasses import dataclass
 
 import jax.numpy as jnp
@@ -41,6 +43,29 @@ class MemoryPlan:
     def fits(self, hbm_bytes_per_chip: int = 16 * 1024**3) -> bool:
         # Leave 20% headroom for XLA scratch and fusion temporaries.
         return self.per_shard_bytes <= int(hbm_bytes_per_chip * 0.8)
+
+
+def engaged_variant(cfg: SimConfig, shards: int = 1) -> str:
+    """Which pull path would actually dispatch for ``cfg`` on the chip:
+    "pairs", "m8", or "xla". THE single resolution shared by the
+    analytic plan and the measured-boundary key — the two must never
+    key memory behavior off different answers. Resolves the env
+    override and "auto" as if on the accelerator (planning hosts must
+    agree with the chip)."""
+    from ..ops.gossip import (
+        pallas_path_engaged,
+        pallas_variant_engaged,
+        resolve_variant_env,
+    )
+
+    cfg = resolve_variant_env(cfg)
+    axis = None if shards == 1 else "owners"
+    n_local = cfg.n_nodes // shards
+    if not pallas_path_engaged(
+        cfg, axis, n_local=n_local, assume_accelerator=True
+    ):
+        return "xla"
+    return pallas_variant_engaged(cfg, axis, n_local)
 
 
 def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
@@ -68,23 +93,12 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
     # The pair-fused kernel path updates w/hb IN PLACE
     # (input_output_aliases) and never materializes a gather: its
     # steady-state peak is the resident state alone. Decided by the
-    # same gates sim_step dispatches on (env override folded in first,
-    # so the plan matches what would actually dispatch), resolving
-    # "auto" AS IF on the accelerator — the planner answers "will it
-    # fit the chip?" and must give the same answer from a CPU planning
-    # host (tests/test_benchmarks.py pins it to bench's constant).
-    from ..ops.gossip import (
-        pallas_path_engaged,
-        pallas_variant_engaged,
-        resolve_variant_env,
-    )
-
-    cfg = resolve_variant_env(cfg)
-    axis = None if shards == 1 else "owners"
-    n_local = n // shards
-    if pallas_path_engaged(
-        cfg, axis, n_local=n_local, assume_accelerator=True
-    ) and pallas_variant_engaged(cfg, axis, n_local) == "pairs":
+    # same resolution sim_step dispatches on (engaged_variant: env
+    # override folded in, "auto" resolved as if on the accelerator) —
+    # the planner answers "will it fit the chip?" and must give the
+    # same answer from a CPU planning host (tests/test_benchmarks.py
+    # pins it to bench's constant).
+    if engaged_variant(cfg, shards) == "pairs":
         # FD configs retain the round-start heartbeat matrix for the
         # phi phase, so the first sub-exchange does NOT alias hb
         # (gossip.py alias_hb) — a second full (N, N) heartbeat matrix
@@ -95,6 +109,161 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
         else:
             transient = 0
     return MemoryPlan(n, state, transient, shards)
+
+
+# -- measured fit/no-fit boundaries -------------------------------------------
+#
+# Round-3 lesson (window 1): the model said a 52,096-node lean sim fits
+# one 16 GiB chip with 20% headroom; the chip said RESOURCE_EXHAUSTED.
+# Every on-chip run therefore persists its fit/no-fit outcome here, and
+# the planner consults the measured table BEFORE trusting the model.
+# Entries are keyed by the execution path that produced them — kernel
+# variant + profile dtypes/flags + shard count — because memory behavior
+# is a property of the compiled program, not of n alone (the 52k OOM ran
+# the non-aliased single-pass path; it says nothing about the in-place
+# pairs path). Within one key group, fit is monotone in n_nodes.
+#
+# The table ships WITH the package (calibration data versioned next to
+# the model it corrects); builder tooling appends to it in-repo.
+
+_BOUNDARIES_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "measured_boundaries.json"
+)
+
+
+def _boundary_key(
+    cfg: SimConfig, shards: int, hbm_bytes_per_chip: int
+) -> dict:
+    """The signature a measured verdict is valid for: the execution
+    path (kernel variant + profile + shards) AND the chip capacity it
+    was observed on — a 16 GiB no-fit says nothing about a 32 GiB
+    part."""
+    return {
+        "variant": engaged_variant(cfg, shards),
+        "version_dtype": cfg.version_dtype,
+        "heartbeat_dtype": cfg.heartbeat_dtype if cfg.track_heartbeats else None,
+        "fd_dtype": cfg.fd_dtype if cfg.track_failure_detector else None,
+        "track_heartbeats": cfg.track_heartbeats,
+        "track_failure_detector": cfg.track_failure_detector,
+        "pairing": cfg.pairing,
+        "shards": shards,
+        "hbm_bytes_per_chip": hbm_bytes_per_chip,
+    }
+
+
+def load_boundaries(path: str | None = None) -> list[dict]:
+    try:
+        with open(path or _BOUNDARIES_PATH) as f:
+            return json.load(f)["entries"]
+    except Exception:
+        return []
+
+
+def record_boundary(
+    cfg: SimConfig,
+    shards: int,
+    fits: bool,
+    *,
+    rounds_per_sec: float | None = None,
+    source: str = "",
+    path: str | None = None,
+    hbm_bytes_per_chip: int = 16 * 1024**3,
+) -> dict:
+    """Append one measured fit/no-fit outcome (atomic rewrite under an
+    inter-process lock — the bench ladder and the battery can both run
+    inside one tunnel window and a lost entry would be a lost hardware
+    fact). Returns the entry. Callers: bench.py's max-scale ladder and
+    the measurement battery, after every on-chip attempt."""
+    import fcntl
+    import time
+
+    path = path or _BOUNDARIES_PATH
+    entry = {
+        **_boundary_key(cfg, shards, hbm_bytes_per_chip),
+        "n_nodes": cfg.n_nodes,
+        "fits": bool(fits),
+        "rounds_per_sec": rounds_per_sec,
+        "source": source,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(path + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        entries = load_boundaries(path)  # re-read under the lock
+        entries.append(entry)
+        payload = {
+            "note": "Measured single-run fit/no-fit outcomes, keyed by "
+            "the execution path (kernel variant, profile, shards) and "
+            "chip capacity. Consulted by sim.memory.fits_verdict before "
+            "the analytic model is trusted.",
+            "entries": entries,
+        }
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+    return entry
+
+
+def fits_verdict(
+    cfg: SimConfig,
+    shards: int = 1,
+    hbm_bytes_per_chip: int = 16 * 1024**3,
+    path: str | None = None,
+) -> dict:
+    """Will this config fit one chip's HBM — measured evidence first,
+    model second.
+
+    Returns ``{"fits", "measured", "evidence", "model_fits",
+    "per_shard_bytes"}``: ``measured=True`` when an on-chip outcome for
+    the same execution path AND chip capacity decides it (a recorded
+    fit at n >= ours ⇒ fits; a recorded OOM at n <= ours ⇒ doesn't —
+    memory use is monotone in n within a path). When fit and OOM
+    evidence contradict each other (physically impossible under
+    monotonicity — one of them was flaky or predates a fix), the more
+    RECENT observation wins, so a transient OOM cannot poison the
+    table forever: the next successful run at that size self-corrects
+    it. Otherwise the analytic MemoryPlan answers, flagged
+    ``measured=False`` so consumers (bench, README claims) can label
+    planner-derived numbers honestly."""
+    p = plan(cfg, shards)
+    key = _boundary_key(cfg, shards, hbm_bytes_per_chip)
+    # Latest-per-n first: re-measuring a rung supersedes its old verdict.
+    latest: dict[int, dict] = {}
+    for e in load_boundaries(path):
+        if any(e.get(k) != v for k, v in key.items()):
+            continue
+        n = e["n_nodes"]
+        if n not in latest or e.get("ts", "") >= latest[n].get("ts", ""):
+            latest[n] = e
+    fit_ev = oom_ev = None
+    for e in latest.values():
+        if e["fits"] and e["n_nodes"] >= cfg.n_nodes:
+            if fit_ev is None or e["n_nodes"] < fit_ev["n_nodes"]:
+                fit_ev = e
+        if not e["fits"] and e["n_nodes"] <= cfg.n_nodes:
+            if oom_ev is None or e["n_nodes"] > oom_ev["n_nodes"]:
+                oom_ev = e
+    model_fits = p.fits(hbm_bytes_per_chip)
+    if oom_ev is not None and fit_ev is not None:
+        # Contradiction (OOM below a fit): recency decides; an exact
+        # timestamp tie stays conservative (OOM).
+        if fit_ev.get("ts", "") > oom_ev.get("ts", ""):
+            verdict, measured, evidence = True, True, fit_ev
+        else:
+            verdict, measured, evidence = False, True, oom_ev
+    elif oom_ev is not None:
+        verdict, measured, evidence = False, True, oom_ev
+    elif fit_ev is not None:
+        verdict, measured, evidence = True, True, fit_ev
+    else:
+        verdict, measured, evidence = model_fits, False, None
+    return {
+        "fits": verdict,
+        "measured": measured,
+        "evidence": evidence,
+        "model_fits": model_fits,
+        "per_shard_bytes": p.per_shard_bytes,
+    }
 
 
 def lean_config(n_nodes: int, **overrides) -> SimConfig:

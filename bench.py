@@ -636,6 +636,25 @@ def sim_rounds_per_sec(
 MAX_LEAN_SINGLE_CHIP = 65_536
 
 
+def _planner_verdict_summary(log) -> dict | None:
+    """fits_verdict for the single-chip lean ceiling, compacted for the
+    record: carries the measured/model provenance split."""
+    try:
+        from aiocluster_tpu.sim.memory import fits_verdict, lean_config
+
+        v = fits_verdict(lean_config(MAX_LEAN_SINGLE_CHIP))
+        return {
+            "nodes": MAX_LEAN_SINGLE_CHIP,
+            "fits": v["fits"],
+            "measured": v["measured"],
+            "evidence_source": (v["evidence"] or {}).get("source"),
+            "per_shard_bytes": v["per_shard_bytes"],
+        }
+    except Exception as exc:
+        log(f"planner verdict unavailable: {exc!r}")
+        return None
+
+
 def scale_probe(log, n_nodes: int = 32_768, rounds: int = 16) -> float:
     """Max single-chip scale: the lean convergence profile (int16
     watermarks, no FD matrices — sim/memory.py) at the largest N that fits
@@ -645,10 +664,15 @@ def scale_probe(log, n_nodes: int = 32_768, rounds: int = 16) -> float:
     import numpy as np
 
     from aiocluster_tpu.sim import Simulator
-    from aiocluster_tpu.sim.memory import lean_config, plan
+    from aiocluster_tpu.sim.memory import fits_verdict, lean_config
 
     cfg = lean_config(n_nodes)
-    assert plan(cfg).fits(), "probe config must fit one chip"
+    # Advisory only: the chip is the authority on fit (the ladder exists
+    # because the model has been wrong) — an AssertionError here would
+    # kill the whole ladder instead of letting the rung OOM and walk on.
+    v = fits_verdict(cfg)
+    log(f"scale probe @ {n_nodes}: planner says fits={v['fits']} "
+        f"(measured={v['measured']})")
     sim = Simulator(cfg, seed=0, chunk=8)
     t0 = time.perf_counter()
     sim.run(8)
@@ -729,29 +753,59 @@ def main() -> None:
         probe_max_rps = None
         probe_max_n = None
         if not args.smoke and on_accel:
+            from aiocluster_tpu.sim.memory import (
+                fits_verdict,
+                lean_config,
+                record_boundary,
+            )
+
+            def note_boundary(n, fits, rps=None):
+                # Every on-chip outcome calibrates the planner (round-3
+                # lesson: the model's 52k claim OOM'd). CPU runs never
+                # reach here — only chip outcomes enter the table.
+                try:
+                    record_boundary(
+                        lean_config(n), 1, fits, rounds_per_sec=rps,
+                        source="bench.py max-scale ladder (on-chip)",
+                    )
+                except Exception as exc:
+                    log(f"boundary record failed: {exc!r}")
+
             try:
                 probe_rps = round(scale_probe(log), 2)
+                note_boundary(32_768, True, probe_rps)
             except Exception as exc:  # keep the headline even if the probe dies
                 log(f"scale probe failed: {exc!r}")
+                if _is_oom(exc):
+                    note_boundary(32_768, False)
             # Walk the 128-aligned ladder down from the in-place pairs
             # ceiling (65,536 — one resident copy) to the largest N
             # that actually executes and record that boundary; 52,096
             # is the old two-copy claim the chip OOM'd on. Each rung
             # pays a full compile, so stop while the watchdog still
-            # has room to emit the measurements already taken.
+            # has room to emit the measurements already taken. Rungs
+            # the measured table already rules out are skipped (the
+            # planner consults hardware truth before the model).
             for probe_n in (MAX_LEAN_SINGLE_CHIP, 61_440, 57_344, 52_096,
                             45_056):
                 if time.perf_counter() - t_main > WATCHDOG_S - 600:
                     log("max-scale ladder stopped: watchdog budget low")
                     break
+                verdict = fits_verdict(lean_config(probe_n))
+                if verdict["measured"] and not verdict["fits"]:
+                    log(f"max-scale rung {probe_n} skipped: measured "
+                        f"no-fit ({verdict['evidence']['source']})")
+                    continue
                 try:
                     probe_max_rps = round(scale_probe(log, n_nodes=probe_n), 2)
                     probe_max_n = probe_n
+                    note_boundary(probe_n, True, probe_max_rps)
                     break
                 except Exception as exc:
                     log(f"max-scale probe at {probe_n} failed: {exc!r}")
                     if not _is_oom(exc):
                         break  # not an OOM — don't hammer a sick tunnel
+                    note_boundary(probe_n, False)
         anchored = None if args.smoke else anchored_asyncio_seconds(log)
         ref_measured = None if args.smoke else measured_reference_baseline(log)
         # A CPU-fallback record is still a valid run, but its headline is
@@ -806,6 +860,14 @@ def main() -> None:
                     }
                     if probe_max_rps is not None
                     else None
+                ),
+                # Planner verdict for the ceiling claim, with measured
+                # provenance: "measured": false labels a number still
+                # resting on the analytic model alone (round-3 lesson).
+                "max_scale_planner_verdict": (
+                    None
+                    if args.smoke
+                    else _planner_verdict_summary(log)
                 ),
                 **sim_extra,
             },

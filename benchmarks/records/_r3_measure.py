@@ -337,6 +337,18 @@ def phase_max_scale() -> dict:
     chunk actually executes, and record the boundary so the planner's
     headroom can be calibrated to hardware truth."""
     from aiocluster_tpu.sim import Simulator
+    from aiocluster_tpu.sim.memory import record_boundary
+
+    def note_boundary(n, fits, rps=None):
+        # Calibrate the planner with every on-chip outcome (the battery
+        # only runs when the tunnel is up, so these are chip verdicts).
+        try:
+            record_boundary(
+                _lean(n), 1, fits, rounds_per_sec=rps,
+                source="battery max_scale phase (on-chip)",
+            )
+        except Exception as exc:
+            log(f"boundary record failed: {exc!r}")
 
     tried = []
     largest = None
@@ -351,6 +363,7 @@ def phase_max_scale() -> dict:
             rate = _rate(sim, rounds=32, chunk=8, trials=2)
             tried.append({"n": n, "ok": True, "rounds_per_sec": rate})
             largest = n
+            note_boundary(n, True, rate)
             log(f"max-scale: n={n} fits, {rate} rounds/s")
             break
         except Exception as exc:
@@ -364,6 +377,7 @@ def phase_max_scale() -> dict:
                 and "out of memory" not in low
             ):
                 break  # not an OOM — don't keep hammering a down tunnel
+            note_boundary(n, False)
     if largest is None:
         # No rung executed (all OOM, or a transient non-OOM failure):
         # the boundary is NOT measured — carry an error so the next

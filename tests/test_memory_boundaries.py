@@ -1,0 +1,136 @@
+"""The planner consults measured fit/no-fit boundaries before trusting
+its analytic model (round-3 lesson: the model's 52,096-node claim OOM'd
+on the chip). Verdicts carry measured/model provenance and are scoped to
+the execution path that produced the evidence."""
+
+from __future__ import annotations
+
+import pytest
+
+from aiocluster_tpu.sim.memory import (
+    fits_verdict,
+    lean_config,
+    load_boundaries,
+    record_boundary,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_variant_pin(monkeypatch):
+    monkeypatch.delenv("AIOCLUSTER_TPU_PALLAS_VARIANT", raising=False)
+
+
+def _lean_m8(n):
+    return lean_config(n, pallas_variant="m8")
+
+
+def test_seed_table_loads():
+    entries = load_boundaries()
+    assert len(entries) >= 3
+    assert any(e["fits"] is False and e["n_nodes"] == 52_096 for e in entries)
+
+
+def test_measured_fit_below_recorded_fit():
+    """32,768 lean fit on the m8 path (window 1) covers every smaller n
+    on the same path."""
+    v = fits_verdict(_lean_m8(25_600))
+    assert v["fits"] is True and v["measured"] is True
+    assert v["evidence"]["n_nodes"] == 32_768
+
+
+def test_measured_oom_above_recorded_oom():
+    """The chip's 52,096 RESOURCE_EXHAUSTED on the non-aliased m8 path
+    rules out every larger n on that path — whatever the model says."""
+    v = fits_verdict(_lean_m8(56_064))
+    assert v["fits"] is False and v["measured"] is True
+    assert v["evidence"]["n_nodes"] == 52_096
+
+
+def test_different_path_falls_back_to_model():
+    """The m8 OOM says nothing about the in-place pairs path: a pairs
+    query between the boundaries gets the model answer, labelled
+    unmeasured — exactly the provenance split the round-3 OOM taught."""
+    v = fits_verdict(lean_config(52_096))  # auto -> pairs path
+    assert v["measured"] is False
+    assert v["evidence"] is None
+    assert v["fits"] == v["model_fits"]
+
+
+def test_between_boundaries_is_model(tmp_path):
+    v = fits_verdict(_lean_m8(40_960))  # above 32,768 fit, below 52,096 OOM
+    assert v["measured"] is False
+
+
+def test_record_and_conflict_resolution(tmp_path):
+    """New outcomes are appended atomically; a measured OOM at or below
+    a queried n beats a larger recorded fit (conservative read)."""
+    path = str(tmp_path / "b.json")
+    cfg = _lean_m8(12_800)
+    record_boundary(cfg, 1, True, rounds_per_sec=99.0,
+                    source="test", path=path)
+    v = fits_verdict(_lean_m8(12_800), path=path)
+    assert v["fits"] is True and v["measured"] is True
+    assert v["evidence"]["rounds_per_sec"] == 99.0
+    # Conflicting evidence: a smaller OOM wins over the larger fit.
+    record_boundary(_lean_m8(6_400), 1, False, source="test", path=path)
+    v2 = fits_verdict(_lean_m8(9_600), path=path)
+    assert v2["fits"] is False and v2["measured"] is True
+    assert v2["evidence"]["n_nodes"] == 6_400
+
+
+def test_shards_scope_evidence(tmp_path):
+    """Evidence at shards=1 never answers a shards=8 query."""
+    path = str(tmp_path / "b.json")
+    record_boundary(_lean_m8(12_800), 1, True, source="test", path=path)
+    v = fits_verdict(_lean_m8(12_800), shards=8, path=path)
+    assert v["measured"] is False
+
+
+def test_hbm_capacity_scopes_evidence(tmp_path):
+    """A 16 GiB no-fit says nothing about a 32 GiB part: the verdict for
+    a different chip capacity falls back to the model (computed with
+    THAT capacity)."""
+    path = str(tmp_path / "b.json")
+    record_boundary(_lean_m8(52_096), 1, False, source="test", path=path)
+    v16 = fits_verdict(_lean_m8(52_096), path=path)
+    assert v16["measured"] is True and v16["fits"] is False
+    v32 = fits_verdict(
+        _lean_m8(52_096), hbm_bytes_per_chip=32 * 1024**3, path=path
+    )
+    assert v32["measured"] is False
+    assert v32["fits"] == v32["model_fits"] is True
+
+
+def test_recency_self_corrects_flaky_oom(tmp_path, monkeypatch):
+    """A transient OOM must not poison the table forever: a LATER
+    successful run at >= that size supersedes it (and vice versa), so
+    bench's measured-skip can never permanently retire a rung that
+    actually works."""
+    import time as time_mod
+
+    import aiocluster_tpu.sim.memory as memory
+
+    path = str(tmp_path / "b.json")
+    stamps = iter(
+        ["2026-07-31T01:00:00Z", "2026-07-31T02:00:00Z",
+         "2026-07-31T03:00:00Z"]
+    )
+    monkeypatch.setattr(
+        memory, "_BOUNDARIES_PATH", path, raising=True
+    )
+    monkeypatch.setattr(
+        time_mod, "strftime", lambda *_a: next(stamps), raising=True
+    )
+    record_boundary(_lean_m8(52_096), 1, False, source="flaky", path=path)
+    v = fits_verdict(_lean_m8(52_096), path=path)
+    assert v["fits"] is False and v["measured"] is True
+    # The battery later runs the same size successfully.
+    record_boundary(_lean_m8(52_096), 1, True, rounds_per_sec=6.0,
+                    source="retry", path=path)
+    v2 = fits_verdict(_lean_m8(52_096), path=path)
+    assert v2["fits"] is True and v2["measured"] is True
+    assert v2["evidence"]["source"] == "retry"
+    # And a later OOM wins back (code change regressed memory, say).
+    record_boundary(_lean_m8(52_096), 1, False, source="regress", path=path)
+    v3 = fits_verdict(_lean_m8(52_096), path=path)
+    assert v3["fits"] is False and v3["evidence"]["source"] == "regress"
