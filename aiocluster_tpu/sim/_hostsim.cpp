@@ -1,0 +1,284 @@
+// Native host fast-path for the lean matching gossip round.
+//
+// Reproduces ops/gossip.py::sim_step's matching sub-exchange BIT-EXACTLY
+// for the lean profile (int16 watermarks — held here as lossless int8,
+// see acg_hostsim_subexchange; no heartbeats/FD, no churn, proportional
+// budget): pair (a, b) of the involution advances both rows
+// toward each other under the budgeted watermark advance
+// (gossip.py::_budgeted_advance), including the f32 proportional scaling
+// and the multiplicative-hash dithered rounding (gossip.py::_hash_uniform,
+// bits=24). Every float operation below mirrors one XLA elementwise op:
+//   d     = max(w_send - w_recv, 0)                    (int16 math)
+//   total = sum(d)              exact: integer < 2^24, so the f32 sum
+//                               XLA performs is order-independent and
+//                               equals this int32 accumulation
+//   scale = min(1f, (float)budget / max((float)total, 1f))
+//   x     = (float)d * scale                           (one f32 rounding)
+//   fl    = floorf(x); frac = x - fl                   (exact)
+//   u     = clip((float)(int32)(h >> 8) * 2^-24, 1e-12f, 1 - 2^-24)
+//   adv   = min((int32)fl + (u < frac), (int32)d)
+//
+// Why this exists: the XLA CPU path at the 100k-node config-5 scale runs
+// ~10^3 s/round on a 1-core host (virtual-mesh collectives or not), which
+// makes exact rounds-to-convergence unmeasurable there; this kernel walks
+// the identical trajectory at ~10^1-10^2 s/round, and the XLA path then
+// certifies the final round from a checkpoint (see sim/hostsim.py).
+// Single-threaded by design (the builder host has one core); the j-loops
+// are written branch-light so the compiler can vectorize.
+//
+// Reference anchors (jettify/aiocluster): the round being simulated is
+// server.py:378-495 (gossip round) with state.py:340-415's MTU-bounded
+// delta collapsed into the budgeted watermark advance.
+
+#include <cstdint>
+#include <cmath>
+
+#ifdef __AVX2__
+#include <immintrin.h>
+#endif
+
+namespace {
+
+// gossip.py::_hash_uniform constants (bits=24 path).
+constexpr uint32_t K1 = 0x9E3779B1u;
+constexpr uint32_t K2 = 0x85EBCA77u;
+constexpr uint32_t K3 = 0xC2B2AE3Du;
+constexpr uint32_t KM = 0x27D4EB2Fu;
+constexpr float INV24 = 5.9604644775390625e-08f;  // 2^-24 (exact)
+
+inline float hash_u24(uint32_t i, uint32_t j, uint32_t s) {
+    uint32_t h = i * K1 ^ j * K2 ^ s * K3;
+    h = (h ^ (h >> 15)) * KM;
+    h = h ^ (h >> 13);
+    // (h >> 8) fits 24 bits: the int32 cast and f32 convert are exact.
+    float u = (float)(int32_t)(h >> 8) * INV24;
+    // jnp.clip(u, 1e-12, 1 - 2^-24): upper clip is a no-op by
+    // construction (max is exactly 1 - 2^-24); lower clip guards u == 0.
+    if (u < 1e-12f) u = 1e-12f;
+    return u;
+}
+
+// One budgeted direction for a single element (the scalar reference the
+// vector path reproduces lane-for-lane; also the tail loop).
+inline int8_t adv_scalar(int8_t orecv, int8_t osend, float scale,
+                          uint32_t row, uint32_t j, uint32_t s) {
+    int32_t d = (int32_t)osend - (int32_t)orecv;
+    d = d > 0 ? d : 0;
+    float x = (float)d * scale;
+    float fl = std::floor(x);
+    float u = hash_u24(row, j, s);
+    int32_t adv = (int32_t)fl + (u < (x - fl) ? 1 : 0);
+    adv = adv < d ? adv : d;
+    return (int8_t)((int32_t)orecv + adv);
+}
+
+#ifdef __AVX2__
+// 8-lane form of _budgeted_advance's elementwise tail. Every intrinsic
+// is the IEEE-exact vector twin of the scalar op (cvtepi32_ps exact for
+// |v| < 2^24, mul_ps round-to-nearest like the scalar multiply,
+// floor_ps == floorf, cvttps_epi32 == the truncating C cast), so the
+// lanes are bit-identical to the scalar path — asserted by the
+// full-trajectory tests, which run whichever build the host produced.
+struct Hash8 {
+    __m256i iK1_s;  // row * K1 ^ s*K3, broadcast
+    __m256i jK2;    // current j * K2 per lane
+    __m256i stepK2; // 16 * K2 — each 16-wide iteration consumes one
+                    // next() from the lo stream (j..j+7) and one from
+                    // the hi stream (j+8..j+15)
+    inline void init(uint32_t row, uint32_t s, uint32_t j0) {
+        iK1_s = _mm256_set1_epi32((int32_t)(row * K1 ^ s * K3));
+        __m256i j = _mm256_add_epi32(
+            _mm256_set1_epi32((int32_t)j0),
+            _mm256_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7));
+        jK2 = _mm256_mullo_epi32(j, _mm256_set1_epi32((int32_t)K2));
+        stepK2 = _mm256_set1_epi32((int32_t)(16u * K2));
+    }
+    inline __m256 next() {  // u for the current 8 columns, then advance
+        __m256i h = _mm256_xor_si256(iK1_s, jK2);
+        jK2 = _mm256_add_epi32(jK2, stepK2);  // (j+16)*K2 == j*K2 + 16*K2
+        h = _mm256_mullo_epi32(
+            _mm256_xor_si256(h, _mm256_srli_epi32(h, 15)),
+            _mm256_set1_epi32((int32_t)KM));
+        h = _mm256_xor_si256(h, _mm256_srli_epi32(h, 13));
+        __m256 u = _mm256_mul_ps(
+            _mm256_cvtepi32_ps(_mm256_srli_epi32(h, 8)),
+            _mm256_set1_ps(INV24));
+        return _mm256_max_ps(u, _mm256_set1_ps(1e-12f));
+    }
+};
+
+// Budgeted advance for 8 int32 lanes: recv + min(floor(d*scale)+bump, d).
+inline __m256i adv8(__m256i orecv, __m256i osend, __m256 scale,
+                    Hash8& hash) {
+    __m256i d = _mm256_max_epi32(_mm256_sub_epi32(osend, orecv),
+                                 _mm256_setzero_si256());
+    __m256 x = _mm256_mul_ps(_mm256_cvtepi32_ps(d), scale);
+    __m256 fl = _mm256_floor_ps(x);
+    __m256 frac = _mm256_sub_ps(x, fl);
+    __m256 u = hash.next();
+    // bump: lanes where u < frac have mask -1; subtracting the mask
+    // adds 1 exactly there.
+    __m256i bump = _mm256_castps_si256(_mm256_cmp_ps(u, frac, _CMP_LT_OQ));
+    __m256i adv = _mm256_sub_epi32(_mm256_cvttps_epi32(fl), bump);
+    adv = _mm256_min_epi32(adv, d);
+    return _mm256_add_epi32(orecv, adv);
+}
+
+inline void widen16(const int8_t* p, __m256i& lo, __m256i& hi) {
+    // 16 int8 -> two 8-lane int32 vectors.
+    __m128i v = _mm_loadu_si128((const __m128i*)p);
+    lo = _mm256_cvtepi8_epi32(v);
+    hi = _mm256_cvtepi8_epi32(_mm_srli_si128(v, 8));
+}
+
+inline void store16(int8_t* p, __m256i lo, __m256i hi) {
+    // Watermarks are 0..127 (hostsim.supported gates keys_per_node), so
+    // the signed saturations never engage; packs_epi32 interleaves
+    // 128-bit lanes, which the permute undoes before the int16->int8
+    // pack.
+    __m256i p16 = _mm256_permute4x64_epi64(
+        _mm256_packs_epi32(lo, hi), 0xD8);
+    __m128i p8 = _mm_packs_epi16(
+        _mm256_castsi256_si128(p16), _mm256_extracti128_si256(p16, 1));
+    _mm_storeu_si128((__m128i*)p, p8);
+}
+#endif  // __AVX2__
+
+// Advance both directions of one pair in place. a_scale/b_scale == 1.0f
+// means that direction saturates (recv = max(recv, send) — exactly what
+// the budgeted formula degenerates to at scale 1, see the module
+// comment); the flags let us skip the hash work for saturating sides.
+inline void advance_pair(int8_t* __restrict ra, int8_t* __restrict rb,
+                         int64_t n, uint32_t a, uint32_t b, uint32_t s,
+                         float sa, float sb, bool a_sat, bool b_sat) {
+    int64_t j = 0;
+#ifdef __AVX2__
+    Hash8 hash_a_lo, hash_a_hi, hash_b_lo, hash_b_hi;
+    if (!a_sat) { hash_a_lo.init(a, s, 0); hash_a_hi.init(a, s, 8); }
+    if (!b_sat) { hash_b_lo.init(b, s, 0); hash_b_hi.init(b, s, 8); }
+    __m256 vsa = _mm256_set1_ps(sa), vsb = _mm256_set1_ps(sb);
+    for (; j + 16 <= n; j += 16) {
+        __m256i alo, ahi, blo, bhi;
+        widen16(ra + j, alo, ahi);
+        widen16(rb + j, blo, bhi);
+        __m256i nalo, nahi, nblo, nbhi;
+        if (a_sat) {
+            nalo = _mm256_max_epi32(alo, blo);
+            nahi = _mm256_max_epi32(ahi, bhi);
+        } else {
+            nalo = adv8(alo, blo, vsa, hash_a_lo);
+            nahi = adv8(ahi, bhi, vsa, hash_a_hi);
+        }
+        if (b_sat) {
+            nblo = _mm256_max_epi32(alo, blo);
+            nbhi = _mm256_max_epi32(ahi, bhi);
+        } else {
+            nblo = adv8(blo, alo, vsb, hash_b_lo);
+            nbhi = adv8(bhi, ahi, vsb, hash_b_hi);
+        }
+        store16(ra + j, nalo, nahi);
+        store16(rb + j, nblo, nbhi);
+    }
+#endif
+    for (; j < n; ++j) {
+        const int8_t oa = ra[j], ob = rb[j];
+        ra[j] = a_sat ? (oa > ob ? oa : ob)
+                      : adv_scalar(oa, ob, sa, a, (uint32_t)j, s);
+        rb[j] = b_sat ? (oa > ob ? oa : ob)
+                      : adv_scalar(ob, oa, sb, b, (uint32_t)j, s);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Advance one matching sub-exchange over all pairs, in place.
+//   w        : (n, n) int8, row-major — the watermark matrix. The sim
+//              stores int16, but on the supported domain every
+//              watermark is <= keys_per_node <= 127, so the int8
+//              REPRESENTATION is lossless and the arithmetic (which
+//              widens to int32/f32 exactly like the int16 path) is
+//              unchanged — it just halves the DRAM traffic this
+//              memory-bound loop is made of.
+//   A, B     : pair index arrays (A[k] < B[k] = p[A[k]], each row of the
+//              involution appears in exactly one pair; self-pairs are
+//              excluded by the caller — they are no-ops)
+//   salt     : gossip.py sub_salt(c, 0) for this sub-exchange
+//   run_salt : random.bits(base_key) — the per-run hash salt
+//   budget   : key-versions per exchange (the MTU analogue)
+//   compute_min / row_min : when nonzero, write min(row) after the
+//              update for every touched row (len-n int32 buffer) — the
+//              convergence check rides the round's last sub-exchange.
+// Returns the number of pairs that took the saturating fast path
+// (total <= budget on both sides), for diagnostics.
+long acg_hostsim_subexchange(int8_t* w, int64_t n,
+                             const int32_t* A, const int32_t* B,
+                             int64_t n_pairs,
+                             int32_t salt, uint32_t run_salt,
+                             int32_t budget,
+                             int32_t compute_min,
+                             int32_t* row_min) {
+    const uint32_t s = (uint32_t)salt ^ run_salt;
+    long fast = 0;
+    for (int64_t k = 0; k < n_pairs; ++k) {
+        const int64_t a = A[k], b = B[k];
+        int8_t* __restrict ra = w + a * n;
+        int8_t* __restrict rb = w + b * n;
+        // Pass 1: both directions' total deficits (rows land in cache
+        // for pass 2).
+        int32_t tota = 0, totb = 0;
+        for (int64_t j = 0; j < n; ++j) {
+            int32_t da = (int32_t)rb[j] - (int32_t)ra[j];
+            tota += da > 0 ? da : 0;
+            totb += da < 0 ? -da : 0;
+        }
+        const bool fa = tota <= budget;  // scale == 1 exactly
+        const bool fb = totb <= budget;
+        if (fa && fb) {
+            ++fast;
+            if (tota | totb) {  // identical rows need no writes at all
+                for (int64_t j = 0; j < n; ++j) {
+                    int8_t m = ra[j] > rb[j] ? ra[j] : rb[j];
+                    ra[j] = m;
+                    rb[j] = m;
+                }
+            }
+        } else {
+            // total > budget on at least one side (scale < 1 there: the
+            // f32 division can only equal 1.0f when total == budget,
+            // which the fast path already took). BOTH directions read
+            // the PRE-exchange rows — element j of one row only depends
+            // on element j of the other, so the per-element
+            // load-both-then-write-both in advance_pair keeps the
+            // in-place update exact.
+            const float sa = fa ? 1.0f : std::fmin(
+                1.0f, (float)budget / std::fmax((float)tota, 1.0f));
+            const float sb = fb ? 1.0f : std::fmin(
+                1.0f, (float)budget / std::fmax((float)totb, 1.0f));
+            advance_pair(ra, rb, n, (uint32_t)a, (uint32_t)b, s,
+                         sa, sb, fa, fb);
+        }
+        if (compute_min) {
+            int32_t ma = 32767, mb = 32767;
+            for (int64_t j = 0; j < n; ++j) {
+                if (ra[j] < ma) ma = ra[j];
+                if (rb[j] < mb) mb = rb[j];
+            }
+            row_min[a] = ma;
+            row_min[b] = mb;
+        }
+    }
+    return fast;
+}
+
+// Refresh owner diagonals: w[i, i] = mv[i] (gossip.py's diagonal refresh
+// — a no-op for write-free runs after init, kept for fidelity).
+void acg_hostsim_diag(int8_t* w, int64_t n, const int32_t* mv) {
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t v = mv[i];
+        w[i * n + i] = (int8_t)v;
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,290 @@
+"""Native host fast-path simulator for the lean matching profile.
+
+``HostSimulator`` walks the EXACT same trajectory as ``Simulator`` (the
+XLA path, and therefore the Pallas kernels and the sharded mesh path,
+which are all bit-identity-tested against each other) for configs on its
+domain, at 10-100x the XLA-CPU speed on a 1-core host. It exists for one
+job: measuring exact rounds-to-convergence at populations where XLA CPU
+needs ~10^3 s/round (the 100k-node BASELINE config 5), so the full-scale
+convergence count can be MEASURED rather than extrapolated — with the
+real XLA path certifying the final round from a checkpoint
+(``benchmarks/records/_r4_northstar_run.py``).
+
+Bit-exactness contract, by construction:
+
+- The per-round randomness (grouped matchings, salts) is drawn by
+  calling the SAME jax functions ``sim_step`` calls
+  (``ops.gossip._grouped_matching``, ``random.fold_in``/``split``/
+  ``bits``) with the same keys — tiny (N/8,) arrays, computed on CPU.
+- The (N, N) arithmetic runs in ``_hostsim.cpp``, which mirrors each
+  XLA elementwise op of ``_budgeted_advance`` + ``_hash_uniform`` at
+  f32/int16 precision (the f32 row totals are integers < 2^24, so XLA's
+  f32 summation order is immaterial — the int32 accumulation is equal).
+- Verified: full-trajectory equality vs ``Simulator`` in
+  tests/test_hostsim.py, every round compared to convergence.
+
+Domain: lean profile only — ``pairing="matching"``, proportional budget,
+``n % 128 == 0`` (the grouped-matching family), int16 watermarks, no
+heartbeats, no failure detector, no churn, no writes, no topology.
+``supported()`` is the gate.
+
+Reference anchor: the loop simulated is jettify/aiocluster
+server.py:378-495; convergence semantics state.py:310-322.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ..utils.cbuild import build_and_load
+from .config import SimConfig
+
+_SRC = Path(__file__).with_name("_hostsim.cpp")
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+def _build() -> ctypes.CDLL | None:
+    """Shared compile-and-cache loader (utils/cbuild.py — the host-ISA
+    cache key matters here because of -march=native). The aggressive
+    flags change instruction selection, not IEEE f32 results, so the
+    build stays bit-exact with the scalar path."""
+    lib = build_and_load(
+        _SRC, flags=("-O3", "-march=native", "-funroll-loops")
+    )
+    if lib is None:
+        return None
+    lib.acg_hostsim_subexchange.restype = ctypes.c_long
+    lib.acg_hostsim_subexchange.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_uint32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_void_p,
+    ]
+    lib.acg_hostsim_diag.restype = None
+    lib.acg_hostsim_diag.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    return lib
+
+
+def _lib() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    if not _TRIED:
+        _LIB = _build()
+        _TRIED = True
+    return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def supported(cfg: SimConfig) -> bool:
+    """The exact domain on which HostSimulator's trajectory equals
+    Simulator's. Everything here mirrors a branch sim_step would take
+    differently (and the kernel only implements int16)."""
+    return (
+        cfg.pairing == "matching"
+        and cfg.budget_policy == "proportional"
+        and cfg.n_nodes % 128 == 0
+        and cfg.version_dtype == "int16"
+        # Watermarks never exceed keys_per_node on this domain (no
+        # writes), so the native kernel's lossless int8 representation
+        # (half the DRAM traffic) requires the bound to fit int8.
+        and cfg.keys_per_node <= 127
+        # The bit-exactness argument needs every row-deficit total to
+        # stay an exact f32 integer: XLA sums deficits in f32, the
+        # kernel in int32, and the two agree only below 2^24
+        # (_hostsim.cpp header). Max possible total = K * (n - 1).
+        and cfg.keys_per_node * (cfg.n_nodes - 1) < 2**24
+        and not cfg.track_heartbeats
+        and not cfg.track_failure_detector
+        and cfg.death_rate == 0.0
+        and cfg.revival_rate == 0.0
+        and cfg.writes_per_round == 0
+    )
+
+
+class HostSimulator:
+    """Drop-in convergence runner for lean matching configs (native C
+    inner loop, jax PRNG draws). API mirrors the Simulator subset the
+    north-star tooling needs: run / run_until_converged / save."""
+
+    def __init__(
+        self,
+        cfg: SimConfig,
+        *,
+        seed: int = 0,
+        state_w: np.ndarray | None = None,
+        tick: int = 0,
+    ) -> None:
+        if not supported(cfg):
+            raise ValueError(
+                "config outside the host fast-path domain "
+                "(see hostsim.supported)"
+            )
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError("native hostsim library failed to build")
+        self._lib = lib
+        self.cfg = cfg
+        self.seed = seed
+        n = cfg.n_nodes
+        self.max_version = np.full(
+            (n,), cfg.keys_per_node, dtype=np.int32
+        )
+        # The watermark matrix lives as int8 (lossless on this domain:
+        # values <= keys_per_node <= 127; supported() gates it) — half
+        # the footprint and DRAM traffic of the sim's int16. Comparisons
+        # against Simulator state are by VALUE, not dtype.
+        if state_w is None:
+            # init_state: each node knows only its own keyspace.
+            self.w = np.zeros((n, n), dtype=np.int8)
+            np.fill_diagonal(self.w, cfg.keys_per_node)
+        else:
+            assert state_w.shape == (n, n)
+            assert state_w.dtype in (np.int8, np.int16), state_w.dtype
+            if state_w.dtype == np.int16:
+                assert int(state_w.max(initial=0)) <= 127
+                state_w = state_w.astype(np.int8)
+            self.w = np.ascontiguousarray(state_w)
+        self.tick = int(tick)
+        self._row_min = np.zeros((n,), dtype=np.int32)
+        # Same key derivation as Simulator: base key from the seed; the
+        # per-round salt is random.bits(base_key) exactly as sim_step
+        # computes it (gossip.py run_salt).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from jax import random
+
+        self._key = random.key(seed)
+        self._run_salt = int(
+            np.asarray(random.bits(self._key, dtype=np.uint32))
+        )
+
+    # -- round advancement ----------------------------------------------------
+
+    def _round_pairs(self, tick: int) -> list[tuple[np.ndarray, np.ndarray]]:
+        """The fanout matchings for one round, drawn with sim_step's own
+        key schedule and matching family (ops.gossip._grouped_matching)."""
+        from jax import random
+
+        from ..ops.gossip import _grouped_matching
+
+        round_key = random.fold_in(self._key, tick)
+        _churn_key, peer_key = random.split(round_key)
+        out = []
+        n = self.cfg.n_nodes
+        idx = np.arange(n, dtype=np.int32)
+        for c in range(self.cfg.fanout):
+            ck = random.fold_in(peer_key, c)
+            _gm, _c8, p = _grouped_matching(ck, n)
+            p = np.asarray(p, dtype=np.int32)
+            a = idx[idx < p]  # self-pairs (p[i] == i) are no-op exchanges
+            out.append((a, p[a]))
+        return out
+
+    def _step(self, track: bool) -> bool:
+        """One full gossip round in place; returns the post-round
+        all-converged flag when ``track`` (else False)."""
+        tick = self.tick + 1
+        n = self.cfg.n_nodes
+        self._lib.acg_hostsim_diag(
+            self.w.ctypes.data, n, self.max_version.ctypes.data
+        )
+        pairs = self._round_pairs(tick)
+        fan = self.cfg.fanout
+        for c, (a, b) in enumerate(pairs):
+            last = c == fan - 1
+            salt = tick * (2 * fan) + 2 * c  # gossip.py sub_salt(c, 0)
+            self._lib.acg_hostsim_subexchange(
+                self.w.ctypes.data, n,
+                a.ctypes.data, b.ctypes.data, len(a),
+                np.int32(salt), np.uint32(self._run_salt),
+                self.cfg.budget,
+                1 if (track and last) else 0,
+                self._row_min.ctypes.data,
+            )
+        self.tick = tick
+        if not track:
+            return False
+        # all_converged_flag semantics for the lean profile: every row's
+        # watermark has reached every owner's max_version (all alive).
+        # Rows untouched this round (self-pairs) keep a stale _row_min;
+        # with n % 128 == 0 the group count is even, so grouped
+        # matchings have no self-pairs — but guard anyway.
+        touched = np.zeros((n,), dtype=bool)
+        a, b = pairs[-1]
+        touched[a] = True
+        touched[b] = True
+        if not touched.all():
+            untouched = ~touched
+            self._row_min[untouched] = self.w[untouched].min(axis=1)
+        return bool((self._row_min >= self.max_version).all())
+
+    def run(self, rounds: int) -> None:
+        for _ in range(rounds):
+            self._step(track=False)
+
+    def run_until_converged(
+        self,
+        max_rounds: int = 100_000,
+        on_round=None,
+    ) -> int | None:
+        """Exact first round at which full convergence holds (checked
+        every round, like Simulator's in-chunk tracker). ``on_round`` is
+        an optional callback(tick) between rounds — checkpoint/pause
+        hooks for multi-hour runs."""
+        if self.tick == 0:
+            pass  # fresh cluster: trivially unconverged (w off-diag 0)
+        elif bool((self.w.min(axis=1) >= self.max_version).all()):
+            return self.tick
+        while self.tick < max_rounds:
+            if self._step(track=True):
+                return self.tick
+            if on_round is not None:
+                on_round(self.tick)
+        return None
+
+    # -- checkpointing --------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Raw checkpoint (np.save of the int8 matrix — 10 GB at the
+        100k scale — plus a JSON sidecar), cheap enough to take every
+        few dozen rounds."""
+        tmp = f"{path}.w.tmp.npy"
+        np.save(tmp, self.w)
+        os.replace(tmp, f"{path}.w.npy")
+        meta = {
+            "tick": self.tick,
+            "seed": self.seed,
+            "n_nodes": self.cfg.n_nodes,
+            "keys_per_node": self.cfg.keys_per_node,
+            "fanout": self.cfg.fanout,
+            "budget": self.cfg.budget,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        with open(f"{path}.json.tmp", "w") as f:
+            json.dump(meta, f)
+        os.replace(f"{path}.json.tmp", f"{path}.json")
+
+    @classmethod
+    def resume(cls, path: str, cfg: SimConfig) -> "HostSimulator":
+        with open(f"{path}.json") as f:
+            meta = json.load(f)
+        for field in ("n_nodes", "keys_per_node", "fanout", "budget"):
+            if meta[field] != getattr(cfg, field):
+                raise ValueError(
+                    f"checkpoint {field}={meta[field]} != cfg "
+                    f"{getattr(cfg, field)}"
+                )
+        w = np.load(f"{path}.w.npy")
+        return cls(cfg, seed=meta["seed"], state_w=w, tick=meta["tick"])

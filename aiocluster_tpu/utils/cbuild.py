@@ -1,0 +1,72 @@
+"""Shared compile-and-cache loader for the repo's native C++ modules.
+
+One cache policy for every native component (wire codec, host-sim
+kernel): g++-compile on first use into
+``$XDG_CACHE_HOME/aiocluster_tpu`` (``~/.cache`` default), keyed by a
+sha256 of the SOURCE + COMPILE FLAGS + HOST ISA. The ISA term matters
+when ``-march=native`` is among the flags: a shared or network cache
+directory must never hand an AVX-512 binary to a host without it
+(SIGILL mid-run), so the host's cpuinfo flags line participates in the
+key. Atomic tmp+rename keeps concurrent builders race-free.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+
+def _host_isa_tag() -> str:
+    """A short digest of this host's ISA surface (uname machine + the
+    cpuinfo feature flags). Only affects the cache key."""
+    bits = os.uname().machine
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    bits += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(bits.encode()).hexdigest()[:8]
+
+
+def build_and_load(
+    src: Path,
+    flags: tuple[str, ...] = ("-O2",),
+    timeout: float = 180.0,
+) -> ctypes.CDLL | None:
+    """Compile ``src`` with g++ (shared lib) and load it; None on any
+    failure — callers degrade to their pure-Python/XLA fallbacks."""
+    source = src.read_bytes()
+    key = hashlib.sha256(
+        source + " ".join(flags).encode() + _host_isa_tag().encode()
+    ).hexdigest()[:16]
+    cache_dir = Path(
+        os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")
+    ) / "aiocluster_tpu"
+    so_path = cache_dir / f"{src.stem}-{key}.so"
+    if not so_path.exists():
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        with tempfile.NamedTemporaryFile(
+            dir=cache_dir, suffix=".so", delete=False
+        ) as tmp:
+            tmp_path = Path(tmp.name)
+        try:
+            subprocess.run(
+                ["g++", *flags, "-shared", "-fPIC", "-std=c++17",
+                 str(src), "-o", str(tmp_path)],
+                check=True, capture_output=True, timeout=timeout,
+            )
+            tmp_path.replace(so_path)
+        except Exception:
+            tmp_path.unlink(missing_ok=True)
+            return None
+    try:
+        return ctypes.CDLL(str(so_path))
+    except OSError:
+        return None

@@ -13,46 +13,15 @@ updates; below that, ctypes marshaling costs more than it saves.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import tempfile
 import threading
 from pathlib import Path
+
+from ..utils.cbuild import build_and_load
 
 _SRC = Path(__file__).with_name("_native.cpp")
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
-
-
-def _build_and_load() -> ctypes.CDLL | None:
-    src = _SRC.read_bytes()
-    digest = hashlib.sha256(src).hexdigest()[:16]
-    cache_dir = Path(
-        os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")
-    ) / "aiocluster_tpu"
-    so_path = cache_dir / f"_native-{digest}.so"
-    if not so_path.exists():
-        cache_dir.mkdir(parents=True, exist_ok=True)
-        # Compile into a temp name then rename: atomic against races.
-        with tempfile.NamedTemporaryFile(
-            dir=cache_dir, suffix=".so", delete=False
-        ) as tmp:
-            tmp_path = Path(tmp.name)
-        try:
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                 str(_SRC), "-o", str(tmp_path)],
-                check=True, capture_output=True, timeout=120,
-            )
-            tmp_path.replace(so_path)
-        except Exception:
-            tmp_path.unlink(missing_ok=True)
-            return None
-    try:
-        return ctypes.CDLL(str(so_path))
-    except OSError:
-        return None
 
 
 def _lib() -> ctypes.CDLL | None:
@@ -62,7 +31,7 @@ def _lib() -> ctypes.CDLL | None:
     _TRIED = True
     if os.environ.get("AIOCLUSTER_TPU_NO_NATIVE"):
         return None
-    lib = _build_and_load()
+    lib = build_and_load(_SRC)  # shared cache policy (utils/cbuild.py)
     if lib is not None:
         lib.acg_enc_kv_updates.restype = ctypes.c_long
         lib.acg_enc_kv_updates.argtypes = [

@@ -1,0 +1,101 @@
+"""The native host fast-path must walk EXACTLY the Simulator's
+trajectory on its domain — it exists to measure the 100k-node
+rounds-to-convergence, so any divergence, however small, would poison
+the headline number. Every round of w is compared bit-for-bit."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from aiocluster_tpu.sim import SimConfig, Simulator
+from aiocluster_tpu.sim.hostsim import HostSimulator, available, supported
+from aiocluster_tpu.sim.memory import lean_config
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native hostsim failed to build"
+)
+
+
+def _trajectories_equal(cfg, seed, max_rounds):
+    sim = Simulator(cfg, seed=seed, chunk=1)
+    host = HostSimulator(cfg, seed=seed)
+    for r in range(1, max_rounds + 1):
+        sim.run(1)
+        host.run(1)
+        np.testing.assert_array_equal(
+            np.asarray(sim.state.w), host.w,
+            err_msg=f"divergence at round {r}",
+        )
+    return sim, host
+
+
+def test_trajectory_bit_identity_budget_bound():
+    """Small budget keeps the run in the budget-bound regime (scale < 1,
+    dithered rounding active) — the regime 100k convergence spends
+    almost all its rounds in."""
+    cfg = lean_config(256, budget=24)
+    _trajectories_equal(cfg, seed=1, max_rounds=12)
+
+
+def test_trajectory_bit_identity_saturating():
+    """Large budget exercises the saturating fast path (scale == 1)."""
+    cfg = lean_config(256, budget=4096)
+    _trajectories_equal(cfg, seed=2, max_rounds=8)
+
+
+def test_convergence_round_matches_simulator():
+    """The headline quantity: exact first-converged round equal between
+    the native path and the Simulator's in-chunk tracker."""
+    cfg = lean_config(256, budget=64)
+    r_sim = Simulator(cfg, seed=1, chunk=4).run_until_converged(
+        max_rounds=512
+    )
+    r_host = HostSimulator(cfg, seed=1).run_until_converged(max_rounds=512)
+    assert r_sim is not None
+    assert r_host == r_sim
+
+
+@pytest.mark.slow
+def test_trajectory_bit_identity_1024():
+    """A bigger population (more groups, denser middle phase), full
+    trajectory to convergence plus the convergence round itself."""
+    cfg = lean_config(1024, budget=128)
+    sim, host = _trajectories_equal(cfg, seed=3, max_rounds=30)
+    r_host = HostSimulator(cfg, seed=3).run_until_converged(max_rounds=512)
+    r_sim = Simulator(cfg, seed=3, chunk=8).run_until_converged(
+        max_rounds=512
+    )
+    assert r_host == r_sim is not None
+
+
+def test_checkpoint_resume_continues_exact(tmp_path):
+    """save/resume is invisible to the trajectory (the 100k run
+    checkpoints every few dozen rounds across battery pauses)."""
+    cfg = lean_config(256, budget=64)
+    a = HostSimulator(cfg, seed=5)
+    a.run(6)
+    a.save(str(tmp_path / "ck"))
+    b = HostSimulator.resume(str(tmp_path / "ck"), cfg)
+    assert b.tick == 6
+    a.run(5)
+    b.run(5)
+    np.testing.assert_array_equal(a.w, b.w)
+    # And the resumed run's future randomness matches a fresh
+    # uninterrupted run (salts depend only on seed + tick).
+    c = HostSimulator(cfg, seed=5)
+    c.run(11)
+    np.testing.assert_array_equal(a.w, c.w)
+
+
+def test_supported_gate():
+    assert supported(lean_config(1024))
+    assert not supported(lean_config(1000))  # off the 128-lane domain
+    assert not supported(
+        lean_config(1024, version_dtype="int32")
+    )
+    assert not supported(
+        SimConfig(n_nodes=1024, keys_per_node=16, fanout=3, budget=64)
+    )  # full-fidelity profile (FD on) is outside the domain
+    with pytest.raises(ValueError):
+        HostSimulator(lean_config(1000))
