@@ -493,11 +493,19 @@ def pallas_fd_engaged(cfg: SimConfig, n_local: int | None = None) -> bool:
     also engages under shard_map (each shard runs the kernel on its
     (N, n_local) column block with its owner offset); pass the shard's
     ``n_local`` so the lane-width check sees the LOCAL column count
-    (default: unsharded, n_local = n_nodes)."""
+    (default: unsharded, n_local = n_nodes).
+
+    ``cfg.use_pallas_fd`` refines the resolution independently of the
+    pull kernel: False pins the FD phase to the XLA block (the on-chip
+    A/B seam / kill switch), True forces the kernel, "auto" follows
+    ``use_pallas``. Bit-identical either way."""
     from . import pallas_fd
 
+    if cfg.use_pallas_fd is False:
+        return False
+    wanted = cfg.use_pallas_fd is True or _pallas_wanted(cfg)
     return (
-        _pallas_wanted(cfg)
+        wanted
         and cfg.track_failure_detector
         and not _lifecycle_enabled(cfg)
         and pallas_fd.supported(

@@ -156,6 +156,16 @@ class SimConfig:
     #   changes a trajectory.
     pallas_variant: str = "auto"
 
+    # The streaming failure-detector kernel (ops/pallas_fd.py),
+    # independently of the pull kernel: "auto" (default) follows
+    # ``use_pallas``'s resolution; False pins the FD phase to the XLA
+    # block while the pull kernel stays engaged — the A/B seam for
+    # measuring what the FD kernel pays on chip (and a kill switch,
+    # mirroring pallas_variant). True forces it (interpreted off-TPU).
+    # Bit-identical either way (tests/test_pallas_fd.py), so this knob
+    # never changes a trajectory.
+    use_pallas_fd: bool | str = "auto"
+
     def __post_init__(self) -> None:
         if self.n_nodes < 2:
             raise ValueError("need at least 2 nodes")
@@ -202,3 +212,9 @@ class SimConfig:
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
         if self.pallas_variant not in ("auto", "m8", "pairs"):
             raise ValueError(f"unknown pallas_variant: {self.pallas_variant!r}")
+        if not (
+            self.use_pallas_fd is True
+            or self.use_pallas_fd is False
+            or self.use_pallas_fd == "auto"
+        ):
+            raise ValueError(f"unknown use_pallas_fd: {self.use_pallas_fd!r}")

@@ -555,8 +555,13 @@ def sim_rounds_per_sec(
         try:
             import dataclasses
 
+            # The baseline arm must be the FULL XLA path: use_pallas_fd
+            # pinned off too, or a forced FD kernel (use_pallas_fd=True)
+            # would leak into the "XLA" rate and skew pallas_speedup.
             sim_x = Simulator(
-                dataclasses.replace(cfg, use_pallas=False),
+                dataclasses.replace(
+                    cfg, use_pallas=False, use_pallas_fd=False
+                ),
                 seed=0, chunk=sim.chunk,
             )
             sim_x.run(sim_x.chunk)

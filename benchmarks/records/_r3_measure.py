@@ -525,6 +525,45 @@ def phase_i16() -> dict:
     return rec
 
 
+# -- phase: FD-kernel A/B at the headline shape -------------------------------
+
+
+def phase_fd_ab() -> dict:
+    """On-chip adjudication of the FD kernel's claim (ops/pallas_fd.py
+    docstring: ~5.4 ms -> ~2.3 ms per round at 10,240): the same
+    headline config with the FD phase on the kernel vs pinned to the
+    XLA block (use_pallas_fd=False — everything else, including the
+    pull kernel, identical). Bit-identical trajectories; only the
+    round rate differs (VERDICT r3 item 6)."""
+    import dataclasses
+
+    from aiocluster_tpu.ops.gossip import pallas_fd_engaged
+    from aiocluster_tpu.sim import SimConfig, Simulator, budget_from_mtu
+
+    cfg = SimConfig(
+        n_nodes=10_240, keys_per_node=16, fanout=3,
+        budget=budget_from_mtu(65_507),
+        version_dtype="int16", heartbeat_dtype="int16", fd_dtype="bfloat16",
+    )
+    cfg_off = dataclasses.replace(cfg, use_pallas_fd=False)
+    engaged_on = pallas_fd_engaged(cfg)
+    rate_on = _rate(Simulator(cfg, seed=0, chunk=16), rounds=64)
+    rate_off = _rate(Simulator(cfg_off, seed=0, chunk=16), rounds=64)
+    delta_ms = (
+        (1e3 / rate_off - 1e3 / rate_on) if rate_on and rate_off else None
+    )
+    return {
+        "fd_kernel_engaged_in_on_arm": engaged_on,
+        "rounds_per_sec_fd_kernel": rate_on,
+        "rounds_per_sec_fd_xla": rate_off,
+        "fd_kernel_ms_saved_per_round": (
+            round(delta_ms, 3) if delta_ms is not None else None
+        ),
+        "claim": "pallas_fd docstring: ~5.4 -> ~2.3 ms FD phase at 10,240"
+                 " (so ~3.1 ms/round saved if it holds)",
+    }
+
+
 # -- phase 5: kernel ceiling at the churn scale -------------------------------
 
 
@@ -636,6 +675,7 @@ def phase_scatter_share() -> dict:
 PHASES = [
     ("pairs_canary", phase_pairs_canary, 900),
     ("bench_full", phase_bench_full, 2700),
+    ("fd_ab", phase_fd_ab, 900),
     ("sharded_1dev", phase_sharded_1dev, 1200),
     ("i16_experiment", phase_i16, 1500),
     ("churn_kernel_ceiling", phase_churn_kernel_ceiling, 900),

@@ -213,6 +213,55 @@ def test_fd_kernel_gate():
     )
 
 
+def test_fd_kernel_independent_knob():
+    """use_pallas_fd pins the FD phase independently of the pull kernel:
+    False = XLA FD block with the pull kernel still engaged (the
+    on-chip A/B seam), True = forced on, 'auto' follows use_pallas."""
+    from aiocluster_tpu.ops.gossip import (
+        pallas_fd_engaged,
+        pallas_path_engaged,
+    )
+    from aiocluster_tpu.sim import SimConfig
+
+    off = SimConfig(n_nodes=128, use_pallas=True, use_pallas_fd=False)
+    assert not pallas_fd_engaged(off)
+    assert pallas_path_engaged(off)  # the pull kernel is untouched
+    assert pallas_fd_engaged(
+        SimConfig(n_nodes=128, use_pallas_fd=True)  # forced, off-TPU
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="use_pallas_fd"):
+        SimConfig(n_nodes=128, use_pallas_fd="yes")
+
+
+def test_fd_ab_arms_trajectories_identical():
+    """The A/B knob never changes a trajectory — only speed (the battery
+    phase_fd_ab relies on this to difference the round rates)."""
+    import dataclasses
+
+    import numpy as np
+
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    base = SimConfig(
+        n_nodes=128, keys_per_node=8, fanout=2, budget=32,
+        use_pallas=True,
+    )
+    a = Simulator(base, seed=11, chunk=2)
+    b = Simulator(
+        dataclasses.replace(base, use_pallas_fd=False), seed=11, chunk=2
+    )
+    a.run(4)
+    b.run(4)
+    for f in ("w", "hb_known", "last_change", "imean", "icount",
+              "live_view"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.state, f)),
+            np.asarray(getattr(b.state, f)), err_msg=f,
+        )
+
+
 def test_pick_block_fits_vmem():
     from aiocluster_tpu.ops.pallas_fd import _per_row_bytes
     from aiocluster_tpu.ops.pallas_pull import VMEM_BUDGET
