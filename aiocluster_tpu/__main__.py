@@ -95,6 +95,55 @@ def _sim_config(args: argparse.Namespace):
 
 
 def _run_sim(args: argparse.Namespace, cfg) -> int:
+    if args.host_native:
+        # The native C fast-path: bit-identical to the device paths on
+        # its (lean matching) domain, ~50x XLA-CPU — million-scale
+        # convergence studies with no accelerator at all.
+        from .sim import hostsim
+
+        if args.shards:
+            print("--host-native runs unsharded (single host)",
+                  file=sys.stderr)
+            return 2
+        if not hostsim.supported(cfg):
+            print(
+                "--host-native needs the lean matching domain: --lean, "
+                "no --churn, --nodes a multiple of 128, --keys <= 127 "
+                "and --keys * --nodes < 2^24 (sim.hostsim.supported)",
+                file=sys.stderr,
+            )
+            return 2
+        if not hostsim.available():
+            print("native hostsim build failed (g++ unavailable?)",
+                  file=sys.stderr)
+            return 2
+        host = hostsim.HostSimulator(cfg, seed=args.seed)
+        converged = host.run_until_converged(max_rounds=args.max_rounds)
+        # Same record shape as the device path (consumers key off
+        # "engine", not a divergent schema); metrics recomputed from w
+        # with convergence_metrics' semantics (all nodes alive here).
+        import numpy as np
+
+        k = float(cfg.keys_per_node)
+        col_min = host.w.min(axis=0).astype(np.float64)
+        frac = np.minimum(host.w.astype(np.float64) / k, 1.0)
+        metrics = {
+            "converged_owners": int((col_min >= k).sum()),
+            "all_converged": bool((col_min >= k).all()),
+            "min_fraction": float(frac.min()),
+            "mean_fraction": float(frac.mean()),
+            "alive_count": cfg.n_nodes,
+        }
+        print(json.dumps({
+            "nodes": args.nodes,
+            "shards": 1,
+            "engine": "host-native",
+            "rounds_to_convergence": converged,
+            "tick": host.tick,
+            "metrics": metrics,
+        }), flush=True)
+        return 0 if converged is not None else 1
+
     import jax
 
     if args.cpu:
@@ -173,6 +222,10 @@ def main(argv: list[str] | None = None) -> int:
                      help="column-shard the owner axis over this many "
                      "devices (the BASELINE config-5 shape; 0 = one "
                      "device, no mesh)")
+    sim.add_argument("--host-native", action="store_true",
+                     help="run the native C host fast-path (bit-"
+                     "identical on the lean matching domain, ~50x "
+                     "XLA-CPU; requires --lean, no churn/shards)")
 
     args = parser.parse_args(argv)
     if args.command == "node":

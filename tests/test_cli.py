@@ -23,6 +23,35 @@ def test_cli_sim_runs_to_convergence():
     assert record["metrics"]["all_converged"] is True
 
 
+def test_cli_sim_host_native():
+    """--host-native runs the C fast-path and reports the same exact
+    convergence count the device paths would (bit-identity is proven in
+    tests/test_hostsim.py; here we check the CLI wiring + gating)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "aiocluster_tpu", "sim",
+         "--nodes", "256", "--lean", "--host-native", "--seed", "1",
+         "--max-rounds", "500"],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["engine"] == "host-native"
+    assert record["rounds_to_convergence"] is not None
+    # Same record schema as the device path (consumers key off
+    # "engine"): metrics + shards present and consistent.
+    assert record["shards"] == 1
+    assert record["metrics"]["all_converged"] is True
+    assert record["metrics"]["converged_owners"] == 256
+    # Off-domain request fails cleanly, not with a traceback.
+    bad = subprocess.run(
+        [sys.executable, "-m", "aiocluster_tpu", "sim",
+         "--nodes", "256", "--host-native"],  # full fidelity: off-domain
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert bad.returncode == 2
+    assert "lean matching domain" in bad.stderr
+
+
 def test_cli_sim_sharded_lean():
     """--shards runs the column-sharded (config-5 shape) path from the
     CLI, and --lean uses the real lean profile (int16 watermarks)."""
