@@ -1,0 +1,116 @@
+"""Fold the measured 100k rounds-to-convergence into the <60 s v5e-8
+projection, with explicit per-point provenance.
+
+Projection arithmetic (VERDICT r3 item 4's "defensible projection"):
+``total_s = R x s_per_round_v5e8``, where R is now MEASURED (the host
+fast-path run certified by the mesh replay), and ``s_per_round_v5e8``
+charges each shard its per-round HBM traffic at the best MEASURED
+achieved bandwidth from a single-chip on-chip point in the same kernel
+regime — the same accounting `_r3_measure._northstar_projection` uses,
+with the fit-extrapolated R replaced by the measured one.
+
+Reads (in preference order) the newest battery checkpoint or the
+window-1 partial for the measured single-chip point; reruns safely as
+better on-chip points land (the battery refreshes r3_measurements.json).
+
+Builder-side tooling (not part of the shipped package).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, REPO)
+
+RESULT = os.path.join(HERE, "r4_northstar_100k_convergence.json")
+
+N_STAR = 100_352
+N_DEV = 8
+HBM_ANALYTIC = {"m8": {"single": 3, "sharded": 5},
+                "pairs": {"single": 2, "sharded": 3}}
+
+
+def measured_single_chip_points() -> list[dict]:
+    """Every measured on-chip lean (rate, n, variant) point we have,
+    newest sources first."""
+    pts: list[dict] = []
+    try:
+        with open(os.path.join(HERE, "r3_measurements.json")) as f:
+            rec = json.load(f)
+        for p in rec.get("lean_scaling", {}).get("points", []):
+            if p.get("rounds_per_sec"):
+                pts.append({
+                    "n": p["n"], "rounds_per_sec": p["rounds_per_sec"],
+                    "variant": p.get("kernel_variant", "m8"),
+                    "source": "battery lean_scaling (on-chip)",
+                })
+        ms = rec.get("max_scale", {})
+        for rung in ms.get("ladder", []):
+            if rung.get("ok"):
+                pts.append({
+                    "n": rung["n"],
+                    "rounds_per_sec": rung["rounds_per_sec"],
+                    "variant": "auto-at-measurement",
+                    "source": "battery max_scale (on-chip)",
+                })
+    except Exception:
+        pass
+    # Window-1 partial: 32,768 lean @ 14.6 r/s on the single-pass path.
+    pts.append({
+        "n": 32_768, "rounds_per_sec": 14.6, "variant": "m8",
+        "source": "r3 window-1 partial (stderr provenance, on-chip)",
+    })
+    return pts
+
+
+def main() -> None:
+    with open(RESULT) as f:
+        record = json.load(f)
+    R = record["value"]
+    assert isinstance(R, int) and R > 0, R
+    pts = measured_single_chip_points()
+    best = max(pts, key=lambda p: p["n"])
+    variant = "m8" if "m8" in str(best["variant"]) else (
+        "pairs" if "pairs" in str(best["variant"]) else "m8"
+    )
+    passes_single = HBM_ANALYTIC[variant]["single"]
+    bytes_per_round_single = 3 * passes_single * best["n"] ** 2 * 2
+    achieved_gbps = bytes_per_round_single * best["rounds_per_sec"] / 1e9
+    # The sharded config runs the two-pass form of whichever variant the
+    # gates resolve at 100,352 / 8 shards; charge conservatively with
+    # the measured point's own variant unless pairs is proven on chip.
+    passes_sharded = HBM_ANALYTIC[variant]["sharded"]
+    shard_bytes = 3 * passes_sharded * N_STAR**2 * 2 / N_DEV
+    s_per_round = shard_bytes / (achieved_gbps * 1e9)
+    total_s = R * s_per_round
+    record["projection_v5e8"] = {
+        "measured_rounds_to_convergence": R,
+        "anchor_point": best,
+        "anchor_variant_charged": variant,
+        "measured_achieved_gb_per_sec": round(achieved_gbps, 1),
+        "bytes_per_round_per_shard": int(shard_bytes),
+        "projected_seconds_per_round": round(s_per_round, 4),
+        "projected_total_seconds": round(total_s, 1),
+        "north_star_target_seconds": 60.0,
+        "meets_target": bool(total_s < 60.0),
+        "arithmetic": (
+            f"MEASURED R = {R}; {variant} sharded form: "
+            f"bytes/round/shard = fanout(3) x {passes_sharded} passes "
+            f"x N^2 x 2B / {N_DEV} = {shard_bytes / 1e9:.1f} GB at the "
+            f"measured {achieved_gbps:.0f} GB/s (single-chip "
+            f"n={best['n']} @ {best['rounds_per_sec']} r/s) -> "
+            f"{s_per_round * 1e3:.0f} ms/round; total {total_s:.0f} s"
+        ),
+    }
+    with open(RESULT + ".tmp", "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(RESULT + ".tmp", RESULT)
+    print(json.dumps(record["projection_v5e8"]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

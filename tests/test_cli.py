@@ -27,6 +27,12 @@ def test_cli_sim_host_native():
     """--host-native runs the C fast-path and reports the same exact
     convergence count the device paths would (bit-identity is proven in
     tests/test_hostsim.py; here we check the CLI wiring + gating)."""
+    import pytest
+
+    from aiocluster_tpu.sim.hostsim import available
+
+    if not available():  # no g++: environment limit, not a failure
+        pytest.skip("native hostsim library failed to build")
     proc = subprocess.run(
         [sys.executable, "-m", "aiocluster_tpu", "sim",
          "--nodes", "256", "--lean", "--host-native", "--seed", "1",

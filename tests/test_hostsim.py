@@ -57,6 +57,28 @@ def test_convergence_round_matches_simulator():
 
 
 @pytest.mark.slow
+def test_trajectory_bit_identity_vs_mesh():
+    """Direct (not just transitive) closure of the certification chain:
+    the native path equals the 8-device-mesh shard_map path — the exact
+    program the 100k certify step replays — round by round."""
+    import jax
+
+    from aiocluster_tpu.parallel.mesh import make_mesh
+
+    cfg = lean_config(256, budget=64)
+    mesh = make_mesh(jax.devices()[:8])
+    sim = Simulator(cfg, seed=4, mesh=mesh, chunk=1)
+    host = HostSimulator(cfg, seed=4)
+    for r in range(1, 9):
+        sim.run(1)
+        host.run(1)
+        np.testing.assert_array_equal(
+            np.asarray(sim.state.w), host.w,
+            err_msg=f"mesh divergence at round {r}",
+        )
+
+
+@pytest.mark.slow
 def test_trajectory_bit_identity_1024():
     """A bigger population (more groups, denser middle phase), full
     trajectory to convergence plus the convergence round itself."""
