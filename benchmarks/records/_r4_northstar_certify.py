@@ -152,12 +152,18 @@ def phase_final() -> dict:
     # Reconstruct the full SimState at start_tick. heartbeat = 1 + tick
     # (init ones, +1 per round, all alive); the FD/heartbeat matrices
     # are the lean profile's zero-sized placeholders (sim/state.py).
+    # Memory discipline (the first attempt OOM-killed at 130 GB): w is
+    # handed over as a NUMPY int16 array — shard_state device_puts the
+    # per-shard slices from it directly, so no extra whole-matrix jax
+    # buffer exists — and the int8 source is freed before that.
+    w16 = host.w.astype(np.int16)
+    del host
     state = SimState(
         tick=jnp.asarray(start_tick, jnp.int32),
         max_version=jnp.full((n,), cfg.keys_per_node, jnp.int32),
         heartbeat=jnp.full((n,), 1 + start_tick, jnp.int32),
         alive=jnp.ones((n,), bool),
-        w=jnp.asarray(host.w.astype(np.int16)),
+        w=w16,
         hb_known=jnp.zeros((0, 0), hdt),
         last_change=jnp.zeros((0, 0), hdt),
         imean=jnp.zeros((0, 0), jnp.dtype(cfg.fd_dtype)),
@@ -165,7 +171,7 @@ def phase_final() -> dict:
         live_view=jnp.zeros((0, 0), bool),
         dead_since=jnp.zeros((0, 0), hdt),
     )
-    del host
+    del w16  # the SimState holds the only reference now
     mesh = _mesh()
     t0 = time.perf_counter()
     sim = Simulator(cfg, seed=SEED, mesh=mesh, chunk=1, state=state)
@@ -183,17 +189,9 @@ def phase_final() -> dict:
     }
 
 
-def main() -> None:
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    _setup_mesh_env()
-    cert: dict = {}
-    if os.path.exists(CERT):
-        with open(CERT) as f:
-            cert = json.load(f)
-    if which in ("prefix", "all"):
-        cert["prefix"] = phase_prefix()
-    if which in ("final", "all"):
-        cert["final"] = phase_final()
+def _write_cert(cert: dict) -> None:
+    """Written after EVERY phase — the first attempt lost a finished
+    prefix phase to an OOM kill in the next one."""
     cert["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     cert["n_nodes"] = N_STAR
     cert["n_devices"] = N_DEV
@@ -206,6 +204,38 @@ def main() -> None:
     with open(CERT + ".tmp", "w") as f:
         json.dump(cert, f, indent=1)
     os.replace(CERT + ".tmp", CERT)
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    _setup_mesh_env()
+    if which == "all":
+        # Each phase in its own process: a 100k-node mesh Simulator's
+        # working set must not still be resident while the next phase
+        # builds its own (the one-process form OOM-killed at 130 GB).
+        import subprocess
+
+        for phase in ("final", "prefix"):  # certification first
+            rc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), phase]
+            ).returncode
+            if rc != 0:
+                log(f"phase {phase} failed rc={rc}")
+                sys.exit(rc)
+        return
+    # Single-phase mode: merge into the existing cert and write
+    # immediately (a later phase's crash must not lose this one).
+    cert: dict = {}
+    if os.path.exists(CERT):
+        with open(CERT) as f:
+            cert = json.load(f)
+    if which == "prefix":
+        cert["prefix"] = phase_prefix()
+    elif which == "final":
+        cert["final"] = phase_final()
+    else:
+        raise SystemExit(f"unknown phase {which!r}")
+    _write_cert(cert)
     print(json.dumps(cert), flush=True)
 
 
