@@ -8,6 +8,13 @@ import subprocess
 import sys
 import time
 
+import pytest
+
+# Interpret-mode kernels / multi-device mesh / subprocess suites:
+# minutes on a 1-core CPU host. `make test` deselects slow; the
+# full `make test-all` (and CI) runs everything.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

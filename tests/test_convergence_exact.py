@@ -7,6 +7,7 @@ import numpy as np
 
 from aiocluster_tpu.parallel.mesh import make_mesh
 from aiocluster_tpu.sim import SimConfig, Simulator
+import pytest
 
 
 def _cfg(**overrides):
@@ -15,6 +16,7 @@ def _cfg(**overrides):
     return SimConfig(**base)
 
 
+@pytest.mark.slow
 def test_convergence_round_invariant_to_chunk():
     rounds = {
         chunk: Simulator(_cfg(), seed=0, chunk=chunk).run_until_converged(500)
@@ -38,6 +40,7 @@ def test_convergence_round_not_a_chunk_multiple():
     assert r == exact
 
 
+@pytest.mark.slow
 def test_sharded_convergence_round_invariant_to_chunk():
     cfg = _cfg(track_failure_detector=False)
     mesh = make_mesh()

@@ -16,6 +16,7 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 import __graft_entry__ as graft  # noqa: E402
+import pytest
 
 sys.path.remove(REPO)
 
@@ -45,6 +46,7 @@ def test_entry_shapes_are_kernel_eligible():
     assert pallas_fd_engaged(forced)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_subprocess():
     """Run the dryrun exactly as the driver does (its own subprocess
     pins JAX_PLATFORMS=cpu with 4 virtual devices — small mesh to keep

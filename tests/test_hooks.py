@@ -6,6 +6,7 @@ import asyncio
 import time
 
 from aiocluster_tpu import Cluster, Config, NodeId
+import pytest
 
 
 def config_for(port: int, **kwargs) -> Config:
@@ -16,6 +17,7 @@ def config_for(port: int, **kwargs) -> Config:
     )
 
 
+@pytest.mark.slow
 async def test_set_does_not_block_on_slow_hooks(free_port):
     async with Cluster(config_for(free_port)) as cluster:
         async def slow_hook(node_id, key, old, new):

@@ -18,6 +18,13 @@ import numpy as np
 from aiocluster_tpu.parallel.mesh import make_mesh
 from aiocluster_tpu.sim import SimConfig, Simulator
 
+import pytest
+
+# Interpret-mode kernels / multi-device mesh / subprocess suites:
+# minutes on a 1-core CPU host. `make test` deselects slow; the
+# full `make test-all` (and CI) runs everything.
+pytestmark = pytest.mark.slow
+
 _WORKER = Path(__file__).with_name("_multihost_worker.py")
 ROUNDS = 10
 CFG = dict(n_nodes=32, keys_per_node=4, budget=16)

@@ -11,6 +11,8 @@ from jax import random
 
 from aiocluster_tpu.ops.pallas_fd import _pick_block, fused_fd, supported
 
+import pytest
+
 
 def _xla_fd(tick, hb, hb0, lc, im, ic, cfg):
     """The FD block of ops/gossip.py::sim_step, extracted verbatim
@@ -104,6 +106,7 @@ def test_fused_fd_refreshes_hb0_diagonal():
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
 
 
+@pytest.mark.slow
 def test_sim_step_fd_state_matches_xla():
     """Flipping use_pallas must not change FD bookkeeping either — the
     full-fidelity trajectory (watermarks AND all four FD outputs) is
@@ -129,6 +132,7 @@ def test_sim_step_fd_state_matches_xla():
         )
 
 
+@pytest.mark.slow
 def test_sim_step_choice_path_fd_kernel_matches_xla():
     """pairing="choice" keeps the pulls on XLA but the FD kernel still
     engages — the mixed combination must also be trajectory-exact."""
@@ -157,6 +161,7 @@ def test_sim_step_choice_path_fd_kernel_matches_xla():
         )
 
 
+@pytest.mark.slow
 def test_sharded_fd_kernel_matches_single_device():
     """The FD kernel engages under shard_map (per-shard blocks + owner
     offsets); a 2-shard kernel run must equal the single-device kernel
@@ -235,6 +240,7 @@ def test_fd_kernel_independent_knob():
         SimConfig(n_nodes=128, use_pallas_fd="yes")
 
 
+@pytest.mark.slow
 def test_fd_ab_arms_trajectories_identical():
     """The A/B knob never changes a trajectory — only speed (the battery
     phase_fd_ab relies on this to difference the round rates)."""

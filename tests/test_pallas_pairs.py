@@ -28,6 +28,11 @@ from aiocluster_tpu.ops.pallas_pull import (
 from aiocluster_tpu.sim import SimConfig
 from aiocluster_tpu.sim.state import init_state
 
+# Interpret-mode kernels / multi-device mesh / subprocess suites:
+# minutes on a 1-core CPU host. `make test` deselects slow; the
+# full `make test-all` (and CI) runs everything.
+pytestmark = pytest.mark.slow
+
 
 def _case(n, dtype, seed, alive_p=0.85):
     key = random.key(seed)

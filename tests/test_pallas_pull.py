@@ -75,6 +75,7 @@ def test_fused_pull_m8_matches_xla(dtype):
     np.testing.assert_array_equal(np.asarray(hb_k), np.asarray(hb_x))
 
 
+@pytest.mark.slow
 def test_fused_pull_m8_diag_fold_matches_prematerialized():
     """Passing mv/hbv must equal pre-applying the owner-diagonal select
     and calling the kernel without them (what the XLA path does)."""
@@ -180,6 +181,7 @@ def test_fused_pull_m8_lean_matches_xla():
     np.testing.assert_array_equal(np.asarray(w_k), np.asarray(w + adv))
 
 
+@pytest.mark.slow
 def test_sim_step_lean_pallas_path_matches_xla():
     """Lean-profile sim trajectories are identical with the kernel on."""
     from aiocluster_tpu.ops.gossip import sim_step
@@ -197,6 +199,7 @@ def test_sim_step_lean_pallas_path_matches_xla():
     np.testing.assert_array_equal(np.asarray(sp.w), np.asarray(sx.w))
 
 
+@pytest.mark.slow
 def test_sim_step_pallas_path_matches_xla():
     """Flipping use_pallas must not change the trajectory: both paths run
     the grouped-matching family on the kernel domain (n % 128 == 0),

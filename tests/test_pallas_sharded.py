@@ -25,6 +25,11 @@ from aiocluster_tpu.ops.pallas_pull import (
 from aiocluster_tpu.parallel.mesh import make_mesh, shard_state, sharded_step_fn
 from aiocluster_tpu.sim import SimConfig, Simulator, init_state
 
+# Interpret-mode kernels / multi-device mesh / subprocess suites:
+# minutes on a 1-core CPU host. `make test` deselects slow; the
+# full `make test-all` (and CI) runs everything.
+pytestmark = pytest.mark.slow
+
 KEY = random.key(21)
 
 # 8 shards of 128 columns each: the smallest population where every

@@ -82,6 +82,7 @@ def test_budget_caps_per_round_progress():
     assert r is not None and r >= 960 // (2 * 8)
 
 
+@pytest.mark.slow
 def test_bandwidth_bound_convergence_scales_with_budget():
     slow = Simulator(SimConfig(n_nodes=64, keys_per_node=16, budget=16,
                                track_failure_detector=False), seed=5)
@@ -122,6 +123,7 @@ def test_failure_detector_marks_silent_nodes_dead():
     assert lv[np.ix_(alive, alive)].mean() > 0.95
 
 
+@pytest.mark.slow
 def test_revived_node_reearns_liveness():
     cfg = SimConfig(n_nodes=24, keys_per_node=2)
     s = run_rounds(init_state(cfg), cfg, 12)
@@ -167,6 +169,7 @@ def test_ring_topology_constrains_knowledge_spread():
             assert min((i - j) % n, (j - i) % n) <= max_hops
 
 
+@pytest.mark.slow
 def test_ring_convergence_slower_than_random():
     n = 64
     ring_sim = Simulator(
@@ -183,6 +186,7 @@ def test_ring_convergence_slower_than_random():
     assert r_ring > r_rand  # diameter-bound vs log-bound dissemination
 
 
+@pytest.mark.slow
 def test_scale_free_topology_valid_and_converges():
     topo = scale_free(128, attach=3, seed=1)
     assert topo.adjacency.shape[0] == 128
@@ -194,6 +198,7 @@ def test_scale_free_topology_valid_and_converges():
     assert sim.run_until_converged(2000) is not None
 
 
+@pytest.mark.slow
 def test_small_world_topology_valid_and_converges():
     from aiocluster_tpu.models.topology import small_world
 
@@ -270,6 +275,7 @@ def test_simcluster_idempotent_set():
     assert len(sc._logs[0]) == 1
 
 
+@pytest.mark.slow
 def test_simcluster_live_view():
     cfg = SimConfig(n_nodes=8, keys_per_node=2)
     sc = SimCluster(cfg)
@@ -315,6 +321,7 @@ def test_view_mode_converges():
     assert sim.run_until_converged(500) is not None
 
 
+@pytest.mark.slow
 def test_sharded_view_mode_bit_identical_to_single_device():
     """The Gumbel-max view sampler is keyed on global indices, so the
     column-sharded run draws the exact same peers as one device."""
@@ -451,6 +458,7 @@ def test_section_timer():
     assert s["a"]["seconds"] >= 0
 
 
+@pytest.mark.slow
 def test_device_trace_writes_profile(tmp_path):
     from aiocluster_tpu.utils import device_trace
 
@@ -485,6 +493,7 @@ def test_matching_is_involution():
         assert int((p == np.arange(n)).sum()) == (n % 2)
 
 
+@pytest.mark.slow
 def test_int16_dtypes_match_int32_convergence():
     base = dict(n_nodes=24, keys_per_node=8, budget=16)
     cfg32 = SimConfig(**base)
@@ -517,6 +526,7 @@ def test_permutation_both_directions_applied():
     assert learned >= 16 - 3
 
 
+@pytest.mark.slow
 def test_bfloat16_fd_matches_float32_liveness():
     base = dict(n_nodes=16, keys_per_node=4, death_rate=0.05, revival_rate=0.2)
     cfg32 = SimConfig(**base)
@@ -531,6 +541,7 @@ def test_bfloat16_fd_matches_float32_liveness():
     assert (np.asarray(s16.live_view) == np.asarray(s32.live_view)).all()
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_continues_trajectory(tmp_path):
     from aiocluster_tpu.sim import Simulator
 
@@ -548,6 +559,7 @@ def test_checkpoint_resume_continues_trajectory(tmp_path):
     assert (np.asarray(a.state.live_view) == np.asarray(b.state.live_view)).all()
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_onto_mesh(tmp_path):
     import jax
     from aiocluster_tpu.parallel.mesh import make_mesh
@@ -651,6 +663,7 @@ def test_checkpoint_bfloat16_roundtrip(tmp_path):
     assert (np.asarray(a.state.w) == np.asarray(b.state.w)).all()
 
 
+@pytest.mark.slow
 def test_checkpoint_topology_must_be_reprovided(tmp_path):
     from aiocluster_tpu.sim import Simulator
 
@@ -667,6 +680,7 @@ def test_checkpoint_topology_must_be_reprovided(tmp_path):
     assert (np.asarray(a.state.w) == np.asarray(b.state.w)).all()
 
 
+@pytest.mark.slow
 def test_simcluster_compact_preserves_views():
     sc = SimCluster(SimConfig(n_nodes=8, keys_per_node=3), seed=4)
     sc.set("node-1", "color", "teal")
@@ -694,6 +708,7 @@ def test_simcluster_compact_preserves_views():
     assert sc.replica_view("node-7", "node-3")["later"] == "z"
 
 
+@pytest.mark.slow
 def test_simcluster_compact_respects_laggards():
     cfg = SimConfig(n_nodes=6, keys_per_node=4, track_failure_detector=False)
     sc = SimCluster(cfg, seed=8)
@@ -707,6 +722,7 @@ def test_simcluster_compact_respects_laggards():
     assert len(views) == 4
 
 
+@pytest.mark.slow
 def test_grouped_matching_convergence_parity():
     """The TPU-shaped grouped-matching family (used when n % 128 == 0)
     must mix like the unrestricted matching family: comparable rounds to
@@ -803,6 +819,7 @@ def test_sim_matches_object_model_at_matched_mtu():
     assert abs(sim_rounds - obj_rounds) <= 1
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrips_lifecycle_state(tmp_path):
     """dead_since (the lifecycle's bookkeeping) survives save/resume and
     the resumed run continues the identical trajectory through churn."""

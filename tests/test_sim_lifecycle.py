@@ -13,6 +13,8 @@ from jax import random
 from aiocluster_tpu.ops.gossip import convergence_metrics, sim_step
 from aiocluster_tpu.sim import SimConfig, init_state
 
+import pytest
+
 KEY = random.key(3)
 
 GRACE = 40  # ticks; scheduled-for-deletion at 20
@@ -125,6 +127,7 @@ def test_config_validation():
         SimConfig(n_nodes=4, dead_grace_ticks=1)
 
 
+@pytest.mark.slow
 def test_simcluster_kill_revive_lifecycle():
     """The named-node API drives the full story: kill -> peers notice ->
     state stops propagating -> forgotten after the grace; revive -> the

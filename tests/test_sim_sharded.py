@@ -16,6 +16,11 @@ from aiocluster_tpu.parallel.mesh import (
 )
 from aiocluster_tpu.sim import SimConfig, Simulator, init_state
 
+# Interpret-mode kernels / multi-device mesh / subprocess suites:
+# minutes on a 1-core CPU host. `make test` deselects slow; the
+# full `make test-all` (and CI) runs everything.
+pytestmark = pytest.mark.slow
+
 KEY = random.key(11)
 
 

@@ -80,6 +80,7 @@ def test_variant_engaged_is_pure_wrt_env(monkeypatch):
     assert pallas_variant_engaged(cfg) == base
 
 
+@pytest.mark.slow
 def test_pinned_simulator_trajectory_matches_explicit(monkeypatch):
     """End-to-end: an env-pinned 'm8' run equals an explicitly configured
     m8 run bit-for-bit (they are the same static config now)."""
