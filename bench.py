@@ -271,6 +271,11 @@ def anchored_asyncio_seconds(log) -> float | None:
     return float(record["value"])
 
 
+RECORDS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "benchmarks", "records"
+)
+
+
 def load_last_onchip_record(log) -> dict | None:
     """The last committed on-chip bench record, embedded VERBATIM in
     CPU-fallback artifacts so a down tunnel can never reduce the
@@ -279,18 +284,40 @@ def load_last_onchip_record(log) -> dict | None:
     (benchmarks/records/_r3_measure.py) and was seeded from the round-2
     certified record, so the chain never goes empty; the certified
     record itself is the fallback of the fallback."""
-    records_dir = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "records"
-    )
     for name in ("latest_onchip.json", "r02_builder_tpu_10240.json"):
         try:
-            with open(os.path.join(records_dir, name)) as f:
+            with open(os.path.join(RECORDS_DIR, name)) as f:
                 return json.load(f)
         except Exception as exc:
             log(f"on-chip record {name} unavailable: {exc!r}")
     log("NO on-chip record embedded — fallback artifact is CPU-only "
         "(should not happen: records/ is committed)")
     return None
+
+
+def load_northstar_record(log) -> dict | None:
+    """The measured-and-certified 100k rounds-to-convergence (round 4):
+    R and its v5e-8 projection ride every bench record so the flagship
+    claim is machine-readable wherever the driver captures it."""
+    try:
+        with open(os.path.join(RECORDS_DIR,
+                               "r4_northstar_100k_convergence.json")) as f:
+            rec = json.load(f)
+        out = {
+            "rounds_to_convergence": rec["value"],
+            "n_nodes": rec["n_nodes"],
+            "certified": "DONE" in str(rec.get("certification", "")),
+        }
+        proj = rec.get("projection_v5e8") or {}
+        if proj:
+            out["projected_v5e8_seconds"] = proj.get(
+                "projected_total_seconds"
+            )
+            out["meets_60s_target"] = proj.get("meets_target")
+        return out
+    except Exception as exc:
+        log(f"northstar record unavailable: {exc!r}")
+        return None
 
 
 def measured_reference_baseline(log) -> dict | None:
@@ -316,6 +343,8 @@ STDOUT_LINE_CAP = 2000
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
     "budget",
+    "northstar_projected_v5e8_s",
+    "northstar_rounds_100k",
     "reference_measured_rounds_per_sec",
     "xla_path_rounds_per_sec",
     "max_scale_rounds_per_sec",
@@ -361,6 +390,12 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         ),
         "reference_measured_rounds_per_sec": ref.get(
             "sim_equivalent_rounds_per_sec"
+        ),
+        "northstar_rounds_100k": (ex.get("northstar_100k") or {}).get(
+            "rounds_to_convergence"
+        ),
+        "northstar_projected_v5e8_s": (ex.get("northstar_100k") or {}).get(
+            "projected_v5e8_seconds"
         ),
         "budget": ex.get("budget"),
         "tpu_note": ex.get("tpu_note"),
@@ -843,6 +878,9 @@ def main() -> None:
                 # compute-bound ceiling — the extrapolated vs_baseline
                 # above now sits next to a measured datum.
                 "measured_reference_library": ref_measured,
+                # Round-4 flagship: the measured (mesh-certified) 100k
+                # rounds-to-convergence + its v5e-8 projection.
+                "northstar_100k": load_northstar_record(log),
                 "keys_per_node": 16,
                 "fanout": 3,
                 "budget": _budget(),

@@ -410,6 +410,23 @@ def _northstar_projection(points: list[dict]) -> dict:
     b, a = np.polyfit(ns, rs, 1)  # rounds ~ b*n + a
     n_star = 100_352  # config 5's 128x8-aligned 100k population
     rounds_100k = float(b * n_star + a)
+    rounds_source = "linear fit of measured lean curve"
+    # Round 4 MEASURED the full-scale count (host fast-path, certified
+    # by the mesh replay): when that record exists, the projection
+    # anchors on truth instead of the fit.
+    try:
+        with open(os.path.join(
+            HERE, "r4_northstar_100k_convergence.json"
+        )) as f:
+            measured = json.load(f)
+        if measured.get("n_nodes") == n_star and measured.get("value"):
+            rounds_100k = float(measured["value"])
+            rounds_source = (
+                "MEASURED (r4_northstar_100k_convergence.json, "
+                "mesh-certified)"
+            )
+    except Exception:
+        pass
     # Measured achieved throughput at the largest single-chip point IN
     # THE SAME KERNEL REGIME as the 100k config's shards (pairs, 3-buf
     # full-overlap at 12,544-wide blocks): a 2-buffer fallback point
@@ -450,6 +467,7 @@ def _northstar_projection(points: list[dict]) -> dict:
             "fit_rounds_per_node": round(b, 6),
             "fit_intercept": round(a, 2),
             "n_star": n_star,
+            "rounds_source": rounds_source,
             "predicted_rounds_to_convergence": round(rounds_100k, 1),
             "kernel_variant@largest_single_chip": big_variant,
             "kernel_variant@n_star_sharded": star_variant,
@@ -459,8 +477,14 @@ def _northstar_projection(points: list[dict]) -> dict:
             "north_star_target_seconds": 60.0,
             "meets_target": bool(total_s < 60.0),
             "arithmetic": (
-                f"rounds({n_star}) = {b:.3e}*N + {a:.1f} = "
-                f"{rounds_100k:.0f}; {star_variant} two-pass sharded "
+                (
+                    f"MEASURED rounds({n_star}) = {rounds_100k:.0f} "
+                    f"(fit would predict {b * n_star + a:.0f})"
+                    if rounds_source.startswith("MEASURED")
+                    else f"rounds({n_star}) = {b:.3e}*N + {a:.1f} = "
+                         f"{rounds_100k:.0f}"
+                )
+                + f"; {star_variant} two-pass sharded "
                 f"kernel: bytes/round/shard = fanout(3) x {star_passes} "
                 f"passes x N^2 x 2B / 8 = {shard_bytes_100k / 1e9:.1f} "
                 f"GB at the measured {achieved_gbps:.0f} GB/s -> "
