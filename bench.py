@@ -275,6 +275,20 @@ RECORDS_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "benchmarks", "records"
 )
 
+# Round-3 window-1's headline, preserved only as stderr provenance (the
+# bench record was nulled by a since-fixed crash; the window closed
+# before a rerun). Embedded in CPU-fallback artifacts NEXT TO the
+# certified chain, never in its place; superseded automatically the
+# moment a battery run lands a certified record >= this.
+UNCERTIFIED_BEST_ONCHIP = {
+    "value": 67.5,
+    "unit": "rounds/s",
+    "n_nodes": 10_240,
+    "source": "benchmarks/records/r3_window1_partial.json "
+              "(stderr provenance; bench record nulled by a "
+              "since-fixed crash)",
+}
+
 
 def load_last_onchip_record(log) -> dict | None:
     """The last committed on-chip bench record, embedded VERBATIM in
@@ -287,9 +301,18 @@ def load_last_onchip_record(log) -> dict | None:
     for name in ("latest_onchip.json", "r02_builder_tpu_10240.json"):
         try:
             with open(os.path.join(RECORDS_DIR, name)) as f:
-                return json.load(f)
+                rec = json.load(f)
         except Exception as exc:
             log(f"on-chip record {name} unavailable: {exc!r}")
+            continue
+        # One shape for every consumer: latest_onchip.json wraps the
+        # bench record in {head, source, record}; the certified round-2
+        # file IS the bare record — wrap it so downstream code (the
+        # compact line, the uncertified-best comparison) reads only the
+        # wrapped form.
+        if "record" not in rec:
+            rec = {"head": None, "source": name, "record": rec}
+        return rec
     log("NO on-chip record embedded — fallback artifact is CPU-only "
         "(should not happen: records/ is committed)")
     return None
@@ -860,6 +883,15 @@ def main() -> None:
                 "benchmarks/records/ (see its README for provenance)"
             )
             last_onchip = load_last_onchip_record(log)
+            # The best on-chip measurement NOT yet in a certified bench
+            # record (round-3 window 1 ended before the record landed;
+            # the numbers survive as stderr provenance). Labelled
+            # uncertified — never substituted for the certified chain.
+            if last_onchip and (
+                (last_onchip.get("record") or {}).get("value") or 0
+            ) < UNCERTIFIED_BEST_ONCHIP["value"]:
+                last_onchip = dict(last_onchip)
+                last_onchip["uncertified_best"] = UNCERTIFIED_BEST_ONCHIP
         result = {
             "metric": metric,
             "value": round(rps, 2),
