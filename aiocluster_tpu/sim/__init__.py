@@ -11,13 +11,15 @@ from .bytes import budget_from_mtu
 from .config import SimConfig
 from .state import SimState, init_state
 
-__all__ = ("SimCluster", "SimConfig", "SimState", "Simulator",
-           "budget_from_mtu", "init_state")
+__all__ = ("HostSimulator", "SimCluster", "SimConfig", "SimState",
+           "Simulator", "budget_from_mtu", "init_state")
 
 
 def __getattr__(name: str):
     # Simulator/SimCluster import ops.gossip, which imports sim.state —
     # loading them lazily keeps `import aiocluster_tpu.ops` acyclic.
+    # HostSimulator is lazy for a different reason: importing it may
+    # g++-compile the native kernel on first use.
     if name == "Simulator":
         from .simulator import Simulator
 
@@ -26,4 +28,8 @@ def __getattr__(name: str):
         from .simcluster import SimCluster
 
         return SimCluster
+    if name == "HostSimulator":
+        from .hostsim import HostSimulator
+
+        return HostSimulator
     raise AttributeError(name)
