@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import functools
 import json
 import os
@@ -70,37 +71,78 @@ def _mtu_budget() -> int:
 # -- config 1: asyncio 3-node loopback cluster --------------------------------
 
 
+def _free_ports(n: int) -> list[int]:
+    import socket
+
+    ports = []
+    with contextlib.ExitStack() as stack:
+        # Hold ALL sockets open while choosing, so the kernel can't hand
+        # the same ephemeral port out twice within one call.
+        for _ in range(n):
+            s = stack.enter_context(socket.socket())
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+    return ports
+
+
+async def _boot_loopback_clusters(
+    gossip_interval: float,
+    choose_ports=_free_ports,
+    attempts: int = 5,
+):
+    """Start the 3-node ring-seeded loopback cluster, retrying with fresh
+    ports on EADDRINUSE.
+
+    The bind-0/close/reuse port chooser is inherently racy (the classic
+    TOCTOU the reference inherits in tests/conftest.py:7-16): another
+    process can claim a chosen port before Cluster.start() binds it.
+    BENCH_r04 lost its config-1 asyncio baseline to exactly that
+    (OSError 98 binding 127.0.0.1:60319). Seeds must be known at
+    construction, so we cannot hold the sockets through start(); instead
+    any EADDRINUSE tears the batch down and retries with fresh ports."""
+    import errno
+
+    from aiocluster_tpu import Cluster, Config, NodeId
+
+    last_exc: OSError | None = None
+    for _ in range(attempts):
+        ports = choose_ports(3)
+        configs = [
+            Config(
+                node_id=NodeId(
+                    name=f"bench{i}", gossip_advertise_addr=("127.0.0.1", ports[i])
+                ),
+                gossip_interval=gossip_interval,
+                seed_nodes=[("127.0.0.1", ports[(i + 1) % 3])],
+                cluster_id="bench1",
+            )
+            for i in range(3)
+        ]
+        clusters = [
+            Cluster(cfg, initial_key_values={"kv": str(i)})
+            for i, cfg in enumerate(configs)
+        ]
+        started = []
+        try:
+            for c in clusters:
+                await c.start()
+                started.append(c)
+            return clusters
+        except OSError as exc:
+            for c in started:
+                await c.close()
+            if exc.errno != errno.EADDRINUSE:
+                raise
+            last_exc = exc
+            log(f"config 1: port collision ({exc}); retrying with fresh ports")
+    raise last_exc
+
+
 async def _config1(gossip_interval: float) -> dict:
     """Wall-clock for a 3-node socket cluster to fully replicate one KV
     per node (the reference's examples/simple.py shape, reference
     examples/simple.py:14-48)."""
-    import socket
-
-    from aiocluster_tpu import Cluster, Config, NodeId
-
-    def free_port() -> int:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    ports = [free_port() for _ in range(3)]
-    configs = [
-        Config(
-            node_id=NodeId(
-                name=f"bench{i}", gossip_advertise_addr=("127.0.0.1", ports[i])
-            ),
-            gossip_interval=gossip_interval,
-            seed_nodes=[("127.0.0.1", ports[(i + 1) % 3])],
-            cluster_id="bench1",
-        )
-        for i in range(3)
-    ]
-    clusters = [
-        Cluster(cfg, initial_key_values={"kv": str(i)})
-        for i, cfg in enumerate(configs)
-    ]
-    for c in clusters:
-        await c.start()
+    clusters = await _boot_loopback_clusters(gossip_interval)
     start = time.perf_counter()
     try:
         async with asyncio.timeout(30.0):

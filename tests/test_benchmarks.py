@@ -24,6 +24,41 @@ def test_config1_asyncio_cluster_converges():
     assert 0 < record["value"] < 30
 
 
+def test_config1_retries_port_collision():
+    """BENCH_r04 regression: the bind-0/close/reuse port chooser raced
+    another process and config 1 crashed with EADDRINUSE, losing the
+    round's asyncio baseline. The boot helper must tear down and retry
+    with fresh ports instead of surfacing the race."""
+    import asyncio
+    import socket
+
+    mod = _load()
+
+    async def run():
+        # Occupy a port for the duration; first attempt collides on it.
+        with socket.socket() as blocker:
+            blocker.bind(("127.0.0.1", 0))
+            blocker.listen(1)
+            taken = blocker.getsockname()[1]
+            calls = {"n": 0}
+
+            def choose(n):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    return [taken] + mod._free_ports(n - 1)
+                return mod._free_ports(n)
+
+            clusters = await mod._boot_loopback_clusters(0.05, choose_ports=choose)
+            try:
+                assert calls["n"] == 2
+                assert len(clusters) == 3
+            finally:
+                for c in clusters:
+                    await c.close()
+
+    asyncio.run(run())
+
+
 def test_all_configs_registered():
     mod = _load()
     assert sorted(mod.CONFIGS) == [1, 2, 3, 4, 5]

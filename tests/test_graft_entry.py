@@ -46,6 +46,56 @@ def test_entry_shapes_are_kernel_eligible():
     assert pallas_fd_engaged(forced)
 
 
+def _write(path, obj):
+    import json
+
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def test_pairs_gate_globs_records_dir(tmp_path):
+    """The unpin gate must find ANY head-matching record carrying a
+    pairs_canary — not one hardcoded round's filename — and the newest
+    head-matching record must win (a fresher failed canary re-pins)."""
+    import time
+
+    d = str(tmp_path)
+    ok = {"pairs_ok": True, "flagship_ok": True}
+    bad = {"pairs_ok": False, "flagship_ok": True}
+
+    # No records at all → pinned.
+    assert graft._pairs_proven_on_chip(records_dir=d, head="abc1234") is False
+
+    # A record under a NEW (round-5+) filename unpins.
+    _write(tmp_path / "r5_measurements.json", {"head": "abc1234", "pairs_canary": ok})
+    assert graft._pairs_proven_on_chip(records_dir=d, head="abc1234") is True
+
+    # Wrong head → stays pinned.
+    assert graft._pairs_proven_on_chip(records_dir=d, head="fffffff") is False
+
+    # Newest head-matching record wins: a later failed canary re-pins.
+    # Ordering is by the IN-RECORD ts (mtimes don't survive checkout);
+    # give the failed record an older mtime to prove ts is authoritative.
+    time.sleep(0.02)
+    _write(
+        tmp_path / "r5_measurements.json",
+        {"head": "abc1234", "ts": "2026-08-01T00:00:00Z", "pairs_canary": ok},
+    )
+    _write(
+        tmp_path / "r6_measurements.json",
+        {"head": "abc1234", "ts": "2026-08-02T00:00:00Z", "pairs_canary": bad},
+    )
+    os.utime(tmp_path / "r6_measurements.json", (0, 0))
+    assert graft._pairs_proven_on_chip(records_dir=d, head="abc1234") is False
+
+    # Records without a pairs_canary (e.g. bench_last_run.json) and
+    # non-dict/corrupt files are ignored, not crashed on.
+    _write(tmp_path / "bench_last_run.json", {"head": "abc1234", "metric": 1})
+    (tmp_path / "corrupt.json").write_text("{not json")
+    _write(tmp_path / "list.json", [1, 2, 3])
+    assert graft._pairs_proven_on_chip(records_dir=d, head="abc1234") is False
+
+
 @pytest.mark.slow
 def test_dryrun_multichip_subprocess():
     """Run the dryrun exactly as the driver does (its own subprocess
