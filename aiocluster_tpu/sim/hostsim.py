@@ -162,7 +162,24 @@ class HostSimulator:
         # computes it (gossip.py run_salt).
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
+        # The tiny PRNG draws below only need CPU-placed arrays, but on
+        # this image backend init may hang forever on a down accelerator
+        # tunnel, so standalone callers (CLI --host-native, the northstar
+        # scripts) want the process pinned to CPU. Pinning is a
+        # process-GLOBAL side effect, so do it only while no backend is
+        # initialized yet: a library user who already brought up an
+        # accelerator keeps it (ADVICE r4, medium).
+        try:
+            from jax._src import xla_bridge as _xb
+
+            uninitialized = not _xb.backends_are_initialized()
+        except Exception:
+            # Private API (no stability guarantee): if it moves, fall
+            # back to the old unconditional pin rather than breaking
+            # construction.
+            uninitialized = True
+        if uninitialized:
+            jax.config.update("jax_platforms", "cpu")
         from jax import random
 
         self._key = random.key(seed)

@@ -126,9 +126,18 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
 # The table ships WITH the package (calibration data versioned next to
 # the model it corrects); builder tooling appends to it in-repo.
 
-_BOUNDARIES_PATH = os.path.join(
+_BOUNDARIES_DEFAULT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "measured_boundaries.json"
 )
+
+
+def _boundaries_path() -> str:
+    """On a read-only / system-site install the in-package path is not
+    writable and a measured hardware fact would be silently dropped
+    (callers log-and-continue); AIOCLUSTER_TPU_BOUNDARIES_PATH redirects
+    both reads and writes (ADVICE r4, low). Resolved at every call, not
+    at import, so setting it after the package is imported works."""
+    return os.environ.get("AIOCLUSTER_TPU_BOUNDARIES_PATH", _BOUNDARIES_DEFAULT)
 
 
 def _boundary_key(
@@ -153,7 +162,7 @@ def _boundary_key(
 
 def load_boundaries(path: str | None = None) -> list[dict]:
     try:
-        with open(path or _BOUNDARIES_PATH) as f:
+        with open(path or _boundaries_path()) as f:
             return json.load(f)["entries"]
     except Exception:
         return []
@@ -177,7 +186,7 @@ def record_boundary(
     import fcntl
     import time
 
-    path = path or _BOUNDARIES_PATH
+    path = path or _boundaries_path()
     entry = {
         **_boundary_key(cfg, shards, hbm_bytes_per_chip),
         "n_nodes": cfg.n_nodes,

@@ -128,10 +128,13 @@ async def _boot_loopback_clusters(
                 await c.start()
                 started.append(c)
             return clusters
-        except OSError as exc:
+        except BaseException as exc:
+            # Tear down whatever started no matter what failed — a
+            # leaked cluster keeps its server + ticker running and
+            # gossips into subsequent configs.
             for c in started:
                 await c.close()
-            if exc.errno != errno.EADDRINUSE:
+            if not (isinstance(exc, OSError) and exc.errno == errno.EADDRINUSE):
                 raise
             last_exc = exc
             log(f"config 1: port collision ({exc}); retrying with fresh ports")

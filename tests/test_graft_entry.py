@@ -88,6 +88,17 @@ def test_pairs_gate_globs_records_dir(tmp_path):
     os.utime(tmp_path / "r6_measurements.json", (0, 0))
     assert graft._pairs_proven_on_chip(records_dir=d, head="abc1234") is False
 
+    # A fresher failed canary WITHOUT a ts (mtime-now on the ISO scale)
+    # still beats an old ts-bearing passing record.
+    d2 = tmp_path / "d2"
+    d2.mkdir()
+    _write(
+        d2 / "old_pass.json",
+        {"head": "h", "ts": "2020-01-01T00:00:00Z", "pairs_canary": ok},
+    )
+    _write(d2 / "new_fail.json", {"head": "h", "pairs_canary": bad})
+    assert graft._pairs_proven_on_chip(records_dir=str(d2), head="h") is False
+
     # Records without a pairs_canary (e.g. bench_last_run.json) and
     # non-dict/corrupt files are ignored, not crashed on.
     _write(tmp_path / "bench_last_run.json", {"head": "abc1234", "metric": 1})

@@ -115,9 +115,7 @@ def test_recency_self_corrects_flaky_oom(tmp_path, monkeypatch):
         ["2026-07-31T01:00:00Z", "2026-07-31T02:00:00Z",
          "2026-07-31T03:00:00Z"]
     )
-    monkeypatch.setattr(
-        memory, "_BOUNDARIES_PATH", path, raising=True
-    )
+    monkeypatch.setenv("AIOCLUSTER_TPU_BOUNDARIES_PATH", path)
     monkeypatch.setattr(
         time_mod, "strftime", lambda *_a: next(stamps), raising=True
     )
