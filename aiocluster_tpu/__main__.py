@@ -76,6 +76,11 @@ def _sim_config(args: argparse.Namespace):
             f"--lean stores int16 watermarks: --keys {args.keys} >= 32768 "
             "overflows (drop --lean or lower --keys)"
         )
+    # --host-native without --lean runs the FULL profile natively, which
+    # requires the scale dtypes (sim.memory.full_config's int16 ticks +
+    # bf16 stored means — hostsim.supported); they are exact on the
+    # CLI's horizon and also what any at-scale device run should use.
+    narrow = args.lean or getattr(args, "host_native", False)
     return SimConfig(
         n_nodes=args.nodes,
         keys_per_node=args.keys,
@@ -89,7 +94,9 @@ def _sim_config(args: argparse.Namespace):
         track_heartbeats=not args.lean,
         # The same profile sim.memory.lean_config prescribes: int16
         # watermarks are what buy the memory headroom at max scale.
-        version_dtype="int16" if args.lean else "int32",
+        version_dtype="int16" if narrow else "int32",
+        heartbeat_dtype="int16" if narrow else "int32",
+        fd_dtype="bfloat16" if narrow else "float32",
         dead_grace_ticks=args.grace if args.churn and not args.lean else None,
     )
 
@@ -107,9 +114,10 @@ def _run_sim(args: argparse.Namespace, cfg) -> int:
             return 2
         if not hostsim.supported(cfg):
             print(
-                "--host-native needs the lean matching domain: --lean, "
-                "no --churn, --nodes a multiple of 128, --keys <= 127 "
-                "and --keys * --nodes < 2^24 (sim.hostsim.supported)",
+                "--host-native needs the matching domain (lean or full "
+                "profile): no --churn, --nodes a multiple of 128, "
+                "--keys <= 127 and --keys * --nodes < 2^24 "
+                "(sim.hostsim.supported)",
                 file=sys.stderr,
             )
             return 2
@@ -227,8 +235,9 @@ def main(argv: list[str] | None = None) -> int:
                      "device, no mesh)")
     sim.add_argument("--host-native", action="store_true",
                      help="run the native C host fast-path (bit-"
-                     "identical on the lean matching domain, ~50x "
-                     "XLA-CPU; requires --lean, no churn/shards)")
+                     "identical on the matching domain — lean, or the "
+                     "full FD profile at int16/bf16 scale dtypes; no "
+                     "churn/shards)")
 
     args = parser.parse_args(argv)
     if args.command == "node":

@@ -201,6 +201,12 @@ extern "C" {
 //              widens to int32/f32 exactly like the int16 path) is
 //              unchanged — it just halves the DRAM traffic this
 //              memory-bound loop is made of.
+//   hb       : (n, n) int16 heartbeat-knowledge matrix, or nullptr on
+//              the lean profile. A matched pair absorbs each other's
+//              heartbeat rows with an elementwise max — gossip.py's
+//              hb_absorb computes both rows' maxima from PRE-exchange
+//              values in one vectorized op, and max is symmetric, so
+//              writing max(ha, hb) to both sides is exact.
 //   A, B     : pair index arrays (A[k] < B[k] = p[A[k]], each row of the
 //              involution appears in exactly one pair; self-pairs are
 //              excluded by the caller — they are no-ops)
@@ -212,7 +218,7 @@ extern "C" {
 //              convergence check rides the round's last sub-exchange.
 // Returns the number of pairs that took the saturating fast path
 // (total <= budget on both sides), for diagnostics.
-long acg_hostsim_subexchange(int8_t* w, int64_t n,
+long acg_hostsim_subexchange(int8_t* w, int16_t* hb, int64_t n,
                              const int32_t* A, const int32_t* B,
                              int64_t n_pairs,
                              int32_t salt, uint32_t run_salt,
@@ -225,6 +231,15 @@ long acg_hostsim_subexchange(int8_t* w, int64_t n,
         const int64_t a = A[k], b = B[k];
         int8_t* __restrict ra = w + a * n;
         int8_t* __restrict rb = w + b * n;
+        if (hb) {
+            int16_t* __restrict ha = hb + a * n;
+            int16_t* __restrict hbp = hb + b * n;
+            for (int64_t j = 0; j < n; ++j) {
+                int16_t m = ha[j] > hbp[j] ? ha[j] : hbp[j];
+                ha[j] = m;
+                hbp[j] = m;
+            }
+        }
         // Pass 1: both directions' total deficits (rows land in cache
         // for pass 2).
         int32_t tota = 0, totb = 0;
@@ -278,6 +293,113 @@ void acg_hostsim_diag(int8_t* w, int64_t n, const int32_t* mv) {
     for (int64_t i = 0; i < n; ++i) {
         int32_t v = mv[i];
         w[i * n + i] = (int8_t)v;
+    }
+}
+
+// Heartbeat diagonal refresh: hb[i, i] = heartbeat[i] (the hbv_vec
+// select in sim_step — runs BEFORE the round-start copy the FD reads).
+void acg_hostsim_diag_hb(int16_t* hb, int64_t n, const int32_t* hbv) {
+    for (int64_t i = 0; i < n; ++i) {
+        hb[i * n + i] = (int16_t)hbv[i];
+    }
+}
+
+namespace {
+
+// XLA's f32 -> bf16 convert (round-to-nearest-even). Values here are
+// finite interval means, so no NaN handling is needed.
+inline uint16_t f32_to_bf16(float f) {
+    uint32_t x;
+    __builtin_memcpy(&x, &f, 4);
+    uint32_t lsb = (x >> 16) & 1u;
+    x += 0x7FFFu + lsb;
+    return (uint16_t)(x >> 16);
+}
+
+inline float bf16_to_f32(uint16_t b) {
+    uint32_t x = ((uint32_t)b) << 16;
+    float f;
+    __builtin_memcpy(&f, &x, 4);
+    return f;
+}
+
+}  // namespace
+
+// One full vectorized phi-accrual FD round — the elementwise twin of
+// gossip.py sim_step's XLA failure-detector block (the branch with no
+// churn and no lifecycle: the host fast-path domain). Per element
+// (observer row i, owner j), every op mirrors one XLA f32/int op in the
+// same order, so the result is bit-identical:
+//   increased  = hb > hb0                       (post vs round-start)
+//   never_seen = lc == 0
+//   interval   = (f32)(tick - lc)
+//   sampled    = increased & !never_seen & interval <= max_interval
+//   icount'    = min(icount + sampled, window)          (int16)
+//   imean'     = sampled ? imean + (interval - imean)/max((f32)icount', 1)
+//                        : imean                        (f32 math)
+//   lc'        = increased ? tick : lc
+//   elapsed    = (f32)(tick - lc')
+//   live       = icount' >= 1 &&
+//                elapsed * ((f32)icount' + pw)
+//                  <= phi * (imean' * (f32)icount' + pw_pm)
+//   live      |= (i == j)                       (self-belief diagonal)
+//   imean_out  = live ? imean' : 0    (stored at fd dtype: f32 or bf16,
+//                                      rounded AFTER the live test, as
+//                                      XLA's .astype does)
+//   icount_out = live ? icount' : 0
+// pw/phi are the f32 casts of the config floats; pw_pm is
+// f32(double(prior_weight) * double(prior_mean_ticks)) — the exact
+// value XLA folds for its `pw * pm` scalar.
+void acg_hostsim_fd(const int16_t* hb, const int16_t* hb0,
+                    int16_t* lc, void* imean, int32_t imean_is_bf16,
+                    int16_t* icount, uint8_t* live_view,
+                    int64_t n, int32_t tick,
+                    int32_t max_interval, int32_t window,
+                    float pw, float pw_pm, float phi) {
+    const int16_t tick16 = (int16_t)tick;
+    for (int64_t i = 0; i < n; ++i) {
+        const int16_t* __restrict hrow = hb + i * n;
+        const int16_t* __restrict h0row = hb0 + i * n;
+        int16_t* __restrict lrow = lc + i * n;
+        int16_t* __restrict crow = icount + i * n;
+        uint8_t* __restrict vrow = live_view + i * n;
+        float* __restrict mrow_f32 =
+            imean_is_bf16 ? nullptr : (float*)imean + i * n;
+        uint16_t* __restrict mrow_bf16 =
+            imean_is_bf16 ? (uint16_t*)imean + i * n : nullptr;
+        for (int64_t j = 0; j < n; ++j) {
+            const bool increased = hrow[j] > h0row[j];
+            const int32_t lc_old = lrow[j];
+            const int32_t interval_i = tick - lc_old;
+            const bool sampled = increased && lc_old != 0 &&
+                                 interval_i <= max_interval;
+            int32_t cnt = (int32_t)crow[j] + (sampled ? 1 : 0);
+            cnt = cnt < window ? cnt : window;
+            float mean = mrow_bf16 ? bf16_to_f32(mrow_bf16[j])
+                                   : mrow_f32[j];
+            if (sampled) {
+                const float interval = (float)interval_i;
+                float denom = (float)cnt;
+                denom = denom > 1.0f ? denom : 1.0f;
+                mean = mean + (interval - mean) / denom;
+            }
+            const int16_t lc_new = increased ? tick16 : (int16_t)lc_old;
+            const float elapsed = (float)(tick - (int32_t)lc_new);
+            const float cnt_f = (float)cnt;
+            bool live = cnt >= 1 &&
+                        elapsed * (cnt_f + pw) <=
+                            phi * (mean * cnt_f + pw_pm);
+            live = live || i == j;
+            lrow[j] = lc_new;
+            crow[j] = live ? (int16_t)cnt : (int16_t)0;
+            vrow[j] = live ? 1 : 0;
+            const float mean_out = live ? mean : 0.0f;
+            if (mrow_bf16) {
+                mrow_bf16[j] = f32_to_bf16(mean_out);
+            } else {
+                mrow_f32[j] = mean_out;
+            }
+        }
     }
 }
 

@@ -54,15 +54,19 @@ def _build() -> ctypes.CDLL | None:
     """Shared compile-and-cache loader (utils/cbuild.py — the host-ISA
     cache key matters here because of -march=native). The aggressive
     flags change instruction selection, not IEEE f32 results, so the
-    build stays bit-exact with the scalar path."""
+    build stays bit-exact with the scalar path. ``-ffp-contract=off``
+    is load-bearing: the FD pass has a mul+add (mean*count + pw*pm)
+    that GCC's default contraction would fuse into an FMA, while XLA
+    emits separate f32 multiply and add ops."""
     lib = build_and_load(
-        _SRC, flags=("-O3", "-march=native", "-funroll-loops")
+        _SRC,
+        flags=("-O3", "-march=native", "-funroll-loops", "-ffp-contract=off"),
     )
     if lib is None:
         return None
     lib.acg_hostsim_subexchange.restype = ctypes.c_long
     lib.acg_hostsim_subexchange.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_int32, ctypes.c_uint32, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_void_p,
@@ -70,6 +74,19 @@ def _build() -> ctypes.CDLL | None:
     lib.acg_hostsim_diag.restype = None
     lib.acg_hostsim_diag.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.acg_hostsim_diag_hb.restype = None
+    lib.acg_hostsim_diag_hb.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.acg_hostsim_fd.restype = None
+    lib.acg_hostsim_fd.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,
     ]
     return lib
 
@@ -89,9 +106,32 @@ def available() -> bool:
 def supported(cfg: SimConfig) -> bool:
     """The exact domain on which HostSimulator's trajectory equals
     Simulator's. Everything here mirrors a branch sim_step would take
-    differently (and the kernel only implements int16)."""
+    differently (and the kernel only implements int16/int8).
+
+    Two profiles qualify: the lean convergence-only profile (round 4)
+    and — new in round 5 — the FULL profile (heartbeats + phi-accrual
+    failure detector, the reference's actual operating shape,
+    server.py:471-474 + failure_detector.py:56-128), as long as the
+    heartbeat matrices are int16 and there is no churn/lifecycle/writes:
+    on that domain the FD block is purely elementwise
+    (_hostsim.cpp::acg_hostsim_fd mirrors it op-for-op) and peer
+    validity masks are all-true, so the w trajectory is shared with the
+    lean profile while hb/FD state walks the exact XLA trajectory."""
+    profile_ok = (
+        # lean: no heartbeat/FD matrices at all
+        (not cfg.track_heartbeats and not cfg.track_failure_detector)
+        # full: hb (+ optionally FD) at int16 ticks; the FD pass stamps
+        # last_change with an int16 tick, so the horizon contract is
+        # the same one Simulator's int16 heartbeat_dtype carries
+        or (
+            cfg.track_heartbeats
+            and cfg.heartbeat_dtype == "int16"
+            and cfg.dead_grace_ticks is None
+        )
+    )
     return (
-        cfg.pairing == "matching"
+        profile_ok
+        and cfg.pairing == "matching"
         and cfg.budget_policy == "proportional"
         and cfg.n_nodes % 128 == 0
         and cfg.version_dtype == "int16"
@@ -104,8 +144,6 @@ def supported(cfg: SimConfig) -> bool:
         # kernel in int32, and the two agree only below 2^24
         # (_hostsim.cpp header). Max possible total = K * (n - 1).
         and cfg.keys_per_node * (cfg.n_nodes - 1) < 2**24
-        and not cfg.track_heartbeats
-        and not cfg.track_failure_detector
         and cfg.death_rate == 0.0
         and cfg.revival_rate == 0.0
         and cfg.writes_per_round == 0
@@ -124,6 +162,7 @@ class HostSimulator:
         seed: int = 0,
         state_w: np.ndarray | None = None,
         tick: int = 0,
+        state_extra: dict[str, np.ndarray] | None = None,
     ) -> None:
         if not supported(cfg):
             raise ValueError(
@@ -157,6 +196,48 @@ class HostSimulator:
             self.w = np.ascontiguousarray(state_w)
         self.tick = int(tick)
         self._row_min = np.zeros((n,), dtype=np.int32)
+        # Full-profile state (mirrors init_state's hb/FD matrices at the
+        # Simulator's exact dtypes — the bit-identity tests compare these
+        # arrays directly). ``state_extra`` restores them on resume.
+        self._track_hb = cfg.track_heartbeats
+        self._track_fd = cfg.track_failure_detector
+        if self._track_hb:
+            extra = state_extra or {}
+
+            def take(name, default):
+                arr = extra.get(name)
+                if arr is None:
+                    return default
+                # Hard errors, not asserts: under python -O a
+                # wrong-shape array would flow straight into the
+                # raw-pointer C kernels.
+                if arr.shape != default.shape or arr.dtype != default.dtype:
+                    raise ValueError(
+                        f"checkpoint {name}: {arr.dtype}{arr.shape} != "
+                        f"expected {default.dtype}{default.shape}"
+                    )
+                return np.ascontiguousarray(arr)
+
+            hb0 = np.zeros((n, n), np.int16)
+            np.fill_diagonal(hb0, 1)
+            self.hb = take("hb", hb0)
+            self.heartbeat = take(
+                "heartbeat", np.ones((n,), np.int32)
+            )
+        if self._track_fd:
+            self._fd_bf16 = cfg.fd_dtype == "bfloat16"
+            if self._fd_bf16:
+                import ml_dtypes
+
+                imean_dtype = np.dtype(ml_dtypes.bfloat16)
+            else:
+                imean_dtype = np.dtype(np.float32)
+            self.last_change = take(
+                "last_change", np.zeros((n, n), np.int16)
+            )
+            self.imean = take("imean", np.zeros((n, n), imean_dtype))
+            self.icount = take("icount", np.zeros((n, n), np.int16))
+            self.live_view = take("live_view", np.eye(n, dtype=bool))
         # Same key derivation as Simulator: base key from the seed; the
         # per-round salt is random.bits(base_key) exactly as sim_step
         # computes it (gossip.py run_salt).
@@ -214,21 +295,64 @@ class HostSimulator:
         all-converged flag when ``track`` (else False)."""
         tick = self.tick + 1
         n = self.cfg.n_nodes
+        hb_ptr = None
+        hb0 = None
+        if self._track_hb:
+            # heartbeat = tick + 1 (starts at 1), so the last safe tick
+            # is 32766 — at 32767 the owner's self-heartbeat would wrap
+            # to int16 minimum on the diagonal refresh.
+            if tick + 1 >= 2**15:
+                raise RuntimeError(
+                    "tick horizon exceeds the int16 heartbeat matrices"
+                )
+            # Owner-side activity: every node is alive on this domain.
+            self.heartbeat += 1
+            self._lib.acg_hostsim_diag_hb(
+                self.hb.ctypes.data, n, self.heartbeat.ctypes.data
+            )
+            hb_ptr = self.hb.ctypes.data
         self._lib.acg_hostsim_diag(
             self.w.ctypes.data, n, self.max_version.ctypes.data
         )
+        if self._track_fd:
+            # The FD compares against the round-start matrix (post
+            # diagonal refresh, pre exchanges) — sim_step's
+            # hb_round_start. Reuse one preallocated buffer: a fresh
+            # (n, n) copy per round would be a multi-GB mmap+fault
+            # cycle at scale.
+            if not hasattr(self, "_hb0"):
+                self._hb0 = np.empty_like(self.hb)
+            hb0 = self._hb0
+            np.copyto(hb0, self.hb)
         pairs = self._round_pairs(tick)
         fan = self.cfg.fanout
         for c, (a, b) in enumerate(pairs):
             last = c == fan - 1
             salt = tick * (2 * fan) + 2 * c  # gossip.py sub_salt(c, 0)
             self._lib.acg_hostsim_subexchange(
-                self.w.ctypes.data, n,
+                self.w.ctypes.data, hb_ptr, n,
                 a.ctypes.data, b.ctypes.data, len(a),
                 np.int32(salt), np.uint32(self._run_salt),
                 self.cfg.budget,
                 1 if (track and last) else 0,
                 self._row_min.ctypes.data,
+            )
+        if self._track_fd:
+            cfg = self.cfg
+            self._lib.acg_hostsim_fd(
+                self.hb.ctypes.data, hb0.ctypes.data,
+                self.last_change.ctypes.data,
+                self.imean.ctypes.data, 1 if self._fd_bf16 else 0,
+                self.icount.ctypes.data, self.live_view.ctypes.data,
+                n, np.int32(tick),
+                np.int32(cfg.max_interval_ticks),
+                np.int32(cfg.window_ticks),
+                # The f32 scalars exactly as XLA folds them: pw and phi
+                # are f32 casts of the config doubles; pw*pm multiplies
+                # in doubles FIRST (Python) and casts the product.
+                float(np.float32(cfg.prior_weight)),
+                float(np.float32(cfg.prior_weight * cfg.prior_mean_ticks)),
+                float(np.float32(cfg.phi_threshold)),
             )
         self.tick = tick
         if not track:
@@ -273,13 +397,27 @@ class HostSimulator:
 
     # -- checkpointing --------------------------------------------------------
 
+    _EXTRA_FIELDS = ("hb", "heartbeat", "last_change", "imean", "icount",
+                     "live_view")
+
     def save(self, path: str) -> None:
         """Raw checkpoint (np.save of the int8 matrix — 10 GB at the
         100k scale — plus a JSON sidecar), cheap enough to take every
-        few dozen rounds."""
+        few dozen rounds. Full-profile runs save each hb/FD matrix as
+        its own sidecar .npy (one np.save per array keeps peak memory
+        flat — an npz would buffer a second copy)."""
         tmp = f"{path}.w.tmp.npy"
         np.save(tmp, self.w)
         os.replace(tmp, f"{path}.w.npy")
+        extras = [f for f in self._EXTRA_FIELDS if hasattr(self, f)]
+        for name in extras:
+            arr = getattr(self, name)
+            if arr.dtype == bool:
+                arr = arr.view(np.uint8)
+            elif arr.dtype.name == "bfloat16":
+                arr = arr.view(np.uint16)
+            np.save(f"{path}.{name}.tmp.npy", arr)
+            os.replace(f"{path}.{name}.tmp.npy", f"{path}.{name}.npy")
         meta = {
             "tick": self.tick,
             "seed": self.seed,
@@ -287,6 +425,8 @@ class HostSimulator:
             "keys_per_node": self.cfg.keys_per_node,
             "fanout": self.cfg.fanout,
             "budget": self.cfg.budget,
+            "extras": extras,
+            "fd_dtype": self.cfg.fd_dtype if self._track_fd else None,
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
         with open(f"{path}.json.tmp", "w") as f:
@@ -303,5 +443,37 @@ class HostSimulator:
                     f"checkpoint {field}={meta[field]} != cfg "
                     f"{getattr(cfg, field)}"
                 )
+        saved = set(meta.get("extras", []))
+        wanted = {
+            f
+            for f in cls._EXTRA_FIELDS
+            if (cfg.track_heartbeats and f in ("hb", "heartbeat"))
+            or (
+                cfg.track_failure_detector
+                and f in ("last_change", "imean", "icount", "live_view")
+            )
+        }
+        if saved != wanted:
+            raise ValueError(
+                f"checkpoint profile {sorted(saved)} != cfg profile "
+                f"{sorted(wanted)}"
+            )
+        if wanted and meta.get("fd_dtype") not in (None, cfg.fd_dtype):
+            raise ValueError(
+                f"checkpoint fd_dtype={meta['fd_dtype']} != cfg {cfg.fd_dtype}"
+            )
+        extra = {}
+        for name in saved:
+            arr = np.load(f"{path}.{name}.npy")
+            if name == "live_view":
+                arr = arr.view(bool)
+            elif name == "imean" and cfg.fd_dtype == "bfloat16":
+                import ml_dtypes
+
+                arr = arr.view(ml_dtypes.bfloat16)
+            extra[name] = arr
         w = np.load(f"{path}.w.npy")
-        return cls(cfg, seed=meta["seed"], state_w=w, tick=meta["tick"])
+        return cls(
+            cfg, seed=meta["seed"], state_w=w, tick=meta["tick"],
+            state_extra=extra or None,
+        )

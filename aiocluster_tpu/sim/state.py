@@ -56,6 +56,14 @@ def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> Sim
     heartbeat at boot, runtime/cluster.py)."""
     n = cfg.n_nodes
     fd_shape = (n, n) if cfg.track_failure_detector else (0, 0)
+    # dead_since only drives the two-stage lifecycle; without it the FD
+    # branch passes the array through untouched, so a zero-sized matrix
+    # saves a full (N, N) heartbeat-dtype allocation (20 GB at 100k).
+    ds_shape = (
+        (n, n)
+        if cfg.track_failure_detector and cfg.dead_grace_ticks is not None
+        else (0, 0)
+    )
     eye = jnp.eye(n, dtype=bool)
     vdt = jnp.dtype(cfg.version_dtype)
     hdt = jnp.dtype(cfg.heartbeat_dtype)
@@ -78,5 +86,5 @@ def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> Sim
         live_view=jnp.eye(*fd_shape, dtype=bool)
         if cfg.track_failure_detector
         else jnp.zeros(fd_shape, bool),
-        dead_since=jnp.zeros(fd_shape, hdt),
+        dead_since=jnp.zeros(ds_shape, hdt),
     )

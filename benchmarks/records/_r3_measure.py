@@ -330,6 +330,17 @@ def phase_lean_scaling() -> dict:
     return result
 
 
+def _is_oom_msg(msg: str) -> bool:
+    """XLA spells device OOM several ways (same heuristic as
+    bench._is_oom — one battery-local copy shared by both ladders)."""
+    low = msg.lower()
+    return (
+        "resource_exhausted" in low
+        or "resource exhausted" in low
+        or "out of memory" in low
+    )
+
+
 def phase_max_scale() -> dict:
     """Empirical largest single-chip lean N: the planner said 52,096
     fits in 12 GiB of a 16 GiB chip, the chip said RESOURCE_EXHAUSTED
@@ -370,12 +381,7 @@ def phase_max_scale() -> dict:
             msg = repr(exc)
             tried.append({"n": n, "ok": False, "error": msg[:300]})
             log(f"max-scale: n={n} failed: {msg[:120]}")
-            low = msg.lower()
-            if (
-                "resource_exhausted" not in low
-                and "resource exhausted" not in low
-                and "out of memory" not in low
-            ):
+            if not _is_oom_msg(msg):
                 break  # not an OOM — don't keep hammering a down tunnel
             note_boundary(n, False)
     if largest is None:
@@ -384,6 +390,82 @@ def phase_max_scale() -> dict:
         # window retries instead of the skip logic calling this done.
         return {"error": "no rung fit/ran", "ladder": tried}
     return {"largest_fitting_n": largest, "ladder": tried}
+
+
+# -- phase: full-profile (heartbeats + FD) single-chip ladder -----------------
+
+
+def _full(n, **kw):
+    from aiocluster_tpu.sim import budget_from_mtu
+    from aiocluster_tpu.sim.memory import full_config
+
+    return full_config(n, budget=budget_from_mtu(65_507), **kw)
+
+
+def phase_full_scale() -> dict:
+    """Measured largest single-chip FULL-profile N (VERDICT r4 next item
+    3b): everything >= 65k the repo has measured is the lean profile,
+    which the reference cannot even run (it never gossips without
+    heartbeats, reference server.py:471-474). Walk the 128-aligned
+    ladder at full FD fidelity (int16 heartbeats, bf16 means — the
+    narrowest exact dtypes), record every fit/OOM boundary, and take the
+    round rate at the largest fitting rung plus a full-vs-lean rate pair
+    at the 10,240 headline scale (what FD fidelity costs per round)."""
+    from aiocluster_tpu.sim import Simulator
+    from aiocluster_tpu.sim.memory import plan, record_boundary
+
+    def note_boundary(n, fits, rps=None):
+        try:
+            record_boundary(
+                _full(n), 1, fits, rounds_per_sec=rps,
+                source="battery full_scale phase (on-chip)",
+            )
+        except Exception as exc:
+            log(f"boundary record failed: {exc!r}")
+
+    tried = []
+    largest = None
+    rate = None
+    # Top rung one step ABOVE the plan's 32,512 single-chip claim (the
+    # lean plan over-promised once — test the model from both sides),
+    # then walk down.
+    for n in (34_816, 32_512, 30_720, 28_672, 24_576):
+        try:
+            sim = Simulator(_full(n), seed=0, chunk=8)
+            sim.run(8)
+            _sync(sim.state.tick)
+            rate = _rate(sim, rounds=32, chunk=8, trials=2)
+            tried.append({"n": n, "ok": True, "rounds_per_sec": rate})
+            largest = n
+            note_boundary(n, True, rate)
+            log(f"full-scale: n={n} fits, {rate} rounds/s")
+            break
+        except Exception as exc:
+            msg = repr(exc)
+            tried.append({"n": n, "ok": False, "error": msg[:300]})
+            log(f"full-scale: n={n} failed: {msg[:120]}")
+            if not _is_oom_msg(msg):
+                break  # not an OOM — don't keep hammering a down tunnel
+            note_boundary(n, False)
+    if largest is None:
+        return {"error": "no full-profile rung fit/ran", "ladder": tried}
+    # FD fidelity cost at the headline scale (full vs lean, same seed).
+    full_10k = _rate(Simulator(_full(10_240), seed=0, chunk=16), rounds=64)
+    lean_10k = _rate(Simulator(_lean(10_240), seed=0, chunk=16), rounds=64)
+    return {
+        "largest_fitting_n": largest,
+        "rounds_per_sec_at_largest": rate,
+        "ladder": tried,
+        "planned_single_chip_n": 32_512,
+        "per_shard_gb_at_largest": round(
+            plan(_full(largest)).per_shard_bytes / 2**30, 2
+        ),
+        "full_10240_rounds_per_sec": full_10k,
+        "lean_10240_rounds_per_sec": lean_10k,
+        "fd_fidelity_cost": (
+            round(1 - full_10k / lean_10k, 4) if lean_10k else None
+        ),
+    }
 
 
 def _northstar_projection(points: list[dict]) -> dict:
@@ -705,6 +787,7 @@ PHASES = [
     ("churn_kernel_ceiling", phase_churn_kernel_ceiling, 900),
     ("scatter_share", phase_scatter_share, 900),
     ("max_scale", phase_max_scale, 1500),
+    ("full_scale", phase_full_scale, 1500),
     ("lean_scaling", phase_lean_scaling, 3600),
 ]
 

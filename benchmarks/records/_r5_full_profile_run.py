@@ -1,0 +1,197 @@
+"""Round-5 full-profile scale runs: exact rounds-to-convergence with
+heartbeats + phi-accrual failure detection (the reference's actual
+operating shape — it never gossips without heartbeats, reference
+server.py:471-474) at N >= 32k, on the native host fast-path.
+
+VERDICT r4 missing item 5 / next item 3(c): everything the repo had
+measured at >= 65k was the lean profile; the reference cannot even run
+that shape. This script produces the owed full-profile exact-R data:
+
+1. ``HostSimulator`` on ``full_config(N, budget=2618)`` — heartbeat and
+   FD matrices at int16/bf16, bit-identical to the XLA ``Simulator``
+   trajectory in EVERY state matrix (tests/test_hostsim.py
+   test_full_profile_bit_identity) — run to first convergence;
+2. sha256 digests of all six state matrices at ticks 1-2 and a near-end
+   checkpoint, so ``_r5_full_certify.py`` can replay the prefix and the
+   final round through the real 8-device-mesh shard_map path.
+
+On this domain the FD cannot feed back into the watermark trajectory
+(no churn, no lifecycle: validity masks are all-true and the matching
+ignores live views), so R must equal the lean R at the same seed — the
+run MEASURES that equality at scale instead of assuming it
+(test_full_profile_matches_lean_w_trajectory proves it at 256).
+
+Etiquette on the shared 1-core host: pauses (with a checkpoint)
+whenever the on-chip measurement battery is running.
+
+Usage: python _r5_full_profile_run.py --n 32768
+Builder-side tooling (not part of the shipped package).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, REPO)
+
+RESULT = os.path.join(HERE, "r5_full_profile_convergence.json")
+CHECKPOINT_EVERY = 25
+MAX_ROUNDS = 2048
+SEED = 1  # the battery/bench fresh-cluster convergence seed
+
+
+def log(msg: str) -> None:
+    print(f"[full-profile] {msg}", file=sys.stderr, flush=True)
+
+
+def battery_running() -> bool:
+    me = os.getpid()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace")
+        except OSError:
+            continue
+        if "_r3_measure.py" in cmd or "_r5_measure" in cmd:
+            return True
+    return False
+
+
+def state_digests(host) -> dict:
+    """Canonical sha256 per state matrix (host-native dtypes; the mesh
+    side converts losslessly: int16 w -> int8, bool/bf16 as raw bytes)."""
+    out = {"w": hashlib.sha256(host.w.tobytes()).hexdigest()}
+    import numpy as np
+
+    out["hb"] = hashlib.sha256(host.hb.tobytes()).hexdigest()
+    out["last_change"] = hashlib.sha256(host.last_change.tobytes()).hexdigest()
+    out["imean"] = hashlib.sha256(
+        host.imean.view(np.uint16).tobytes()
+    ).hexdigest()
+    out["icount"] = hashlib.sha256(host.icount.tobytes()).hexdigest()
+    out["live_view"] = hashlib.sha256(host.live_view.tobytes()).hexdigest()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--seed", type=int, default=SEED)
+    args = ap.parse_args()
+    n = args.n
+
+    from aiocluster_tpu.sim import budget_from_mtu
+    from aiocluster_tpu.sim.hostsim import HostSimulator
+    from aiocluster_tpu.sim.memory import full_config, plan
+
+    ckpt = os.path.join(HERE, f"_r5_full_{n}_ckpt")
+    near = os.path.join(HERE, f"_r5_full_{n}_near")
+    progress_path = os.path.join(HERE, f"_r5_full_{n}_progress.jsonl")
+
+    def progress(rec: dict) -> None:
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(progress_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    cfg = full_config(n, budget=budget_from_mtu(65_507))
+    if os.path.exists(ckpt + ".json"):
+        host = HostSimulator.resume(ckpt, cfg)
+        log(f"resumed at tick {host.tick}")
+    else:
+        host = HostSimulator(cfg, seed=args.seed)
+        log(f"fresh run: n={n} budget={cfg.budget} seed={args.seed}")
+
+    state = {"last_wall": time.perf_counter(), "round_s": []}
+
+    def on_round(tick: int) -> None:
+        now = time.perf_counter()
+        dt = now - state["last_wall"]
+        state["last_wall"] = now
+        state["round_s"].append(dt)
+        min_w = int(host._row_min.min())
+        progress({"tick": tick, "round_s": round(dt, 1), "min_w": min_w})
+        if tick % 10 == 0 or dt > 120:
+            log(f"round {tick}: {dt:.1f}s, min watermark {min_w}/"
+                f"{cfg.keys_per_node}")
+        if tick in (1, 2):
+            d = state_digests(host)
+            progress({"tick": tick, "digests": d})
+            log(f"prefix digests @ {tick}: w={d['w'][:16]}…")
+        near_end = min_w >= cfg.keys_per_node - 1
+        if near_end:
+            host.save(near)
+        elif tick % CHECKPOINT_EVERY == 0:
+            host.save(ckpt)
+            log(f"checkpoint at {tick}")
+        if battery_running():
+            host.save(ckpt)
+            log("battery running — pausing (chip windows beat CPU hours)")
+            while battery_running():
+                time.sleep(60)
+            log("battery done — resuming")
+            state["last_wall"] = time.perf_counter()
+
+    t0 = time.perf_counter()
+    converged = host.run_until_converged(
+        max_rounds=MAX_ROUNDS, on_round=on_round
+    )
+    wall = time.perf_counter() - t0
+    if converged is None:
+        log(f"NOT CONVERGED within {MAX_ROUNDS} rounds — no record written")
+        host.save(ckpt)
+        sys.exit(2)
+    mem = plan(cfg, shards=1)
+    entry = {
+        "metric": "full_profile_rounds_to_convergence",
+        "value": converged,
+        "unit": "rounds",
+        "n_nodes": n,
+        "budget": cfg.budget,
+        "seed": args.seed,
+        "profile": "full (heartbeats int16 + phi-accrual FD, bf16 means)",
+        "engine": "native host fast-path (sim/hostsim.py) — bit-identical"
+                  " to the XLA path in every state matrix"
+                  " (tests/test_hostsim.py::test_full_profile_bit_identity)",
+        "wall_seconds_host_path": round(wall, 1),
+        "mean_round_seconds_host_path": round(
+            sum(state["round_s"]) / max(len(state["round_s"]), 1), 2
+        ),
+        "sim_state_bytes_xla": mem.state_bytes,
+        "certification": "pending: _r5_full_certify.py replays ticks 1-2"
+                         " digests and the final round on the 8-device"
+                         " virtual mesh from the R-1 checkpoint",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    # Merge into the multi-N record file.
+    rec = {}
+    if os.path.exists(RESULT):
+        with open(RESULT) as f:
+            rec = json.load(f)
+    rec[str(n)] = entry
+    with open(RESULT + ".tmp", "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(RESULT + ".tmp", RESULT)
+    # The periodic checkpoint is no longer needed; the near slot stays
+    # for certification.
+    for suff in (".json", ".w.npy", ".hb.npy", ".heartbeat.npy",
+                 ".last_change.npy", ".imean.npy", ".icount.npy",
+                 ".live_view.npy"):
+        try:
+            os.remove(ckpt + suff)
+        except OSError:
+            pass
+    log(f"DONE: n={n} converged at round {converged} ({wall:.0f}s)")
+    print(json.dumps(entry), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,9 +131,14 @@ async def _boot_loopback_clusters(
         except BaseException as exc:
             # Tear down whatever started no matter what failed — a
             # leaked cluster keeps its server + ticker running and
-            # gossips into subsequent configs.
+            # gossips into subsequent configs. Each close is isolated:
+            # one failing teardown must not leak the rest or replace
+            # the original error.
             for c in started:
-                await c.close()
+                try:
+                    await c.close()
+                except BaseException as close_exc:
+                    log(f"config 1: cleanup close failed: {close_exc!r}")
             if not (isinstance(exc, OSError) and exc.errno == errno.EADDRINUSE):
                 raise
             last_exc = exc

@@ -55,14 +55,26 @@ def test_cli_sim_host_native():
     assert record["shards"] == 1
     assert record["metrics"]["all_converged"] is True
     assert record["metrics"]["converged_owners"] == 256
-    # Off-domain request fails cleanly, not with a traceback.
+    # The FULL profile runs natively too (round 5: --host-native
+    # implies the int16/bf16 scale dtypes), and — the FD not feeding
+    # back on this domain — converges at the exact same round.
+    full = subprocess.run(
+        [sys.executable, "-m", "aiocluster_tpu", "sim",
+         "--nodes", "256", "--host-native", "--seed", "1",
+         "--max-rounds", "500"],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert full.returncode == 0, full.stderr[-800:]
+    frec = json.loads(full.stdout.strip().splitlines()[-1])
+    assert frec["rounds_to_convergence"] == record["rounds_to_convergence"]
+    # Off-domain request (churn) fails cleanly, not with a traceback.
     bad = subprocess.run(
         [sys.executable, "-m", "aiocluster_tpu", "sim",
-         "--nodes", "256", "--host-native"],  # full fidelity: off-domain
+         "--nodes", "256", "--host-native", "--churn", "0.05"],
         capture_output=True, text=True, timeout=120, cwd=REPO,
     )
     assert bad.returncode == 2
-    assert "lean matching domain" in bad.stderr
+    assert "matching domain" in bad.stderr
 
 
 def test_cli_sim_sharded_lean():

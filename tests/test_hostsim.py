@@ -10,7 +10,7 @@ import pytest
 
 from aiocluster_tpu.sim import SimConfig, Simulator
 from aiocluster_tpu.sim.hostsim import HostSimulator, available, supported
-from aiocluster_tpu.sim.memory import lean_config
+from aiocluster_tpu.sim.memory import full_config, lean_config
 
 pytestmark = pytest.mark.skipif(
     not available(), reason="native hostsim failed to build"
@@ -116,8 +116,114 @@ def test_supported_gate():
     assert not supported(
         lean_config(1024, version_dtype="int32")
     )
+    # Full profile: on the domain at int16 heartbeat ticks (round 5),
+    # but NOT at the default int32 (the kernel implements int16 only)
+    # and NOT with the lifecycle/churn/writes branches.
+    assert supported(full_config(1024))
+    assert supported(full_config(1024, fd_dtype="float32"))
     assert not supported(
         SimConfig(n_nodes=1024, keys_per_node=16, fanout=3, budget=64)
-    )  # full-fidelity profile (FD on) is outside the domain
+    )  # default heartbeat_dtype=int32
+    assert not supported(full_config(1024, dead_grace_ticks=64))
+    assert not supported(full_config(1024, death_rate=0.05))
+    assert not supported(full_config(1024, writes_per_round=1))
     with pytest.raises(ValueError):
         HostSimulator(lean_config(1000))
+
+
+# -- full profile (heartbeats + failure detector), round 5 -------------------
+
+
+def _full_state_equal(sim, host, r, fd_dtype):
+    s = sim.state
+    np.testing.assert_array_equal(
+        np.asarray(s.w), host.w, err_msg=f"w divergence at round {r}"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s.hb_known), host.hb, err_msg=f"hb divergence at round {r}"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s.last_change), host.last_change,
+        err_msg=f"last_change divergence at round {r}",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s.icount), host.icount,
+        err_msg=f"icount divergence at round {r}",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s.live_view), host.live_view,
+        err_msg=f"live_view divergence at round {r}",
+    )
+    a, b = np.asarray(s.imean), host.imean
+    if fd_dtype == "bfloat16":
+        a, b = a.view(np.uint16), b.view(np.uint16)
+    np.testing.assert_array_equal(
+        a, b, err_msg=f"imean divergence at round {r}"
+    )
+
+
+@pytest.mark.parametrize("fd_dtype", ["bfloat16", "float32"])
+def test_full_profile_bit_identity(fd_dtype):
+    """The FULL profile (heartbeats + phi-accrual FD — the reference's
+    actual operating shape) walks the Simulator's exact trajectory in
+    EVERY state matrix, at both stored-mean dtypes. Small budget keeps
+    the watermark advance in the dithered budget-bound regime."""
+    cfg = full_config(256, budget=24, fd_dtype=fd_dtype)
+    sim = Simulator(cfg, seed=7, chunk=1)
+    host = HostSimulator(cfg, seed=7)
+    for r in range(1, 9):
+        sim.run(1)
+        host.run(1)
+        _full_state_equal(sim, host, r, fd_dtype)
+
+
+def test_full_profile_convergence_round_matches():
+    cfg = full_config(256, budget=64)
+    r_sim = Simulator(cfg, seed=8, chunk=4).run_until_converged(
+        max_rounds=512
+    )
+    r_host = HostSimulator(cfg, seed=8).run_until_converged(max_rounds=512)
+    assert r_sim is not None
+    assert r_host == r_sim
+
+
+def test_full_profile_matches_lean_w_trajectory():
+    """On the no-churn/no-lifecycle domain the FD never feeds back into
+    the watermark advance (validity masks are all-true, peer choice is
+    the matching), so the full profile's w trajectory — and therefore
+    its convergence round — must equal the lean profile's at the same
+    seed. This is why the lean 100k R generalizes to the full profile."""
+    lean = lean_config(256, budget=24)
+    full = full_config(256, budget=24)
+    a = HostSimulator(lean, seed=9)
+    b = HostSimulator(full, seed=9)
+    for _ in range(6):
+        a.run(1)
+        b.run(1)
+        np.testing.assert_array_equal(a.w, b.w)
+
+
+def test_full_profile_checkpoint_resume(tmp_path):
+    """save/resume round-trips every full-profile matrix exactly."""
+    cfg = full_config(256, budget=64)
+    a = HostSimulator(cfg, seed=10)
+    a.run(5)
+    a.save(str(tmp_path / "ck"))
+    b = HostSimulator.resume(str(tmp_path / "ck"), cfg)
+    assert b.tick == 5
+    a.run(4)
+    b.run(4)
+    np.testing.assert_array_equal(a.w, b.w)
+    np.testing.assert_array_equal(a.hb, b.hb)
+    np.testing.assert_array_equal(
+        a.imean.view(np.uint16), b.imean.view(np.uint16)
+    )
+    np.testing.assert_array_equal(a.icount, b.icount)
+    np.testing.assert_array_equal(a.live_view, b.live_view)
+    # Lean checkpoints refuse to resume under a full-profile config
+    # (missing matrices must not be silently zero-initialized).
+    lean = lean_config(256, budget=64)
+    c = HostSimulator(lean, seed=10)
+    c.save(str(tmp_path / "lk"))
+    with pytest.raises(ValueError):
+        HostSimulator.resume(str(tmp_path / "lk"), cfg)
