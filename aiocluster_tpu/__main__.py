@@ -121,6 +121,16 @@ def _run_sim(args: argparse.Namespace, cfg) -> int:
                 file=sys.stderr,
             )
             return 2
+        if cfg.track_heartbeats and args.max_rounds > 32_766:
+            # The full profile's int16 heartbeat matrices cap the run
+            # horizon; clamp up front rather than dying mid-run with
+            # the kernel's RuntimeError after hours of compute.
+            print(
+                "--host-native full profile: clamping --max-rounds to "
+                "32766 (int16 heartbeat horizon)",
+                file=sys.stderr,
+            )
+            args.max_rounds = 32_766
         if not hostsim.available():
             print("native hostsim build failed (g++ unavailable?)",
                   file=sys.stderr)

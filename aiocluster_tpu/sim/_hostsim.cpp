@@ -287,6 +287,86 @@ long acg_hostsim_subexchange(int8_t* w, int16_t* hb, int64_t n,
     return fast;
 }
 
+// One 'choice'-pairing sub-exchange (gossip.py sim_step's else-branch:
+// every node independently samples a peer — the reference's
+// server.py:699 semantics, inbound load varies). All reads come from
+// ``w_pre``, the caller's pre-sub-exchange snapshot, exactly like the
+// XLA form where both _budgeted_advance calls and the scatter operand
+// derive from the loop-carry value:
+//   pass A (initiator applies responder's delta):
+//     w[i] = w_pre[i] + adv(recv=w_pre[i], send=w_pre[p[i]], row=i, salt0)
+//   pass B (responder applies initiator's delta, scatter-max over
+//     duplicate responders — max is associative+commutative, so the
+//     sequential loop equals XLA's .at[p].max):
+//     w[p[i]] = max(w[p[i]],
+//                   w_pre[p[i]] + adv(recv=w_pre[p[i]], send=w_pre[i],
+//                                     row=i, salt1))
+// The dither hash row index is the INITIATOR i for BOTH directions
+// (each _budgeted_advance's d matrix is indexed by initiator row).
+void acg_hostsim_choice_subexchange(int8_t* w, const int8_t* w_pre,
+                                    int64_t n, const int32_t* p,
+                                    int32_t salt0, int32_t salt1,
+                                    uint32_t run_salt, int32_t budget) {
+    const uint32_t s0 = (uint32_t)salt0 ^ run_salt;
+    const uint32_t s1 = (uint32_t)salt1 ^ run_salt;
+    for (int64_t i = 0; i < n; ++i) {
+        const int8_t* __restrict recv = w_pre + i * n;
+        const int8_t* __restrict send = w_pre + p[i] * n;
+        int8_t* __restrict dst = w + i * n;
+        int32_t tot = 0;
+        for (int64_t j = 0; j < n; ++j) {
+            int32_t d = (int32_t)send[j] - (int32_t)recv[j];
+            tot += d > 0 ? d : 0;
+        }
+        if (tot <= budget) {
+            for (int64_t j = 0; j < n; ++j)
+                dst[j] = recv[j] > send[j] ? recv[j] : send[j];
+        } else {
+            const float sc = std::fmin(
+                1.0f, (float)budget / std::fmax((float)tot, 1.0f));
+            for (int64_t j = 0; j < n; ++j)
+                dst[j] = adv_scalar(recv[j], send[j], sc,
+                                    (uint32_t)i, (uint32_t)j, s0);
+        }
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        const int8_t* __restrict recv = w_pre + p[i] * n;  // responder's pre
+        const int8_t* __restrict send = w_pre + i * n;     // initiator's pre
+        int8_t* __restrict dst = w + p[i] * n;
+        int32_t tot = 0;
+        for (int64_t j = 0; j < n; ++j) {
+            int32_t d = (int32_t)send[j] - (int32_t)recv[j];
+            tot += d > 0 ? d : 0;
+        }
+        if (tot <= budget) {
+            for (int64_t j = 0; j < n; ++j) {
+                int8_t m = recv[j] > send[j] ? recv[j] : send[j];
+                dst[j] = dst[j] > m ? dst[j] : m;
+            }
+        } else {
+            const float sc = std::fmin(
+                1.0f, (float)budget / std::fmax((float)tot, 1.0f));
+            for (int64_t j = 0; j < n; ++j) {
+                int8_t v = adv_scalar(recv[j], send[j], sc,
+                                      (uint32_t)i, (uint32_t)j, s1);
+                dst[j] = dst[j] > v ? dst[j] : v;
+            }
+        }
+    }
+}
+
+// Row minima of w into row_min (the convergence check for paths whose
+// last sub-exchange cannot carry it, e.g. 'choice' scatters).
+void acg_hostsim_rowmin(const int8_t* w, int64_t n, int32_t* row_min) {
+    for (int64_t i = 0; i < n; ++i) {
+        const int8_t* __restrict row = w + i * n;
+        int32_t m = 127;
+        for (int64_t j = 0; j < n; ++j)
+            if (row[j] < m) m = row[j];
+        row_min[i] = m;
+    }
+}
+
 // Refresh owner diagonals: w[i, i] = mv[i] (gossip.py's diagonal refresh
 // — a no-op for write-free runs after init, kept for fidelity).
 void acg_hostsim_diag(int8_t* w, int64_t n, const int32_t* mv) {

@@ -75,6 +75,15 @@ def _build() -> ctypes.CDLL | None:
     lib.acg_hostsim_diag.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
     ]
+    lib.acg_hostsim_choice_subexchange.restype = None
+    lib.acg_hostsim_choice_subexchange.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32, ctypes.c_int32,
+    ]
+    lib.acg_hostsim_rowmin.restype = None
+    lib.acg_hostsim_rowmin.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
     lib.acg_hostsim_diag_hb.restype = None
     lib.acg_hostsim_diag_hb.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -129,9 +138,18 @@ def supported(cfg: SimConfig) -> bool:
             and cfg.dead_grace_ticks is None
         )
     )
+    # "choice" (the reference's independent-sampling semantics,
+    # server.py:699) is native too — lean profile only: the responder
+    # side of its heartbeat absorb would need a scatter the hb kernel
+    # doesn't model, and FD-faithful "view" sampling reads live_view.
+    pairing_ok = cfg.pairing == "matching" or (
+        cfg.pairing == "choice"
+        and cfg.peer_mode == "alive"
+        and not cfg.track_heartbeats
+    )
     return (
         profile_ok
-        and cfg.pairing == "matching"
+        and pairing_ok
         and cfg.budget_policy == "proportional"
         and cfg.n_nodes % 128 == 0
         and cfg.version_dtype == "int16"
@@ -290,11 +308,31 @@ class HostSimulator:
             out.append((a, p[a]))
         return out
 
+    def _round_peers(self, tick: int) -> np.ndarray:
+        """(n, fanout) independent peer draws for 'choice' pairing, via
+        sim_step's own select_peers with the identical key schedule."""
+        from jax import numpy as jnp
+        from jax import random
+
+        from ..ops.gossip import select_peers
+
+        round_key = random.fold_in(self._key, tick)
+        _churn_key, peer_key = random.split(round_key)
+        view_salt = jnp.int32(-(tick + 1) * self.cfg.fanout)
+        peers = select_peers(
+            peer_key, jnp.ones((self.cfg.n_nodes,), bool), None, self.cfg,
+            None, None, axis_name=None, view_salt=view_salt,
+            run_salt=jnp.uint32(self._run_salt),
+        )
+        return np.asarray(peers, dtype=np.int32)
+
     def _step(self, track: bool) -> bool:
         """One full gossip round in place; returns the post-round
         all-converged flag when ``track`` (else False)."""
         tick = self.tick + 1
         n = self.cfg.n_nodes
+        if self.cfg.pairing == "choice":
+            return self._step_choice(tick, track)
         hb_ptr = None
         hb0 = None
         if self._track_hb:
@@ -369,6 +407,36 @@ class HostSimulator:
         if not touched.all():
             untouched = ~touched
             self._row_min[untouched] = self.w[untouched].min(axis=1)
+        return bool((self._row_min >= self.max_version).all())
+
+    def _step_choice(self, tick: int, track: bool) -> bool:
+        """One 'choice'-pairing round: fanout independent sub-exchanges,
+        each reading a pre-sub-exchange snapshot (the XLA loop carry)."""
+        n = self.cfg.n_nodes
+        fan = self.cfg.fanout
+        self._lib.acg_hostsim_diag(
+            self.w.ctypes.data, n, self.max_version.ctypes.data
+        )
+        peers = self._round_peers(tick)
+        if not hasattr(self, "_w_pre"):
+            self._w_pre = np.empty_like(self.w)
+        for c in range(fan):
+            np.copyto(self._w_pre, self.w)
+            p = np.ascontiguousarray(peers[:, c])
+            base = tick * (2 * fan) + 2 * c  # sub_salt(0, d) + 2c
+            self._lib.acg_hostsim_choice_subexchange(
+                self.w.ctypes.data, self._w_pre.ctypes.data, n,
+                p.ctypes.data, np.int32(base), np.int32(base + 1),
+                np.uint32(self._run_salt), self.cfg.budget,
+            )
+        self.tick = tick
+        if not track:
+            return False
+        # The scatter pass can touch any row after its min was last
+        # known; one dedicated min pass gives the exact flag.
+        self._lib.acg_hostsim_rowmin(
+            self.w.ctypes.data, n, self._row_min.ctypes.data
+        )
         return bool((self._row_min >= self.max_version).all())
 
     def run(self, rounds: int) -> None:

@@ -103,9 +103,17 @@ def main() -> None:
             f.write(json.dumps(rec) + "\n")
 
     cfg = full_config(n, budget=budget_from_mtu(65_507))
-    if os.path.exists(ckpt + ".json"):
-        host = HostSimulator.resume(ckpt, cfg)
-        log(f"resumed at tick {host.tick}")
+    # Resume from the FRESHEST slot: near-end rounds save only the
+    # `near` slot, so after a crash there it is ahead of `ckpt`.
+    slots = []
+    for slot in (ckpt, near):
+        if os.path.exists(slot + ".json"):
+            with open(slot + ".json") as f:
+                slots.append((json.load(f)["tick"], slot))
+    if slots:
+        _tick, slot = max(slots)
+        host = HostSimulator.resume(slot, cfg)
+        log(f"resumed at tick {host.tick} from {os.path.basename(slot)}")
     else:
         host = HostSimulator(cfg, seed=args.seed)
         log(f"fresh run: n={n} budget={cfg.budget} seed={args.seed}")

@@ -137,7 +137,9 @@ async def _boot_loopback_clusters(
             for c in started:
                 try:
                     await c.close()
-                except BaseException as close_exc:
+                except Exception as close_exc:
+                    # Exception, not BaseException: cancellation must
+                    # still propagate out of the cleanup.
                     log(f"config 1: cleanup close failed: {close_exc!r}")
             if not (isinstance(exc, OSError) and exc.errno == errno.EADDRINUSE):
                 raise

@@ -131,6 +131,38 @@ def test_supported_gate():
         HostSimulator(lean_config(1000))
 
 
+# -- 'choice' pairing (reference independent-sampling semantics) -------------
+
+
+def test_choice_pairing_bit_identity():
+    """The reference-faithful independent-sampling path (server.py:699
+    semantics: every node samples a peer; inbound load varies; the
+    responder side is a scatter-max) walks the XLA trajectory exactly.
+    Small budget exercises the dithered regime in both directions."""
+    cfg = lean_config(256, budget=24, pairing="choice")
+    _trajectories_equal(cfg, seed=11, max_rounds=10)
+
+
+def test_choice_pairing_convergence_round_matches():
+    cfg = lean_config(256, budget=64, pairing="choice")
+    r_sim = Simulator(cfg, seed=12, chunk=4).run_until_converged(
+        max_rounds=512
+    )
+    r_host = HostSimulator(cfg, seed=12).run_until_converged(max_rounds=512)
+    assert r_sim is not None
+    assert r_host == r_sim
+
+
+def test_choice_gate():
+    assert supported(lean_config(256, pairing="choice"))
+    # FD-faithful 'view' sampling and the hb scatter are outside the
+    # native domain.
+    assert not supported(full_config(256, pairing="choice"))
+    assert not supported(
+        lean_config(256, pairing="permutation")
+    )
+
+
 # -- full profile (heartbeats + failure detector), round 5 -------------------
 
 
