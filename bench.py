@@ -398,6 +398,39 @@ def load_northstar_record(log) -> dict | None:
         return None
 
 
+def load_full_profile_record(log) -> dict | None:
+    """Round-5: the measured full-profile (heartbeats + FD) exact R at
+    the largest N walked, with its mesh-certification status — the
+    scale evidence for the profile the reference actually runs."""
+    try:
+        with open(os.path.join(RECORDS_DIR,
+                               "r5_full_profile_convergence.json")) as f:
+            rec = json.load(f)
+        cert = {}
+        try:
+            with open(os.path.join(
+                RECORDS_DIR, "r5_full_profile_certification.json"
+            )) as f:
+                cert = json.load(f)
+        except Exception:
+            pass
+        best_n = max(int(k) for k in rec)
+        entry = rec[str(best_n)]
+        c = cert.get(str(best_n), {})
+        return {
+            "n_nodes": best_n,
+            "rounds_to_convergence": entry["value"],
+            "profile": entry.get("profile"),
+            "mesh_certified": bool(
+                c.get("final", {}).get("ok")
+                and c.get("prefix", {}).get("ok")
+            ),
+        }
+    except Exception as exc:
+        log(f"full-profile record unavailable: {exc!r}")
+        return None
+
+
 def measured_reference_baseline(log) -> dict | None:
     """The ACTUAL reference library (/root/reference), run live as a
     64-node loopback cluster, measured in sim-equivalent rounds/s and
@@ -421,6 +454,8 @@ STDOUT_LINE_CAP = 2000
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
     "budget",
+    "full_profile_n",
+    "full_profile_r",
     "northstar_projected_v5e8_s",
     "northstar_rounds_100k",
     "reference_measured_rounds_per_sec",
@@ -475,6 +510,10 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         "northstar_projected_v5e8_s": (ex.get("northstar_100k") or {}).get(
             "projected_v5e8_seconds"
         ),
+        "full_profile_r": (ex.get("full_profile_scale") or {}).get(
+            "rounds_to_convergence"
+        ),
+        "full_profile_n": (ex.get("full_profile_scale") or {}).get("n_nodes"),
         "budget": ex.get("budget"),
         "tpu_note": ex.get("tpu_note"),
         # A CPU fallback still points at (and summarizes) the certified
@@ -973,6 +1012,9 @@ def main() -> None:
                 # Round-4 flagship: the measured (mesh-certified) 100k
                 # rounds-to-convergence + its v5e-8 projection.
                 "northstar_100k": load_northstar_record(log),
+                # Round-5: measured full-profile (heartbeats+FD) exact R
+                # at the largest N walked, mesh-certification status.
+                "full_profile_scale": load_full_profile_record(log),
                 "keys_per_node": 16,
                 "fanout": 3,
                 "budget": _budget(),

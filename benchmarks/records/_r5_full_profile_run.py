@@ -109,7 +109,18 @@ def main() -> None:
     for slot in (ckpt, near):
         if os.path.exists(slot + ".json"):
             with open(slot + ".json") as f:
-                slots.append((json.load(f)["tick"], slot))
+                meta = json.load(f)
+            if meta["seed"] != args.seed:
+                # A completed run keeps its near slot for certification;
+                # silently resuming it under a different --seed would
+                # mislabel the record (converge-in-one-round with the
+                # old trajectory). Refuse instead.
+                raise SystemExit(
+                    f"{os.path.basename(slot)} holds seed={meta['seed']} "
+                    f"state but --seed={args.seed}; delete the checkpoint "
+                    "slots to start a fresh trajectory"
+                )
+            slots.append((meta["tick"], slot))
     if slots:
         _tick, slot = max(slots)
         host = HostSimulator.resume(slot, cfg)

@@ -1,0 +1,70 @@
+"""CPU record of the dynamic-workload benchmarks (VERDICT r4 item 8).
+
+Runs benchmarks/staleness.py's burst-recovery and sustained-staleness
+measurements at a CPU-tractable scale (n=2048; the on-chip battery
+phase_staleness runs the same code at 10,240) and writes
+r5_staleness_cpu.json. Honest labels: platform=cpu, scaled-down — the
+on-chip record supersedes it when a tunnel window lands.
+
+Usage: python _r5_staleness_cpu.py
+Builder-side tooling (not part of the shipped package).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+RESULT = os.path.join(HERE, "r5_staleness_cpu.json")
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from staleness import (
+        burst_recovery,
+        sustainable_write_rate,
+        sustained_staleness,
+    )
+
+    n = 2048
+    # budget chosen so INTEGER write rates straddle the knee (1.5
+    # writes/node/round): 1 is sub-critical (tracking, bounded lag),
+    # 2 and 4 are super-critical (measured divergence slope).
+    budget = 1024
+    bursts = [
+        burst_recovery(n, burst, budget, seed=1) for burst in (4, 16, 64)
+    ]
+    knee = sustainable_write_rate(n, budget)
+    sustained = [
+        sustained_staleness(n, w, budget, rounds=120, tail=40, seed=1)
+        for w in (0, 1, 2, 4)
+    ]
+    record = {
+        "metric": "dynamic_workload_staleness",
+        "platform": "cpu",
+        "n_nodes": n,
+        "budget": budget,
+        "note": "scaled-down CPU datum; battery phase_staleness runs the"
+                " same measurements at 10,240 on chip and supersedes this",
+        "sustainable_writes_per_node_per_round": round(knee, 3),
+        "burst_recovery": bursts,
+        "sustained": sustained,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(RESULT + ".tmp", "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(RESULT + ".tmp", RESULT)
+    print(json.dumps(record, indent=1))
+
+
+if __name__ == "__main__":
+    main()
