@@ -123,6 +123,24 @@ class Simulator:
         self._known_max_version = int(np.asarray(self.state.max_version).max())
         self._host_tick = int(np.asarray(self.state.tick))
         self._version_base_tick = self._host_tick
+        # select_peers' churn-free 'choice' fast path samples uniformly
+        # over ALL nodes (the alive mask is statically all-true for
+        # states this config family produces). A provided state carrying
+        # dead nodes — e.g. a checkpoint from a churn run — would be
+        # silently mis-sampled; refuse it here, where alive is concrete
+        # and the check is free.
+        if (
+            state is not None
+            and cfg.pairing == "choice"
+            and cfg.death_rate == 0.0
+            and cfg.revival_rate == 0.0
+            and not bool(np.asarray(self.state.alive).all())
+        ):
+            raise ValueError(
+                "churn-free 'choice' config resumed with dead nodes in "
+                "state.alive — peer sampling would ignore them; run this "
+                "state under a config with churn enabled"
+            )
         self._mesh = mesh
         if mesh is not None:
             self.state = shard_state(self.state, mesh)

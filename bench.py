@@ -414,7 +414,9 @@ def load_full_profile_record(log) -> dict | None:
                 cert = json.load(f)
         except Exception:
             pass
-        best_n = max(int(k) for k in rec)
+        # Numeric keys are the full-profile entries; "choice_<n>" keys
+        # hold the choice-pairing data points.
+        best_n = max(int(k) for k in rec if k.isdigit())
         entry = rec[str(best_n)]
         c = cert.get(str(best_n), {})
         return {

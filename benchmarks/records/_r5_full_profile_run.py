@@ -67,18 +67,27 @@ def battery_running() -> bool:
 
 
 def state_digests(host) -> dict:
-    """Canonical sha256 per state matrix (host-native dtypes; the mesh
-    side converts losslessly: int16 w -> int8, bool/bf16 as raw bytes)."""
-    out = {"w": hashlib.sha256(host.w.tobytes()).hexdigest()}
+    """Canonical sha256 per state matrix the profile carries
+    (host-native dtypes; the mesh side converts losslessly: int16 w ->
+    int8, bool/bf16 as raw bytes). One source of the digest format for
+    every profile — matrices the profile lacks are simply absent."""
     import numpy as np
 
-    out["hb"] = hashlib.sha256(host.hb.tobytes()).hexdigest()
-    out["last_change"] = hashlib.sha256(host.last_change.tobytes()).hexdigest()
-    out["imean"] = hashlib.sha256(
-        host.imean.view(np.uint16).tobytes()
-    ).hexdigest()
-    out["icount"] = hashlib.sha256(host.icount.tobytes()).hexdigest()
-    out["live_view"] = hashlib.sha256(host.live_view.tobytes()).hexdigest()
+    out = {"w": hashlib.sha256(host.w.tobytes()).hexdigest()}
+    if hasattr(host, "hb"):
+        out["hb"] = hashlib.sha256(host.hb.tobytes()).hexdigest()
+    if hasattr(host, "last_change"):
+        out["last_change"] = hashlib.sha256(
+            host.last_change.tobytes()
+        ).hexdigest()
+        imean = host.imean
+        if imean.dtype.name == "bfloat16":
+            imean = imean.view(np.uint16)
+        out["imean"] = hashlib.sha256(imean.tobytes()).hexdigest()
+        out["icount"] = hashlib.sha256(host.icount.tobytes()).hexdigest()
+        out["live_view"] = hashlib.sha256(
+            host.live_view.tobytes()
+        ).hexdigest()
     return out
 
 
@@ -86,23 +95,36 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, required=True)
     ap.add_argument("--seed", type=int, default=SEED)
+    ap.add_argument(
+        "--profile", choices=["full", "lean_choice"], default="full",
+        help="full = heartbeats+FD matching (the default round-5 datum); "
+        "lean_choice = lean profile under 'choice' pairing (the "
+        "reference's independent-sampling semantics, server.py:699 — "
+        "VERDICT r4 item 6's large-N exact-R datum)",
+    )
     args = ap.parse_args()
     n = args.n
 
     from aiocluster_tpu.sim import budget_from_mtu
     from aiocluster_tpu.sim.hostsim import HostSimulator
-    from aiocluster_tpu.sim.memory import full_config, plan
+    from aiocluster_tpu.sim.memory import full_config, lean_config, plan
 
-    ckpt = os.path.join(HERE, f"_r5_full_{n}_ckpt")
-    near = os.path.join(HERE, f"_r5_full_{n}_near")
-    progress_path = os.path.join(HERE, f"_r5_full_{n}_progress.jsonl")
+    tag = n if args.profile == "full" else f"choice_{n}"
+    ckpt = os.path.join(HERE, f"_r5_full_{tag}_ckpt")
+    near = os.path.join(HERE, f"_r5_full_{tag}_near")
+    progress_path = os.path.join(HERE, f"_r5_full_{tag}_progress.jsonl")
 
     def progress(rec: dict) -> None:
         rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         with open(progress_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
 
-    cfg = full_config(n, budget=budget_from_mtu(65_507))
+    if args.profile == "full":
+        cfg = full_config(n, budget=budget_from_mtu(65_507))
+    else:
+        cfg = lean_config(
+            n, budget=budget_from_mtu(65_507), pairing="choice"
+        )
     # Resume from the FRESHEST slot: near-end rounds save only the
     # `near` slot, so after a crash there it is ahead of `ckpt`.
     slots = []
@@ -169,17 +191,25 @@ def main() -> None:
         host.save(ckpt)
         sys.exit(2)
     mem = plan(cfg, shards=1)
+    if args.profile == "full":
+        metric = "full_profile_rounds_to_convergence"
+        profile_desc = "full (heartbeats int16 + phi-accrual FD, bf16 means)"
+        identity_ref = "tests/test_hostsim.py::test_full_profile_bit_identity"
+    else:
+        metric = "choice_pairing_rounds_to_convergence"
+        profile_desc = ("lean, pairing='choice' (reference independent-"
+                        "sampling semantics, server.py:699)")
+        identity_ref = "tests/test_hostsim.py::test_choice_pairing_bit_identity"
     entry = {
-        "metric": "full_profile_rounds_to_convergence",
+        "metric": metric,
         "value": converged,
         "unit": "rounds",
         "n_nodes": n,
         "budget": cfg.budget,
         "seed": args.seed,
-        "profile": "full (heartbeats int16 + phi-accrual FD, bf16 means)",
+        "profile": profile_desc,
         "engine": "native host fast-path (sim/hostsim.py) — bit-identical"
-                  " to the XLA path in every state matrix"
-                  " (tests/test_hostsim.py::test_full_profile_bit_identity)",
+                  f" to the XLA path ({identity_ref})",
         "wall_seconds_host_path": round(wall, 1),
         "mean_round_seconds_host_path": round(
             sum(state["round_s"]) / max(len(state["round_s"]), 1), 2
@@ -195,7 +225,7 @@ def main() -> None:
     if os.path.exists(RESULT):
         with open(RESULT) as f:
             rec = json.load(f)
-    rec[str(n)] = entry
+    rec[str(tag)] = entry
     with open(RESULT + ".tmp", "w") as f:
         json.dump(rec, f, indent=1)
     os.replace(RESULT + ".tmp", RESULT)
