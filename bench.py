@@ -416,18 +416,35 @@ def load_full_profile_record(log) -> dict | None:
             pass
         # Numeric keys are the full-profile entries; "choice_<n>" keys
         # hold the choice-pairing data points.
-        best_n = max(int(k) for k in rec if k.isdigit())
-        entry = rec[str(best_n)]
-        c = cert.get(str(best_n), {})
-        return {
-            "n_nodes": best_n,
-            "rounds_to_convergence": entry["value"],
-            "profile": entry.get("profile"),
-            "mesh_certified": bool(
-                c.get("final", {}).get("ok")
-                and c.get("prefix", {}).get("ok")
-            ),
-        }
+        numeric = [int(k) for k in rec if k.isdigit()]
+        out = {}
+        if numeric:
+            best_n = max(numeric)
+            entry = rec[str(best_n)]
+            c = cert.get(str(best_n), {})
+            out = {
+                "n_nodes": best_n,
+                "rounds_to_convergence": entry["value"],
+                "profile": entry.get("profile"),
+                "mesh_certified": bool(
+                    c.get("final", {}).get("ok")
+                    and c.get("prefix", {}).get("ok")
+                ),
+            }
+        # The reference-faithful independent-sampling datum rides along.
+        choice_keys = [k for k in rec if k.startswith("choice_")]
+        if choice_keys:
+            ck = max(choice_keys, key=lambda k: int(k.split("_")[1]))
+            cc = cert.get(ck, {})
+            out["choice_pairing"] = {
+                "n_nodes": rec[ck]["n_nodes"],
+                "rounds_to_convergence": rec[ck]["value"],
+                "mesh_certified": bool(
+                    cc.get("final", {}).get("ok")
+                    and cc.get("prefix", {}).get("ok")
+                ),
+            }
+        return out or None
     except Exception as exc:
         log(f"full-profile record unavailable: {exc!r}")
         return None

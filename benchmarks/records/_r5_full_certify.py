@@ -1,20 +1,24 @@
-"""Certify the full-profile (heartbeats + FD) convergence count on the
-REAL sharded path (round-5 twin of _r4_northstar_certify.py).
+"""Certify host fast-path convergence counts on the REAL sharded path
+(round-5 twin of _r4_northstar_certify.py).
 
-Two phases, each executing the actual sharded code (8-device virtual
-CPU mesh, `parallel/mesh.py` shard_map — the identical program a v5e-8
-runs):
+Two profiles (--profile): "full" — heartbeats + FD, where the prefix
+check covers ALL six state matrices (w, hb_known, last_change, imean,
+icount, live_view) — and "lean_choice" — the lean profile under
+'choice' pairing (reference independent-sampling semantics), where the
+profile carries only w. Two phases, each executing the actual sharded
+code (8-device virtual CPU mesh, `parallel/mesh.py` shard_map — the
+identical program a v5e-8 runs):
 
-- ``prefix``: fresh mesh run of rounds 1-2 at N; every state matrix —
-  w, hb_known, last_change, imean, icount, live_view — must reproduce
-  the host fast-path's committed sha256 digests
-  (_r5_full_<N>_progress.jsonl). This is a full-scale, full-state
-  equality check of the FULL profile, not just the watermarks.
+- ``prefix``: fresh mesh run of rounds 1-2 at N; every state matrix the
+  profile carries must reproduce the host fast-path's committed sha256
+  digests (_r5_full_<tag>_progress.jsonl), with the digest KEY SETS
+  cross-checked so a coverage mismatch cannot pass silently.
 - ``final``: load the host run's R-1 checkpoint into the mesh Simulator
   and step with the exact convergence tracker; it must report
   convergence at exactly R.
 
-Usage: python _r5_full_certify.py --n 32768 [prefix|final|all]
+Usage: python _r5_full_certify.py --n 32768 [--profile full|lean_choice]
+                                  [prefix|final|all]
 Builder-side tooling (not part of the shipped package).
 """
 
@@ -60,11 +64,20 @@ def _setup_mesh_env() -> None:
     sys.path.insert(0, REPO)
 
 
+PROFILE = "full"  # set by main() from --profile
+
+
+def _tag(n: int) -> str:
+    return str(n) if PROFILE == "full" else f"choice_{n}"
+
+
 def _cfg(n: int):
     from aiocluster_tpu.sim import budget_from_mtu
-    from aiocluster_tpu.sim.memory import full_config
+    from aiocluster_tpu.sim.memory import full_config, lean_config
 
-    return full_config(n, budget=budget_from_mtu(65_507))
+    if PROFILE == "full":
+        return full_config(n, budget=budget_from_mtu(65_507))
+    return lean_config(n, budget=budget_from_mtu(65_507), pairing="choice")
 
 
 def _mesh():
@@ -86,7 +99,7 @@ def _mesh():
 
 def _host_digests(n: int) -> dict[int, dict]:
     out: dict[int, dict] = {}
-    with open(os.path.join(HERE, f"_r5_full_{n}_progress.jsonl")) as f:
+    with open(os.path.join(HERE, f"_r5_full_{_tag(n)}_progress.jsonl")) as f:
         for line in f:
             rec = json.loads(line)
             if "digests" in rec:
@@ -94,31 +107,35 @@ def _host_digests(n: int) -> dict[int, dict]:
     return out
 
 
-def _mesh_digests(state) -> dict[str, str]:
+def _mesh_digests(state, cfg) -> dict[str, str]:
     """Same canonical bytes as _r5_full_profile_run.state_digests (the
-    host side's native dtypes)."""
+    host side's native dtypes). The digest set derives from the CONFIG
+    flags — mirroring the run side's what-the-host-carries logic — so a
+    profile/flag mismatch can never silently digest fewer matrices than
+    the host logged (phase_prefix additionally cross-checks key sets)."""
     import numpy as np
 
     w = np.asarray(state.w)
     assert int(w.max()) <= 127
-    return {
-        "w": hashlib.sha256(w.astype(np.int8).tobytes()).hexdigest(),
-        "hb": hashlib.sha256(
+    out = {"w": hashlib.sha256(w.astype(np.int8).tobytes()).hexdigest()}
+    if cfg.track_heartbeats:
+        out["hb"] = hashlib.sha256(
             np.asarray(state.hb_known).tobytes()
-        ).hexdigest(),
-        "last_change": hashlib.sha256(
+        ).hexdigest()
+    if cfg.track_failure_detector:
+        out["last_change"] = hashlib.sha256(
             np.asarray(state.last_change).tobytes()
-        ).hexdigest(),
-        "imean": hashlib.sha256(
+        ).hexdigest()
+        out["imean"] = hashlib.sha256(
             np.asarray(state.imean).view(np.uint16).tobytes()
-        ).hexdigest(),
-        "icount": hashlib.sha256(
+        ).hexdigest()
+        out["icount"] = hashlib.sha256(
             np.asarray(state.icount).tobytes()
-        ).hexdigest(),
-        "live_view": hashlib.sha256(
+        ).hexdigest()
+        out["live_view"] = hashlib.sha256(
             np.asarray(state.live_view).tobytes()
-        ).hexdigest(),
-    }
+        ).hexdigest()
+    return out
 
 
 def phase_prefix(n: int) -> dict:
@@ -128,13 +145,19 @@ def phase_prefix(n: int) -> dict:
     assert 1 in want and 2 in want, "host run has not logged digests yet"
     mesh = _mesh()
     t0 = time.perf_counter()
-    sim = Simulator(_cfg(n), seed=SEED, mesh=mesh, chunk=1)
+    cfg = _cfg(n)
+    sim = Simulator(cfg, seed=SEED, mesh=mesh, chunk=1)
     rec: dict = {"digests": {}}
     ok = True
     for tick in (1, 2):
         sim.run(1)
-        got = _mesh_digests(sim.state)
-        matches = {k: got[k] == want[tick][k] for k in got}
+        got = _mesh_digests(sim.state, cfg)
+        # Key sets must agree exactly: a host digest with no mesh
+        # counterpart (or vice versa) is a coverage failure, not a pass.
+        if set(got) != set(want[tick]):
+            matches = {"digest_key_sets": False}
+        else:
+            matches = {k: got[k] == want[tick][k] for k in got}
         rec["digests"][str(tick)] = {
             "match": matches, "all_match": all(matches.values()),
         }
@@ -156,10 +179,10 @@ def phase_final(n: int) -> dict:
     from aiocluster_tpu.sim.state import SimState
 
     with open(RESULT) as f:
-        R = json.load(f)[str(n)]["value"]
+        R = json.load(f)[_tag(n)]["value"]
     assert isinstance(R, int) and R > 0, f"no measured R for n={n}: {R!r}"
     cfg = _cfg(n)
-    near = os.path.join(HERE, f"_r5_full_{n}_near")
+    near = os.path.join(HERE, f"_r5_full_{_tag(n)}_near")
     host = HostSimulator.resume(near, cfg)
     start_tick = host.tick
     assert start_tick < R, (start_tick, R)
@@ -169,18 +192,32 @@ def phase_final(n: int) -> dict:
     # device_puts per-shard slices from numpy without materializing a
     # second whole-matrix jax buffer).
     w16 = host.w.astype(np.int16)
+    hdt = jnp.dtype(cfg.heartbeat_dtype)
+    if PROFILE == "full":
+        extras = dict(
+            heartbeat=np.ascontiguousarray(host.heartbeat),
+            hb_known=host.hb,
+            last_change=host.last_change,
+            imean=host.imean,
+            icount=host.icount,
+            live_view=host.live_view,
+        )
+    else:  # lean choice: zero-sized placeholders (sim/state.py)
+        extras = dict(
+            heartbeat=jnp.full((n,), 1 + start_tick, jnp.int32),
+            hb_known=jnp.zeros((0, 0), hdt),
+            last_change=jnp.zeros((0, 0), hdt),
+            imean=jnp.zeros((0, 0), jnp.dtype(cfg.fd_dtype)),
+            icount=jnp.zeros((0, 0), jnp.int16),
+            live_view=jnp.zeros((0, 0), bool),
+        )
     state = SimState(
         tick=jnp.asarray(start_tick, jnp.int32),
         max_version=jnp.full((n,), cfg.keys_per_node, jnp.int32),
-        heartbeat=np.ascontiguousarray(host.heartbeat),
         alive=jnp.ones((n,), bool),
         w=w16,
-        hb_known=host.hb,
-        last_change=host.last_change,
-        imean=host.imean,
-        icount=host.icount,
-        live_view=host.live_view,
-        dead_since=jnp.zeros((0, 0), jnp.dtype(cfg.heartbeat_dtype)),
+        dead_since=jnp.zeros((0, 0), hdt),
+        **extras,
     )
     del host, w16  # SimState holds the only references now
     mesh = _mesh()
@@ -205,29 +242,34 @@ def _write_cert(n: int, cert_n: dict) -> None:
     if os.path.exists(CERT):
         with open(CERT) as f:
             cert = json.load(f)
-    entry = cert.get(str(n), {})
+    entry = cert.get(_tag(n), {})
     entry.update(cert_n)
     entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     entry["n_nodes"] = n
     entry["n_devices"] = N_DEV
+    entry["profile"] = PROFILE
     entry["note"] = (
-        "Real sharded full-profile path (8-device virtual mesh, same "
-        "shard_map program a v5e-8 runs): trajectory-prefix digests over "
-        "ALL six state matrices + final-round convergence, certifying "
-        "the host fast-path's full-profile rounds-to-convergence count."
+        "Real sharded path (8-device virtual mesh, same shard_map "
+        "program a v5e-8 runs): trajectory-prefix digests over every "
+        "state matrix the profile carries + final-round convergence, "
+        "certifying the host fast-path's rounds-to-convergence count."
     )
-    cert[str(n)] = entry
+    cert[_tag(n)] = entry
     with open(CERT + ".tmp", "w") as f:
         json.dump(cert, f, indent=1)
     os.replace(CERT + ".tmp", CERT)
 
 
 def main() -> None:
+    global PROFILE
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--profile", choices=["full", "lean_choice"],
+                    default="full")
     ap.add_argument("phase", nargs="?", default="all",
                     choices=["prefix", "final", "all"])
     args = ap.parse_args()
+    PROFILE = "full" if args.profile == "full" else "choice"
     _setup_mesh_env()
     if args.phase == "all":
         import subprocess
@@ -235,7 +277,7 @@ def main() -> None:
         for phase in ("final", "prefix"):  # certification first
             rc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 "--n", str(args.n), phase]
+                 "--n", str(args.n), "--profile", args.profile, phase]
             ).returncode
             if rc != 0:
                 log(f"phase {phase} failed rc={rc}")
@@ -246,7 +288,7 @@ def main() -> None:
     else:
         _write_cert(args.n, {"final": phase_final(args.n)})
     with open(CERT) as f:
-        print(json.dumps(json.load(f)[str(args.n)]), flush=True)
+        print(json.dumps(json.load(f)[_tag(args.n)]), flush=True)
 
 
 if __name__ == "__main__":
