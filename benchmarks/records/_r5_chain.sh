@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 follow-on chain: after the 49,152 full-profile run +
+# certification finish, measure the reference's ACTUAL sampling
+# semantics (choice pairing) at the config-5 north-star population and
+# certify it on the mesh. Waits on a completion SENTINEL (the prior
+# pipeline's final certify output reaching a terminal state), not a pid
+# — pids can be stale (instant false "done") or reused (infinite hang).
+set -u
+cd "$(dirname "$0")"
+SENTINEL="${1:?usage: _r5_chain.sh <sentinel-file-written-on-completion>}"
+while [ ! -s "$SENTINEL" ]; do sleep 60; done
+# Free the 49k full-profile near checkpoint only if its certification
+# succeeded (both phases ok) — it is the only evidence source otherwise.
+python - <<'PYEOF'
+import json, os, glob
+try:
+    c = json.load(open("r5_full_profile_certification.json"))["49152"]
+    certified = bool(
+        c.get("final", {}).get("ok") and c.get("prefix", {}).get("ok")
+    )
+except Exception as exc:
+    certified = False
+    print(f"no 49152 certification yet: {exc!r}")
+if certified:
+    print("49152 certified; freeing near checkpoint")
+    for f in glob.glob("_r5_full_49152_near*"):
+        try:
+            os.remove(f)
+        except OSError as exc:
+            print(f"removal failed for {f}: {exc!r}")
+else:
+    print("keeping 49152 checkpoint")
+PYEOF
+python _r5_full_profile_run.py --n 100352 --profile lean_choice \
+    > _r5_full_choice_100352.out 2>&1 \
+  && python _r5_full_certify.py --n 100352 --profile lean_choice all \
+    > _r5_choice_certify_100352.out 2>&1
