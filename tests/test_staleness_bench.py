@@ -1,0 +1,50 @@
+"""The dynamic-workload benchmark helpers (benchmarks/staleness.py)
+stay runnable and honest: burst recovery respects its information
+floor, and the sustained-staleness classifier separates the tracking
+regime from falling behind (the measured slope follows the excess-load
+arithmetic (writes*N - budget*fanout)/N)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+try:
+    from staleness import (
+        burst_recovery,
+        sustainable_write_rate,
+        sustained_staleness,
+    )
+finally:
+    sys.path.remove(os.path.join(REPO, "benchmarks"))
+
+
+def test_burst_recovery_floor_and_convergence():
+    rec = burst_recovery(256, burst=8, budget=128, seed=3)
+    assert rec["rounds_to_reconverge"] is not None
+    # Floor: every observer needs n*burst versions at <= budget*fanout
+    # per round; recovery can't beat it and shouldn't need many times it.
+    assert rec["floor_rounds"] == -(-256 * 8 // (128 * 3))
+    assert rec["rounds_to_reconverge"] >= rec["floor_rounds"]
+    assert rec["rounds_to_reconverge"] <= 6 * rec["floor_rounds"] + 8
+
+
+def test_sustained_tracking_vs_divergence():
+    # Sub-critical (load 2/3): bounded lag, ~zero slope.
+    sub = sustained_staleness(256, 1, budget=128, rounds=60, tail=20, seed=3)
+    assert sub["load_ratio"] < 1
+    assert sub["tracking"] is True
+    # Super-critical (load 4/3): lag grows at the excess-load rate.
+    sup = sustained_staleness(256, 2, budget=128, rounds=60, tail=20, seed=3)
+    assert sup["load_ratio"] > 1
+    assert sup["tracking"] is False
+    expected_slope = (2 * 256 - 128 * 3) / 256  # 0.5
+    assert sup["mean_lag_slope_per_round"] == pytest.approx(
+        expected_slope, rel=0.25
+    )
+
+
+def test_knee_formula():
+    assert sustainable_write_rate(10_240, 2618) == pytest.approx(0.767, abs=1e-3)
