@@ -1,0 +1,37 @@
+#!/bin/bash
+# Recover the 49,152 full-profile near checkpoint (round-5 incident:
+# the K-1 near trigger never fired and the periodic ckpt was deleted)
+# by re-walking the deterministic trajectory to R-1, then certify.
+# Ordering: the multi-GB certify replay must not run concurrently with
+# the 100k choice pipeline's own run/certify (OOM risk), so BOTH heavy
+# steps wait for it: the pipeline writes _r5_full_choice_100352.out at
+# stage start, and its wrapper process (cmdline contains lean_choice)
+# lives until the whole pipeline ends.
+set -eu
+cd "$(dirname "$0")"
+wait_for_100k_pipeline() {
+    # Started AND finished: output file exists and no writer remains.
+    while [ ! -f _r5_full_choice_100352.out ] \
+        || pgrep -f "lean_choice" > /dev/null; do
+        sleep 120
+    done
+}
+wait_for_100k_pipeline
+python - <<'PYEOF'
+import json, os, sys, time
+sys.path.insert(0, os.path.abspath(os.path.join("..", "..")))
+from aiocluster_tpu.sim import budget_from_mtu
+from aiocluster_tpu.sim.hostsim import HostSimulator
+from aiocluster_tpu.sim.memory import full_config
+
+R = json.load(open("r5_full_profile_convergence.json"))["49152"]["value"]
+cfg = full_config(49_152, budget=budget_from_mtu(65_507))
+host = HostSimulator(cfg, seed=1)
+t0 = time.time()
+host.run(R - 1)  # deterministic: same seed => same trajectory
+host.save("_r5_full_49152_near")
+print(f"re-walked to tick {host.tick} in {time.time()-t0:.0f}s; near saved",
+      flush=True)
+PYEOF
+[ -f _r5_full_49152_near.json ]  # set -e: stop if the walk didn't land
+python _r5_full_certify.py --n 49152 all > _r5_full_certify_49152.out 2>&1

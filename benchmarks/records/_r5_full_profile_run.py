@@ -167,7 +167,12 @@ def main() -> None:
             d = state_digests(host)
             progress({"tick": tick, "digests": d})
             log(f"prefix digests @ {tick}: w={d['w'][:16]}…")
-        near_end = min_w >= cfg.keys_per_node - 1
+        # K-2, not K-1: run_until_converged returns from the converging
+        # round BEFORE this callback fires, and the 49,152 run jumped
+        # from min_w=14 straight to converged — with a K-1 trigger the
+        # near slot was never written and the certify final phase had
+        # nothing to resume (round-5 incident).
+        near_end = min_w >= cfg.keys_per_node - 2
         if near_end:
             host.save(near)
         elif tick % CHECKPOINT_EVERY == 0:
@@ -229,15 +234,29 @@ def main() -> None:
     with open(RESULT + ".tmp", "w") as f:
         json.dump(rec, f, indent=1)
     os.replace(RESULT + ".tmp", RESULT)
-    # The periodic checkpoint is no longer needed; the near slot stays
-    # for certification.
-    for suff in (".json", ".w.npy", ".hb.npy", ".heartbeat.npy",
-                 ".last_change.npy", ".imean.npy", ".icount.npy",
-                 ".live_view.npy"):
-        try:
-            os.remove(ckpt + suff)
-        except OSError:
-            pass
+    # The periodic checkpoint is only disposable once the near slot
+    # actually exists for the certify final phase to resume — deleting
+    # it unconditionally left the 49,152 run with NO checkpoint when
+    # the near trigger never fired (round-5 incident).
+    suffixes = (".json", ".w.npy", ".hb.npy", ".heartbeat.npy",
+                ".last_change.npy", ".imean.npy", ".icount.npy",
+                ".live_view.npy")
+    if os.path.exists(near + ".json"):
+        for suff in suffixes:
+            try:
+                os.remove(ckpt + suff)
+            except OSError:
+                pass
+    elif os.path.exists(ckpt + ".json"):
+        # No near slot (the K-2 trigger is still a heuristic) but a
+        # periodic checkpoint exists: PROMOTE it to the near name —
+        # phase_final only needs any tick < R, so certification works
+        # unattended instead of requiring a multi-hour re-walk. The
+        # .json sidecar moves LAST: it is the slot's validity marker.
+        for suff in [s for s in suffixes if s != ".json"] + [".json"]:
+            if os.path.exists(ckpt + suff):
+                os.replace(ckpt + suff, near + suff)
+        log("near slot missing — promoted the periodic checkpoint")
     log(f"DONE: n={n} converged at round {converged} ({wall:.0f}s)")
     print(json.dumps(entry), flush=True)
 
