@@ -287,6 +287,54 @@ long acg_hostsim_subexchange(int8_t* w, int16_t* hb, int64_t n,
     return fast;
 }
 
+namespace {
+
+// Single-direction budgeted advance of one row toward a sender row,
+// writing (or max-accumulating into) ``dst`` — the 'choice' twin of
+// advance_pair. AVX2 16-lane main loop with the same IEEE-exact vector
+// building blocks as the matching kernel (Hash8/adv8), scalar tail;
+// the hash row index is the INITIATOR ``row`` for both directions.
+inline void advance_row(int8_t* __restrict dst,
+                        const int8_t* __restrict recv,
+                        const int8_t* __restrict send,
+                        int64_t n, uint32_t row, uint32_t s,
+                        float scale, bool sat, bool accum_max) {
+    int64_t j = 0;
+#ifdef __AVX2__
+    Hash8 hash_lo, hash_hi;
+    if (!sat) { hash_lo.init(row, s, 0); hash_hi.init(row, s, 8); }
+    __m256 vs = _mm256_set1_ps(scale);
+    for (; j + 16 <= n; j += 16) {
+        __m256i rlo, rhi, slo, shi;
+        widen16(recv + j, rlo, rhi);
+        widen16(send + j, slo, shi);
+        __m256i vlo, vhi;
+        if (sat) {
+            vlo = _mm256_max_epi32(rlo, slo);
+            vhi = _mm256_max_epi32(rhi, shi);
+        } else {
+            vlo = adv8(rlo, slo, vs, hash_lo);
+            vhi = adv8(rhi, shi, vs, hash_hi);
+        }
+        if (accum_max) {
+            __m256i dlo, dhi;
+            widen16(dst + j, dlo, dhi);
+            vlo = _mm256_max_epi32(vlo, dlo);
+            vhi = _mm256_max_epi32(vhi, dhi);
+        }
+        store16(dst + j, vlo, vhi);
+    }
+#endif
+    for (; j < n; ++j) {
+        int8_t v = sat ? (recv[j] > send[j] ? recv[j] : send[j])
+                       : adv_scalar(recv[j], send[j], scale, row,
+                                    (uint32_t)j, s);
+        dst[j] = accum_max && dst[j] > v ? dst[j] : v;
+    }
+}
+
+}  // namespace
+
 // One 'choice'-pairing sub-exchange (gossip.py sim_step's else-branch:
 // every node independently samples a peer — the reference's
 // server.py:699 semantics, inbound load varies). All reads come from
@@ -312,46 +360,28 @@ void acg_hostsim_choice_subexchange(int8_t* w, const int8_t* w_pre,
     for (int64_t i = 0; i < n; ++i) {
         const int8_t* __restrict recv = w_pre + i * n;
         const int8_t* __restrict send = w_pre + p[i] * n;
-        int8_t* __restrict dst = w + i * n;
         int32_t tot = 0;
         for (int64_t j = 0; j < n; ++j) {
             int32_t d = (int32_t)send[j] - (int32_t)recv[j];
             tot += d > 0 ? d : 0;
         }
-        if (tot <= budget) {
-            for (int64_t j = 0; j < n; ++j)
-                dst[j] = recv[j] > send[j] ? recv[j] : send[j];
-        } else {
-            const float sc = std::fmin(
-                1.0f, (float)budget / std::fmax((float)tot, 1.0f));
-            for (int64_t j = 0; j < n; ++j)
-                dst[j] = adv_scalar(recv[j], send[j], sc,
-                                    (uint32_t)i, (uint32_t)j, s0);
-        }
+        const float sc = tot <= budget ? 1.0f : std::fmin(
+            1.0f, (float)budget / std::fmax((float)tot, 1.0f));
+        advance_row(w + i * n, recv, send, n, (uint32_t)i, s0,
+                    sc, tot <= budget, false);
     }
     for (int64_t i = 0; i < n; ++i) {
         const int8_t* __restrict recv = w_pre + p[i] * n;  // responder's pre
         const int8_t* __restrict send = w_pre + i * n;     // initiator's pre
-        int8_t* __restrict dst = w + p[i] * n;
         int32_t tot = 0;
         for (int64_t j = 0; j < n; ++j) {
             int32_t d = (int32_t)send[j] - (int32_t)recv[j];
             tot += d > 0 ? d : 0;
         }
-        if (tot <= budget) {
-            for (int64_t j = 0; j < n; ++j) {
-                int8_t m = recv[j] > send[j] ? recv[j] : send[j];
-                dst[j] = dst[j] > m ? dst[j] : m;
-            }
-        } else {
-            const float sc = std::fmin(
-                1.0f, (float)budget / std::fmax((float)tot, 1.0f));
-            for (int64_t j = 0; j < n; ++j) {
-                int8_t v = adv_scalar(recv[j], send[j], sc,
-                                      (uint32_t)i, (uint32_t)j, s1);
-                dst[j] = dst[j] > v ? dst[j] : v;
-            }
-        }
+        const float sc = tot <= budget ? 1.0f : std::fmin(
+            1.0f, (float)budget / std::fmax((float)tot, 1.0f));
+        advance_row(w + p[i] * n, recv, send, n, (uint32_t)i, s1,
+                    sc, tot <= budget, true);
     }
 }
 

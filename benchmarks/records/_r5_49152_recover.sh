@@ -2,21 +2,31 @@
 # Recover the 49,152 full-profile near checkpoint (round-5 incident:
 # the K-1 near trigger never fired and the periodic ckpt was deleted)
 # by re-walking the deterministic trajectory to R-1, then certify.
-# Ordering: the multi-GB certify replay must not run concurrently with
-# the 100k choice pipeline's own run/certify (OOM risk), so BOTH heavy
-# steps wait for it: the pipeline writes _r5_full_choice_100352.out at
-# stage start, and its wrapper process (cmdline contains lean_choice)
-# lives until the whole pipeline ends.
+#
+# Serialization gate (multi-GB steps must not overlap the 100k choice
+# pipeline): proceed only when the pipeline's COMPLETION RECORD exists
+# (r5_full_profile_convergence.json gains choice_100352 — written only
+# on success) or its stage output is old and orphaned (crashed pipeline
+# that will not be writing again), and no lean_choice stage is running.
 set -eu
 cd "$(dirname "$0")"
-wait_for_100k_pipeline() {
-    # Started AND finished: output file exists and no writer remains.
-    while [ ! -f _r5_full_choice_100352.out ] \
-        || pgrep -f "lean_choice" > /dev/null; do
-        sleep 120
-    done
+pipeline_done() {
+    pgrep -f "lean_choice" > /dev/null && return 1
+    python - <<'PYEOF'
+import json, os, sys, time
+try:
+    rec = json.load(open("r5_full_profile_convergence.json"))
+    if "choice_100352" in rec:
+        sys.exit(0)  # completed successfully
+except Exception:
+    pass
+out = "_r5_full_choice_100352.out"
+if os.path.exists(out) and time.time() - os.path.getmtime(out) > 1800:
+    sys.exit(0)  # orphaned crash: no writer for 30 min
+sys.exit(1)
+PYEOF
 }
-wait_for_100k_pipeline
+until pipeline_done; do sleep 120; done
 python - <<'PYEOF'
 import json, os, sys, time
 sys.path.insert(0, os.path.abspath(os.path.join("..", "..")))
@@ -24,14 +34,32 @@ from aiocluster_tpu.sim import budget_from_mtu
 from aiocluster_tpu.sim.hostsim import HostSimulator
 from aiocluster_tpu.sim.memory import full_config
 
+
+def battery_running():
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                if b"_r3_measure.py" in f.read():
+                    return True
+        except OSError:
+            continue
+    return False
+
+
 R = json.load(open("r5_full_profile_convergence.json"))["49152"]["value"]
 cfg = full_config(49_152, budget=budget_from_mtu(65_507))
 host = HostSimulator(cfg, seed=1)
 t0 = time.time()
-host.run(R - 1)  # deterministic: same seed => same trajectory
+for _ in range(R - 1):  # deterministic: same seed => same trajectory
+    host.run(1)
+    while battery_running():  # chip windows beat CPU hours
+        time.sleep(60)
 host.save("_r5_full_49152_near")
 print(f"re-walked to tick {host.tick} in {time.time()-t0:.0f}s; near saved",
       flush=True)
 PYEOF
 [ -f _r5_full_49152_near.json ]  # set -e: stop if the walk didn't land
+while pgrep -f "_r3_measure" > /dev/null; do sleep 60; done
 python _r5_full_certify.py --n 49152 all > _r5_full_certify_49152.out 2>&1
