@@ -62,4 +62,4 @@ print(f"re-walked to tick {host.tick} in {time.time()-t0:.0f}s; near saved",
 PYEOF
 [ -f _r5_full_49152_near.json ]  # set -e: stop if the walk didn't land
 while pgrep -f "_r3_measure" > /dev/null; do sleep 60; done
-python _r5_full_certify.py --n 49152 all > _r5_full_certify_49152.out 2>&1
+flock /tmp/r5_certify.lock python _r5_full_certify.py --n 49152 all > _r5_full_certify_49152.out 2>&1

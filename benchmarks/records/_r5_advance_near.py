@@ -1,0 +1,18 @@
+"""Advance the promoted 100k-choice near slot to R-1 host-side so the
+mesh final phase replays ONE round, not nineteen."""
+import json, os, sys, time
+HERE = os.path.dirname(os.path.abspath(__file__))
+os.chdir(HERE)
+sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+from aiocluster_tpu.sim import budget_from_mtu
+from aiocluster_tpu.sim.hostsim import HostSimulator
+from aiocluster_tpu.sim.memory import lean_config
+
+R = json.load(open("r5_full_profile_convergence.json"))["choice_100352"]["value"]
+cfg = lean_config(100_352, budget=budget_from_mtu(65_507), pairing="choice")
+host = HostSimulator.resume("_r5_full_choice_100352_near", cfg)
+print(f"resumed at {host.tick}; advancing to {R-1}", flush=True)
+t0 = time.time()
+host.run(R - 1 - host.tick)
+host.save("_r5_full_choice_100352_near")
+print(f"near now at tick {host.tick} ({time.time()-t0:.0f}s)", flush=True)
