@@ -10,9 +10,19 @@ from aiocluster_tpu.sim.memory import lean_config
 
 R = json.load(open("r5_full_profile_convergence.json"))["choice_100352"]["value"]
 cfg = lean_config(100_352, budget=budget_from_mtu(65_507), pairing="choice")
-host = HostSimulator.resume("_r5_full_choice_100352_near", cfg)
+SLOT = "_r5_full_choice_100352_near"
+host = HostSimulator.resume(SLOT, cfg)
 print(f"resumed at {host.tick}; advancing to {R-1}", flush=True)
 t0 = time.time()
 host.run(R - 1 - host.tick)
-host.save("_r5_full_choice_100352_near")
+# Never overwrite the SOLE checkpoint in place: save() is not
+# multi-file atomic (a kill between the array and the tick-bearing
+# json sidecar would leave advanced arrays under the old tick, and the
+# next resume would re-advance them off the trajectory). Save to a
+# scratch slot, then rename file-by-file with the json marker LAST.
+host.save(SLOT + ".adv")
+import glob
+
+for f in sorted(glob.glob(SLOT + ".adv.*"), key=lambda p: p.endswith(".json")):
+    os.replace(f, SLOT + f[len(SLOT + ".adv"):])
 print(f"near now at tick {host.tick} ({time.time()-t0:.0f}s)", flush=True)

@@ -33,5 +33,6 @@ else:
 PYEOF
 python _r5_full_profile_run.py --n 100352 --profile lean_choice \
     > _r5_full_choice_100352.out 2>&1 \
-  && python _r5_full_certify.py --n 100352 --profile lean_choice all \
+  && flock /tmp/r5_certify.lock \
+    python _r5_full_certify.py --n 100352 --profile lean_choice all \
     > _r5_choice_certify_100352.out 2>&1
