@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint check cov protos smoke clean
+.PHONY: test test-all lint check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -38,6 +38,20 @@ protos:
 smoke:
 	$(PY) bench.py --smoke
 	$(PY) __graft_entry__.py dryrun 8
+
+# Zero-to-telemetry check (docs/observability.md): run a short traced sim
+# with the metrics sampler on, then validate the trace is well-formed
+# JSONL carrying the per-round convergence series.
+obs-demo:
+	rm -f build/obs_demo_trace.jsonl && mkdir -p build
+	JAX_PLATFORMS=cpu $(PY) -m aiocluster_tpu sim --nodes 512 --keys 64 \
+		--mtu 5000 --lean --cpu --max-rounds 256 --metrics-stride 2 \
+		--trace-file build/obs_demo_trace.jsonl
+	$(PY) -c "from aiocluster_tpu.obs import read_trace; \
+		t = read_trace('build/obs_demo_trace.jsonl'); \
+		assert t and all(e['event'] == 'sim_round' for e in t), t; \
+		assert t[-1]['mean_fraction'] == 1.0, t[-1]; \
+		print(f'obs-demo OK: {len(t)} sampled rounds, converged')"
 
 clean:
 	rm -rf build .pytest_cache

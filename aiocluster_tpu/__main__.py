@@ -33,6 +33,7 @@ def _parse_kv(text: str) -> tuple[str, str]:
 
 async def _run_node(args: argparse.Namespace) -> int:
     from . import Cluster, Config, NodeId
+    from .obs import MetricsHTTPServer, TraceWriter, default_registry
 
     cfg = Config(
         node_id=NodeId(name=args.name, gossip_advertise_addr=args.listen),
@@ -40,23 +41,38 @@ async def _run_node(args: argparse.Namespace) -> int:
         seed_nodes=args.seed,
         gossip_interval=args.interval,
     )
-    async with Cluster(
-        cfg, initial_key_values=dict(args.set or [])
-    ) as cluster:
-        print(f"[{args.name}] listening on {args.listen[0]}:{args.listen[1]}",
-              file=sys.stderr, flush=True)
-        try:
-            while True:
-                await asyncio.sleep(args.interval)
-                snap = cluster.snapshot()
-                live = sorted(n.name for n in snap.live_nodes)
-                print(json.dumps({
-                    "node": args.name,
-                    "live": live,
-                    "nodes_known": len(snap.node_states),
-                }), flush=True)
-        except asyncio.CancelledError:
-            pass
+    trace = TraceWriter(args.trace_file) if args.trace_file else None
+    metrics_server = None
+    try:
+        if args.metrics_port is not None:
+            metrics_server = MetricsHTTPServer(
+                default_registry(), port=args.metrics_port
+            )
+            port = await metrics_server.start()
+            print(f"[{args.name}] /metrics on 127.0.0.1:{port}",
+                  file=sys.stderr, flush=True)
+        async with Cluster(
+            cfg, initial_key_values=dict(args.set or []), trace=trace
+        ) as cluster:
+            print(f"[{args.name}] listening on {args.listen[0]}:{args.listen[1]}",
+                  file=sys.stderr, flush=True)
+            try:
+                while True:
+                    await asyncio.sleep(args.interval)
+                    snap = cluster.snapshot()
+                    live = sorted(n.name for n in snap.live_nodes)
+                    print(json.dumps({
+                        "node": args.name,
+                        "live": live,
+                        "nodes_known": len(snap.node_states),
+                    }), flush=True)
+            except asyncio.CancelledError:
+                pass
+    finally:
+        if metrics_server is not None:
+            await metrics_server.stop()
+        if trace is not None:
+            trace.close()
     return 0
 
 
@@ -101,6 +117,38 @@ def _sim_config(args: argparse.Namespace):
     )
 
 
+def _make_telemetry(args: argparse.Namespace):
+    """(registry, trace, server, obs_kwargs) from the CLI flags. Telemetry
+    is opt-in: without --metrics-port/--trace-file the sim constructors
+    get no registry and the hot loop carries zero obs dispatches."""
+    from .obs import MetricsHTTPServer, TraceWriter, default_registry
+
+    trace = TraceWriter(args.trace_file) if args.trace_file else None
+    server = None
+    registry = None
+    if args.metrics_port is not None:
+        registry = default_registry()
+        server = MetricsHTTPServer(registry, port=args.metrics_port)
+        try:
+            port = server.start_in_thread()
+        except BaseException:
+            if trace is not None:
+                trace.close()
+            raise
+        print(f"[sim] /metrics on 127.0.0.1:{port}", file=sys.stderr,
+              flush=True)
+    kwargs = {}
+    if registry is not None or trace is not None:
+        kwargs = {
+            # metrics=None + a trace writer -> the sampler records into
+            # a private registry (SimMetrics' fallback).
+            "metrics": registry,
+            "metrics_stride": args.metrics_stride,
+            "trace_writer": trace,
+        }
+    return registry, trace, server, kwargs
+
+
 def _run_sim(args: argparse.Namespace, cfg) -> int:
     if args.host_native:
         # The native C fast-path: bit-identical to the device paths on
@@ -135,8 +183,16 @@ def _run_sim(args: argparse.Namespace, cfg) -> int:
             print("native hostsim build failed (g++ unavailable?)",
                   file=sys.stderr)
             return 2
-        host = hostsim.HostSimulator(cfg, seed=args.seed)
-        converged = host.run_until_converged(max_rounds=args.max_rounds)
+        _registry, trace, server, obs_kwargs = _make_telemetry(args)
+        try:
+            host = hostsim.HostSimulator(cfg, seed=args.seed, **obs_kwargs)
+            converged = host.run_until_converged(max_rounds=args.max_rounds)
+            telemetry_samples = host.flush_metrics()
+        finally:
+            if server is not None:
+                server.stop_thread()
+            if trace is not None:
+                trace.close()
         # Same record shape as the device path (consumers key off
         # "engine", not a divergent schema); metrics recomputed from w
         # with convergence_metrics' semantics (all nodes alive here).
@@ -155,14 +211,17 @@ def _run_sim(args: argparse.Namespace, cfg) -> int:
             "mean_fraction": float(host.w.mean(dtype=np.float64)) / k,
             "alive_count": cfg.n_nodes,
         }
-        print(json.dumps({
+        record = {
             "nodes": args.nodes,
             "shards": 1,
             "engine": "host-native",
             "rounds_to_convergence": converged,
             "tick": host.tick,
             "metrics": metrics,
-        }), flush=True)
+        }
+        if telemetry_samples:
+            record["telemetry_samples"] = len(telemetry_samples)
+        print(json.dumps(record), flush=True)
         return 0 if converged is not None else 1
 
     import jax
@@ -193,16 +252,27 @@ def _run_sim(args: argparse.Namespace, cfg) -> int:
             )
             return 2
         mesh = make_mesh(devices[: args.shards])
-    sim = Simulator(cfg, seed=args.seed, mesh=mesh, chunk=8)
-    converged = sim.run_until_converged(max_rounds=args.max_rounds)
+    _registry, trace, server, obs_kwargs = _make_telemetry(args)
+    try:
+        sim = Simulator(cfg, seed=args.seed, mesh=mesh, chunk=8, **obs_kwargs)
+        converged = sim.run_until_converged(max_rounds=args.max_rounds)
+        telemetry_samples = sim.flush_metrics()
+    finally:
+        if server is not None:
+            server.stop_thread()
+        if trace is not None:
+            trace.close()
     m = {k: v.tolist() for k, v in sim.metrics().items()}
-    print(json.dumps({
+    record = {
         "nodes": args.nodes,
         "shards": args.shards or 1,
         "rounds_to_convergence": converged,
         "tick": sim.tick,
         "metrics": m,
-    }), flush=True)
+    }
+    if telemetry_samples:
+        record["telemetry_samples"] = len(telemetry_samples)
+    print(json.dumps(record), flush=True)
     return 0 if converged is not None else 1
 
 
@@ -221,6 +291,12 @@ def main(argv: list[str] | None = None) -> int:
     node.add_argument("--interval", type=float, default=1.0)
     node.add_argument("--set", type=_parse_kv, action="append",
                       metavar="KEY=VALUE", help="initial key (repeatable)")
+    node.add_argument("--metrics-port", type=int, default=None,
+                      metavar="PORT",
+                      help="serve Prometheus text on 127.0.0.1:PORT"
+                      "/metrics (0 = ephemeral port, printed to stderr)")
+    node.add_argument("--trace-file", default=None, metavar="PATH",
+                      help="append per-round JSONL trace events to PATH")
 
     sim = sub.add_parser("sim", help="run a tensor-sim convergence study")
     sim.add_argument("--nodes", type=int, default=1024)
@@ -243,6 +319,16 @@ def main(argv: list[str] | None = None) -> int:
                      help="column-shard the owner axis over this many "
                      "devices (the BASELINE config-5 shape; 0 = one "
                      "device, no mesh)")
+    sim.add_argument("--metrics-port", type=int, default=None,
+                     metavar="PORT",
+                     help="serve Prometheus text on 127.0.0.1:PORT"
+                     "/metrics from a daemon thread (0 = ephemeral port)")
+    sim.add_argument("--trace-file", default=None, metavar="PATH",
+                     help="append sampled sim_round JSONL events to PATH")
+    sim.add_argument("--metrics-stride", type=int, default=64,
+                     help="rounds between metric samples (device metrics "
+                     "are buffered un-synced and flushed at the end; "
+                     "default 64)")
     sim.add_argument("--host-native", action="store_true",
                      help="run the native C host fast-path (bit-"
                      "identical on the matching domain — lean, or the "

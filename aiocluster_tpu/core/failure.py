@@ -156,7 +156,12 @@ class FailureDetector:
     def dead_nodes(self) -> list[NodeId]:
         return list(self._dead)
 
-    def update_node_liveness(self, node_id: NodeId, ts: datetime | None = None) -> None:
+    def update_node_liveness(
+        self, node_id: NodeId, ts: datetime | None = None
+    ) -> float | None:
+        """Re-evaluate one peer's live/dead state; returns the phi the
+        decision used (None = no heartbeat evidence yet), so telemetry
+        can sample exactly the decision value without recomputing it."""
         now = ts if ts is not None else utc_now()
         phi = self.phi(node_id, ts=now)
         alive = phi is not None and phi <= self._config.phi_threshhold
@@ -170,6 +175,7 @@ class FailureDetector:
             if window is not None:
                 # A dead node must re-earn its liveness with fresh samples.
                 window.reset()
+        return phi
 
     # -- dead-node lifecycle --------------------------------------------------
 

@@ -1,0 +1,47 @@
+"""Unified telemetry: metrics registry, traces, exposition, profiling.
+
+The reference has no tracing, metrics, or profiling at all (SURVEY.md §5);
+this package is the measurement substrate both backends report through:
+
+- ``registry``: dependency-free counters/gauges/histograms with labels,
+  safe to update from asyncio callbacks and worker threads alike.
+- ``expo``: Prometheus text-format rendering of a registry, plus an
+  optional asyncio HTTP ``/metrics`` endpoint (stdlib only).
+- ``trace``: a JSONL trace writer for per-round/per-event records, with a
+  reader for round-trips and offline analysis.
+- ``profiling``: the XLA device trace + wall-clock section timer that
+  used to live in ``utils/profiling.py``.
+
+Both the runtime layer (runtime/cluster.py and friends) and the sim layer
+(sim/simulator.py, sim/hostsim.py) accept a ``MetricsRegistry`` and emit
+through it; ``python -m aiocluster_tpu`` wires ``--metrics-port`` and
+``--trace-file`` to these pieces, and bench.py embeds a registry snapshot
+in every BENCH record. docs/observability.md catalogues the metric names.
+"""
+
+from .expo import MetricsHTTPServer, render_prometheus
+from .profiling import SectionTimer, device_trace
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from .sim import SimMetrics
+from .trace import TraceWriter, read_trace
+
+__all__ = (
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsHTTPServer",
+    "MetricsRegistry",
+    "SectionTimer",
+    "SimMetrics",
+    "TraceWriter",
+    "default_registry",
+    "device_trace",
+    "read_trace",
+    "render_prometheus",
+)

@@ -1,0 +1,179 @@
+"""Sim-backend instrumentation: stride-sampled, deferred-sync metrics.
+
+The sim hot loop must never pay a device->host sync for telemetry (one
+sync per round erases the batching the backend exists for). The contract
+here:
+
+- ``due(tick)`` decides on the host, from tick arithmetic alone, whether
+  this chunk boundary is a sample point (every ``stride`` rounds).
+- ``record(tick, sample)`` accepts the sample's metrics as *device
+  scalars* (or host floats, for the native host path) and buffers them.
+  Nothing is converted, so jit dispatch stays asynchronous.
+- ``flush()`` converts everything buffered in one go (a single sync at
+  the end of a run / on demand), pushes the latest values into the
+  registry gauges, emits one ``sim_round`` trace event per sample, and
+  returns the series as plain dicts.
+
+Wall-clock: ``record`` stamps ``perf_counter`` at dispatch time, so the
+per-round wall time derived between consecutive samples measures the
+async dispatch cadence; over a steady run backpressure makes it converge
+on true device-step time (the same reasoning the bench's best-of-N trial
+loop uses). docs/observability.md spells this out.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .registry import MetricsRegistry
+from .trace import TraceWriter
+
+# Gauge/counter names shared by both sim engines, labelled by engine
+# ("xla", "host-native") so a process driving both stays legible.
+_SAMPLE_GAUGES = (
+    ("aiocluster_sim_tick", "Current simulated gossip round"),
+    ("aiocluster_sim_mean_fraction", "Mean replicated fraction over alive pairs"),
+    ("aiocluster_sim_min_fraction", "Worst replicated fraction over alive pairs"),
+    ("aiocluster_sim_converged_owners", "Owners fully replicated to all alive nodes"),
+    ("aiocluster_sim_alive_nodes", "Nodes currently alive in the simulation"),
+    ("aiocluster_sim_version_spread", "Worst key-version lag over alive pairs"),
+)
+
+
+class SimMetrics:
+    """Stride sampler + registry/trace bridge for one sim run."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        trace: TraceWriter | None = None,
+        stride: int = 64,
+        engine: str = "xla",
+        bytes_per_kv: float = 35.0,
+        start_tick: int = 0,
+    ) -> None:
+        if stride < 1:
+            raise ValueError("metrics stride must be >= 1")
+        # No registry -> a PRIVATE one (trace-only runs), never the
+        # process default: a sim study must not inject stale series into
+        # a registry some other component serves over /metrics.
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.trace = trace
+        self.stride = stride
+        self.engine = engine
+        # Wire cost of one replicated key-version for the delta-bytes
+        # ESTIMATE (default: the bench workload's 8-byte keys/values
+        # under the proto3 framing of wire/sizes.py).
+        self.bytes_per_kv = bytes_per_kv
+        self._gauges = {
+            name: self.registry.gauge(name, help_text, labels=("engine",))
+            .labels(engine)
+            for name, help_text in _SAMPLE_GAUGES
+        }
+        self._rounds = self.registry.counter(
+            "aiocluster_sim_rounds_total",
+            "Simulated gossip rounds advanced",
+            labels=("engine",),
+        ).labels(engine)
+        self._step_seconds = self.registry.histogram(
+            "aiocluster_sim_step_seconds",
+            "Per-round wall time, derived between metric samples",
+            labels=("engine",),
+            buckets=(1e-5, 1e-4, 1e-3, 0.01, 0.1, 1.0, 10.0),
+        ).labels(engine)
+        self._delta_kvs = self.registry.counter(
+            "aiocluster_sim_delta_key_versions_total",
+            "Key-versions replicated by gossip (sampled between windows)",
+            labels=("engine",),
+        ).labels(engine)
+        self._delta_bytes = self.registry.counter(
+            "aiocluster_sim_delta_bytes_total",
+            "Estimated delta bytes moved (key-versions x wire cost)",
+            labels=("engine",),
+        ).labels(engine)
+        self._pending: list[tuple[int, float, dict]] = []
+        # Rounds run before the sampler existed (a resumed checkpoint's
+        # tick) must not inflate the rounds counter at the first sample.
+        self._start_tick = start_tick
+        self._last_tick: int | None = None
+        self._last_wall: float | None = None
+        self.samples: list[dict] = []
+
+    @property
+    def last_tick(self) -> int | None:
+        """Tick of the most recent sample (None before the first) — the
+        drivers use it to close the series at the run's final state."""
+        return self._last_tick
+
+    def due(self, tick: int) -> bool:
+        """Host-side stride gate: true when ``tick`` crossed into a new
+        stride window since the last sample (chunked steppers land on
+        chunk boundaries, so "crossed" rather than "equals a multiple")."""
+        if self._last_tick is None:
+            return True
+        return tick // self.stride > self._last_tick // self.stride
+
+    def record(self, tick: int, sample: dict) -> None:
+        """Buffer one sample. ``sample`` values may be device scalars —
+        they are NOT converted here."""
+        now = time.perf_counter()
+        prev = self._start_tick if self._last_tick is None else self._last_tick
+        if tick > prev:
+            self._rounds.inc(tick - prev)
+        self._pending.append((tick, now, dict(sample)))
+        self._last_tick = tick
+        self._last_wall = now
+
+    def flush(self) -> list[dict]:
+        """Convert buffered samples (the one deliberate sync), update
+        gauges to the latest values, emit trace events, and return the
+        full series accumulated so far."""
+        import numpy as np
+
+        prev_tick = prev_wall = None
+        if self.samples:
+            prev_tick = self.samples[-1]["tick"]
+            prev_wall = self.samples[-1]["_wall"]
+        prev_kv = None
+        if self.samples:
+            prev_kv = self.samples[-1].get("kv_known")
+        for tick, wall, raw in self._pending:
+            sample = {"tick": int(tick), "_wall": wall}
+            for key, value in raw.items():
+                sample[key] = float(np.asarray(value))
+            if prev_tick is not None and tick > prev_tick:
+                per_round = (wall - prev_wall) / (tick - prev_tick)
+                sample["step_seconds"] = round(per_round, 9)
+                self._step_seconds.observe(per_round)
+            kv = sample.get("kv_known")
+            if kv is not None and prev_kv is not None:
+                moved = max(kv - prev_kv, 0.0)
+                sample["delta_key_versions"] = moved
+                sample["delta_bytes_est"] = round(moved * self.bytes_per_kv)
+                self._delta_kvs.inc(moved)
+                self._delta_bytes.inc(moved * self.bytes_per_kv)
+            prev_kv = kv if kv is not None else prev_kv
+            prev_tick, prev_wall = tick, wall
+            self.samples.append(sample)
+            if self.trace is not None:
+                self.trace.emit(
+                    "sim_round",
+                    engine=self.engine,
+                    **{k: v for k, v in sample.items() if k != "_wall"},
+                )
+        self._pending.clear()
+        if self.samples:
+            last = self.samples[-1]
+            for short, gauge in (
+                ("tick", "aiocluster_sim_tick"),
+                ("mean_fraction", "aiocluster_sim_mean_fraction"),
+                ("min_fraction", "aiocluster_sim_min_fraction"),
+                ("converged_owners", "aiocluster_sim_converged_owners"),
+                ("alive_count", "aiocluster_sim_alive_nodes"),
+                ("version_spread", "aiocluster_sim_version_spread"),
+            ):
+                if short in last:
+                    self._gauges[gauge].set(last[short])
+        return [
+            {k: v for k, v in s.items() if k != "_wall"} for s in self.samples
+        ]

@@ -1,0 +1,79 @@
+"""JSONL trace writer: one JSON object per line, one line per event.
+
+The schema is deliberately open — every record carries ``event`` (the
+record type) and ``ts`` (seconds, ``time.time()``), and the emitter adds
+whatever scalar fields describe the event (docs/observability.md lists
+the event types both backends emit). JSONL keeps the file greppable,
+streamable, and loadable with one ``read_trace`` call or a pandas
+``read_json(lines=True)``.
+
+Writes are line-buffered under a lock (safe from asyncio callbacks and
+worker threads) and flushed per line so a crash mid-run loses at most the
+line being written — a trace that dies with the process is the one you
+need most.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+from pathlib import Path
+
+
+class TraceWriter:
+    """Append-only JSONL event sink. Usable as a context manager."""
+
+    def __init__(self, path: str | Path) -> None:
+        self.path = Path(path)
+        self._fh: io.TextIOBase | None = self.path.open("a", encoding="utf-8")
+        self._lock = threading.Lock()
+        self.events_written = 0
+
+    def emit(self, event: str, **fields: object) -> None:
+        """Write one record; silently drops events after close() (late
+        callbacks during shutdown must not raise into the event loop)."""
+        record = {"event": event, "ts": round(time.time(), 6), **fields}
+        line = json.dumps(record, separators=(",", ":"), default=str)
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            self.events_written += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "TraceWriter":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()
+
+
+def read_trace(path: str | Path) -> list[dict]:
+    """Load a JSONL trace back into a list of dicts. Raises ValueError
+    (with the line number) on a corrupt line — the obs-demo CI target
+    uses this as the validity check."""
+    records: list[dict] = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"{path}:{lineno}: invalid JSONL: {exc}") from None
+            if not isinstance(rec, dict) or "event" not in rec:
+                raise ValueError(
+                    f"{path}:{lineno}: trace records must be objects with "
+                    "an 'event' field"
+                )
+            records.append(rec)
+    return records

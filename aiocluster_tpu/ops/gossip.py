@@ -990,11 +990,25 @@ def convergence_metrics(
     pair_count = jnp.sum(pair_mask)
     n_converged = owner_ok.sum()
     min_frac = frac.min()
+    # Total key-versions replicated across alive pairs (capped at each
+    # owner's max): differencing consecutive samples gives the
+    # key-versions the gossip moved per window — the sim analogue of the
+    # runtime's delta_key_values counter. f32 sum: an ESTIMATE above
+    # ~2^24 total (fine for telemetry; convergence decisions never read
+    # this).
+    kv_known = jnp.sum(
+        jnp.where(
+            pair_mask,
+            jnp.minimum(state.w.astype(jnp.float32), needed.astype(jnp.float32)),
+            0.0,
+        )
+    )
     if axis_name is not None:
         n_converged = lax.psum(n_converged, axis_name)
         min_frac = lax.pmin(min_frac, axis_name)
         frac_sum = lax.psum(frac_sum, axis_name)
         pair_count = lax.psum(pair_count, axis_name)
+        kv_known = lax.psum(kv_known, axis_name)
     total = state.alive.shape[0]
     return {
         "converged_owners": n_converged,
@@ -1002,4 +1016,24 @@ def convergence_metrics(
         "min_fraction": jnp.minimum(min_frac, 1.0),
         "mean_fraction": frac_sum / jnp.maximum(pair_count, 1),
         "alive_count": state.alive.sum(),
+        "kv_known": kv_known,
     }
+
+
+def version_spread(
+    state: SimState, axis_name: str | None = None
+) -> jax.Array:
+    """Worst version lag over alive (observer, owner) pairs: how many
+    key-versions the most stale alive replica still misses. 0 at full
+    convergence; the obs layer samples it as the sim's staleness-depth
+    gauge (companion to convergence_metrics' fractions, which normalise
+    this away)."""
+    n_local = state.w.shape[1]
+    owners = _local_owner_ids(n_local, axis_name)
+    needed = state.max_version[owners][None, :]
+    pair_mask = state.alive[:, None] & state.alive[owners][None, :]
+    lag = jnp.where(pair_mask, needed - state.w.astype(jnp.int32), 0)
+    spread = jnp.maximum(lag.max(), 0)
+    if axis_name is not None:
+        spread = lax.pmax(spread, axis_name)
+    return spread

@@ -9,6 +9,7 @@ applications port without changes.
 from __future__ import annotations
 
 import asyncio
+import time
 from asyncio import StreamReader, StreamWriter
 from collections.abc import Awaitable, Callable, Sequence
 from contextlib import suppress
@@ -16,7 +17,6 @@ from dataclasses import dataclass
 from datetime import timedelta
 from random import Random
 from types import TracebackType
-from typing import Self
 
 from ..core.cluster_state import ClusterState
 from ..core.config import Config
@@ -25,6 +25,9 @@ from ..core.identity import Address, NodeId
 from ..core.kvstate import NodeState
 from ..core.messages import Ack, BadCluster, Packet, Syn, SynAck
 from ..core.values import VersionedValue
+from ..obs.registry import MetricsRegistry, default_registry
+from ..obs.trace import TraceWriter
+from ..utils.clock import utc_now
 from ..utils.logging import node_logger
 from ..wire import native as wire_native
 from .engine import GossipEngine
@@ -56,10 +59,45 @@ class Cluster:
         config: Config,
         initial_key_values: dict[str, str] | None = None,
         rng: Random | None = None,
+        metrics: MetricsRegistry | None = None,
+        trace: TraceWriter | None = None,
     ) -> None:
         self._config = config
         self._rng = rng if rng is not None else Random()
         self._log = node_logger(config.node_id.long_name())
+
+        # Telemetry (obs/): every subsystem reports through one registry —
+        # the process default unless the caller injects its own (tests and
+        # multi-node-per-process setups pass per-cluster registries).
+        # ``trace`` optionally records one JSONL event per gossip round
+        # and per membership transition.
+        self._metrics = metrics if metrics is not None else default_registry()
+        self._trace = trace
+        self._round_seconds = self._metrics.histogram(
+            "aiocluster_round_seconds",
+            "Wall-clock duration of one initiated gossip round",
+        )
+        self._peer_selection = self._metrics.counter(
+            "aiocluster_peer_selection_total",
+            "Gossip targets chosen per round, by kind (live/dead/seed)",
+            labels=("kind",),
+        )
+        self._phi_hist = self._metrics.histogram(
+            "aiocluster_fd_phi",
+            "Phi-accrual suspicion samples across peers",
+            buckets=(0.5, 1.0, 2.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0),
+        )
+        self._fd_transitions = self._metrics.counter(
+            "aiocluster_fd_transitions_total",
+            "Failure-detector membership transitions, by new state",
+            labels=("to",),
+        )
+        self._live_gauge = self._metrics.gauge(
+            "aiocluster_live_nodes", "Peers currently believed live"
+        )
+        self._dead_gauge = self._metrics.gauge(
+            "aiocluster_dead_nodes", "Peers currently believed dead"
+        )
 
         self._cluster_state = ClusterState(seed_addrs=set(config.seed_nodes))
         self._failure_detector = FailureDetector(config.failure_detector)
@@ -68,12 +106,14 @@ class Cluster:
             drain_on_shutdown=config.drain_hooks_on_shutdown,
             shutdown_timeout=config.hook_shutdown_timeout,
             log=self._log,
+            metrics=self._metrics,
         )
         self._engine = GossipEngine(
             config,
             self._cluster_state,
             self._failure_detector,
             on_key_change=self._emit_key_change,
+            metrics=self._metrics,
         )
         self._transport = GossipTransport(
             max_payload_size=config.max_payload_size,
@@ -83,6 +123,7 @@ class Cluster:
             tls_server_context=config.tls_server_context,
             tls_client_context=config.tls_client_context,
             tls_server_hostname=config.tls_server_hostname,
+            metrics=self._metrics,
         )
         initial_delay = (
             self._rng.uniform(0, config.gossip_jitter * config.gossip_interval)
@@ -94,6 +135,8 @@ class Cluster:
             config.gossip_interval,
             initial_delay=initial_delay,
             on_error=lambda exc: self._log.exception(f"Gossip round error: {exc}"),
+            metrics=self._metrics,
+            metrics_label="gossip",
         )
         self._gossip_semaphore = asyncio.Semaphore(
             max(1, config.max_concurrent_gossip)
@@ -117,7 +160,7 @@ class Cluster:
 
     # -- lifecycle ------------------------------------------------------------
 
-    async def __aenter__(self) -> Self:
+    async def __aenter__(self) -> "Cluster":
         await self.start()
         return self
 
@@ -205,6 +248,12 @@ class Cluster:
     def hook_stats(self) -> HookStats:
         return self._hooks.stats()
 
+    def metrics_registry(self) -> MetricsRegistry:
+        """The registry this cluster reports through (the process default
+        unless one was injected) — hand it to ``obs.render_prometheus`` or
+        an ``obs.MetricsHTTPServer``."""
+        return self._metrics
+
     # -- hooks ----------------------------------------------------------------
 
     def on_node_join(self, callback: NodeEventCallback) -> None:
@@ -270,6 +319,7 @@ class Cluster:
     # -- gossip round (initiator) --------------------------------------------
 
     async def _gossip_round(self) -> None:
+        round_start = time.perf_counter()
         tls_names: dict[Address, str | None] = {
             n.gossip_advertise_addr: n.tls_name
             for n in self._cluster_state.nodes()
@@ -288,29 +338,51 @@ class Cluster:
             peers, live, dead, seeds, rng=self._rng,
             gossip_count=self._config.gossip_count,
         )
+        if targets:
+            self._peer_selection.labels("live").inc(len(targets))
+        if dead_target is not None:
+            self._peer_selection.labels("dead").inc()
+        if seed_target is not None:
+            self._peer_selection.labels("seed").inc()
 
         self.self_node_state().inc_heartbeat()
         self._cluster_state.gc_marked_for_deletion(
             timedelta(seconds=self._config.marked_for_deletion_grace_period)
         )
 
-        async with asyncio.TaskGroup() as tg:
-            for host, port in targets:
-                tg.create_task(
-                    self._gossip_with(host, port, "live", tls_names.get((host, port)))
-                )
-            if dead_target is not None:
-                host, port = dead_target
-                tg.create_task(
-                    self._gossip_with(host, port, "dead", tls_names.get(dead_target))
-                )
-            if seed_target is not None:
-                host, port = seed_target
-                tg.create_task(
-                    self._gossip_with(host, port, "seed", tls_names.get(seed_target))
-                )
+        # gather, not TaskGroup (3.11+): _gossip_with contains its own
+        # failures, so plain fan-out-and-wait has identical semantics.
+        handshakes = [
+            self._gossip_with(host, port, "live", tls_names.get((host, port)))
+            for host, port in targets
+        ]
+        if dead_target is not None:
+            host, port = dead_target
+            handshakes.append(
+                self._gossip_with(host, port, "dead", tls_names.get(dead_target))
+            )
+        if seed_target is not None:
+            host, port = seed_target
+            handshakes.append(
+                self._gossip_with(host, port, "seed", tls_names.get(seed_target))
+            )
+        if handshakes:
+            await asyncio.gather(*handshakes)
 
         self._update_liveness()
+        duration = time.perf_counter() - round_start
+        self._round_seconds.observe(duration)
+        if self._trace is not None:
+            self._trace.emit(
+                "gossip_round",
+                node=self._config.node_id.name,
+                duration_s=round(duration, 6),
+                targets=len(targets)
+                + (dead_target is not None)
+                + (seed_target is not None),
+                live=len(live),
+                dead=len(dead),
+            )
 
     async def _gossip_with(
         self, host: str, port: int, label: str, tls_name: str | None = None
@@ -334,7 +406,8 @@ class Cluster:
                     self._log.debug(
                         f"Unexpected gossip reply from {label} {host}:{port}"
                     )
-            except (TimeoutError, OSError, asyncio.IncompleteReadError, ValueError) as exc:
+            except (TimeoutError, asyncio.TimeoutError, OSError,
+                asyncio.IncompleteReadError, ValueError) as exc:
                 self._log.debug(f"Gossip with {label} {host}:{port} failed: {exc}")
             except Exception as exc:
                 self._log.exception(f"Gossip with {label} {host}:{port} errored: {exc}")
@@ -368,7 +441,8 @@ class Cluster:
                 self._log.debug("Unexpected gossip ack message type")
                 return
             self._engine.handle_ack(ack)
-        except (TimeoutError, OSError, asyncio.IncompleteReadError, ValueError) as exc:
+        except (TimeoutError, asyncio.TimeoutError, OSError,
+                asyncio.IncompleteReadError, ValueError) as exc:
             self._log.debug(f"Server gossip error: {exc}")
         except Exception as exc:
             self._log.exception(f"Server gossip exception: {exc}")
@@ -396,14 +470,40 @@ class Cluster:
     # -- liveness -------------------------------------------------------------
 
     def _update_liveness(self) -> None:
+        # One timestamp for the whole pass; update_node_liveness returns
+        # the phi each decision actually used, so the histogram samples
+        # exactly the decision values with no recomputation.
+        now = utc_now()
         for node_id in self._cluster_state.nodes():
             if node_id != self.self_node_id:
-                self._failure_detector.update_node_liveness(node_id)
+                phi = self._failure_detector.update_node_liveness(
+                    node_id, ts=now
+                )
+                if phi is not None:
+                    self._phi_hist.observe(phi)
         live = set(self._failure_detector.live_nodes())
         for node_id in live - self._prev_live:
+            self._fd_transitions.labels("live").inc()
+            if self._trace is not None:
+                self._trace.emit(
+                    "node_transition",
+                    node=self._config.node_id.name,
+                    peer=node_id.name,
+                    to="live",
+                )
             self._hooks.emit(tuple(self._on_node_join), (node_id,))
         for node_id in self._prev_live - live:
+            self._fd_transitions.labels("dead").inc()
+            if self._trace is not None:
+                self._trace.emit(
+                    "node_transition",
+                    node=self._config.node_id.name,
+                    peer=node_id.name,
+                    to="dead",
+                )
             self._hooks.emit(tuple(self._on_node_leave), (node_id,))
         self._prev_live = live
+        self._live_gauge.set(len(live))
+        self._dead_gauge.set(len(self._failure_detector.dead_nodes()))
         for node_id in self._failure_detector.garbage_collect():
             self._cluster_state.remove_node(node_id)

@@ -15,7 +15,12 @@ from ..core.config import Config
 from ..core.failure import FailureDetector
 from ..core.identity import NodeId
 from ..core.kvstate import KeyChangeFn
-from ..core.messages import Ack, BadCluster, Digest, Packet, Syn, SynAck
+from ..core.messages import Ack, BadCluster, Delta, Digest, Packet, Syn, SynAck
+from ..obs.registry import MetricsRegistry
+
+
+def _delta_kv_count(delta: Delta) -> int:
+    return sum(len(nd.key_values) for nd in delta.node_deltas)
 
 
 class GossipEngine:
@@ -27,11 +32,38 @@ class GossipEngine:
         cluster_state: ClusterState,
         failure_detector: FailureDetector,
         on_key_change: KeyChangeFn | None = None,
+        metrics: MetricsRegistry | None = None,
     ) -> None:
         self._config = config
         self._state = cluster_state
         self._fd = failure_detector
         self._on_key_change = on_key_change
+        # Protocol-level telemetry: handshake steps by role/step, and the
+        # reconciliation payload itself — key-version updates sent vs
+        # applied (the transport counts the wire bytes; this counts the
+        # anti-entropy work those bytes bought).
+        self._steps = self._delta_kvs = None
+        if metrics is not None:
+            self._steps = metrics.counter(
+                "aiocluster_handshake_steps_total",
+                "Handshake state-machine steps executed, by step",
+                labels=("step",),
+            )
+            self._delta_kvs = metrics.counter(
+                "aiocluster_delta_key_values_total",
+                "Key-version updates carried by deltas, sent vs applied",
+                labels=("direction",),
+            )
+
+    def _note(self, step: str, sent: Delta | None = None,
+              applied: Delta | None = None) -> None:
+        if self._steps is None:
+            return
+        self._steps.labels(step).inc()
+        if sent is not None:
+            self._delta_kvs.labels("sent").inc(_delta_kv_count(sent))
+        if applied is not None:
+            self._delta_kvs.labels("applied").inc(_delta_kv_count(applied))
 
     # -- digest helpers -------------------------------------------------------
 
@@ -55,6 +87,7 @@ class GossipEngine:
 
     def make_syn(self) -> Packet:
         """Initiator step 1: advertise what we know."""
+        self._note("make_syn")
         return Packet(
             self._config.cluster_id, Syn(self._self_digest(self._excluded()))
         )
@@ -63,6 +96,7 @@ class GossipEngine:
         """Responder step: answer a Syn with our digest plus the delta the
         initiator is missing — or BadCluster on cluster-id mismatch."""
         if packet.cluster_id != self._config.cluster_id:
+            self._note("bad_cluster")
             return Packet(self._config.cluster_id, BadCluster())
         assert isinstance(packet.msg, Syn)
         self._observe_digest(packet.msg.digest)
@@ -70,6 +104,7 @@ class GossipEngine:
         delta = self._state.compute_partial_delta_respecting_mtu(
             packet.msg.digest, self._config.max_payload_size, excluded
         )
+        self._note("handle_syn", sent=delta)
         return Packet(
             self._config.cluster_id, SynAck(self._self_digest(excluded), delta)
         )
@@ -84,9 +119,11 @@ class GossipEngine:
         delta = self._state.compute_partial_delta_respecting_mtu(
             packet.msg.digest, self._config.max_payload_size, excluded
         )
+        self._note("handle_synack", sent=delta, applied=packet.msg.delta)
         return Packet(self._config.cluster_id, Ack(delta))
 
     def handle_ack(self, packet: Packet) -> None:
         """Responder final step: apply the initiator's delta."""
         assert isinstance(packet.msg, Ack)
+        self._note("handle_ack", applied=packet.msg.delta)
         self._state.apply_delta(packet.msg.delta, on_key_change=self._on_key_change)

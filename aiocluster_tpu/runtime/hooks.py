@@ -15,6 +15,8 @@ from collections.abc import Awaitable, Callable
 from contextlib import suppress
 from dataclasses import dataclass
 
+from ..obs.registry import MetricsRegistry
+
 HookCallback = Callable[..., Awaitable[None]]
 
 
@@ -43,6 +45,7 @@ class HookDispatcher:
         drain_on_shutdown: bool = True,
         shutdown_timeout: float = 5.0,
         log: logging.Logger | logging.LoggerAdapter | None = None,
+        metrics: MetricsRegistry | None = None,
     ) -> None:
         if maxsize <= 0:
             raise ValueError("hook_queue_maxsize must be > 0")
@@ -55,6 +58,25 @@ class HookDispatcher:
         self._processed = 0
         self._dropped = 0
         self._errors = 0
+        # HookStats, folded into the metrics registry: same four counters
+        # by outcome label, plus a live queue-depth gauge. stats() keeps
+        # returning the dataclass for existing callers.
+        self._events_metric = self._queue_gauge = None
+        if metrics is not None:
+            self._events_metric = metrics.counter(
+                "aiocluster_hook_events_total",
+                "Hook events by outcome (enqueued/processed/dropped/error)",
+                labels=("outcome",),
+            )
+            self._queue_gauge = metrics.gauge(
+                "aiocluster_hook_queue_size", "Hook events waiting in queue"
+            )
+
+    def _count(self, outcome: str, amount: int = 1) -> None:
+        if self._events_metric is not None:
+            self._events_metric.labels(outcome).inc(amount)
+        if self._queue_gauge is not None:
+            self._queue_gauge.set(self._queue.qsize())
 
     def start(self) -> None:
         if self._worker is None:
@@ -67,8 +89,10 @@ class HookDispatcher:
         try:
             self._queue.put_nowait(_Event(callbacks, args))
             self._enqueued += 1
+            self._count("enqueued")
         except asyncio.QueueFull:
             self._dropped += 1
+            self._count("dropped")
 
     def stats(self) -> HookStats:
         return HookStats(
@@ -91,9 +115,11 @@ class HookDispatcher:
                         await callback(*event.args)
                     except Exception as exc:
                         self._errors += 1
+                        self._count("error")
                         self._log.exception(f"Hook callback error: {exc}")
             finally:
                 self._processed += 1
+                self._count("processed")
                 self._queue.task_done()
 
     async def stop(self) -> None:
@@ -105,10 +131,12 @@ class HookDispatcher:
                 await asyncio.wait_for(
                     self._queue.join(), timeout=self._shutdown_timeout
                 )
-            except TimeoutError:
+            except (TimeoutError, asyncio.TimeoutError):
                 self._dropped += self._queue.qsize()
+                self._count("dropped", self._queue.qsize())
         else:
             self._dropped += self._queue.qsize()
+            self._count("dropped", self._queue.qsize())
 
         if not worker.done():
             if self._drain_on_shutdown:
@@ -116,7 +144,7 @@ class HookDispatcher:
                     self._queue.put_nowait(None)
                 try:
                     await asyncio.wait_for(worker, timeout=self._shutdown_timeout)
-                except TimeoutError:
+                except (TimeoutError, asyncio.TimeoutError):
                     worker.cancel()
             else:
                 worker.cancel()

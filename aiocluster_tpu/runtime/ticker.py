@@ -10,6 +10,8 @@ from __future__ import annotations
 import asyncio
 from collections.abc import Awaitable, Callable
 
+from ..obs.registry import MetricsRegistry
+
 
 def drift_compensated_timeout(
     interval: float, tick_start: float, tick_stop: float
@@ -30,6 +32,8 @@ class Ticker:
         initial_delay: float = 0.0,
         timeout_func: Callable[[float, float, float], float] | None = None,
         on_error: Callable[[Exception], None] | None = None,
+        metrics: MetricsRegistry | None = None,
+        metrics_label: str = "tick",
     ) -> None:
         self._tick = tick
         self._interval = interval
@@ -38,6 +42,27 @@ class Ticker:
         self._on_error = on_error
         self._task: asyncio.Task[None] | None = None
         self._stopping = False
+        # Per-tick telemetry, labelled so several tickers in one process
+        # (or one registry) stay distinguishable. Overruns — ticks longer
+        # than the interval, where drift compensation clamps to zero sleep
+        # and the schedule slips — get their own counter.
+        self._seconds = self._errors = self._overruns = None
+        if metrics is not None:
+            self._seconds = metrics.histogram(
+                "aiocluster_ticker_seconds",
+                "Wall-clock duration of one tick callback",
+                labels=("ticker",),
+            ).labels(metrics_label)
+            self._errors = metrics.counter(
+                "aiocluster_ticker_errors_total",
+                "Tick callbacks that raised",
+                labels=("ticker",),
+            ).labels(metrics_label)
+            self._overruns = metrics.counter(
+                "aiocluster_ticker_overruns_total",
+                "Ticks that ran longer than the interval",
+                labels=("ticker",),
+            ).labels(metrics_label)
 
     @property
     def closed(self) -> bool:
@@ -52,11 +77,18 @@ class Ticker:
             try:
                 await self._tick()
             except Exception as exc:
+                if self._errors is not None:
+                    self._errors.inc()
                 if self._on_error is None:
                     raise
                 self._on_error(exc)
+            stopped = loop.time()
+            if self._seconds is not None:
+                self._seconds.observe(stopped - started)
+                if stopped - started > self._interval:
+                    self._overruns.inc()
             await asyncio.sleep(
-                self._timeout_func(self._interval, started, loop.time())
+                self._timeout_func(self._interval, started, stopped)
             )
 
     def start(self) -> None:

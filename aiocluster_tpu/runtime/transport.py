@@ -14,6 +14,7 @@ from asyncio import StreamReader, StreamWriter
 from collections.abc import Awaitable, Callable
 
 from ..core.messages import Packet
+from ..obs.registry import MetricsRegistry
 from ..utils.framing import HEADER_SIZE, frame, read_frame_size
 from ..wire import decode_packet, encode_packet
 
@@ -31,6 +32,7 @@ class GossipTransport:
         tls_server_context: ssl.SSLContext | None = None,
         tls_client_context: ssl.SSLContext | None = None,
         tls_server_hostname: str | None = None,
+        metrics: MetricsRegistry | None = None,
     ) -> None:
         self._max_payload_size = max_payload_size
         self._connect_timeout = connect_timeout
@@ -39,6 +41,22 @@ class GossipTransport:
         self._tls_server_context = tls_server_context
         self._tls_client_context = tls_client_context
         self._tls_server_hostname = tls_server_hostname
+        # Wire-level telemetry: every framed packet counted by handshake
+        # message type and direction, bytes as framed on the wire (header
+        # included) — so syn (digest-only) vs synack/ack (delta-carrying)
+        # traffic separates cleanly in the exposition.
+        self._packets = self._bytes = None
+        if metrics is not None:
+            self._packets = metrics.counter(
+                "aiocluster_gossip_packets_total",
+                "Gossip packets by handshake message type and direction",
+                labels=("type", "direction"),
+            )
+            self._bytes = metrics.counter(
+                "aiocluster_gossip_bytes_total",
+                "Framed gossip bytes on the wire (header included)",
+                labels=("type", "direction"),
+            )
 
     # -- client side ----------------------------------------------------------
 
@@ -97,8 +115,18 @@ class GossipTransport:
         raw = await asyncio.wait_for(
             reader.readexactly(size), timeout=self._read_timeout
         )
-        return decode_packet(raw)
+        packet = decode_packet(raw)
+        if self._packets is not None:
+            kind = type(packet.msg).__name__.lower()
+            self._packets.labels(kind, "in").inc()
+            self._bytes.labels(kind, "in").inc(HEADER_SIZE + size)
+        return packet
 
     async def write_packet(self, writer: StreamWriter, packet: Packet) -> None:
-        writer.write(frame(encode_packet(packet)))
+        raw = frame(encode_packet(packet))
+        if self._packets is not None:
+            kind = type(packet.msg).__name__.lower()
+            self._packets.labels(kind, "out").inc()
+            self._bytes.labels(kind, "out").inc(len(raw))
+        writer.write(raw)
         await asyncio.wait_for(writer.drain(), timeout=self._write_timeout)

@@ -42,6 +42,9 @@ from pathlib import Path
 
 import numpy as np
 
+from ..obs.registry import MetricsRegistry
+from ..obs.sim import SimMetrics
+from ..obs.trace import TraceWriter
 from ..utils.cbuild import build_and_load
 from .config import SimConfig
 
@@ -181,6 +184,9 @@ class HostSimulator:
         state_w: np.ndarray | None = None,
         tick: int = 0,
         state_extra: dict[str, np.ndarray] | None = None,
+        metrics: MetricsRegistry | None = None,
+        metrics_stride: int = 64,
+        trace_writer: TraceWriter | None = None,
     ) -> None:
         if not supported(cfg):
             raise ValueError(
@@ -214,28 +220,41 @@ class HostSimulator:
             self.w = np.ascontiguousarray(state_w)
         self.tick = int(tick)
         self._row_min = np.zeros((n,), dtype=np.int32)
+        # Unified telemetry (obs/): the same stride sampler the XLA
+        # Simulator uses, engine-labelled "host-native". Each sample costs
+        # one pass over w, so the stride bounds the overhead exactly.
+        self._obs: SimMetrics | None = None
+        if metrics is not None or trace_writer is not None:
+            self._obs = SimMetrics(
+                metrics, trace_writer, stride=metrics_stride,
+                engine="host-native", start_tick=self.tick,
+            )
         # Full-profile state (mirrors init_state's hb/FD matrices at the
         # Simulator's exact dtypes — the bit-identity tests compare these
         # arrays directly). ``state_extra`` restores them on resume.
         self._track_hb = cfg.track_heartbeats
         self._track_fd = cfg.track_failure_detector
+        # Shared by BOTH profile blocks below — hoisted so the FD block
+        # never depends on the heartbeat block having run (SimConfig
+        # currently rejects FD-without-heartbeats, but that invariant
+        # must not be what keeps this code a going concern).
+        extra = state_extra or {}
+
+        def take(name, default):
+            arr = extra.get(name)
+            if arr is None:
+                return default
+            # Hard errors, not asserts: under python -O a
+            # wrong-shape array would flow straight into the
+            # raw-pointer C kernels.
+            if arr.shape != default.shape or arr.dtype != default.dtype:
+                raise ValueError(
+                    f"checkpoint {name}: {arr.dtype}{arr.shape} != "
+                    f"expected {default.dtype}{default.shape}"
+                )
+            return np.ascontiguousarray(arr)
+
         if self._track_hb:
-            extra = state_extra or {}
-
-            def take(name, default):
-                arr = extra.get(name)
-                if arr is None:
-                    return default
-                # Hard errors, not asserts: under python -O a
-                # wrong-shape array would flow straight into the
-                # raw-pointer C kernels.
-                if arr.shape != default.shape or arr.dtype != default.dtype:
-                    raise ValueError(
-                        f"checkpoint {name}: {arr.dtype}{arr.shape} != "
-                        f"expected {default.dtype}{default.shape}"
-                    )
-                return np.ascontiguousarray(arr)
-
             hb0 = np.zeros((n, n), np.int16)
             np.fill_diagonal(hb0, 1)
             self.hb = take("hb", hb0)
@@ -442,6 +461,7 @@ class HostSimulator:
     def run(self, rounds: int) -> None:
         for _ in range(rounds):
             self._step(track=False)
+            self._maybe_sample()
 
     def run_until_converged(
         self,
@@ -457,11 +477,49 @@ class HostSimulator:
         elif bool((self.w.min(axis=1) >= self.max_version).all()):
             return self.tick
         while self.tick < max_rounds:
-            if self._step(track=True):
+            converged = self._step(track=True)
+            self._maybe_sample()
+            if converged:
                 return self.tick
             if on_round is not None:
                 on_round(self.tick)
         return None
+
+    # -- telemetry ------------------------------------------------------------
+
+    def _maybe_sample(self) -> None:
+        if self._obs is None or not self._obs.due(self.tick):
+            return
+        self._sample_now()
+
+    def _sample_now(self) -> None:
+        k = self.cfg.keys_per_node
+        col_min = self.w.min(axis=0)
+        w_min = int(self.w.min())
+        self._obs.record(
+            self.tick,
+            {
+                "converged_owners": int((col_min >= k).sum()),
+                "min_fraction": w_min / k,
+                "mean_fraction": float(self.w.mean(dtype=np.float64)) / k,
+                "alive_count": self.cfg.n_nodes,
+                # max_version is uniform on this domain (no writes), so
+                # the worst pair lag collapses to max - global min, and
+                # w <= k everywhere makes the plain sum the capped one.
+                "version_spread": int(self.max_version.max()) - w_min,
+                "kv_known": float(self.w.sum(dtype=np.int64)),
+            },
+        )
+
+    def flush_metrics(self) -> list[dict]:
+        """Push buffered samples into the registry/trace; returns the
+        series (empty when obs was not enabled). Host arrays mean no
+        device sync — this exists for API symmetry with Simulator."""
+        if self._obs is None:
+            return []
+        if self._obs.last_tick != self.tick:
+            self._sample_now()  # close the series at the final state
+        return self._obs.flush()
 
     # -- checkpointing --------------------------------------------------------
 

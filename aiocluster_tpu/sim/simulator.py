@@ -16,7 +16,10 @@ from jax import lax, random
 from jax.sharding import Mesh
 
 from ..models.topology import Topology
-from ..ops.gossip import convergence_metrics, sim_step
+from ..obs.registry import MetricsRegistry
+from ..obs.sim import SimMetrics
+from ..obs.trace import TraceWriter
+from ..ops.gossip import convergence_metrics, sim_step, version_spread
 from ..parallel.mesh import (
     shard_state,
     sharded_chunk_fn,
@@ -25,6 +28,15 @@ from ..parallel.mesh import (
 )
 from .config import SimConfig
 from .state import SimState, init_state
+
+
+@jax.jit
+def _metrics_sample(state: SimState) -> dict[str, jax.Array]:
+    """convergence_metrics + version spread in one fused device pass —
+    the quantity bundle the obs stride sampler buffers per window."""
+    out = convergence_metrics(state)
+    out["version_spread"] = version_spread(state)
+    return out
 
 
 @partial(jax.jit, static_argnames=("cfg", "m"), donate_argnums=(0,))
@@ -80,6 +92,9 @@ class Simulator:
         initial_versions=None,
         trace: bool = False,
         state: SimState | None = None,
+        metrics: MetricsRegistry | None = None,
+        metrics_stride: int = 64,
+        trace_writer: TraceWriter | None = None,
     ) -> None:
         if topology is not None and topology.n_nodes != cfg.n_nodes:
             raise ValueError("topology size != cfg.n_nodes")
@@ -123,15 +138,30 @@ class Simulator:
         self._known_max_version = int(np.asarray(self.state.max_version).max())
         self._host_tick = int(np.asarray(self.state.tick))
         self._version_base_tick = self._host_tick
+        # Unified telemetry (obs/): a stride sampler that buffers DEVICE
+        # scalars at chunk boundaries and converts only on
+        # flush_metrics() — the jit'd hot loop never syncs for metrics.
+        # Enabled by passing a registry and/or a JSONL trace writer.
+        # start_tick anchors the rounds counter for resumed checkpoints.
+        self._obs: SimMetrics | None = None
+        if metrics is not None or trace_writer is not None:
+            self._obs = SimMetrics(
+                metrics, trace_writer, stride=metrics_stride, engine="xla",
+                start_tick=self._host_tick,
+            )
         # select_peers' churn-free 'choice' fast path samples uniformly
         # over ALL nodes (the alive mask is statically all-true for
         # states this config family produces). A provided state carrying
         # dead nodes — e.g. a checkpoint from a churn run — would be
         # silently mis-sampled; refuse it here, where alive is concrete
-        # and the check is free.
+        # and the check is free. peer_mode='view' samples from live_view
+        # instead of the alive mask, so view-mode resumes with dead nodes
+        # are legitimate and pass (the guard matches EXACTLY the
+        # select_peers fast path it protects).
         if (
             state is not None
             and cfg.pairing == "choice"
+            and cfg.peer_mode == "alive"
             and cfg.death_rate == 0.0
             and cfg.revival_rate == 0.0
             and not bool(np.asarray(self.state.alive).all())
@@ -225,6 +255,7 @@ class Simulator:
                 )
             done += m
             self._host_tick += m
+            self._maybe_sample()
             if self._trace_enabled:
                 self._record_trace()
 
@@ -250,6 +281,7 @@ class Simulator:
                     self.state, self._key, self.cfg, m, self._adj, self._deg
                 )
             self._host_tick += m
+            self._maybe_sample()
             if self._trace_enabled:
                 self._record_trace()
             first = int(first)
@@ -258,6 +290,32 @@ class Simulator:
         return None
 
     # -- observation ----------------------------------------------------------
+
+    def _sample_now(self) -> None:
+        """Device-side metric sample (no host sync): the dispatch queues
+        a small fused reduction; conversion waits for flush_metrics()."""
+        if self._mesh is not None:
+            sample = self._sharded_metrics(self.state)
+        else:
+            sample = _metrics_sample(self.state)
+        self._obs.record(self._host_tick, sample)
+
+    def _maybe_sample(self) -> None:
+        if self._obs is not None and self._obs.due(self._host_tick):
+            self._sample_now()
+
+    def flush_metrics(self) -> list[dict]:
+        """Convert buffered metric samples (one device sync), update the
+        registry gauges, emit trace events; returns the sampled series.
+        No-op (empty list) when obs was not enabled."""
+        if self._obs is None:
+            return []
+        # Close the series at the run's final state: a run whose last
+        # rounds fell inside one stride window would otherwise end its
+        # series (and leave the gauges) strides short of convergence.
+        if self._obs.last_tick != self._host_tick:
+            self._sample_now()
+        return self._obs.flush()
 
     def _record_trace(self) -> None:
         m = self.metrics()
@@ -275,7 +333,7 @@ class Simulator:
         if self._mesh is not None:
             m = self._sharded_metrics(self.state)
         else:
-            m = convergence_metrics(self.state)
+            m = _metrics_sample(self.state)
         return {k: np.asarray(v) for k, v in m.items()}
 
     @property

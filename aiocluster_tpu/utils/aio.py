@@ -1,0 +1,52 @@
+"""Asyncio compatibility helpers.
+
+The package runs on Python 3.10+, but ``asyncio.timeout`` only landed in
+3.11. ``timeout_after`` is the portable spelling used by the tests and
+benchmark harnesses: on 3.11+ it IS ``asyncio.timeout``; on 3.10 a small
+shim reproduces the same contract (cancel the enclosing task at the
+deadline, surface it as the builtin ``TimeoutError``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from contextlib import asynccontextmanager
+
+if hasattr(asyncio, "timeout"):
+    timeout_after = asyncio.timeout
+else:
+
+    @asynccontextmanager
+    async def timeout_after(delay: float):
+        # Shim limitation vs the real asyncio.timeout: an EXTERNAL cancel
+        # racing the deadline timer cannot be told apart from the timeout
+        # on 3.10 (no Task.uncancel), so it surfaces as TimeoutError.
+        task = asyncio.current_task()
+        assert task is not None
+        loop = asyncio.get_running_loop()
+        timed_out = False
+
+        def _fire() -> None:
+            nonlocal timed_out
+            timed_out = True
+            task.cancel()
+
+        handle = loop.call_later(delay, _fire)
+        try:
+            yield
+        except asyncio.CancelledError:
+            if timed_out:
+                raise TimeoutError from None
+            raise
+        else:
+            if timed_out:
+                # The timer fired as the body completed: absorb the
+                # pending cancellation (it would otherwise surface at the
+                # caller's next await) and report the elapsed deadline.
+                try:
+                    await asyncio.sleep(0)
+                except asyncio.CancelledError:
+                    pass
+                raise TimeoutError from None
+        finally:
+            handle.cancel()

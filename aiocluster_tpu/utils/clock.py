@@ -7,7 +7,11 @@ clock-injection seam described in SURVEY.md §4).
 
 from __future__ import annotations
 
-from datetime import UTC, datetime
+from datetime import datetime, timezone
+
+# ``datetime.UTC`` only exists on Python 3.11+; this alias keeps the whole
+# package (and its tests) importable on 3.10, where it equals timezone.utc.
+UTC = timezone.utc
 
 
 def utc_now() -> datetime:

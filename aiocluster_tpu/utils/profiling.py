@@ -1,55 +1,10 @@
-"""Profiling hooks for the sim backend.
-
-The reference has no tracing/profiling at all (SURVEY.md §5); this module
-adds the two observability seams the tensor backend makes natural: an XLA
-profiler trace (view in TensorBoard / xprof) and a tiny wall-clock section
-timer for host-side phases.
+"""Compatibility shim: profiling moved to ``aiocluster_tpu.obs.profiling``
+when the unified telemetry layer landed. Import from ``obs`` directly in
+new code; this module keeps old import paths working.
 """
 
 from __future__ import annotations
 
-import time
-from contextlib import contextmanager
-from dataclasses import dataclass, field
+from ..obs.profiling import SectionTimer, device_trace
 
-
-@contextmanager
-def device_trace(logdir: str):
-    """Capture a jax.profiler trace (HLO timelines, per-op device time)
-    for everything run inside the block. Works on TPU and CPU."""
-    import jax
-
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-@dataclass
-class SectionTimer:
-    """Accumulates wall-clock per named section; ``summary()`` gives
-    {name: total_seconds}. The host-side companion to device_trace."""
-
-    totals: dict[str, float] = field(default_factory=dict)
-    counts: dict[str, int] = field(default_factory=dict)
-
-    @contextmanager
-    def section(self, name: str):
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            elapsed = time.perf_counter() - start
-            self.totals[name] = self.totals.get(name, 0.0) + elapsed
-            self.counts[name] = self.counts.get(name, 0) + 1
-
-    def summary(self) -> dict[str, dict[str, float]]:
-        return {
-            name: {
-                "seconds": round(total, 6),
-                "calls": self.counts[name],
-                "mean_seconds": round(total / self.counts[name], 6),
-            }
-            for name, total in self.totals.items()
-        }
+__all__ = ("SectionTimer", "device_trace")

@@ -49,7 +49,9 @@ import time
 def measure_python_handshake_seconds(n_nodes: int) -> float:
     """Mean wall-clock of one full in-memory 3-way handshake between two
     nodes of an ``n_nodes``-sized cluster view (object model, no sockets)."""
-    from datetime import UTC, datetime
+    from datetime import datetime
+
+    from aiocluster_tpu.utils.clock import UTC
 
     from aiocluster_tpu.core import (
         ClusterState,
@@ -229,9 +231,19 @@ def resolve_platform(requested: str, log) -> None:
     watcher = _tunnel_watcher_verdict(log)
     if watcher == "down":
         log("tunnel watcher says down (fresh); single short probe only")
-        if _probe_accelerator(log, timeout_s=PROBE_TIMEOUT_KNOWN_DOWN_S) == "ok":
+        verdict = _probe_accelerator(log, timeout_s=PROBE_TIMEOUT_KNOWN_DOWN_S)
+        if verdict == "ok":
             return
         if requested == "tpu":
+            # A 'cpu' verdict is a deterministic resolution (plugin
+            # absent), not a tunnel outage — report it as the full
+            # ladder would, so the diagnostic says what actually
+            # happened instead of implying a flaky tunnel.
+            if verdict == "cpu":
+                raise RuntimeError(
+                    "accelerator backend unavailable: probe resolved to "
+                    "CPU, not an accelerator (watcher: down; 1 probe)"
+                )
             raise RuntimeError(
                 "accelerator backend unavailable (watcher: down; 1 probe)"
             )
@@ -455,19 +467,31 @@ def load_staleness_record(log) -> dict | None:
     """Round-5 dynamic-workload summary: prefer the battery's on-chip
     phase output; fall back to the CPU record (honestly labelled)."""
     try:
-        # On-chip battery output first.
-        for path in sorted(
-            glob.glob(os.path.join(RECORDS_DIR, "*measurements*.json")),
-            key=os.path.getmtime, reverse=True,
-        ):
+        # On-chip battery output first. Candidates order by the record's
+        # own ISO-8601 ``ts`` — checkout/clone rewrites mtimes, so a
+        # fresh clone would otherwise pick an arbitrary winner — exactly
+        # as _pairs_proven_on_chip orders canary evidence; a ts-less
+        # record competes via its mtime rendered on the same ISO scale,
+        # with sub-second mtime breaking same-second ties.
+        candidates = []
+        for path in glob.glob(os.path.join(RECORDS_DIR, "*measurements*.json")):
             try:
                 with open(path) as f:
                     rec = json.load(f)
             except Exception:
                 continue
             phase = rec.get("staleness")
-            if isinstance(phase, dict) and "error" not in phase:
-                return {"source": "battery (on-chip)", **phase}
+            if not (isinstance(phase, dict) and "error" not in phase):
+                continue
+            mtime = os.path.getmtime(path)
+            iso = str(rec.get("ts") or "") or time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)
+            )
+            candidates.append(((iso, mtime), rec.get("head"), phase))
+        if candidates:
+            _, head, phase = max(candidates, key=lambda c: c[0])
+            source = "battery (on-chip)" + (f" @ {head}" if head else "")
+            return {"source": source, **phase}
         with open(os.path.join(RECORDS_DIR, "r5_staleness_cpu.json")) as f:
             rec = json.load(f)
         return {
@@ -746,10 +770,47 @@ def sim_rounds_per_sec(
             f"-> {rounds / elapsed:.1f} rounds/s (tick={end_tick})"
         )
 
+    # Telemetry-overhead arm (obs/): the same config with the stride-64
+    # metrics sampler attached — the BENCH record carries the measured
+    # cost of leaving metrics on, and the registry snapshot itself.
+    extra: dict = {}
+    try:
+        from aiocluster_tpu.obs import MetricsRegistry
+
+        obs_registry = MetricsRegistry()
+        sim_m = Simulator(
+            cfg, seed=0, chunk=sim.chunk,
+            metrics=obs_registry, metrics_stride=64,
+        )
+        sim_m.run(sim_m.chunk)
+        int(np.asarray(sim_m.state.tick))
+        metrics_rps = 0.0
+        for _ in range(2):
+            start = time.perf_counter()
+            sim_m.run(rounds)
+            int(np.asarray(sim_m.state.tick))
+            metrics_rps = max(
+                metrics_rps, rounds / (time.perf_counter() - start)
+            )
+        sim_m.flush_metrics()
+        extra["metrics_overhead"] = {
+            "stride": 64,
+            "rounds_per_sec_with_metrics": round(metrics_rps, 2),
+            "fraction_of_metrics_off": (
+                round(metrics_rps / rps, 4) if rps else None
+            ),
+        }
+        extra["metrics_snapshot"] = obs_registry.snapshot()
+        del sim_m
+        log(f"metrics-on rate (stride 64): {metrics_rps:.1f} rounds/s "
+            f"({metrics_rps / rps:.1%} of metrics-off)" if rps else
+            "metrics-on rate measured")
+    except Exception as exc:
+        log(f"metrics overhead arm failed: {exc!r}")
+
     # The XLA-path rate for the same config: records the fused Pallas
     # kernel's measured speedup (VERDICT r1 item 3) without trusting the
     # default gate to have engaged.
-    extra: dict = {}
     from aiocluster_tpu.ops.gossip import pallas_fd_engaged, pallas_path_engaged
 
     # The exact gates sim_step used: only claim fused-path numbers when
@@ -819,15 +880,42 @@ def sim_rounds_per_sec(
                f" (unknown peak for {kind!r})"))
 
     # Convergence from a FRESH cluster (the timing runs above have long
-    # converged this one).
+    # converged this one) — with the obs sampler on, so the record also
+    # carries the per-chunk convergence-fraction / delta-bytes series.
     t0 = time.perf_counter()
-    fresh = Simulator(cfg, seed=1, chunk=sim.chunk)
+    try:
+        from aiocluster_tpu.obs import MetricsRegistry
+
+        conv_registry = MetricsRegistry()
+        fresh = Simulator(
+            cfg, seed=1, chunk=sim.chunk,
+            metrics=conv_registry, metrics_stride=sim.chunk,
+        )
+    except Exception as exc:
+        log(f"convergence probe metrics unavailable: {exc!r}")
+        fresh = Simulator(cfg, seed=1, chunk=sim.chunk)
     # Cap the horizon inside the int16 heartbeat/tick contract (< 2^15);
     # the caller lowers the cap further on a CPU fallback, where this
     # probe is the dominant cost (watchdog budget).
     converged_at = fresh.run_until_converged(
         max_rounds=min(4 * n_nodes, 30_000, max_converge_rounds or 30_000)
     )
+    try:
+        series = fresh.flush_metrics()
+        if series:
+            # Bounded embed: the full record must stay a sane size.
+            extra["convergence_series"] = [
+                {
+                    k: s.get(k)
+                    for k in ("tick", "mean_fraction", "min_fraction",
+                              "version_spread", "delta_key_versions",
+                              "delta_bytes_est")
+                    if k in s
+                }
+                for s in series[-64:]
+            ]
+    except Exception as exc:
+        log(f"convergence series flush failed: {exc!r}")
     log(
         f"rounds to full convergence @ {n_nodes} nodes: {converged_at} "
         f"({time.perf_counter() - t0:.1f}s wall)"

@@ -32,6 +32,7 @@ import os
 import sys
 import time
 
+
 _REF_PATH = "/root/reference"
 
 
@@ -160,10 +161,12 @@ async def _measure(
     for c in clusters:
         await c.start()
     t0 = time.perf_counter()
+    from aiocluster_tpu.utils.aio import timeout_after
+
     try:
         convergence_s = None
         try:
-            async with asyncio.timeout(converge_timeout):
+            async with timeout_after(converge_timeout):
                 while not converged():
                     await asyncio.sleep(gossip_interval / 2)
             convergence_s = time.perf_counter() - t0

@@ -29,6 +29,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from aiocluster_tpu.utils.aio import timeout_after  # noqa: E402  (needs the repo-root path above)
+
 
 def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
@@ -155,7 +157,7 @@ async def _config1(gossip_interval: float) -> dict:
     clusters = await _boot_loopback_clusters(gossip_interval)
     start = time.perf_counter()
     try:
-        async with asyncio.timeout(30.0):
+        async with timeout_after(30.0):
             while True:
                 done = all(
                     len(c.snapshot().node_states) == 3

@@ -29,7 +29,6 @@ record script (benchmarks/records/_r5_staleness_cpu.py).
 from __future__ import annotations
 
 import time
-from dataclasses import replace
 
 
 def _lag_stats_fn():

@@ -17,6 +17,8 @@ import socket
 
 import pytest
 
+from aiocluster_tpu.utils.aio import timeout_after
+
 # Override unconditionally: the driver environment presets JAX_PLATFORMS to
 # the real TPU (and the image's site hooks merge it back as "axon,cpu"), but
 # tests must run on the virtual 8-device CPU mesh. The config update below
@@ -50,7 +52,7 @@ async def wait_for(predicate, timeout: float = 2.0):
     """Poll-until-true with a hard deadline — the reference's test seam
     for loopback-cluster assertions (SURVEY.md §4). Shared by every
     socket-backend test (``from conftest import wait_for``)."""
-    async with asyncio.timeout(timeout):
+    async with timeout_after(timeout):
         while not predicate():
             await asyncio.sleep(0.02)
 

@@ -1,6 +1,8 @@
 """Identity/value/config entity behavior (reference entities.py parity)."""
 
-from datetime import UTC, datetime, timedelta
+from datetime import datetime, timedelta
+
+from aiocluster_tpu.utils.clock import UTC
 
 from aiocluster_tpu.core import (
     Config,

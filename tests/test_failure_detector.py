@@ -2,7 +2,9 @@
 time-travel death and two-stage GC (reference tests/test_failure_detector.py
 coverage, rebuilt)."""
 
-from datetime import UTC, datetime, timedelta
+from datetime import datetime, timedelta
+
+from aiocluster_tpu.utils.clock import UTC
 
 from aiocluster_tpu.core import NodeId
 from aiocluster_tpu.core.config import FailureDetectorConfig

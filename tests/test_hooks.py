@@ -8,6 +8,8 @@ import time
 from aiocluster_tpu import Cluster, Config, NodeId
 import pytest
 
+from aiocluster_tpu.utils.aio import timeout_after
+
 
 def config_for(port: int, **kwargs) -> Config:
     return Config(
@@ -120,7 +122,7 @@ async def test_join_and_key_hooks_fire_between_nodes(free_port_factory):
         c1.on_node_join(lambda n: _collect(joined, n.name))
         c1.on_key_change(lambda n, k, o, v: _collect(changed, (n.name, k)))
         async with Cluster(cfg2, initial_key_values={"color": "blue"}) as c2:
-            async with asyncio.timeout(2.0):
+            async with timeout_after(2.0):
                 while not joined or not any(name == "b" for name, _ in changed):
                     await asyncio.sleep(0.01)
     assert "b" in joined

@@ -14,6 +14,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples"))
 import http_api  # noqa: E402
 
+from aiocluster_tpu.utils.aio import timeout_after
+
 sys.path.pop(0)
 
 
@@ -56,7 +58,7 @@ async def test_http_api_two_nodes(free_port_factory):
         try:
             # Bind is signalled, not slept for: the first PUT below must
             # never race the listening socket on a loaded host.
-            async with asyncio.timeout(5.0):
+            async with timeout_after(5.0):
                 await up1.wait()
                 await up2.wait()
 
@@ -71,7 +73,7 @@ async def test_http_api_two_nodes(free_port_factory):
                 snap = json.loads(body)
                 return snap["nodes"].get(f"api-{g1}", {}).get("color") == "red"
 
-            async with asyncio.timeout(4.0):
+            async with timeout_after(4.0):
                 while not await replicated():
                     await asyncio.sleep(0.05)
 

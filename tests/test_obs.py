@@ -1,0 +1,399 @@
+"""Unified telemetry layer (aiocluster_tpu/obs): registry semantics,
+Prometheus exposition, JSONL trace round-trip, sim stride sampling, and
+runtime instrumentation through the integration harness."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from aiocluster_tpu.obs import (
+    MetricsHTTPServer,
+    MetricsRegistry,
+    TraceWriter,
+    read_trace,
+    render_prometheus,
+)
+
+# -- registry semantics -------------------------------------------------------
+
+
+def test_counter_labels_and_accumulation():
+    reg = MetricsRegistry()
+    c = reg.counter("pkts_total", "Packets", labels=("type", "dir"))
+    c.labels("syn", "in").inc()
+    c.labels("syn", "in").inc(2)
+    c.labels("ack", "out").inc(5)
+    snap = reg.snapshot()
+    assert snap["pkts_total{type=syn,dir=in}"] == 3
+    assert snap["pkts_total{type=ack,dir=out}"] == 5
+
+
+def test_family_creation_is_idempotent():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "X")
+    b = reg.counter("x_total", "different help, same family")
+    assert a is b
+
+
+def test_kind_and_label_conflicts_raise():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "X")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "X")
+    reg.gauge("g", "G", labels=("a",))
+    with pytest.raises(ValueError):
+        reg.gauge("g", "G", labels=("b",))
+
+
+def test_label_arity_enforced():
+    reg = MetricsRegistry()
+    c = reg.counter("y_total", "Y", labels=("one",))
+    with pytest.raises(ValueError):
+        c.labels("a", "b")
+
+
+def test_counter_rejects_negative():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("z_total", "Z").inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", "D")
+    g.set(10)
+    g.inc(5)
+    g.dec(3)
+    assert reg.snapshot()["depth"] == 12
+
+
+def test_histogram_buckets_are_cumulative():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "L", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    child = h.labels()
+    assert child.buckets() == [(0.1, 1), (1.0, 3), (10.0, 4), (float("inf"), 5)]
+    assert child.count == 5
+    assert child.sum == pytest.approx(56.05)
+
+
+def test_invalid_metric_names_rejected():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "B")
+    with pytest.raises(ValueError):
+        reg.counter("1starts_with_digit", "B")
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "T", labels=("worker",))
+    h = reg.histogram("t_lat", "T", buckets=(0.5,))
+    n_threads, n_incs = 8, 500
+
+    def work(i: int) -> None:
+        for _ in range(n_incs):
+            c.labels(str(i % 2)).inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["t_total{worker=0}"] + snap["t_total{worker=1}"] == (
+        n_threads * n_incs
+    )
+    assert snap["t_lat"]["count"] == n_threads * n_incs
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("gossip_total", "Gossip rounds", labels=("kind",))
+    c.labels("live").inc(7)
+    reg.gauge("alive", "Alive peers").set(3)
+    h = reg.histogram("round_s", "Round seconds", buckets=(0.5, 2.0))
+    h.observe(0.1)
+    h.observe(1.0)
+    assert render_prometheus(reg) == (
+        "# HELP alive Alive peers\n"
+        "# TYPE alive gauge\n"
+        "alive 3\n"
+        "# HELP gossip_total Gossip rounds\n"
+        "# TYPE gossip_total counter\n"
+        'gossip_total{kind="live"} 7\n'
+        "# HELP round_s Round seconds\n"
+        "# TYPE round_s histogram\n"
+        'round_s_bucket{le="0.5"} 1\n'
+        'round_s_bucket{le="2"} 2\n'
+        'round_s_bucket{le="+Inf"} 2\n'
+        "round_s_sum 1.1\n"
+        "round_s_count 2\n"
+    )
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("esc_total", "E", labels=("v",)).labels('a"b\\c\nd').inc()
+    text = render_prometheus(reg)
+    assert 'esc_total{v="a\\"b\\\\c\\nd"} 1' in text
+
+
+async def test_metrics_http_endpoint():
+    reg = MetricsRegistry()
+    reg.counter("served_total", "S").inc(4)
+    server = MetricsHTTPServer(reg)
+    port = await server.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read(-1)
+        writer.close()
+        text = raw.decode()
+        assert "200 OK" in text
+        assert "served_total 4" in text
+        # 404 for unknown paths
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /nope HTTP/1.0\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read(-1)
+        writer.close()
+        assert "404" in raw.decode()
+    finally:
+        await server.stop()
+
+
+# -- JSONL trace --------------------------------------------------------------
+
+
+def test_trace_round_trip(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    with TraceWriter(path) as t:
+        t.emit("round", tick=1, frac=0.25)
+        t.emit("transition", peer="n2", to="live")
+    records = read_trace(path)
+    assert [r["event"] for r in records] == ["round", "transition"]
+    assert records[0]["frac"] == 0.25
+    assert all("ts" in r for r in records)
+    # every line is independently valid JSON
+    for line in path.read_text().splitlines():
+        json.loads(line)
+
+
+def test_trace_emit_after_close_is_dropped(tmp_path):
+    t = TraceWriter(tmp_path / "t.jsonl")
+    t.emit("a")
+    t.close()
+    t.emit("b")  # must not raise
+    assert [r["event"] for r in read_trace(tmp_path / "t.jsonl")] == ["a"]
+
+
+def test_trace_reader_rejects_corrupt_lines(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    path.write_text('{"event":"ok","ts":1}\nnot json\n')
+    with pytest.raises(ValueError, match="invalid JSONL"):
+        read_trace(path)
+    path.write_text('{"no_event_field":1}\n')
+    with pytest.raises(ValueError, match="event"):
+        read_trace(path)
+
+
+# -- sim backend: stride sampling --------------------------------------------
+
+
+def _sim(stride: int, registry: MetricsRegistry, trace=None):
+    from aiocluster_tpu.sim import SimConfig, Simulator
+
+    cfg = SimConfig(
+        n_nodes=64, keys_per_node=4,
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    return Simulator(
+        cfg, seed=3, chunk=1,
+        metrics=registry, metrics_stride=stride, trace_writer=trace,
+    )
+
+
+def test_sim_metrics_stride_correctness():
+    """Samples at a coarse stride must be IDENTICAL to the stride-1
+    samples at the same ticks: sampling is a pure read of the (seeded,
+    deterministic) trajectory."""
+    s1 = _sim(1, MetricsRegistry())
+    s1.run(12)
+    series1 = {s["tick"]: s for s in s1.flush_metrics()}
+    s4 = _sim(4, MetricsRegistry())
+    s4.run(12)
+    series4 = s4.flush_metrics()
+    assert len(series4) >= 3
+    for sample in series4:
+        ref = series1[sample["tick"]]
+        for key in ("mean_fraction", "min_fraction", "converged_owners",
+                    "version_spread", "alive_count", "kv_known"):
+            assert sample[key] == ref[key], (sample["tick"], key)
+
+
+def test_sim_metrics_defer_host_sync():
+    """The hot loop buffers DEVICE scalars; conversion happens only at
+    flush_metrics() — the stride sampler must never np.asarray mid-run."""
+    import jax
+
+    sim = _sim(2, MetricsRegistry())
+    sim.run(6)
+    pending = sim._obs._pending
+    assert pending, "sampler never fired"
+    for _tick, _wall, raw in pending:
+        assert all(isinstance(v, jax.Array) for v in raw.values())
+    series = sim.flush_metrics()
+    assert not sim._obs._pending
+    assert all(isinstance(s["mean_fraction"], float) for s in series)
+
+
+def test_sim_metrics_gauges_and_trace(tmp_path):
+    reg = MetricsRegistry()
+    trace_path = tmp_path / "sim.jsonl"
+    with TraceWriter(trace_path) as tw:
+        sim = _sim(2, reg, trace=tw)
+        converged = sim.run_until_converged(max_rounds=200)
+        sim.flush_metrics()
+    assert converged is not None
+    snap = reg.snapshot()
+    assert snap["aiocluster_sim_tick{engine=xla}"] >= converged
+    assert snap["aiocluster_sim_mean_fraction{engine=xla}"] == 1.0
+    assert snap["aiocluster_sim_version_spread{engine=xla}"] == 0
+    assert snap["aiocluster_sim_rounds_total{engine=xla}"] > 0
+    events = read_trace(trace_path)
+    assert events and all(e["event"] == "sim_round" for e in events)
+    # the convergence-fraction series is monotone for a churn-free run
+    fracs = [e["mean_fraction"] for e in events]
+    assert fracs == sorted(fracs)
+    # delta series present from the second sample on
+    assert any("delta_key_versions" in e for e in events[1:])
+
+
+def test_hostsim_metrics_match_engine_label(tmp_path):
+    from aiocluster_tpu.sim import SimConfig, hostsim
+
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=8,
+        track_failure_detector=False, track_heartbeats=False,
+        version_dtype="int16",
+    )
+    if not (hostsim.available() and hostsim.supported(cfg)):
+        pytest.skip("native hostsim unavailable")
+    reg = MetricsRegistry()
+    host = hostsim.HostSimulator(cfg, seed=0, metrics=reg, metrics_stride=4)
+    converged = host.run_until_converged(max_rounds=200)
+    series = host.flush_metrics()
+    assert converged is not None and series
+    snap = reg.snapshot()
+    assert snap["aiocluster_sim_mean_fraction{engine=host-native}"] == 1.0
+    assert snap["aiocluster_sim_tick{engine=host-native}"] >= converged
+
+
+# -- runtime backend: instrumentation smoke -----------------------------------
+
+
+async def test_runtime_instrumentation_smoke(free_port_factory, tmp_path):
+    """Two-node loopback cluster reporting through one registry + trace:
+    the exposition must cover the full runtime metric catalogue with
+    nonzero gossip traffic."""
+    from conftest import wait_for
+
+    from aiocluster_tpu import Cluster, Config, NodeId
+
+    p1, p2 = free_port_factory(), free_port_factory()
+    reg = MetricsRegistry()
+    trace_path = tmp_path / "runtime.jsonl"
+
+    def cfg(name, port, seed_port):
+        return Config(
+            node_id=NodeId(name=name, gossip_advertise_addr=("127.0.0.1", port)),
+            gossip_interval=0.02,
+            seed_nodes=[("127.0.0.1", seed_port)],
+            cluster_id="obs-smoke",
+        )
+
+    with TraceWriter(trace_path) as tw:
+        c1 = Cluster(cfg("one", p1, p2), initial_key_values={"k1": "v1"},
+                     metrics=reg, trace=tw)
+        c2 = Cluster(cfg("two", p2, p1), initial_key_values={"k2": "v2"},
+                     metrics=reg)
+        async with c1, c2:
+            assert c1.metrics_registry() is reg
+            await wait_for(
+                lambda: any(n.name == "two" for n in c1.snapshot().live_nodes),
+                timeout=5.0,
+            )
+    snap = reg.snapshot()
+    assert snap["aiocluster_gossip_packets_total{type=syn,direction=out}"] > 0
+    assert snap["aiocluster_gossip_bytes_total{type=synack,direction=in}"] > 0
+    assert snap["aiocluster_handshake_steps_total{step=handle_ack}"] > 0
+    assert snap["aiocluster_delta_key_values_total{direction=applied}"] > 0
+    assert snap["aiocluster_peer_selection_total{kind=seed}"] > 0
+    assert snap["aiocluster_fd_transitions_total{to=live}"] >= 1
+    assert snap["aiocluster_live_nodes"] >= 1
+    assert snap["aiocluster_round_seconds"]["count"] > 0
+    assert snap["aiocluster_ticker_seconds{ticker=gossip}"]["count"] > 0
+    # One registry can serve BOTH backends: drive a small sim through the
+    # same registry and require the exposition to cover >= 10 distinct
+    # metric names spanning runtime and sim (the ISSUE acceptance bar).
+    sim = _sim(2, reg)
+    sim.run(4)
+    sim.flush_metrics()
+    text = render_prometheus(reg)
+    names = {
+        line.split()[2]
+        for line in text.splitlines()
+        if line.startswith("# TYPE")
+    }
+    runtime_names = {n for n in names if not n.startswith("aiocluster_sim_")}
+    sim_names = {n for n in names if n.startswith("aiocluster_sim_")}
+    assert len(names) >= 10, sorted(names)
+    assert len(runtime_names) >= 5 and len(sim_names) >= 5, sorted(names)
+    events = read_trace(trace_path)
+    kinds = {e["event"] for e in events}
+    assert "gossip_round" in kinds
+    assert "node_transition" in kinds
+
+
+async def test_hook_stats_fold_into_registry(free_port_factory):
+    """HookStats and the registry view of hook traffic must agree."""
+    from aiocluster_tpu.runtime.hooks import HookDispatcher
+
+    reg = MetricsRegistry()
+    dispatcher = HookDispatcher(4, metrics=reg)
+    dispatcher.start()
+    seen = []
+
+    async def cb(x):
+        seen.append(x)
+
+    for i in range(3):
+        dispatcher.emit((cb,), (i,))
+    await dispatcher.stop()
+    stats = dispatcher.stats()
+    snap = reg.snapshot()
+    assert seen == [0, 1, 2]
+    assert snap["aiocluster_hook_events_total{outcome=enqueued}"] == (
+        stats.enqueued
+    ) == 3
+    assert snap["aiocluster_hook_events_total{outcome=processed}"] == (
+        stats.processed
+    ) == 3
+    assert snap["aiocluster_hook_queue_size"] == stats.queue_size == 0
+
+
+def test_profiling_absorbed_into_obs():
+    """utils/profiling is now a shim over obs.profiling."""
+    from aiocluster_tpu import obs, utils
+
+    assert utils.SectionTimer is obs.SectionTimer
+    assert utils.device_trace is obs.device_trace

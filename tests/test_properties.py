@@ -7,7 +7,9 @@ hand-picked cases — seeded for reproducibility.
 
 import random as pyrandom
 import string
-from datetime import UTC, datetime, timedelta
+from datetime import datetime, timedelta
+
+from aiocluster_tpu.utils.clock import UTC
 
 import pytest
 

@@ -364,7 +364,9 @@ def test_sim_matches_object_model_convergence_shape():
     r = sim.run_until_converged(100)
     assert r is not None and r <= sim.chunk  # effectively immediate
 
-    from datetime import UTC, datetime
+    from datetime import datetime
+
+    from aiocluster_tpu.utils.clock import UTC
 
     from aiocluster_tpu.core import ClusterState, Digest, NodeId
 
@@ -759,7 +761,9 @@ def test_sim_matches_object_model_at_matched_mtu():
     round at the margin (the first object-model delta omits the zero
     from_version_excluded varint, so its overhead is a few bytes lighter
     than steady state)."""
-    from datetime import UTC, datetime
+    from datetime import datetime
+
+    from aiocluster_tpu.utils.clock import UTC
 
     from aiocluster_tpu.core import (
         ClusterState,

@@ -185,8 +185,9 @@ def test_sim_fd_matches_object_model_fd_tick_for_tick():
     mapping and must agree, tick for tick, on live belief, scheduled-for-
     deletion, and the forget/GC transition — through death, the grace
     stages, and revival."""
-    from datetime import UTC, datetime, timedelta
+    from datetime import datetime, timedelta
 
+    from aiocluster_tpu.utils.clock import UTC
     from aiocluster_tpu.core import (
         FailureDetector,
         FailureDetectorConfig,

@@ -2,7 +2,9 @@
 digest, delta application, and MTU-bounded packing (reference
 tests/test_state.py + tests/test_node_state.py coverage, rebuilt)."""
 
-from datetime import UTC, datetime, timedelta
+from datetime import datetime, timedelta
+
+from aiocluster_tpu.utils.clock import UTC
 
 from aiocluster_tpu.core import (
     ClusterState,

@@ -11,6 +11,8 @@ import pytest
 
 from aiocluster_tpu import Cluster, Config, NodeId
 
+from aiocluster_tpu.utils.aio import timeout_after
+
 pytestmark = pytest.mark.skipif(
     shutil.which("openssl") is None, reason="openssl not available"
 )
@@ -84,7 +86,7 @@ async def test_mtls_nodes_become_live(certs, free_port_factory):
     cb = Cluster(tls_config(certs, "node-b", "node-b", pb, pa),
                  initial_key_values={"who": "b"})
     async with ca, cb:
-        async with asyncio.timeout(3.0):
+        async with timeout_after(3.0):
             while not (
                 any(n.name == "node-b" for n in ca.snapshot().live_nodes)
                 and any(n.name == "node-a" for n in cb.snapshot().live_nodes)
