@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -19,8 +19,16 @@ test-all:
 lint:
 	$(PY) tools/lint.py $(LINT_PATHS)
 
-# What CI runs; a red suite or dirty lint cannot land through this gate.
-check: lint test-all
+# Domain-aware static analysis (docs/static-analysis.md): async-safety,
+# JAX purity, and the paper's owner-write invariant, plus the ACT00x
+# style family. Pre-existing findings are grandfathered in
+# tools/analyze/baseline.json; only NEW findings fail.
+analyze:
+	$(PY) -m tools.analyze $(LINT_PATHS)
+
+# What CI runs; a red suite, dirty lint, or new analysis finding cannot
+# land through this gate.
+check: lint analyze test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

@@ -56,18 +56,19 @@ async def _run_node(args: argparse.Namespace) -> int:
         ) as cluster:
             print(f"[{args.name}] listening on {args.listen[0]}:{args.listen[1]}",
                   file=sys.stderr, flush=True)
-            try:
-                while True:
-                    await asyncio.sleep(args.interval)
-                    snap = cluster.snapshot()
-                    live = sorted(n.name for n in snap.live_nodes)
-                    print(json.dumps({
-                        "node": args.name,
-                        "live": live,
-                        "nodes_known": len(snap.node_states),
-                    }), flush=True)
-            except asyncio.CancelledError:
-                pass
+            # No CancelledError handler here (ACT013 audit): Ctrl-C
+            # cancellation propagates — the async-with closes the
+            # cluster, the finally below closes telemetry, and main()
+            # turns the resulting KeyboardInterrupt into exit 0.
+            while True:
+                await asyncio.sleep(args.interval)
+                snap = cluster.snapshot()
+                live = sorted(n.name for n in snap.live_nodes)
+                print(json.dumps({
+                    "node": args.name,
+                    "live": live,
+                    "nodes_known": len(snap.node_states),
+                }), flush=True)
     finally:
         if metrics_server is not None:
             await metrics_server.stop()

@@ -73,6 +73,12 @@ class ClusterState:
     def nodes(self) -> Sequence[NodeId]:
         return tuple(self._node_states)
 
+    def node_states(self) -> dict[NodeId, NodeState]:
+        """Shallow copy of the per-node state map — the snapshot surface
+        (Cluster.snapshot), so readers never hold the live dict while
+        gossip mutates it."""
+        return dict(self._node_states)
+
     def seed_addrs(self) -> Sequence[Address]:
         return tuple(self._seed_addrs)
 

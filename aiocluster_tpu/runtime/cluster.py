@@ -209,8 +209,16 @@ class Cluster:
             # whose result nobody needs anymore — cancel and move on; the
             # orphaned compile thread finishes harmlessly.
             self._codec_warmup.cancel()
-            with suppress(Exception, asyncio.CancelledError):
+            try:
                 await self._codec_warmup
+            except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued
+                # Our own cancel() surfacing. If close() itself was
+                # cancelled in the same window, that cancellation
+                # re-raises at the next await point (3.10 has no
+                # Task.uncancel to tell the two apart).
+                pass
+            except Exception:
+                pass  # a failed warmup build is harmless: codec no-ops to pure Python
             self._codec_warmup = None
         if self._server is not None:
             self._server.close()
@@ -240,7 +248,7 @@ class Cluster:
         return ClusterSnapshot(
             cluster_id=self._config.cluster_id,
             self_node_id=self.self_node_id,
-            node_states=dict(self._cluster_state._node_states),
+            node_states=self._cluster_state.node_states(),
             live_nodes=self._failure_detector.live_nodes(),
             dead_nodes=self._failure_detector.dead_nodes(),
         )

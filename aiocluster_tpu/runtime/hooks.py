@@ -148,6 +148,9 @@ class HookDispatcher:
                     worker.cancel()
             else:
                 worker.cancel()
-        with suppress(asyncio.CancelledError):
+        # Terminal join of a worker we cancelled (or sent the sentinel)
+        # above; stop() owns the task's whole lifecycle, so there is no
+        # outer awaiter left to starve of the cancellation.
+        with suppress(asyncio.CancelledError):  # noqa: ACT013 -- joining our own cancelled worker
             await worker
         self._worker = None

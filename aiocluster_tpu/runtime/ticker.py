@@ -102,6 +102,8 @@ class Ticker:
         self._task.cancel()
         try:
             await self._task
-        except asyncio.CancelledError:
+        except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued
+            # Terminal join of the tick task we just cancelled; stop()
+            # owns its lifecycle and retains no other awaiter.
             pass
         self._task = None

@@ -44,7 +44,7 @@ def save_state(
     arrays = {}
     dtypes: dict[str, str] = {}
     for name in _FIELDS:
-        arr = np.asarray(getattr(state, name))
+        arr = np.asarray(getattr(state, name))  # noqa: ACT021 -- checkpointing IS the device->host gather
         dtypes[name] = str(arr.dtype)
         if arr.dtype.kind not in "biufc":  # e.g. bfloat16 -> void in npz
             arr = arr.view(np.uint8).reshape(arr.shape + (arr.dtype.itemsize,))

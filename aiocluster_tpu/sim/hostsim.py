@@ -322,7 +322,7 @@ class HostSimulator:
         for c in range(self.cfg.fanout):
             ck = random.fold_in(peer_key, c)
             _gm, _c8, p = _grouped_matching(ck, n)
-            p = np.asarray(p, dtype=np.int32)
+            p = np.asarray(p, dtype=np.int32)  # noqa: ACT021 -- deliberate: the host-native path pulls each draw to host memory
             a = idx[idx < p]  # self-pairs (p[i] == i) are no-op exchanges
             out.append((a, p[a]))
         return out

@@ -45,7 +45,10 @@ else:
                 # caller's next await) and report the elapsed deadline.
                 try:
                     await asyncio.sleep(0)
-                except asyncio.CancelledError:
+                except asyncio.CancelledError:  # noqa: ACT013 -- deadline cancel converts to TimeoutError
+                    # This cancellation is our own timer's (timed_out is
+                    # True); converting it to TimeoutError below IS the
+                    # asyncio.timeout contract being shimmed.
                     pass
                 raise TimeoutError from None
         finally:

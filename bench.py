@@ -358,6 +358,31 @@ UNCERTIFIED_BEST_ONCHIP = {
 }
 
 
+def analyzer_health(log) -> dict | None:
+    """Run the repo's static analyzer in-process (tools/analyze: pure
+    AST, ~1-2 s, no device) so every BENCH record carries
+    correctness-tooling health next to the perf numbers — a perf
+    trajectory over a dirty tree is not a trajectory worth chasing.
+    ``analyze_clean`` is the `make check` gate verdict (no NEW findings
+    under the committed baseline); ``analyze_findings`` counts new +
+    grandfathered (suppressed judged-intentional sites excluded)."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from tools.analyze import run_default
+
+            report = run_default()
+        finally:
+            sys.path.pop(0)
+        return {
+            "analyze_clean": report.new == 0,
+            "analyze_findings": report.new + report.count("baselined"),
+        }
+    except Exception as exc:
+        log(f"analyzer health check failed: {exc!r}")
+        return None
+
+
 def load_last_onchip_record(log) -> dict | None:
     """The last committed on-chip bench record, embedded VERBATIM in
     CPU-fallback artifacts so a down tunnel can never reduce the
@@ -567,6 +592,8 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
     lo_rec = lo.get("record") or {}
     extra = {
         "platform": ex.get("platform"),
+        "analyze_clean": ex.get("analyze_clean"),
+        "analyze_findings": ex.get("analyze_findings"),
         "rounds_to_convergence": ex.get("rounds_to_convergence"),
         "pallas_variant": ex.get("pallas_variant_engaged"),
         "pallas_speedup": ex.get("pallas_speedup"),
@@ -1139,6 +1166,10 @@ def main() -> None:
             "vs_baseline": round(rps / baseline_rps, 1),
             "extra": {
                 "platform": platform,
+                # Correctness-tooling health rides every record (smoke
+                # included): the perf number and the analyzer verdict
+                # describe the same tree.
+                **(analyzer_health(log) or {}),
                 **({"tpu_note": tpu_note} if tpu_note else {}),
                 **({"last_onchip": last_onchip} if last_onchip else {}),
                 "rounds_to_convergence": converged_at,

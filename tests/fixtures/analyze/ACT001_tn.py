@@ -1,0 +1,3 @@
+"""TN: parses fine."""
+
+VALUE = 1

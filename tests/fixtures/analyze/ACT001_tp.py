@@ -1,0 +1,5 @@
+"""TP: does not parse."""
+
+
+def broken(:
+    return 1

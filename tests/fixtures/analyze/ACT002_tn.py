@@ -1,0 +1,8 @@
+"""TN: import used only via a string annotation still counts."""
+
+import os
+from pathlib import Path
+
+
+def loader(p: "Path") -> str:
+    return os.fspath(p)

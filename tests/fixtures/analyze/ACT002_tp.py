@@ -1,0 +1,10 @@
+"""TP: unused import mentioned only in prose.
+
+The old tools/lint.py credited any word in any string constant as a
+"use", so mentioning os here hid the unused import below. ACT002 only
+credits annotation contexts.
+"""
+
+import os
+
+VALUE = 1

@@ -1,0 +1,6 @@
+"""TN: `import a.b` and `import a` bind the same root, not dupes."""
+
+import collections
+import collections.abc
+
+PAIR = (collections.OrderedDict, collections.abc.Mapping)

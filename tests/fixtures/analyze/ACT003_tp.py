@@ -1,0 +1,6 @@
+"""TP: the same binding imported twice."""
+
+import json
+import json
+
+DUMP = json.dumps

@@ -1,0 +1,5 @@
+"""TN: every __all__ entry is defined."""
+
+__all__ = ["present"]
+
+present = 1

@@ -1,0 +1,3 @@
+"""TP: __all__ names a binding that does not exist."""
+
+__all__ = ["missing"]

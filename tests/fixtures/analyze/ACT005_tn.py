@@ -1,0 +1,5 @@
+"""TN: spaces only."""
+
+
+def f():
+    return 1

@@ -1,0 +1,5 @@
+"""TP: tab in indentation."""
+
+
+def f():
+	return 1

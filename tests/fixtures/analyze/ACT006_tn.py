@@ -1,0 +1,3 @@
+"""TN: no trailing whitespace."""
+
+VALUE = 1

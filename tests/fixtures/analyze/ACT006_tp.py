@@ -1,0 +1,3 @@
+"""TP: trailing whitespace."""
+
+VALUE = 1 

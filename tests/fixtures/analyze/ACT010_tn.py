@@ -1,0 +1,12 @@
+"""TN: awaited asyncio.sleep; blocking call only in sync code."""
+
+import asyncio
+import time
+
+
+async def handler():
+    await asyncio.sleep(0.1)
+
+
+def sync_helper():
+    time.sleep(0.1)

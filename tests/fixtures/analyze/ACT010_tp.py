@@ -1,0 +1,7 @@
+"""TP: time.sleep blocks the event loop inside async def."""
+
+import time
+
+
+async def handler():
+    time.sleep(0.1)

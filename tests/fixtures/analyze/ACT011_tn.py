@@ -1,0 +1,9 @@
+"""TN: the coroutine is awaited."""
+
+
+async def job():
+    return 1
+
+
+async def run():
+    await job()

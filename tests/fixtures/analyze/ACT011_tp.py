@@ -1,0 +1,9 @@
+"""TP: bare call to a module-level coroutine function."""
+
+
+async def job():
+    return 1
+
+
+def schedule():
+    job()

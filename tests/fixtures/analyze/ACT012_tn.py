@@ -1,0 +1,12 @@
+"""TN: the task reference is retained and awaited."""
+
+import asyncio
+
+
+async def work():
+    return 1
+
+
+async def boot():
+    task = asyncio.create_task(work())
+    await task

@@ -1,0 +1,11 @@
+"""TP: create_task result dropped (weak-ref hazard)."""
+
+import asyncio
+
+
+async def work():
+    return 1
+
+
+async def boot():
+    asyncio.create_task(work())

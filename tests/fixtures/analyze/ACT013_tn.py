@@ -1,0 +1,11 @@
+"""TN: cancellation is re-raised after cleanup."""
+
+import asyncio
+
+
+async def run(resource):
+    try:
+        await asyncio.sleep(1)
+    except asyncio.CancelledError:
+        resource.close()
+        raise

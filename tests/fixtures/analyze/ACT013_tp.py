@@ -1,0 +1,10 @@
+"""TP: CancelledError caught and dropped."""
+
+import asyncio
+
+
+async def run():
+    try:
+        await asyncio.sleep(1)
+    except asyncio.CancelledError:
+        pass

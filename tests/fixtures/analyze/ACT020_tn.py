@@ -1,0 +1,14 @@
+"""TN: pure jit body; the clock lives in host code."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x * 2
+
+
+def host_timer():
+    return time.time()

@@ -1,0 +1,10 @@
+"""TP: host clock read inside a jitted function."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x * time.time()

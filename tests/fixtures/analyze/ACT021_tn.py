@@ -1,0 +1,8 @@
+# analyze-domain: sim
+"""TN: one sync after the loop, none inside it."""
+
+
+def run(sim, rounds):
+    for _ in range(rounds):
+        sim.step()
+    return float(sim.state.tick)

@@ -1,0 +1,10 @@
+# analyze-domain: sim
+"""TP: per-iteration float() sync in a host loop (sim domain)."""
+
+
+def run(sim, rounds):
+    out = []
+    for _ in range(rounds):
+        sim.step()
+        out.append(float(sim.state.tick))
+    return out

@@ -1,0 +1,5 @@
+"""TP: jnp computation at module import time."""
+
+import jax.numpy as jnp
+
+LOOKUP = jnp.arange(16)

@@ -1,0 +1,9 @@
+"""TN: a class maintaining its own fields is the owner path."""
+
+
+class NodeState:
+    def __init__(self):
+        self.max_version = 0
+
+    def bump(self):
+        self.max_version += 1

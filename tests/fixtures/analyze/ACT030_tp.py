@@ -1,0 +1,5 @@
+"""TP: direct write to a peer NodeState's version counter."""
+
+
+def corrupt(peer_state):
+    peer_state.max_version = 99

@@ -1,0 +1,5 @@
+"""TN: replicas converge through the sanctioned delta path."""
+
+
+def reconcile(cluster_state, peer, delta):
+    cluster_state.node_state_or_default(peer).apply_delta(delta)

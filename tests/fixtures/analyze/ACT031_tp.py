@@ -1,0 +1,5 @@
+"""TP: owner-only mutator called on a peer lookup."""
+
+
+def poke(cluster_state, peer, key, value):
+    cluster_state.node_state_or_default(peer).set(key, value)

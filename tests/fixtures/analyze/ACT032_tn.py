@@ -1,0 +1,5 @@
+"""TN: the public snapshot surface."""
+
+
+def snapshot(cluster_state):
+    return cluster_state.node_states()

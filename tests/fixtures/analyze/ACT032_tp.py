@@ -1,0 +1,5 @@
+"""TP: reaching into ClusterState's private map."""
+
+
+def snoop(cluster_state):
+    return cluster_state._node_states

@@ -1,0 +1,463 @@
+"""tools/analyze — the domain-aware static analysis suite.
+
+Covers, per docs/static-analysis.md:
+- every rule code has a firing true-positive and a quiet true-negative
+  fixture (tests/fixtures/analyze/);
+- inline ``# noqa: ACT0xx`` suppression (exact code, blanket, wrong
+  code, justification trailer);
+- baseline matching (grandfathered findings pass, NEW findings fail,
+  stale entries are counted);
+- the JSON output schema (``aiocluster-analyze/1``);
+- the CI gate: the CLI exits non-zero on a seeded violation in a
+  fixture tree, and the repo itself is clean under the committed
+  baseline (exactly what ``make check`` enforces);
+- the ACT002 migration fix: docstring mentions no longer credit an
+  import as used, annotation strings still do.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+CORPUS = REPO / "tests" / "fixtures" / "analyze"
+
+sys.path.insert(0, str(REPO))
+
+from tools.analyze import RULES, analyze_file, analyze_paths, run_default  # noqa: E402
+from tools.analyze import baseline as bl  # noqa: E402
+from tools.analyze.core import load_context  # noqa: E402
+
+CODES = sorted(RULES)
+
+
+def findings(path: Path, select=None):
+    return analyze_file(load_context(path), select)
+
+
+# -- fixtures corpus: one TP + one TN per rule code ---------------------------
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_true_positive_fixture_fires(code):
+    f = CORPUS / f"{code}_tp.py"
+    assert f.is_file(), f"missing true-positive fixture for {code}"
+    new = {x.code for x in findings(f) if x.status == "new"}
+    assert code in new, f"{f.name} should trigger {code}, got {sorted(new)}"
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_true_negative_fixture_is_quiet(code):
+    f = CORPUS / f"{code}_tn.py"
+    assert f.is_file(), f"missing true-negative fixture for {code}"
+    got = {x.code for x in findings(f)}
+    assert code not in got, f"{f.name} must not trigger {code}"
+
+
+def test_registry_spans_all_four_families():
+    prefixes = {c[:5] for c in CODES}
+    assert {"ACT00", "ACT01", "ACT02", "ACT03"} <= prefixes
+    assert len(CODES) >= 10
+
+
+def test_corpus_excluded_from_directory_walks():
+    report = analyze_paths([REPO / "tests"])
+    assert not any("fixtures/analyze" in f.path for f in report.findings)
+
+
+# -- inline suppression -------------------------------------------------------
+
+
+def _write(tmp_path: Path, src: str, name: str = "mod.py") -> Path:
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src), encoding="utf-8")
+    return p
+
+
+BLOCKING = """\
+    import time
+
+    async def handler():
+        time.sleep(0.1){noqa}
+"""
+
+
+def test_noqa_exact_code_suppresses(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa="  # noqa: ACT010"))
+    (f,) = [x for x in findings(p) if x.code == "ACT010"]
+    assert f.status == "suppressed"
+
+
+def test_noqa_with_justification_trailer(tmp_path):
+    p = _write(
+        tmp_path, BLOCKING.format(noqa="  # noqa: ACT010 -- cold path, bounded")
+    )
+    (f,) = [x for x in findings(p) if x.code == "ACT010"]
+    assert f.status == "suppressed"
+
+
+def test_noqa_blanket_suppresses(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa="  # noqa"))
+    (f,) = [x for x in findings(p) if x.code == "ACT010"]
+    assert f.status == "suppressed"
+
+
+def test_noqa_wrong_code_does_not_suppress(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa="  # noqa: ACT013"))
+    (f,) = [x for x in findings(p) if x.code == "ACT010"]
+    assert f.status == "new"
+
+
+def test_noqa_on_other_line_does_not_suppress(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import time  # noqa: ACT010
+
+        async def handler():
+            time.sleep(0.1)
+        """,
+    )
+    (f,) = [x for x in findings(p) if x.code == "ACT010"]
+    assert f.status == "new"
+
+
+# -- baseline matching --------------------------------------------------------
+
+
+def test_baseline_grandfathers_old_flags_new(tmp_path):
+    old = _write(tmp_path, BLOCKING.format(noqa=""), "old.py")
+    report = analyze_paths([old])
+    base = tmp_path / "baseline.json"
+    assert bl.write(base, report.findings) == 1
+
+    # Same tree re-analyzed under the baseline: everything grandfathered.
+    report = analyze_paths([old])
+    stale = bl.apply(report.findings, bl.load(base))
+    assert stale == 0 and report.new == 0
+    assert report.count("baselined") == 1
+
+    # A NEW violation elsewhere is not absorbed.
+    new = _write(
+        tmp_path,
+        """\
+        import asyncio
+
+        async def work():
+            return 1
+
+        async def boot():
+            asyncio.create_task(work())
+        """,
+        "new.py",
+    )
+    report = analyze_paths([old, new])
+    bl.apply(report.findings, bl.load(base))
+    fresh = [f for f in report.findings if f.status == "new"]
+    assert [f.code for f in fresh] == ["ACT012"]
+    assert fresh[0].path.endswith("new.py")
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa=""))
+    base = tmp_path / "baseline.json"
+    bl.write(base, analyze_paths([p]).findings)
+    # Shift the violation down: the fingerprint (path, code, message)
+    # still matches — unrelated edits above must not churn the baseline.
+    p.write_text("# a new leading comment\n" + p.read_text(), encoding="utf-8")
+    report = analyze_paths([p])
+    assert bl.apply(report.findings, bl.load(base)) == 0
+    assert report.new == 0
+
+
+def test_fixed_finding_leaves_stale_baseline_entry(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa=""))
+    base = tmp_path / "baseline.json"
+    bl.write(base, analyze_paths([p]).findings)
+    _write(tmp_path, "VALUE = 1\n")  # violation fixed
+    report = analyze_paths([p])
+    assert bl.apply(report.findings, bl.load(base)) == 1  # stale entry
+    assert report.new == 0
+
+
+# -- CLI: JSON schema and the CI gate -----------------------------------------
+
+
+def run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analyze", *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_json_output_schema(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa=""))
+    proc = run_cli("--format", "json", "--no-baseline", str(p))
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert data["schema"] == "aiocluster-analyze/1"
+    assert data["files"] == 1
+    assert {r["code"] for r in data["rules"]} == set(CODES)
+    assert all({"code", "name", "summary"} <= set(r) for r in data["rules"])
+    assert data["counts"]["new"] >= 1
+    assert data["counts"]["total"] == len(data["findings"])
+    for f in data["findings"]:
+        assert {"path", "line", "col", "code", "message", "status"} <= set(f)
+        assert f["status"] in ("new", "baselined", "suppressed")
+    assert data["by_code"]["ACT010"]["new"] == 1
+
+
+def test_gate_fails_on_seeded_violation_then_passes_fixed(tmp_path):
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    seeded = tree / "svc.py"
+    seeded.write_text(
+        textwrap.dedent(
+            """\
+            import time
+
+            async def serve():
+                time.sleep(1.0)
+            """
+        ),
+        encoding="utf-8",
+    )
+    proc = run_cli(str(tree))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "ACT010" in proc.stdout
+
+    seeded.write_text(
+        "import asyncio\n\n\nasync def serve():\n    await asyncio.sleep(1.0)\n",
+        encoding="utf-8",
+    )
+    proc = run_cli(str(tree))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_repo_is_clean_under_committed_baseline():
+    """What `make check` gates on: default paths + committed baseline."""
+    report = run_default()
+    fresh = [f.render() for f in report.findings if f.status == "new"]
+    assert not fresh, "new analyzer findings:\n" + "\n".join(fresh)
+    assert report.stale_baseline == 0, (
+        "baseline has stale entries: regenerate with --write-baseline"
+    )
+
+
+def test_lint_shim_still_gates_style(tmp_path):
+    dirty = _write(tmp_path, "import os\n\nVALUE = 1\n", "dirty.py")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), str(dirty)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "ACT002" in proc.stdout
+    clean = _write(tmp_path, "VALUE = 1\n", "clean.py")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), str(clean)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+
+
+# -- review regressions -------------------------------------------------------
+
+
+def test_act030_catches_tuple_unpacking_writes(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        def swap(a, b):
+            a.max_version, b.max_version = b.max_version, a.max_version
+
+        def sneak(peer, rest):
+            peer.heartbeat, *rest = [1, 2, 3]
+        """,
+    )
+    hits = [f for f in findings(p) if f.code == "ACT030"]
+    assert len(hits) == 3  # two targets in the swap, one in the starred
+
+
+def test_act011_not_fooled_by_shadowing_local(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        async def notify():
+            return 1
+
+
+        def register(notify):
+            notify()
+
+
+        def local_rebind():
+            notify = print
+            notify()
+        """,
+    )
+    assert not any(f.code == "ACT011" for f in findings(p))
+
+
+def test_act011_still_fires_in_nested_branches(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        async def notify():
+            return 1
+
+
+        def run(flag):
+            if flag:
+                notify()
+        """,
+    )
+    assert any(f.code == "ACT011" for f in findings(p))
+
+
+def test_write_baseline_refuses_select(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa=""))
+    base = tmp_path / "baseline.json"
+    proc = run_cli(
+        "--select", "ACT01", "--write-baseline", "--baseline", str(base), str(p)
+    )
+    assert proc.returncode == 2
+    assert not base.exists()
+    assert "refusing" in proc.stderr
+
+
+def test_corrupt_baseline_is_a_clean_usage_error(tmp_path):
+    p = _write(tmp_path, "VALUE = 1\n")
+    base = tmp_path / "baseline.json"
+    base.write_text("{not json", encoding="utf-8")
+    proc = run_cli("--baseline", str(base), str(p))
+    assert proc.returncode == 2
+    assert "unreadable baseline" in proc.stderr
+    base.write_text('{"schema": "bogus/9", "findings": []}', encoding="utf-8")
+    proc = run_cli("--baseline", str(base), str(p))
+    assert proc.returncode == 2
+    assert "unreadable baseline" in proc.stderr
+
+
+def test_act022_ignores_lazy_defs_under_module_if(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        if True:
+            def lazy():
+                return jnp.zeros(3)
+
+        try:
+            compat = lambda: jnp.ones(2)
+        except Exception:
+            compat = None
+        """,
+    )
+    assert not any(f.code == "ACT022" for f in findings(p))
+
+
+def test_act013_flags_bare_and_base_exception_in_async(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import asyncio
+
+
+        async def swallow_all():
+            try:
+                await asyncio.sleep(1)
+            except BaseException:
+                pass
+
+
+        async def swallow_bare():
+            try:
+                await asyncio.sleep(1)
+            except:
+                pass
+
+
+        def sync_guard():
+            try:
+                return 1
+            except BaseException:
+                return 0
+        """,
+    )
+    hits = [f for f in findings(p) if f.code == "ACT013"]
+    assert len(hits) == 2  # both async swallows; the sync guard is fine
+    assert all(f.line in (7, 14) for f in hits)
+
+
+def test_act013_base_exception_with_reraise_is_fine(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import asyncio
+
+
+        async def log_and_reraise(log):
+            try:
+                await asyncio.sleep(1)
+            except BaseException as exc:
+                log(exc)
+                raise
+        """,
+    )
+    assert not any(f.code == "ACT013" for f in findings(p))
+
+
+def test_act021_skips_loop_variable_conversions(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        # analyze-domain: sim
+        def parse(lines):
+            total = 0
+            for ln in lines:
+                total += int(ln)
+            return total
+        """,
+    )
+    assert not any(f.code == "ACT021" for f in findings(p))
+
+
+# -- the ACT002 migration fix (old string-scan false negative) ----------------
+
+
+def test_docstring_mention_no_longer_credits_import(tmp_path):
+    p = _write(
+        tmp_path,
+        '''\
+        """Helpers built on os primitives."""
+
+        import os
+
+        VALUE = 1
+        ''',
+    )
+    assert any(f.code == "ACT002" for f in findings(p))
+
+
+def test_annotation_string_still_credits_import(tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        from pathlib import Path
+
+
+        def size(p: "Path") -> int:
+            return 0
+        """,
+    )
+    assert not any(f.code == "ACT002" for f in findings(p))

@@ -1,0 +1,2 @@
+"""Repo tooling: ``tools.lint`` (style shim) and ``tools.analyze``
+(domain-aware static analysis — see docs/static-analysis.md)."""

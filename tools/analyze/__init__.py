@@ -1,0 +1,35 @@
+"""Dependency-free, AST-based static analysis for this repo.
+
+Four rule families over one shared parse per file (docs/static-analysis.md):
+
+- ACT00x  style/imports (the old tools/lint.py, now a shim over this)
+- ACT01x  async-safety for the runtime backend's event loop
+- ACT02x  JAX purity / tracer discipline for the sim backend
+- ACT03x  the paper's owner-write invariant around core/kvstate.py
+
+Inline suppression: ``# noqa: ACT012 -- justification``. Pre-existing
+findings are grandfathered in tools/analyze/baseline.json; only NEW
+findings fail the gate (`make analyze`, folded into `make check`).
+"""
+
+from .core import RULES, FileContext, Finding, Rule, rule
+from .engine import (
+    DEFAULT_PATHS,
+    Report,
+    analyze_file,
+    analyze_paths,
+    run_default,
+)
+
+__all__ = [
+    "RULES",
+    "FileContext",
+    "Finding",
+    "Rule",
+    "rule",
+    "DEFAULT_PATHS",
+    "Report",
+    "analyze_file",
+    "analyze_paths",
+    "run_default",
+]

@@ -1,0 +1,68 @@
+"""Baseline (grandfathering) support.
+
+A committed baseline file lists pre-existing findings by fingerprint
+(path, code, message — no line numbers, so unrelated edits don't churn
+it). Findings matching a baseline entry are reported as ``baselined``
+and do not fail the run; anything NEW does. The intended workflow:
+
+- ``python -m tools.analyze --write-baseline PATH...`` snapshots today's
+  findings; commit the file.
+- Fix a grandfathered finding -> its entry goes stale; the run reports
+  the stale count (informational) and ``--write-baseline`` prunes it.
+- Never baseline a finding you just introduced: baselines are for
+  adopting the tool on an existing codebase, suppressions (``# noqa:
+  ACT0xx -- why``) are for judged-intentional code.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+
+from .core import Finding
+
+SCHEMA = "aiocluster-analyze-baseline/1"
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def load(path: Path) -> Counter:
+    """Multiset of grandfathered fingerprints (an entry absorbs one
+    occurrence per ``count``)."""
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if data.get("schema") != SCHEMA:
+        raise ValueError(f"{path}: unknown baseline schema {data.get('schema')!r}")
+    counts: Counter = Counter()
+    for e in data["findings"]:
+        counts[(e["path"], e["code"], e["message"])] += int(e.get("count", 1))
+    return counts
+
+
+def apply(findings: list[Finding], baseline: Counter) -> int:
+    """Mark matching non-suppressed findings ``baselined`` (consuming
+    baseline budget); returns the number of stale (unconsumed) entries."""
+    budget = Counter(baseline)
+    for f in findings:
+        if f.status != "new":
+            continue
+        fp = f.fingerprint()
+        if budget[fp] > 0:
+            budget[fp] -= 1
+            f.status = "baselined"
+    return sum(n for n in budget.values() if n > 0)
+
+
+def write(path: Path, findings: list[Finding]) -> int:
+    """Snapshot every non-suppressed finding as the new baseline;
+    returns the entry count. Entries are sorted and count-folded so the
+    file diffs cleanly."""
+    counts: Counter = Counter(
+        f.fingerprint() for f in findings if f.status != "suppressed"
+    )
+    entries = [
+        {"path": p, "code": c, "message": m, **({"count": n} if n > 1 else {})}
+        for (p, c, m), n in sorted(counts.items())
+    ]
+    payload = {"schema": SCHEMA, "findings": entries}
+    path.write_text(json.dumps(payload, indent=1) + "\n", encoding="utf-8")
+    return sum(counts.values())

@@ -1,0 +1,172 @@
+"""Command-line front end: text/JSON output, baseline handling, exit codes.
+
+Exit codes: 0 = clean (every finding suppressed or baselined),
+1 = new findings, 2 = usage error. The JSON schema is stable
+(``aiocluster-analyze/1``) and covered by tests/test_analyze.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import baseline as bl
+from .core import RULES, Rule
+from .engine import Report, analyze_paths, selected_rules
+
+JSON_SCHEMA = "aiocluster-analyze/1"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="Domain-aware static analysis (ACT00x style, ACT01x "
+        "async-safety, ACT02x JAX purity, ACT03x owner-write invariant). "
+        "See docs/static-analysis.md.",
+    )
+    p.add_argument("paths", nargs="*", help=".py files or directories")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument(
+        "--baseline",
+        type=Path,
+        default=bl.DEFAULT_BASELINE,
+        help="baseline file grandfathering pre-existing findings "
+        "(default: tools/analyze/baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding as new (ignore the baseline file)",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="snapshot current findings into the baseline file and exit 0 "
+        "(REPLACES the file: run it over the full gate paths with every "
+        "rule — it refuses to combine with --select)",
+    )
+    p.add_argument(
+        "--select", default=None, metavar="PREFIX[,PREFIX]",
+        help="only run rules whose code matches a prefix (e.g. ACT01,ACT02)",
+    )
+    p.add_argument(
+        "--include-corpus", action="store_true",
+        help="also analyze the deliberate-violation fixture corpus "
+        "(tests/fixtures/analyze/, excluded by default)",
+    )
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def report_json(report: Report, rules: list[Rule]) -> dict:
+    counts = {
+        s: report.count(s) for s in ("new", "baselined", "suppressed")
+    }
+    counts["total"] = len(report.findings)
+    counts["stale_baseline"] = report.stale_baseline
+    return {
+        "schema": JSON_SCHEMA,
+        "files": report.files,
+        "rules": [
+            {"code": r.code, "name": r.name, "summary": r.summary}
+            for r in sorted(rules, key=lambda r: r.code)
+        ],
+        "counts": counts,
+        "by_code": {
+            code: dict(statuses) for code, statuses in sorted(report.by_code().items())
+        },
+        "findings": [
+            {
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "code": f.code,
+                "message": f.message,
+                "status": f.status,
+            }
+            for f in report.findings
+        ],
+    }
+
+
+def report_text(report: Report, rules: list[Rule], out=sys.stdout, err=sys.stderr) -> None:
+    for f in report.findings:
+        if f.status == "new":
+            print(f.render(), file=out)
+    by_code = report.by_code()
+    print(
+        f"analyze: {report.files} files, {len(rules)} rules, "
+        f"{len(report.findings)} finding(s): {report.count('new')} new, "
+        f"{report.count('baselined')} baselined, "
+        f"{report.count('suppressed')} suppressed"
+        + (
+            f", {report.stale_baseline} stale baseline entr"
+            + ("y" if report.stale_baseline == 1 else "ies")
+            if report.stale_baseline
+            else ""
+        ),
+        file=err,
+    )
+    for r in sorted(rules, key=lambda r: r.code):
+        statuses = by_code.get(r.code, {})
+        total = sum(statuses.values())
+        detail = (
+            " ".join(f"{n} {s}" for s, n in sorted(statuses.items()))
+            if total
+            else "clean"
+        )
+        print(f"  {r.code} {r.name:<24} {detail}", file=err)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for code in sorted(RULES):
+            r = RULES[code]
+            print(f"{code}  {r.name:<24} {r.summary}")
+        return 0
+    if not args.paths:
+        print("usage: python -m tools.analyze PATH...", file=sys.stderr)
+        return 2
+    select = tuple(s.strip() for s in args.select.split(",")) if args.select else None
+    if args.write_baseline and select:
+        # A narrowed run would REPLACE the baseline with its subset,
+        # silently un-grandfathering every other family's findings.
+        print(
+            "analyze: refusing --write-baseline with --select: the "
+            "baseline is replaced whole, so a narrowed snapshot would "
+            "drop every other rule family's entries",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        report = analyze_paths(
+            args.paths, select=select, include_corpus=args.include_corpus
+        )
+    except FileNotFoundError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        n = bl.write(args.baseline, report.findings)
+        print(f"analyze: wrote {n} baseline entr{'y' if n == 1 else 'ies'} "
+              f"to {args.baseline}", file=sys.stderr)
+        return 0
+    if not args.no_baseline and args.baseline.exists():
+        try:
+            baseline = bl.load(args.baseline)
+        except (ValueError, KeyError, TypeError) as exc:
+            # json.JSONDecodeError is a ValueError: one branch covers
+            # malformed JSON, wrong schema, and missing fields.
+            print(
+                f"analyze: unreadable baseline {args.baseline}: {exc} "
+                "(regenerate with --write-baseline)",
+                file=sys.stderr,
+            )
+            return 2
+        report.stale_baseline = bl.apply(report.findings, baseline)
+    rules = selected_rules(select)
+    if args.format == "json":
+        print(json.dumps(report_json(report, rules), indent=1))
+    else:
+        report_text(report, rules)
+    return 1 if report.new else 0

@@ -1,0 +1,244 @@
+"""Analysis core: findings, the rule registry, and per-file context.
+
+Every file is read and parsed exactly ONCE (``FileContext``); all rules —
+including the migrated ACT00x style family that used to live in
+tools/lint.py — consume the same AST. Rules register through the
+``@rule`` decorator with a stable code; codes are the suppression and
+baseline currency, so they must never be renumbered (retire a code
+rather than reuse it).
+
+Code families (docs/static-analysis.md has the full catalogue):
+
+- ACT00x  style/imports (the old tools/lint.py checks)
+- ACT01x  async-safety (blocking calls, dropped tasks, swallowed cancels)
+- ACT02x  JAX purity / tracer discipline (host syncs, impure jit bodies)
+- ACT03x  owner-write invariant (the paper's "only the owner mutates
+          its keyspace" rule)
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from collections.abc import Callable, Iterable
+from dataclasses import dataclass
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# ``# noqa`` (blanket) or ``# noqa: ACT012[, ACT013]`` with an optional
+# ``-- justification`` trailer (encouraged; see docs/static-analysis.md).
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?::\s*(?P<codes>[A-Z][A-Z0-9]*(?:\s*,\s*[A-Z][A-Z0-9]*)*))?",
+    re.IGNORECASE,
+)
+# Fixture-corpus files opt into a domain the path doesn't imply, e.g.
+# ``# analyze-domain: sim`` (tests/fixtures/analyze/ uses this so
+# path-scoped rules stay testable outside their real directories).
+_DOMAIN_RE = re.compile(r"#\s*analyze-domain:\s*([a-z0-9_\-, ]+)", re.IGNORECASE)
+
+
+@dataclass
+class Finding:
+    """One rule violation at a location. ``status`` is assigned by the
+    engine: new | suppressed | baselined."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    status: str = "new"
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        """Baseline identity: deliberately excludes line/col so findings
+        survive unrelated edits above them (messages carry names, not
+        line numbers, for the same reason)."""
+        return (self.path, self.code, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+    check: Callable[["FileContext"], Iterable[Finding]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(code: str, name: str, summary: str):
+    """Register a rule. ``check(ctx)`` yields Findings; it must tolerate
+    ``ctx.tree is None`` (syntax-error files) by yielding nothing."""
+
+    def deco(fn):
+        if code in RULES:
+            raise ValueError(f"duplicate rule code {code}")
+        RULES[code] = Rule(code, name, summary, fn)
+        return fn
+
+    return deco
+
+
+@dataclass
+class FileContext:
+    path: Path
+    relpath: str  # posix, repo-root-relative when under the repo
+    src: str
+    lines: list[str]
+    tree: ast.Module | None
+    syntax_error: SyntaxError | None
+    suppressions: dict[int, set[str] | None]  # line -> codes (None=blanket)
+    domains: set[str]
+    import_map: dict[str, str]  # local binding -> dotted origin
+
+    def finding(self, node: ast.AST | int, code: str, message: str) -> Finding:
+        if isinstance(node, int):
+            line, col = node, 0
+        else:
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+        return Finding(self.relpath, line, col, code, message)
+
+    def is_suppressed(self, f: Finding) -> bool:
+        codes = self.suppressions.get(f.line, ...)
+        if codes is ...:
+            return False
+        return codes is None or f.code in codes
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Dotted origin of a Name/Attribute chain through the module's
+        imports: with ``from time import sleep``, ``sleep`` resolves to
+        ``time.sleep``; with ``from jax import random``, ``random.bits``
+        resolves to ``jax.random.bits`` (so the stdlib-``random`` purity
+        rule can't misfire on jax.random)."""
+        d = dotted_name(node)
+        if d is None:
+            return None
+        root, _, rest = d.partition(".")
+        base = self.import_map.get(root, root)
+        return f"{base}.{rest}" if rest else base
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def build_import_map(tree: ast.Module) -> dict[str, str]:
+    imap: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    imap[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        imap[a.asname or a.name] = f"{node.module}.{a.name}"
+    return imap
+
+
+def _parse_suppressions(src: str) -> dict[int, set[str] | None]:
+    supp: dict[int, set[str] | None] = {}
+
+    def record(line: int, text: str) -> None:
+        m = _NOQA_RE.search(text)
+        if not m:
+            return
+        codes = m.group("codes")
+        if codes is None:
+            supp[line] = None  # blanket
+        elif supp.get(line, set()) is not None:
+            cur = supp.setdefault(line, set())
+            assert cur is not None
+            cur.update(c.strip().upper() for c in codes.split(","))
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                record(tok.start[0], tok.string)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Un-tokenizable (e.g. a syntax-error fixture): naive line scan.
+        for lineno, line in enumerate(src.splitlines(), 1):
+            if "#" in line:
+                record(lineno, line[line.index("#"):])
+    return supp
+
+
+def _compute_domains(relpath: str, src: str) -> set[str]:
+    p = relpath.replace("\\", "/")
+    domains: set[str] = set()
+    if "/sim/" in p:
+        domains.add("sim")
+    if "/ops/" in p:
+        domains.add("ops")
+    if "/core/" in p:
+        domains.add("core")
+    if p.endswith("core/kvstate.py"):
+        domains.add("kvstate")
+    if p.endswith("core/cluster_state.py"):
+        domains.add("cluster-state")
+    for m in _DOMAIN_RE.finditer(src):
+        for d in m.group(1).split(","):
+            domains.add(d.strip().lower())
+    return domains
+
+
+def load_context(path: Path, root: Path | None = None) -> FileContext:
+    root = root or REPO_ROOT
+    try:
+        rel = path.resolve().relative_to(root).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    src = path.read_text(encoding="utf-8")
+    tree: ast.Module | None = None
+    err: SyntaxError | None = None
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as exc:
+        err = exc
+    return FileContext(
+        path=path,
+        relpath=rel,
+        src=src,
+        lines=src.splitlines(),
+        tree=tree,
+        syntax_error=err,
+        suppressions=_parse_suppressions(src),
+        domains=_compute_domains(rel, src),
+        import_map=build_import_map(tree) if tree is not None else {},
+    )
+
+
+# Shared AST helpers ---------------------------------------------------------
+
+def walk_excluding_nested_functions(body: list[ast.stmt]):
+    """Walk statements without descending into nested function/class
+    defs — for rules whose scope is "directly in THIS function's
+    execution" (a nested def's body runs elsewhere, possibly in a
+    thread via asyncio.to_thread). Scope-boundary nodes (defs, classes,
+    lambdas) ARE yielded — at any depth — so callers can recurse into
+    them deliberately; their bodies are just never entered here."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

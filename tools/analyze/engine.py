@@ -1,0 +1,118 @@
+"""File iteration + rule execution + report assembly.
+
+The engine walks the given paths (skipping the deliberate-violation
+corpus under ``tests/fixtures/analyze/`` unless a file there is named
+explicitly), builds one FileContext per file, runs every selected rule
+over it, applies inline suppressions, then (optionally) the committed
+baseline. tools/lint.py is a thin shim over this engine with
+``select=("ACT00",)`` — one parser serves both gates.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from . import rules_async, rules_jax, rules_owner, rules_style  # noqa: ACT002 -- imported for rule registration side effects
+from .core import RULES, FileContext, Finding, load_context
+
+# Directory suffix of the deliberate-violation fixture corpus: analyzing
+# it as part of the repo gate would (by design) light up every rule.
+CORPUS_MARKER = "fixtures/analyze"
+
+#: What the repo gate (`make analyze`, bench.py's health field, and the
+#: acceptance command) analyzes.
+DEFAULT_PATHS = ("aiocluster_tpu", "tests", "benchmarks", "tools",
+                 "bench.py", "__graft_entry__.py")
+
+
+@dataclass
+class Report:
+    files: int = 0
+    findings: list[Finding] = field(default_factory=list)
+    stale_baseline: int = 0
+
+    def count(self, status: str) -> int:
+        return sum(1 for f in self.findings if f.status == status)
+
+    @property
+    def new(self) -> int:
+        return self.count("new")
+
+    def by_code(self) -> dict[str, Counter]:
+        out: dict[str, Counter] = {}
+        for f in self.findings:
+            out.setdefault(f.code, Counter())[f.status] += 1
+        return out
+
+
+def iter_py_files(paths: list[str | Path], *, include_corpus: bool = False):
+    """Yield .py files. Directories recurse (sorted, corpus excluded);
+    explicit file arguments are always analyzed."""
+    seen: set[Path] = set()
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                if not include_corpus and CORPUS_MARKER in f.as_posix():
+                    continue
+                r = f.resolve()
+                if r not in seen:
+                    seen.add(r)
+                    yield f
+        elif path.suffix == ".py" and path.is_file():
+            r = path.resolve()
+            if r not in seen:
+                seen.add(r)
+                yield path
+        else:
+            raise FileNotFoundError(f"{path}: not a .py file or directory")
+
+
+def selected_rules(select: tuple[str, ...] | None):
+    if not select:
+        return list(RULES.values())
+    return [r for r in RULES.values() if any(r.code.startswith(s) for s in select)]
+
+
+def analyze_file(ctx: FileContext, select: tuple[str, ...] | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for r in selected_rules(select):
+        for f in r.check(ctx):
+            if ctx.is_suppressed(f):
+                f.status = "suppressed"
+            findings.append(f)
+    # Dedup (a rule re-visiting a shared subtree must not double-report),
+    # then order for stable output.
+    unique = {(f.path, f.line, f.col, f.code, f.message): f for f in findings}
+    return sorted(
+        unique.values(), key=lambda f: (f.path, f.line, f.col, f.code, f.message)
+    )
+
+
+def analyze_paths(
+    paths: list[str | Path],
+    *,
+    select: tuple[str, ...] | None = None,
+    include_corpus: bool = False,
+    root: Path | None = None,
+) -> Report:
+    report = Report()
+    for path in iter_py_files(paths, include_corpus=include_corpus):
+        report.files += 1
+        ctx = load_context(path, root=root)
+        report.findings.extend(analyze_file(ctx, select))
+    return report
+
+
+def run_default(repo_root: Path | None = None) -> Report:
+    """The repo gate, programmatically (bench.py's analyze_clean field
+    and the self-check test): default paths + committed baseline."""
+    from . import baseline as bl
+    from .core import REPO_ROOT
+
+    root = repo_root or REPO_ROOT
+    report = analyze_paths([root / p for p in DEFAULT_PATHS], root=root)
+    report.stale_baseline = bl.apply(report.findings, bl.load(bl.DEFAULT_BASELINE))
+    return report

@@ -1,0 +1,274 @@
+"""ACT01x — async-safety.
+
+The runtime's Syn→SynAck→Ack handshake lives entirely on one event
+loop; the four rules here target the bug classes that silently sink
+such a loop: blocking it (ACT010), forgetting to await (ACT011),
+letting the GC collect an in-flight task (ACT012 — asyncio holds only a
+weak reference to running tasks), and swallowing cancellation so
+shutdown hangs (ACT013).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, rule, walk_excluding_nested_functions
+
+# Fully-qualified call targets that block the calling thread. Resolution
+# goes through the module's import map, so both ``time.sleep(...)`` and
+# ``from time import sleep; sleep(...)`` match.
+BLOCKING_CALLS = {
+    "time.sleep": "time.sleep blocks the event loop (use asyncio.sleep)",
+    "subprocess.run": "subprocess.run blocks (use asyncio.create_subprocess_exec)",
+    "subprocess.call": "subprocess.call blocks (use asyncio.create_subprocess_exec)",
+    "subprocess.check_call": "subprocess.check_call blocks (use asyncio subprocesses)",
+    "subprocess.check_output": "subprocess.check_output blocks (use asyncio subprocesses)",
+    "subprocess.Popen": "subprocess.Popen blocks on pipe I/O (use asyncio subprocesses)",
+    "os.system": "os.system blocks (use asyncio.create_subprocess_shell)",
+    "os.waitpid": "os.waitpid blocks (use asyncio child watchers)",
+    "socket.create_connection": "blocking socket connect (use asyncio.open_connection)",
+    "socket.getaddrinfo": "blocking DNS resolution (use loop.getaddrinfo)",
+    "socket.gethostbyname": "blocking DNS resolution (use loop.getaddrinfo)",
+    "requests.get": "requests blocks (use an async HTTP client or to_thread)",
+    "requests.post": "requests blocks (use an async HTTP client or to_thread)",
+    "requests.request": "requests blocks (use an async HTTP client or to_thread)",
+    "urllib.request.urlopen": "urlopen blocks (use an async HTTP client or to_thread)",
+    "open": "file open() blocks (wrap in asyncio.to_thread for slow media)",
+}
+# Synchronous-file-I/O method names: flagged on any receiver inside an
+# async def (Path.read_text and friends).
+BLOCKING_METHODS = {
+    "read_text", "write_text", "read_bytes", "write_bytes",
+}
+
+
+def _is_cancelled_error(ctx: FileContext, node: ast.expr) -> bool:
+    r = ctx.resolve(node)
+    return r is not None and (
+        r == "asyncio.CancelledError"
+        or r.endswith(".CancelledError")
+        or r == "CancelledError"
+    )
+
+
+@rule("ACT010", "blocking-call-in-async", "blocking call inside async def")
+def check_blocking(ctx: FileContext):
+    if ctx.tree is None:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in walk_excluding_nested_functions(fn.body):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func)
+            if target in BLOCKING_CALLS:
+                yield ctx.finding(
+                    node,
+                    "ACT010",
+                    f"blocking call '{target}' in async def "
+                    f"'{fn.name}': {BLOCKING_CALLS[target]}",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in BLOCKING_METHODS
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT010",
+                    f"blocking file I/O '.{node.func.attr}()' in async def "
+                    f"'{fn.name}' (wrap in asyncio.to_thread)",
+                )
+
+
+def _async_defs(tree: ast.Module):
+    """(module-level async function names, class -> async method names)."""
+    module_async = {
+        n.name for n in tree.body if isinstance(n, ast.AsyncFunctionDef)
+    }
+    class_async: dict[str, set[str]] = {}
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            class_async[cls.name] = {
+                n.name for n in cls.body if isinstance(n, ast.AsyncFunctionDef)
+            }
+    return module_async, class_async
+
+
+def _local_bindings(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    """Names a function scope binds: parameters plus anything assigned
+    inside it (a binding shadows a module-level async def of the same
+    name, so a bare call to it is NOT the coroutine)."""
+    a = fn.args
+    names = {
+        p.arg
+        for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)
+    }
+    for v in (a.vararg, a.kwarg):
+        if v is not None:
+            names.add(v.arg)
+    for n in walk_excluding_nested_functions(fn.body):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                names |= {x.id for x in ast.walk(t) if isinstance(x, ast.Name)}
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign, ast.For, ast.AsyncFor)):
+            names |= {
+                x.id for x in ast.walk(n.target) if isinstance(x, ast.Name)
+            }
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    names |= {
+                        x.id
+                        for x in ast.walk(item.optional_vars)
+                        if isinstance(x, ast.Name)
+                    }
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(n.name)
+    return names
+
+
+@rule("ACT011", "unawaited-coroutine", "coroutine called but never awaited")
+def check_unawaited(ctx: FileContext):
+    if ctx.tree is None:
+        return
+    module_async, class_async = _async_defs(ctx.tree)
+
+    def scan_scope(body: list[ast.stmt], shadowed: frozenset[str]):
+        nested: list[ast.AST] = []
+        for node in walk_excluding_nested_functions(body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                nested.append(node)
+            elif (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in module_async
+                and node.value.func.id not in shadowed
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT011",
+                    f"coroutine '{node.value.func.id}()' is never awaited "
+                    "(await it, or schedule it with asyncio.create_task and "
+                    "retain the task)",
+                )
+        for child in nested:
+            if isinstance(child, ast.ClassDef):
+                yield from scan_scope(child.body, shadowed)
+            else:
+                yield from scan_scope(
+                    child.body, shadowed | _local_bindings(child)
+                )
+
+    yield from scan_scope(ctx.tree.body, frozenset())
+    # Bare-statement self.<async method>() within the defining class.
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        async_methods = class_async.get(cls.name, set())
+        for node in ast.walk(cls):
+            if (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and isinstance(node.value.func.value, ast.Name)
+                and node.value.func.value.id == "self"
+                and node.value.func.attr in async_methods
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT011",
+                    f"coroutine 'self.{node.value.func.attr}()' is never "
+                    "awaited (await it, or schedule it with "
+                    "asyncio.create_task and retain the task)",
+                )
+
+
+@rule("ACT012", "dropped-task", "task created but reference dropped")
+def check_dropped_task(ctx: FileContext):
+    if ctx.tree is None:
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        target = ctx.resolve(call.func)
+        is_spawn = target in ("asyncio.create_task", "asyncio.ensure_future")
+        if not is_spawn and isinstance(call.func, ast.Attribute):
+            # loop.create_task(...) / self._loop.create_task(...):
+            # same weak-reference hazard. (TaskGroup.create_task retains
+            # its tasks; group receivers are conventionally named 'tg'
+            # or 'group' — not matched here.)
+            recv = ctx.resolve(call.func.value) or ""
+            is_spawn = call.func.attr == "create_task" and "loop" in recv.lower()
+        if is_spawn:
+            yield ctx.finding(
+                node,
+                "ACT012",
+                "task reference dropped: asyncio keeps only a weak ref to "
+                "running tasks — retain the result (and cancel it on close)",
+            )
+
+
+def _handler_reraises(node: ast.ExceptHandler) -> bool:
+    return any(
+        isinstance(n, ast.Raise)
+        for n in walk_excluding_nested_functions(node.body)
+    )
+
+
+@rule("ACT013", "swallowed-cancellation", "CancelledError caught without re-raise")
+def check_swallowed_cancel(ctx: FileContext):
+    if ctx.tree is None:
+        return
+    # except BaseException / bare except inside an async def swallow
+    # CancelledError just as thoroughly as naming it (CancelledError
+    # derives from BaseException since 3.8) — but only flag them in
+    # async execution scope, where a cancellation can actually arrive.
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in walk_excluding_nested_functions(fn.body):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            catches_everything = node.type is None or (
+                ctx.resolve(node.type) == "BaseException"
+            )
+            if catches_everything and not _handler_reraises(node):
+                yield ctx.finding(
+                    node,
+                    "ACT013",
+                    ("bare except" if node.type is None else "except BaseException")
+                    + " in async code swallows CancelledError too: the task "
+                    "becomes unkillable (catch Exception, or re-raise)",
+                )
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is not None:
+            caught = (
+                node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
+            )
+            if not any(_is_cancelled_error(ctx, c) for c in caught):
+                continue
+            if not _handler_reraises(node):
+                yield ctx.finding(
+                    node,
+                    "ACT013",
+                    "except CancelledError without re-raise: swallowing "
+                    "cancellation makes the task unkillable (re-raise, or "
+                    "suppress with a justification at a terminal point)",
+                )
+        elif isinstance(node, ast.Call):
+            target = ctx.resolve(node.func)
+            if target in ("contextlib.suppress", "suppress") and any(
+                _is_cancelled_error(ctx, a)
+                or ctx.resolve(a) == "BaseException"
+                for a in node.args
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT013",
+                    "suppress(CancelledError) swallows cancellation: the "
+                    "awaiting task becomes unkillable (narrow the suppress, "
+                    "or justify it at a terminal point)",
+                )

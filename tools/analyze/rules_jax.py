@@ -1,0 +1,201 @@
+"""ACT02x — JAX purity / tracer discipline.
+
+The sim backend's whole performance story is "the jit'd hot loop never
+talks to the host" (PR 1's device-scalar buffering exists because the
+host-sync-in-hot-loop bug class is real here). These rules catch the
+three ways that discipline erodes: impure host calls inside traced
+code (ACT020 — they freeze a trace-time value into the compiled
+artifact), device syncs inside host loops (ACT021 — each one stalls
+the dispatch pipeline), and jnp computation at import time (ACT022 —
+it initializes a backend and burns compile time before main runs).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, dotted_name, rule, walk_excluding_nested_functions
+
+# Host-impure call targets: inside a traced function these execute once
+# at trace time and bake a constant into the compiled computation.
+IMPURE_CALLS = (
+    "time.time",
+    "time.time_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.monotonic",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "os.urandom",
+    "uuid.uuid4",
+)
+IMPURE_PREFIXES = (
+    "random.",  # the stdlib module; jax.random resolves to "jax.random." and passes
+    "numpy.random.",
+)
+
+# Calls that force a device->host transfer (or a dispatch-queue flush).
+SYNC_ATTR_CALLS = {"item", "block_until_ready"}
+SYNC_TARGETS = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+}
+
+
+def _jit_functions(ctx: FileContext) -> list[ast.AST]:
+    """Function defs traced by JAX: decorated with jax.jit (bare, via
+    functools.partial, or called with options), plus Pallas kernel
+    bodies (functions passed as the first argument to pl.pallas_call)."""
+    tree = ctx.tree
+    assert tree is not None
+
+    def is_jit_expr(node: ast.expr) -> bool:
+        r = ctx.resolve(node)
+        if r in ("jax.jit", "jax.pmap", "jax.vmap"):
+            return True
+        if isinstance(node, ast.Call):
+            fr = ctx.resolve(node.func)
+            if fr in ("functools.partial", "partial") and node.args:
+                return is_jit_expr(node.args[0])
+            return fr in ("jax.jit", "jax.pmap")
+        return False
+
+    jitted: list[ast.AST] = []
+    kernel_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(is_jit_expr(d) for d in node.decorator_list):
+                jitted.append(node)
+        elif isinstance(node, ast.Call):
+            r = ctx.resolve(node.func)
+            if r is not None and r.endswith("pallas_call") and node.args:
+                name = dotted_name(node.args[0])
+                if name is not None:
+                    kernel_names.add(name.split(".")[-1])
+    if kernel_names:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name in kernel_names
+                and node not in jitted
+            ):
+                jitted.append(node)
+    return jitted
+
+
+@rule("ACT020", "impure-jit", "host-impure call inside a traced function")
+def check_impure_jit(ctx: FileContext):
+    if ctx.tree is None:
+        return
+    for fn in _jit_functions(ctx):
+        # Nested defs ARE included: they run under the same trace.
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func)
+            if target is None:
+                continue
+            if target in IMPURE_CALLS or any(
+                target.startswith(p) for p in IMPURE_PREFIXES
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT020",
+                    f"impure call '{target}' inside traced function "
+                    f"'{fn.name}': it runs once at trace time and bakes a "
+                    "constant into the compiled computation",
+                )
+
+
+@rule("ACT021", "device-sync-in-loop", "device sync inside a host loop (sim/ops)")
+def check_sync_in_loop(ctx: FileContext):
+    if ctx.tree is None or not ({"sim", "ops"} & ctx.domains):
+        return
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        # int()/float() of a loop variable iterates a Python container —
+        # pure host work, no device queue involved. Collect every For
+        # target within this loop's subtree so `for ln in lines:
+        # int(ln)` never needs a suppression.
+        loop_vars = {
+            x.id
+            for n in ast.walk(loop)
+            if isinstance(n, (ast.For, ast.AsyncFor))
+            for x in ast.walk(n.target)
+            if isinstance(x, ast.Name)
+        }
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func)
+            if target in SYNC_TARGETS:
+                yield ctx.finding(
+                    node,
+                    "ACT021",
+                    f"'{target}' in a host loop forces a device sync per "
+                    "iteration (hoist it, or buffer device scalars and "
+                    "convert after the loop)",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_ATTR_CALLS
+                and not node.args
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT021",
+                    f"'.{node.func.attr}()' in a host loop forces a device "
+                    "sync per iteration (buffer and convert after the loop)",
+                )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int")
+                and len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)
+                and not (
+                    isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in loop_vars
+                )
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT021",
+                    f"'{node.func.id}(...)' on an array in a host loop "
+                    "blocks on the device queue (poll at chunk boundaries "
+                    "or buffer device scalars)",
+                )
+
+
+@rule("ACT022", "import-time-jnp", "jnp computation at module import time")
+def check_import_time_jnp(ctx: FileContext):
+    tree = ctx.tree
+    if tree is None:
+        return
+    for stmt in tree.body:
+        if isinstance(
+            stmt,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+             ast.Import, ast.ImportFrom),
+        ):
+            continue
+        # Only code that RUNS at import time counts: a def nested under
+        # a module-level if/try (the version-compat pattern) is lazy.
+        for node in walk_excluding_nested_functions([stmt]):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func)
+            if (
+                target is not None
+                and target.startswith("jax.numpy.")
+                and target != "jax.numpy.dtype"  # metadata, no device op
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT022",
+                    f"'{target}' at module import time initializes a "
+                    "backend before main() (build constants lazily or "
+                    "inside the traced function)",
+                )

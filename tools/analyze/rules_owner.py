@@ -1,0 +1,130 @@
+"""ACT03x — the paper's owner-write invariant.
+
+ScuttleButt's core correctness rule: only the OWNER mutates its
+keyspace; replicas converge exclusively through the version-ordered
+delta-apply path (core/kvstate.py::apply_delta). A direct write to a
+peer's NodeState from anywhere else forks version history — the peer
+will keep gossiping versions the owner never issued, and the CRDT join
+can never reconcile them. These rules fence that path syntactically.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, rule
+
+# NodeState version-structure fields only the owner (or the delta path)
+# may assign.
+PROTECTED_FIELDS = {"heartbeat", "max_version", "last_gc_version", "key_values"}
+# Owner-only mutators: calling one of these on a PEER's state forks its
+# version history. (apply_delta/apply_heartbeat are the sanctioned
+# replica-side operations and are deliberately absent.)
+OWNER_MUTATORS = {
+    "set",
+    "set_versioned",
+    "set_with_version",
+    "set_with_ttl",
+    "delete",
+    "delete_after_ttl",
+    "inc_heartbeat",
+}
+# Receiver shapes that denote "some peer's state" rather than our own:
+# a _node_states[...] subscript or a node_state lookup in the call chain.
+PEER_LOOKUPS = {"node_state", "node_state_or_default"}
+
+
+def _exempt(ctx: FileContext) -> bool:
+    # kvstate.py IS the invariant's implementation; cluster_state.py is
+    # its container (delta routing, GC, removal).
+    return bool({"kvstate", "cluster-state"} & ctx.domains)
+
+
+def _mentions_peer_lookup(node: ast.expr) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "_node_states":
+            return True
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in PEER_LOOKUPS
+        ):
+            return True
+    return False
+
+
+@rule("ACT030", "nodestate-field-write", "direct write to NodeState version fields")
+def check_field_write(ctx: FileContext):
+    if ctx.tree is None or _exempt(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            # Flatten tuple/list/starred unpacking so `peer.heartbeat, x
+            # = 1, 2` can't slip through the fence.
+            flat: list[ast.expr] = []
+            stack = list(targets)
+            while stack:
+                t = stack.pop()
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    stack.extend(t.elts)
+                elif isinstance(t, ast.Starred):
+                    stack.append(t.value)
+                else:
+                    flat.append(t)
+            for t in flat:
+                # X.key_values[...] = ... assigns through the subscript.
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if not isinstance(base, ast.Attribute):
+                    continue
+                if base.attr not in PROTECTED_FIELDS:
+                    continue
+                if isinstance(base.value, ast.Name) and base.value.id == "self":
+                    continue  # a class maintaining its own fields
+                # Anchor on the target, not the statement: a swap writes
+                # two protected fields on one line and must report both.
+                yield ctx.finding(
+                    base,
+                    "ACT030",
+                    f"direct write to NodeState.{base.attr} outside "
+                    "core/kvstate.py: version structures may only change "
+                    "through owner writes or apply_delta",
+                )
+
+
+@rule("ACT031", "peer-kv-mutation", "owner-only mutator called on a peer's state")
+def check_peer_mutation(ctx: FileContext):
+    if ctx.tree is None or _exempt(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr not in OWNER_MUTATORS:
+            continue
+        if _mentions_peer_lookup(node.func.value):
+            yield ctx.finding(
+                node,
+                "ACT031",
+                f"'{node.func.attr}()' on a peer NodeState: only the owner "
+                "mutates its keyspace — replicas must go through "
+                "apply_delta (core/kvstate.py)",
+            )
+
+
+@rule("ACT032", "private-state-access", "reach into ClusterState._node_states")
+def check_private_access(ctx: FileContext):
+    if ctx.tree is None or _exempt(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute) and node.attr == "_node_states":
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                continue  # the owning class itself
+            yield ctx.finding(
+                node,
+                "ACT032",
+                "access to ClusterState._node_states outside core/: use "
+                "the public surface (node_state/node_states/digest) so the "
+                "owner-write fence stays auditable",
+            )

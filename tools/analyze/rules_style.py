@@ -1,0 +1,183 @@
+"""ACT00x — style/import hygiene (migrated from the original
+tools/lint.py so one engine parses each file once).
+
+Migration note (ACT002): the old lint credited an import as "used" when
+its name appeared in ANY string constant — including docstrings — so an
+unused import mentioned in prose was never reported (tools/lint.py
+lines 123-126 in the pre-migration version). Here string-scan credit is
+restricted to *annotation contexts* (string annotations on arguments,
+returns, AnnAssigns, and ``typing.cast`` targets), which is the only
+place a string legitimately stands for a name.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import FileContext, Finding, rule
+
+
+def _module_all(tree: ast.Module) -> list[str]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        value = ast.literal_eval(node.value)
+                    except ValueError:
+                        return []
+                    return [str(v) for v in value]
+    return []
+
+
+def _top_level_names(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                names.add((alias.asname or alias.name).split(".")[0])
+    return names
+
+
+@rule("ACT001", "syntax-error", "file does not parse")
+def check_syntax(ctx: FileContext):
+    if ctx.syntax_error is not None:
+        yield Finding(
+            ctx.relpath,
+            ctx.syntax_error.lineno or 1,
+            0,
+            "ACT001",
+            f"syntax error: {ctx.syntax_error.msg}",
+        )
+
+
+def _names_in_annotation_string(s: str) -> set[str]:
+    try:
+        t = ast.parse(s, mode="eval")
+    except SyntaxError:
+        return {tok for tok in re.split(r"\W+", s) if tok}
+    return {n.id for n in ast.walk(t) if isinstance(n, ast.Name)}
+
+
+def _annotation_string_names(tree: ast.Module, ctx: FileContext) -> set[str]:
+    """Names inside string annotations (and typing.cast first args) —
+    the ONLY strings that credit an import as used."""
+    ann_nodes: list[ast.expr] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign):
+            ann_nodes.append(node.annotation)
+        elif isinstance(node, ast.arg) and node.annotation is not None:
+            ann_nodes.append(node.annotation)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.returns is not None:
+                ann_nodes.append(node.returns)
+        elif (
+            isinstance(node, ast.Call)
+            and ctx.resolve(node.func) == "typing.cast"
+            and node.args
+        ):
+            ann_nodes.append(node.args[0])
+    names: set[str] = set()
+    for ann in ann_nodes:
+        for c in ast.walk(ann):
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                names |= _names_in_annotation_string(c.value)
+    return names
+
+
+@rule("ACT002", "unused-import", "module-scope import never used")
+def check_unused_imports(ctx: FileContext):
+    tree = ctx.tree
+    if tree is None:
+        return
+    if ctx.path.name == "__init__.py":
+        return  # package re-export surface
+    exported = set(_module_all(tree))
+    imports: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = (alias.asname or alias.name).split(".")[0]
+                imports.setdefault(bound, node.lineno)
+    used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+    used |= _annotation_string_names(tree, ctx)
+    for name, lineno in imports.items():
+        if name not in used and name not in exported:
+            yield ctx.finding(lineno, "ACT002", f"unused import '{name}'")
+
+
+@rule("ACT003", "duplicate-import", "same binding imported twice")
+def check_duplicate_imports(ctx: FileContext):
+    tree = ctx.tree
+    if tree is None:
+        return
+    seen_targets: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = (alias.asname or alias.name).split(".")[0]
+                # Dedup on the full dotted target: `import a.b` and
+                # `import a.c` both bind `a` but are not duplicates.
+                target = alias.asname or alias.name
+                if isinstance(node, ast.ImportFrom):
+                    target = f"{node.module}:{target}"
+                if target in seen_targets:
+                    yield ctx.finding(
+                        node.lineno, "ACT003", f"duplicate import of '{bound}'"
+                    )
+                else:
+                    seen_targets.add(target)
+
+
+@rule("ACT004", "undefined-export", "__all__ names a missing binding")
+def check_all_exports(ctx: FileContext):
+    tree = ctx.tree
+    if tree is None:
+        return
+    exported = _module_all(tree)
+    if not exported:
+        return
+    # PEP 562 lazy exports: a module __getattr__ may serve any name.
+    if any(
+        isinstance(n, ast.FunctionDef) and n.name == "__getattr__" for n in tree.body
+    ):
+        return
+    defined = _top_level_names(tree)
+    for name in exported:
+        if name not in defined:
+            yield ctx.finding(1, "ACT004", f"__all__ exports undefined name '{name}'")
+
+
+@rule("ACT005", "tab-indent", "tab character in indentation")
+def check_tabs(ctx: FileContext):
+    for lineno, line in enumerate(ctx.lines, 1):
+        indent = line[: len(line) - len(line.lstrip())]
+        if "\t" in indent:
+            yield ctx.finding(lineno, "ACT005", "tab in indentation")
+
+
+@rule("ACT006", "trailing-whitespace", "whitespace at end of line")
+def check_trailing_ws(ctx: FileContext):
+    for lineno, line in enumerate(ctx.lines, 1):
+        if line != line.rstrip():
+            yield ctx.finding(lineno, "ACT006", "trailing whitespace")

@@ -1,166 +1,39 @@
 #!/usr/bin/env python3
-"""Dependency-free lint for this repo (the image ships no ruff/flake8).
+"""Style lint — thin shim over tools.analyze's ACT00x family.
 
-Checks, per Python file:
-- syntax errors (ast.parse)
-- unused imports (module scope, aliasing-aware; ``__init__.py`` re-exports
-  and explicit ``__all__`` members are exempt)
-- duplicate imports of the same binding
-- ``__all__`` entries that aren't defined at module scope
-- tabs in indentation and trailing whitespace
+The checks that used to live here (syntax errors, unused/duplicate
+imports, __all__ hygiene, whitespace) are now rules ACT001-ACT006 in
+``tools/analyze`` so one engine parses each file once for lint AND the
+domain rules (async-safety, JAX purity, owner-write invariant). This
+shim keeps the historical entry point and contract: exit 0 = clean,
+1 = findings, 2 = usage error; no baseline — style findings are always
+fixed, never grandfathered.
 
-Exit code 0 = clean, 1 = findings. Usage: python tools/lint.py PATH...
+Migration fix shipped with the move: the old "usage" scan credited an
+import whenever its name appeared in ANY string constant (docstrings
+included), silently missing genuinely unused imports. ACT002 now
+credits string mentions only in annotation contexts.
+
+Usage: python tools/lint.py PATH...
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
+# Runnable both as `python tools/lint.py` (script: repo root not on
+# sys.path) and as `python -m tools.lint`.
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-def _iter_py(paths: list[str]):
-    for p in paths:
-        path = Path(p)
-        if path.is_dir():
-            yield from sorted(path.rglob("*.py"))
-        elif path.suffix == ".py":
-            if not path.is_file():
-                print(f"{path}: no such file", file=sys.stderr)
-                raise SystemExit(2)
-            yield path
-
-
-def _module_all(tree: ast.Module) -> list[str]:
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__":
-                    try:
-                        value = ast.literal_eval(node.value)
-                    except ValueError:
-                        return []
-                    return [str(v) for v in value]
-    return []
-
-
-def _top_level_names(tree: ast.Module) -> set[str]:
-    names: set[str] = set()
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            names.add(node.name)
-        elif isinstance(node, ast.Assign):
-            for t in node.targets:
-                for n in ast.walk(t):
-                    if isinstance(n, ast.Name):
-                        names.add(n.id)
-        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
-            names.add(node.target.id)
-        elif isinstance(node, (ast.Import, ast.ImportFrom)):
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                names.add((alias.asname or alias.name).split(".")[0])
-    return names
-
-
-def check_file(path: Path) -> list[str]:
-    problems: list[str] = []
-    src = path.read_text(encoding="utf-8")
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as exc:
-        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
-
-    for lineno, line in enumerate(src.splitlines(), 1):
-        stripped = line.rstrip("\n")
-        if stripped != stripped.rstrip():
-            problems.append(f"{path}:{lineno}: trailing whitespace")
-        indent = stripped[: len(stripped) - len(stripped.lstrip())]
-        if "\t" in indent:
-            problems.append(f"{path}:{lineno}: tab in indentation")
-
-    exported = set(_module_all(tree))
-    is_package_init = path.name == "__init__.py"
-
-    # Collect module-scope imports: binding -> first line.
-    imports: dict[str, int] = {}
-    seen_targets: set[str] = set()
-    duplicate: list[tuple[str, int]] = []
-    for node in tree.body:
-        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
-            continue  # future statements are directives, not bindings
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                bound = (alias.asname or alias.name).split(".")[0]
-                # Dedup on the full dotted target: `import a.b` and
-                # `import a.c` both bind `a` but are not duplicates.
-                target = alias.asname or alias.name
-                if isinstance(node, ast.ImportFrom):
-                    target = f"{node.module}:{target}"
-                if target in seen_targets:
-                    duplicate.append((bound, node.lineno))
-                else:
-                    seen_targets.add(target)
-                    imports.setdefault(bound, node.lineno)
-    for name, lineno in duplicate:
-        problems.append(f"{path}:{lineno}: duplicate import of '{name}'")
-
-    # Usage scan: every Name load + attribute roots + names in string
-    # annotations are "uses"; so is appearing in __all__.
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            root = node
-            while isinstance(root, ast.Attribute):
-                root = root.value
-            if isinstance(root, ast.Name):
-                used.add(root.id)
-        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
-            # crude but effective: string annotations / docstring refs
-            for token in node.value.replace(".", " ").split():
-                used.add(token)
-    for name, lineno in imports.items():
-        if name in used or name in exported or is_package_init:
-            continue
-        problems.append(f"{path}:{lineno}: unused import '{name}'")
-
-    if exported:
-        defined = _top_level_names(tree)
-        # PEP 562 lazy exports: a module __getattr__ may serve any name.
-        has_module_getattr = any(
-            isinstance(n, ast.FunctionDef) and n.name == "__getattr__"
-            for n in tree.body
-        )
-        if not has_module_getattr:
-            for name in exported:
-                if name not in defined:
-                    problems.append(
-                        f"{path}:1: __all__ exports undefined name '{name}'"
-                    )
-    return problems
+from tools.analyze.cli import main as analyze_main  # noqa: E402
 
 
 def main(argv: list[str]) -> int:
     if not argv:
         print("usage: python tools/lint.py PATH...", file=sys.stderr)
         return 2
-    problems: list[str] = []
-    n_files = 0
-    for path in _iter_py(argv):
-        n_files += 1
-        problems.extend(check_file(path))
-    for p in problems:
-        print(p)
-    print(
-        f"lint: {n_files} files, {len(problems)} problem(s)",
-        file=sys.stderr,
-    )
-    return 1 if problems else 0
+    return analyze_main(["--select", "ACT00", "--no-baseline", *argv])
 
 
 if __name__ == "__main__":
