@@ -30,7 +30,7 @@ from datetime import datetime, timedelta
 from ..utils.clock import utc_now
 from .identity import Address, NodeId
 from .kvstate import KeyChangeFn, NodeState
-from .messages import Delta, Digest, KeyValueUpdate, NodeDelta
+from .messages import Delta, Digest, KeyValueUpdate, NodeDelta, NodeDigest
 
 
 @dataclass(frozen=True, slots=True)
@@ -56,11 +56,32 @@ def staleness_score(node_state: NodeState, floor_version: int) -> Staleness | No
 
 
 class ClusterState:
-    """All node keyspaces known to this process, keyed by NodeId."""
+    """All node keyspaces known to this process, keyed by NodeId.
+
+    Digest computation is incrementally cached: every NodeState created
+    here carries a change hook that adds its node to a dirty-set when a
+    digest field (heartbeat / max_version / last_gc_version) moves, so
+    ``compute_digest`` rebuilds O(dirty) per-node entries — and when
+    nothing moved at all, returns the previously assembled ``Digest``
+    object outright. ``digest_cache_stats`` exposes plain counters
+    (rebuilds / hits / reuses) that the runtime exports as metrics and
+    tests assert on; ``digest_epoch`` is a monotonic generation the
+    engine keys its encoded-Syn cache on.
+    """
 
     def __init__(self, seed_addrs: set[Address] | None = None) -> None:
         self._node_states: dict[NodeId, NodeState] = {}
         self._seed_addrs: set[Address] = seed_addrs or set()
+        self._digest_cache: dict[NodeId, NodeDigest] = {}
+        self._dirty: set[NodeId] = set()
+        self._epoch = 0
+        self._assembled: Digest | None = None
+        self._assembled_key: tuple[int, frozenset[NodeId]] | None = None
+        self.digest_cache_stats: dict[str, int] = {
+            "rebuilds": 0,  # per-node NodeDigest reconstructions
+            "hits": 0,      # per-node entries served from cache
+            "reuses": 0,    # whole assembled Digests served unchanged
+        }
 
     # -- membership -----------------------------------------------------------
 
@@ -68,7 +89,26 @@ class ClusterState:
         return self._node_states.get(node_id)
 
     def node_state_or_default(self, node_id: NodeId) -> NodeState:
-        return self._node_states.setdefault(node_id, NodeState(node_id))
+        ns = self._node_states.get(node_id)
+        if ns is None:
+            ns = NodeState(node_id)
+            ns._on_change = lambda: self.mark_dirty(node_id)
+            self._node_states[node_id] = ns
+            self.mark_dirty(node_id)
+        return ns
+
+    def mark_dirty(self, node_id: NodeId) -> None:
+        """Invalidate the cached digest entry for ``node_id``. Fired
+        automatically by every NodeState mutator; call it manually after
+        white-box direct field writes."""
+        self._dirty.add(node_id)
+        self._epoch += 1
+
+    @property
+    def digest_epoch(self) -> int:
+        """Monotonic generation: bumps whenever any digest field changes
+        (or membership does). Equal epochs ⇒ identical digests."""
+        return self._epoch
 
     def nodes(self) -> Sequence[NodeId]:
         return tuple(self._node_states)
@@ -84,6 +124,9 @@ class ClusterState:
 
     def remove_node(self, node_id: NodeId) -> None:
         self._node_states.pop(node_id, None)
+        self._digest_cache.pop(node_id, None)
+        self._dirty.discard(node_id)
+        self._epoch += 1
 
     # -- reconciliation -------------------------------------------------------
 
@@ -100,14 +143,47 @@ class ClusterState:
 
     def compute_digest(self, scheduled_for_deletion: set[NodeId]) -> Digest:
         """Digest of every known node except those scheduled for deletion
-        (excluding them stops their state re-propagating before GC)."""
-        return Digest(
-            {
-                node_id: ns.digest()
-                for node_id, ns in self._node_states.items()
-                if node_id not in scheduled_for_deletion
-            }
-        )
+        (excluding them stops their state re-propagating before GC).
+
+        Incremental: only dirty nodes rebuild their NodeDigest; a fully
+        quiescent call returns the previously assembled Digest object
+        (callers treat digests as read-only — the wire layer only
+        encodes them)."""
+        stats = self.digest_cache_stats
+        if self._dirty:
+            rebuilt = 0
+            for node_id in self._dirty:
+                ns = self._node_states.get(node_id)
+                if ns is not None:
+                    self._digest_cache[node_id] = ns.digest()
+                    rebuilt += 1
+            self._dirty.clear()
+            stats["rebuilds"] += rebuilt
+        key = (self._epoch, frozenset(scheduled_for_deletion))
+        if self._assembled is not None and self._assembled_key == key:
+            stats["reuses"] += 1
+            return self._assembled
+        cache = self._digest_cache
+        # Iterate _node_states (not the cache) so entry order — and
+        # therefore encoded bytes — matches the uncached implementation.
+        # A state injected behind the API (white-box tests) has no cache
+        # entry yet; build it here rather than KeyError.
+        entries: dict[NodeId, NodeDigest] = {}
+        for node_id, ns in self._node_states.items():
+            if node_id in scheduled_for_deletion:
+                continue
+            nd = cache.get(node_id)
+            if nd is None:
+                nd = ns.digest()
+                cache[node_id] = nd
+                stats["rebuilds"] += 1
+            else:
+                stats["hits"] += 1
+            entries[node_id] = nd
+        digest = Digest(entries)
+        self._assembled = digest
+        self._assembled_key = key
+        return digest
 
     def gc_marked_for_deletion(self, grace_period: timedelta) -> None:
         for ns in self._node_states.values():
@@ -154,23 +230,18 @@ class ClusterState:
 
         node_deltas: list[NodeDelta] = []
         for ns, floor in candidates:
-            stale = sorted(
-                (
-                    KeyValueUpdate(k, vv.value, vv.version, vv.status)
-                    for k, vv in ns.stale_key_values(floor)
-                ),
-                key=lambda kv: kv.version,
-            )
-            if not stale:
-                continue
-
             # Reserve max_version bytes up front so packing decisions match
             # the reference's accounting; emit it only if nothing truncates.
             body = sizes.node_delta_base(ns.node, floor, ns.last_gc_version,
                                          ns.max_version)
             selected: list[KeyValueUpdate] = []
             truncated = False
-            for kv in stale:
+            # stale_key_values yields in increasing version order straight
+            # off the node's version index, so packing consumes it lazily:
+            # an MTU-truncated node stops scanning at the cutoff instead
+            # of materialising (and sorting) its whole stale range.
+            for key, vv in ns.stale_key_values(floor):
+                kv = KeyValueUpdate(key, vv.value, vv.version, vv.status)
                 grown = body + sizes.kv_increment(kv)
                 if sizes.delta_total_with(grown) > mtu:
                     truncated = True

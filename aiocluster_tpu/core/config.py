@@ -13,9 +13,12 @@ from datetime import timedelta
 
 from .identity import Address, NodeId
 
-# The reference's default delta MTU (entities.py:105): the UDP-sized cap
-# on one encoded DeltaPb. Shared by Config, the benchmarks, and the sim's
-# bytes-budget conversion so there is exactly one copy of the number.
+# The reference's default delta MTU (entities.py:105): the cap on one
+# encoded DeltaPb. The number happens to be the classic UDP-payload
+# maximum, but the transport is TCP (asyncio.start_server /
+# open_connection) — 65,507 only bounds delta payloads. Shared by
+# Config, the benchmarks, and the sim's bytes-budget conversion so there
+# is exactly one copy of the number.
 DEFAULT_MAX_PAYLOAD_SIZE = 65_507
 
 
@@ -59,3 +62,19 @@ class Config:
     # startup jitter so co-booted nodes desynchronise their rounds
     # (the reference left this as a TODO, ticker.py:27-28).
     gossip_jitter: float = 0.0
+    # New in aiocluster_tpu: persistent peer channels. When True (the
+    # default) the initiator keeps gossip connections in a per-peer pool
+    # and the responder serves successive handshakes on one connection;
+    # wire format AND lifecycle interop with close-per-handshake peers
+    # (the reference) is preserved — EOF after an Ack is a normal close,
+    # and a pooled connection found dead is retried once on a fresh one.
+    # False restores the reference's connect/teardown-per-round lifecycle.
+    persistent_connections: bool = True
+    # Idle pooled connections beyond this per-peer count are closed on
+    # release (borrowed connections are not bounded: concurrent
+    # handshakes to one peer are rare and short).
+    pool_max_idle_per_peer: int = 2
+    # Seconds an idle pooled connection survives between uses; the
+    # responder waits the same window for the next Syn on a persistent
+    # connection before closing it.
+    pool_idle_timeout: float = 60.0

@@ -15,10 +15,29 @@ Parity: reference state.py:106-287 (``NodeState``). Semantics preserved:
   evidence of liveness (reference state.py:280-287).
 
 All time-dependent methods accept ``ts`` for deterministic tests.
+
+Two performance structures ride along (both invisible to the semantics
+above):
+
+- A **version index** — ``(version, key)`` pairs in increasing version
+  order. Versions are monotonic (the owner claims ``max_version + 1``
+  per write; replicas receive version-ordered delta prefixes), so writes
+  append in order and ``stale_key_values(floor)`` is a bisect plus a
+  tail walk instead of a full keyspace scan. Entries for re-written or
+  GC'd keys go stale in place and are filtered lazily; an out-of-order
+  install or wholesale ``key_values`` replacement just marks the index
+  dirty for a lazy rebuild.
+- A **digest-change hook** (``_on_change``, wired by ClusterState):
+  fired whenever one of the three digest fields (heartbeat,
+  max_version, last_gc_version) changes, so the container can cache
+  per-node digests and rebuild only what moved. Direct field writes
+  (white-box tests) bypass the hook — pair them with
+  ``ClusterState.mark_dirty`` when a digest is computed afterwards.
 """
 
 from __future__ import annotations
 
+from bisect import bisect_right
 from collections.abc import Callable, Iterator
 from datetime import datetime, timedelta
 
@@ -33,7 +52,16 @@ KeyChangeFn = Callable[[NodeId, str, VersionedValue | None, VersionedValue], Non
 class NodeState:
     """Versioned key-value state for a single node (owner or replica)."""
 
-    __slots__ = ("key_values", "heartbeat", "max_version", "last_gc_version", "node")
+    __slots__ = (
+        "key_values",
+        "heartbeat",
+        "max_version",
+        "last_gc_version",
+        "node",
+        "_vindex",
+        "_vindex_dirty",
+        "_on_change",
+    )
 
     def __init__(
         self,
@@ -48,6 +76,31 @@ class NodeState:
         self.key_values: dict[str, VersionedValue] = key_values or {}
         self.max_version = max_version
         self.last_gc_version = last_gc_version
+        self._vindex: list[tuple[int, str]] = []
+        self._vindex_dirty = bool(self.key_values)
+        self._on_change: Callable[[], None] | None = None
+
+    def _touch(self) -> None:
+        """One of the digest fields changed; tell the container (if any)."""
+        cb = self._on_change
+        if cb is not None:
+            cb()
+
+    def _index_add(self, version: int, key: str) -> None:
+        """Record an installed key version. Appends in O(1) on the
+        monotonic fast path; anything out of order defers to a rebuild."""
+        if self._vindex_dirty:
+            return
+        if not self._vindex or version >= self._vindex[-1][0]:
+            self._vindex.append((version, key))
+        else:
+            self._vindex_dirty = True
+
+    def _rebuild_index(self) -> None:
+        self._vindex = sorted(
+            (vv.version, k) for k, vv in self.key_values.items()
+        )
+        self._vindex_dirty = False
 
     # -- reads --------------------------------------------------------------
 
@@ -65,9 +118,21 @@ class NodeState:
     def stale_key_values(
         self, floor_version: int
     ) -> Iterator[tuple[str, VersionedValue]]:
-        """Keys with versions strictly above ``floor_version``."""
-        for key, vv in self.key_values.items():
-            if vv.version > floor_version:
+        """Keys with versions strictly above ``floor_version``, in
+        increasing version order (bisect + tail walk over the version
+        index — O(log K + stale), not O(K))."""
+        # Rebuild when dirty, or when stale entries (re-written / GC'd
+        # keys left in place) outnumber the live keyspace — the lazy
+        # compaction that keeps the tail walk proportional to real work.
+        if self._vindex_dirty or len(self._vindex) > 2 * len(self.key_values) + 16:
+            self._rebuild_index()
+        idx = self._vindex
+        kvs = self.key_values
+        start = bisect_right(idx, floor_version, key=lambda e: e[0])
+        for i in range(start, len(idx)):
+            version, key = idx[i]
+            vv = kvs.get(key)
+            if vv is not None and vv.version == version:
                 yield key, vv
 
     def digest(self) -> NodeDigest:
@@ -98,11 +163,14 @@ class NodeState:
         """Install ``vv`` unless we already hold an equal-or-newer version.
         Always advances ``max_version`` (the owner has *seen* this version
         even when the key itself is stale)."""
-        self.max_version = max(self.max_version, vv.version)
+        if vv.version > self.max_version:
+            self.max_version = vv.version
+            self._touch()
         current = self.key_values.get(key)
         if current is not None and current.version >= vv.version:
             return
         self.key_values[key] = vv
+        self._index_add(vv.version, key)
 
     def set_with_ttl(self, key: str, value: str, ts: datetime | None = None) -> None:
         """Set a value that becomes GC-eligible after the grace period."""
@@ -129,6 +197,8 @@ class NodeState:
         vv.version = self.max_version
         vv.value = ""
         vv.status_change_ts = ts if ts is not None else utc_now()
+        self._index_add(vv.version, key)
+        self._touch()
 
     def delete_after_ttl(self, key: str, ts: datetime | None = None) -> None:
         """Schedule ``key`` for TTL deletion, keeping its value readable via
@@ -140,6 +210,8 @@ class NodeState:
         vv.status = KeyStatus.DELETE_AFTER_TTL
         vv.version = self.max_version
         vv.status_change_ts = ts if ts is not None else utc_now()
+        self._index_add(vv.version, key)
+        self._touch()
 
     # -- replica-side reconciliation ----------------------------------------
 
@@ -179,6 +251,12 @@ class NodeState:
             self.key_values = {}
             self.max_version = 0
             self.last_gc_version = node_delta.last_gc_version
+            # Wholesale replacement: the old index orders versions the
+            # rebuilt keyspace no longer follows — start empty so the
+            # reset delta's installs append monotonically again.
+            self._vindex = []
+            self._vindex_dirty = False
+            self._touch()
         elif node_delta.last_gc_version > self.last_gc_version:
             self.last_gc_version = node_delta.last_gc_version
             self.key_values = {
@@ -186,6 +264,7 @@ class NodeState:
                 for k, v in self.key_values.items()
                 if v.version > self.last_gc_version or not v.is_deleted()
             }
+            self._touch()
         for kv in node_delta.key_values:
             if kv.version <= self.max_version:
                 continue
@@ -201,8 +280,11 @@ class NodeState:
             self.set_versioned(kv.key, vv)
             if on_key_change is not None:
                 on_key_change(self.node, kv.key, existing, vv)
-        if node_delta.max_version is not None:
-            self.max_version = max(self.max_version, node_delta.max_version)
+        if node_delta.max_version is not None and (
+            node_delta.max_version > self.max_version
+        ):
+            self.max_version = node_delta.max_version
+            self._touch()
 
     # -- garbage collection ---------------------------------------------------
 
@@ -219,13 +301,21 @@ class NodeState:
                 survivors[key] = vv
             else:
                 watermark = max(watermark, vv.version)
-        self.key_values = survivors
-        self.last_gc_version = watermark
+        if len(survivors) != len(self.key_values) or (
+            watermark != self.last_gc_version
+        ):
+            # Purged keys leave stale index entries behind; the lazy
+            # filter in stale_key_values skips them and compaction
+            # reclaims them, so relative order stays valid.
+            self.key_values = survivors
+            self.last_gc_version = watermark
+            self._touch()
 
     # -- heartbeats -----------------------------------------------------------
 
     def inc_heartbeat(self) -> int:
         self.heartbeat += 1
+        self._touch()
         return self.heartbeat
 
     def apply_heartbeat(self, value: int) -> bool:
@@ -233,8 +323,11 @@ class NodeState:
         *increase* — the first observation just initialises the counter."""
         if self.heartbeat == 0:
             self.heartbeat = value
+            if value:
+                self._touch()
             return False
         if value > self.heartbeat:
             self.heartbeat = value
+            self._touch()
             return True
         return False

@@ -33,8 +33,19 @@ from ..wire import native as wire_native
 from .engine import GossipEngine
 from .hooks import HookDispatcher, HookStats
 from .peers import select_gossip_targets
+from .pool import ConnectionPool, PooledConnection
 from .ticker import Ticker
 from .transport import GossipTransport
+
+# Failure modes meaning "the peer ended the connection" — on a REUSED
+# pooled connection these are expected (close-per-handshake peers, idle
+# timeouts racing a borrow) and warrant one retry on a fresh dial.
+_PEER_CLOSED_ERRORS = (
+    asyncio.IncompleteReadError,
+    ConnectionResetError,
+    BrokenPipeError,
+    ConnectionAbortedError,
+)
 
 KeyChangeCallback = Callable[
     [NodeId, str, VersionedValue | None, VersionedValue], Awaitable[None]
@@ -125,6 +136,16 @@ class Cluster:
             tls_server_hostname=config.tls_server_hostname,
             metrics=self._metrics,
         )
+        self._pool = ConnectionPool(
+            self._transport.connect,
+            max_idle_per_peer=(
+                config.pool_max_idle_per_peer
+                if config.persistent_connections
+                else 0
+            ),
+            idle_timeout=config.pool_idle_timeout,
+            metrics=self._metrics,
+        )
         initial_delay = (
             self._rng.uniform(0, config.gossip_jitter * config.gossip_interval)
             if config.gossip_jitter > 0
@@ -148,6 +169,7 @@ class Cluster:
         self._prev_live: set[NodeId] = set()
 
         self._server: asyncio.Server | None = None
+        self._inbound: set[StreamWriter] = set()
         self._codec_warmup: asyncio.Task | None = None
         self._started = False
         self._closing = False
@@ -220,8 +242,20 @@ class Cluster:
             except Exception:
                 pass  # a failed warmup build is harmless: codec no-ops to pure Python
             self._codec_warmup = None
+        # Ticker is stopped, so no new borrows: close the idle pool
+        # before the server so peers see orderly FINs, not RSTs.
+        await self._pool.close()
         if self._server is not None:
             self._server.close()
+            # Persistent inbound channels may be parked waiting for their
+            # next Syn; close them so the handler tasks finish now rather
+            # than lingering for the idle window (on 3.12+ wait_closed
+            # would block on them). Each handler's finally joins its own
+            # writer; the join here covers a handler that already left.
+            for writer in list(self._inbound):
+                writer.close()
+                with suppress(Exception):
+                    await writer.wait_closed()
             await self._server.wait_closed()
             self._server = None
         await self._hooks.stop()
@@ -357,6 +391,7 @@ class Cluster:
         self._cluster_state.gc_marked_for_deletion(
             timedelta(seconds=self._config.marked_for_deletion_grace_period)
         )
+        await self._pool.evict_idle()
 
         # gather, not TaskGroup (3.11+): _gossip_with contains its own
         # failures, so plain fan-out-and-wait has identical semantics.
@@ -395,66 +430,145 @@ class Cluster:
     async def _gossip_with(
         self, host: str, port: int, label: str, tls_name: str | None = None
     ) -> None:
-        syn = self._engine.make_syn()
-        writer: StreamWriter | None = None
+        """One initiated handshake over a pooled connection.
+
+        A reused connection may have been closed by the peer since its
+        last handshake (close-per-handshake peers — the reference — do
+        this every time; idle timeouts race borrows): that surfaces as
+        EOF/reset on first use and is retried exactly once on a fresh
+        dial. A fresh connection failing the same way is a real peer
+        problem and is not retried.
+        """
         async with self._gossip_semaphore:
-            try:
-                reader, writer = await self._transport.connect(host, port, tls_name)
-                await self._transport.write_packet(writer, syn)
-                reply = await self._transport.read_packet(reader)
-                if isinstance(reply.msg, BadCluster):
-                    self._log.warning(
-                        f"Peer {host}:{port} rejected us: wrong cluster "
-                        f"(ours={self._config.cluster_id!r})"
+            for attempt in (0, 1):
+                conn: PooledConnection | None = None
+                reused = False
+                try:
+                    syn_bytes = self._engine.make_syn_bytes()
+                    # The retry (attempt 1) must actually redial: another
+                    # idle sibling of the connection that just died would
+                    # burn the retry on the same peer restart.
+                    conn = await self._pool.acquire(
+                        host, port, tls_name, fresh=attempt > 0
                     )
-                elif isinstance(reply.msg, SynAck):
-                    ack = self._engine.handle_synack(reply)
-                    await self._transport.write_packet(writer, ack)
-                else:
+                    reused = conn.reused
+                    await self._transport.write_framed(
+                        conn.writer, syn_bytes, "syn"
+                    )
+                    reply = await self._transport.read_packet(conn.reader)
+                    if isinstance(reply.msg, BadCluster):
+                        self._log.warning(
+                            f"Peer {host}:{port} rejected us: wrong cluster "
+                            f"(ours={self._config.cluster_id!r})"
+                        )
+                    elif isinstance(reply.msg, SynAck):
+                        ack = self._engine.handle_synack(reply)
+                        await self._transport.write_packet(conn.writer, ack)
+                        if self._config.persistent_connections:
+                            # Settled: the finally below must not discard.
+                            await self._pool.release(conn)
+                            conn = None
+                        # else: reference lifecycle — teardown per round,
+                        # via the finally's discard.
+                    else:
+                        self._log.debug(
+                            f"Unexpected gossip reply from {label} {host}:{port}"
+                        )
+                    return
+                except _PEER_CLOSED_ERRORS as exc:
+                    if reused and attempt == 0:
+                        # The pooled connection died between handshakes;
+                        # normal against close-per-handshake peers.
+                        self._pool.note_reconnect()
+                        continue
                     self._log.debug(
-                        f"Unexpected gossip reply from {label} {host}:{port}"
+                        f"Gossip with {label} {host}:{port} failed: {exc}"
                     )
-            except (TimeoutError, asyncio.TimeoutError, OSError,
-                asyncio.IncompleteReadError, ValueError) as exc:
-                self._log.debug(f"Gossip with {label} {host}:{port} failed: {exc}")
-            except Exception as exc:
-                self._log.exception(f"Gossip with {label} {host}:{port} errored: {exc}")
-            finally:
-                if writer is not None:
-                    writer.close()
-                    with suppress(Exception):
-                        await writer.wait_closed()
+                    return
+                except (TimeoutError, asyncio.TimeoutError, OSError,
+                        ValueError) as exc:
+                    self._log.debug(
+                        f"Gossip with {label} {host}:{port} failed: {exc}"
+                    )
+                    return
+                except Exception as exc:
+                    self._log.exception(
+                        f"Gossip with {label} {host}:{port} errored: {exc}"
+                    )
+                    return
+                finally:
+                    # Everything except a released connection — handshake
+                    # failures, BadCluster, per-round lifecycle, and
+                    # cancellation mid-handshake — closes here.
+                    if conn is not None:
+                        await self._pool.discard(conn)
 
     # -- responder side -------------------------------------------------------
 
     async def _handle_connection(
         self, reader: StreamReader, writer: StreamWriter
     ) -> None:
-        # Inbound traffic counts as activity for our own heartbeat.
-        self.self_node_state().inc_heartbeat()
+        """Serve Syn→SynAck→Ack handshakes on one inbound connection.
+
+        Persistent-channel peers send many handshakes back to back; the
+        loop waits up to the pool idle window for each next Syn.
+        Close-per-handshake peers (the reference) disconnect after the
+        Ack — EOF or a reset between handshakes is a normal close, not
+        an error. The first Syn gets only the ordinary read timeout: a
+        fresh connection that sends nothing is not worth holding.
+        """
+        handshakes = 0
+        self._inbound.add(writer)
         try:
-            packet = await self._transport.read_packet(reader)
-            if not isinstance(packet.msg, Syn):
-                self._log.debug("Unexpected first gossip message type")
-                return
-            if not self._verify_peer_tls_name(packet, writer):
-                self._log.warning("TLS peer identity verification failed")
-                return
-            reply = self._engine.handle_syn(packet)
-            await self._transport.write_packet(writer, reply)
-            if isinstance(reply.msg, BadCluster):
-                return
-            ack = await self._transport.read_packet(reader)
-            if not isinstance(ack.msg, Ack):
-                self._log.debug("Unexpected gossip ack message type")
-                return
-            self._engine.handle_ack(ack)
+            while True:
+                syn_wait = (
+                    self._config.pool_idle_timeout
+                    if handshakes and self._config.persistent_connections
+                    else None
+                )
+                try:
+                    packet = await self._transport.read_packet(
+                        reader, timeout=syn_wait
+                    )
+                except asyncio.IncompleteReadError as exc:
+                    if not exc.partial:
+                        return  # clean EOF between handshakes
+                    raise
+                except (TimeoutError, asyncio.TimeoutError):
+                    if handshakes:
+                        return  # idle persistent channel: close quietly
+                    raise
+                except ConnectionResetError:
+                    if handshakes:
+                        return  # peer tore the channel down mid-idle
+                    raise
+                # Inbound traffic counts as activity for our own heartbeat.
+                self.self_node_state().inc_heartbeat()
+                if not isinstance(packet.msg, Syn):
+                    self._log.debug("Unexpected first gossip message type")
+                    return
+                if not self._verify_peer_tls_name(packet, writer):
+                    self._log.warning("TLS peer identity verification failed")
+                    return
+                reply = self._engine.handle_syn(packet)
+                await self._transport.write_packet(writer, reply)
+                if isinstance(reply.msg, BadCluster):
+                    return
+                ack = await self._transport.read_packet(reader)
+                if not isinstance(ack.msg, Ack):
+                    self._log.debug("Unexpected gossip ack message type")
+                    return
+                self._engine.handle_ack(ack)
+                handshakes += 1
+                if not self._config.persistent_connections:
+                    return  # reference lifecycle: one handshake per conn
         except (TimeoutError, asyncio.TimeoutError, OSError,
                 asyncio.IncompleteReadError, ValueError) as exc:
             self._log.debug(f"Server gossip error: {exc}")
         except Exception as exc:
             self._log.exception(f"Server gossip exception: {exc}")
         finally:
+            self._inbound.discard(writer)
             writer.close()
             with suppress(Exception):
                 await writer.wait_closed()

@@ -17,6 +17,7 @@ from ..core.identity import NodeId
 from ..core.kvstate import KeyChangeFn
 from ..core.messages import Ack, BadCluster, Delta, Digest, Packet, Syn, SynAck
 from ..obs.registry import MetricsRegistry
+from ..wire import encode_packet
 
 
 def _delta_kv_count(delta: Delta) -> int:
@@ -42,7 +43,7 @@ class GossipEngine:
         # reconciliation payload itself — key-version updates sent vs
         # applied (the transport counts the wire bytes; this counts the
         # anti-entropy work those bytes bought).
-        self._steps = self._delta_kvs = None
+        self._steps = self._delta_kvs = self._digest_events = None
         if metrics is not None:
             self._steps = metrics.counter(
                 "aiocluster_handshake_steps_total",
@@ -54,6 +55,17 @@ class GossipEngine:
                 "Key-version updates carried by deltas, sent vs applied",
                 labels=("direction",),
             )
+            self._digest_events = metrics.counter(
+                "aiocluster_digest_cache_events_total",
+                "Incremental digest cache activity (rebuild/hit/reuse, "
+                "plus encoded-Syn byte cache encode/reuse)",
+                labels=("event",),
+            )
+        # Cached encoded Syn packet, keyed by (digest epoch, excluded
+        # set): between quiescent rounds — and across the several targets
+        # of one round — the identical bytes go out without re-encoding.
+        self._syn_cache: tuple[int, frozenset[NodeId], bytes] | None = None
+        self._digest_stats_exported: dict[str, int] = {}
 
     def _note(self, step: str, sent: Delta | None = None,
               applied: Delta | None = None) -> None:
@@ -71,7 +83,20 @@ class GossipEngine:
         return set(self._fd.scheduled_for_deletion_nodes())
 
     def _self_digest(self, excluded: set[NodeId]) -> Digest:
-        return self._state.compute_digest(excluded)
+        digest = self._state.compute_digest(excluded)
+        self._sync_digest_metrics()
+        return digest
+
+    def _sync_digest_metrics(self) -> None:
+        """Export ClusterState's plain digest-cache counters (core/ is
+        dependency-free and can't import obs/) as registry counter deltas."""
+        if self._digest_events is None:
+            return
+        for event, value in self._state.digest_cache_stats.items():
+            prev = self._digest_stats_exported.get(event, 0)
+            if value > prev:
+                self._digest_events.labels(event).inc(value - prev)
+                self._digest_stats_exported[event] = value
 
     def _observe_digest(self, digest: Digest) -> None:
         """Heartbeats piggyback on digests; every one we see feeds the
@@ -91,6 +116,28 @@ class GossipEngine:
         return Packet(
             self._config.cluster_id, Syn(self._self_digest(self._excluded()))
         )
+
+    def make_syn_bytes(self) -> bytes:
+        """Initiator step 1, pre-encoded: the wire bytes of ``make_syn()``'s
+        packet (unframed). Cached while the digest epoch and excluded set
+        are unchanged, so a quiescent node re-sends the identical bytes —
+        to every target of a round, and across rounds — with zero encode
+        work. The transport frames and counts them via ``write_framed``."""
+        self._note("make_syn")
+        excluded = self._excluded()
+        key = (self._state.digest_epoch, frozenset(excluded))
+        cached = self._syn_cache
+        if cached is not None and (cached[0], cached[1]) == key:
+            if self._digest_events is not None:
+                self._digest_events.labels("syn_encode_reuse").inc()
+            return cached[2]
+        raw = encode_packet(
+            Packet(self._config.cluster_id, Syn(self._self_digest(excluded)))
+        )
+        self._syn_cache = (key[0], key[1], raw)
+        if self._digest_events is not None:
+            self._digest_events.labels("syn_encode").inc()
+        return raw
 
     def handle_syn(self, packet: Packet) -> Packet:
         """Responder step: answer a Syn with our digest plus the delta the

@@ -1,0 +1,198 @@
+"""Persistent per-peer connection pool for the gossip fast path.
+
+The reference pays a full TCP connect/teardown per gossip handshake
+(reference server.py:389-405); at a 64-node population that connect —
+not the reconciliation work — dominates round latency. The pool keeps
+completed-handshake connections keyed by ``(host, port, tls_name)`` and
+hands them back on the next round:
+
+- **borrow/return**: ``acquire`` pops the most recently used idle
+  connection (LIFO keeps the hot socket hot and lets the cold ones age
+  out) or dials a new one; ``release`` returns it, closing overflow
+  beyond ``max_idle_per_peer``.
+- **staleness**: a close-per-handshake peer (the reference) will have
+  closed the pooled connection by the next borrow. Connections that
+  already signal EOF/closing are evicted at borrow time; the race where
+  the peer's FIN is still in flight surfaces as an EOF on first use,
+  which the caller retries once on a fresh connection
+  (``PooledConnection.reused`` says whether the retry is warranted).
+- **idle eviction**: ``evict_idle`` (called once per gossip round)
+  closes connections unused for ``idle_timeout`` seconds, matching the
+  responder's own idle window so both ends agree on lifetime.
+- **metrics**: ``aiocluster_pool_connections_open`` (gauge) and
+  ``aiocluster_pool_events_total{event=hit|miss|reconnect|stale|
+  evicted|discarded}`` (counter).
+
+The pool never reads or writes the sockets beyond closing them — the
+wire protocol stays entirely in transport/engine, so pooled and
+unpooled nodes are indistinguishable on the wire.
+"""
+
+from __future__ import annotations
+
+import time
+from asyncio import StreamReader, StreamWriter
+from collections import deque
+from collections.abc import Awaitable, Callable
+from contextlib import suppress
+from dataclasses import dataclass, field
+
+from ..obs.registry import MetricsRegistry
+
+PoolKey = tuple[str, int, str | None]
+ConnectFn = Callable[[str, int, str | None], Awaitable[tuple[StreamReader, StreamWriter]]]
+
+
+@dataclass
+class PooledConnection:
+    """One borrowed or idle gossip connection."""
+
+    key: PoolKey
+    reader: StreamReader
+    writer: StreamWriter
+    reused: bool = False
+    last_used: float = field(default_factory=time.monotonic)
+
+    def is_dead(self) -> bool:
+        """Best-effort liveness: a peer's processed FIN/RST shows up as
+        reader EOF or a closing transport without any I/O."""
+        return self.writer.is_closing() or self.reader.at_eof()
+
+
+class ConnectionPool:
+    """Bounded per-peer pool of gossip connections (see module docstring)."""
+
+    def __init__(
+        self,
+        connect: ConnectFn,
+        *,
+        max_idle_per_peer: int = 2,
+        idle_timeout: float = 60.0,
+        metrics: MetricsRegistry | None = None,
+    ) -> None:
+        self._connect = connect
+        self._max_idle_per_peer = max(0, max_idle_per_peer)
+        self._idle_timeout = idle_timeout
+        self._idle: dict[PoolKey, deque[PooledConnection]] = {}
+        self._open = 0
+        self._closed = False
+        self._open_gauge = self._events = None
+        if metrics is not None:
+            self._open_gauge = metrics.gauge(
+                "aiocluster_pool_connections_open",
+                "Pooled gossip connections currently open (idle + borrowed)",
+            )
+            self._events = metrics.counter(
+                "aiocluster_pool_events_total",
+                "Connection pool activity, by event",
+                labels=("event",),
+            )
+
+    # -- internals ------------------------------------------------------------
+
+    def _note(self, event: str) -> None:
+        if self._events is not None:
+            self._events.labels(event).inc()
+
+    def _track_open(self, delta: int) -> None:
+        self._open += delta
+        if self._open_gauge is not None:
+            self._open_gauge.set(self._open)
+
+    async def _close_conn(self, conn: PooledConnection, event: str) -> None:
+        self._track_open(-1)
+        self._note(event)
+        conn.writer.close()
+        with suppress(Exception):
+            await conn.writer.wait_closed()
+
+    # -- borrow / return ------------------------------------------------------
+
+    @property
+    def open_connections(self) -> int:
+        return self._open
+
+    def idle_connections(self) -> int:
+        return sum(len(q) for q in self._idle.values())
+
+    async def acquire(
+        self,
+        host: str,
+        port: int,
+        tls_name: str | None = None,
+        *,
+        fresh: bool = False,
+    ) -> PooledConnection:
+        """Borrow a connection to ``(host, port)``: the freshest live
+        idle one, else a new dial. The caller owns it until ``release``
+        or ``discard``. ``fresh=True`` (the EOF-retry path) flushes any
+        remaining idle connections for the peer and always dials — a
+        reused connection just died, so its idle siblings predate the
+        same peer restart and must not consume the retry."""
+        key: PoolKey = (host, port, tls_name)
+        queue = self._idle.get(key)
+        while queue:
+            if fresh:
+                await self._close_conn(queue.pop(), "stale")
+                continue
+            conn = queue.pop()
+            if conn.is_dead():
+                await self._close_conn(conn, "stale")
+                continue
+            conn.reused = True
+            self._note("hit")
+            return conn
+        self._note("miss")
+        reader, writer = await self._connect(host, port, tls_name)
+        self._track_open(+1)
+        return PooledConnection(key, reader, writer)
+
+    async def release(self, conn: PooledConnection) -> None:
+        """Return a healthy connection to the idle pool (closing it
+        instead if the pool is closed, the connection died in flight, or
+        the per-peer idle bound is reached)."""
+        if self._closed or conn.is_dead():
+            await self._close_conn(conn, "discarded")
+            return
+        conn.last_used = time.monotonic()
+        conn.reused = False
+        queue = self._idle.setdefault(conn.key, deque())
+        queue.append(conn)
+        while len(queue) > self._max_idle_per_peer:
+            await self._close_conn(queue.popleft(), "evicted")
+
+    async def discard(self, conn: PooledConnection) -> None:
+        """Close a borrowed connection that failed mid-handshake."""
+        await self._close_conn(conn, "discarded")
+
+    def note_reconnect(self) -> None:
+        """Record that a reused connection died on first use and the
+        handshake is retrying on a fresh dial."""
+        self._note("reconnect")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def evict_idle(self, now: float | None = None) -> int:
+        """Close idle connections unused for ``idle_timeout`` seconds.
+        Returns how many were evicted. Cheap when nothing is idle — the
+        gossip round calls this once per tick."""
+        now = time.monotonic() if now is None else now
+        evicted = 0
+        for key in list(self._idle):
+            queue = self._idle[key]
+            # Oldest sit at the left (LIFO reuse from the right).
+            while queue and now - queue[0].last_used > self._idle_timeout:
+                await self._close_conn(queue.popleft(), "evicted")
+                evicted += 1
+            if not queue:
+                del self._idle[key]
+        return evicted
+
+    async def close(self) -> None:
+        """Close every idle connection and refuse future pooling
+        (borrowed connections close via their in-flight release)."""
+        self._closed = True
+        for queue in self._idle.values():
+            while queue:
+                await self._close_conn(queue.pop(), "evicted")
+        self._idle.clear()

@@ -105,9 +105,16 @@ class GossipTransport:
 
     # -- framed packet I/O ----------------------------------------------------
 
-    async def read_packet(self, reader: StreamReader) -> Packet:
+    async def read_packet(
+        self, reader: StreamReader, timeout: float | None = None
+    ) -> Packet:
+        """Read one framed packet. ``timeout`` overrides the configured
+        read timeout for the header wait only — the server loop waits
+        longer between handshakes on a persistent connection than it
+        would mid-handshake."""
         header = await asyncio.wait_for(
-            reader.readexactly(HEADER_SIZE), timeout=self._read_timeout
+            reader.readexactly(HEADER_SIZE),
+            timeout=self._read_timeout if timeout is None else timeout,
         )
         size = read_frame_size(header)
         if size <= 0 or size > self._max_payload_size:
@@ -124,8 +131,20 @@ class GossipTransport:
 
     async def write_packet(self, writer: StreamWriter, packet: Packet) -> None:
         raw = frame(encode_packet(packet))
+        await self._write_raw(writer, raw, type(packet.msg).__name__.lower())
+
+    async def write_framed(
+        self, writer: StreamWriter, payload: bytes, kind: str
+    ) -> None:
+        """Write an already-encoded packet body (the engine's cached Syn
+        bytes), framing it here. ``kind`` labels the packet metrics the
+        same way ``write_packet`` derives from the message type."""
+        await self._write_raw(writer, frame(payload), kind)
+
+    async def _write_raw(
+        self, writer: StreamWriter, raw: bytes, kind: str
+    ) -> None:
         if self._packets is not None:
-            kind = type(packet.msg).__name__.lower()
             self._packets.labels(kind, "out").inc()
             self._bytes.labels(kind, "out").inc(len(raw))
         writer.write(raw)

@@ -264,6 +264,36 @@ def encode_node_digest(nd: NodeDigest) -> bytes:
     return bytes(out)
 
 
+@functools.lru_cache(maxsize=65536)
+def _encode_digest_entry(nd: NodeDigest) -> bytes:
+    """One complete digest entry — field-1 tag + length + NodeDigest body
+    — memoized on the (frozen, hashable) NodeDigest. ClusterState's
+    incremental digest cache hands back the same per-node entries until
+    a node's heartbeat/version moves, so a population-sized digest
+    encode is ~all dict hits with one real encode per changed node.
+    Changed entries churn through the LRU (heartbeats are monotonic),
+    but the stable majority stays hot; eviction degrades to the uncached
+    cost, never beyond. Byte-identical to the encode_node_digest framing
+    (differential-tested)."""
+    nid = encode_node_id(nd.node_id)  # memoized bytes
+    hb, lgc, mv = nd.heartbeat, nd.last_gc_version, nd.max_version
+    body_len = 1 + varint_size(len(nid)) + len(nid)
+    if hb:
+        body_len += 1 + varint_size(hb)
+    if lgc:
+        body_len += 1 + varint_size(lgc)
+    if mv:
+        body_len += 1 + varint_size(mv)
+    out = bytearray()
+    out.append(1 << 3 | _LEN)
+    out += _uvarint(body_len)
+    _field_msg(out, 1, nid)
+    _field_varint(out, 2, hb)
+    _field_varint(out, 3, lgc)
+    _field_varint(out, 4, mv)
+    return bytes(out)
+
+
 def decode_node_digest(body: bytes) -> NodeDigest:
     r = _Reader(body)
     node_id = NodeId("", 0, ("", 0))
@@ -386,28 +416,13 @@ def decode_node_delta(body: bytes) -> NodeDelta:
 
 
 def encode_digest(digest: Digest) -> bytes:
-    """Hot path (the decode_digest note applies): each entry's length
-    is computed arithmetically and the fields are emitted straight into
-    ONE output buffer — no per-entry bytearray or bytes copy. Emission
-    is byte-identical to _field_msg(out, 1, encode_node_digest(nd)),
-    which remains the single-entry oracle (differential-tested)."""
+    """Hot path: one memoized entry-bytes lookup per node (see
+    _encode_digest_entry) concatenated into one buffer. Emission is
+    byte-identical to _field_msg(out, 1, encode_node_digest(nd)), which
+    remains the single-entry oracle (differential-tested)."""
     out = bytearray()
     for nd in digest.node_digests.values():
-        nid = encode_node_id(nd.node_id)  # memoized bytes
-        hb, lgc, mv = nd.heartbeat, nd.last_gc_version, nd.max_version
-        body_len = 1 + varint_size(len(nid)) + len(nid)
-        if hb:
-            body_len += 1 + varint_size(hb)
-        if lgc:
-            body_len += 1 + varint_size(lgc)
-        if mv:
-            body_len += 1 + varint_size(mv)
-        out.append(1 << 3 | _LEN)
-        out += _uvarint(body_len)
-        _field_msg(out, 1, nid)
-        _field_varint(out, 2, hb)
-        _field_varint(out, 3, lgc)
-        _field_varint(out, 4, mv)
+        out += _encode_digest_entry(nd)
     return bytes(out)
 
 
@@ -415,15 +430,46 @@ def encode_digest(digest: Digest) -> bytes:
 # (degenerate but legal); NodeId is frozen, so one instance is safe.
 _EMPTY_NODE_ID = NodeId("", 0, ("", 0))
 
+# Only small entry bodies are cache-eligible — the same reasoning (and
+# the same bound) as _NODE_ID_CACHE_MAX_BODY: the key is PEER-CONTROLLED
+# bytes, honest entries are tens of bytes, and junk can at worst evict
+# down to the uncached baseline.
+_DIGEST_ENTRY_CACHE_MAX_BODY = 256
+
+
+@functools.lru_cache(maxsize=65536)
+def _decode_digest_entry_cached(body: bytes) -> NodeDigest:
+    """Memoized single-entry decode: a peer's digest entry for a node
+    repeats byte-for-byte every handshake until that node's heartbeat or
+    versions move, so steady-state digest decodes are ~all dict hits.
+    NodeDigest is frozen; sharing one object per distinct encoding is
+    safe. Mirrors decode_node_digest exactly (the oracle)."""
+    r = _Reader(body)
+    node_id = _EMPTY_NODE_ID
+    heartbeat = last_gc = max_version = 0
+    while not r.at_end():
+        ef, ewt = r.field()
+        if ef == 1 and ewt == _LEN:
+            node_id = decode_node_id(r.chunk())
+        elif ef == 2 and ewt == _VARINT:
+            heartbeat = r.varint()
+        elif ef == 3 and ewt == _VARINT:
+            last_gc = r.varint()
+        elif ef == 4 and ewt == _VARINT:
+            max_version = r.varint()
+        else:
+            r.skip(ewt)
+    return NodeDigest(node_id, heartbeat, last_gc, max_version)
+
 
 def decode_digest(body: bytes) -> Digest:
     """Hot path: every handshake carries one or two digests with an
-    entry per known node. Entries are parsed in a WINDOW of the one
-    top-level reader (no per-entry bytes copy, no second _Reader
-    object) — ~equivalent bytes-in to the generic decode_node_digest,
-    whose behavior this mirrors exactly (same _Reader primitives, same
-    WireError cases; decode_node_digest remains the single-entry API
-    and the differential-test oracle)."""
+    entry per known node. Small entries (every honest one) go through
+    the memoized single-entry decode above — one bytes-slice + dict hit
+    per unchanged entry; oversized entries are parsed in a WINDOW of
+    the one top-level reader. Both mirror decode_node_digest exactly
+    (same _Reader primitives, same WireError cases; decode_node_digest
+    remains the single-entry API and the differential-test oracle)."""
     r = _Reader(body)
     digests: dict[NodeId, NodeDigest] = {}
     while not r.at_end():
@@ -433,6 +479,11 @@ def decode_digest(body: bytes) -> Digest:
             entry_end = r.pos + n
             if entry_end > r.end:
                 raise WireError("truncated length-delimited field")
+            if n <= _DIGEST_ENTRY_CACHE_MAX_BODY:
+                nd = _decode_digest_entry_cached(r.buf[r.pos:entry_end])
+                r.pos = entry_end
+                digests[nd.node_id] = nd
+                continue
             node_id = _EMPTY_NODE_ID
             heartbeat = last_gc = max_version = 0
             outer_end = r.end

@@ -544,6 +544,17 @@ def measured_reference_baseline(log) -> dict | None:
     )
 
 
+def runtime_handshake_bench(log) -> dict | None:
+    """The asyncio-backend fast-path trajectory datum: back-to-back
+    Syn→SynAck→Ack handshakes/s at a 64-node view, no gossip-interval
+    floor (benchmarks/handshake_bench.py) — pooled persistent channels
+    vs the reference's connect-per-round lifecycle on the same code.
+    Cheap (a few seconds, loopback only, no device), so it rides every
+    record including smoke: the perf trajectory tracks the runtime
+    backend, not only the sim."""
+    return _run_benchmarks_helper("handshake_bench", "measure", log, log=log)
+
+
 # Hard cap on the stdout record line. Round 3's full record grew to
 # ~4.5 KB and the driver's capture kept only an unparseable tail
 # (BENCH_r03.json "parsed": null); the compact line stays ~an order of
@@ -556,6 +567,7 @@ STDOUT_LINE_CAP = 2000
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
     "budget",
+    "runtime_handshakes_per_sec_per_round",
     "full_profile_n",
     "full_profile_r",
     "northstar_projected_v5e8_s",
@@ -590,10 +602,17 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
     ) or {}
     lo = ex.get("last_onchip") or {}
     lo_rec = lo.get("record") or {}
+    hs = ex.get("runtime_handshake_bench") or {}
     extra = {
         "platform": ex.get("platform"),
         "analyze_clean": ex.get("analyze_clean"),
         "analyze_findings": ex.get("analyze_findings"),
+        "runtime_handshakes_per_sec": (hs.get("pooled") or {}).get(
+            "handshakes_per_sec"
+        ),
+        "runtime_handshakes_per_sec_per_round": (
+            hs.get("per_round") or {}
+        ).get("handshakes_per_sec"),
         "rounds_to_convergence": ex.get("rounds_to_convergence"),
         "pallas_variant": ex.get("pallas_variant_engaged"),
         "pallas_speedup": ex.get("pallas_speedup"),
@@ -1138,6 +1157,8 @@ def main() -> None:
                     note_boundary(probe_n, False)
         anchored = None if args.smoke else anchored_asyncio_seconds(log)
         ref_measured = None if args.smoke else measured_reference_baseline(log)
+        # Cheap and device-free: measured on every record, smoke included.
+        hs_bench = runtime_handshake_bench(log)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1181,6 +1202,10 @@ def main() -> None:
                 # compute-bound ceiling — the extrapolated vs_baseline
                 # above now sits next to a measured datum.
                 "measured_reference_library": ref_measured,
+                # The asyncio fast path, floored-interval-free: pooled
+                # persistent channels vs connect-per-round on the same
+                # 64-node view (benchmarks/handshake_bench.py).
+                "runtime_handshake_bench": hs_bench,
                 # Round-4 flagship: the measured (mesh-certified) 100k
                 # rounds-to-convergence + its v5e-8 projection.
                 "northstar_100k": load_northstar_record(log),

@@ -1,0 +1,20 @@
+"""TN: every writer close is joined with an awaited wait_closed — the
+suppress wrapper and a wait_for-bounded join both count."""
+
+import asyncio
+from contextlib import suppress
+
+
+async def clean(host, port):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(b"ping")
+    await writer.drain()
+    await reader.read(4)
+    writer.close()
+    with suppress(Exception):
+        await writer.wait_closed()
+
+
+async def clean_bounded(conn):
+    conn.writer.close()
+    await asyncio.wait_for(conn.writer.wait_closed(), timeout=3.0)

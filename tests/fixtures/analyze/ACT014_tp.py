@@ -1,0 +1,12 @@
+"""TP: the stream writer is closed but the close is never joined — the
+transport (and its fd) lingers until GC."""
+
+import asyncio
+
+
+async def leak(host, port):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(b"ping")
+    await writer.drain()
+    await reader.read(4)
+    writer.close()

@@ -33,6 +33,7 @@ async def _request(port: int, method: str, path: str) -> tuple[str, str]:
             length = int(line.split(":")[1])
     body = (await reader.readexactly(length)).decode()
     writer.close()
+    await writer.wait_closed()
     return status_line.split(" ", 1)[1].strip(), body
 
 

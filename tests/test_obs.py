@@ -157,6 +157,7 @@ async def test_metrics_http_endpoint():
         await writer.drain()
         raw = await reader.read(-1)
         writer.close()
+        await writer.wait_closed()
         text = raw.decode()
         assert "200 OK" in text
         assert "served_total 4" in text
@@ -166,6 +167,7 @@ async def test_metrics_http_endpoint():
         await writer.drain()
         raw = await reader.read(-1)
         writer.close()
+        await writer.wait_closed()
         assert "404" in raw.decode()
     finally:
         await server.stop()

@@ -1,0 +1,261 @@
+"""Persistent peer channels: connection pool units, the pooled gossip
+fast path, and the connection-lifecycle interop regression (a pooled
+node and a close-per-handshake node — the reference's lifecycle — must
+converge in both directions; ISSUE 3)."""
+
+import asyncio
+
+import pytest
+from conftest import wait_for
+
+from aiocluster_tpu import Cluster, Config, NodeId
+from aiocluster_tpu.obs import MetricsRegistry
+from aiocluster_tpu.runtime.pool import ConnectionPool
+
+
+# -- pool units (fake transport) ----------------------------------------------
+
+
+class FakeWriter:
+    def __init__(self) -> None:
+        self.closed = False
+
+    def is_closing(self) -> bool:
+        return self.closed
+
+    def close(self) -> None:
+        self.closed = True
+
+    async def wait_closed(self) -> None:
+        pass
+
+
+class FakeReader:
+    def __init__(self) -> None:
+        self.eof = False
+
+    def at_eof(self) -> bool:
+        return self.eof
+
+
+def make_pool(**kwargs):
+    dialed = []
+
+    async def connect(host, port, tls_name=None):
+        conn = (FakeReader(), FakeWriter())
+        dialed.append(conn)
+        return conn
+
+    return ConnectionPool(connect, **kwargs), dialed
+
+
+async def test_pool_reuses_released_connection():
+    pool, dialed = make_pool()
+    c1 = await pool.acquire("h", 1)
+    assert not c1.reused and len(dialed) == 1
+    await pool.release(c1)
+    c2 = await pool.acquire("h", 1)
+    assert c2 is c1 and c2.reused
+    assert len(dialed) == 1  # no second dial
+    assert pool.open_connections == 1
+
+
+async def test_pool_keys_on_host_port_tls():
+    pool, dialed = make_pool()
+    a = await pool.acquire("h", 1)
+    await pool.release(a)
+    b = await pool.acquire("h", 1, tls_name="other")  # different key
+    assert b is not a and len(dialed) == 2
+
+
+async def test_pool_evicts_dead_idle_connection_on_borrow():
+    pool, dialed = make_pool(metrics=MetricsRegistry())
+    c1 = await pool.acquire("h", 1)
+    await pool.release(c1)
+    c1.reader.eof = True  # the peer closed it while idle
+    c2 = await pool.acquire("h", 1)
+    assert c2 is not c1 and not c2.reused
+    assert len(dialed) == 2
+    assert pool.open_connections == 1  # the dead one was closed
+
+
+async def test_pool_bounds_idle_per_peer():
+    pool, dialed = make_pool(max_idle_per_peer=1)
+    a = await pool.acquire("h", 1)
+    b = await pool.acquire("h", 1)  # concurrent borrow: second dial
+    await pool.release(a)
+    await pool.release(b)
+    assert pool.idle_connections() == 1
+    assert a.writer.closed  # oldest idle evicted
+    assert not b.writer.closed
+
+
+async def test_pool_idle_timeout_eviction():
+    pool, dialed = make_pool(idle_timeout=10.0)
+    c = await pool.acquire("h", 1)
+    await pool.release(c)
+    assert await pool.evict_idle(now=c.last_used + 5.0) == 0
+    assert await pool.evict_idle(now=c.last_used + 11.0) == 1
+    assert c.writer.closed and pool.idle_connections() == 0
+
+
+async def test_pool_close_refuses_further_pooling():
+    pool, dialed = make_pool()
+    c = await pool.acquire("h", 1)
+    held = await pool.acquire("h", 1)
+    await pool.release(c)
+    await pool.close()
+    assert c.writer.closed
+    await pool.release(held)  # in-flight release after close: closed too
+    assert held.writer.closed
+    assert pool.open_connections == 0
+
+
+# -- pooled gossip fast path ---------------------------------------------------
+
+
+def _mk_cluster(name, port, peer_port, *, persistent=True, metrics=None,
+                **cfg_kwargs):
+    return Cluster(
+        Config(
+            node_id=NodeId(name=name, gossip_advertise_addr=("127.0.0.1", port)),
+            cluster_id="pooltest",
+            gossip_interval=0.02,
+            seed_nodes=[("127.0.0.1", peer_port)],
+            persistent_connections=persistent,
+            **cfg_kwargs,
+        ),
+        initial_key_values={f"from-{name}": name},
+        metrics=metrics,
+    )
+
+
+def _pool_events(reg: MetricsRegistry) -> dict:
+    return {
+        key.split("event=")[1].rstrip("}"): int(v)
+        for key, v in reg.snapshot().items()
+        if key.startswith("aiocluster_pool_events_total{")
+    }
+
+
+def _replicated(cluster, peer_name: str, key: str) -> bool:
+    for n, s in cluster.snapshot().node_states.items():
+        if n.name == peer_name and s.get(key) is not None:
+            return True
+    return False
+
+
+async def test_pooled_nodes_reuse_connections(free_port_factory):
+    p1, p2 = free_port_factory(), free_port_factory()
+    r1 = MetricsRegistry()
+    c1 = _mk_cluster("one", p1, p2, metrics=r1)
+    c2 = _mk_cluster("two", p2, p1, metrics=MetricsRegistry())
+    async with c1, c2:
+        await wait_for(lambda: _replicated(c1, "two", "from-two"))
+        await wait_for(lambda: _replicated(c2, "one", "from-one"))
+        # Let several more rounds run over the (now established) channel.
+        # Early rounds may dial more than once (a live target and a seed
+        # pick can hit the same peer concurrently); steady state must be
+        # dominated by reuse.
+        await wait_for(
+            lambda: _pool_events(r1).get("hit", 0)
+            >= _pool_events(r1).get("miss", 0) + 5,
+            timeout=4.0,
+        )
+    ev = _pool_events(r1)
+    assert ev.get("hit", 0) > ev.get("miss", 0)
+
+
+async def test_cluster_close_does_not_hang_with_parked_channels(
+    free_port_factory,
+):
+    """A pooled peer parks its inbound channel waiting for the next Syn
+    (up to pool_idle_timeout); close() must not wait that window out."""
+    p1, p2 = free_port_factory(), free_port_factory()
+    c1 = _mk_cluster("one", p1, p2, pool_idle_timeout=60.0)
+    c2 = _mk_cluster("two", p2, p1, pool_idle_timeout=60.0)
+    async with c1, c2:
+        await wait_for(lambda: _replicated(c1, "two", "from-two"))
+        start = asyncio.get_event_loop().time()
+        await c2.close()
+        assert asyncio.get_event_loop().time() - start < 5.0
+
+
+# -- connection-lifecycle interop (ISSUE 3 regression) -------------------------
+
+
+@pytest.mark.parametrize(
+    "initiator_persistent,responder_persistent",
+    [(True, False), (False, True)],
+    ids=["pooled-vs-close-per-round", "close-per-round-vs-pooled"],
+)
+async def test_lifecycle_interop_both_directions(
+    free_port_factory, initiator_persistent, responder_persistent
+):
+    """A pooled node completes Syn→SynAck→Ack against a peer that closes
+    the connection after every handshake (the reference lifecycle), and
+    vice versa: wire format AND connection lifecycle interoperate — EOF
+    after an Ack is a normal close, and a pooled borrow that lands on a
+    peer-closed connection retries once on a fresh dial."""
+    p1, p2 = free_port_factory(), free_port_factory()
+    r1 = MetricsRegistry()
+    c1 = _mk_cluster("one", p1, p2, persistent=initiator_persistent, metrics=r1)
+    c2 = _mk_cluster("two", p2, p1, persistent=responder_persistent,
+                     metrics=MetricsRegistry())
+    async with c1, c2:
+        # Full bidirectional replication through mixed-lifecycle handshakes.
+        await wait_for(lambda: _replicated(c1, "two", "from-two"), timeout=4.0)
+        await wait_for(lambda: _replicated(c2, "one", "from-one"), timeout=4.0)
+        # Liveness both ways (heartbeats keep flowing round after round).
+        await wait_for(
+            lambda: any(n.name == "two" for n in c1.snapshot().live_nodes),
+            timeout=4.0,
+        )
+        await wait_for(
+            lambda: any(n.name == "one" for n in c2.snapshot().live_nodes),
+            timeout=4.0,
+        )
+        # A live write still propagates across the lifecycle mismatch.
+        c1.set("late", "write")
+        await wait_for(lambda: _replicated(c2, "one", "late"), timeout=4.0)
+        if initiator_persistent:
+            # The pooled side keeps borrowing connections the reference-
+            # lifecycle side keeps closing. Depending on whether the
+            # peer's FIN is processed before the next borrow, that
+            # surfaces as a stale eviction at borrow OR an EOF-on-first-
+            # use reconnect — both prove the lifecycle recovery path, so
+            # accept either (asserting `reconnect` alone races the FIN
+            # and fails under CPU load).
+            def recovered() -> int:
+                ev = _pool_events(r1)
+                return ev.get("reconnect", 0) + ev.get("stale", 0)
+
+            await wait_for(lambda: recovered() >= 1, timeout=4.0)
+
+
+async def test_engine_syn_bytes_cache_quiescent(free_port_factory):
+    """Between rounds with no state change the engine re-serves the
+    identical encoded Syn bytes; any write invalidates them."""
+    from aiocluster_tpu.core import (
+        ClusterState,
+        FailureDetector,
+        FailureDetectorConfig,
+    )
+    from aiocluster_tpu.runtime.engine import GossipEngine
+    from aiocluster_tpu.wire import decode_packet
+
+    nid = NodeId("solo", 1, ("127.0.0.1", free_port_factory()))
+    cfg = Config(node_id=nid, cluster_id="syncache")
+    cs = ClusterState()
+    ns = cs.node_state_or_default(nid)
+    ns.set("k", "v")
+    engine = GossipEngine(cfg, cs, FailureDetector(FailureDetectorConfig()),
+                          metrics=MetricsRegistry())
+    first = engine.make_syn_bytes()
+    assert engine.make_syn_bytes() is first  # quiescent: cached bytes
+    assert cs.digest_cache_stats["rebuilds"] == 1  # one node, built once
+    ns.set("k", "v2")
+    second = engine.make_syn_bytes()
+    assert second is not first
+    pkt = decode_packet(second)
+    assert pkt.msg.digest.node_digests[nid].max_version == 2

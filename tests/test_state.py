@@ -321,3 +321,205 @@ def test_cluster_apply_delta_creates_nodes():
     assert cs.node_state(N1).get("a").value == "1"
     cs.remove_node(N1)
     assert cs.node_state(N1) is None
+
+
+# -- version index (stale_key_values fast path) --------------------------------
+
+
+def test_stale_key_values_is_version_ordered():
+    ns = NodeState(N1)
+    for i in range(8):
+        ns.set(f"k{i}", str(i), ts=T0)
+    got = list(ns.stale_key_values(3))
+    assert [vv.version for _, vv in got] == [4, 5, 6, 7, 8]
+    assert [k for k, _ in got] == ["k3", "k4", "k5", "k6", "k7"]
+
+
+def test_stale_key_values_skips_rewritten_and_gc_entries():
+    """Re-writing a key strands its old index entry; deleting then GCing
+    strands another. Neither may surface: only the live version of each
+    key appears, still in version order."""
+    ns = NodeState(N1)
+    ns.set("a", "1", ts=T0)   # v1 (stranded after rewrite)
+    ns.set("b", "x", ts=T0)   # v2
+    ns.set("a", "2", ts=T0)   # v3
+    ns.delete("b", ts=T0)     # v4 tombstone
+    ns.gc_marked_for_deletion(timedelta(0), ts=advance(T0, 1))  # purge b
+    got = list(ns.stale_key_values(0))
+    assert got == [("a", ns.get_versioned("a"))]
+    assert got[0][1].version == 3
+
+
+def test_stale_key_values_survives_out_of_order_installs():
+    """set_versioned below the index tail marks the index dirty; the
+    lazy rebuild restores exact version order."""
+    ns = NodeState(N1)
+    ns.set_with_version("hi", "x", 10, ts=T0)
+    ns.set_with_version("lo", "y", 4, ts=T0)  # out of order: index rebuild
+    assert [k for k, _ in ns.stale_key_values(0)] == ["lo", "hi"]
+    assert [k for k, _ in ns.stale_key_values(4)] == ["hi"]
+    assert list(ns.stale_key_values(10)) == []
+
+
+def test_version_index_compacts_after_churn():
+    """Hundreds of rewrites of one key must not leave the index growing
+    without bound (the 2x-live compaction threshold)."""
+    ns = NodeState(N1)
+    for i in range(300):
+        ns.set("hot", f"v{i}", ts=T0)
+    assert len(list(ns.stale_key_values(0))) == 1
+    assert len(ns._vindex) <= 2 * len(ns.key_values) + 16
+
+
+# -- MTU packing edges (ISSUE 3 satellite) -------------------------------------
+
+
+def _packed_size(delta: Delta) -> int:
+    return len(encode_delta(delta))
+
+
+def test_partial_delta_exact_mtu_boundary_packs_fully():
+    """An MTU of exactly the full encoded size must pack everything and
+    stamp completeness; one byte less must truncate and unstamp."""
+    cs = two_node_cluster()
+    full = cs.compute_partial_delta_respecting_mtu(Digest(), 65_507, set())
+    exact = _packed_size(full)
+    at_boundary = cs.compute_partial_delta_respecting_mtu(Digest(), exact, set())
+    assert _packed_size(at_boundary) == exact
+    assert all(nd.max_version is not None for nd in at_boundary.node_deltas)
+
+    below = cs.compute_partial_delta_respecting_mtu(Digest(), exact - 1, set())
+    assert _packed_size(below) <= exact - 1
+    assert sum(len(nd.key_values) for nd in below.node_deltas) < 3
+    truncated = [nd for nd in below.node_deltas
+                 if len(nd.key_values) < len(cs.node_state(nd.node_id).key_values)]
+    assert all(nd.max_version is None for nd in truncated)
+
+
+def test_partial_delta_gc_reset_restarts_from_floor_zero():
+    """The GC-watermark reset path: a peer whose knowledge predates our
+    watermark restarts at floor 0, and the reset delta round-trips the
+    codec carrying the watermark that triggers the receiver-side wipe."""
+    cs = ClusterState()
+    ns = cs.node_state_or_default(N1)
+    for i in range(4):
+        ns.set(f"k{i}", str(i), ts=T0)          # v1..v4
+    ns.delete("k0", ts=T0)                       # v5 tombstone
+    ns.gc_marked_for_deletion(timedelta(0), ts=advance(T0, 1))  # watermark 5
+    assert ns.last_gc_version == 5
+
+    peer = Digest()
+    peer.add_node(N1, heartbeat=1, last_gc_version=0, max_version=2)
+    delta = cs.compute_partial_delta_respecting_mtu(peer, 65_507, set())
+    (nd,) = delta.node_deltas
+    assert nd.from_version_excluded == 0          # reset, not an increment
+    assert nd.last_gc_version == 5
+
+    from aiocluster_tpu.wire import decode_delta
+
+    wire_nd = decode_delta(encode_delta(delta)).node_deltas[0]
+    replica = NodeState(N1)
+    replica.set_with_version("k0", "0", 1, ts=T0)
+    replica.set_with_version("k1", "1", 2, ts=T0)
+    replica.apply_delta(wire_nd, ts=T0)
+    # The stale pre-reset knowledge is gone; only the owner's live state remains.
+    assert replica.get("k0") is None
+    assert {k for k, _ in replica.stale_key_values(0)} == {"k1", "k2", "k3"}
+    assert replica.last_gc_version == 5
+    assert replica.max_version == ns.max_version
+
+
+def test_truncated_delta_round_trips_without_max_version():
+    """max_version=None (truncation) must survive the wire codec — the
+    optional-field presence bit is the lost-update fix — and the
+    receiver must not fast-forward past what it actually received."""
+    cs = ClusterState()
+    ns = cs.node_state_or_default(N1)
+    for i in range(6):
+        ns.set(f"key-{i}", "v" * 40, ts=T0)
+    full = cs.compute_partial_delta_respecting_mtu(Digest(), 65_507, set())
+    small_mtu = _packed_size(full) - 1
+    truncated = cs.compute_partial_delta_respecting_mtu(Digest(), small_mtu, set())
+    (nd,) = truncated.node_deltas
+    assert 0 < len(nd.key_values) < 6
+    assert nd.max_version is None
+
+    from aiocluster_tpu.wire import decode_delta
+
+    wire_nd = decode_delta(encode_delta(truncated)).node_deltas[0]
+    assert wire_nd.max_version is None            # presence survived the wire
+    replica = NodeState(N1)
+    replica.apply_delta(wire_nd, ts=T0)
+    assert replica.max_version == wire_nd.key_values[-1].version
+    assert replica.max_version < ns.max_version   # the gap is re-requestable
+
+    # Next round: the peer's digest (its real max) yields the remainder.
+    peer = Digest()
+    peer.add_node(N1, 1, replica.last_gc_version, replica.max_version)
+    rest = cs.compute_partial_delta_respecting_mtu(peer, 65_507, set())
+    for nd2 in rest.node_deltas:
+        replica.apply_delta(nd2, ts=T0)
+    assert replica.max_version == ns.max_version
+    assert {k for k, _ in replica.stale_key_values(0)} == set(ns.key_values)
+
+
+# -- incremental digest cache --------------------------------------------------
+
+
+def test_quiescent_digest_rebuilds_nothing():
+    """Two compute_digest calls with no interleaved mutation: the second
+    serves the SAME assembled Digest with zero per-node rebuilds (the
+    acceptance counter for the gossip fast path)."""
+    cs = two_node_cluster()
+    d1 = cs.compute_digest(set())
+    stats_after_first = dict(cs.digest_cache_stats)
+    d2 = cs.compute_digest(set())
+    assert d2 is d1  # whole-digest reuse
+    assert cs.digest_cache_stats["rebuilds"] == stats_after_first["rebuilds"]
+    assert cs.digest_cache_stats["reuses"] == stats_after_first["reuses"] + 1
+
+
+def test_digest_cache_rebuilds_only_dirty_nodes():
+    cs = two_node_cluster()
+    cs.compute_digest(set())
+    base = cs.digest_cache_stats["rebuilds"]
+    owner = cs.node_state_or_default(N1)  # N1 acting as its own owner here
+    owner.inc_heartbeat()  # dirties N1 only
+    d = cs.compute_digest(set())
+    assert cs.digest_cache_stats["rebuilds"] == base + 1
+    assert d.node_digests[N1].heartbeat == cs.node_state(N1).heartbeat
+
+
+def test_digest_cache_tracks_all_mutation_paths():
+    """Every digest-field mutation path invalidates: owner writes,
+    deletes, TTL, replica apply_delta, heartbeats, GC, removal."""
+    cs = ClusterState()
+    ns = cs.node_state_or_default(N1)
+    ns.set("a", "1", ts=T0)
+    assert cs.compute_digest(set()).node_digests[N1].max_version == 1
+    ns.delete("a", ts=T0)
+    assert cs.compute_digest(set()).node_digests[N1].max_version == 2
+    ns.set("b", "2", ts=T0)
+    ns.delete_after_ttl("b", ts=T0)
+    assert cs.compute_digest(set()).node_digests[N1].max_version == 4
+    ns.apply_heartbeat(9)
+    assert cs.compute_digest(set()).node_digests[N1].heartbeat == 9
+    cs.apply_delta(
+        Delta([NodeDelta(N2, 0, 0,
+                         [KeyValueUpdate("x", "y", 3, VersionStatusEnum.SET)], 3)]),
+        ts=T0,
+    )
+    assert cs.compute_digest(set()).node_digests[N2].max_version == 3
+    ns.gc_marked_for_deletion(timedelta(0), ts=advance(T0, 1))
+    assert cs.compute_digest(set()).node_digests[N1].last_gc_version == 4
+    cs.remove_node(N2)
+    assert N2 not in cs.compute_digest(set()).node_digests
+
+
+def test_digest_cache_excluded_set_changes_assembly_not_entries():
+    cs = two_node_cluster()
+    cs.compute_digest(set())
+    base = cs.digest_cache_stats["rebuilds"]
+    d = cs.compute_digest({N2})
+    assert set(d.node_digests) == {N1}
+    assert cs.digest_cache_stats["rebuilds"] == base  # entries reused

@@ -321,3 +321,57 @@ def test_encode_digest_inline_matches_per_entry_oracle():
     assert encode_digest(d) == bytes(want)
     # Round-trip through the windowed decoder agrees too.
     assert decode_digest(encode_digest(d)).node_digests == d.node_digests
+
+
+def test_digest_entry_codec_caches_are_sound():
+    """Gossip fast path: digest entries are memoized on both sides.
+    Encoding the same NodeDigest twice serves the identical cached entry
+    bytes; decoding the same entry bytes twice shares one NodeDigest
+    object; oversized entries bypass the decode cache but still decode
+    identically to the per-entry oracle."""
+    from aiocluster_tpu.wire.proto import (
+        _DIGEST_ENTRY_CACHE_MAX_BODY,
+        _decode_digest_entry_cached,
+        _encode_digest_entry,
+        _field_msg,
+    )
+
+    nd = NodeDigest(N1, heartbeat=12, last_gc_version=3, max_version=40)
+    assert _encode_digest_entry(nd) is _encode_digest_entry(
+        NodeDigest(N1, 12, 3, 40)
+    )  # value-keyed: an equal digest entry reuses the cached bytes
+    want = bytearray()
+    _field_msg(want, 1, encode_node_digest(nd))
+    assert _encode_digest_entry(nd) == bytes(want)  # byte-identical framing
+
+    body = encode_node_digest(nd)
+    assert len(body) <= _DIGEST_ENTRY_CACHE_MAX_BODY
+    assert _decode_digest_entry_cached(body) is _decode_digest_entry_cached(
+        bytes(body)
+    )  # shared object for equal bytes
+    d = decode_digest(_encode_digest_entry(nd))
+    assert d.node_digests[N1] == nd
+
+    # An entry too large for the cache (giant tls_name) still decodes
+    # exactly like the oracle, through the windowed path.
+    big_id = NodeId("n-big", 1, ("h", 1), "t" * 400)
+    big = NodeDigest(big_id, 5, 0, 9)
+    entry = encode_node_digest(big)
+    assert len(entry) > _DIGEST_ENTRY_CACHE_MAX_BODY
+    framed = bytearray()
+    _field_msg(framed, 1, entry)
+    got = decode_digest(bytes(framed)).node_digests[big_id]
+    assert got == decode_node_digest(entry) == big
+
+
+def test_encode_node_id_is_cached():
+    """The encode side mirrors the lru_cache'd decode side: every
+    digest/delta encode re-serializes the same frozen NodeIds each
+    round, so the bytes are memoized (identity-stable) and correct."""
+    nid = NodeId("cache-probe", 3, ("10.1.2.3", 4567), "tls-x")
+    first = encode_node_id(nid)
+    again = encode_node_id(NodeId("cache-probe", 3, ("10.1.2.3", 4567), "tls-x"))
+    assert first is again  # equal NodeIds hit the same cached bytes
+    assert decode_node_id(first) == nid  # and they are the right bytes
+    info = encode_node_id.cache_info()
+    assert info.maxsize and info.maxsize >= 4096  # above any plausible population

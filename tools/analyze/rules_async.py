@@ -1,18 +1,20 @@
 """ACT01x — async-safety.
 
 The runtime's Syn→SynAck→Ack handshake lives entirely on one event
-loop; the four rules here target the bug classes that silently sink
+loop; the five rules here target the bug classes that silently sink
 such a loop: blocking it (ACT010), forgetting to await (ACT011),
 letting the GC collect an in-flight task (ACT012 — asyncio holds only a
-weak reference to running tasks), and swallowing cancellation so
-shutdown hangs (ACT013).
+weak reference to running tasks), swallowing cancellation so shutdown
+hangs (ACT013), and leaking stream-writer transports by closing without
+joining the close (ACT014 — the leak class a connection pool makes easy
+to reintroduce).
 """
 
 from __future__ import annotations
 
 import ast
 
-from .core import FileContext, rule, walk_excluding_nested_functions
+from .core import FileContext, dotted_name, rule, walk_excluding_nested_functions
 
 # Fully-qualified call targets that block the calling thread. Resolution
 # goes through the module's import map, so both ``time.sleep(...)`` and
@@ -216,6 +218,61 @@ def _handler_reraises(node: ast.ExceptHandler) -> bool:
         isinstance(n, ast.Raise)
         for n in walk_excluding_nested_functions(node.body)
     )
+
+
+def _receiver_is_writer(dotted: str | None) -> bool:
+    """True for receivers that name an asyncio StreamWriter by
+    convention: the final path segment contains 'writer' (``writer``,
+    ``self._writer``, ``conn.writer`` …)."""
+    return dotted is not None and "writer" in dotted.rsplit(".", 1)[-1].lower()
+
+
+@rule("ACT014", "unjoined-writer-close", "writer.close() without awaited wait_closed()")
+def check_unjoined_writer_close(ctx: FileContext):
+    """``StreamWriter.close()`` only *schedules* the transport teardown;
+    without an awaited ``wait_closed()`` the socket (and any buffered
+    bytes) linger until the GC gets around to it — per-handshake that is
+    an fd leak, and exactly what a connection pool's borrow/discard
+    paths make easy to reintroduce. Flags a ``<writer>.close()``
+    statement in any function whose scope never awaits
+    ``<same receiver>.wait_closed()`` (wrapping the await in
+    ``contextlib.suppress`` or ``wait_for`` still counts)."""
+    if ctx.tree is None:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        closes: list[tuple[ast.AST, str]] = []
+        waited: set[str] = set()
+        for node in walk_excluding_nested_functions(fn.body):
+            if (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "close"
+            ):
+                recv = dotted_name(node.value.func.value)
+                if _receiver_is_writer(recv):
+                    closes.append((node, recv))
+            elif isinstance(node, ast.Await):
+                for sub in ast.walk(node.value):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "wait_closed"
+                    ):
+                        recv = dotted_name(sub.func.value)
+                        if recv is not None:
+                            waited.add(recv)
+        for node, recv in closes:
+            if recv not in waited:
+                yield ctx.finding(
+                    node,
+                    "ACT014",
+                    f"'{recv}.close()' never joined: await "
+                    f"'{recv}.wait_closed()' in the same scope or the "
+                    "transport (and its fd) leaks until GC",
+                )
 
 
 @rule("ACT013", "swallowed-cancellation", "CancelledError caught without re-raise")
