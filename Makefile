@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -26,8 +26,17 @@ lint:
 analyze:
 	$(PY) -m tools.analyze $(LINT_PATHS)
 
-# What CI runs; a red suite, dirty lint, or new analysis finding cannot
-# land through this gate.
+# Deterministic chaos soak (docs/faults.md): seeded flaky_links +
+# split_brain + crash/restart against real loopback fleets and the sim,
+# < 60 s on a 1-core host — the fast standalone loop for fault work.
+# The soak is part of the tests/ tree, so `check` runs it via test-all
+# (full-scale variants included); listing `chaos` as a separate
+# prerequisite would run the same tests twice per CI pass.
+chaos:
+	$(PY) -m pytest tests/test_chaos.py -q -m "not slow"
+
+# What CI runs; a red suite, dirty lint, new analysis finding, or a
+# failed chaos soak cannot land through this gate.
 check: lint analyze test-all
 
 cov:

@@ -11,6 +11,7 @@ import ssl
 from dataclasses import dataclass, field
 from datetime import timedelta
 
+from ..faults.plan import FaultPlan
 from .identity import Address, NodeId
 
 # The reference's default delta MTU (entities.py:105): the cap on one
@@ -78,3 +79,11 @@ class Config:
     # responder waits the same window for the next Syn on a persistent
     # connection before closing it.
     pool_idle_timeout: float = 60.0
+    # New in aiocluster_tpu: deterministic fault injection
+    # (docs/faults.md). When set, the cluster's transport (and, through
+    # its dial path, the connection pool) is wrapped by a
+    # FaultController compiled from the plan — injected connect
+    # refusals, framed-read/write drops and delays, mid-handshake EOF,
+    # partitions, crash windows. None (the default) constructs none of
+    # it: every path is byte-identical to the fault-free build.
+    fault_plan: FaultPlan | None = None

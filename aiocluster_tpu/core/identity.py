@@ -1,17 +1,45 @@
 """Node identity.
 
 Parity: reference entities.py:52-82 (``Address``, ``NodeId``). A node is
-identified by a human name plus a ``generation_id`` that defaults to the boot
-monotonic clock, so a restarted node is a *new* cluster member and stale
+identified by a human name plus a ``generation_id`` that defaults to the
+boot wall-clock, so a restarted node is a *new* cluster member and stale
 replicas of its old incarnation age out instead of shadowing fresh state.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
 Address = tuple[str, int]
+
+# Highest generation handed out by this process, guarded for the
+# multi-threaded spawn case (servers booting clusters off-loop).
+_generation_lock = threading.Lock()
+_last_generation = 0
+
+
+def next_generation_id() -> int:
+    """A fresh, strictly increasing generation.
+
+    Wall-clock (``time.time_ns``, the reference's semantics), NOT
+    ``time.monotonic_ns``: the monotonic clock restarts at an arbitrary
+    (typically small) value on host reboot, so a rebooted node could come
+    back with a *lower* generation than its previous incarnation and lose
+    the newer-generation-wins rule — its fresh state would be shadowed by
+    stale replicas for up to the dead-node grace period. The guard below
+    additionally pins the value strictly above every generation this
+    process has issued, so in-process restarts (and a backwards-stepping
+    wall clock) still bump the generation.
+    """
+    global _last_generation
+    with _generation_lock:
+        generation = time.time_ns()
+        if generation <= _last_generation:
+            generation = _last_generation + 1
+        _last_generation = generation
+        return generation
 
 
 @dataclass(frozen=True, slots=True, eq=True)
@@ -19,7 +47,7 @@ class NodeId:
     """Unique identity of one cluster member."""
 
     name: str
-    generation_id: int = field(default_factory=time.monotonic_ns)
+    generation_id: int = field(default_factory=next_generation_id)
     gossip_advertise_addr: Address = ("localhost", 7001)
     tls_name: str | None = None
 

@@ -1,0 +1,46 @@
+"""Deterministic fault injection across both backends (docs/faults.md).
+
+- :mod:`.plan` — the scenario model: seeded, serializable
+  :class:`FaultPlan` dataclasses shared by the asyncio runtime and the
+  TPU sim.
+- :mod:`.scenarios` — the named library (``split_brain``,
+  ``flaky_links``, ``rolling_restart``, ``slow_third``).
+- :mod:`.runtime` — FaultController + transport wrapping (compiled in
+  by ``Config.fault_plan``).
+- :mod:`.sim` — jit-compatible link/crash masks (compiled in by
+  ``SimConfig.fault_plan``).
+- :mod:`.runner` — ChaosHarness: a real loopback fleet under one plan,
+  crash/restart with generation bump included.
+"""
+
+from .plan import (
+    ALL_NODES,
+    FaultPlan,
+    LinkFault,
+    NodeCrash,
+    NodeSet,
+    Partition,
+)
+from .scenarios import (
+    SCENARIOS,
+    flaky_links,
+    rolling_restart,
+    round_robin_groups,
+    slow_third,
+    split_brain,
+)
+
+__all__ = (
+    "ALL_NODES",
+    "FaultPlan",
+    "LinkFault",
+    "NodeCrash",
+    "NodeSet",
+    "Partition",
+    "SCENARIOS",
+    "flaky_links",
+    "rolling_restart",
+    "round_robin_groups",
+    "slow_third",
+    "split_brain",
+)

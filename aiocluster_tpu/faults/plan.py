@@ -1,0 +1,287 @@
+"""Deterministic fault plans: one scenario model for both backends.
+
+The paper's algorithms (phi-accrual failure detection, ScuttleButt
+anti-entropy) only earn their keep under hostile networks, yet neither
+backend could previously *produce* one. A :class:`FaultPlan` names the
+hostile conditions — per-link drop/delay/duplication, timed partitions
+with heal, asymmetric links, node crash/restart, slow-peer throttling —
+as seeded, serializable data that compiles into
+
+- a runtime :class:`~aiocluster_tpu.faults.runtime.FaultController`
+  wrapping the asyncio transport/pool (``Config.fault_plan``), and
+- per-round link/crash masks for the JAX engines
+  (:mod:`aiocluster_tpu.faults.sim`, ``SimConfig.fault_plan``), so the
+  same scenario runs at 10k-100k nodes.
+
+Determinism contract: every injected fault is a pure function of
+``(plan.seed, link, operation index)`` in the runtime and of
+``(plan.seed, tick, src, dst)`` in the sim — the same (seed, plan)
+yields the identical schedule on every run (tests/test_faults.py).
+
+Time units: plan times are **seconds in the runtime and gossip rounds
+(ticks) in the sim**. The reference's round interval is 1 s, so the two
+scales coincide for reference-shaped clusters; scale windows by your
+``gossip_interval`` otherwise.
+
+Everything here is stdlib-only and hashable (frozen dataclasses over
+tuples), so a plan can ride inside the sim's jit-static ``SimConfig``.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from dataclasses import asdict, dataclass, fields
+
+
+def _frac_of(name: str) -> float:
+    """Stable position of a node *name* in [0, 1): the runtime's analogue
+    of the sim's index/n coordinate, so fraction-addressed NodeSets mean
+    the same thing in both backends (crc32 is stable across processes,
+    unlike ``hash``)."""
+    return (zlib.crc32(name.encode()) & 0xFFFFFFFF) / 2**32
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class NodeSet:
+    """Which nodes a fault applies to.
+
+    - ``names``: explicit node names (runtime) — exact matches.
+    - ``frac``: a half-open [lo, hi) window of the node-coordinate space.
+      The sim places node ``i`` at ``i / n``; the runtime places a node
+      at ``crc32(name) / 2**32``. Fraction-addressed sets are therefore
+      the portable way to say "a third of the cluster" in one plan that
+      runs on both backends.
+    - both empty/None: matches every node.
+    """
+
+    names: tuple[str, ...] = ()
+    frac: tuple[float, float] | None = None
+
+    def matches_all(self) -> bool:
+        return not self.names and self.frac is None
+
+    def matches_name(self, name: str) -> bool:
+        if self.matches_all():
+            return True
+        if name in self.names:
+            return True
+        if self.frac is not None:
+            lo, hi = self.frac
+            return lo <= _frac_of(name) < hi
+        return False
+
+
+ALL_NODES = NodeSet()
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class LinkFault:
+    """Directional link degradation from ``src`` to ``dst`` (asymmetric
+    by construction: a plan with one direction only degrades that
+    direction).
+
+    Probabilities are per *operation* (a connect attempt, one framed
+    read/write) in the runtime and per *sub-exchange direction* in the
+    sim:
+
+    - ``drop``: the operation fails — a connect is refused, a framed
+      write/read sees a connection reset. In the sim the exchange simply
+      does not happen this round.
+    - ``delay`` / ``delay_prob``: with probability ``delay_prob`` the
+      operation is stalled ``delay`` seconds (slow-peer throttling). In
+      the sim a delay of >= 1 tick means the exchange misses its round
+      deadline — observationally a drop for that tick; sub-tick delays
+      are invisible at tick resolution.
+    - ``duplicate``: a framed write is sent twice. Runtime only, and a
+      STREAM-CORRUPTION fault, not benign datagram re-delivery: the
+      duplicated frame lands where the Syn/SynAck/Ack state machine
+      expects the next message, so the responder rejects it and closes
+      the connection — the handshake's responder-side merge is lost and
+      recovered by a later round/reconnect (the recovery is the point;
+      tests/test_faults.py::test_duplicate_frames_desync_but_converge).
+      The sim ignores duplication entirely: its connectionless
+      max-merge has no stream to corrupt.
+    - ``eof``: a framed read sees EOF mid-handshake — the peer appears
+      to hang up between our write and its reply.
+
+    ``start``/``end`` bound the active window (``end=None`` = forever).
+    """
+
+    src: NodeSet = ALL_NODES
+    dst: NodeSet = ALL_NODES
+    drop: float = 0.0
+    delay: float = 0.0
+    delay_prob: float = 0.0
+    duplicate: float = 0.0
+    eof: float = 0.0
+    start: float = 0.0
+    end: float | None = None
+
+    def active(self, t: float) -> bool:
+        return t >= self.start and (self.end is None or t < self.end)
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class Partition:
+    """A timed partition into ``n_groups`` islands, healing at ``end``.
+
+    Group assignment: explicit ``groups`` (tuples of node labels,
+    runtime only) when given; otherwise derived — the sim cuts the
+    index space into ``n_groups`` contiguous blocks
+    (``i * n_groups // n``), the runtime buckets by the stable name
+    hash (``frac * n_groups``). Traffic crossing group boundaries is
+    blocked while the window is active; at ``end`` the partition heals
+    and anti-entropy reconverges the islands.
+
+    Explicit groups are FAIL-CLOSED: a label not listed in any group is
+    isolated from everyone while the partition is active. Runtime plans
+    must therefore list each member under BOTH its node name and its
+    ``host:port`` — before a peer's first handshake the dialer can only
+    label it by address, and bucketing that unresolved label by hash
+    could silently land it in the dialer's own group, leaking traffic
+    across the cut (``ChaosHarness.name_groups`` builds the aliased
+    groups for you).
+    """
+
+    n_groups: int = 2
+    start: float = 0.0
+    end: float | None = None
+    groups: tuple[tuple[str, ...], ...] = ()
+
+    def active(self, t: float) -> bool:
+        return t >= self.start and (self.end is None or t < self.end)
+
+    def group_of_name(self, name: str) -> int | None:
+        """The label's group, or None when explicit groups are given
+        and the label is unlisted (fail-closed: an unknown peer is cut
+        from every island while the partition is active — see class
+        docstring)."""
+        if self.groups:
+            for g, members in enumerate(self.groups):
+                if name in members:
+                    return g
+            return None
+        # Derived assignment: stable hash bucket (total by construction).
+        g = int(_frac_of(name) * self.n_groups)
+        return min(g, self.n_groups - 1)
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class NodeCrash:
+    """Nodes in ``nodes`` crash at ``at`` and restart ``down_for``
+    later. In the runtime the ChaosHarness actually closes the cluster
+    and reboots it with a **bumped generation** (newer-generation-wins);
+    while down, peers' connects to it are refused. In the sim the node's
+    heartbeat and writes freeze and all its exchanges no-op for the
+    window — the restart keeps the node's identity (the sim's watermark
+    model has no generations; see docs/faults.md)."""
+
+    nodes: NodeSet = ALL_NODES
+    at: float = 0.0
+    down_for: float = 1.0
+
+    def down(self, t: float) -> bool:
+        return self.at <= t < self.at + self.down_for
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class FaultPlan:
+    """A complete, seeded fault scenario (see module docstring)."""
+
+    seed: int = 0
+    links: tuple[LinkFault, ...] = ()
+    partitions: tuple[Partition, ...] = ()
+    crashes: tuple[NodeCrash, ...] = ()
+
+    # -- validation -----------------------------------------------------------
+
+    def __post_init__(self) -> None:
+        for lf in self.links:
+            for name in ("drop", "delay_prob", "duplicate", "eof"):
+                p = getattr(lf, name)
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(f"LinkFault.{name} must be in [0, 1], got {p}")
+            if lf.delay < 0:
+                raise ValueError("LinkFault.delay must be >= 0")
+        for part in self.partitions:
+            if part.n_groups < 2:
+                raise ValueError("Partition.n_groups must be >= 2")
+            if part.groups and len(part.groups) != part.n_groups:
+                raise ValueError("Partition.groups length must equal n_groups")
+        for cr in self.crashes:
+            if cr.down_for <= 0:
+                raise ValueError("NodeCrash.down_for must be > 0")
+
+    def check_sim_compatible(self) -> None:
+        """The sim addresses nodes by index fraction only: a plan whose
+        NodeSets use explicit ``names`` or whose partitions use explicit
+        ``groups`` cannot be compiled to masks. Raise a descriptive
+        error instead of silently matching nothing."""
+        sets = [(lf.src, "LinkFault.src") for lf in self.links]
+        sets += [(lf.dst, "LinkFault.dst") for lf in self.links]
+        sets += [(cr.nodes, "NodeCrash.nodes") for cr in self.crashes]
+        for ns, where in sets:
+            if ns.names:
+                raise ValueError(
+                    f"{where} uses explicit names — the sim backend only "
+                    "supports fraction-addressed NodeSets (frac=(lo, hi))"
+                )
+        for part in self.partitions:
+            if part.groups:
+                raise ValueError(
+                    "Partition.groups uses explicit names — the sim "
+                    "backend derives groups from contiguous index blocks"
+                )
+
+    # -- serialization --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "FaultPlan":
+        def _nodeset(d: dict) -> NodeSet:
+            return NodeSet(
+                names=tuple(d.get("names", ())),
+                frac=tuple(d["frac"]) if d.get("frac") is not None else None,
+            )
+
+        def _load(dc_cls, d: dict, nodeset_keys: tuple[str, ...]):
+            kwargs = dict(d)
+            for key in nodeset_keys:
+                if key in kwargs:
+                    kwargs[key] = _nodeset(kwargs[key])
+            allowed = {f.name for f in fields(dc_cls)}
+            unknown = set(kwargs) - allowed
+            if unknown:
+                raise ValueError(
+                    f"unknown {dc_cls.__name__} fields: {sorted(unknown)}"
+                )
+            return dc_cls(**kwargs)
+
+        return cls(
+            seed=int(data.get("seed", 0)),
+            links=tuple(
+                _load(LinkFault, d, ("src", "dst"))
+                for d in data.get("links", ())
+            ),
+            partitions=tuple(
+                _load(
+                    Partition,
+                    {**d, "groups": tuple(tuple(g) for g in d.get("groups", ()))},
+                    (),
+                )
+                for d in data.get("partitions", ())
+            ),
+            crashes=tuple(
+                _load(NodeCrash, d, ("nodes",)) for d in data.get("crashes", ())
+            ),
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(raw))

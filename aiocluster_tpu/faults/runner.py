@@ -1,0 +1,305 @@
+"""Scenario runner for the asyncio backend: an in-process fleet of real
+clusters under one FaultPlan.
+
+The harness boots N loopback clusters whose transports all inject the
+same plan against one synchronised epoch (so a partition heals
+everywhere at the same instant), and drives the plan's ``crashes``
+against reality: a crashed node's ``Cluster`` is actually closed (its
+port stops accepting, its pooled channels die) and the restart boots a
+**fresh Cluster with a bumped generation** — exercising the
+newer-generation-wins rule end to end, not a simulation of it.
+
+Used by the chaos soak (tests/test_chaos.py), the convergence-under-
+fault benchmark (benchmarks/fault_bench.py) and ad-hoc scenario runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import time
+
+from ..core.config import Config
+from ..core.identity import NodeId, next_generation_id
+from ..obs.registry import MetricsRegistry
+from ..runtime.cluster import Cluster
+from .plan import FaultPlan
+
+# Crash schedule granularity: how often the harness compares plan time
+# against the crash windows. Fine enough for sub-second scenario steps.
+_CRASH_POLL_S = 0.02
+
+
+class ChaosHarness:
+    """N loopback clusters, one plan, one epoch (see module docstring)."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        plan=None,
+        *,
+        cluster_id: str = "chaos",
+        gossip_interval: float = 0.05,
+        config_overrides: dict | None = None,
+    ) -> None:
+        self.n_nodes = n_nodes
+        self.names = [f"n{i:02d}" for i in range(n_nodes)]
+        self._cluster_id = cluster_id
+        self._interval = gossip_interval
+        self._overrides = config_overrides or {}
+        self.clusters: dict[str, Cluster] = {}
+        self.registries: dict[str, MetricsRegistry] = {}
+        # Ports are allocated up front so plans can address nodes by
+        # BOTH name and "host:port": before a peer's first handshake the
+        # cluster state cannot resolve an address to a name, and a
+        # name-only partition group would let bootstrap traffic leak
+        # across the cut (see name_groups).
+        self._ports: dict[str, int] = self._free_ports()
+        # ``plan`` may be a factory taking the harness — the hook for
+        # building explicit groups over the fleet's real labels:
+        #   ChaosHarness(6, lambda h: split_brain(2, groups=h.name_groups(2)))
+        self.plan: FaultPlan | None = plan(self) if callable(plan) else plan
+        self._epoch: float | None = None
+        self._crash_task: asyncio.Task | None = None
+        self._crashed: set[str] = set()
+        self.generations: dict[str, list[int]] = {}
+
+    def addr_label(self, name: str) -> str:
+        """The pre-resolution fault label of a node (``host:port``)."""
+        return f"127.0.0.1:{self._ports[name]}"
+
+    def name_groups(self, n_groups: int) -> tuple[tuple[str, ...], ...]:
+        """Balanced partition groups over the fleet
+        (scenarios.round_robin_groups), each member listed under both
+        its name and its address label so the cut holds from the first
+        bootstrap connect onward."""
+        from .scenarios import round_robin_groups
+
+        return tuple(
+            tuple(
+                label
+                for member in group
+                for label in (member, self.addr_label(member))
+            )
+            for group in round_robin_groups(self.names, n_groups)
+        )
+
+    def node_set(self, *names: str):
+        """A NodeSet matching the given fleet members under both their
+        labels (for crash/link entries in harness-run plans)."""
+        from .plan import NodeSet
+
+        return NodeSet(
+            names=tuple(
+                label for n in names for label in (n, self.addr_label(n))
+            )
+        )
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _free_ports(self) -> dict[str, int]:
+        socks = []
+        try:
+            for _ in self.names:
+                s = socket.socket()
+                s.bind(("127.0.0.1", 0))
+                socks.append(s)
+            return {
+                name: s.getsockname()[1]
+                for name, s in zip(self.names, socks)
+            }
+        finally:
+            for s in socks:
+                s.close()
+
+    def _make_cluster(self, name: str, generation: int | None = None) -> Cluster:
+        port = self._ports[name]
+        seeds = [
+            ("127.0.0.1", p) for n, p in self._ports.items() if n != name
+        ]
+        node_id = (
+            NodeId(name=name, gossip_advertise_addr=("127.0.0.1", port))
+            if generation is None
+            else NodeId(
+                name=name,
+                generation_id=generation,
+                gossip_advertise_addr=("127.0.0.1", port),
+            )
+        )
+        config = Config(
+            node_id=node_id,
+            cluster_id=self._cluster_id,
+            gossip_interval=self._interval,
+            seed_nodes=seeds,
+            fault_plan=self.plan,
+            **self._overrides,
+        )
+        registry = self.registries.setdefault(name, MetricsRegistry())
+        cluster = Cluster(
+            config,
+            initial_key_values={f"from-{name}": name},
+            metrics=registry,
+        )
+        self.generations.setdefault(name, []).append(node_id.generation_id)
+        return cluster
+
+    async def start(self) -> None:
+        self.clusters = {name: self._make_cluster(name) for name in self.names}
+        # One epoch for the whole fleet, latched BEFORE any boot traffic
+        # can lazily start a controller's local clock: every
+        # controller's t=0 is the same instant, so windows open and
+        # heal simultaneously (explicit epochs also override any lazy
+        # latch that sneaks in — see FaultController.start).
+        self._epoch = time.monotonic()
+        for cluster in self.clusters.values():
+            ctl = cluster.fault_controller
+            if ctl is not None:
+                ctl.start(self._epoch)
+        await asyncio.gather(*(c.start() for c in self.clusters.values()))
+        if self.plan is not None and self.plan.crashes:
+            self._crash_task = asyncio.create_task(self._drive_crashes())
+
+    async def stop(self) -> None:
+        if self._crash_task is not None:
+            self._crash_task.cancel()
+            try:
+                await self._crash_task
+            except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued at harness teardown
+                pass
+            self._crash_task = None
+        await asyncio.gather(
+            *(c.close() for c in self.clusters.values()),
+            return_exceptions=True,
+        )
+
+    async def __aenter__(self) -> "ChaosHarness":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    # -- plan time ------------------------------------------------------------
+
+    def elapsed(self) -> float:
+        assert self._epoch is not None, "harness not started"
+        return time.monotonic() - self._epoch
+
+    # -- crash/restart driver -------------------------------------------------
+
+    def _down_now(self, name: str, t: float) -> bool:
+        return any(
+            cr.down(t) and cr.nodes.matches_name(name)
+            for cr in self.plan.crashes
+        )
+
+    async def _drive_crashes(self) -> None:
+        """Close clusters whose crash window opened; reboot them (bumped
+        generation, same name/port) once it closes. The restarted node's
+        higher generation makes its fresh state win over stale replicas
+        of the old incarnation.
+
+        A transient failure on one node (e.g. the old port not yet
+        released at restart) is logged and retried on the next poll —
+        the driver must outlive individual hiccups, or every later
+        crash window silently stops being injected while the soak
+        appears to pass."""
+        log = logging.getLogger("aiocluster.chaos")
+        while True:
+            t = self.elapsed()
+            for name in self.names:
+                down = self._down_now(name, t)
+                try:
+                    if down and name not in self._crashed:
+                        await self.clusters[name].close()
+                        self._crashed.add(name)
+                    elif not down and name in self._crashed:
+                        cluster = self._make_cluster(
+                            name, generation=next_generation_id()
+                        )
+                        # Rejoin the fleet's ORIGINAL epoch before any
+                        # boot traffic runs — the restarted node must
+                        # not restart the plan clock at its own reboot.
+                        ctl = cluster.fault_controller
+                        if ctl is not None:
+                            ctl.start(self._epoch)
+                        await cluster.start()
+                        self.clusters[name] = cluster
+                        # Only a successful reboot leaves the crashed
+                        # set (a failed one rolls back the generation
+                        # record and retries next poll).
+                        self._crashed.discard(name)
+                except Exception as exc:
+                    if not down and self.generations.get(name):
+                        self.generations[name].pop()
+                    log.warning(
+                        f"chaos crash driver: {name} "
+                        f"{'close' if down else 'restart'} failed "
+                        f"(retrying next poll): {exc!r}"
+                    )
+            await asyncio.sleep(_CRASH_POLL_S)
+
+    # -- observation ----------------------------------------------------------
+
+    def running(self) -> list[str]:
+        return [n for n in self.names if n not in self._crashed]
+
+    def sees(self, observer: str, owner: str) -> bool:
+        """Does ``observer`` hold ``owner``'s marker key?"""
+        cluster = self.clusters[observer]
+        key = f"from-{owner}"
+        for node_id, ns in cluster.snapshot().node_states.items():
+            if node_id.name == owner and ns.get(key) is not None:
+                return True
+        return False
+
+    def converged(self) -> bool:
+        """Every running cluster holds every running node's marker key
+        (full cross-fleet replication among the nodes that are up)."""
+        running = self.running()
+        return all(
+            self.sees(observer, owner)
+            for observer in running
+            for owner in running
+            if observer != owner
+        )
+
+    def cross_group_blind(self, groups: tuple[tuple[str, ...], ...]) -> bool:
+        """True while no cluster holds a marker from another group —
+        the partitioned-state probe for split-brain assertions.
+        ``name_groups``-style address aliases are ignored (only node
+        names carry marker keys)."""
+        groups = tuple(
+            tuple(m for m in g if ":" not in m) for g in groups
+        )
+        for gi, members in enumerate(groups):
+            for observer in members:
+                for gj, others in enumerate(groups):
+                    if gi == gj:
+                        continue
+                    for owner in others:
+                        if self.sees(observer, owner):
+                            return False
+        return True
+
+    async def wait_converged(self, timeout: float = 30.0) -> float:
+        """Poll until :meth:`converged`; returns how long it took.
+        Raises TimeoutError when the deadline passes."""
+        start = time.monotonic()
+        deadline = start + timeout
+        while time.monotonic() < deadline:
+            if self.converged():
+                return time.monotonic() - start
+            await asyncio.sleep(self._interval / 2)
+        raise TimeoutError(f"fleet did not converge within {timeout}s")
+
+    def fault_counts(self) -> dict[str, int]:
+        """Fleet-wide ``aiocluster_faults_injected_total`` by kind."""
+        totals: dict[str, int] = {}
+        for registry in self.registries.values():
+            for key, value in registry.snapshot().items():
+                if key.startswith("aiocluster_faults_injected_total{"):
+                    kind = key.split("kind=")[1].rstrip("}")
+                    totals[kind] = totals.get(kind, 0) + int(value)
+        return totals

@@ -1,0 +1,319 @@
+"""Runtime fault injection: a FaultPlan compiled against the asyncio
+backend.
+
+:class:`FaultController` turns a plan into a deterministic per-link
+decision stream; :class:`FaultyTransport` wraps the real
+``GossipTransport`` and consults the controller around every initiator
+operation — connect attempts (injected refusals/delays), framed writes
+(drops as connection resets, slow-peer delays, duplication) and framed
+reads (drops, delays, **mid-handshake EOF**). The connection pool is
+covered transitively: it dials through the wrapped ``connect``, so
+pooled borrows, the reconnect-retry path and stale eviction all see the
+same hostile network.
+
+Injection is initiator-side: every link gets both endpoints' outbound
+operations degraded, which fully cuts a partitioned link (neither side's
+handshakes go out) without the responder needing to attribute inbound
+connections. Crashed-node windows additionally refuse all of the down
+node's own traffic in both roles.
+
+Determinism: each probability draw is
+``blake2b(seed | src | dst | op | op_index | check)`` — a pure function
+of the plan and the per-link operation sequence, independent of
+wall-clock, PRNG state, or scheduling (tests/test_faults.py asserts two
+controllers replay identical schedules). Fault *windows* (start/end)
+are evaluated against an injectable clock so tests can step time
+explicitly.
+
+With ``Config.fault_plan=None`` none of this is constructed: the
+transport is the plain ``GossipTransport`` and every wrapped path is
+byte-identical to the fault-free build.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+import weakref
+from collections.abc import Callable
+from dataclasses import dataclass
+
+from ..obs.registry import MetricsRegistry
+from .plan import FaultPlan
+
+# Operation labels the transport wrapper reports; part of the hash
+# domain, so renaming one would re-key its schedule.
+OPS = ("connect", "read", "write")
+
+
+@dataclass(frozen=True, slots=True)
+class Decision:
+    """One injected-fault verdict for one operation."""
+
+    action: str  # "ok" | "drop" | "eof" | "down" | "partition"
+    delay: float = 0.0
+    duplicate: bool = False
+
+
+class FaultController:
+    """Deterministic fault schedule for one node (see module docstring).
+
+    ``clock`` defaults to ``time.monotonic``; tests inject a fake. The
+    epoch is latched by :meth:`start` (the ChaosHarness synchronises one
+    epoch across a fleet so partitions heal simultaneously) or lazily on
+    the first decision.
+    """
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        self_name: str,
+        *,
+        metrics: MetricsRegistry | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._plan = plan
+        self._self = self_name
+        self._clock = clock
+        self._t0: float | None = None
+        self._op_index: dict[tuple[str, str], int] = {}
+        self._injected = self._partition_gauge = None
+        if metrics is not None:
+            self._injected = metrics.counter(
+                "aiocluster_faults_injected_total",
+                "Faults injected into the runtime transport, by kind",
+                labels=("kind",),
+            )
+            self._partition_gauge = metrics.gauge(
+                "aiocluster_fault_partition_active",
+                "Fault-plan partitions currently active (0 = fully healed)",
+            )
+
+    @property
+    def plan(self) -> FaultPlan:
+        return self._plan
+
+    # -- time -----------------------------------------------------------------
+
+    def start(self, epoch: float | None = None) -> None:
+        """Latch the plan's t=0. An EXPLICIT epoch always wins: the
+        cluster's own boot traffic lazily latches a local t0 via
+        elapsed() before a harness can reach the controller, and a
+        restarted node must rejoin the fleet's ORIGINAL epoch — not
+        restart the plan clock at its own reboot."""
+        if epoch is not None:
+            self._t0 = epoch
+        elif self._t0 is None:
+            self._t0 = self._clock()
+
+    def elapsed(self) -> float:
+        self.start()
+        return self._clock() - self._t0
+
+    # -- deterministic draws --------------------------------------------------
+
+    def _u(self, dst: str, op: str, k: int, check: str) -> float:
+        """Uniform [0, 1) draw for check ``check`` of the k-th ``op`` on
+        link self->dst. blake2b, not ``hash()``: stable across processes
+        and runs, so (seed, plan) fully determines the schedule."""
+        key = f"{self._plan.seed}|{self._self}|{dst}|{op}|{k}|{check}"
+        digest = hashlib.blake2b(key.encode(), digest_size=8).digest()
+        return int.from_bytes(digest, "big") / 2**64
+
+    # -- decision -------------------------------------------------------------
+
+    def partitions_active(self, t: float | None = None) -> int:
+        t = self.elapsed() if t is None else t
+        n = sum(1 for p in self._plan.partitions if p.active(t))
+        if self._partition_gauge is not None:
+            self._partition_gauge.set(n)
+        return n
+
+    def _node_down(self, name: str, t: float) -> bool:
+        return any(
+            cr.down(t) and cr.nodes.matches_name(name)
+            for cr in self._plan.crashes
+        )
+
+    def _partition_blocked(self, dst: str, t: float) -> bool:
+        self.partitions_active(t)  # keep the gauge current
+        for p in self._plan.partitions:
+            if not p.active(t):
+                continue
+            g_self = p.group_of_name(self._self)
+            g_dst = p.group_of_name(dst)
+            # None = unlisted under explicit groups: fail-closed — an
+            # unattributable peer is cut from every island rather than
+            # hash-bucketed into (possibly) our own.
+            if g_self is None or g_dst is None or g_self != g_dst:
+                return True
+        return False
+
+    def decide(self, dst: str, op: str, t: float | None = None) -> Decision:
+        """The verdict for the next ``op`` on link self->dst. Advances
+        the link's operation counter; every probability check consumes
+        its own named draw, so the schedule does not depend on which
+        check short-circuits first."""
+        t = self.elapsed() if t is None else t
+        k = self._op_index[(dst, op)] = self._op_index.get((dst, op), 0) + 1
+        if self._node_down(self._self, t) or self._node_down(dst, t):
+            return Decision("down")
+        if self._partition_blocked(dst, t):
+            return Decision("partition")
+        delay = 0.0
+        duplicate = False
+        for idx, lf in enumerate(self._plan.links):
+            if not lf.active(t):
+                continue
+            if not (
+                lf.src.matches_name(self._self) and lf.dst.matches_name(dst)
+            ):
+                continue
+            if (
+                op == "read"
+                and lf.eof > 0
+                and self._u(dst, op, k, f"{idx}:eof") < lf.eof
+            ):
+                return Decision("eof")
+            if lf.drop > 0 and self._u(dst, op, k, f"{idx}:drop") < lf.drop:
+                return Decision("drop")
+            if (
+                lf.delay_prob > 0
+                and self._u(dst, op, k, f"{idx}:delay") < lf.delay_prob
+            ):
+                delay = max(delay, lf.delay)
+            if (
+                op == "write"
+                and lf.duplicate > 0
+                and self._u(dst, op, k, f"{idx}:dup") < lf.duplicate
+            ):
+                duplicate = True
+        return Decision("ok", delay=delay, duplicate=duplicate)
+
+    # -- application ----------------------------------------------------------
+
+    def _count(self, kind: str) -> None:
+        if self._injected is not None:
+            self._injected.labels(kind).inc()
+
+    def apply(self, dst: str, op: str) -> Decision:
+        """Decide, count, and raise injected failures (as the exception
+        the real network would produce). Returns the Decision; the
+        transport wrapper owns delay composition, because an injected
+        delay must consume the OPERATION'S own timeout budget — a
+        slow-peer plan whose delay exceeds ``read_timeout`` has to
+        surface as the TimeoutError the fault-free code paths handle,
+        not silently stretch the round."""
+        d = self.decide(dst, op)
+        if d.action == "ok":
+            if d.delay > 0:
+                self._count("delay")
+            if d.duplicate:
+                self._count("duplicate")
+            return d
+        self._count(d.action)
+        if d.action == "eof":
+            raise asyncio.IncompleteReadError(partial=b"", expected=None)
+        if op == "connect":
+            raise ConnectionRefusedError(f"fault injected: {d.action}")
+        raise ConnectionResetError(f"fault injected: {d.action}")
+
+
+class FaultyTransport:
+    """``GossipTransport`` wrapper consulting a FaultController around
+    every initiator-side operation. Constructed only when
+    ``Config.fault_plan`` is set; reads/writes on connections the
+    wrapper did not dial (the responder role) pass through untouched.
+    """
+
+    def __init__(
+        self,
+        inner,
+        controller: FaultController,
+        resolve_label: Callable[[str, int], str],
+    ) -> None:
+        self._inner = inner
+        self._ctl = controller
+        self._resolve = resolve_label
+        # Dialed streams -> peer label, so read/write ops can be
+        # attributed without threading labels through the call sites.
+        self._peer_of: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
+
+    @property
+    def controller(self) -> FaultController:
+        return self._ctl
+
+    async def _with_delay(self, delay: float, make_coro, budget: float):
+        """Run ``make_coro()`` after an injected delay, with delay +
+        operation together bounded by the operation's OWN configured
+        timeout — so a delay past the budget surfaces as the
+        TimeoutError a real slow peer would produce (the code path the
+        plan exists to exercise), never as a silently stretched round.
+        ``make_coro`` is a factory (not a coroutine) so a timeout that
+        lands inside the sleep leaves no never-awaited coroutine."""
+        if delay <= 0:
+            return await make_coro()
+        async def delayed():
+            await asyncio.sleep(delay)
+            return await make_coro()
+        return await asyncio.wait_for(delayed(), timeout=budget)
+
+    async def connect(self, host: str, port: int, tls_name: str | None = None):
+        label = self._resolve(host, port)
+        d = self._ctl.apply(label, "connect")
+        reader, writer = await self._with_delay(
+            d.delay,
+            lambda: self._inner.connect(host, port, tls_name),
+            self._inner._connect_timeout,
+        )
+        self._peer_of[reader] = label
+        self._peer_of[writer] = label
+        return reader, writer
+
+    async def read_packet(self, reader, timeout: float | None = None):
+        label = self._peer_of.get(reader)
+        if label is None:
+            return await self._inner.read_packet(reader, timeout)
+        d = self._ctl.apply(label, "read")
+        budget = self._inner._read_timeout if timeout is None else timeout
+        return await self._with_delay(
+            d.delay, lambda: self._inner.read_packet(reader, timeout), budget
+        )
+
+    async def write_packet(self, writer, packet) -> None:
+        label = self._peer_of.get(writer)
+        if label is None:
+            return await self._inner.write_packet(writer, packet)
+        d = self._ctl.apply(label, "write")
+        if d.duplicate:
+            await self._inner.write_packet(writer, packet)
+        await self._with_delay(
+            d.delay,
+            lambda: self._inner.write_packet(writer, packet),
+            self._inner._write_timeout,
+        )
+
+    async def write_framed(self, writer, payload: bytes, kind: str) -> None:
+        label = self._peer_of.get(writer)
+        if label is None:
+            return await self._inner.write_framed(writer, payload, kind)
+        d = self._ctl.apply(label, "write")
+        if d.duplicate:
+            await self._inner.write_framed(writer, payload, kind)
+        await self._with_delay(
+            d.delay,
+            lambda: self._inner.write_framed(writer, payload, kind),
+            self._inner._write_timeout,
+        )
+
+    async def start_server(self, host, port, handler):
+        return await self._inner.start_server(host, port, handler)
+
+    def peer_cert_names(self, writer):
+        return self._inner.peer_cert_names(writer)
+
+    def __getattr__(self, name: str):
+        # Anything else (private fields, future methods) passes through —
+        # the wrapper only intercepts the fault-bearing operations.
+        return getattr(self._inner, name)

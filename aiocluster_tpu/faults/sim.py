@@ -1,0 +1,158 @@
+"""FaultPlan compiled for the batched JAX sim: per-round link and crash
+masks.
+
+The sim's unit of network activity is the per-round sub-exchange, so the
+plan lowers to two mask families, both pure functions of
+``(plan, tick, global indices)``:
+
+- :func:`crash_mask` — (N,) bool, nodes inside a crash window this tick.
+  ``sim_step`` freezes their heartbeats/writes and invalidates their
+  exchanges (the node's process isn't running), without touching the
+  churn ground truth — the restart half of the window ends the freeze.
+- :func:`link_ok` — (N,) bool per sub-exchange direction: whether
+  traffic ``src[i] -> dst[i]`` is permitted. Partitions mask
+  cross-group pairs exactly like the churn mask masks dead pairs;
+  probabilistic faults (drop, mid-handshake EOF, delays of >= 1 tick —
+  a delayed exchange misses its round deadline) combine into one
+  per-direction failure probability and draw from the same
+  global-index multiplicative hash family as the budget dither
+  (ops/gossip._hash_uniform), so a column-sharded run produces the
+  identical mask sequence as a single-device run.
+
+Time is measured in ticks (1 tick = 1 reference second); node sets are
+fraction-addressed (``FaultPlan.check_sim_compatible`` rejects
+name-addressed plans at config time). Duplication is a modelled no-op:
+the sim's max-merge is idempotent.
+
+Determinism: nothing here reads the run's PRNG key — masks depend only
+on ``(plan.seed, tick)``, so the same (seed, FaultPlan) yields the
+identical link-mask sequence on every run and every shard layout
+(tests/test_faults.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .plan import FaultPlan, NodeSet
+
+
+def _pair_uniform(
+    i: jax.Array, j: jax.Array, salt: jax.Array
+) -> jax.Array:
+    """Deterministic (i, j, salt) -> [0, 1) draw, elementwise over two
+    index vectors — ops.gossip's shared hash mix evaluated per
+    (row, peer) pair instead of per (row, owner column), so the draw
+    for a directed link depends only on GLOBAL indices and is
+    shard-exact."""
+    from ..ops.gossip import hash_mix_u32
+
+    h = hash_mix_u32(
+        i.astype(jnp.uint32), j.astype(jnp.uint32), salt.astype(jnp.uint32)
+    )
+    return (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
+
+
+def _fault_salt(plan: FaultPlan, tick: jax.Array, fault_idx: int, sub: jax.Array):
+    """One salt per (plan seed, tick, link-fault entry, sub-exchange
+    direction): every fault entry and every direction of every
+    sub-exchange draws independently, reproducibly."""
+    seed = jnp.uint32(plan.seed & 0xFFFFFFFF)
+    return (
+        tick.astype(jnp.uint32) * jnp.uint32(0x51ED2701)
+        ^ seed * jnp.uint32(0x9E3779B9)
+        ^ jnp.uint32(fault_idx * 2 + 1) * jnp.uint32(0x7FEB3527)
+        ^ jnp.asarray(sub).astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+    )
+
+
+def _member_mask(ns: NodeSet, idx: jax.Array, n: int) -> jax.Array | None:
+    """(len(idx),) bool — which of the given global indices fall in the
+    fraction-addressed set (None = all; explicit names were rejected by
+    check_sim_compatible)."""
+    if ns.matches_all():
+        return None
+    lo, hi = ns.frac
+    pos = idx.astype(jnp.float32) / n
+    return (pos >= lo) & (pos < hi)
+
+
+def crash_mask(plan: FaultPlan, n: int, tick: jax.Array) -> jax.Array:
+    """(N,) bool: nodes down inside a crash window at this tick."""
+    i = jnp.arange(n, dtype=jnp.int32)
+    t = tick.astype(jnp.float32)
+    down = jnp.zeros((n,), bool)
+    for cr in plan.crashes:
+        active = (t >= cr.at) & (t < cr.at + cr.down_for)
+        members = _member_mask(cr.nodes, i, n)
+        hit = active if members is None else active & members
+        down = down | hit
+    return down
+
+
+def _link_failure_prob(lf) -> float:
+    """Per-direction sub-exchange failure probability of one LinkFault:
+    drop, mid-handshake EOF and a >= 1-tick delay each independently
+    kill the exchange for the round (matching the runtime's independent
+    per-check draws)."""
+    p_ok = (1.0 - lf.drop) * (1.0 - lf.eof)
+    if lf.delay >= 1.0:
+        p_ok *= 1.0 - lf.delay_prob
+    return 1.0 - p_ok
+
+
+def link_ok(
+    plan: FaultPlan,
+    n: int,
+    tick: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    sub: jax.Array | int = 0,
+) -> jax.Array:
+    """(N,) bool: is traffic ``src[i] -> dst[i]`` permitted this tick?
+
+    ``sub`` distinguishes the round's sub-exchange directions so each
+    draws fresh fault randomness. Pass ``src=p, dst=arange(n)`` for the
+    receive direction of a pull from peer ``p`` and ``src=arange(n),
+    dst=p`` for the send direction.
+    """
+    t = tick.astype(jnp.float32)
+    ok = jnp.ones(src.shape, bool)
+    for part in plan.partitions:
+        end = jnp.inf if part.end is None else part.end
+        active = (t >= part.start) & (t < end)
+        g_src = (src * part.n_groups) // n
+        g_dst = (dst * part.n_groups) // n
+        ok = ok & ~(active & (g_src != g_dst))
+    for idx, lf in enumerate(plan.links):
+        p_fail = _link_failure_prob(lf)
+        if p_fail <= 0.0:
+            continue
+        end = jnp.inf if lf.end is None else lf.end
+        active = (t >= lf.start) & (t < end)
+        applies = jnp.ones(src.shape, bool)
+        src_m = _member_mask(lf.src, src, n)
+        if src_m is not None:
+            applies = applies & src_m
+        dst_m = _member_mask(lf.dst, dst, n)
+        if dst_m is not None:
+            applies = applies & dst_m
+        u = _pair_uniform(src, dst, _fault_salt(plan, tick, idx, sub))
+        ok = ok & ~(active & applies & (u < p_fail))
+    return ok
+
+
+def plan_affects_links(plan: FaultPlan | None) -> bool:
+    """Whether the plan carries any link-level behavior the sim must
+    mask (partitions, or link faults with a nonzero per-round failure
+    probability)."""
+    if plan is None:
+        return False
+    return bool(plan.partitions) or any(
+        _link_failure_prob(lf) > 0.0 for lf in plan.links
+    )
+
+
+def plan_affects_nodes(plan: FaultPlan | None) -> bool:
+    return plan is not None and bool(plan.crashes)

@@ -133,6 +133,21 @@ def _global_cumsum_excl(d: jax.Array, axis_name: str | None) -> jax.Array:
     return local_excl + offset[:, None]
 
 
+def hash_mix_u32(i: jax.Array, j: jax.Array, s: jax.Array) -> jax.Array:
+    """The repo's one multiplicative-hash mix of two index streams and
+    a salt (uint32 in, uint32 out). Single-sourced: the budget dither /
+    view draws below and the fault masks (faults/sim.py) must stay in
+    lockstep — the fused Pallas kernel reproduces this exact sequence,
+    so a tweak here is a kernel change too."""
+    h = (
+        i * jnp.uint32(0x9E3779B1)
+        ^ j * jnp.uint32(0x85EBCA77)
+        ^ s * jnp.uint32(0xC2B2AE3D)
+    )
+    h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
+    return h ^ (h >> 13)
+
+
 def _hash_uniform(
     salt: jax.Array,
     n_rows: int,
@@ -166,13 +181,7 @@ def _hash_uniform(
     s = salt.astype(jnp.uint32)
     if run_salt is not None:
         s = s ^ run_salt.astype(jnp.uint32)
-    h = (
-        i * jnp.uint32(0x9E3779B1)
-        ^ j * jnp.uint32(0x85EBCA77)
-        ^ s * jnp.uint32(0xC2B2AE3D)
-    )
-    h = (h ^ (h >> 15)) * jnp.uint32(0x27D4EB2F)
-    h = h ^ (h >> 13)
+    h = hash_mix_u32(i, j, s)
     if bits == 32:
         u = h.astype(jnp.float32) * (1.0 / 4294967296.0)
     else:
@@ -355,6 +364,18 @@ def _lifecycle_enabled(cfg: SimConfig) -> bool:
     return cfg.track_failure_detector and cfg.dead_grace_ticks is not None
 
 
+def _fault_plan_active(cfg: SimConfig) -> bool:
+    """Whether the config's fault plan carries ANY behavior the masks
+    would have to inject — the predicate sim_step itself branches on,
+    so a no-op plan (empty, or all-zero probabilities) costs nothing
+    and keeps the fused-kernel fast paths engaged."""
+    from ..faults.sim import plan_affects_links, plan_affects_nodes
+
+    return plan_affects_links(cfg.fault_plan) or plan_affects_nodes(
+        cfg.fault_plan
+    )
+
+
 def pallas_path_engaged(
     cfg: SimConfig,
     axis_name: str | None = None,
@@ -395,6 +416,10 @@ def pallas_path_engaged(
     if not (
         _pallas_wanted(cfg, assume_accelerator)
         and not has_topology  # adjacency runs force the choice path
+        # Fault-injecting runs stay on XLA: the fused kernels carry no
+        # link/crash mask (docs/faults.md). A plan with no effective
+        # behavior keeps the kernels — sim_step injects nothing then.
+        and not _fault_plan_active(cfg)
         and cfg.pairing == "matching"
         # fanout >= 1 so the round's first kernel call exists to carry
         # the owner-diagonal refresh (a fanout=0 round must still
@@ -566,9 +591,33 @@ def sim_step(
         revives = random.bernoulli(rk, cfg.revival_rate, (n,))
         alive = jnp.where(alive, ~dies, revives)
 
+    # -- fault plan (docs/faults.md) -----------------------------------------
+    # Crash windows override EFFECTIVE liveness for the round — the
+    # node's process isn't running, so its heartbeat/writes freeze and
+    # its exchanges no-op — without touching the churn ground truth
+    # (state.alive), so the window's end is the restart. Link faults
+    # lower to per-direction masks ANDed into exchange validity below.
+    plan = cfg.fault_plan
+    from ..faults.sim import link_ok, plan_affects_links, plan_affects_nodes
+
+    eff_alive = alive
+    if plan_affects_nodes(plan):
+        from ..faults.sim import crash_mask
+
+        eff_alive = alive & ~crash_mask(plan, n, tick)
+    faulty_links = plan_affects_links(plan)
+
+    def fault_ok(src: jax.Array, dst: jax.Array, sub) -> jax.Array | None:
+        """(N,) permit mask for traffic src[i] -> dst[i] this round, or
+        None when the plan carries no link behavior (keeps the
+        fault-free trace byte-identical to before)."""
+        if not faulty_links:
+            return None
+        return link_ok(plan, n, tick, src, dst, sub)
+
     # -- owner-side activity: heartbeat tick + workload writes ---------------
-    heartbeat = state.heartbeat + alive.astype(jnp.int32)
-    max_version = state.max_version + cfg.writes_per_round * alive.astype(jnp.int32)
+    heartbeat = state.heartbeat + eff_alive.astype(jnp.int32)
+    max_version = state.max_version + cfg.writes_per_round * eff_alive.astype(jnp.int32)
 
     # Owner diagonal refresh: w[j_owner, j] = max_version[j_owner] (and
     # the heartbeat analogue). On the fused-kernel path the refresh rides
@@ -610,11 +659,17 @@ def sim_step(
     sched = scheduled_for_deletion_mask(state, cfg, tick)
     kernel_flag = None  # set when the pairs kernel carries the check
 
+    rows = jnp.arange(n, dtype=jnp.int32)
+
     def peer_adv(w, peer, salt):
         """The budgeted watermark advance of each row toward its peer row
-        (one handshake direction), masked to alive pairs and to owner
-        columns the sender has not scheduled for deletion."""
-        valid = alive & alive[peer]
+        (one handshake direction), masked to alive pairs, to the fault
+        plan's link permits (traffic peer -> row), and to owner columns
+        the sender has not scheduled for deletion."""
+        valid = eff_alive & eff_alive[peer]
+        f_ok = fault_ok(peer, rows, salt)
+        if f_ok is not None:
+            valid = valid & f_ok
         adv = _budgeted_advance(
             w, w[peer, :], cfg.budget, valid, axis_name,
             cfg.budget_policy, salt, owners, run_salt,
@@ -674,7 +729,7 @@ def sim_step(
                 # The first sub-exchange carries the diagonal refresh
                 # (later ones see it in w/hb themselves).
                 first = c == 0
-                valid_pair = alive & alive[p]
+                valid_pair = eff_alive & eff_alive[p]
                 # shards is STATIC (both n and n_local are trace-time
                 # shapes): a one-shard mesh runs the plain single-pass
                 # kernel — its in-kernel row sum IS the global total —
@@ -722,7 +777,7 @@ def sim_step(
                 )
                 kw = {}
                 if carry_check:
-                    kw["check"] = (mv_vec, alive, alive[owners])
+                    kw["check"] = (mv_vec, eff_alive, eff_alive[owners])
                 if use_pairs:
                     # The FD reads the round-start hb after the loop
                     # (hb_round_start): aliasing hb on the first
@@ -766,23 +821,29 @@ def sim_step(
         # the budget dither's non-negative sub_salt space.
         view_salt = (-(tick + 1) * cfg.fanout).astype(jnp.int32)
         peers = select_peers(
-            peer_key, alive, live_view, cfg, adjacency, degrees,
+            peer_key, eff_alive, live_view, cfg, adjacency, degrees,
             axis_name=axis_name, view_salt=view_salt, run_salt=run_salt,
         )
 
         def exchange(c, carry: tuple[jax.Array, jax.Array]):
             w, hb = carry
             p = peers[:, c]
-            valid = alive & alive[p]
+            valid = eff_alive & eff_alive[p]
+            # Per-direction fault permits: the two halves of one
+            # handshake can fail independently (asymmetric links).
+            f_in = fault_ok(p, rows, sub_salt(0, 0) + 2 * c)
+            f_out = fault_ok(rows, p, sub_salt(0, 1) + 2 * c)
+            valid_in = valid if f_in is None else valid & f_in
+            valid_out = valid if f_out is None else valid & f_out
             w_peer = w[p, :]
             ok_from_peer = None if sched is None else ~sched[p, :]
             adv_in = _budgeted_advance(
-                w, w_peer, cfg.budget, valid, axis_name,
+                w, w_peer, cfg.budget, valid_in, axis_name,
                 cfg.budget_policy, sub_salt(0, 0) + 2 * c, owners, run_salt,
                 col_ok=ok_from_peer,
             )
             adv_out = _budgeted_advance(
-                w_peer, w, cfg.budget, valid, axis_name,
+                w_peer, w, cfg.budget, valid_out, axis_name,
                 cfg.budget_policy, sub_salt(0, 1) + 2 * c, owners, run_salt,
                 col_ok=None if sched is None else ~sched,
             )
@@ -790,9 +851,10 @@ def sim_step(
             w_next = w_next.at[p].max(w_peer + adv_out)  # responder applies ours
             if track_hb:
                 hb_peer = hb[p, :]
-                vcol = valid[:, None]
-                in_ok = vcol if sched is None else vcol & ok_from_peer
-                out_ok = vcol if sched is None else vcol & ~sched
+                in_col = valid_in[:, None]
+                out_col = valid_out[:, None]
+                in_ok = in_col if sched is None else in_col & ok_from_peer
+                out_ok = out_col if sched is None else out_col & ~sched
                 hb_next = jnp.maximum(hb, jnp.where(in_ok, hb_peer, 0))
                 hb_next = hb_next.at[p].max(jnp.where(out_ok, hb, 0))
             else:
@@ -883,7 +945,9 @@ def sim_step(
             # row would watch every heartbeat stall, stamp the whole
             # cluster and garbage-collect its own state). Re-earning
             # liveness discards the stamp (FD dead-set pop).
-            row_alive = alive[:, None]
+            # eff_alive: a node inside a fault-plan crash window isn't
+            # running its FD either, so its bookkeeping freezes too.
+            row_alive = eff_alive[:, None]
             known = ((w > 0) | (hb > 0)) & row_alive
             ds = jnp.where(
                 live,

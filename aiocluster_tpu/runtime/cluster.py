@@ -126,7 +126,7 @@ class Cluster:
             on_key_change=self._emit_key_change,
             metrics=self._metrics,
         )
-        self._transport = GossipTransport(
+        transport = GossipTransport(
             max_payload_size=config.max_payload_size,
             connect_timeout=config.connect_timeout,
             read_timeout=config.read_timeout,
@@ -136,6 +136,22 @@ class Cluster:
             tls_server_hostname=config.tls_server_hostname,
             metrics=self._metrics,
         )
+        # Deterministic fault injection (docs/faults.md): only a set
+        # fault_plan constructs the controller/wrapper — with None the
+        # transport above is used as-is, byte-identical to before.
+        self._fault_controller = None
+        if config.fault_plan is not None:
+            from ..faults.runtime import FaultController, FaultyTransport
+
+            self._fault_controller = FaultController(
+                config.fault_plan,
+                config.node_id.name,
+                metrics=self._metrics,
+            )
+            transport = FaultyTransport(
+                transport, self._fault_controller, self._peer_label
+            )
+        self._transport = transport
         self._pool = ConnectionPool(
             self._transport.connect,
             max_idle_per_peer=(
@@ -295,6 +311,22 @@ class Cluster:
         unless one was injected) — hand it to ``obs.render_prometheus`` or
         an ``obs.MetricsHTTPServer``."""
         return self._metrics
+
+    @property
+    def fault_controller(self):
+        """The FaultController compiled from ``Config.fault_plan``
+        (None when no plan is set). The ChaosHarness uses this to
+        synchronise one plan epoch across a fleet."""
+        return self._fault_controller
+
+    def _peer_label(self, host: str, port: int) -> str:
+        """Fault-plan addressing: the peer's node *name* when the
+        cluster state knows the address, else ``host:port`` (plans can
+        match either — NodeSet.names accepts both forms)."""
+        for node_id in self._cluster_state.nodes():
+            if node_id.gossip_advertise_addr == (host, port):
+                return node_id.name
+        return f"{host}:{port}"
 
     # -- hooks ----------------------------------------------------------------
 

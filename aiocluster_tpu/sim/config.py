@@ -27,6 +27,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from ..faults.plan import FaultPlan
+
 
 @dataclass(frozen=True, slots=True, eq=True)
 class SimConfig:
@@ -126,6 +128,19 @@ class SimConfig:
     # off (with the FD) for memory-lean pure-convergence runs at 100k.
     track_heartbeats: bool = True
 
+    # Deterministic fault injection (docs/faults.md): the same FaultPlan
+    # the runtime compiles into its transport wrapper lowers here to
+    # per-round link masks (partitions/drops/delays mask sub-exchanges
+    # exactly like the churn mask masks dead pairs) and crash windows
+    # (heartbeats/writes freeze, exchanges no-op, then the node returns).
+    # Plan times are in TICKS; node sets must be fraction-addressed
+    # (validated below). The plan is part of this (hashable) config, so
+    # it is a jit static argument like everything else. Fault-INJECTING
+    # runs take the XLA path — the fused Pallas kernels carry no link
+    # mask (pallas_path_engaged and hostsim.supported gate on the plan
+    # carrying effective behavior; a no-op plan keeps the fast paths).
+    fault_plan: FaultPlan | None = None
+
     # Run each sub-exchange through the fused Pallas TPU kernel
     # (ops/pallas_pull.py): one pass over HBM instead of several, exact
     # same results (the XLA matching path shares the kernel's
@@ -192,6 +207,10 @@ class SimConfig:
             )
         if self.budget_policy not in ("proportional", "greedy"):
             raise ValueError(f"unknown budget_policy: {self.budget_policy}")
+        if self.fault_plan is not None:
+            if not isinstance(self.fault_plan, FaultPlan):
+                raise ValueError("fault_plan must be a faults.FaultPlan")
+            self.fault_plan.check_sim_compatible()
         if self.track_failure_detector and not self.track_heartbeats:
             raise ValueError("failure detector requires track_heartbeats")
         if self.dead_grace_ticks is not None:

@@ -42,6 +42,7 @@ from pathlib import Path
 
 import numpy as np
 
+from ..faults import sim as _faults_sim
 from ..obs.registry import MetricsRegistry
 from ..obs.sim import SimMetrics
 from ..obs.trace import TraceWriter
@@ -168,6 +169,14 @@ def supported(cfg: SimConfig) -> bool:
         and cfg.death_rate == 0.0
         and cfg.revival_rate == 0.0
         and cfg.writes_per_round == 0
+        # Fault plans lower to per-round link/crash masks the native
+        # kernel does not model (docs/faults.md) — those configs run on
+        # the XLA engine, where the masks are implemented. A plan with
+        # no effective behavior injects nothing and stays native.
+        and not (
+            _faults_sim.plan_affects_links(cfg.fault_plan)
+            or _faults_sim.plan_affects_nodes(cfg.fault_plan)
+        )
     )
 
 

@@ -555,6 +555,18 @@ def runtime_handshake_bench(log) -> dict | None:
     return _run_benchmarks_helper("handshake_bench", "measure", log, log=log)
 
 
+def convergence_under_fault_bench(log, smoke: bool) -> dict | None:
+    """The robustness trajectory datum (benchmarks/fault_bench.py):
+    time to re-converge after a 3-way partition heals — wall-clock
+    seconds on a real 16-node loopback fleet AND gossip rounds in the
+    batched sim (10k nodes full / 1,280 smoke), both driven by the same
+    seeded split_brain FaultPlan (docs/faults.md). Rides every record:
+    a perf gain that regressed reconvergence is not a gain."""
+    return _run_benchmarks_helper(
+        "fault_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 # Hard cap on the stdout record line. Round 3's full record grew to
 # ~4.5 KB and the driver's capture kept only an unparseable tail
 # (BENCH_r03.json "parsed": null); the compact line stays ~an order of
@@ -567,6 +579,8 @@ STDOUT_LINE_CAP = 2000
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
     "budget",
+    "sim_fault_reconverge_rounds",
+    "fault_reconverge_seconds",
     "runtime_handshakes_per_sec_per_round",
     "full_profile_n",
     "full_profile_r",
@@ -603,6 +617,7 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
     lo = ex.get("last_onchip") or {}
     lo_rec = lo.get("record") or {}
     hs = ex.get("runtime_handshake_bench") or {}
+    fb = ex.get("fault_bench") or {}
     extra = {
         "platform": ex.get("platform"),
         "analyze_clean": ex.get("analyze_clean"),
@@ -613,6 +628,14 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         "runtime_handshakes_per_sec_per_round": (
             hs.get("per_round") or {}
         ).get("handshakes_per_sec"),
+        # Reconvergence after a healed 3-way partition: wall-clock on
+        # the 16-node runtime fleet, rounds in the sim arm.
+        "fault_reconverge_seconds": (fb.get("runtime") or {}).get(
+            "fault_reconverge_seconds"
+        ),
+        "sim_fault_reconverge_rounds": (fb.get("sim") or {}).get(
+            "sim_fault_reconverge_rounds"
+        ),
         "rounds_to_convergence": ex.get("rounds_to_convergence"),
         "pallas_variant": ex.get("pallas_variant_engaged"),
         "pallas_speedup": ex.get("pallas_speedup"),
@@ -1159,6 +1182,10 @@ def main() -> None:
         ref_measured = None if args.smoke else measured_reference_baseline(log)
         # Cheap and device-free: measured on every record, smoke included.
         hs_bench = runtime_handshake_bench(log)
+        # Convergence-under-fault: the robustness companion to the
+        # handshake datum, also on every record (sim arm at 10k nodes
+        # in full runs, 1,280 in smoke).
+        fault_rec = convergence_under_fault_bench(log, args.smoke)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1206,6 +1233,9 @@ def main() -> None:
                 # persistent channels vs connect-per-round on the same
                 # 64-node view (benchmarks/handshake_bench.py).
                 "runtime_handshake_bench": hs_bench,
+                # Reconvergence after a healed 3-way partition, both
+                # backends, one seeded plan (benchmarks/fault_bench.py).
+                "fault_bench": fault_rec,
                 # Round-4 flagship: the measured (mesh-certified) 100k
                 # rounds-to-convergence + its v5e-8 projection.
                 "northstar_100k": load_northstar_record(log),

@@ -1,0 +1,176 @@
+"""Convergence-under-fault benchmark: time to re-converge after a 3-way
+partition heals, in both backends (docs/faults.md).
+
+The scenario is the library's ``split_brain(3)``: the cluster is cut
+into three islands from t=0, each island converges internally, and at
+the heal point anti-entropy must merge three divergent views back into
+one. Two arms, one plan:
+
+- **runtime** — a real 16-node loopback fleet (ChaosHarness, fault-plan
+  partitions injected at the transport). Reports
+  ``fault_reconverge_seconds``: wall-clock from heal to every node
+  holding every node's marker key.
+- **sim** — the batched JAX engine at 10k+ nodes (``SimConfig.
+  fault_plan``, link-mask path). Reports
+  ``sim_fault_reconverge_rounds``: gossip rounds from heal to the exact
+  first all-converged tick (chunk-invariant tracked stepping).
+
+Both arms also record whether the cluster was still *non*-converged at
+the heal point — the "partitions actually bite" half of the datum; a
+record where ``non_converged_at_heal`` is false measured nothing.
+
+Usage: python benchmarks/fault_bench.py [--smoke] [--sim-nodes N]
+Importable: bench.py calls measure() for its BENCH record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# Runtime arm shape: 16 nodes is big enough that three islands hold
+# real divergent state, small enough for one event loop on a CPU host.
+RUNTIME_NODES = 16
+RUNTIME_INTERVAL_S = 0.05
+RUNTIME_HEAL_S = 2.0
+
+# Sim arm shape: the north-star demonstration scale (>= 10k, 128-aligned
+# for the grouped-matching family), lean profile, at the exact wire-size
+# budget of the reference MTU (the BASELINE config bench.py measures
+# with) — a starved budget would make "reconverge rounds" measure MTU
+# math, not anti-entropy. The heal tick must sit past each island's
+# internal convergence so the reconvergence being timed is purely
+# cross-island anti-entropy.
+SIM_NODES = 10_240
+SIM_NODES_SMOKE = 1_280
+SIM_HEAL_TICK = 48
+SIM_MAX_ROUNDS = 400
+
+
+async def _runtime_arm() -> dict:
+    from aiocluster_tpu.faults import split_brain
+    from aiocluster_tpu.faults.runner import ChaosHarness
+
+    harness = ChaosHarness(
+        RUNTIME_NODES,
+        lambda h: split_brain(
+            3, start=0.0, heal=RUNTIME_HEAL_S, groups=h.name_groups(3)
+        ),
+        cluster_id="faultbench",
+        gossip_interval=RUNTIME_INTERVAL_S,
+    )
+    groups = harness.plan.partitions[0].groups
+    async with harness:
+        # Sit out the partition window, measured in PLAN time (the
+        # epoch predates the 16 boots, so a fixed sleep could overshoot
+        # the heal on a loaded host and probe a healed cluster).
+        while harness.elapsed() < RUNTIME_HEAL_S - 2 * RUNTIME_INTERVAL_S:
+            await asyncio.sleep(RUNTIME_INTERVAL_S / 4)
+        blind_at_heal = harness.cross_group_blind(groups)
+        probed_at = harness.elapsed()
+        while harness.elapsed() < RUNTIME_HEAL_S:
+            await asyncio.sleep(RUNTIME_INTERVAL_S / 4)
+        t_heal = time.monotonic()
+        await harness.wait_converged(timeout=30.0)
+        reconverge_s = time.monotonic() - t_heal
+        counts = harness.fault_counts()
+    return {
+        "nodes": RUNTIME_NODES,
+        "gossip_interval_s": RUNTIME_INTERVAL_S,
+        "partition_s": RUNTIME_HEAL_S,
+        "non_converged_at_heal": blind_at_heal,
+        "blind_probe_at_s": round(probed_at, 3),  # must be < partition_s
+        "fault_reconverge_seconds": round(reconverge_s, 3),
+        "faults_injected": counts,
+    }
+
+
+def _sim_arm(n_nodes: int) -> dict:
+    from aiocluster_tpu.faults import split_brain
+    from aiocluster_tpu.sim import budget_from_mtu
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    cfg = SimConfig(
+        n_nodes=n_nodes,
+        keys_per_node=16,
+        budget=budget_from_mtu(65_507),
+        track_failure_detector=False,
+        track_heartbeats=False,
+        version_dtype="int16",
+        fault_plan=split_brain(3, start=0.0, heal=float(SIM_HEAL_TICK)),
+    )
+    sim = Simulator(cfg, seed=0)
+    sim.run(SIM_HEAL_TICK)
+    non_converged_at_heal = not bool(sim.metrics()["all_converged"])
+    converged_at = sim.run_until_converged(max_rounds=SIM_MAX_ROUNDS)
+    return {
+        "nodes": n_nodes,
+        "heal_tick": SIM_HEAL_TICK,
+        "non_converged_at_heal": non_converged_at_heal,
+        "converged_at_round": converged_at,
+        "sim_fault_reconverge_rounds": (
+            None if converged_at is None else converged_at - SIM_HEAL_TICK
+        ),
+    }
+
+
+def measure(
+    *, smoke: bool = False, sim_nodes: int | None = None, log=lambda m: None
+) -> dict | None:
+    """The datum bench.py embeds (``extra.fault_bench``). Returns None
+    instead of raising — the BENCH record must survive a broken loopback
+    or an OOM'd sim arm. Each arm fails independently."""
+    record: dict = {"scenario": "split_brain(3)"}
+    try:
+        record["runtime"] = asyncio.run(_runtime_arm())
+        log(
+            "fault bench runtime arm: reconverged "
+            f"{record['runtime']['fault_reconverge_seconds']}s after a "
+            f"{RUNTIME_HEAL_S}s 3-way partition healed "
+            f"({RUNTIME_NODES} nodes)"
+        )
+    except Exception as exc:
+        log(f"fault bench runtime arm failed: {exc!r}")
+        record["runtime"] = None
+    try:
+        n = sim_nodes or (SIM_NODES_SMOKE if smoke else SIM_NODES)
+        record["sim"] = _sim_arm(n)
+        log(
+            "fault bench sim arm: reconverged in "
+            f"{record['sim']['sim_fault_reconverge_rounds']} rounds after "
+            f"heal at tick {SIM_HEAL_TICK} ({n} nodes)"
+        )
+    except Exception as exc:
+        log(f"fault bench sim arm failed: {exc!r}")
+        record["sim"] = None
+    if record["runtime"] is None and record["sim"] is None:
+        return None
+    return record
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--sim-nodes", type=int, default=None)
+    args = parser.parse_args()
+
+    def log(m: str) -> None:
+        print(f"[faultbench] {m}", file=sys.stderr, flush=True)
+
+    record = measure(smoke=args.smoke, sim_nodes=args.sim_nodes, log=log)
+    print(json.dumps(record, indent=1))
+    if record is None:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

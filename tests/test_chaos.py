@@ -1,0 +1,200 @@
+"""Deterministic chaos soak (`make chaos`, folded into `make check`):
+seeded library scenarios driven against real loopback fleets and the
+batched sim. The unmarked tests together stay well under 60 s on a
+1-core CPU host (tier-1-safe); the full-scale variants are `slow`.
+"""
+
+import asyncio
+
+import pytest
+
+from aiocluster_tpu.faults import (
+    NodeCrash,
+    FaultPlan,
+    flaky_links,
+    split_brain,
+)
+from aiocluster_tpu.faults.runner import ChaosHarness
+
+# -- runtime soaks (tier-1) ----------------------------------------------------
+
+
+async def test_chaos_flaky_links_soak():
+    """ScuttleButt converges THROUGH a 25%-drop network, and live writes
+    still propagate — slower, not never (the paper's point)."""
+    plan = flaky_links(0.25, seed=1)
+    async with ChaosHarness(3, plan, gossip_interval=0.05) as h:
+        await h.wait_converged(timeout=20.0)
+        # A live write crosses the flaky links too.
+        h.clusters["n00"].set("late-write", "v")
+
+        def seen_everywhere() -> bool:
+            return all(
+                any(
+                    n.name == "n00" and s.get("late-write") is not None
+                    for n, s in c.snapshot().node_states.items()
+                )
+                for name, c in h.clusters.items()
+                if name != "n00"
+            )
+
+        deadline = asyncio.get_event_loop().time() + 20.0
+        while not seen_everywhere():
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        counts = h.fault_counts()
+    assert counts.get("drop", 0) > 0  # the chaos actually bit
+
+
+async def test_chaos_split_brain_heals():
+    """2-way split on a 6-node fleet: islands stay mutually blind while
+    the cut holds, then reconverge after heal."""
+    heal = 1.2
+    h = ChaosHarness(
+        6,
+        lambda h: split_brain(2, start=0.0, heal=heal, groups=h.name_groups(2)),
+        gossip_interval=0.05,
+    )
+    groups = h.plan.partitions[0].groups
+    async with h:
+        await asyncio.sleep(heal - 0.2)
+        assert h.cross_group_blind(groups)  # still cut
+        assert not h.converged()
+        await h.wait_converged(timeout=20.0)
+        assert h.fault_counts().get("partition", 0) > 0
+
+
+async def test_chaos_crash_restart_bumps_generation():
+    """A crashed-and-restarted node comes back as a NEW incarnation
+    (higher generation) and the fleet reconverges on its fresh state —
+    newer-generation-wins exercised end to end."""
+    h = ChaosHarness(3, None, gossip_interval=0.05)
+    # Crash n02 from t=0.8 for 0.8 s; label both ways (name + addr).
+    h.plan = FaultPlan(
+        crashes=(NodeCrash(nodes=h.node_set("n02"), at=0.8, down_for=0.8),)
+    )
+    async with h:
+        await h.wait_converged(timeout=20.0)
+        await asyncio.sleep(1.0)  # into the crash window
+        assert "n02" in h._crashed or len(h.generations["n02"]) > 1
+
+        def restarted_state_won() -> bool:
+            gens = h.generations["n02"]
+            if len(gens) < 2:
+                return False
+            observer = h.clusters["n00"]
+            return any(
+                n.name == "n02" and n.generation_id == gens[-1]
+                for n in observer.snapshot().node_states
+            )
+
+        deadline = asyncio.get_event_loop().time() + 20.0
+        while not restarted_state_won():
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        await h.wait_converged(timeout=20.0)
+        gens = h.generations["n02"]
+        assert len(gens) == 2 and gens[1] > gens[0]
+
+
+# -- sim soak (tier-1) ---------------------------------------------------------
+
+
+def test_chaos_sim_flaky_links_converges():
+    """The sim backend under the same seeded flaky_links plan: slower
+    than fault-free, still convergent, and deterministic."""
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    base = dict(
+        n_nodes=256, track_failure_detector=False, track_heartbeats=False
+    )
+    clean = Simulator(SimConfig(**base), seed=2)
+    r_clean = clean.run_until_converged(max_rounds=400)
+    flaky = Simulator(
+        SimConfig(**base, fault_plan=flaky_links(0.5, seed=2)), seed=2
+    )
+    r_flaky = flaky.run_until_converged(max_rounds=400)
+    assert r_clean is not None and r_flaky is not None
+    assert r_flaky >= r_clean  # chaos can only slow convergence
+    # Determinism of the whole soak: a second identical run lands on the
+    # exact same convergence round.
+    again = Simulator(
+        SimConfig(**base, fault_plan=flaky_links(0.5, seed=2)), seed=2
+    )
+    assert again.run_until_converged(max_rounds=400) == r_flaky
+
+
+# -- full-scale variants (slow) ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sim_fault_masks_shard_exact():
+    """A column-sharded fault-plan run walks the bit-identical
+    trajectory of the single-device run: the masks hash global indices
+    only (8-device CPU mesh, the test-harness standard)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from aiocluster_tpu.faults import FaultPlan, flaky_links, split_brain
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    plan = FaultPlan(
+        seed=3,
+        links=flaky_links(0.3, seed=3).links,
+        partitions=split_brain(3, start=0.0, heal=10.0).partitions,
+    )
+    cfg = SimConfig(
+        n_nodes=256,
+        track_failure_detector=False,
+        track_heartbeats=False,
+        fault_plan=plan,
+    )
+    single = Simulator(cfg, seed=4)
+    single.run(16)
+    sharded = Simulator(
+        cfg, seed=4, mesh=Mesh(np.array(jax.devices()), ("owners",))
+    )
+    sharded.run(16)
+    assert (
+        np.asarray(single.state.w)
+        == np.asarray(jax.device_get(sharded.state.w))
+    ).all()
+
+
+@pytest.mark.slow
+def test_sim_split_brain_at_10k():
+    """Acceptance: the 3-way partition scenario at >= 10k nodes — no
+    convergence while partitioned, full convergence after heal."""
+    import benchmarks.fault_bench as fb
+
+    record = fb._sim_arm(10_240)
+    assert record["non_converged_at_heal"]
+    assert record["converged_at_round"] is not None
+    assert record["sim_fault_reconverge_rounds"] > 0
+
+
+@pytest.mark.slow
+async def test_chaos_16_node_runtime_soak():
+    """The fault bench's runtime arm shape as a soak: 16 nodes, 3-way
+    split, flaky links layered on top, full reconvergence."""
+    heal = 2.0
+    h = ChaosHarness(
+        16,
+        lambda h: FaultPlan(
+            seed=5,
+            links=flaky_links(0.15, seed=5).links,
+            partitions=split_brain(
+                3, start=0.0, heal=heal, groups=h.name_groups(3)
+            ).partitions,
+        ),
+        gossip_interval=0.05,
+    )
+    async with h:
+        await asyncio.sleep(heal)
+        await h.wait_converged(timeout=40.0)
+        counts = h.fault_counts()
+    assert counts.get("partition", 0) > 0
+    assert counts.get("drop", 0) > 0

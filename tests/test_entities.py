@@ -13,11 +13,43 @@ from aiocluster_tpu.core import (
 )
 
 
-def test_node_id_generation_defaults_to_monotonic_and_is_fresh():
+def test_node_id_generation_defaults_are_fresh():
     a = NodeId(name="n")
     b = NodeId(name="n")
     assert a.generation_id != b.generation_id
     assert a != b  # a restarted node is a brand-new member
+
+
+def test_generation_is_wall_clock_and_monotonic(monkeypatch):
+    """Regression (ISSUE 4 satellite): generations must come from the
+    WALL clock — ``time.monotonic_ns`` restarts on host reboot, so a
+    rebooted node could return with a *lower* generation and lose
+    newer-generation-wins — and must never step backwards even when the
+    wall clock does (NTP jumps, in-process restarts within one ns tick).
+    """
+    import time
+
+    from aiocluster_tpu.core import identity
+
+    # Default generations sit at wall-clock scale, not monotonic scale
+    # (a freshly booted host's monotonic clock is near zero; the wall
+    # clock of any plausible host is past 2020-01-01).
+    ns_2020 = 1_577_836_800 * 10**9
+    assert NodeId(name="n").generation_id > ns_2020
+
+    # Backwards-stepping clock: the guard keeps generations increasing.
+    before = identity.next_generation_id()
+    monkeypatch.setattr(time, "time_ns", lambda: before - 10**9)
+    g1 = identity.next_generation_id()
+    g2 = identity.next_generation_id()
+    assert before < g1 < g2
+
+    # A restarted node (fresh default NodeId) always outranks its
+    # previous incarnation, even inside one nanosecond tick.
+    monkeypatch.setattr(time, "time_ns", lambda: before)
+    old = NodeId(name="n")
+    new = NodeId(name="n")
+    assert new.generation_id > old.generation_id
 
 
 def test_node_id_long_name():

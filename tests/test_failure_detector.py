@@ -146,3 +146,59 @@ def test_two_stage_dead_node_gc():
 def test_phi_unknown_node_is_none():
     fd = FailureDetector(FailureDetectorConfig())
     assert fd.phi(NODE) is None
+
+
+# -- injected heartbeat-gap schedules (ISSUE 4 satellite) ----------------------
+
+
+def test_phi_crossing_window_is_the_closed_form_bound():
+    """Under a steady-1s schedule followed by silence, phi must cross
+    the 8.0 threshold exactly when elapsed exceeds 8x the prior-weighted
+    mean — alive one step before the bound, dead one step after."""
+    fd = FailureDetector(FailureDetectorConfig())
+    for i in range(60):
+        fd.report_heartbeat(NODE, ts=at(float(i)))
+    # 59 sampled 1s intervals: mean = (59 + 5*5) / (59 + 5).
+    mean = (59.0 + PRIOR_WEIGHT * 5.0) / (59.0 + PRIOR_WEIGHT)
+    t_gap = 59.0
+    cross = t_gap + 8.0 * mean
+    fd.update_node_liveness(NODE, ts=at(cross - 0.25))
+    assert fd.live_nodes() == [NODE]
+    fd.update_node_liveness(NODE, ts=at(cross + 0.25))
+    assert fd.dead_nodes() == [NODE]
+
+
+def test_detector_under_partition_gap_schedule_dies_and_heals():
+    """Heartbeat schedule derived from a fault-plan partition window
+    (heartbeats arrive every second except while the partition is
+    active): the detector must flip dead within the predicted window of
+    the gap's start and recover shortly after heal."""
+    from aiocluster_tpu.faults import split_brain
+
+    part = split_brain(2, start=30.0, heal=45.0).partitions[0]
+    fd = FailureDetector(FailureDetectorConfig())
+    # 29 pre-gap samples of 1s: the closed-form crossing bound.
+    mean = (29.0 + PRIOR_WEIGHT * 5.0) / (29.0 + PRIOR_WEIGHT)
+    cross = 29.0 + 8.0 * mean
+    assert part.start < cross < part.end  # the gap is long enough to kill
+    probes: list[tuple[float, bool]] = [
+        (cross - 0.5, True),  # not yet: phi still under the threshold
+        (cross + 0.5, False),  # dead within the predicted window
+        # After heal (45.0) the schedule resumes; the death reset the
+        # window and the >10s gap is not admitted as a sample, so the
+        # node re-earns liveness from its second post-heal heartbeat on.
+        (46.5, True),
+    ]
+    expected = iter(probes)
+    next_probe = next(expected)
+    for i in range(60):
+        t = float(i)
+        while next_probe is not None and next_probe[0] < t:
+            probe_t, expect_live = next_probe
+            fd.update_node_liveness(NODE, ts=at(probe_t))
+            assert (fd.live_nodes() == [NODE]) is expect_live, probe_t
+            assert (fd.dead_nodes() == [NODE]) is not expect_live, probe_t
+            next_probe = next(expected, None)
+        if not part.active(t):  # the gap: no heartbeats get through
+            fd.report_heartbeat(NODE, ts=at(t))
+    assert next_probe is None  # every probe ran inside the schedule

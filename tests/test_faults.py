@@ -1,0 +1,466 @@
+"""Fault-injection subsystem units (docs/faults.md): plan model +
+serialization, runtime FaultController determinism and injection
+semantics, sim link/crash mask determinism, and the inertness guarantee
+(fault_plan=None changes nothing)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from aiocluster_tpu.faults import (
+    FaultPlan,
+    LinkFault,
+    NodeCrash,
+    NodeSet,
+    Partition,
+    flaky_links,
+    rolling_restart,
+    round_robin_groups,
+    slow_third,
+    split_brain,
+)
+from aiocluster_tpu.faults.runtime import FaultController
+from aiocluster_tpu.obs import MetricsRegistry
+
+# -- plan model ----------------------------------------------------------------
+
+
+def test_plan_round_trips_through_json():
+    for plan in (
+        split_brain(3, start=1.0, heal=9.0),
+        flaky_links(0.25, delay=0.1, delay_prob=0.5, duplicate=0.05),
+        rolling_restart(4),
+        slow_third(0.5),
+        FaultPlan(
+            seed=42,
+            links=(LinkFault(src=NodeSet(names=("a",)), dst=NodeSet(frac=(0.5, 1.0)), eof=0.1),),
+            partitions=(Partition(n_groups=2, groups=(("a",), ("b",))),),
+            crashes=(NodeCrash(nodes=NodeSet(names=("b",)), at=3.0, down_for=2.0),),
+        ),
+    ):
+        restored = FaultPlan.from_json(plan.to_json())
+        assert restored == plan
+        assert hash(restored) == hash(plan)  # usable as a jit static arg
+
+
+def test_plan_validation_rejects_bad_probabilities():
+    with pytest.raises(ValueError):
+        FaultPlan(links=(LinkFault(drop=1.5),))
+    with pytest.raises(ValueError):
+        FaultPlan(partitions=(Partition(n_groups=1),))
+    with pytest.raises(ValueError):
+        FaultPlan(crashes=(NodeCrash(down_for=0.0),))
+    with pytest.raises(ValueError):
+        FaultPlan.from_dict({"links": [{"bogus_field": 1}]})
+
+
+def test_node_set_matching():
+    assert NodeSet().matches_name("anything")
+    assert NodeSet(names=("a", "b")).matches_name("a")
+    assert not NodeSet(names=("a",)).matches_name("c")
+    full = NodeSet(frac=(0.0, 1.0))
+    assert full.matches_name("any-name-hashes-inside")
+    assert not NodeSet(frac=(0.0, 0.0)).matches_name("x")
+
+
+def test_sim_compatibility_check():
+    named = FaultPlan(links=(LinkFault(src=NodeSet(names=("a",))),))
+    with pytest.raises(ValueError, match="explicit names"):
+        named.check_sim_compatible()
+    grouped = FaultPlan(partitions=(Partition(groups=(("a",), ("b",))),))
+    with pytest.raises(ValueError, match="groups"):
+        grouped.check_sim_compatible()
+    split_brain(3).check_sim_compatible()  # fraction/derived plans pass
+
+
+# -- runtime controller determinism --------------------------------------------
+
+
+def test_controller_schedule_is_deterministic():
+    """Acceptance: the same (seed, FaultPlan) yields an identical
+    injected fault schedule across two runs."""
+    plan = flaky_links(0.3, seed=11)
+    ops = [("b", "write"), ("b", "read"), ("c", "connect")] * 40
+    streams = []
+    for _ in range(2):
+        ctl = FaultController(plan, "a", clock=lambda: 0.0)
+        streams.append([ctl.decide(dst, op).action for dst, op in ops])
+    assert streams[0] == streams[1]
+    assert "drop" in streams[0] and "ok" in streams[0]  # actually flaky
+
+
+def test_controller_different_seed_different_schedule():
+    ops = [("b", "write")] * 64
+    a = FaultController(flaky_links(0.3, seed=1), "a", clock=lambda: 0.0)
+    b = FaultController(flaky_links(0.3, seed=2), "a", clock=lambda: 0.0)
+    assert [a.decide(*o).action for o in ops] != [
+        b.decide(*o).action for o in ops
+    ]
+
+
+def test_controller_windows_follow_injected_clock():
+    now = {"t": 0.0}
+    plan = FaultPlan(links=(LinkFault(drop=1.0, start=5.0, end=10.0),))
+    ctl = FaultController(plan, "a", clock=lambda: now["t"])
+    ctl.start()
+    assert ctl.decide("b", "write").action == "ok"
+    now["t"] = 7.0
+    assert ctl.decide("b", "write").action == "drop"
+    now["t"] = 10.0
+    assert ctl.decide("b", "write").action == "ok"  # healed
+
+
+def test_controller_partition_and_crash_decisions():
+    now = {"t": 0.0}
+    plan = FaultPlan(
+        partitions=(Partition(n_groups=2, start=1.0, end=2.0, groups=(("a",), ("b",))),),
+        crashes=(NodeCrash(nodes=NodeSet(names=("b",)), at=3.0, down_for=1.0),),
+    )
+    reg = MetricsRegistry()
+    ctl = FaultController(plan, "a", metrics=reg, clock=lambda: now["t"])
+    ctl.start()
+    assert ctl.decide("b", "connect").action == "ok"
+    now["t"] = 1.5
+    assert ctl.decide("b", "connect").action == "partition"
+    assert ctl.partitions_active() == 1
+    now["t"] = 2.5
+    assert ctl.decide("b", "connect").action == "ok"
+    assert ctl.partitions_active() == 0
+    now["t"] = 3.5  # peer down
+    assert ctl.decide("b", "connect").action == "down"
+    now["t"] = 4.5  # restarted
+    assert ctl.decide("b", "connect").action == "ok"
+
+
+def test_controller_apply_raises_the_right_exceptions():
+    now = {"t": 0.0}
+    plan = FaultPlan(
+        links=(
+            LinkFault(drop=1.0, start=0.0, end=1.0),
+            LinkFault(eof=1.0, start=1.0, end=2.0),
+        ),
+    )
+    reg = MetricsRegistry()
+    ctl = FaultController(plan, "a", metrics=reg, clock=lambda: now["t"])
+    ctl.start()
+    with pytest.raises(ConnectionRefusedError):
+        ctl.apply("b", "connect")  # a dropped connect is refused
+    with pytest.raises(ConnectionResetError):
+        ctl.apply("b", "write")  # a dropped write is a reset
+    now["t"] = 1.5
+    with pytest.raises(asyncio.IncompleteReadError):
+        ctl.apply("b", "read")  # mid-handshake EOF
+    assert ctl.apply("b", "write").duplicate is False  # eof never hits writes
+    counts = {
+        key.split("kind=")[1].rstrip("}"): value
+        for key, value in reg.snapshot().items()
+        if key.startswith("aiocluster_faults_injected_total{")
+    }
+    assert counts == {"drop": 2, "eof": 1}
+
+
+async def test_injected_delay_consumes_operation_timeout(free_port_factory):
+    """A slow-peer delay past the configured timeouts must surface as
+    the TimeoutError the fault-free code handles — a handshake against
+    a throttled peer fails fast instead of silently stretching the
+    round by the full injected delay."""
+    import time as _time
+
+    from test_pool import _mk_cluster
+
+    p1, p2 = free_port_factory(), free_port_factory()
+    plan = FaultPlan(
+        links=(LinkFault(delay=5.0, delay_prob=1.0),),
+    )
+    r1 = MetricsRegistry()
+    c1 = _mk_cluster(
+        "one", p1, p2, metrics=r1, fault_plan=plan,
+        connect_timeout=0.3, read_timeout=0.3, write_timeout=0.3,
+    )
+    c2 = _mk_cluster("two", p2, p1, metrics=MetricsRegistry())
+    for c in (c1, c2):
+        host, port = c._config.node_id.gossip_advertise_addr
+        c._server = await c._transport.start_server(
+            host, port, c._handle_connection
+        )
+    try:
+        start = _time.monotonic()
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        elapsed = _time.monotonic() - start
+        # Bounded by the op timeouts (one attempt's connect), not by
+        # the 5 s injected delay.
+        assert elapsed < 2.0, elapsed
+        snap = r1.snapshot()
+        assert snap.get(
+            "aiocluster_faults_injected_total{kind=delay}", 0
+        ) >= 1
+        # The handshake never completed: the throttle turned into the
+        # same timeout failure a genuinely slow peer produces.
+        assert "aiocluster_handshake_steps_total{step=handle_synack}" not in snap
+    finally:
+        for c in (c1, c2):
+            await c._pool.close()
+            for writer in list(c._inbound):
+                writer.close()
+                with __import__("contextlib").suppress(Exception):
+                    await writer.wait_closed()
+            c._server.close()
+            await c._server.wait_closed()
+
+
+def test_round_robin_groups_balanced():
+    groups = round_robin_groups([f"n{i}" for i in range(7)], 3)
+    assert len(groups) == 3
+    sizes = sorted(len(g) for g in groups)
+    assert sizes == [2, 2, 3]
+
+
+# -- sim masks -----------------------------------------------------------------
+
+
+def _mask_sequence(plan, n, ticks, seed_vec=0):
+    import jax.numpy as jnp
+
+    from aiocluster_tpu.faults.sim import link_ok
+
+    rows = jnp.arange(n, dtype=jnp.int32)
+    peer = jnp.roll(rows, 1)
+    return [
+        np.asarray(link_ok(plan, n, jnp.asarray(t), peer, rows, sub=0))
+        for t in ticks
+    ]
+
+
+def test_sim_link_mask_sequence_deterministic():
+    """Acceptance: the same (seed, FaultPlan) yields an identical
+    link-mask sequence in the sim backend."""
+    plan = flaky_links(0.5, seed=9)
+    a = _mask_sequence(plan, 64, range(10))
+    b = _mask_sequence(plan, 64, range(10))
+    for ma, mb in zip(a, b):
+        assert (ma == mb).all()
+    # Different drops on different ticks (it's a schedule, not a stamp).
+    assert any((ma != a[0]).any() for ma in a[1:])
+    # And a different seed gives a different schedule.
+    c = _mask_sequence(flaky_links(0.5, seed=10), 64, range(10))
+    assert any((mc != ma).any() for ma, mc in zip(a, c))
+
+
+def test_sim_partition_mask_blocks_cross_group_only():
+    import jax.numpy as jnp
+
+    from aiocluster_tpu.faults.sim import link_ok
+
+    n = 12
+    plan = split_brain(3, start=5.0, heal=10.0)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    group = np.arange(n) * 3 // n
+    peer = jnp.roll(rows, 4)  # group 0 talks to group 2, etc.
+    before = np.asarray(link_ok(plan, n, jnp.asarray(0), peer, rows))
+    during = np.asarray(link_ok(plan, n, jnp.asarray(7), peer, rows))
+    after = np.asarray(link_ok(plan, n, jnp.asarray(10), peer, rows))
+    assert before.all() and after.all()
+    cross = group != np.roll(group, 4)
+    assert (~during[cross]).all() and during[~cross].all()
+
+
+def test_sim_crash_mask_window():
+    import jax.numpy as jnp
+
+    from aiocluster_tpu.faults.sim import crash_mask
+
+    plan = rolling_restart(2, start=4.0, wave_every=4.0, down_for=2.0)
+    n = 10
+    down_at = {
+        t: np.asarray(crash_mask(plan, n, jnp.asarray(t))) for t in (3, 5, 9, 12)
+    }
+    assert not down_at[3].any()
+    assert down_at[5][: n // 2].all() and not down_at[5][n // 2 :].any()
+    assert down_at[9][n // 2 :].all() and not down_at[9][: n // 2].any()
+    assert not down_at[12].any()
+
+
+def test_sim_trajectory_identical_across_runs_and_without_plan():
+    """Two runs of the same (seed, plan) are bit-identical; and a plan
+    whose windows are all in the future leaves the trajectory identical
+    to fault_plan=None (the masks are inert until they bite)."""
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    plan = flaky_links(0.4, seed=3)
+    runs = []
+    for _ in range(2):
+        sim = Simulator(SimConfig(n_nodes=64, fault_plan=plan), seed=5)
+        sim.run(12)
+        runs.append(np.asarray(sim.state.w))
+    assert (runs[0] == runs[1]).all()
+
+    future = flaky_links(1.0, start=1000.0, seed=3)
+    with_plan = Simulator(SimConfig(n_nodes=64, fault_plan=future), seed=5)
+    with_plan.run(12)
+    without = Simulator(SimConfig(n_nodes=64), seed=5)
+    without.run(12)
+    assert (np.asarray(with_plan.state.w) == np.asarray(without.state.w)).all()
+    assert (
+        np.asarray(with_plan.state.hb_known)
+        == np.asarray(without.state.hb_known)
+    ).all()
+
+
+def test_fault_plan_disables_pallas_path():
+    from aiocluster_tpu.ops.gossip import pallas_path_engaged
+    from aiocluster_tpu.sim.config import SimConfig
+
+    base = dict(n_nodes=1024, use_pallas=True)
+    assert pallas_path_engaged(SimConfig(**base))
+    assert not pallas_path_engaged(
+        SimConfig(**base, fault_plan=flaky_links(0.1))
+    )
+    # A plan with no EFFECTIVE behavior injects nothing and keeps the
+    # fused-kernel fast path.
+    assert pallas_path_engaged(SimConfig(**base, fault_plan=FaultPlan()))
+    assert pallas_path_engaged(
+        SimConfig(**base, fault_plan=flaky_links(0.0))
+    )
+
+
+def test_partition_explicit_groups_fail_closed():
+    """A label unlisted in explicit groups is cut from every island
+    while the partition is active — never hash-bucketed into (possibly)
+    the dialer's own group (the raw Config.fault_plan bootstrap-leak
+    hole; ChaosHarness.name_groups lists address aliases instead)."""
+    plan = FaultPlan(
+        partitions=(Partition(n_groups=2, groups=(("a",), ("b",)),),),
+    )
+    ctl = FaultController(plan, "a", clock=lambda: 0.0)
+    ctl.start()
+    assert ctl.decide("b", "connect").action == "partition"  # cross-group
+    assert ctl.decide("127.0.0.1:9999", "connect").action == "partition"
+    # Derived (hash-bucket) groups stay total: every label gets a group.
+    derived = FaultPlan(partitions=(Partition(n_groups=2),))
+    assert derived.partitions[0].group_of_name("anything") is not None
+
+
+def test_sim_config_rejects_name_addressed_plans():
+    from aiocluster_tpu.sim.config import SimConfig
+
+    named = FaultPlan(links=(LinkFault(src=NodeSet(names=("a",))),))
+    with pytest.raises(ValueError, match="explicit names"):
+        SimConfig(n_nodes=16, fault_plan=named)
+
+
+def test_sim_split_brain_reconverges_after_heal():
+    """The acceptance scenario at test scale: no full convergence while
+    the 3-way partition holds, full convergence after heal (the 10k-node
+    arm runs in test_chaos.py::test_sim_split_brain_at_10k / the
+    fault bench)."""
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    heal = 40
+    cfg = SimConfig(
+        n_nodes=256,
+        track_failure_detector=False,
+        track_heartbeats=False,
+        fault_plan=split_brain(3, start=0.0, heal=float(heal)),
+    )
+    sim = Simulator(cfg, seed=1)
+    sim.run(heal - 1)
+    assert not bool(sim.metrics()["all_converged"])
+    converged_at = sim.run_until_converged(max_rounds=300)
+    assert converged_at is not None and converged_at > heal
+
+
+async def test_duplicate_frames_desync_but_converge():
+    """``duplicate`` is a stream-corruption fault: every duplicated
+    frame desyncs the handshake and costs the connection — yet the
+    cluster still converges (initiator-side merges complete before the
+    responder rejects the stray frame, and both nodes initiate)."""
+    from aiocluster_tpu.faults import flaky_links
+    from aiocluster_tpu.faults.runner import ChaosHarness
+
+    plan = flaky_links(0.0, duplicate=1.0, seed=4)
+    async with ChaosHarness(2, plan, gossip_interval=0.05) as h:
+        await h.wait_converged(timeout=20.0)
+        assert h.fault_counts().get("duplicate", 0) > 0
+
+
+# -- runtime cluster integration ----------------------------------------------
+
+
+async def test_cluster_without_plan_uses_plain_transport(free_port_factory):
+    from aiocluster_tpu import Cluster, Config, NodeId
+    from aiocluster_tpu.runtime.transport import GossipTransport
+
+    c = Cluster(
+        Config(
+            node_id=NodeId("solo", 1, ("127.0.0.1", free_port_factory())),
+        ),
+        metrics=MetricsRegistry(),
+    )
+    assert type(c._transport) is GossipTransport  # no wrapper, no controller
+    assert c.fault_controller is None
+
+
+async def test_cluster_partition_blocks_and_heals(free_port_factory):
+    """Two real clusters under a 2-way partition that heals: no
+    replication while cut, full replication after."""
+    from aiocluster_tpu import Cluster, Config, NodeId
+
+    p1, p2 = free_port_factory(), free_port_factory()
+    plan = FaultPlan(
+        partitions=(
+            Partition(
+                n_groups=2,
+                start=0.0,
+                end=1.2,
+                groups=(
+                    ("one", f"127.0.0.1:{p1}"),
+                    ("two", f"127.0.0.1:{p2}"),
+                ),
+            ),
+        ),
+    )
+
+    def mk(name, port, peer_port, registry):
+        return Cluster(
+            Config(
+                node_id=NodeId(name=name, gossip_advertise_addr=("127.0.0.1", port)),
+                cluster_id="faulttest",
+                gossip_interval=0.05,
+                seed_nodes=[("127.0.0.1", peer_port)],
+                fault_plan=plan,
+            ),
+            initial_key_values={f"from-{name}": name},
+            metrics=registry,
+        )
+
+    from conftest import wait_for
+
+    r1 = MetricsRegistry()
+    c1 = mk("one", p1, p2, r1)
+    c2 = mk("two", p2, p1, MetricsRegistry())
+
+    def replicated(cluster, peer, key):
+        return any(
+            n.name == peer and s.get(key) is not None
+            for n, s in cluster.snapshot().node_states.items()
+        )
+
+    async with c1, c2:
+        epoch = None
+        for c in (c1, c2):
+            c.fault_controller.start(epoch)
+            epoch = epoch or c.fault_controller._t0
+        await asyncio.sleep(0.9)
+        assert not replicated(c1, "two", "from-two")  # cut holds
+        assert not replicated(c2, "one", "from-one")
+        await wait_for(lambda: replicated(c1, "two", "from-two"), timeout=5.0)
+        await wait_for(lambda: replicated(c2, "one", "from-one"), timeout=5.0)
+    blocked = {
+        key.split("kind=")[1].rstrip("}"): value
+        for key, value in r1.snapshot().items()
+        if key.startswith("aiocluster_faults_injected_total{")
+    }
+    assert blocked.get("partition", 0) > 0

@@ -233,6 +233,125 @@ async def test_lifecycle_interop_both_directions(
             await wait_for(lambda: recovered() >= 1, timeout=4.0)
 
 
+# -- injected-fault reconnect semantics (ISSUE 4 satellite) --------------------
+
+
+async def test_pool_retry_under_injected_eof_and_refused_storm(
+    free_port_factory,
+):
+    """The reconnect single-retry path under deterministic fault
+    injection (docs/faults.md), two hostile phases on one plan:
+
+    - mid-handshake EOF window: a reused pooled connection EOFs on the
+      SynAck read -> exactly one reconnect, the fresh retry EOFs too and
+      is NOT retried again (the retry is never double-burned);
+    - connect-refused storm: a reused connection's write is reset ->
+      one reconnect, whose redial is refused -> give up; a second round
+      with an empty pool fails at the fresh dial with NO reconnect.
+
+    Pool event counts (hit/miss/reconnect/stale/discarded) are asserted
+    exactly per phase — the schedule is deterministic, so they are too.
+    """
+    from aiocluster_tpu.faults import FaultPlan, LinkFault, NodeSet
+
+    p1, p2 = free_port_factory(), free_port_factory()
+    peer = NodeSet(names=("two", f"127.0.0.1:{p2}"))
+    plan = FaultPlan(
+        links=(
+            LinkFault(dst=peer, eof=1.0, start=10.0, end=20.0),
+            LinkFault(dst=peer, drop=1.0, start=30.0, end=40.0),
+        ),
+    )
+    r1 = MetricsRegistry()
+    c1 = _mk_cluster("one", p1, p2, metrics=r1, fault_plan=plan)
+    c2 = _mk_cluster("two", p2, p1, metrics=MetricsRegistry())
+
+    # Deterministic plan time: drive the controller off a fake clock.
+    now = {"t": 0.0}
+    ctl = c1.fault_controller
+    ctl._clock = lambda: now["t"]
+    ctl._t0 = 0.0
+
+    # Boot only the servers (the handshake_bench pattern): every
+    # handshake below is driven explicitly, nothing races the ticker.
+    for c in (c1, c2):
+        host, port = c._config.node_id.gossip_advertise_addr
+        c._server = await c._transport.start_server(
+            host, port, c._handle_connection
+        )
+    try:
+        def events() -> dict:
+            return _pool_events(r1)
+
+        def delta(before: dict, after: dict) -> dict:
+            keys = set(before) | set(after)
+            d = {k: after.get(k, 0) - before.get(k, 0) for k in keys}
+            return {k: v for k, v in d.items() if v}
+
+        # Phase 0 (t=0, fault-free): handshake succeeds, conn pooled.
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert events() == {"miss": 1}
+        assert c1._pool.idle_connections() == 1
+
+        # Phase 1 (EOF window): reused conn EOFs mid-handshake -> one
+        # reconnect; the fresh retry EOFs too -> NOT retried again.
+        now["t"] = 15.0
+        before = events()
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert delta(before, events()) == {
+            "hit": 1,  # the pooled borrow
+            "reconnect": 1,  # the single retry — never double-burned
+            "miss": 1,  # the retry's fresh dial
+            "discarded": 2,  # both failed conns closed, none pooled
+        }
+        assert c1._pool.idle_connections() == 0
+
+        # Phase 2 (healed, t=25): recovery, conn pooled again.
+        now["t"] = 25.0
+        before = events()
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert delta(before, events()) == {"miss": 1}
+        assert c1._pool.idle_connections() == 1
+
+        # Phase 3 (refused storm): the reused conn's write is reset ->
+        # one reconnect; the redial is refused at connect -> give up.
+        now["t"] = 35.0
+        before = events()
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert delta(before, events()) == {
+            "hit": 1,
+            "reconnect": 1,
+            "miss": 1,  # the retry's dial attempt (refused mid-connect)
+            "discarded": 1,  # only the reset conn; the refused dial never opened
+        }
+        # Same storm, empty pool: fresh dial refused, NO retry burned.
+        before = events()
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert delta(before, events()) == {"miss": 1}
+
+        # Phase 4 (healed): the pool recovers from the storm.
+        now["t"] = 50.0
+        before = events()
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert delta(before, events()) == {"miss": 1}
+        assert c1._pool.idle_connections() == 1
+        faults = {
+            key.split("kind=")[1].rstrip("}"): int(v)
+            for key, v in r1.snapshot().items()
+            if key.startswith("aiocluster_faults_injected_total{")
+        }
+        assert faults == {"eof": 2, "drop": 3}
+    finally:
+        for c in (c1, c2):
+            await c._pool.close()
+            for writer in list(c._inbound):
+                writer.close()
+                with __import__("contextlib").suppress(Exception):
+                    await writer.wait_closed()
+            c._server.close()
+            await c._server.wait_closed()
+
+
 async def test_engine_syn_bytes_cache_quiescent(free_port_factory):
     """Between rounds with no state change the engine re-serves the
     identical encoded Syn bytes; any write invalidates them."""
