@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos sweep-bench check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -35,9 +35,17 @@ analyze:
 chaos:
 	$(PY) -m pytest tests/test_chaos.py -q -m "not slow"
 
-# What CI runs; a red suite, dirty lint, new analysis finding, or a
-# failed chaos soak cannot land through this gate.
-check: lint analyze test-all
+# Sweep-engine smoke (benchmarks/sweep_bench.py): an 8-lane vmapped
+# sweep must finish the same scenarios in < 0.5x the wall time of 8
+# sequential runs (compile amortization), with per-lane
+# rounds-to-convergence parity. CPU, small N, ~30 s.
+sweep-bench:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/sweep_bench.py --smoke
+
+# What CI runs; a red suite, dirty lint, new analysis finding, a failed
+# chaos soak, or a sweep-amortization regression cannot land through
+# this gate.
+check: lint analyze sweep-bench test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

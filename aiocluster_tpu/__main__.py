@@ -229,6 +229,14 @@ def _run_sim(args: argparse.Namespace, cfg) -> int:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    # Persistent XLA compilation cache (utils/xla_cache.py): reruns of
+    # the same study skip the compile. AIOCLUSTER_XLA_CACHE overrides
+    # the location ("off" disables); failures are non-fatal.
+    from .utils.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache(
+        log=lambda msg: print(f"[sim] {msg}", file=sys.stderr, flush=True)
+    )
     from .sim import Simulator
 
     mesh = None

@@ -28,6 +28,15 @@ Determinism: nothing here reads the run's PRNG key — masks depend only
 on ``(plan.seed, tick)``, so the same (seed, FaultPlan) yields the
 identical link-mask sequence on every run and every shard layout
 (tests/test_faults.py).
+
+Sweep lanes: because the probabilistic draws depend on the plan only
+through ``plan.seed``, a multi-scenario sweep (sim/sweep.py) lowers a
+per-lane fault-plan *salt* for free — ``link_ok(..., seed=s)`` with a
+traced uint32 ``s`` produces exactly the mask sequence of
+``dataclasses.replace(plan, seed=s)``, so one compiled step serves a
+whole ensemble of plan variants (crash/partition windows are pure
+functions of tick and take no seed; only the probabilistic link draws
+re-roll per lane).
 """
 
 from __future__ import annotations
@@ -54,11 +63,23 @@ def _pair_uniform(
     return (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
 
 
-def _fault_salt(plan: FaultPlan, tick: jax.Array, fault_idx: int, sub: jax.Array):
+def _fault_salt(
+    plan: FaultPlan,
+    tick: jax.Array,
+    fault_idx: int,
+    sub: jax.Array,
+    seed: jax.Array | None = None,
+):
     """One salt per (plan seed, tick, link-fault entry, sub-exchange
     direction): every fault entry and every direction of every
-    sub-exchange draws independently, reproducibly."""
-    seed = jnp.uint32(plan.seed & 0xFFFFFFFF)
+    sub-exchange draws independently, reproducibly. ``seed`` (traced
+    uint32) overrides ``plan.seed`` — the sweep's per-lane fault salt;
+    it must be pre-masked to 32 bits so the traced path computes the
+    exact expression the static path does."""
+    if seed is None:
+        seed = jnp.uint32(plan.seed & 0xFFFFFFFF)
+    else:
+        seed = seed.astype(jnp.uint32)
     return (
         tick.astype(jnp.uint32) * jnp.uint32(0x51ED2701)
         ^ seed * jnp.uint32(0x9E3779B9)
@@ -109,13 +130,18 @@ def link_ok(
     src: jax.Array,
     dst: jax.Array,
     sub: jax.Array | int = 0,
+    *,
+    seed: jax.Array | None = None,
 ) -> jax.Array:
     """(N,) bool: is traffic ``src[i] -> dst[i]`` permitted this tick?
 
     ``sub`` distinguishes the round's sub-exchange directions so each
     draws fresh fault randomness. Pass ``src=p, dst=arange(n)`` for the
     receive direction of a pull from peer ``p`` and ``src=arange(n),
-    dst=p`` for the send direction.
+    dst=p`` for the send direction. ``seed`` (traced uint32, pre-masked
+    to 32 bits) overrides ``plan.seed`` for the probabilistic draws —
+    bit-identical to ``replace(plan, seed=...)``, which is how sweep
+    lanes run plan ensembles under one compile.
     """
     t = tick.astype(jnp.float32)
     ok = jnp.ones(src.shape, bool)
@@ -138,7 +164,7 @@ def link_ok(
         dst_m = _member_mask(lf.dst, dst, n)
         if dst_m is not None:
             applies = applies & dst_m
-        u = _pair_uniform(src, dst, _fault_salt(plan, tick, idx, sub))
+        u = _pair_uniform(src, dst, _fault_salt(plan, tick, idx, sub, seed))
         ok = ok & ~(active & applies & (u < p_fail))
     return ok
 

@@ -28,7 +28,7 @@ from .registry import (
     MetricsRegistry,
     default_registry,
 )
-from .sim import SimMetrics
+from .sim import SimMetrics, SweepMetrics
 from .trace import TraceWriter, read_trace
 
 __all__ = (
@@ -39,6 +39,7 @@ __all__ = (
     "MetricsRegistry",
     "SectionTimer",
     "SimMetrics",
+    "SweepMetrics",
     "TraceWriter",
     "default_registry",
     "device_trace",

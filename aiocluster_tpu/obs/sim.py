@@ -91,6 +91,12 @@ class SimMetrics:
             "Estimated delta bytes moved (key-versions x wire cost)",
             labels=("engine",),
         ).labels(engine)
+        self._chunk_cache = self.registry.gauge(
+            "aiocluster_sim_chunk_cache_size",
+            "Compiled chunk callables currently cached by the driver "
+            "(bounded; sim/simulator.py BoundedFnCache)",
+            labels=("engine",),
+        ).labels(engine)
         self._pending: list[tuple[int, float, dict]] = []
         # Rounds run before the sampler existed (a resumed checkpoint's
         # tick) must not inflate the rounds counter at the first sample.
@@ -104,6 +110,11 @@ class SimMetrics:
         """Tick of the most recent sample (None before the first) — the
         drivers use it to close the series at the run's final state."""
         return self._last_tick
+
+    def set_chunk_cache_size(self, n: int) -> None:
+        """Driver hook: current compiled-chunk cache entry count (pure
+        host bookkeeping — no device traffic)."""
+        self._chunk_cache.set(n)
 
     def due(self, tick: int) -> bool:
         """Host-side stride gate: true when ``tick`` crossed into a new
@@ -177,3 +188,54 @@ class SimMetrics:
         return [
             {k: v for k, v in s.items() if k != "_wall"} for s in self.samples
         ]
+
+
+class SweepMetrics:
+    """Per-lane gauges for one multi-scenario sweep (sim/sweep.py).
+
+    The sweep's hot loop never syncs for telemetry; this bridge is fed
+    host-side numpy arrays at result time (ONE conversion of each
+    lane-axis array — never a per-lane ``int(x[lane])`` loop, which is
+    exactly the pattern the analyzer's ACT023 rule flags)."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        engine: str = "xla",
+    ) -> None:
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.engine = engine
+        self._lanes = self.registry.gauge(
+            "aiocluster_sim_sweep_lanes",
+            "Scenario lanes in the current sweep",
+            labels=("engine",),
+        ).labels(engine)
+        self._lanes_converged = self.registry.gauge(
+            "aiocluster_sim_sweep_lanes_converged",
+            "Sweep lanes whose convergence tick has been observed",
+            labels=("engine",),
+        ).labels(engine)
+        self._lane_rounds = self.registry.gauge(
+            "aiocluster_sim_lane_rounds_to_convergence",
+            "First round at which the lane held full convergence "
+            "(absent until observed)",
+            labels=("engine", "lane"),
+        )
+        self._lane_spread = self.registry.gauge(
+            "aiocluster_sim_lane_version_spread",
+            "Worst key-version lag over alive pairs, per sweep lane",
+            labels=("engine", "lane"),
+        )
+
+    def update(self, rounds_to_convergence, version_spread=None) -> None:
+        """Push per-lane series (host values: lists/np arrays; None or 0
+        rounds = lane not converged yet)."""
+        rounds = list(rounds_to_convergence)
+        self._lanes.set(len(rounds))
+        self._lanes_converged.set(sum(1 for r in rounds if r))
+        for lane, r in enumerate(rounds):
+            if r:
+                self._lane_rounds.labels(self.engine, str(lane)).set(float(r))
+        if version_spread is not None:
+            for lane, s in enumerate(list(version_spread)):
+                self._lane_spread.labels(self.engine, str(lane)).set(float(s))

@@ -564,6 +564,7 @@ def sim_step(
     adjacency: jax.Array | None = None,
     degrees: jax.Array | None = None,
     return_converged: bool = False,
+    sweep=None,
 ) -> SimState | tuple[SimState, jax.Array]:
     """Advance the whole cluster by one gossip round.
 
@@ -571,10 +572,36 @@ def sim_step(
     the POST-round state (exactly ``all_converged_flag(new_state)``).
     On the pair-fused kernel path the flag rides the round's last
     sub-exchange for free — convergence-tracked runs pay no extra pass
-    over w; other paths compute the separate (XLA-fused) check."""
+    over w; other paths compute the separate (XLA-fused) check.
+
+    ``sweep`` (a ``sim.state.SweepParams``) lifts the declared sweepable
+    scalars — fanout, phi threshold, writes_per_round, fault-plan seed —
+    from static config fields to traced operands, so ``SweepSimulator``
+    can vmap one compiled step over a lane axis of scenarios. Each
+    override reproduces EXACTLY the math of the corresponding static
+    field (tests/test_sweep.py asserts lane-vs-sequential bit-identity).
+    Sweep steps always run the plain XLA path: the fused Pallas kernels
+    bake these scalars into their grids and carry no lane axis."""
     n = cfg.n_nodes
     n_local = state.w.shape[1]
     owners = _local_owner_ids(n_local, axis_name)
+    sw_fanout = None if sweep is None else sweep.fanout
+    sw_phi = None if sweep is None else sweep.phi_threshold
+    sw_wpr = None if sweep is None else sweep.writes_per_round
+    sw_fault_seed = None if sweep is None else sweep.fault_seed
+    if sw_fanout is not None and (
+        cfg.pairing == "choice" or adjacency is not None
+    ):
+        # "choice" (and topology runs, which force the choice path)
+        # draws all fanout columns in one shape-(n, fanout) PRNG call —
+        # the draws are shape-dependent, so a masked wider draw cannot
+        # reproduce a narrower sequential run bit-for-bit, and that
+        # path carries no sub_active masking.
+        raise ValueError(
+            "per-lane fanout sweeps require pairing='matching' or "
+            "'permutation' without a topology (choice-path peer draws "
+            "are shape-dependent)"
+        )
     tick = state.tick + 1
     round_key = random.fold_in(key, tick)
     churn_key, peer_key = random.split(round_key)
@@ -610,14 +637,17 @@ def sim_step(
     def fault_ok(src: jax.Array, dst: jax.Array, sub) -> jax.Array | None:
         """(N,) permit mask for traffic src[i] -> dst[i] this round, or
         None when the plan carries no link behavior (keeps the
-        fault-free trace byte-identical to before)."""
+        fault-free trace byte-identical to before). A sweep lane's
+        fault seed re-rolls the probabilistic draws exactly as
+        ``replace(plan, seed=...)`` would."""
         if not faulty_links:
             return None
-        return link_ok(plan, n, tick, src, dst, sub)
+        return link_ok(plan, n, tick, src, dst, sub, seed=sw_fault_seed)
 
     # -- owner-side activity: heartbeat tick + workload writes ---------------
+    wpr = cfg.writes_per_round if sw_wpr is None else sw_wpr
     heartbeat = state.heartbeat + eff_alive.astype(jnp.int32)
-    max_version = state.max_version + cfg.writes_per_round * eff_alive.astype(jnp.int32)
+    max_version = state.max_version + wpr * eff_alive.astype(jnp.int32)
 
     # Owner diagonal refresh: w[j_owner, j] = max_version[j_owner] (and
     # the heartbeat analogue). On the fused-kernel path the refresh rides
@@ -631,7 +661,10 @@ def sim_step(
     track_hb = cfg.track_heartbeats
     mv_vec = max_version[owners]
     hbv_vec = heartbeat[owners]
-    use_pallas = pallas_path_engaged(
+    # Sweep steps pin the XLA path: the kernels' grids bake the swept
+    # scalars in, and the kernels are bit-identical to XLA anyway, so a
+    # lane still matches a kernel-served sequential run exactly.
+    use_pallas = sweep is None and pallas_path_engaged(
         cfg, axis_name, has_topology=adjacency is not None, n_local=n_local
     )
     if use_pallas:
@@ -661,12 +694,17 @@ def sim_step(
 
     rows = jnp.arange(n, dtype=jnp.int32)
 
-    def peer_adv(w, peer, salt):
+    def peer_adv(w, peer, salt, active=None):
         """The budgeted watermark advance of each row toward its peer row
         (one handshake direction), masked to alive pairs, to the fault
         plan's link permits (traffic peer -> row), and to owner columns
-        the sender has not scheduled for deletion."""
+        the sender has not scheduled for deletion. ``active`` (scalar
+        bool) voids the whole sub-exchange — how a lane whose swept
+        fanout is below the static bound skips its excess
+        sub-exchanges."""
         valid = eff_alive & eff_alive[peer]
+        if active is not None:
+            valid = valid & active
         f_ok = fault_ok(peer, rows, salt)
         if f_ok is not None:
             valid = valid & f_ok
@@ -684,7 +722,17 @@ def sim_step(
         return jnp.maximum(hb, jnp.where(ok, hb[peer, :], 0))
 
     def sub_salt(c: int, direction: int) -> jax.Array:
-        return (tick * (2 * cfg.fanout) + 2 * c + direction).astype(jnp.int32)
+        # A swept fanout feeds the lane's OWN value into the dither-salt
+        # schedule, so the lane's salts equal a sequential run with that
+        # static fanout (the salt spacing is 2 * fanout per tick).
+        f = cfg.fanout if sw_fanout is None else sw_fanout
+        return (tick * (2 * f) + 2 * c + direction).astype(jnp.int32)
+
+    def sub_active(c: int) -> jax.Array | None:
+        """Scalar bool: does sub-exchange ``c`` run for this lane?
+        None (all run) unless the lane sweeps fanout below the static
+        bound."""
+        return None if sw_fanout is None else (c < sw_fanout)
 
     # -- fanout sub-exchanges (both handshake directions per pair) -----------
     if cfg.pairing in ("permutation", "matching") and adjacency is None:
@@ -801,15 +849,15 @@ def sim_step(
                     pulled, kernel_flag = pulled
                 w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
-                adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0))
-                adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1))
+                adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
+                adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1), sub_active(c))
                 w = w + jnp.maximum(adv_p, adv_i)
                 if track_hb:
                     hb = jnp.maximum(
                         hb_absorb(hb, p, valid_p), hb_absorb(hb, inv, valid_i)
                     )
             else:
-                adv, valid = peer_adv(w, p, sub_salt(c, 0))
+                adv, valid = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
                 w = w + adv
                 if track_hb:
                     hb = hb_absorb(hb, p, valid)
@@ -864,7 +912,7 @@ def sim_step(
         w, hb = lax.fori_loop(0, cfg.fanout, exchange, (w, hb), unroll=True)
 
     # -- vectorized phi-accrual failure detection ----------------------------
-    if pallas_fd_engaged(cfg, n_local):
+    if sweep is None and pallas_fd_engaged(cfg, n_local):
         # One streaming pass over the five FD operands (bit-identical to
         # the XLA block below — tests/test_pallas_fd.py). Runs per shard
         # under shard_map, with this shard's owner offset.
@@ -925,9 +973,13 @@ def sim_step(
         # ~1-ulp boundary shift vs the divide form is inside the noise of
         # an 8.0 heuristic threshold.
         elapsed = (tick - last_change).astype(jnp.float32)
+        # A swept phi threshold replaces the static scalar in the same
+        # f32 product — a python float and a traced float32 of the same
+        # value promote identically, so lanes match sequential runs.
+        phi = cfg.phi_threshold if sw_phi is None else sw_phi
         live = (icount >= 1) & (
             elapsed * (count_f32 + cfg.prior_weight)
-            <= cfg.phi_threshold
+            <= phi
             * (imean * count_f32 + cfg.prior_weight * cfg.prior_mean_ticks)
         )
         live = live | diag  # self-belief (elementwise, not a scatter)

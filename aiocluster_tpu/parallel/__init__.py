@@ -5,16 +5,24 @@ from .mesh import (
     AXIS,
     make_mesh,
     shard_state,
+    shard_sweep_state,
     sharded_metrics_fn,
     sharded_step_fn,
+    sharded_sweep_chunk_fn,
+    sharded_sweep_metrics_fn,
     state_partition_spec,
+    sweep_state_partition_spec,
 )
 
 __all__ = (
     "AXIS",
     "make_mesh",
     "shard_state",
+    "shard_sweep_state",
     "sharded_metrics_fn",
     "sharded_step_fn",
+    "sharded_sweep_chunk_fn",
+    "sharded_sweep_metrics_fn",
     "state_partition_spec",
+    "sweep_state_partition_spec",
 )

@@ -109,11 +109,14 @@ def _check_vma(cfg: SimConfig, mesh: Mesh, topology: bool) -> bool:
     )
 
 
-def sharded_chunk_fn(
-    cfg: SimConfig, mesh: Mesh, rounds: int = 1, *, topology: bool = False
-):
-    """shard_map'd fn advancing ``rounds`` gossip rounds:
-    (state, key[, adjacency, degrees]) -> state.
+def sharded_chunk_fn(cfg: SimConfig, mesh: Mesh, *, topology: bool = False):
+    """shard_map'd fn advancing ``m`` gossip rounds:
+    (state, key, m[, adjacency, degrees]) -> state.
+
+    ``m`` is a TRACED round count (a replicated scalar operand), so one
+    compile serves every chunk length — a partial tail chunk
+    (``min(chunk, remaining)``) no longer retraces; the fori_loop lowers
+    to the same while loop either way.
 
     With ``topology=True`` adjacency/degrees are extra replicated args —
     their entries are global row indices, and peer-row gathers/scatters
@@ -125,22 +128,21 @@ def sharded_chunk_fn(
     spec = state_partition_spec()
     extra_specs = (P(None, None), P(None)) if topology else ()
 
-    def body(state: SimState, key: jax.Array, *topo) -> SimState:
+    def body(state: SimState, key: jax.Array, m: jax.Array, *topo) -> SimState:
         adj, deg = topo if topology else (None, None)
         return lax.fori_loop(
             0,
-            rounds,
+            m,
             lambda _, st: sim_step(
                 st, key, cfg, axis_name=AXIS, adjacency=adj, degrees=deg
             ),
             state,
-            unroll=False,
         )
 
     fn = _shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, P(), *extra_specs),
+        in_specs=(spec, P(), P(), *extra_specs),
         out_specs=spec,
         check=_check_vma(cfg, mesh, topology),
     )
@@ -149,17 +151,23 @@ def sharded_chunk_fn(
 
 def sharded_step_fn(cfg: SimConfig, mesh: Mesh, *, topology: bool = False):
     """shard_map'd single-round step: (state, key[, adj, deg]) -> state."""
-    return sharded_chunk_fn(cfg, mesh, 1, topology=topology)
+    fn = sharded_chunk_fn(cfg, mesh, topology=topology)
+
+    def step(state: SimState, key: jax.Array, *topo) -> SimState:
+        return fn(state, key, 1, *topo)
+
+    return step
 
 
 def sharded_tracked_chunk_fn(
-    cfg: SimConfig, mesh: Mesh, rounds: int = 1, *, topology: bool = False
+    cfg: SimConfig, mesh: Mesh, *, topology: bool = False
 ):
     """Like sharded_chunk_fn, but the chunk also returns the EXACT tick
     at which full convergence was first observed inside it (0 = not in
     this chunk) — the sharded half of the chunk-invariant
     rounds-to-convergence contract (Simulator.run_until_converged).
-    The per-round check is one fused read of w plus a scalar pmin."""
+    The per-round check is one fused read of w plus a scalar pmin.
+    ``m`` is traced, exactly as in sharded_chunk_fn."""
     from jax import lax
 
     import jax.numpy as jnp
@@ -167,7 +175,7 @@ def sharded_tracked_chunk_fn(
     spec = state_partition_spec()
     extra_specs = (P(None, None), P(None)) if topology else ()
 
-    def body(state: SimState, key: jax.Array, *topo):
+    def body(state: SimState, key: jax.Array, m: jax.Array, *topo):
         adj, deg = topo if topology else (None, None)
 
         def one(_, carry):
@@ -183,17 +191,138 @@ def sharded_tracked_chunk_fn(
             return st, first
 
         return lax.fori_loop(
-            0, rounds, one, (state, jnp.zeros((), jnp.int32)), unroll=False
+            0, m, one, (state, jnp.zeros((), jnp.int32))
         )
 
     fn = _shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, P(), *extra_specs),
+        in_specs=(spec, P(), P(), *extra_specs),
         out_specs=(spec, P()),
         check=_check_vma(cfg, mesh, topology),
     )
     return jax.jit(fn, donate_argnums=(0,))
+
+
+# -- sweep lanes (sim/sweep.py): a leading scenario axis ----------------------
+#
+# Sweep state is the SimState pytree with a leading lane axis: matrices
+# are (S, N, n_local) — lanes and rows unsharded, owners column-sharded
+# exactly as before — and vectors/scalars are (S, ...) replicated. The
+# body vmaps the per-lane chunk over the lane axis INSIDE shard_map, so
+# each collective (deficit psums, convergence pmins) becomes one batched
+# (S,)-wide collective instead of S separate dispatches.
+
+
+def sweep_state_partition_spec() -> SimState:
+    """PartitionSpec pytree for lane-batched SimState: (S, N, n_local)
+    matrices column-sharded on the owner axis, everything else
+    replicated."""
+    mat = P(None, None, AXIS)
+    rep = P()
+    return SimState(
+        tick=rep,
+        max_version=rep,
+        heartbeat=rep,
+        alive=rep,
+        w=mat,
+        hb_known=mat,
+        last_change=mat,
+        imean=mat,
+        icount=mat,
+        live_view=mat,
+        dead_since=mat,
+    )
+
+
+def shard_sweep_state(states: SimState, mesh: Mesh) -> SimState:
+    spec = sweep_state_partition_spec()
+    return jax.device_put(
+        states, jax.tree.map(lambda s: NamedSharding(mesh, s), spec)
+    )
+
+
+def sharded_sweep_chunk_fn(cfg: SimConfig, mesh: Mesh, *, tracked: bool = False):
+    """shard_map'd lane-batched chunk. Untracked:
+    (states, keys, sweep, m) -> states. Tracked:
+    (states, keys, sweep, first, m) -> (states, first), where ``first``
+    is the per-lane (S,) int32 first-converged tick accumulator (0 = not
+    yet) carried ON DEVICE across chunks — lanes retire without
+    per-chunk host syncs. ``m`` is traced (one compile per cfg)."""
+    from jax import lax
+
+    import jax.numpy as jnp
+
+    spec = sweep_state_partition_spec()
+    # Sweeps pin the XLA path inside sim_step, so the vma checker has no
+    # pallas_call to trip over; _check_vma still consults the gates in
+    # case a future kernel learns a lane axis.
+    check = _check_vma(cfg, mesh, False)
+
+    if not tracked:
+
+        def body(states, keys, sweep, m):
+            def one_lane(state, key, sw):
+                return lax.fori_loop(
+                    0,
+                    m,
+                    lambda _, st: sim_step(
+                        st, key, cfg, axis_name=AXIS, sweep=sw
+                    ),
+                    state,
+                )
+
+            return jax.vmap(one_lane)(states, keys, sweep)
+
+        fn = _shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, P(), P(), P()),
+            out_specs=spec,
+            check=check,
+        )
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def body(states, keys, sweep, first, m):
+        def one_lane(state, key, sw, f0):
+            def one(_, carry):
+                st, f = carry
+                st, conv = sim_step(
+                    st, key, cfg, axis_name=AXIS, sweep=sw,
+                    return_converged=True,
+                )
+                f = jnp.where((f == 0) & conv, st.tick, f)
+                return st, f
+
+            return lax.fori_loop(0, m, one, (state, f0))
+
+        return jax.vmap(one_lane)(states, keys, sweep, first)
+
+    fn = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, P(), P(), P(), P()),
+        out_specs=(spec, P()),
+        check=check,
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_sweep_metrics_fn(mesh: Mesh):
+    """Per-lane convergence metrics for lane-batched sharded state:
+    states -> dict of (S,) arrays."""
+    spec = sweep_state_partition_spec()
+
+    @partial(_shard_map, mesh=mesh, in_specs=(spec,), out_specs=P())
+    def metrics(states: SimState):
+        def one(state: SimState):
+            out = convergence_metrics(state, axis_name=AXIS)
+            out["version_spread"] = version_spread(state, axis_name=AXIS)
+            return out
+
+        return jax.vmap(one)(states)
+
+    return jax.jit(metrics)
 
 
 def sharded_metrics_fn(mesh: Mesh):

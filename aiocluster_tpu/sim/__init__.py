@@ -9,9 +9,10 @@ device-resident rounds (optionally sharded over a mesh), and
 
 from .bytes import budget_from_mtu
 from .config import SimConfig
-from .state import SimState, init_state
+from .state import SimState, SweepParams, init_state
 
 __all__ = ("HostSimulator", "SimCluster", "SimConfig", "SimState",
+           "SweepParams", "SweepResult", "SweepSimulator",
            "Simulator", "budget_from_mtu", "init_state")
 
 
@@ -32,4 +33,12 @@ def __getattr__(name: str):
         from .hostsim import HostSimulator
 
         return HostSimulator
+    if name == "SweepSimulator":
+        from .sweep import SweepSimulator
+
+        return SweepSimulator
+    if name == "SweepResult":
+        from .sweep import SweepResult
+
+        return SweepResult
     raise AttributeError(name)

@@ -9,6 +9,10 @@ seed) on any device layout — single chip or a sharded mesh — because the
 kernel's randomness is keyed by (seed, tick), not by historical host
 state.
 
+Sweep checkpoints (sim/sweep.py) use the same container with a leading
+lane axis on every array and ``meta["sweep"]`` marking the layout; one
+shared field codec serves both, so the two formats cannot drift.
+
 Non-numpy dtypes (bfloat16 lives in ml_dtypes) are stored as raw bit
 patterns plus a dtype string; np.savez would otherwise round-trip them as
 void dtypes that refuse to load.
@@ -30,6 +34,57 @@ from .state import SimState
 _FIELDS = [f.name for f in dataclasses.fields(SimState)]
 
 
+def _config_from_meta(raw: dict) -> SimConfig:
+    """SimConfig from a checkpoint's ``dataclasses.asdict`` snapshot.
+    ``asdict`` recurses into the (frozen) FaultPlan, so a fault-plan
+    config round-trips as a plain dict — rebuild it through the plan's
+    own deserializer or SimConfig's validation rejects it."""
+    known = {f.name for f in dataclasses.fields(SimConfig)}
+    kwargs = {k: v for k, v in raw.items() if k in known}
+    if isinstance(kwargs.get("fault_plan"), dict):
+        from ..faults.plan import FaultPlan
+
+        kwargs["fault_plan"] = FaultPlan.from_dict(kwargs["fault_plan"])
+    return SimConfig(**kwargs)
+
+
+def _encode_fields(state: SimState) -> tuple[dict, dict[str, str]]:
+    """(arrays, dtypes) for one state pytree — the single npz field
+    codec (non-numpy dtypes stored as uint8 bit patterns)."""
+    arrays: dict = {}
+    dtypes: dict[str, str] = {}
+    for name in _FIELDS:
+        arr = np.asarray(getattr(state, name))  # noqa: ACT021 -- checkpointing IS the device->host gather
+        dtypes[name] = str(arr.dtype)
+        if arr.dtype.kind not in "biufc":  # e.g. bfloat16 -> void in npz
+            arr = arr.view(np.uint8).reshape(arr.shape + (arr.dtype.itemsize,))
+        arrays[name] = arr
+    return arrays, dtypes
+
+
+def _decode_fields(data, dtypes: dict[str, str]) -> SimState:
+    """Inverse of _encode_fields, onto device arrays."""
+    fields = {}
+    for name in _FIELDS:
+        arr = data[name]
+        want = jnp.dtype(dtypes[name])
+        if arr.dtype == np.uint8 and want.kind not in "biufc":
+            arr = arr.reshape(arr.shape[:-1] + (-1,)).view(want)
+            arr = arr.reshape(arr.shape[:-1])
+        fields[name] = jnp.asarray(arr, dtype=want)
+    return SimState(**fields)
+
+
+def _atomic_savez(path: Path, arrays: dict, meta: dict) -> None:
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(fh, **arrays)
+    tmp.replace(path)
+
+
 def save_state(
     path: str | Path,
     state: SimState,
@@ -40,28 +95,60 @@ def save_state(
 ) -> None:
     """Write state + config + run metadata to ``path`` (.npz, atomic via
     temp rename)."""
-    path = Path(path)
-    arrays = {}
-    dtypes: dict[str, str] = {}
-    for name in _FIELDS:
-        arr = np.asarray(getattr(state, name))  # noqa: ACT021 -- checkpointing IS the device->host gather
-        dtypes[name] = str(arr.dtype)
-        if arr.dtype.kind not in "biufc":  # e.g. bfloat16 -> void in npz
-            arr = arr.view(np.uint8).reshape(arr.shape + (arr.dtype.itemsize,))
-        arrays[name] = arr
+    arrays, dtypes = _encode_fields(state)
     meta = {
         "config": dataclasses.asdict(cfg),
         "dtypes": dtypes,
         "seed": seed,
         "has_topology": has_topology,
     }
-    arrays["__meta__"] = np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8
-    )
-    tmp = path.with_suffix(path.suffix + ".tmp")
-    with open(tmp, "wb") as fh:
-        np.savez_compressed(fh, **arrays)
-    tmp.replace(path)
+    _atomic_savez(Path(path), arrays, meta)
+
+
+def save_sweep(
+    path: str | Path,
+    states: SimState,
+    cfg: SimConfig,
+    *,
+    seeds: list[int],
+    params: dict[str, list],
+    first,
+    host_tick: int,
+) -> None:
+    """Checkpoint a lane-batched sweep (sim/sweep.py): the (S, ...)
+    state pytree plus the per-lane seeds, the declared sweep values and
+    the on-device convergence accumulator. Same npz container and field
+    codec as single-sim checkpoints; ``meta["sweep"]`` marks the
+    lane-batched layout so load_state can refuse it loudly."""
+    arrays, dtypes = _encode_fields(states)
+    arrays["__first__"] = np.asarray(first, np.int32)
+    meta = {
+        "config": dataclasses.asdict(cfg),
+        "dtypes": dtypes,
+        "sweep": {
+            "seeds": [int(s) for s in seeds],
+            "params": {k: list(v) for k, v in params.items()},
+            "host_tick": int(host_tick),
+        },
+    }
+    _atomic_savez(Path(path), arrays, meta)
+
+
+def load_sweep(path: str | Path) -> tuple[SimState, SimConfig, dict]:
+    """Read a sweep checkpoint; returns (lane-batched states, config,
+    meta) with meta carrying ``seeds``, ``params``, ``first`` and
+    ``host_tick``."""
+    with np.load(Path(path)) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode())
+        if "sweep" not in meta:
+            raise ValueError(
+                "not a sweep checkpoint (single-sim file? use load_state)"
+            )
+        cfg = _config_from_meta(dict(meta["config"]))
+        states = _decode_fields(data, meta["dtypes"])
+        out_meta = dict(meta["sweep"])
+        out_meta["first"] = np.asarray(data["__first__"])
+    return states, cfg, out_meta
 
 
 def load_state(
@@ -72,6 +159,11 @@ def load_state(
     parallel.shard_state when resuming on a mesh."""
     with np.load(Path(path)) as data:
         meta = json.loads(bytes(data["__meta__"]).decode())
+        if "sweep" in meta:
+            raise ValueError(
+                "lane-batched sweep checkpoint; use load_sweep / "
+                "SweepSimulator.resume"
+            )
         # Tolerate config keys this code version doesn't know (a NEWER
         # writer's fields): unknown knobs can't influence a build that
         # lacks them, and refusing the load would strand otherwise
@@ -86,14 +178,6 @@ def load_state(
                 "(written by a newer version?); ignoring them",
                 stacklevel=2,
             )
-        cfg = SimConfig(**{k: v for k, v in raw.items() if k in known})
-        fields = {}
-        for name in _FIELDS:
-            arr = data[name]
-            want = jnp.dtype(meta["dtypes"][name])
-            if arr.dtype == np.uint8 and want.kind not in "biufc":
-                arr = arr.reshape(arr.shape[:-1] + (-1,)).view(want)
-                arr = arr.reshape(arr.shape[:-1])
-            fields[name] = jnp.asarray(arr, dtype=want)
-        state = SimState(**fields)
+        cfg = _config_from_meta(raw)
+        state = _decode_fields(data, meta["dtypes"])
     return state, cfg, meta

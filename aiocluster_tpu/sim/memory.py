@@ -29,12 +29,16 @@ from .config import SimConfig
 
 @dataclass(frozen=True)
 class MemoryPlan:
-    """Estimated device bytes for one simulated cluster."""
+    """Estimated device bytes for one simulated cluster (or a sweep of
+    ``lanes`` of them — the sweep memory model is ``lanes x per-lane
+    bytes``: every lane holds its own full state and its own step
+    transients)."""
 
     n_nodes: int
-    state_bytes: int  # resident SimState matrices
+    state_bytes: int  # resident SimState matrices (all lanes)
     transient_bytes: int  # largest gathered operand alive during a step
     shards: int
+    lanes: int = 1
 
     @property
     def per_shard_bytes(self) -> int:
@@ -68,8 +72,14 @@ def engaged_variant(cfg: SimConfig, shards: int = 1) -> str:
     return pallas_variant_engaged(cfg, axis, n_local)
 
 
-def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
-    """Bytes needed for ``cfg`` sharded ``shards`` ways on the owner axis."""
+def plan(cfg: SimConfig, shards: int = 1, lanes: int = 1) -> MemoryPlan:
+    """Bytes needed for ``cfg`` sharded ``shards`` ways on the owner
+    axis. ``lanes`` > 1 models a SweepSimulator run: state and step
+    transients scale linearly with the lane count, and sweeps always
+    take the XLA path (the in-place pairs-kernel discount below never
+    applies to them)."""
+    if lanes < 1:
+        raise ValueError("lanes must be >= 1")
     n = cfg.n_nodes
     pair = jnp.dtype(cfg.version_dtype).itemsize  # w
     if cfg.track_heartbeats:
@@ -104,7 +114,7 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
     # the planner answers "will it fit the chip?" and must give the
     # same answer from a CPU planning host (tests/test_benchmarks.py
     # pins it to bench's constant).
-    if engaged_variant(cfg, shards) == "pairs":
+    if lanes == 1 and engaged_variant(cfg, shards) == "pairs":
         # FD configs retain the round-start heartbeat matrix for the
         # phi phase, so the first sub-exchange does NOT alias hb
         # (gossip.py alias_hb) — a second full (N, N) heartbeat matrix
@@ -114,7 +124,7 @@ def plan(cfg: SimConfig, shards: int = 1) -> MemoryPlan:
             transient = jnp.dtype(cfg.heartbeat_dtype).itemsize * n * n
         else:
             transient = 0
-    return MemoryPlan(n, state, transient, shards)
+    return MemoryPlan(n, state * lanes, transient * lanes, shards, lanes)
 
 
 # -- measured fit/no-fit boundaries -------------------------------------------

@@ -215,14 +215,14 @@ class SimCluster:
         floors = w.min(axis=0).astype(np.int64)  # includes the owner diag
         folded = 0
         for j in range(len(self._logs)):
-            k = int(floors[j] - self._log_base[j])  # noqa: ACT021 -- host numpy scalar; w was gathered once above the loop
+            k = int(floors[j] - self._log_base[j])  # noqa: ACT021, ACT023 -- host numpy scalar; w was gathered once above the loop
             if k <= 0:
                 continue
             k = min(k, len(self._logs[j]))
             base = self._base_views[j]
             for idx, e in enumerate(self._logs[j][:k]):
                 if e.status is KeyStatus.SET:
-                    version = int(self._log_base[j]) + idx + 1  # noqa: ACT021 -- host-side log counter, no device involved
+                    version = int(self._log_base[j]) + idx + 1  # noqa: ACT021, ACT023 -- host-side log counter, no device involved
                     base[e.key] = (e.value, e.status, version)
                 else:
                     base.pop(e.key, None)

@@ -39,9 +39,13 @@ def _metrics_sample(state: SimState) -> dict[str, jax.Array]:
     return out
 
 
-@partial(jax.jit, static_argnames=("cfg", "m"), donate_argnums=(0,))
-def _chunk(state: SimState, key: jax.Array, cfg: SimConfig, m: int,
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _chunk(state: SimState, key: jax.Array, cfg: SimConfig, m,
            adjacency=None, degrees=None) -> SimState:
+    """``m`` is a TRACED round count: one compile serves every chunk
+    length, so the partial tail chunk of a run whose round count is not
+    a chunk multiple (``min(chunk, remaining)``) never retraces — the
+    fori_loop lowers to the same while loop a static bound does."""
     return lax.fori_loop(
         0,
         m,
@@ -50,12 +54,13 @@ def _chunk(state: SimState, key: jax.Array, cfg: SimConfig, m: int,
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "m"), donate_argnums=(0,))
-def _chunk_tracked(state: SimState, key: jax.Array, cfg: SimConfig, m: int,
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _chunk_tracked(state: SimState, key: jax.Array, cfg: SimConfig, m,
                    adjacency=None, degrees=None):
-    """m rounds + the EXACT tick at which full convergence first held
-    inside the chunk (0 = didn't). One extra fused read of w per round
-    — only run_until_converged pays it; rate measurement (run) doesn't."""
+    """m rounds (traced, like _chunk) + the EXACT tick at which full
+    convergence first held inside the chunk (0 = didn't). One extra
+    fused read of w per round — only run_until_converged pays it;
+    rate measurement (run) doesn't."""
     import jax.numpy as jnp
 
     def one(_, carry):
@@ -71,6 +76,39 @@ def _chunk_tracked(state: SimState, key: jax.Array, cfg: SimConfig, m: int,
         return s, first
 
     return lax.fori_loop(0, m, one, (state, jnp.zeros((), jnp.int32)))
+
+
+class BoundedFnCache:
+    """Small LRU for compiled chunk callables.
+
+    The traced-``m`` refactor removed the per-chunk-length cache
+    dimension (one compile serves every length), but the sharded chunk
+    builders are still cached per kind/topology — this bound guarantees
+    that any future key growth (or a regression back to per-``m`` keys)
+    cannot accumulate compiled programs without limit. Size is exported
+    as the ``aiocluster_sim_chunk_cache_size`` gauge when obs is on."""
+
+    def __init__(self, maxsize: int = 8) -> None:
+        if maxsize < 1:
+            raise ValueError("maxsize must be >= 1")
+        from collections import OrderedDict
+
+        self.maxsize = maxsize
+        self._entries: "OrderedDict[object, object]" = OrderedDict()
+
+    def get_or_build(self, key, build):
+        fn = self._entries.get(key)
+        if fn is None:
+            fn = build()
+            self._entries[key] = fn
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)  # evict oldest
+        else:
+            self._entries.move_to_end(key)
+        return fn
+
+    def __len__(self) -> int:
+        return len(self._entries)
 
 
 class Simulator:
@@ -174,28 +212,37 @@ class Simulator:
         self._mesh = mesh
         if mesh is not None:
             self.state = shard_state(self.state, mesh)
-            self._sharded_chunks: dict[int, object] = {}
-            self._sharded_tracked: dict[int, object] = {}
+            # Bounded (was: unbounded dicts keyed per chunk length —
+            # every distinct tail length compiled and retained a fresh
+            # program; chunk lengths are traced operands now, so the
+            # cache holds one entry per kind).
+            self._chunk_fns = BoundedFnCache(maxsize=8)
             self._sharded_metrics = sharded_metrics_fn(mesh)
 
-    def _sharded_chunk(self, m: int):
-        """shard_map'd m-round chunk, cached per chunk length."""
-        fn = self._sharded_chunks.get(m)
-        if fn is None:
-            fn = sharded_chunk_fn(
-                self.cfg, self._mesh, m, topology=self._adj is not None
-            )
-            self._sharded_chunks[m] = fn
+    def _note_chunk_cache(self) -> None:
+        if self._obs is not None:
+            self._obs.set_chunk_cache_size(len(self._chunk_fns))
+
+    def _sharded_chunk(self):
+        """shard_map'd traced-m chunk (one compile per cfg)."""
+        fn = self._chunk_fns.get_or_build(
+            ("chunk", self._adj is not None),
+            lambda: sharded_chunk_fn(
+                self.cfg, self._mesh, topology=self._adj is not None
+            ),
+        )
+        self._note_chunk_cache()
         return fn
 
-    def _sharded_tracked_chunk(self, m: int):
-        """Convergence-tracking variant, cached per chunk length."""
-        fn = self._sharded_tracked.get(m)
-        if fn is None:
-            fn = sharded_tracked_chunk_fn(
-                self.cfg, self._mesh, m, topology=self._adj is not None
-            )
-            self._sharded_tracked[m] = fn
+    def _sharded_tracked_chunk(self):
+        """Convergence-tracking variant (also traced-m)."""
+        fn = self._chunk_fns.get_or_build(
+            ("tracked", self._adj is not None),
+            lambda: sharded_tracked_chunk_fn(
+                self.cfg, self._mesh, topology=self._adj is not None
+            ),
+        )
+        self._note_chunk_cache()
         return fn
 
     # -- stepping -------------------------------------------------------------
@@ -244,11 +291,11 @@ class Simulator:
             m = min(self.chunk, rounds - done)
             if self._mesh is not None:
                 if self._adj is not None:
-                    self.state = self._sharded_chunk(m)(
-                        self.state, self._key, self._adj, self._deg
+                    self.state = self._sharded_chunk()(
+                        self.state, self._key, m, self._adj, self._deg
                     )
                 else:
-                    self.state = self._sharded_chunk(m)(self.state, self._key)
+                    self.state = self._sharded_chunk()(self.state, self._key, m)
             else:
                 self.state = _chunk(
                     self.state, self._key, self.cfg, m, self._adj, self._deg
@@ -274,11 +321,11 @@ class Simulator:
             self._check_horizon(m)
             if self._mesh is not None:
                 args = (
-                    (self.state, self._key, self._adj, self._deg)
+                    (self.state, self._key, m, self._adj, self._deg)
                     if self._adj is not None
-                    else (self.state, self._key)
+                    else (self.state, self._key, m)
                 )
-                self.state, first = self._sharded_tracked_chunk(m)(*args)
+                self.state, first = self._sharded_tracked_chunk()(*args)
             else:
                 self.state, first = _chunk_tracked(
                     self.state, self._key, self.cfg, m, self._adj, self._deg

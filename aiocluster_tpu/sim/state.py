@@ -49,6 +49,35 @@ class SimState:
     dead_since: jax.Array  # (N, N) heartbeat_dtype
 
 
+@struct.dataclass
+class SweepParams:
+    """Per-lane traced overrides for the sweepable SimConfig scalars.
+
+    Each field is either ``None`` (the lane uses the static config
+    value — the field stays out of the pytree, so the compiled step is
+    byte-identical to a sweep-free trace of the same math) or a scalar
+    array that ``sim_step`` folds into the round exactly where the
+    static field would have been read. ``SweepSimulator`` vmaps over a
+    leading lane axis, so one jit compile serves every lane's values.
+
+    - ``fanout`` (int32, <= cfg.fanout): sub-exchanges ``c >= fanout``
+      are masked to no-ops and the budget-dither salt uses the lane's
+      value, so a lane is bit-identical to a sequential run with
+      ``replace(cfg, fanout=...)`` (matching/permutation pairing only —
+      "choice" draws peers with shape-dependent PRNG streams).
+    - ``phi_threshold`` (float32): the FD liveness comparison's bound.
+    - ``writes_per_round`` (int32): the owner-side write rate.
+    - ``fault_seed`` (uint32, pre-masked to 32 bits): overrides
+      ``fault_plan.seed`` in the probabilistic link draws — one lane
+      per plan-ensemble member (faults/sim.py).
+    """
+
+    fanout: jax.Array | None = None
+    phi_threshold: jax.Array | None = None
+    writes_per_round: jax.Array | None = None
+    fault_seed: jax.Array | None = None
+
+
 def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> SimState:
     """Fresh cluster: every node owns ``keys_per_node`` versions (versions
     1..K) — or per-node counts via ``initial_versions`` — knows only

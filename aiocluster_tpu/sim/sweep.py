@@ -1,0 +1,475 @@
+"""Multi-scenario sweeps: vmap-batched simulation over a lane axis.
+
+One process used to simulate exactly one (seed, config, fault-plan)
+tuple and pay the full XLA compile for it. The FaultPlan library and
+the tuning workloads (phi-threshold sweeps, seed ensembles, fault-plan
+sensitivity) want dozens of variants at once — so ``SweepSimulator``
+adds a LANE axis: ``sim_step`` is vmapped over a leading scenario
+dimension of S lanes, where each lane gets
+
+- its own PRNG seed (``random.key(seed)`` per lane — exactly the key a
+  sequential ``Simulator(cfg, seed=...)`` would use),
+- its own fault-plan salt (the link-fault draws depend on the plan only
+  through ``plan.seed``, so a traced per-lane seed reproduces
+  ``replace(plan, seed=...)`` bit-for-bit — faults/sim.py), and
+- its own values for the declared sweepable scalars — ``fanout``,
+  ``phi_threshold``, ``writes_per_round`` — lifted from static config
+  fields to per-lane traced operands (``SweepParams``, ops/gossip.py).
+
+One jit compile therefore serves all S scenarios. Per-lane convergence
+flags accumulate ON DEVICE (the ``first`` tick array rides the chunk
+carry), so lanes retire without per-chunk host syncs; the host polls a
+single all-lanes-done scalar per chunk, exactly like the sequential
+driver's chunk-boundary poll. Results come back as a ``SweepResult``
+table: per-lane rounds-to-convergence, version spread, and final
+convergence metrics.
+
+Sweeps compose with the ``owners`` shard axis (parallel/mesh.py): lane
+x owner-sharded matrices are (S, N, n_local) with lanes and rows
+unsharded, and every collective becomes one batched (S,)-wide dispatch.
+
+Bit-identity contract (tests/test_sweep.py): an S-lane sweep is
+bit-identical to S sequential single-sim runs with the same seeds and
+the lane's values applied as static config fields — unsharded and under
+a mesh. Sweep steps run the plain XLA path (the fused Pallas kernels
+carry no lane axis), which preserves that contract on every backend
+because the kernels are bit-identical to XLA by construction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax import lax, random
+from jax.sharding import Mesh
+
+from ..obs.registry import MetricsRegistry
+from ..obs.sim import SweepMetrics
+from ..ops.gossip import resolve_variant_env, sim_step
+from ..parallel.mesh import (
+    shard_sweep_state,
+    sharded_sweep_chunk_fn,
+    sharded_sweep_metrics_fn,
+)
+from .config import SimConfig
+from .simulator import BoundedFnCache, _metrics_sample
+from .state import SimState, SweepParams, init_state
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _sweep_chunk(states, keys, sweep, cfg: SimConfig, m):
+    """m rounds for every lane (m traced — one compile per cfg)."""
+
+    def one_lane(state, key, sw):
+        return lax.fori_loop(
+            0, m, lambda _, s: sim_step(s, key, cfg, sweep=sw), state
+        )
+
+    return jax.vmap(one_lane)(states, keys, sweep)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _sweep_chunk_tracked(states, keys, sweep, first, cfg: SimConfig, m):
+    """m rounds per lane + the per-lane first-converged tick accumulator
+    (0 = not yet), carried across chunks on device."""
+    import jax.numpy as jnp
+
+    def one_lane(state, key, sw, f0):
+        def one(_, carry):
+            s, f = carry
+            s, conv = sim_step(s, key, cfg, sweep=sw, return_converged=True)
+            f = jnp.where((f == 0) & conv, s.tick, f)
+            return s, f
+
+        return lax.fori_loop(0, m, one, (state, f0))
+
+    return jax.vmap(one_lane)(states, keys, sweep, first)
+
+
+@jax.jit
+def _sweep_metrics(states):
+    return jax.vmap(_metrics_sample)(states)
+
+
+class SweepResult:
+    """Per-lane results table of one sweep (plain host data)."""
+
+    def __init__(
+        self,
+        *,
+        seeds: list[int],
+        params: dict[str, list],
+        rounds_to_convergence: list[int | None],
+        metrics: dict[str, np.ndarray],
+    ) -> None:
+        self.lanes = len(seeds)
+        self.seeds = list(seeds)
+        self.params = {k: list(v) for k, v in params.items()}
+        self.rounds_to_convergence = list(rounds_to_convergence)
+        self.version_spread = np.asarray(metrics["version_spread"]).tolist()
+        self.converged_owners = np.asarray(metrics["converged_owners"]).tolist()
+        self.mean_fraction = np.asarray(metrics["mean_fraction"]).tolist()
+        self.min_fraction = np.asarray(metrics["min_fraction"]).tolist()
+        self.alive_count = np.asarray(metrics["alive_count"]).tolist()
+
+    def rows(self) -> list[dict]:
+        """One dict per lane — the table the bench/CLI prints."""
+        out = []
+        for lane in range(self.lanes):
+            row = {
+                "lane": lane,
+                "seed": self.seeds[lane],
+                "rounds_to_convergence": self.rounds_to_convergence[lane],
+                "version_spread": self.version_spread[lane],
+                "converged_owners": self.converged_owners[lane],
+                "mean_fraction": self.mean_fraction[lane],
+                "min_fraction": self.min_fraction[lane],
+                "alive_count": self.alive_count[lane],
+            }
+            for name, values in self.params.items():
+                row[name] = values[lane]
+            out.append(row)
+        return out
+
+    def summary(self) -> dict:
+        conv = [r for r in self.rounds_to_convergence if r]
+        return {
+            "lanes": self.lanes,
+            "lanes_converged": len(conv),
+            "rounds_to_convergence_min": min(conv) if conv else None,
+            "rounds_to_convergence_max": max(conv) if conv else None,
+            "swept": sorted(self.params),
+        }
+
+
+class SweepSimulator:
+    """Runs S simulated scenarios under ONE compiled step.
+
+    ``seeds`` declares the lanes (one per seed). The keyword lists —
+    ``fanout``, ``phi_threshold``, ``writes_per_round``, ``fault_seeds``
+    — are optional per-lane values for the sweepable scalars; each must
+    be length S when given. ``mesh`` composes lanes with the owner shard
+    axis. The per-lane trajectory is bit-identical to
+    ``Simulator(replace(cfg, <lane values>), seed=seeds[lane])``.
+    """
+
+    def __init__(
+        self,
+        cfg: SimConfig,
+        seeds,
+        *,
+        fanout=None,
+        phi_threshold=None,
+        writes_per_round=None,
+        fault_seeds=None,
+        mesh: Mesh | None = None,
+        chunk: int = 8,
+        initial_versions=None,
+        states: SimState | None = None,
+        metrics: MetricsRegistry | None = None,
+    ) -> None:
+        import jax.numpy as jnp
+
+        self.cfg = cfg = resolve_variant_env(cfg)
+        self.chunk = chunk
+        self.seeds = [int(s) for s in seeds]
+        lanes = len(self.seeds)
+        if lanes < 1:
+            raise ValueError("need at least one sweep lane (seed)")
+        if any(not (0 <= s < 2**32) for s in self.seeds):
+            # Lane keys are built from a uint32 array so they equal
+            # random.key(seed) exactly; 64-bit seeds would seed the
+            # upper key word differently.
+            raise ValueError("sweep seeds must be in [0, 2**32)")
+
+        def lane_list(name, values, lo=None, hi=None):
+            if values is None:
+                return None
+            values = list(values)
+            if len(values) != lanes:
+                raise ValueError(
+                    f"{name} must have one value per lane "
+                    f"({len(values)} != {lanes})"
+                )
+            if lo is not None and any(v < lo for v in values):
+                raise ValueError(f"{name} values must be >= {lo}")
+            if hi is not None and any(v > hi for v in values):
+                raise ValueError(f"{name} values must be <= {hi}")
+            return values
+
+        # cfg.fanout is the STATIC sub-exchange bound; lanes at a lower
+        # value mask their excess sub-exchanges to no-ops (gossip.py).
+        fanout = lane_list("fanout", fanout, lo=0, hi=cfg.fanout)
+        if fanout is not None and cfg.pairing == "choice":
+            raise ValueError(
+                "fanout sweeps require pairing='matching' or "
+                "'permutation' (sim_step's contract)"
+            )
+        phi_threshold = lane_list("phi_threshold", phi_threshold)
+        if phi_threshold is not None and not cfg.track_failure_detector:
+            raise ValueError("phi_threshold sweep requires the failure detector")
+        writes_per_round = lane_list("writes_per_round", writes_per_round, lo=0)
+        fault_seeds = lane_list("fault_seeds", fault_seeds)
+        if fault_seeds is not None and cfg.fault_plan is None:
+            raise ValueError("fault_seeds sweep requires cfg.fault_plan")
+
+        self.params: dict[str, list] = {}
+        for name, values in (
+            ("fanout", fanout),
+            ("phi_threshold", phi_threshold),
+            ("writes_per_round", writes_per_round),
+            ("fault_seeds", fault_seeds),
+        ):
+            if values is not None:
+                self.params[name] = values
+        self._sweep = SweepParams(
+            fanout=None if fanout is None else jnp.asarray(fanout, jnp.int32),
+            phi_threshold=(
+                None
+                if phi_threshold is None
+                else jnp.asarray(phi_threshold, jnp.float32)
+            ),
+            writes_per_round=(
+                None
+                if writes_per_round is None
+                else jnp.asarray(writes_per_round, jnp.int32)
+            ),
+            fault_seed=(
+                None
+                if fault_seeds is None
+                else jnp.asarray(
+                    [int(s) & 0xFFFFFFFF for s in fault_seeds], jnp.uint32
+                )
+            ),
+        )
+        # Horizon guard facts (host arithmetic only, like Simulator's):
+        # the version bound must charge the FASTEST-writing lane.
+        self._max_wpr = (
+            max(writes_per_round)
+            if writes_per_round is not None
+            else cfg.writes_per_round
+        )
+        # Lane keys: exactly random.key(seed) per lane (vmapped over a
+        # uint32 seed vector — bitwise equal to the scalar construction,
+        # so lane randomness matches the sequential Simulator's).
+        self._keys = jax.vmap(random.key)(
+            jnp.asarray(self.seeds, jnp.uint32)
+        )
+        self._host_tick = 0
+        self._version_base_tick = 0
+        if states is not None:
+            # A provided lane-batched state (checkpoint resume) skips
+            # the fresh broadcast entirely — peak memory stays at one
+            # sweep's worth, not two.
+            if np.shape(states.w)[0] != lanes:
+                raise ValueError(
+                    f"provided states carry {np.shape(states.w)[0]} "
+                    f"lanes, expected {lanes}"
+                )
+            self.states = states
+        else:
+            base = init_state(cfg, initial_versions)
+            # All lanes start from the same fresh state: materialize
+            # the broadcast so the buffers are real (donation rewrites
+            # them).
+            self.states = jax.tree.map(
+                lambda x: jnp.array(
+                    jnp.broadcast_to(x[None, ...], (lanes,) + x.shape)
+                ),
+                base,
+            )
+        self._known_max_version = int(np.asarray(self.states.max_version).max())
+        self._first = jnp.zeros((lanes,), jnp.int32)
+        self._mesh = mesh
+        self._obs = SweepMetrics(metrics) if metrics is not None else None
+        if mesh is not None:
+            self.states = shard_sweep_state(self.states, mesh)
+            self._chunk_fns = BoundedFnCache(maxsize=4)
+            self._sharded_metrics = sharded_sweep_metrics_fn(mesh)
+
+    @property
+    def lanes(self) -> int:
+        return len(self.seeds)
+
+    # -- stepping -------------------------------------------------------------
+
+    def _check_horizon(self, rounds: int) -> None:
+        """Simulator._check_horizon with the sweep's worst-lane write
+        rate (host-side arithmetic; no device traffic)."""
+        end_tick = self._host_tick + rounds
+        cfg = self.cfg
+        if (
+            cfg.track_heartbeats
+            and cfg.heartbeat_dtype == "int16"
+            and end_tick >= 2**15
+        ):
+            raise ValueError(
+                f"running to tick {end_tick} overflows int16 heartbeats"
+            )
+        if cfg.version_dtype == "int16":
+            bound = self._known_max_version + self._max_wpr * (
+                end_tick - self._version_base_tick
+            )
+            if bound >= 2**15:
+                raise ValueError(
+                    f"versions may reach {bound} by tick {end_tick}, "
+                    "overflowing version_dtype='int16'"
+                )
+
+    def _sharded_chunk(self, tracked: bool):
+        return self._chunk_fns.get_or_build(
+            ("sweep-tracked" if tracked else "sweep",),
+            lambda: sharded_sweep_chunk_fn(
+                self.cfg, self._mesh, tracked=tracked
+            ),
+        )
+
+    def run(self, rounds: int) -> None:
+        """Advance every lane by a fixed number of gossip rounds."""
+        self._check_horizon(rounds)
+        done = 0
+        while done < rounds:
+            m = min(self.chunk, rounds - done)
+            if self._mesh is not None:
+                self.states = self._sharded_chunk(False)(
+                    self.states, self._keys, self._sweep, m
+                )
+            else:
+                self.states = _sweep_chunk(
+                    self.states, self._keys, self._sweep, self.cfg, m
+                )
+            done += m
+            self._host_tick += m
+
+    def run_until_converged(self, max_rounds: int = 100_000) -> list[int | None]:
+        """Step all lanes until each has held full convergence once (or
+        ``max_rounds`` elapsed); returns the per-lane EXACT first
+        converged round (None = lane never converged). The flags
+        accumulate on device — the host reads ONE scalar per chunk (the
+        all-lanes-retired test), the same amortized chunk-boundary poll
+        the sequential driver makes."""
+        import jax.numpy as jnp
+
+        # Entry check mirrors Simulator's converged-before-stepping
+        # answer: a lane already converged records the CURRENT tick (a
+        # tick-0 pre-convergence needs keys_per_node == 0, where the 0
+        # sentinel is ambiguous — no real config hits that).
+        conv0 = np.asarray(self.metrics()["all_converged"])
+        if conv0.any():
+            first = np.asarray(self._first).copy()
+            mask = (first == 0) & conv0
+            if mask.any():
+                first[mask] = self._host_tick
+                self._first = jnp.asarray(first, jnp.int32)
+        while self._host_tick < max_rounds:
+            if bool(np.asarray((self._first != 0).all())):  # noqa: ACT021 -- one scalar per chunk, the amortized retirement poll
+                break
+            m = min(self.chunk, max_rounds - self._host_tick)
+            self._check_horizon(m)
+            if self._mesh is not None:
+                self.states, self._first = self._sharded_chunk(True)(
+                    self.states, self._keys, self._sweep, self._first, m
+                )
+            else:
+                self.states, self._first = _sweep_chunk_tracked(
+                    self.states,
+                    self._keys,
+                    self._sweep,
+                    self._first,
+                    self.cfg,
+                    m,
+                )
+            self._host_tick += m
+        first = np.asarray(self._first)
+        out = [int(f) if f else None for f in first.tolist()]
+        if self._obs is not None:
+            self._obs.update(out)
+        return out
+
+    # -- observation ----------------------------------------------------------
+
+    def metrics(self) -> dict[str, np.ndarray]:
+        """Per-lane convergence metrics: dict of (S,) host arrays (one
+        sync for the whole bundle)."""
+        if self._mesh is not None:
+            m = self._sharded_metrics(self.states)
+        else:
+            m = _sweep_metrics(self.states)
+        return {k: np.asarray(v) for k, v in m.items()}
+
+    def result(self) -> SweepResult:
+        """The per-lane results table at the current state (one metrics
+        sync; rounds-to-convergence reflects what run_until_converged
+        has observed so far)."""
+        first = np.asarray(self._first)
+        rounds = [int(f) if f else None for f in first.tolist()]
+        metrics = self.metrics()
+        if self._obs is not None:
+            self._obs.update(rounds, metrics["version_spread"])
+        return SweepResult(
+            seeds=self.seeds,
+            params=self.params,
+            rounds_to_convergence=rounds,
+            metrics=metrics,
+        )
+
+    @property
+    def tick(self) -> int:
+        return self._host_tick
+
+    # -- checkpoint / resume ---------------------------------------------------
+
+    def save(self, path) -> None:
+        """Checkpoint all lanes (gathers to host), plus seeds, sweep
+        values and the convergence accumulator."""
+        from .checkpoint import save_sweep
+
+        save_sweep(
+            path,
+            jax.device_get(self.states),
+            self.cfg,
+            seeds=self.seeds,
+            params=self.params,
+            first=np.asarray(self._first),
+            host_tick=self._host_tick,
+        )
+
+    @classmethod
+    def resume(
+        cls,
+        path,
+        *,
+        mesh: Mesh | None = None,
+        chunk: int = 8,
+        metrics: MetricsRegistry | None = None,
+    ) -> "SweepSimulator":
+        """Continue a checkpointed sweep on any device layout (lane
+        randomness is keyed by (seed, tick), exactly like the
+        single-scenario resume)."""
+        import jax.numpy as jnp
+
+        from .checkpoint import load_sweep
+
+        states, cfg, meta = load_sweep(path)
+        params = meta["params"]
+        sim = cls(
+            cfg,
+            meta["seeds"],
+            fanout=params.get("fanout"),
+            phi_threshold=params.get("phi_threshold"),
+            writes_per_round=params.get("writes_per_round"),
+            fault_seeds=params.get("fault_seeds"),
+            mesh=mesh,
+            chunk=chunk,
+            states=states,  # __init__ skips the fresh broadcast
+            metrics=metrics,  # (and shards the provided states on a mesh)
+        )
+        sim._first = jnp.asarray(meta["first"], jnp.int32)
+        sim._host_tick = int(meta["host_tick"])
+        # The resumed guard charges writes only for ticks run SINCE the
+        # checkpoint: the checkpointed max_version already contains its
+        # past writes (same contract as Simulator's version_base_tick).
+        sim._version_base_tick = sim._host_tick
+        return sim

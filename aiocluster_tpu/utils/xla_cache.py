@@ -1,0 +1,110 @@
+"""Persistent XLA compilation cache wiring, in one place.
+
+The library/bench pays ~30 s of XLA compile before the first 10k-node
+chunk runs (BENCH_r05); the persistent compilation cache makes every
+rerun of the same program skip straight to execution. Until this
+module, only ad-hoc scripts under benchmarks/records/ set it up, each
+with its own copy of the three config lines — now bench.py, the sim
+CLI and those scripts all call :func:`enable_persistent_cache`.
+
+Resolution order for the cache directory:
+
+1. the explicit ``cache_dir`` argument (the records scripts pass their
+   ``NORTHSTAR_CACHE`` location through it);
+2. the ``AIOCLUSTER_XLA_CACHE`` environment variable — set it to ``off``
+   (or ``0`` / ``none``) to disable the cache entirely;
+3. ``<repo>/build/xla_cache`` (the repo's build dir, next to the other
+   generated artifacts), falling back to a per-user temp dir when the
+   package is installed somewhere read-only.
+
+Failures are non-fatal by design: a bench or sim run must never die
+because a cache directory could not be created.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+ENV_VAR = "AIOCLUSTER_XLA_CACHE"
+_DISABLED = ("off", "0", "none", "disabled")
+
+
+def default_cache_dir() -> str | None:
+    """The directory :func:`enable_persistent_cache` would use, or None
+    when the env var disables caching."""
+    env = os.environ.get(ENV_VAR)
+    if env is not None:
+        if env.strip().lower() in _DISABLED:
+            return None
+        return env
+    # aiocluster_tpu/utils/xla_cache.py -> <repo>/build/xla_cache
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    return os.path.join(root, "build", "xla_cache")
+
+
+def enable_persistent_cache(
+    cache_dir: str | None = None,
+    *,
+    min_compile_seconds: float = 1.0,
+    log=None,
+) -> str | None:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (resolved per the module docstring). Returns the directory actually
+    enabled, or None when caching is disabled/unavailable. Idempotent;
+    safe to call before or after backend initialization."""
+
+    def say(msg: str) -> None:
+        if log is not None:
+            log(msg)
+
+    path = cache_dir if cache_dir is not None else default_cache_dir()
+    if path is None:
+        say("persistent XLA cache disabled via " + ENV_VAR)
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, ".writable")
+        with open(probe, "w"):
+            pass
+        os.remove(probe)
+    except OSError:
+        fallback = os.path.join(
+            tempfile.gettempdir(), f"aiocluster_xla_cache_{os.getuid()}"
+        )
+        say(f"cache dir {path!r} unwritable; falling back to {fallback!r}")
+        try:
+            os.makedirs(fallback, exist_ok=True)
+            path = fallback
+        except OSError as exc:
+            say(f"persistent XLA cache unavailable: {exc!r}")
+            return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_seconds
+        )
+    except Exception as exc:  # old jax without the knob, etc.
+        say(f"persistent XLA cache not enabled: {exc!r}")
+        return None
+    say(f"persistent XLA cache: {path}")
+    return path
+
+
+def entry_count(cache_dir: str | None) -> int:
+    """Number of cache entries currently on disk (0 for a missing or
+    disabled cache) — the cheap hit/miss probe bench.py records."""
+    if not cache_dir:
+        return 0
+    try:
+        return sum(
+            1
+            for name in os.listdir(cache_dir)
+            if not name.startswith(".") and not name.endswith(".tmp")
+        )
+    except OSError:
+        return 0

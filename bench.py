@@ -555,6 +555,17 @@ def runtime_handshake_bench(log) -> dict | None:
     return _run_benchmarks_helper("handshake_bench", "measure", log, log=log)
 
 
+def sweep_bench(log, smoke: bool) -> dict | None:
+    """The multi-scenario throughput datum (benchmarks/sweep_bench.py):
+    an S-lane vmapped sweep's wall time vs S sequential single-scenario
+    runs of the same scenarios (each paying its own compile — distinct
+    static configs), plus lane-rounds/s. Rides every record: the sweep
+    engine is how scenario studies are meant to be run."""
+    return _run_benchmarks_helper(
+        "sweep_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 def convergence_under_fault_bench(log, smoke: bool) -> dict | None:
     """The robustness trajectory datum (benchmarks/fault_bench.py):
     time to re-converge after a 3-way partition heals — wall-clock
@@ -579,6 +590,9 @@ STDOUT_LINE_CAP = 2000
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
     "budget",
+    "sweep_amortization_ratio",
+    "sim_sweep_lane_rounds_per_sec",
+    "compile_cache_hit",
     "sim_fault_reconverge_rounds",
     "fault_reconverge_seconds",
     "runtime_handshakes_per_sec_per_round",
@@ -636,6 +650,14 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         "sim_fault_reconverge_rounds": (fb.get("sim") or {}).get(
             "sim_fault_reconverge_rounds"
         ),
+        # S-lane sweep throughput + compile amortization (sweep_bench).
+        "sim_sweep_lane_rounds_per_sec": (ex.get("sweep_bench") or {}).get(
+            "sim_sweep_lane_rounds_per_sec"
+        ),
+        "sweep_amortization_ratio": (ex.get("sweep_bench") or {}).get(
+            "amortization_ratio"
+        ),
+        "compile_cache_hit": ex.get("compile_cache_hit"),
         "rounds_to_convergence": ex.get("rounds_to_convergence"),
         "pallas_variant": ex.get("pallas_variant_engaged"),
         "pallas_speedup": ex.get("pallas_speedup"),
@@ -823,7 +845,8 @@ def sim_rounds_per_sec(
             cfg = sim.cfg
             sim.run(sim.chunk)
             sync()
-    log(f"compile+first chunk: {time.perf_counter() - t0:.1f}s")
+    compile_first_chunk_s = time.perf_counter() - t0
+    log(f"compile+first chunk: {compile_first_chunk_s:.1f}s")
 
     # The tunnel to the TPU is shared and noisy; take the best of three
     # trials as the device's attainable rate.
@@ -842,7 +865,7 @@ def sim_rounds_per_sec(
     # Telemetry-overhead arm (obs/): the same config with the stride-64
     # metrics sampler attached — the BENCH record carries the measured
     # cost of leaving metrics on, and the registry snapshot itself.
-    extra: dict = {}
+    extra: dict = {"compile_first_chunk_seconds": round(compile_first_chunk_s, 2)}
     try:
         from aiocluster_tpu.obs import MetricsRegistry
 
@@ -1101,6 +1124,17 @@ def main() -> None:
         platform = jax.default_backend()
         log(f"platform: {platform}")
 
+        # Persistent XLA compilation cache (utils/xla_cache.py): a warm
+        # cache lets a second bench run skip the ~30 s sim compile. The
+        # entry counts around the sim phase are the hit/miss probe.
+        from aiocluster_tpu.utils.xla_cache import (
+            enable_persistent_cache,
+            entry_count,
+        )
+
+        xla_cache_dir = enable_persistent_cache(log=log)
+        cache_entries_before = entry_count(xla_cache_dir)
+
         from aiocluster_tpu.ops.gossip import on_accelerator
 
         on_accel = on_accelerator()
@@ -1119,6 +1153,24 @@ def main() -> None:
             # watchdog.
             max_converge_rounds=None if on_accel or args.smoke else 64,
         )
+        # Cache verdict for the SIM phase specifically (snapshot before
+        # later phases compile their own programs): a warm cache writes
+        # no new entries, so before == after (> 0) means every compile
+        # was served from disk.
+        cache_entries_after = entry_count(xla_cache_dir)
+        compile_cache_hit = bool(
+            xla_cache_dir
+            and cache_entries_before > 0
+            and cache_entries_after == cache_entries_before
+        )
+        sim_extra["compile_cache"] = {
+            "dir": xla_cache_dir,
+            "entries_before": cache_entries_before,
+            "entries_after": cache_entries_after,
+        }
+        sim_extra["compile_cache_hit"] = compile_cache_hit
+        log(f"compile cache: {cache_entries_before} -> "
+            f"{cache_entries_after} entries (hit={compile_cache_hit})")
         baseline_rps = python_rounds_per_sec(n_nodes)
         log(f"python object-model estimate: {baseline_rps:.4f} rounds/s")
         probe_rps = None
@@ -1186,6 +1238,10 @@ def main() -> None:
         # handshake datum, also on every record (sim arm at 10k nodes
         # in full runs, 1,280 in smoke).
         fault_rec = convergence_under_fault_bench(log, args.smoke)
+        # Sweep engine: S-lane vmapped multi-scenario wall time vs S
+        # sequential single-scenario runs (compile amortization is the
+        # point — benchmarks/sweep_bench.py).
+        sweep_rec = sweep_bench(log, args.smoke)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1236,6 +1292,9 @@ def main() -> None:
                 # Reconvergence after a healed 3-way partition, both
                 # backends, one seeded plan (benchmarks/fault_bench.py).
                 "fault_bench": fault_rec,
+                # S-lane sweep vs S sequential runs: lane-rounds/s and
+                # the compile-amortization ratio (sweep_bench.py).
+                "sweep_bench": sweep_rec,
                 # Round-4 flagship: the measured (mesh-certified) 100k
                 # rounds-to-convergence + its v5e-8 projection.
                 "northstar_100k": load_northstar_record(log),

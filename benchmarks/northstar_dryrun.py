@@ -54,12 +54,14 @@ def main() -> None:
     # Persistent compilation cache: the sharded 100k program takes
     # ~15-20 min to build on one core; cache it so reruns skip straight
     # to execution.
-    cache_dir = os.environ.get(
-        "NORTHSTAR_CACHE", os.path.join("/tmp", "northstar_xla_cache")
+    from aiocluster_tpu.utils.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache(
+        os.environ.get(
+            "NORTHSTAR_CACHE", os.path.join("/tmp", "northstar_xla_cache")
+        ),
+        min_compile_seconds=10,
     )
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
     import numpy as np
 
     from aiocluster_tpu.parallel.mesh import make_mesh

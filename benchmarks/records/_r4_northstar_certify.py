@@ -75,12 +75,12 @@ def _mesh():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    cache_dir = os.environ.get(
-        "NORTHSTAR_CACHE", "/tmp/northstar_xla_cache"
+    from aiocluster_tpu.utils.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache(
+        os.environ.get("NORTHSTAR_CACHE", "/tmp/northstar_xla_cache"),
+        min_compile_seconds=10,
     )
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
     from aiocluster_tpu.parallel.mesh import make_mesh
 
     devices = jax.devices()[:N_DEV]

@@ -1,0 +1,14 @@
+# analyze-domain: sim
+"""TN: the whole lane axis converts once, after (or instead of) the
+loop — no per-lane device traffic."""
+
+import numpy as np
+
+
+def collect(first, spread, lanes):
+    rounds = [int(r) for r in np.asarray(first).tolist()]
+    worst = float(np.asarray(spread).max())
+    total = 0
+    for r in rounds:  # host list iteration; int() of the loop var only
+        total += int(r)
+    return rounds, worst, total

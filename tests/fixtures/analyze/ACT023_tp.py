@@ -1,0 +1,12 @@
+# analyze-domain: sim
+"""TP: per-lane host syncs on lane-indexed arrays inside sweep loops."""
+
+
+def collect(first, spread, lanes):
+    rounds = []
+    for lane in range(lanes):
+        rounds.append(int(first[lane]))  # one device sync per lane
+    worst = 0.0
+    for i in range(lanes):
+        worst = max(worst, spread[i].item())
+    return rounds, worst

@@ -1,0 +1,394 @@
+"""Sweep engine (sim/sweep.py): vmap-batched multi-scenario simulation.
+
+The load-bearing contract is BIT-IDENTITY: an S-lane sweep must equal S
+sequential single-sim runs with the same seeds and the lane's sweep
+values applied as static config fields — unsharded and under a 2-shard
+mesh — and a swept FaultPlan lane must match the single-plan masks from
+faults/sim.py tick-for-tick. Alongside it: the tail-chunk retrace fix
+(bounded jit compilations across mixed, non-chunk-multiple round
+counts), the bounded chunk-fn cache + its obs gauge, sweep
+checkpoint/resume, and the lane-aware memory plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiocluster_tpu.faults.scenarios import flaky_links
+from aiocluster_tpu.faults.sim import link_ok
+from aiocluster_tpu.sim import SimConfig, Simulator
+from aiocluster_tpu.sim import simulator as simulator_mod
+from aiocluster_tpu.sim.simulator import BoundedFnCache
+from aiocluster_tpu.sim.sweep import SweepSimulator
+
+STATE_FIELDS = (
+    "w", "hb_known", "live_view", "max_version", "heartbeat",
+    "imean", "icount", "last_change",
+)
+
+
+def _assert_lane_equals_state(sweep, lane, sim):
+    for field in STATE_FIELDS:
+        a = np.asarray(getattr(sim.state, field))
+        b = np.asarray(getattr(sweep.states, field))[lane]
+        assert np.array_equal(a, b), f"lane {lane} diverged in {field}"
+    assert int(sim.state.tick) == int(np.asarray(sweep.states.tick)[lane])
+
+
+def _sequential(cfg, seed, lane_values, rounds=None, max_rounds=None, chunk=8):
+    sim = Simulator(
+        dataclasses.replace(cfg, **lane_values), seed=seed, chunk=chunk
+    )
+    if rounds is not None:
+        sim.run(rounds)
+        return sim, None
+    return sim, sim.run_until_converged(max_rounds=max_rounds)
+
+
+CFG = SimConfig(n_nodes=64, keys_per_node=16, budget=32, fanout=3)
+SEEDS = [0, 1, 2]
+PHIS = [7.0, 8.0, 9.5]
+WPRS = [0, 1, 2]
+FANS = [1, 2, 3]
+
+
+def test_sweep_bit_identical_to_sequential_unsharded():
+    """All three sweepable scalars at once, 17 rounds (a non-chunk
+    multiple: exercises the masked/odd tail)."""
+    sweep = SweepSimulator(
+        CFG, SEEDS, phi_threshold=PHIS, writes_per_round=WPRS,
+        fanout=FANS, chunk=8,
+    )
+    sweep.run(17)
+    for lane, seed in enumerate(SEEDS):
+        sim, _ = _sequential(
+            CFG, seed,
+            dict(phi_threshold=PHIS[lane], writes_per_round=WPRS[lane],
+                 fanout=FANS[lane]),
+            rounds=17,
+        )
+        _assert_lane_equals_state(sweep, lane, sim)
+
+
+def test_sweep_rounds_to_convergence_matches_sequential():
+    """Per-lane EXACT first-converged round == the sequential answer,
+    and the per-lane flags accumulated on device (the retirement path)."""
+    cfg = dataclasses.replace(CFG, budget=256)
+    sweep = SweepSimulator(cfg, SEEDS, phi_threshold=PHIS, chunk=8)
+    got = sweep.run_until_converged(max_rounds=200)
+    assert all(r is not None for r in got)
+    for lane, seed in enumerate(SEEDS):
+        _, want = _sequential(
+            cfg, seed, dict(phi_threshold=PHIS[lane]), max_rounds=200
+        )
+        assert got[lane] == want
+    # Result table carries the same answers.
+    result = sweep.result()
+    assert result.rounds_to_convergence == got
+    assert result.summary()["lanes_converged"] == len(SEEDS)
+    assert all(s == 0 for s in result.version_spread)
+
+
+def test_sweep_permutation_pairing_fanout_lane():
+    """Fanout sweeping holds on the 'permutation' pairing too (both
+    handshake directions masked)."""
+    cfg = dataclasses.replace(CFG, pairing="permutation")
+    sweep = SweepSimulator(cfg, [3, 4], fanout=[1, 3], chunk=4)
+    sweep.run(9)
+    for lane, (seed, f) in enumerate(zip([3, 4], [1, 3])):
+        sim, _ = _sequential(cfg, seed, dict(fanout=f), rounds=9)
+        _assert_lane_equals_state(sweep, lane, sim)
+
+
+@pytest.mark.slow
+def test_sweep_sharded_bit_identical_to_sequential():
+    """Lanes compose with the owners shard axis: a 2-shard sweep equals
+    the sequential single-device runs bit-for-bit, and rounds-to-
+    convergence parity holds through the sharded tracked chunk."""
+    from aiocluster_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:2])
+    sweep = SweepSimulator(
+        CFG, SEEDS, phi_threshold=PHIS, writes_per_round=WPRS,
+        fanout=FANS, chunk=8, mesh=mesh,
+    )
+    sweep.run(17)
+    for lane, seed in enumerate(SEEDS):
+        sim, _ = _sequential(
+            CFG, seed,
+            dict(phi_threshold=PHIS[lane], writes_per_round=WPRS[lane],
+                 fanout=FANS[lane]),
+            rounds=17,
+        )
+        _assert_lane_equals_state(sweep, lane, sim)
+
+    cfg = dataclasses.replace(CFG, budget=256)
+    tracked = SweepSimulator(cfg, SEEDS, chunk=8, mesh=mesh)
+    got = tracked.run_until_converged(max_rounds=200)
+    for lane, seed in enumerate(SEEDS):
+        _, want = _sequential(cfg, seed, {}, max_rounds=200)
+        assert got[lane] == want
+
+
+def test_swept_fault_lane_matches_single_plan_masks():
+    """A lane's traced fault seed produces the single-plan masks of
+    ``replace(plan, seed=...)`` tick-for-tick, for every sub-exchange
+    direction — the free per-lane plan ensemble."""
+    plan = flaky_links(drop=0.3, seed=7)
+    n = 64
+    src = jnp.arange(n, dtype=jnp.int32)
+    dst = (src + 13) % n
+    for lane_seed in (7, 123, 99991):
+        plan_s = dataclasses.replace(plan, seed=lane_seed)
+        seed_arr = jnp.asarray(lane_seed & 0xFFFFFFFF, jnp.uint32)
+        for t in range(0, 20, 4):
+            tick = jnp.asarray(t, jnp.int32)
+            for sub in (0, 1, 5):
+                want = np.asarray(link_ok(plan_s, n, tick, src, dst, sub))
+                got = np.asarray(
+                    link_ok(plan, n, tick, src, dst, sub, seed=seed_arr)
+                )
+                assert np.array_equal(want, got), (lane_seed, t, sub)
+
+
+def test_swept_fault_lane_full_state_parity():
+    plan = flaky_links(drop=0.3, seed=7)
+    cfg = dataclasses.replace(CFG, fault_plan=plan)
+    fault_seeds = [7, 123]
+    sweep = SweepSimulator(cfg, [0, 0], fault_seeds=fault_seeds, chunk=8)
+    sweep.run(15)
+    for lane, fs in enumerate(fault_seeds):
+        cfg_lane = dataclasses.replace(
+            cfg, fault_plan=dataclasses.replace(plan, seed=fs)
+        )
+        sim = Simulator(cfg_lane, seed=0, chunk=8)
+        sim.run(15)
+        _assert_lane_equals_state(sweep, lane, sim)
+
+
+def test_sweep_validation():
+    with pytest.raises(ValueError, match="fanout sweeps require"):
+        SweepSimulator(
+            dataclasses.replace(CFG, pairing="choice"), [0, 1], fanout=[1, 2]
+        )
+    with pytest.raises(ValueError, match="one value per lane"):
+        SweepSimulator(CFG, [0, 1], phi_threshold=[8.0])
+    with pytest.raises(ValueError, match="fault_seeds sweep requires"):
+        SweepSimulator(CFG, [0, 1], fault_seeds=[1, 2])
+    with pytest.raises(ValueError, match="at least one"):
+        SweepSimulator(CFG, [])
+    with pytest.raises(ValueError, match="<= 3"):
+        SweepSimulator(CFG, [0], fanout=[4])
+    lean = dataclasses.replace(
+        CFG, track_failure_detector=False, track_heartbeats=False
+    )
+    with pytest.raises(ValueError, match="failure detector"):
+        SweepSimulator(lean, [0, 1], phi_threshold=[8.0, 9.0])
+
+
+def test_fanout_sweep_with_topology_rejected_by_sim_step():
+    """A topology forces the choice path (no sub_active masking), so a
+    swept fanout there would silently break bit-identity — sim_step
+    refuses at trace time."""
+    from aiocluster_tpu.ops.gossip import sim_step
+    from aiocluster_tpu.sim import init_state
+    from aiocluster_tpu.sim.state import SweepParams
+
+    state = init_state(CFG)
+    n = CFG.n_nodes
+    adj = jnp.tile(jnp.arange(n, dtype=jnp.int32)[None, :], (n, 1))
+    deg = jnp.full((n,), n, jnp.int32)
+    with pytest.raises(ValueError, match="without a topology"):
+        sim_step(
+            state, jax.random.key(0), CFG, adjacency=adj, degrees=deg,
+            sweep=SweepParams(fanout=jnp.asarray(2, jnp.int32)),
+        )
+
+
+# -- tail-chunk retrace fix ---------------------------------------------------
+
+
+def test_tail_chunk_compilations_bounded():
+    """Mixed, non-chunk-multiple round counts across repeated run() /
+    run_until_converged() calls compile a BOUNDED number of programs:
+    the chunk length is a traced operand, so after the first compile of
+    each chunk family the jit cache never grows (cache-size probe)."""
+    cfg = SimConfig(n_nodes=32, keys_per_node=8, budget=16)
+    sim = Simulator(cfg, seed=3, chunk=8)
+    sim.run(8)  # first compile of the untracked chunk
+    sim.run_until_converged(max_rounds=9)  # first compile of the tracked chunk
+    c0 = simulator_mod._chunk._cache_size()
+    t0 = simulator_mod._chunk_tracked._cache_size()
+    sim.run(5)
+    sim.run(3)
+    sim.run(13)
+    sim.run(1)
+    sim.run_until_converged(max_rounds=int(sim.tick) + 29)
+    sim2 = Simulator(cfg, seed=4, chunk=7)  # different chunk size, same cfg
+    sim2.run(11)
+    sim2.run_until_converged(max_rounds=23)
+    assert simulator_mod._chunk._cache_size() == c0
+    assert simulator_mod._chunk_tracked._cache_size() == t0
+
+
+@pytest.mark.slow
+def test_tail_chunk_compilations_bounded_sharded():
+    """The sharded driver holds ONE compiled fn per chunk family in its
+    bounded cache regardless of tail lengths."""
+    from aiocluster_tpu.parallel.mesh import make_mesh
+
+    cfg = SimConfig(n_nodes=32, keys_per_node=8, budget=64)
+    sim = Simulator(cfg, seed=3, chunk=8, mesh=make_mesh(jax.devices()[:2]))
+    sim.run(5)
+    sim.run(3)
+    sim.run(13)
+    sim.run_until_converged(max_rounds=int(sim.tick) + 17)
+    assert len(sim._chunk_fns) <= 2  # one untracked + one tracked
+
+
+def test_bounded_fn_cache_evicts_lru():
+    cache = BoundedFnCache(maxsize=2)
+    a = cache.get_or_build("a", lambda: "A")
+    b = cache.get_or_build("b", lambda: "B")
+    assert (a, b) == ("A", "B") and len(cache) == 2
+    assert cache.get_or_build("a", lambda: "A2") == "A"  # hit, refreshed
+    cache.get_or_build("c", lambda: "C")  # evicts b (oldest)
+    assert len(cache) == 2
+    assert cache.get_or_build("b", lambda: "B2") == "B2"  # rebuilt
+    with pytest.raises(ValueError):
+        BoundedFnCache(maxsize=0)
+
+
+@pytest.mark.slow
+def test_chunk_cache_gauge_exported():
+    """The obs registry carries aiocluster_sim_chunk_cache_size for a
+    mesh-driven simulator."""
+    from aiocluster_tpu.obs import MetricsRegistry
+    from aiocluster_tpu.parallel.mesh import make_mesh
+
+    registry = MetricsRegistry()
+    cfg = SimConfig(n_nodes=32, keys_per_node=8, budget=64)
+    sim = Simulator(
+        cfg, seed=0, chunk=4, mesh=make_mesh(jax.devices()[:2]),
+        metrics=registry,
+    )
+    sim.run(4)
+    from aiocluster_tpu.obs.expo import render_prometheus
+
+    text = render_prometheus(registry)
+    assert "aiocluster_sim_chunk_cache_size" in text
+    sample = [
+        ln for ln in text.splitlines()
+        if ln.startswith("aiocluster_sim_chunk_cache_size{")
+    ]
+    assert sample and float(sample[0].rsplit(" ", 1)[1]) >= 1
+
+
+# -- checkpoint / memory / obs ------------------------------------------------
+
+
+def test_sweep_checkpoint_roundtrip(tmp_path):
+    path = tmp_path / "sweep.npz"
+    sweep = SweepSimulator(CFG, SEEDS, writes_per_round=WPRS, chunk=8)
+    sweep.run(10)
+    sweep.save(path)
+    resumed = SweepSimulator.resume(path, chunk=8)
+    assert resumed.seeds == SEEDS
+    assert resumed.params["writes_per_round"] == WPRS
+    assert resumed.tick == 10
+    resumed.run(7)
+    straight = SweepSimulator(CFG, SEEDS, writes_per_round=WPRS, chunk=8)
+    straight.run(17)
+    for field in STATE_FIELDS:
+        assert np.array_equal(
+            np.asarray(getattr(resumed.states, field)),
+            np.asarray(getattr(straight.states, field)),
+        ), field
+
+
+def test_fault_plan_checkpoint_roundtrip(tmp_path):
+    """asdict() turns the frozen FaultPlan into plain dicts inside the
+    checkpoint meta; both loaders must rebuild it through
+    FaultPlan.from_dict (found by the sweep-resume drive — the
+    single-sim loader had the same latent bug)."""
+    plan = flaky_links(drop=0.2, seed=3)
+    cfg = dataclasses.replace(CFG, fault_plan=plan)
+
+    sweep = SweepSimulator(cfg, [0, 1], fault_seeds=[3, 4], chunk=4)
+    sweep.run(6)
+    spath = tmp_path / "sweep_fault.npz"
+    sweep.save(spath)
+    resumed = SweepSimulator.resume(spath, chunk=4)
+    assert resumed.cfg.fault_plan == plan
+    resumed.run(6)
+    straight = SweepSimulator(cfg, [0, 1], fault_seeds=[3, 4], chunk=4)
+    straight.run(12)
+    assert np.array_equal(
+        np.asarray(resumed.states.w), np.asarray(straight.states.w)
+    )
+
+    sim = Simulator(cfg, seed=0, chunk=4)
+    sim.run(6)
+    path = tmp_path / "single_fault.npz"
+    sim.save(path)
+    back = Simulator.resume(path, chunk=4)
+    assert back.cfg.fault_plan == plan
+
+
+def test_sweep_checkpoint_rejected_by_single_loader(tmp_path):
+    from aiocluster_tpu.sim.checkpoint import load_state
+
+    path = tmp_path / "sweep.npz"
+    sweep = SweepSimulator(CFG, [0, 1], chunk=4)
+    sweep.run(4)
+    sweep.save(path)
+    with pytest.raises(ValueError, match="sweep checkpoint"):
+        load_state(path)
+
+
+def test_memory_plan_lane_aware():
+    from aiocluster_tpu.sim.memory import lean_config, plan
+
+    cfg = lean_config(1024)
+    one = plan(cfg)
+    eight = plan(cfg, lanes=8)
+    assert one.lanes == 1 and eight.lanes == 8
+    assert eight.state_bytes == 8 * one.state_bytes
+    # Sweeps run the XLA path: the pairs-kernel zero-transient discount
+    # must NOT apply to a multi-lane plan even when the single-lane
+    # config would earn it.
+    assert eight.transient_bytes >= 8 * one.transient_bytes
+    assert eight.transient_bytes > 0
+    with pytest.raises(ValueError):
+        plan(cfg, lanes=0)
+
+
+def test_sweep_metrics_gauges():
+    from aiocluster_tpu.obs import MetricsRegistry
+
+    registry = MetricsRegistry()
+    cfg = dataclasses.replace(CFG, budget=256)
+    sweep = SweepSimulator(cfg, SEEDS, chunk=8, metrics=registry)
+    sweep.run_until_converged(max_rounds=200)
+    sweep.result()
+    from aiocluster_tpu.obs.expo import render_prometheus
+
+    text = render_prometheus(registry)
+    assert "aiocluster_sim_sweep_lanes" in text
+    assert "aiocluster_sim_lane_rounds_to_convergence" in text
+    assert 'lane="0"' in text
+
+
+def test_sweep_result_rows():
+    sweep = SweepSimulator(CFG, SEEDS, phi_threshold=PHIS, chunk=8)
+    sweep.run(8)
+    rows = sweep.result().rows()
+    assert len(rows) == len(SEEDS)
+    assert rows[1]["seed"] == SEEDS[1]
+    assert rows[1]["phi_threshold"] == PHIS[1]
+    assert rows[0]["rounds_to_convergence"] is None  # run() doesn't track

@@ -169,6 +169,69 @@ def check_sync_in_loop(ctx: FileContext):
                 )
 
 
+@rule(
+    "ACT023",
+    "lane-sync-in-sweep-loop",
+    "per-lane host sync on a lane-indexed array inside a sweep loop",
+)
+def check_lane_sync_in_sweep_loop(ctx: FileContext):
+    """The sweep engine's failure mode: a host loop over lanes that
+    converts ONE element of a lane-axis device array per iteration
+    (``int(first[lane])``, ``np.asarray(spread[i])``, ``x[lane].item()``)
+    — S device syncs where one conversion of the whole array after the
+    loop would do (sim/sweep.py's idiom). Syntactic heuristic: the
+    synced expression is a Subscript indexed by the loop variable."""
+    if ctx.tree is None or not ({"sim", "ops"} & ctx.domains):
+        return
+    seen: set[tuple[int, int]] = set()
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        loop_vars = {
+            x.id for x in ast.walk(loop.target) if isinstance(x, ast.Name)
+        }
+        if not loop_vars:
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func)
+            synced: ast.expr | None = None
+            label = target
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("int", "float")
+                and len(node.args) == 1
+            ):
+                synced, label = node.args[0], f"{node.func.id}(...)"
+            elif target in SYNC_TARGETS and node.args:
+                synced, label = node.args[0], f"{target}(...)"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("item", "tolist")
+                and not node.args
+            ):
+                synced, label = node.func.value, f".{node.func.attr}()"
+            if not isinstance(synced, ast.Subscript):
+                continue
+            if not any(
+                isinstance(x, ast.Name) and x.id in loop_vars
+                for x in ast.walk(synced.slice)
+            ):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:  # nested loops: report each call site once
+                continue
+            seen.add(key)
+            yield ctx.finding(
+                node,
+                "ACT023",
+                f"'{label}' on a lane-indexed array inside a sweep loop "
+                "syncs the device once per lane (convert the whole lane "
+                "axis once, after the loop)",
+            )
+
+
 @rule("ACT022", "import-time-jnp", "jnp computation at module import time")
 def check_import_time_jnp(ctx: FileContext):
     tree = ctx.tree
