@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos sweep-bench check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos sweep-bench kernel-parity check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -42,10 +42,21 @@ chaos:
 sweep-bench:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/sweep_bench.py --smoke
 
+# Fused-round kernel parity (docs/sim.md): the one-pass pull+FD kernel
+# — slow interpret-mode differential tests included — must stay
+# bit-identical to the XLA path for lean/full/dead-grace/fault-masked
+# and multi-lane sweep configs, unsharded and under a 2-shard mesh.
+# This is the merge gate for kernel work when the accelerator is
+# unreachable; the compiled path is certified on-chip by bench.py.
+kernel-parity:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fused_kernel.py -q
+
 # What CI runs; a red suite, dirty lint, new analysis finding, a failed
-# chaos soak, or a sweep-amortization regression cannot land through
-# this gate.
-check: lint analyze sweep-bench test-all
+# chaos soak, a sweep-amortization regression, or a kernel-parity break
+# cannot land through this gate. (kernel-parity re-runs one test file
+# that test-all also covers — the explicit target keeps the merge gate
+# for kernel work nameable and runnable alone.)
+check: lint analyze kernel-parity sweep-bench test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

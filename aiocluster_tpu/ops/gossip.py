@@ -37,6 +37,7 @@ shard_map; ``None`` runs the identical math on one device.
 
 from __future__ import annotations
 
+import collections
 import os
 from functools import partial
 
@@ -376,6 +377,61 @@ def _fault_plan_active(cfg: SimConfig) -> bool:
     )
 
 
+# Loud-fallback ledger: every sim_step TRACE whose config WANTED the
+# fused kernels (use_pallas True, or "auto" on an accelerator) but was
+# routed to XLA bumps a reason-keyed counter here — a metric, not a
+# print, so tests and telemetry can pin "this config silently degraded"
+# (tests/test_fused_kernel.py). Counted at trace time: one increment
+# per compiled config, which is exactly the grain at which the decision
+# is made.
+pallas_fallbacks: collections.Counter = collections.Counter()
+
+
+def pallas_fallback_reason(
+    cfg: SimConfig,
+    axis_name: str | None = None,
+    *,
+    has_topology: bool = False,
+    n_local: int | None = None,
+    sweep: bool = False,
+) -> str | None:
+    """Why a kernel-wanting config fell back to XLA — the FIRST failing
+    gate, in the order ``pallas_path_engaged`` checks them (the shard
+    -width precondition first, then the config gates in the boolean's
+    written order, then variant/VMEM) — or None when the kernels
+    engaged (or were never wanted). A rejection no named gate explains
+    (a future gate added to pallas_path_engaged but not here) lands in
+    the catch-all "vmem_or_width", so the counter can under-label but
+    never miss a fallback; tests/test_fused_kernel.py pins one reason
+    per named gate. Feeds the ``pallas_fallbacks`` counter."""
+    if not _pallas_wanted(cfg):
+        return None
+    if axis_name is not None and n_local is None:
+        return "unknown_shard_width"
+    if has_topology:
+        return "topology"
+    if _fault_plan_active(cfg):
+        return "fault_plan"
+    if cfg.pairing != "matching":
+        return "pairing"
+    if cfg.fanout < 1:
+        return "fanout"
+    if cfg.n_nodes % 128 != 0:
+        return "shape"
+    if cfg.budget_policy != "proportional":
+        return "budget_policy"
+    if _lifecycle_enabled(cfg):
+        return "lifecycle"
+    if sweep and pallas_variant_engaged(cfg, axis_name, n_local) != "pairs":
+        return "sweep_needs_pairs"
+    if not pallas_path_engaged(
+        cfg, axis_name, has_topology=has_topology, n_local=n_local,
+        sweep=sweep,
+    ):
+        return "vmem_or_width"
+    return None
+
+
 def pallas_path_engaged(
     cfg: SimConfig,
     axis_name: str | None = None,
@@ -383,6 +439,7 @@ def pallas_path_engaged(
     has_topology: bool = False,
     n_local: int | None = None,
     assume_accelerator: bool = False,
+    sweep: bool = False,
 ) -> bool:
     """Single source of truth for whether sim_step routes matching
     sub-exchanges through the fused Pallas kernel for this config —
@@ -408,7 +465,15 @@ def pallas_path_engaged(
 
     ``has_topology``: adjacency-constrained runs force the choice path,
     so callers labelling a Simulator(..., topology=...) run must pass
-    True (sim_step itself never consults the gate on that path)."""
+    True (sim_step itself never consults the gate on that path).
+
+    ``sweep``: lane-batched steps (SweepSimulator vmaps sim_step over S
+    scenarios) engage the kernels too — the pairs family carries a lane
+    grid axis (pallas_pull.fused_pull_pairs_lanes) — but ONLY when the
+    pairs variant serves the shape: the single-pass m8 kernel and the
+    standalone FD kernel have no lane lift, so a sweep whose shape
+    falls off the pairs domain runs plain XLA (loudly — see
+    pallas_fallbacks)."""
     from . import pallas_pull
 
     if axis_name is not None and n_local is None:
@@ -439,6 +504,8 @@ def pallas_path_engaged(
     # rejected by the m8 block search.
     if pallas_variant_engaged(cfg, axis_name, n_local) == "pairs":
         return True  # pairs_supported held inside the variant decision
+    if sweep:
+        return False  # only the pairs family carries the lane axis
     itemsize = jnp.dtype(cfg.version_dtype).itemsize
     if cfg.track_heartbeats:
         itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
@@ -509,46 +576,114 @@ def pallas_variant_engaged(
     itemsize = jnp.dtype(cfg.version_dtype).itemsize
     if cfg.track_heartbeats:
         itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
+    # FD-fusing configs charge the epilogue's VMEM (last_change / imean
+    # / icount / live tiles + the hb0 stream) in the pairs fit check:
+    # the variant decision and the kernel that actually allocates must
+    # read one accounting or a width could pass the gate and then fail
+    # pairs_nbuf inside the wrapper.
+    fd_sizes = (
+        (
+            jnp.dtype(cfg.heartbeat_dtype).itemsize,
+            jnp.dtype(cfg.fd_dtype).itemsize,
+        )
+        if _fd_fusion_candidate(cfg)
+        else None
+    )
     use_pairs = variant in ("auto", "pairs") and pallas_pull.pairs_supported(
-        n, itemsize, cfg.track_heartbeats, n_local=width
+        n, itemsize, cfg.track_heartbeats, n_local=width, fd_sizes=fd_sizes
     )
     return "pairs" if use_pairs else "m8"
 
 
-def pallas_fd_engaged(cfg: SimConfig, n_local: int | None = None) -> bool:
-    """Whether the streaming FD kernel (ops/pallas_fd.py) replaces the
-    XLA failure-detection block for this config. Mirrors
-    ``pallas_path_engaged``'s resolution of ``use_pallas`` ("auto" = on a
-    real TPU; forcing True off-TPU runs interpreted, for tests). The
-    dead-node lifecycle stays on XLA: its branch rewrites w/hb and
-    carries dead_since, none of which the kernel models.
+def _fd_fusion_candidate(cfg: SimConfig) -> bool:
+    """Whether a pairs-served round would carry the fused FD epilogue —
+    the term the variant decision charges VMEM for. use_pallas_fd=False
+    pins the FD phase to XLA (the A/B seam), so those configs don't pay
+    the epilogue's footprint."""
+    return (
+        cfg.track_failure_detector
+        and not _lifecycle_enabled(cfg)
+        and cfg.use_pallas_fd is not False
+    )
 
-    Unlike the pull kernel, the FD math is purely per-element, so it
-    also engages under shard_map (each shard runs the kernel on its
-    (N, n_local) column block with its owner offset); pass the shard's
-    ``n_local`` so the lane-width check sees the LOCAL column count
-    (default: unsharded, n_local = n_nodes).
+
+def fd_phase_engaged(
+    cfg: SimConfig,
+    axis_name: str | None = None,
+    n_local: int | None = None,
+    *,
+    has_topology: bool = False,
+    assume_accelerator: bool = False,
+    sweep: bool = False,
+) -> str:
+    """Which implementation serves the round's failure-detection phase:
+
+    - "fused": the FD update rides the round's LAST pairs sub-exchange
+      (one Pallas dispatch for pull + FD — the fused round kernel);
+    - "kernel": the standalone streaming FD kernel (ops/pallas_fd.py),
+      the fallback when the pull phase is not pairs-served (m8 shapes,
+      choice/permutation pairing, use_pallas off with use_pallas_fd
+      forced);
+    - "xla": the plain XLA block (lifecycle configs, use_pallas_fd
+      pinned False, unsupported shapes, sweeps off the pairs domain);
+    - "off": no failure detector in this config.
+
+    THE single resolution consumed by sim_step's dispatch AND by
+    bench.py's ``fd_kernel`` stamp / bytes-per-round accounting
+    (sim/bytes.py), so the recorded provenance can never drift from
+    what the compiled step actually did."""
+    if not cfg.track_failure_detector:
+        return "off"
+    if _lifecycle_enabled(cfg) or cfg.use_pallas_fd is False:
+        return "xla"
+    if pallas_path_engaged(
+        cfg,
+        axis_name,
+        has_topology=has_topology,
+        n_local=n_local,
+        assume_accelerator=assume_accelerator,
+        sweep=sweep,
+    ) and pallas_variant_engaged(cfg, axis_name, n_local) == "pairs":
+        return "fused"
+    if sweep:
+        return "xla"  # the standalone FD kernel has no lane axis
+    from . import pallas_fd
+
+    wanted = cfg.use_pallas_fd is True or _pallas_wanted(
+        cfg, assume_accelerator
+    )
+    if wanted and pallas_fd.supported(
+        cfg.n_nodes,
+        cfg.n_nodes if n_local is None else n_local,
+        jnp.dtype(cfg.heartbeat_dtype).itemsize,
+        jnp.dtype(cfg.fd_dtype).itemsize,
+    ):
+        return "kernel"
+    return "xla"
+
+
+def pallas_fd_engaged(cfg: SimConfig, n_local: int | None = None) -> bool:
+    """Whether the FD phase runs in a Pallas kernel for this config —
+    fused into the round's last pairs sub-exchange OR the standalone
+    streaming kernel (``fd_phase_engaged`` says which; this is the
+    boolean consumers like mesh._check_vma and bench's ``fd_kernel``
+    stamp care about). Mirrors ``pallas_path_engaged``'s resolution of
+    ``use_pallas`` ("auto" = on a real TPU; forcing True off-TPU runs
+    interpreted, for tests). The dead-node lifecycle stays on XLA: its
+    branch rewrites w/hb and carries dead_since, which no kernel
+    models.
+
+    The FD math is purely per-element, so it engages under shard_map
+    too (each shard's (N, n_local) column block with its owner offset);
+    pass the shard's ``n_local`` so the lane-width check sees the LOCAL
+    column count (default: unsharded, n_local = n_nodes).
 
     ``cfg.use_pallas_fd`` refines the resolution independently of the
     pull kernel: False pins the FD phase to the XLA block (the on-chip
     A/B seam / kill switch), True forces the kernel, "auto" follows
-    ``use_pallas``. Bit-identical either way."""
-    from . import pallas_fd
-
-    if cfg.use_pallas_fd is False:
-        return False
-    wanted = cfg.use_pallas_fd is True or _pallas_wanted(cfg)
-    return (
-        wanted
-        and cfg.track_failure_detector
-        and not _lifecycle_enabled(cfg)
-        and pallas_fd.supported(
-            cfg.n_nodes,
-            cfg.n_nodes if n_local is None else n_local,
-            jnp.dtype(cfg.heartbeat_dtype).itemsize,
-            jnp.dtype(cfg.fd_dtype).itemsize,
-        )
-    )
+    ``use_pallas``. Bit-identical every way."""
+    axis = None if n_local is None or n_local == cfg.n_nodes else "owners"
+    return fd_phase_engaged(cfg, axis, n_local) in ("fused", "kernel")
 
 
 @partial(
@@ -580,8 +715,13 @@ def sim_step(
     can vmap one compiled step over a lane axis of scenarios. Each
     override reproduces EXACTLY the math of the corresponding static
     field (tests/test_sweep.py asserts lane-vs-sequential bit-identity).
-    Sweep steps always run the plain XLA path: the fused Pallas kernels
-    bake these scalars into their grids and carry no lane axis."""
+    Sweep steps engage the fused Pallas path too whenever the pairs
+    variant serves the shape: the pairs kernels carry a lane grid axis
+    (a custom_vmap rule in ops/pallas_pull.py routes the vmapped call
+    to it, per-lane scalars riding scalar prefetch), and a swept fanout
+    folds into the kernel's alive-pair mask. Off the pairs domain a
+    sweep runs plain XLA — and either way every lane stays bit-identical
+    to the equivalent sequential run (tests/test_fused_kernel.py)."""
     n = cfg.n_nodes
     n_local = state.w.shape[1]
     owners = _local_owner_ids(n_local, axis_name)
@@ -661,12 +801,30 @@ def sim_step(
     track_hb = cfg.track_heartbeats
     mv_vec = max_version[owners]
     hbv_vec = heartbeat[owners]
-    # Sweep steps pin the XLA path: the kernels' grids bake the swept
-    # scalars in, and the kernels are bit-identical to XLA anyway, so a
-    # lane still matches a kernel-served sequential run exactly.
-    use_pallas = sweep is None and pallas_path_engaged(
-        cfg, axis_name, has_topology=adjacency is not None, n_local=n_local
+    # Sweeps engage the kernels too (the pairs family carries a lane
+    # axis); the gate additionally requires the pairs variant then,
+    # because m8 and the standalone FD kernel have no lane lift.
+    use_pallas = pallas_path_engaged(
+        cfg, axis_name, has_topology=adjacency is not None, n_local=n_local,
+        sweep=sweep is not None,
     )
+    # Which implementation serves the FD phase this trace — the SAME
+    # resolution bench.py stamps into records (fd_kernel provenance).
+    fd_phase = fd_phase_engaged(
+        cfg, axis_name, n_local,
+        has_topology=adjacency is not None, sweep=sweep is not None,
+    )
+    if not use_pallas:
+        # Loud fallback: a config that WANTED the kernels but degraded
+        # to XLA bumps the reason-keyed counter (trace-time — once per
+        # compiled config), so silent-perf-loss regressions are visible
+        # in a metric instead of a vibe.
+        reason = pallas_fallback_reason(
+            cfg, axis_name, has_topology=adjacency is not None,
+            n_local=n_local, sweep=sweep is not None,
+        )
+        if reason is not None:
+            pallas_fallbacks[reason] += 1
     if use_pallas:
         diag = None
         w, hb = state.w, state.hb_known
@@ -691,6 +849,7 @@ def sim_step(
     lifecycle = _lifecycle_enabled(cfg)
     sched = scheduled_for_deletion_mask(state, cfg, tick)
     kernel_flag = None  # set when the pairs kernel carries the check
+    kernel_fd = None  # set when the fused FD rides the last sub-exchange
 
     rows = jnp.arange(n, dtype=jnp.int32)
 
@@ -748,6 +907,18 @@ def sim_step(
         # Interpreter mode off-TPU so the same config runs (slowly) in
         # CPU tests; the axon platform is a TPU PJRT plugin.
         interpret = not on_accelerator()
+        # Static FD constants for the fused epilogue (python scalars —
+        # part of the kernel's jit key, hoisted out of the loop).
+        fused_fd_params = (
+            (
+                float(cfg.max_interval_ticks),
+                int(cfg.window_ticks),
+                float(cfg.prior_weight),
+                float(cfg.prior_mean_ticks),
+            )
+            if fd_phase == "fused"
+            else None
+        )
         for c in range(cfg.fanout):
             ck = random.fold_in(peer_key, c)
             gm8 = c8 = None
@@ -777,7 +948,16 @@ def sim_step(
                 # The first sub-exchange carries the diagonal refresh
                 # (later ones see it in w/hb themselves).
                 first = c == 0
+                last = c == cfg.fanout - 1
                 valid_pair = eff_alive & eff_alive[p]
+                # A lane sweeping fanout below the static bound voids
+                # its excess sub-exchanges by zeroing the alive-pair
+                # mask — the kernel then writes identical tiles back
+                # (adv = 0, hb max against 0), exactly the XLA
+                # sub_active no-op.
+                act = sub_active(c)
+                if act is not None:
+                    valid_pair = valid_pair & act
                 # shards is STATIC (both n and n_local are trace-time
                 # shapes): a one-shard mesh runs the plain single-pass
                 # kernel — its in-kernel row sum IS the global total —
@@ -792,62 +972,122 @@ def sim_step(
                     pallas_variant_engaged(cfg, axis_name, n_local)
                     == "pairs"
                 )
+                # The fused round: the LAST pairs sub-exchange also
+                # runs the whole FD phase on the tiles it already
+                # holds (fd_phase_engaged said "fused"), so the
+                # separate FD pass over the heartbeat matrices
+                # disappears (ops/pallas_fd.py stays the standalone
+                # fallback for non-pairs paths).
+                fd_here = fd_phase == "fused" and last
                 if axis_name is not None and shards > 1:
                     # Two-pass sharded form: local deficit totals
                     # (streaming pass, no writes), one psum — the only
                     # ICI traffic — then the apply pass with the global
                     # totals. Bit-identical to the XLA sharded path's
                     # psum(d.sum(axis=1)) pipeline.
-                    totals_fn = (
-                        pallas_pull.fused_pull_pairs_totals
-                        if use_pairs
-                        else pallas_pull.fused_pull_totals_m8
-                    )
-                    tot = totals_fn(
-                        w, gm8, c8, valid_pair, interpret=interpret,
-                        mv=mv_vec if first else None,
-                        owner_offset=owners[0],
-                    )
+                    if use_pairs:
+                        tops = {
+                            "w": w, "gm": gm8, "c": c8,
+                            "valid": valid_pair,
+                            "owner_offset": owners[0],
+                        }
+                        if first:
+                            tops["mv"] = mv_vec
+                        tot = pallas_pull.pairs_totals(
+                            tops, interpret=interpret
+                        )
+                    else:
+                        tot = pallas_pull.fused_pull_totals_m8(
+                            w, gm8, c8, valid_pair, interpret=interpret,
+                            mv=mv_vec if first else None,
+                            owner_offset=owners[0],
+                        )
                     tot = lax.psum(tot, axis_name)
                 else:
                     tot = None
-                pull_fn = (
-                    pallas_pull.fused_pull_pairs
-                    if use_pairs
-                    else pallas_pull.fused_pull_m8
-                )
                 # The round's LAST pairs call can also evaluate the
                 # convergence flag on its output tiles (w is final
                 # after the sub-exchanges on this path — no lifecycle),
                 # so tracked runs pay no separate full read of w.
-                carry_check = (
-                    use_pairs and return_converged and c == cfg.fanout - 1
-                )
-                kw = {}
-                if carry_check:
-                    kw["check"] = (mv_vec, eff_alive, eff_alive[owners])
+                carry_check = use_pairs and return_converged and last
                 if use_pairs:
-                    # The FD reads the round-start hb after the loop
-                    # (hb_round_start): aliasing hb on the first
-                    # sub-exchange would make XLA copy the retained
-                    # buffer — two extra hb passes, worse than the
-                    # plain write. Later sub-exchanges flow linearly.
-                    kw["alias_hb"] = not (
-                        first and cfg.track_failure_detector
+                    ops = {
+                        "w": w,
+                        "gm": gm8,
+                        "c": c8,
+                        "valid": valid_pair,
+                        "salt": sub_salt(c, 0),
+                        "run_salt": run_salt,
+                        "owner_offset": owners[0],
+                    }
+                    if track_hb:
+                        ops["hb"] = hb
+                    if first:
+                        ops["mv"] = mv_vec
+                        if track_hb:
+                            ops["hbv"] = hbv_vec
+                    if tot is not None:
+                        ops["totals"] = tot
+                    if carry_check:
+                        ops["need"] = mv_vec
+                        ops["alive"] = eff_alive
+                        ops["alive_owner"] = eff_alive[owners]
+                    fd_params = None
+                    if fd_here:
+                        ops["tick"] = tick
+                        ops["lc"] = state.last_change
+                        ops["im"] = state.imean
+                        ops["ic"] = state.icount
+                        ops["hbv"] = hbv_vec  # hb0's diagonal refresh
+                        ops["phi"] = (
+                            jnp.asarray(cfg.phi_threshold, jnp.float32)
+                            if sw_phi is None
+                            else sw_phi
+                        )
+                        if cfg.fanout > 1:
+                            # fanout == 1: the kernel's input hb IS the
+                            # round-start matrix — no extra stream.
+                            ops["hb0"] = hb_round_start
+                        fd_params = fused_fd_params
+                    # The FD phase reads the round-start hb after the
+                    # loop unless it fused into this very call:
+                    # aliasing hb on the first sub-exchange would make
+                    # XLA copy the retained buffer — two extra hb
+                    # passes, worse than the plain write. With fused FD
+                    # at fanout == 1 nothing after this call reads the
+                    # input hb, so it aliases like any other.
+                    retain_start = cfg.track_failure_detector and not (
+                        fd_phase == "fused" and cfg.fanout == 1
                     )
-                pulled = pull_fn(
-                    w, hb if track_hb else None, gm8, c8,
-                    valid_pair, sub_salt(c, 0), run_salt,
-                    cfg.budget, interpret=interpret,
-                    mv=mv_vec if first else None,
-                    hbv=hbv_vec if first and track_hb else None,
-                    owner_offset=owners[0],
-                    totals=tot,
-                    **kw,
-                )
-                if carry_check:
-                    pulled, kernel_flag = pulled
-                w, hb = pulled if track_hb else (pulled, hb)
+                    flat = pallas_pull.pairs_pull(
+                        ops,
+                        budget=cfg.budget,
+                        interpret=interpret,
+                        alias_hb=not (first and retain_start),
+                        fd_params=fd_params,
+                    )
+                    i = 0
+                    w = flat[i]
+                    i += 1
+                    if track_hb:
+                        hb = flat[i]
+                        i += 1
+                    if fd_here:
+                        kernel_fd = flat[i : i + 4]
+                        i += 4
+                    if carry_check:
+                        kernel_flag = flat[i]
+                else:
+                    pulled = pallas_pull.fused_pull_m8(
+                        w, hb if track_hb else None, gm8, c8,
+                        valid_pair, sub_salt(c, 0), run_salt,
+                        cfg.budget, interpret=interpret,
+                        mv=mv_vec if first else None,
+                        hbv=hbv_vec if first and track_hb else None,
+                        owner_offset=owners[0],
+                        totals=tot,
+                    )
+                    w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
                 adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
                 adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1), sub_active(c))
@@ -912,10 +1152,22 @@ def sim_step(
         w, hb = lax.fori_loop(0, cfg.fanout, exchange, (w, hb), unroll=True)
 
     # -- vectorized phi-accrual failure detection ----------------------------
-    if sweep is None and pallas_fd_engaged(cfg, n_local):
-        # One streaming pass over the five FD operands (bit-identical to
-        # the XLA block below — tests/test_pallas_fd.py). Runs per shard
-        # under shard_map, with this shard's owner offset.
+    if fd_phase == "fused":
+        # The FD phase already rode the round's last pairs sub-exchange
+        # (one Pallas dispatch for pull + FD — the fused round): the
+        # kernel updated last_change/imean/icount in place and wrote
+        # the live matrix while it still held every post-exchange hb
+        # tile in VMEM, so the separate pass over the heartbeat
+        # matrices never runs (tests/test_fused_kernel.py pins
+        # bit-identity to the XLA block).
+        assert kernel_fd is not None
+        last_change, imean, icount, live = kernel_fd
+        dead_since = state.dead_since
+    elif fd_phase == "kernel":
+        # Standalone streaming FD kernel — the fallback when the pull
+        # phase is not pairs-served (bit-identical to the XLA block
+        # below — tests/test_pallas_fd.py). Runs per shard under
+        # shard_map, with this shard's owner offset.
         from . import pallas_fd
 
         last_change, imean, icount, live = pallas_fd.fused_fd(

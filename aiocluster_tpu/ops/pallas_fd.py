@@ -1,4 +1,14 @@
-"""Fused Pallas TPU kernel for the vectorized phi-accrual FD phase.
+"""Standalone streaming Pallas kernel for the phi-accrual FD phase.
+
+Since the fused round kernel landed, FD-enabled configs served by the
+PAIRS pull variant run the whole FD phase inside the round's last
+sub-exchange (ops/pallas_pull.py `fd=` epilogue — zero extra reads of
+the heartbeat matrices); this kernel is the STANDALONE FALLBACK for
+every other kernel-wanting path — the single-pass m8 variant,
+choice/permutation pairing (the pull stays on XLA but the FD phase
+still kernels), and ``use_pallas_fd=True`` forced without the pull
+kernel. ``ops/gossip.py::fd_phase_engaged`` is the single dispatch
+resolution ("fused" / "kernel" / "xla" / "off").
 
 The XLA path of ops/gossip.py's failure-detection block is a chain of
 elementwise ops over five (N, N) matrices (hb, round-start hb,
@@ -35,7 +45,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_pull import largest_fitting_block
+from .pallas_pull import fd_update, largest_fitting_block
 
 
 def _fd_kernel(
@@ -71,26 +81,22 @@ def _fd_kernel(
     # the caller never materializes a diagonal-select pass. Idempotent
     # when the caller already applied it (the XLA pull path does).
     hb0 = jnp.where(diag, hbv_ref[:], hb0_ref[:].astype(jnp.int32))
-    lc = lc_ref[:].astype(jnp.int32)
-    increased = hb > hb0
-    never_seen = lc == 0
-    interval = (tick - lc).astype(jnp.float32)
-    sampled = increased & ~never_seen & (interval <= max_interval)
-    icount = jnp.minimum(
-        ic_ref[:].astype(jnp.int32) + sampled.astype(jnp.int32), window
-    )
-    mean_f32 = im_ref[:].astype(jnp.float32)
-    denom = jnp.maximum(icount.astype(jnp.float32), 1.0)
-    imean = jnp.where(sampled, mean_f32 + (interval - mean_f32) / denom, mean_f32)
-    lc2 = jnp.where(increased, tick, lc)
-    count_f32 = icount.astype(jnp.float32)
-    # Cross-multiplied phi test — same arithmetic as the XLA block in
-    # gossip.sim_step (two divides per element saved; the FD pass is
-    # VPU-bound).
-    elapsed = (tick - lc2).astype(jnp.float32)
-    live = (icount >= 1) & (
-        elapsed * (count_f32 + prior_weight)
-        <= phi_threshold * (imean * count_f32 + prior_weight * prior_mean)
+    # The arithmetic lives in pallas_pull.fd_update — one source shared
+    # with the fused round kernel's FD epilogue, so the two kernels and
+    # the XLA block can never drift (cross-multiplied phi test included:
+    # two divides per element saved; the FD pass is VPU-bound).
+    lc2, imean, icount, live = fd_update(
+        tick,
+        hb,
+        hb0,
+        lc_ref[:].astype(jnp.int32),
+        im_ref[:].astype(jnp.float32),
+        ic_ref[:].astype(jnp.int32),
+        max_interval=max_interval,
+        window=window,
+        prior_weight=prior_weight,
+        prior_mean=prior_mean,
+        phi=phi_threshold,
     )
     # Self-belief diagonal (global row == global owner column — the
     # offset above makes this exact on every shard).

@@ -1,4 +1,7 @@
-"""Fused Pallas TPU kernel for one gossip sub-exchange (grouped matching).
+"""Fused Pallas TPU kernels for gossip sub-exchanges (grouped matching)
+— including the FUSED ROUND: pull + phi-accrual FD in one dispatch,
+with a lane axis for multi-scenario sweeps (tests/test_fused_kernel.py
+is the interpret-mode differential gate, `make kernel-parity`).
 
 The XLA path of ops/gossip.py executes a matching sub-exchange as several
 separate passes over the (N, N) matrices: peer-row gathers for w and hb
@@ -90,6 +93,39 @@ def _dither(r_k1: jax.Array, js: jax.Array, row0: jax.Array) -> jax.Array:
     # gossip._hash_uniform — the paths must stay bit-identical).
     u = (h >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
     return jnp.clip(u, 1e-12, 1.0 - 2.0**-24)
+
+
+def fd_update(
+    tick, hb, hb0, lc, im32, ic,
+    *, max_interval, window, prior_weight, prior_mean, phi,
+):
+    """The phi-accrual FD update on widened int32/f32 tiles — THE single
+    source of the arithmetic shared by the standalone streaming FD
+    kernel (ops/pallas_fd.py) and the fused epilogue the pairs kernel
+    runs on the round's last sub-exchange. Same ops in the same order as
+    the XLA block in gossip.sim_step (loads widen exactly, stores round
+    once at the end), so every consumer stays bit-identical to the XLA
+    path. ``phi`` may be a static float or a traced f32 scalar (a sweep
+    lane's value) — both promote identically in the f32 product.
+
+    Returns (last_change', imean', icount', live') PRE death-wipe and
+    PRE self-diagonal: callers apply ``live |= diag`` and the
+    where(live, ...) resets themselves (their diagonal bases differ)."""
+    increased = hb > hb0
+    never_seen = lc == 0
+    interval = (tick - lc).astype(jnp.float32)
+    sampled = increased & ~never_seen & (interval <= max_interval)
+    icount = jnp.minimum(ic + sampled.astype(jnp.int32), window)
+    denom = jnp.maximum(icount.astype(jnp.float32), 1.0)
+    imean = jnp.where(sampled, im32 + (interval - im32) / denom, im32)
+    lc2 = jnp.where(increased, tick, lc)
+    count_f32 = icount.astype(jnp.float32)
+    elapsed = (tick - lc2).astype(jnp.float32)
+    live = (icount >= 1) & (
+        elapsed * (count_f32 + prior_weight)
+        <= phi * (imean * count_f32 + prior_weight * prior_mean)
+    )
+    return lc2, imean, icount, live
 
 
 def _advance(w_self32, w_peer32, valid_col, budget, r_k1, js, row0, totals=None):
@@ -285,40 +321,71 @@ def _m8_totals_kernel(
         tot_ref[sl, :] = jnp.sum(d.astype(jnp.float32), axis=1, keepdims=True)
 
 
+def _pairs_ref_names(
+    track_hb: bool, use_totals: bool, fd: bool, fd_hb0: bool
+) -> tuple[str, ...]:
+    """Positional ref layout of ``_pairs_kernel`` for one static config:
+    scalar prefetch, then inputs, outputs, scratch — in pallas_call
+    order. The wrapper builds its operand/spec/scratch lists from this
+    same table (``_pairs_call``), so kernel signature and call can never
+    skew as the optional FD block comes and goes."""
+    names = [
+        "ld",  # (n/8,) slot -> leader group (padded past `count`)
+        "gm",  # (n/8,) partner group per group (involution)
+        "c",  # (n/8,) within-pair row rotation
+        "vb",  # (n/8,) alive-pair mask, one bit per row, packed per group
+        "ab",  # (n/8,) alive mask bits (convergence; dummy if check off)
+        "meta",  # [salt, run_salt, budget, count, owner_offset, tick]
+        # VMEM inputs (whole-array blocks, loaded once):
+        "mv",  # (1, n) int32 owner max_version (diag refresh; dummy if off)
+        "hbv",  # (1, n) int32 owner heartbeat (diag refresh / FD hb0 diag)
+        "need",  # (1, n) int32 convergence target (dummy if check off)
+        "fdp",  # (1, 128) f32 [phi_threshold, ...] (dummy if fd off)
+        # HBM operands:
+        "w_hbm",
+        "hb_hbm",
+        "tot_hbm",  # (n_rows, 1) f32 global deficit totals (dummy if unused)
+    ]
+    if fd:
+        names += ["lc_hbm", "im_hbm", "ic_hbm"]  # FD bookkeeping
+        if fd_hb0:
+            names.append("hb0_hbm")  # round-start hb (fanout > 1)
+    names += [
+        "wout",
+        "hbout",
+        "flag_out",  # (1, 1) int32 all-converged flag (1 if check off)
+    ]
+    if fd:
+        names += ["lcout", "imout", "icout", "liveout"]
+    names += [
+        "win",  # (16*nbuf, n): [buf] x [side 0/1] x 8 rows; outputs OVERWRITE
+        "hbin",
+        "tscr",  # (16*nbuf, 1) f32 totals rows (dummy if unused)
+        "fscr",  # (1, 1) int32 running converged flag
+    ]
+    if fd:
+        names += ["lcin", "imin", "icin", "livescr"]
+        if fd_hb0:
+            names.append("hb0in")
+    names += [
+        "insems",  # (nbuf, 2, n_in_streams): [buf, side, stream]
+        "outsems",  # (nbuf, 2, n_out_streams)
+    ]
+    return tuple(names)
+
+
 def _pairs_kernel(
-    # scalar prefetch
-    ld_ref,  # (n/8,) slot -> leader group (padded past `count`)
-    gm_ref,  # (n/8,) partner group per group (involution)
-    c_ref,  # (n/8,) within-pair row rotation
-    vb_ref,  # (n/8,) alive-pair mask, one bit per row, packed per group
-    ab_ref,  # (n/8,) alive mask, one bit per row (convergence; dummy if off)
-    meta_ref,  # [salt, run_salt, budget, count, owner_offset]
-    # VMEM inputs (whole-array blocks, loaded once)
-    mv_ref,  # (1, n) int32 owner max_version (diag refresh; dummy if off)
-    hbv_ref,  # (1, n) int32 owner heartbeat (diag refresh; dummy if off)
-    need_ref,  # (1, n) int32 convergence target, 0 at dead owners
-    # HBM operands
-    w_hbm,
-    hb_hbm,
-    tot_hbm,  # (n_rows, 1) f32 global deficit totals (dummy if unused)
-    # HBM outputs
-    wout_hbm,
-    hbout_hbm,
-    flag_out,  # (1, 1) int32 all-converged flag (written 1 if check off)
-    # scratch
-    win,  # (16*nbuf, n): [buf] x [side 0/1] x 8 rows; outputs OVERWRITE it
-    hbin,
-    tscr,  # (16*nbuf, 1) f32 totals rows (dummy if unused)
-    fscr,  # (1, 1) int32 running converged flag
-    insems,  # (nbuf, 2, 3): [buf, side, matrix(w/hb/totals)]
-    outsems,  # (nbuf, 2, 2): [buf, side, matrix(w/hb)]
-    *,
+    *refs,
     n: int,
     track_hb: bool,
     apply_diag: bool,
     use_totals: bool,
     check: bool,
     nbuf: int,
+    lanes: bool,
+    fd: bool,
+    fd_hb0: bool,
+    fd_consts: tuple | None,
 ):
     """Both sides of every matched group pair in ONE visit (the
     pair-fused pull). The matching is an involution, so the single-pass
@@ -357,12 +424,56 @@ def _pairs_kernel(
     test (w' >= max_version[owner], dead rows and dead owners excused)
     on the output tiles it already holds, so convergence-tracked runs
     pay ZERO extra HBM traffic for the check (the separate
-    all_converged_flag pass reads the whole matrix again)."""
-    salt = meta_ref[0]
-    run_salt = meta_ref[1]
-    budget = meta_ref[2].astype(jnp.float32)
-    count = meta_ref[3]
-    owner_off = meta_ref[4]
+    all_converged_flag pass reads the whole matrix again).
+
+    ``fd``: the round's LAST sub-exchange can also carry the whole
+    phi-accrual FD phase (the fused round). Each side's freshly
+    computed hb tile IS the post-exchange heartbeat knowledge, so the
+    epilogue streams only the FD bookkeeping (last_change / imean /
+    icount, updated IN PLACE via input_output_aliases) plus the
+    round-start hb0 when fanout > 1 (``fd_hb0``; at fanout == 1 the
+    input hb tile is the round-start matrix and hb0 costs nothing),
+    and writes the live matrix — the separate ops/pallas_fd.py pass
+    (which would re-read both heartbeat matrices) disappears. The
+    arithmetic is ``fd_update`` — shared with the standalone kernel —
+    with the phi threshold folded in from the ``fdp`` row (a traced
+    per-lane scalar under sweeps, the static config value otherwise).
+
+    ``lanes``: the grid is lifted over a leading sweep-lane dimension S
+    (one grid step per lane). Every scalar-prefetch operand gains a
+    lane row — per-lane matchings, salts, budgets-dither state, counts
+    and FD phi — and the HBM operands a leading S axis indexed by
+    ``program_id``; scratch is reused serially across lanes. This is
+    how SweepSimulator's vmapped ``sim_step`` engages the kernel (the
+    custom_vmap rule in ``_pairs_dispatcher`` routes batched calls
+    here)."""
+    r = dict(zip(_pairs_ref_names(track_hb, use_totals, fd, fd_hb0), refs))
+    assert len(refs) == len(
+        _pairs_ref_names(track_hb, use_totals, fd, fd_hb0)
+    )
+    lane = pl.program_id(0) if lanes else None
+
+    def at(ref, i):
+        # Scalar-prefetch access: lane-batched arrays carry a leading
+        # lane axis; single-lane arrays are as before.
+        return ref[lane, i] if lanes else ref[i]
+
+    def lhbm(ref):
+        # HBM operands: this lane's (n, n_cols) plane.
+        return ref.at[lane] if lanes else ref
+
+    ld_ref, gm_ref, c_ref = r["ld"], r["gm"], r["c"]
+    vb_ref, ab_ref, meta_ref = r["vb"], r["ab"], r["meta"]
+    mv_ref, hbv_ref, need_ref = r["mv"], r["hbv"], r["need"]
+    win, hbin, tscr, fscr = r["win"], r["hbin"], r["tscr"], r["fscr"]
+    insems, outsems = r["insems"], r["outsems"]
+    flag_out = r["flag_out"]
+
+    salt = at(meta_ref, 0)
+    run_salt = at(meta_ref, 1)
+    budget = at(meta_ref, 2).astype(jnp.float32)
+    count = at(meta_ref, 3)
+    owner_off = at(meta_ref, 4)
     r_k1, js = _dither_base((8, n), salt, run_salt, owner_off)
     col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
     r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
@@ -373,77 +484,128 @@ def _pairs_kernel(
     sub8 = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
 
     def vmask(g):
-        return (vb_ref[g] >> sub8) & 1
+        return (at(vb_ref, g) >> sub8) & 1
 
-    mats = [(w_hbm, wout_hbm, win, 0)]
+    # DMA stream tables (static per config): every in/out stream shares
+    # the one slot/side -> 8-row addressing, so adding the FD matrices
+    # is a table entry, not new plumbing.
+    in_streams = [(lhbm(r["w_hbm"]), win)]
     if track_hb:
-        mats.append((hb_hbm, hbout_hbm, hbin, 1))
+        in_streams.append((lhbm(r["hb_hbm"]), hbin))
+    if use_totals:
+        in_streams.append((lhbm(r["tot_hbm"]), tscr))
+    if fd:
+        in_streams += [
+            (lhbm(r["lc_hbm"]), r["lcin"]),
+            (lhbm(r["im_hbm"]), r["imin"]),
+            (lhbm(r["ic_hbm"]), r["icin"]),
+        ]
+        if fd_hb0:
+            in_streams.append((lhbm(r["hb0_hbm"]), r["hb0in"]))
+    out_streams = [(win, lhbm(r["wout"]))]
+    if track_hb:
+        out_streams.append((hbin, lhbm(r["hbout"])))
+    if fd:
+        out_streams += [
+            (r["lcin"], lhbm(r["lcout"])),
+            (r["imin"], lhbm(r["imout"])),
+            (r["icin"], lhbm(r["icout"])),
+            (r["livescr"], lhbm(r["liveout"])),
+        ]
 
-    def in_copy(slot, side, mat):
-        src_hbm, _, scr, m = mats[mat]
-        g = ld_ref[slot]
-        src = (g if side == 0 else gm_ref[g]) * 8
+    def in_copy(slot, side, k):
+        src_hbm, scr = in_streams[k]
+        g = at(ld_ref, slot)
+        src = (g if side == 0 else at(gm_ref, g)) * 8
         row = (slot % nbuf) * 16 + side * 8
         return pltpu.make_async_copy(
             src_hbm.at[pl.ds(src, 8), :],
             scr.at[pl.ds(row, 8), :],
-            insems.at[slot % nbuf, side, m],
+            insems.at[slot % nbuf, side, k],
         )
 
-    def out_copy(slot, side, mat):
-        _, dst_hbm, scr, m = mats[mat]
-        g = ld_ref[slot]
-        dst = (g if side == 0 else gm_ref[g]) * 8
+    def out_copy(slot, side, k):
+        scr, dst_hbm = out_streams[k]
+        g = at(ld_ref, slot)
+        dst = (g if side == 0 else at(gm_ref, g)) * 8
         row = (slot % nbuf) * 16 + side * 8
         return pltpu.make_async_copy(
             scr.at[pl.ds(row, 8), :],
             dst_hbm.at[pl.ds(dst, 8), :],
-            outsems.at[slot % nbuf, side, m],
-        )
-
-    def tot_copy(slot, side):
-        g = ld_ref[slot]
-        src = (g if side == 0 else gm_ref[g]) * 8
-        row = (slot % nbuf) * 16 + side * 8
-        return pltpu.make_async_copy(
-            tot_hbm.at[pl.ds(src, 8), :],
-            tscr.at[pl.ds(row, 8), :],
-            insems.at[slot % nbuf, side, 2],
+            outsems.at[slot % nbuf, side, k],
         )
 
     def start_in(slot):
-        for mat in range(len(mats)):
-            in_copy(slot, 0, mat).start()
-            in_copy(slot, 1, mat).start()
-        if use_totals:
-            tot_copy(slot, 0).start()
-            tot_copy(slot, 1).start()
+        for k in range(len(in_streams)):
+            in_copy(slot, 0, k).start()
+            in_copy(slot, 1, k).start()
 
     def wait_in(slot):
-        for mat in range(len(mats)):
-            in_copy(slot, 0, mat).wait()
-            in_copy(slot, 1, mat).wait()
-        if use_totals:
-            tot_copy(slot, 0).wait()
-            tot_copy(slot, 1).wait()
+        for k in range(len(in_streams)):
+            in_copy(slot, 0, k).wait()
+            in_copy(slot, 1, k).wait()
 
     def start_out(slot):
-        for mat in range(len(mats)):
-            out_copy(slot, 0, mat).start()
+        for k in range(len(out_streams)):
+            out_copy(slot, 0, k).start()
 
-        @pl.when(gm_ref[ld_ref[slot]] != ld_ref[slot])
+        @pl.when(at(gm_ref, at(ld_ref, slot)) != at(ld_ref, slot))
         def _():
-            for mat in range(len(mats)):
-                out_copy(slot, 1, mat).start()
+            for k in range(len(out_streams)):
+                out_copy(slot, 1, k).start()
 
     def wait_out(slot):
-        for mat in range(len(mats)):
-            out_copy(slot, 0, mat).wait()
+        for k in range(len(out_streams)):
+            out_copy(slot, 0, k).wait()
 
-        @pl.when(gm_ref[ld_ref[slot]] != ld_ref[slot])
+        @pl.when(at(gm_ref, at(ld_ref, slot)) != at(ld_ref, slot))
         def _():
-            for mat in range(len(mats)):
-                out_copy(slot, 1, mat).wait()
+            for k in range(len(out_streams)):
+                out_copy(slot, 1, k).wait()
+
+    if fd:
+        tick = at(meta_ref, 5)
+        phi = r["fdp"][0, 0]
+        fd_max_interval, fd_window, fd_pw, fd_pm = fd_consts
+        lcin, imin, icin = r["lcin"], r["imin"], r["icin"]
+        livescr = r["livescr"]
+
+        def fd_side(base_row, grp, hb_old, hb_new):
+            """The FD phase for one side's 8-row tile: hb_new is the
+            freshly computed post-exchange knowledge (int32, pre-cast —
+            same values the stored matrix will hold), hb_old the
+            diag-refreshed input tile. Death wipes the window and the
+            self diagonal stays live, exactly as the XLA block."""
+            sl = pl.ds(base_row, 8)
+            diag_side = col == 8 * grp + r8
+            if fd_hb0:
+                hb0_t = jnp.where(
+                    diag_side,
+                    hbv_ref[:],
+                    r["hb0in"][sl, :].astype(jnp.int32),
+                )
+            else:
+                # fanout == 1: the input hb tile IS the round-start
+                # matrix (owner diagonal already refreshed above).
+                hb0_t = hb_old
+            lc2, imean, icount, live = fd_update(
+                tick,
+                hb_new,
+                hb0_t,
+                lcin[sl, :].astype(jnp.int32),
+                imin[sl, :].astype(jnp.float32),
+                icin[sl, :].astype(jnp.int32),
+                max_interval=fd_max_interval,
+                window=fd_window,
+                prior_weight=fd_pw,
+                prior_mean=fd_pm,
+                phi=phi,
+            )
+            live = live | diag_side
+            lcin[sl, :] = lc2.astype(lcin.dtype)
+            imin[sl, :] = jnp.where(live, imean, 0.0).astype(imin.dtype)
+            icin[sl, :] = jnp.where(live, icount, 0).astype(icin.dtype)
+            livescr[sl, :] = live
 
     def body(s, _):
         base = (s % nbuf) * 16
@@ -461,10 +623,10 @@ def _pairs_kernel(
             start_in(s + 1)
 
         wait_in(s)
-        g = ld_ref[s]
-        h = gm_ref[g]
-        cg = c_ref[g]
-        ch = c_ref[h]
+        g = at(ld_ref, s)
+        h = at(gm_ref, g)
+        cg = at(c_ref, g)
+        ch = at(c_ref, h)
         vg = vmask(g)
         vh = vmask(h)
         w_g = win[pl.ds(base, 8), :].astype(jnp.int32)
@@ -493,8 +655,8 @@ def _pairs_kernel(
             # AND-accumulated across slots; side 1 skipped for
             # self-matched pairs (those rows were side 0).
             need = need_ref[:]
-            ag = (ab_ref[g] >> sub8) & 1
-            ah = (ab_ref[h] >> sub8) & 1
+            ag = (at(ab_ref, g) >> sub8) & 1
+            ah = (at(ab_ref, h) >> sub8) & 1
             ok_g = jnp.all((w_g + adv_g >= need) | (ag == 0))
             ok_h = jnp.all((w_h + adv_h >= need) | (ah == 0))
             ok_h = jnp.where(g == h, True, ok_h)
@@ -508,12 +670,16 @@ def _pairs_kernel(
                 hbv_b = hbv_ref[:]
                 hb_g = jnp.where(col == 8 * g + r8, hbv_b, hb_g)
                 hb_h = jnp.where(col == 8 * h + r8, hbv_b, hb_h)
-            hbin[pl.ds(base, 8), :] = jnp.maximum(
-                hb_g, pltpu.roll(hb_h, cg, 0) * vg
-            ).astype(hbin.dtype)
-            hbin[pl.ds(base + 8, 8), :] = jnp.maximum(
-                hb_h, pltpu.roll(hb_g, ch, 0) * vh
-            ).astype(hbin.dtype)
+            hb_new_g = jnp.maximum(hb_g, pltpu.roll(hb_h, cg, 0) * vg)
+            hb_new_h = jnp.maximum(hb_h, pltpu.roll(hb_g, ch, 0) * vh)
+            hbin[pl.ds(base, 8), :] = hb_new_g.astype(hbin.dtype)
+            hbin[pl.ds(base + 8, 8), :] = hb_new_h.astype(hbin.dtype)
+            if fd:
+                # FD epilogue on the tiles this slot already holds —
+                # self-matched pairs skip side 1's write (those rows
+                # were side 0), exactly like the pull outputs.
+                fd_side(base, g, hb_g, hb_new_g)
+                fd_side(base + 8, h, hb_h, hb_new_h)
         start_out(s)
         return 0
 
@@ -556,39 +722,48 @@ def _pairs_totals_kernel(
     *,
     n: int,
     apply_diag: bool,
+    lanes: bool = False,
 ):
     """Pass A of the sharded pair-fused pull: LOCAL deficit row totals
     for this shard's (N, n_local) block, visiting each matched group
     pair once — every row read ONCE (the m8 totals pass reads each row
     twice: streamed as self, gathered as its partner's peer). The
     caller psums the (N,) result across shards and feeds it to
-    fused_pull_pairs as ``totals``."""
-    count = meta_ref[0]
-    owner_off = meta_ref[1]
+    fused_pull_pairs as ``totals``. ``lanes`` lifts the grid over the
+    sweep's leading S dimension exactly as in _pairs_kernel."""
+    lane = pl.program_id(0) if lanes else None
+
+    def at(ref, i):
+        return ref[lane, i] if lanes else ref[i]
+
+    w_src = w_hbm.at[lane] if lanes else w_hbm
+    tot_dst = tot_hbm.at[lane] if lanes else tot_hbm
+    count = at(meta_ref, 0)
+    owner_off = at(meta_ref, 1)
     col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
     r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
     sub8 = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
 
     def vmask(g):
-        return (vb_ref[g] >> sub8) & 1
+        return (at(vb_ref, g) >> sub8) & 1
 
     def in_copy(slot, side):
-        g = ld_ref[slot]
-        src = (g if side == 0 else gm_ref[g]) * 8
+        g = at(ld_ref, slot)
+        src = (g if side == 0 else at(gm_ref, g)) * 8
         row = (slot % 2) * 16 + side * 8
         return pltpu.make_async_copy(
-            w_hbm.at[pl.ds(src, 8), :],
+            w_src.at[pl.ds(src, 8), :],
             win.at[pl.ds(row, 8), :],
             insems.at[slot % 2, side],
         )
 
     def out_copy(slot, side):
-        g = ld_ref[slot]
-        dst = (g if side == 0 else gm_ref[g]) * 8
+        g = at(ld_ref, slot)
+        dst = (g if side == 0 else at(gm_ref, g)) * 8
         row = (slot % 2) * 16 + side * 8
         return pltpu.make_async_copy(
             tout.at[pl.ds(row, 8), :],
-            tot_hbm.at[pl.ds(dst, 8), :],
+            tot_dst.at[pl.ds(dst, 8), :],
             outsems.at[slot % 2, side],
         )
 
@@ -599,14 +774,14 @@ def _pairs_totals_kernel(
     def start_out(slot):
         out_copy(slot, 0).start()
 
-        @pl.when(gm_ref[ld_ref[slot]] != ld_ref[slot])
+        @pl.when(at(gm_ref, at(ld_ref, slot)) != at(ld_ref, slot))
         def _():
             out_copy(slot, 1).start()
 
     def wait_out(slot):
         out_copy(slot, 0).wait()
 
-        @pl.when(gm_ref[ld_ref[slot]] != ld_ref[slot])
+        @pl.when(at(gm_ref, at(ld_ref, slot)) != at(ld_ref, slot))
         def _():
             out_copy(slot, 1).wait()
 
@@ -624,10 +799,10 @@ def _pairs_totals_kernel(
         def _():
             wait_out(s - 2)
 
-        g = ld_ref[s]
-        h = gm_ref[g]
-        cg = c_ref[g]
-        ch = c_ref[h]
+        g = at(ld_ref, s)
+        h = at(gm_ref, g)
+        cg = at(c_ref, g)
+        ch = at(c_ref, h)
         w_g = win[pl.ds(base, 8), :].astype(jnp.int32)
         w_h = win[pl.ds(base + 8, 8), :].astype(jnp.int32)
         if apply_diag:
@@ -884,7 +1059,11 @@ def fused_pull_m8(
 
 
 def pairs_nbuf(
-    n: int, itemsize: int, track_hb: bool = True, n_local: int | None = None
+    n: int,
+    itemsize: int,
+    track_hb: bool = True,
+    n_local: int | None = None,
+    fd_sizes: tuple[int, int] | None = None,
 ) -> int | None:
     """Scratch-buffer rotation depth for the pair-fused kernel at this
     shape, or None when it cannot run. 3 whenever VMEM allows — each
@@ -900,28 +1079,49 @@ def pairs_nbuf(
     tracked run's last sub-exchange carries (worst case fanout=1: diag
     AND check ride the same call), charged unconditionally so the gate
     never admits a shape whose tracked instance exceeds VMEM. The
-    sharded form adds only the tiny (16*nbuf, 1) totals scratch."""
+    sharded form adds only the tiny (16*nbuf, 1) totals scratch.
+
+    ``fd_sizes`` = (heartbeat itemsize, fd-mean itemsize) when the
+    round's last sub-exchange carries the fused FD epilogue: it adds
+    tile pairs for last_change, imean, icount (int16), the live matrix
+    (bool, held as 4 B/elem in VMEM — see pallas_fd._per_row_bytes)
+    and the streamed round-start hb0 (charged unconditionally — only
+    fanout > 1 streams it, but the gate must never admit a shape whose
+    multi-sub-exchange instance exceeds VMEM)."""
     width = n if n_local is None else n_local
     if n % 128 != 0 or width % 128 != 0:
         return None
     bases = 2 * 8 * width * 4
     vecs = ((2 if track_hb else 1) + 1) * 8 * width * 4
     for nbuf in (3, 2):
-        tiles = (2 if track_hb else 1) * 16 * nbuf * width * itemsize
+        per_tile = 16 * nbuf * width
+        tiles = (2 if track_hb else 1) * per_tile * itemsize
+        if fd_sizes is not None:
+            hb_sz, fd_sz = fd_sizes
+            tiles += per_tile * (hb_sz + fd_sz + 2 + 4 + hb_sz)
         if tiles + bases + vecs <= VMEM_BUDGET:
             return nbuf
     return None
 
 
 def pairs_supported(
-    n: int, itemsize: int, track_hb: bool = True, n_local: int | None = None
+    n: int,
+    itemsize: int,
+    track_hb: bool = True,
+    n_local: int | None = None,
+    fd_sizes: tuple[int, int] | None = None,
 ) -> bool:
     """Whether the pair-fused kernel can run this shape (see
     pairs_nbuf for the accounting)."""
-    return pairs_nbuf(n, itemsize, track_hb, n_local) is not None
+    return pairs_nbuf(n, itemsize, track_hb, n_local, fd_sizes) is not None
 
 
-def pairs_supported_for(n: int, w: jax.Array, hb: jax.Array | None) -> bool:
+def pairs_supported_for(
+    n: int,
+    w: jax.Array,
+    hb: jax.Array | None,
+    fd_sizes: tuple[int, int] | None = None,
+) -> bool:
     """pairs_supported with itemsize and local width derived from the
     operands — the one eligibility rule shared by the sim_step dispatch
     and the fused_pull_pairs wrapper."""
@@ -929,11 +1129,302 @@ def pairs_supported_for(n: int, w: jax.Array, hb: jax.Array | None) -> bool:
     if hb is not None:
         itemsize = max(itemsize, hb.dtype.itemsize)
     return pairs_supported(
-        n, itemsize, track_hb=hb is not None, n_local=w.shape[1]
+        n, itemsize, track_hb=hb is not None, n_local=w.shape[-1],
+        fd_sizes=fd_sizes,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("budget", "interpret", "alias_hb"))
+def _pairs_call(
+    w,
+    hb,
+    gm,
+    c,
+    valid,
+    salt,
+    run_salt,
+    budget,
+    interpret,
+    mv,
+    hbv,
+    owner_offset,
+    totals,
+    check,
+    fd,
+    fd_params,
+    alias_hb,
+    lanes,
+):
+    """Shared builder behind fused_pull_pairs (lanes=False) and
+    fused_pull_pairs_lanes (lanes=True): constructs the operand list,
+    specs and scratch from the same table the kernel unpacks
+    (``_pairs_ref_names``) and invokes one pallas_call. In lane mode
+    every array carries a leading S axis and the grid is (S,)."""
+    track_hb = hb is not None
+    apply_diag = mv is not None
+    use_totals = totals is not None
+    do_check = check is not None
+    do_fd = fd is not None
+    if apply_diag and track_hb and hbv is None:
+        raise ValueError("hbv required when mv is given and hb is tracked")
+    if hbv is not None and not track_hb:
+        raise ValueError("hbv given but no hb matrix to refresh (lean mode)")
+    if hbv is not None and mv is None and not do_fd:
+        raise ValueError("hbv given without mv: the diagonal refresh is all-or-none")
+    if do_fd:
+        if not track_hb:
+            raise ValueError("fused FD requires the heartbeat matrix")
+        if hbv is None:
+            raise ValueError("fused FD requires hbv (hb0's diagonal refresh)")
+        if fd_params is None:
+            raise ValueError("fused FD requires fd_params statics")
+        fd_tick, fd_lc, fd_im, fd_ic, fd_hb0_mat, fd_phi = fd
+        fd_hb0 = fd_hb0_mat is not None
+    else:
+        fd_hb0 = False
+    if lanes:
+        n_lanes, n, n_cols = w.shape
+    else:
+        n, n_cols = w.shape
+    itemsize = w.dtype.itemsize
+    if track_hb:
+        itemsize = max(itemsize, hb.dtype.itemsize)
+    fd_sizes = (
+        (fd_lc.dtype.itemsize, fd_im.dtype.itemsize) if do_fd else None
+    )
+    nbuf = pairs_nbuf(n, itemsize, track_hb, n_local=n_cols, fd_sizes=fd_sizes)
+    if nbuf is None:
+        raise ValueError(f"pair-fused kernel cannot run shape {w.shape}")
+    gm = gm.astype(jnp.int32)
+    if lanes:
+        leaders, count, vbits = jax.vmap(
+            lambda g, v: _pairs_slots(n, g, v)
+        )(gm, valid)
+
+        def lane_vec(x):
+            return jnp.broadcast_to(
+                jnp.asarray(x, jnp.int32), (n_lanes,)
+            ).astype(jnp.int32)
+
+        meta = jnp.stack(
+            [
+                lane_vec(salt),
+                lane_vec(run_salt),
+                lane_vec(budget),
+                count,
+                lane_vec(owner_offset),
+                lane_vec(fd_tick if do_fd else 0),
+            ],
+            axis=1,
+        )
+    else:
+        leaders, count, vbits = _pairs_slots(n, gm, valid)
+        meta = jnp.stack(
+            [
+                salt.astype(jnp.int32),
+                run_salt.astype(jnp.int32),
+                jnp.asarray(budget, jnp.int32),
+                count,
+                jnp.asarray(owner_offset, jnp.int32),
+                (
+                    fd_tick.astype(jnp.int32)
+                    if do_fd
+                    else jnp.asarray(0, jnp.int32)
+                ),
+            ]
+        )
+    if not track_hb:
+        hb = jnp.zeros((8, 128), w.dtype)
+    if use_totals:
+        totals = totals.astype(jnp.float32).reshape(
+            (n_lanes, n, 1) if lanes else (n, 1)
+        )
+    else:
+        totals = jnp.zeros((8, 128), jnp.float32)
+
+    # Broadcast-row specs: one (1, width) row per call, or per LANE in
+    # lane mode (a leading squeezed axis indexed by the grid step).
+    def row_spec(width):
+        if lanes:
+            return pl.BlockSpec((None, 1, width), lambda s, *_: (s, 0, 0))
+        return pl.BlockSpec((1, width), lambda *_: (0, 0))
+
+    dummy_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
+
+    def row_operand(vec):
+        # (n_cols,) [or (S, n_cols)] -> broadcast row in the call shape.
+        v = vec.astype(jnp.int32)
+        return v[:, None, :] if lanes else v[None, :]
+
+    if do_check:
+        needed, alive, alive_owner = check
+        abits = (
+            jax.vmap(lambda a: _pack_row_bits(a, n))(alive)
+            if lanes
+            else _pack_row_bits(alive, n)
+        )
+        # Dead owners are excused by zeroing their target: watermarks
+        # are non-negative, so w >= 0 holds everywhere — one broadcast
+        # row instead of a separate alive-owner mask row.
+        need = row_operand(jnp.where(alive_owner, needed.astype(jnp.int32), 0))
+        need_spec = row_spec(n_cols)
+    else:
+        abits = jnp.zeros(
+            ((n_lanes, n // 8) if lanes else (n // 8,)), jnp.int32
+        )
+        need = jnp.zeros((1, 128), jnp.int32)
+        need_spec = dummy_spec
+    use_hbv = (apply_diag and track_hb) or do_fd
+    if apply_diag:
+        mv = row_operand(mv)
+        vec_spec = row_spec(n_cols)
+    else:
+        mv = jnp.zeros((1, 128), jnp.int32)
+        vec_spec = dummy_spec
+    if use_hbv:
+        hbv = row_operand(hbv)
+        hbv_spec = row_spec(n_cols)
+    else:
+        hbv = jnp.zeros((1, 128), jnp.int32)
+        hbv_spec = dummy_spec
+    if do_fd:
+        phi32 = jnp.asarray(fd_phi, jnp.float32)
+        if lanes:
+            fdp = jnp.broadcast_to(
+                jnp.broadcast_to(phi32, (n_lanes,))[:, None, None],
+                (n_lanes, 1, 128),
+            )
+        else:
+            fdp = jnp.full((1, 128), phi32, jnp.float32)
+        fdp_spec = row_spec(128)
+    else:
+        fdp = jnp.zeros((1, 128), jnp.float32)
+        fdp_spec = dummy_spec
+
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    in_specs = [vec_spec, hbv_spec, need_spec, fdp_spec,
+                any_spec, any_spec, any_spec]
+    inputs = [mv, hbv, need, fdp, w, hb, totals]
+    if do_fd:
+        in_specs += [any_spec] * (4 if fd_hb0 else 3)
+        inputs += [fd_lc, fd_im, fd_ic]
+        if fd_hb0:
+            inputs.append(fd_hb0_mat)
+    flag_shape = (n_lanes, 1, 1) if lanes else (1, 1)
+    flag_spec = (
+        pl.BlockSpec((None, 1, 1), lambda s, *_: (s, 0, 0))
+        if lanes
+        else pl.BlockSpec((1, 1), lambda *_: (0, 0))
+    )
+    out_specs = [any_spec, any_spec, flag_spec]
+    out_shapes = [
+        jax.ShapeDtypeStruct(w.shape, w.dtype),
+        jax.ShapeDtypeStruct(hb.shape, hb.dtype),
+        jax.ShapeDtypeStruct(flag_shape, jnp.int32),
+    ]
+    if do_fd:
+        out_specs += [any_spec] * 4
+        out_shapes += [
+            jax.ShapeDtypeStruct(fd_lc.shape, fd_lc.dtype),
+            jax.ShapeDtypeStruct(fd_im.shape, fd_im.dtype),
+            jax.ShapeDtypeStruct(fd_ic.shape, fd_ic.dtype),
+            jax.ShapeDtypeStruct(
+                (n_lanes, n, n_cols) if lanes else (n, n_cols), jnp.bool_
+            ),
+        ]
+    n_in_streams = 1 + int(track_hb) + int(use_totals) + (
+        (3 + int(fd_hb0)) if do_fd else 0
+    )
+    n_out_streams = 1 + int(track_hb) + (4 if do_fd else 0)
+    hb_scr = (16 * nbuf, n_cols) if track_hb else (8, 128)
+    scratch = [
+        pltpu.VMEM((16 * nbuf, n_cols), w.dtype),  # win (in-place out)
+        pltpu.VMEM(hb_scr, hb.dtype),  # hbin (ditto)
+        pltpu.VMEM((16 * nbuf, 1), jnp.float32),  # tscr
+        pltpu.VMEM((1, 1), jnp.int32),  # fscr
+    ]
+    if do_fd:
+        scratch += [
+            pltpu.VMEM((16 * nbuf, n_cols), fd_lc.dtype),  # lcin
+            pltpu.VMEM((16 * nbuf, n_cols), fd_im.dtype),  # imin
+            pltpu.VMEM((16 * nbuf, n_cols), fd_ic.dtype),  # icin
+            pltpu.VMEM((16 * nbuf, n_cols), jnp.bool_),  # livescr
+        ]
+        if fd_hb0:
+            scratch.append(pltpu.VMEM((16 * nbuf, n_cols), hb.dtype))
+    scratch += [
+        pltpu.SemaphoreType.DMA((nbuf, 2, n_in_streams)),
+        pltpu.SemaphoreType.DMA((nbuf, 2, n_out_streams)),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(n_lanes,) if lanes else (1,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _pairs_kernel,
+        n=n_cols,
+        track_hb=track_hb,
+        apply_diag=apply_diag,
+        use_totals=use_totals,
+        check=do_check,
+        nbuf=nbuf,
+        lanes=lanes,
+        fd=do_fd,
+        fd_hb0=fd_hb0,
+        fd_consts=fd_params,
+    )
+    # w (and usually hb) update IN PLACE: every row is read exactly
+    # once (wait_in of its own slot) strictly before its out DMA
+    # starts, and rows across slots are disjoint, so the aliasing
+    # has no read-after-write hazard — unlike the m8 kernel, whose
+    # peer gather may read rows whose output block already streamed
+    # out. Halves the path's peak HBM (one resident copy per
+    # matrix). ``alias_hb=False`` exists for callers that RETAIN
+    # the input hb (the FD's round-start matrix on the round's
+    # first sub-exchange): aliasing a still-live operand makes XLA
+    # insert a full copy — two extra hb passes, worse than the
+    # unaliased write. The fused FD's bookkeeping (last_change /
+    # imean / icount) always aliases: each tile is read exactly once
+    # before its updated tile streams out, and sim_step donates the
+    # state they come from. Indices are over the flattened operand
+    # list: 0-4 scalar prefetch (leaders, gm, c, vbits, abits),
+    # 5 meta is prefetch too, then 6 mv, 7 hbv, 8 need, 9 fdp,
+    # 10 w, 11 hb, 12 totals, 13 lc, 14 im, 15 ic[, 16 hb0].
+    aliases = {10: 0}
+    if alias_hb:
+        aliases[11] = 1
+    if do_fd:
+        aliases.update({13: 3, 14: 4, 15: 5})
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(
+        leaders,
+        gm,
+        c.astype(jnp.int32),
+        vbits,
+        abits,
+        meta,
+        *inputs,
+    )
+    w_new, hb_new, flag = outs[0], outs[1], outs[2]
+    if do_fd:
+        out = (w_new, hb_new) + tuple(outs[3:7])
+    else:
+        out = (w_new, hb_new) if track_hb else w_new
+    if do_check:
+        return out, (flag[:, 0, 0] if lanes else flag[0, 0])
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("budget", "interpret", "alias_hb", "fd_params")
+)
 def fused_pull_pairs(
     w: jax.Array,
     hb: jax.Array | None,
@@ -949,6 +1440,8 @@ def fused_pull_pairs(
     owner_offset: jax.Array | int = 0,
     totals: jax.Array | None = None,
     check: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+    fd: tuple | None = None,
+    fd_params: tuple | None = None,
     alias_hb: bool = True,
 ):
     """One fused grouped-matching sub-exchange, pair-at-a-time: 4 bytes
@@ -972,152 +1465,64 @@ def fused_pull_pairs(
     ops/gossip.py::all_converged_flag is the semantics being reproduced
     — same excusals, zero extra HBM traffic.
 
+    ``fd`` = (tick, last_change, imean, icount, hb0, phi_threshold)
+    asks the round's LAST sub-exchange to also run the whole phi-accrual
+    FD phase on its output tiles (the fused round): ``hb0`` is the
+    round-start heartbeat matrix (None at fanout == 1, where the input
+    hb IS round-start), ``phi_threshold`` a float or traced f32 scalar,
+    and ``fd_params`` = (max_interval, window, prior_weight,
+    prior_mean) the static FD constants. The return value grows by
+    (last_change', imean', icount', live') — bit-identical to the XLA
+    FD block and to ops/pallas_fd.py (tests/test_fused_kernel.py),
+    which stays as the standalone fallback for non-pairs paths.
+
     Reference anchor: the same server.py:378-495 hot loop; the pairing
     insight is that the reference's Syn/SynAck/Ack already computes both
     directions from the pre-handshake digests, so one visit per pair is
     semantically exact."""
-    track_hb = hb is not None
-    apply_diag = mv is not None
-    use_totals = totals is not None
-    do_check = check is not None
-    if apply_diag and track_hb and hbv is None:
-        raise ValueError("hbv required when mv is given and hb is tracked")
-    if hbv is not None and not track_hb:
-        raise ValueError("hbv given but no hb matrix to refresh (lean mode)")
-    if hbv is not None and mv is None:
-        raise ValueError("hbv given without mv: the diagonal refresh is all-or-none")
-    n, n_cols = w.shape
-    itemsize = w.dtype.itemsize
-    if track_hb:
-        itemsize = max(itemsize, hb.dtype.itemsize)
-    nbuf = pairs_nbuf(n, itemsize, track_hb, n_local=n_cols)
-    if nbuf is None:
-        raise ValueError(f"pair-fused kernel cannot run shape {w.shape}")
-    leaders, count, vbits = _pairs_slots(n, gm, valid)
-    gm = gm.astype(jnp.int32)
-    meta = jnp.stack(
-        [
-            salt.astype(jnp.int32),
-            run_salt.astype(jnp.int32),
-            jnp.asarray(budget, jnp.int32),
-            count,
-            jnp.asarray(owner_offset, jnp.int32),
-        ]
+    return _pairs_call(
+        w, hb, gm, c, valid, salt, run_salt, budget, interpret,
+        mv, hbv, owner_offset, totals, check, fd, fd_params, alias_hb,
+        lanes=False,
     )
-    if not track_hb:
-        hb = jnp.zeros((8, 128), w.dtype)
-    if use_totals:
-        totals = totals.astype(jnp.float32).reshape(n, 1)
-    else:
-        totals = jnp.zeros((8, 128), jnp.float32)
-    if do_check:
-        needed, alive, alive_owner = check
-        abits = _pack_row_bits(alive, n)
-        # Dead owners are excused by zeroing their target: watermarks
-        # are non-negative, so w >= 0 holds everywhere — one broadcast
-        # row instead of a separate alive-owner mask row.
-        need = jnp.where(
-            alive_owner, needed.astype(jnp.int32), 0
-        )[None, :]
-        need_spec = pl.BlockSpec((1, n_cols), lambda *_: (0, 0))
-    else:
-        abits = jnp.zeros((n // 8,), jnp.int32)
-        need = jnp.zeros((1, 128), jnp.int32)
-        need_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
-    if apply_diag:
-        mv = mv.astype(jnp.int32)[None, :]
-        hbv = (
-            hbv.astype(jnp.int32)[None, :]
-            if track_hb
-            else jnp.zeros((1, 128), jnp.int32)
-        )
-        vec_spec = pl.BlockSpec((1, n_cols), lambda *_: (0, 0))
-        hbv_spec = vec_spec if track_hb else pl.BlockSpec(
-            (1, 128), lambda *_: (0, 0)
-        )
-    else:
-        mv = jnp.zeros((1, 128), jnp.int32)
-        hbv = jnp.zeros((1, 128), jnp.int32)
-        vec_spec = hbv_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
-    hb_scr = (16 * nbuf, n_cols) if track_hb else (8, 128)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=6,
-        grid=(1,),
-        in_specs=[
-            vec_spec,  # mv row (dummy tile when diag off)
-            hbv_spec,  # heartbeat row (dummy tile when diag off / lean)
-            need_spec,  # convergence target row (dummy when check off)
-            pl.BlockSpec(memory_space=pl.ANY),  # w (HBM operand)
-            pl.BlockSpec(memory_space=pl.ANY),  # hb
-            pl.BlockSpec(memory_space=pl.ANY),  # totals (dummy if unused)
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),  # w out
-            pl.BlockSpec(memory_space=pl.ANY),  # hb out
-            pl.BlockSpec((1, 1), lambda *_: (0, 0)),  # converged flag
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((16 * nbuf, n_cols), w.dtype),  # win (in-place out)
-            pltpu.VMEM(hb_scr, hb.dtype),  # hbin (ditto)
-            pltpu.VMEM((16 * nbuf, 1), jnp.float32),  # tscr
-            pltpu.VMEM((1, 1), jnp.int32),  # fscr
-            pltpu.SemaphoreType.DMA((nbuf, 2, 3)),  # in [buf, side, mat]
-            pltpu.SemaphoreType.DMA((nbuf, 2, 2)),  # out [buf, side, mat]
-        ],
+
+
+@functools.partial(
+    jax.jit, static_argnames=("budget", "interpret", "alias_hb", "fd_params")
+)
+def fused_pull_pairs_lanes(
+    w: jax.Array,
+    hb: jax.Array | None,
+    gm: jax.Array,
+    c: jax.Array,
+    valid: jax.Array,
+    salt: jax.Array,
+    run_salt: jax.Array,
+    budget: int,
+    interpret: bool = False,
+    mv: jax.Array | None = None,
+    hbv: jax.Array | None = None,
+    owner_offset: jax.Array | int = 0,
+    totals: jax.Array | None = None,
+    check: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+    fd: tuple | None = None,
+    fd_params: tuple | None = None,
+    alias_hb: bool = True,
+):
+    """fused_pull_pairs lifted over a leading sweep-lane axis S: every
+    array operand carries the lane dimension ((S, N, n_local) matrices,
+    (S,) scalars, (S, n/8) matchings) and the kernel grid becomes (S,)
+    — per-lane salts, matchings, budget dither, fanout masks (folded
+    into ``valid`` by the caller) and FD phi all ride scalar prefetch.
+    Lane s's output is bit-identical to fused_pull_pairs on lane s's
+    operands (tests/test_fused_kernel.py); this is the implementation
+    the custom_vmap rule dispatches to when SweepSimulator vmaps
+    sim_step over scenarios."""
+    return _pairs_call(
+        w, hb, gm, c, valid, salt, run_salt, budget, interpret,
+        mv, hbv, owner_offset, totals, check, fd, fd_params, alias_hb,
+        lanes=True,
     )
-    kernel = functools.partial(
-        _pairs_kernel,
-        n=n_cols,
-        track_hb=track_hb,
-        apply_diag=apply_diag,
-        use_totals=use_totals,
-        check=do_check,
-        nbuf=nbuf,
-    )
-    w_new, hb_new, flag = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct(w.shape, w.dtype),
-            jax.ShapeDtypeStruct(hb.shape, hb.dtype),
-            jax.ShapeDtypeStruct((1, 1), jnp.int32),
-        ],
-        # w (and usually hb) update IN PLACE: every row is read exactly
-        # once (wait_in of its own slot) strictly before its out DMA
-        # starts, and rows across slots are disjoint, so the aliasing
-        # has no read-after-write hazard — unlike the m8 kernel, whose
-        # peer gather may read rows whose output block already streamed
-        # out. Halves the path's peak HBM (one resident copy per
-        # matrix). ``alias_hb=False`` exists for callers that RETAIN
-        # the input hb (the FD's round-start matrix on the round's
-        # first sub-exchange): aliasing a still-live operand makes XLA
-        # insert a full copy — two extra hb passes, worse than the
-        # unaliased write. Indices are over the flattened operand
-        # list: 0-4 scalar prefetch (leaders, gm, c, vbits, abits),
-        # 5 meta is prefetch too, then 6 mv, 7 hbv, 8 need, 9 w,
-        # 10 hb, 11 totals.
-        input_output_aliases=(
-            {9: 0, 10: 1} if alias_hb else {9: 0}
-        ),
-        interpret=interpret,
-    )(
-        leaders,
-        gm,
-        c.astype(jnp.int32),
-        vbits,
-        abits,
-        meta,
-        mv,
-        hbv,
-        need,
-        w,
-        hb,
-        totals,
-    )
-    out = (w_new, hb_new) if track_hb else w_new
-    if do_check:
-        return out, flag[0, 0]
-    return out
 
 
 def _pack_row_bits(mask: jax.Array, n: int) -> jax.Array:
@@ -1143,6 +1548,76 @@ def _pairs_slots(n: int, gm: jax.Array, valid: jax.Array):
     return leaders.astype(jnp.int32), count, _pack_row_bits(valid, n)
 
 
+def _pairs_totals_call(w, gm, c, valid, interpret, mv, owner_offset, lanes):
+    apply_diag = mv is not None
+    if lanes:
+        n_lanes, n, n_cols = w.shape
+    else:
+        n, n_cols = w.shape
+    if not pairs_supported_for(n, w, None):
+        raise ValueError(f"pair-fused totals cannot run shape {w.shape}")
+    gm = gm.astype(jnp.int32)
+    if lanes:
+        leaders, count, vbits = jax.vmap(
+            lambda g, v: _pairs_slots(n, g, v)
+        )(gm, valid)
+        off = jnp.broadcast_to(
+            jnp.asarray(owner_offset, jnp.int32), (n_lanes,)
+        ).astype(jnp.int32)
+        meta = jnp.stack([count, off], axis=1)
+    else:
+        leaders, count, vbits = _pairs_slots(n, gm, valid)
+        meta = jnp.stack([count, jnp.asarray(owner_offset, jnp.int32)])
+    if apply_diag:
+        mv = mv.astype(jnp.int32)
+        mv = mv[:, None, :] if lanes else mv[None, :]
+        vec_spec = (
+            pl.BlockSpec((None, 1, n_cols), lambda s, *_: (s, 0, 0))
+            if lanes
+            else pl.BlockSpec((1, n_cols), lambda *_: (0, 0))
+        )
+    else:
+        mv = jnp.zeros((1, 128), jnp.int32)
+        vec_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(n_lanes,) if lanes else (1,),
+        in_specs=[
+            vec_spec,  # mv row (dummy tile when diag off)
+            pl.BlockSpec(memory_space=pl.ANY),  # w (HBM operand)
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # totals out
+        scratch_shapes=[
+            pltpu.VMEM((32, n_cols), w.dtype),  # win
+            pltpu.VMEM((32, 1), jnp.float32),  # tout
+            pltpu.SemaphoreType.DMA((2, 2)),  # in [buf, side]
+            pltpu.SemaphoreType.DMA((2, 2)),  # out
+        ],
+    )
+    kernel = functools.partial(
+        _pairs_totals_kernel, n=n_cols, apply_diag=apply_diag, lanes=lanes
+    )
+    (tot,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (n_lanes, n, 1) if lanes else (n, 1), jnp.float32
+            )
+        ],
+        interpret=interpret,
+    )(
+        leaders,
+        gm,
+        c.astype(jnp.int32),
+        vbits,
+        meta,
+        mv,
+        w,
+    )
+    return tot[..., 0]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fused_pull_pairs_totals(
     w: jax.Array,
@@ -1159,51 +1634,160 @@ def fused_pull_pairs_totals(
     result across shards and passes it to fused_pull_pairs as
     ``totals``; f32 sums of integer deficits are exact below 2^24, so
     the two-pass result is bit-identical to the single-pass kernel's."""
-    apply_diag = mv is not None
-    n, n_cols = w.shape
-    if not pairs_supported_for(n, w, None):
-        raise ValueError(f"pair-fused totals cannot run shape {w.shape}")
-    leaders, count, vbits = _pairs_slots(n, gm, valid)
-    meta = jnp.stack([count, jnp.asarray(owner_offset, jnp.int32)])
-    if apply_diag:
-        mv = mv.astype(jnp.int32)[None, :]
-        vec_spec = pl.BlockSpec((1, n_cols), lambda *_: (0, 0))
-    else:
-        mv = jnp.zeros((1, 128), jnp.int32)
-        vec_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(1,),
-        in_specs=[
-            vec_spec,  # mv row (dummy tile when diag off)
-            pl.BlockSpec(memory_space=pl.ANY),  # w (HBM operand)
-        ],
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # totals out
-        scratch_shapes=[
-            pltpu.VMEM((32, n_cols), w.dtype),  # win
-            pltpu.VMEM((32, 1), jnp.float32),  # tout
-            pltpu.SemaphoreType.DMA((2, 2)),  # in [buf, side]
-            pltpu.SemaphoreType.DMA((2, 2)),  # out
-        ],
+    return _pairs_totals_call(
+        w, gm, c, valid, interpret, mv, owner_offset, lanes=False
     )
-    kernel = functools.partial(
-        _pairs_totals_kernel, n=n_cols, apply_diag=apply_diag
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_pull_pairs_totals_lanes(
+    w: jax.Array,
+    gm: jax.Array,
+    c: jax.Array,
+    valid: jax.Array,
+    interpret: bool = False,
+    mv: jax.Array | None = None,
+    owner_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """fused_pull_pairs_totals over a leading lane axis: (S, N, n_local)
+    w -> (S, N) local totals, one grid step per lane (the sharded sweep
+    path's pass A)."""
+    return _pairs_totals_call(
+        w, gm, c, valid, interpret, mv, owner_offset, lanes=True
     )
-    (tot,) = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((n, 1), jnp.float32)],
-        interpret=interpret,
-    )(
-        leaders,
-        gm.astype(jnp.int32),
-        c.astype(jnp.int32),
-        vbits,
-        meta,
-        mv,
-        w,
-    )
-    return tot[:, 0]
+
+
+def _bcast_lane(x, batched, axis_size):
+    """Broadcast an unbatched operand up to the lane axis (custom_vmap
+    rule helper); batched operands already carry it in front."""
+    if batched:
+        return x
+    x = jnp.asarray(x)
+    return jnp.broadcast_to(x[None, ...], (axis_size,) + x.shape)
+
+
+@functools.lru_cache(maxsize=128)
+def _pairs_dispatcher(op_keys, budget, interpret, alias_hb, fd_params):
+    """custom_vmap entry for one static pairs-call configuration: the
+    primal path is the single-lane kernel; a vmapped call (sim_step
+    under SweepSimulator's lane vmap) broadcasts any unbatched operands
+    to the lane axis and runs the lane-lifted kernel — the grid itself
+    absorbs the batch dimension instead of falling back to XLA. Keyed
+    by the operand-name set (which optional blocks exist) plus the
+    static scalars, so the returned callable is stable across sim_step
+    retraces and its jit cache keys."""
+    op_keys = frozenset(op_keys)
+    do_check = "need" in op_keys
+    do_fd = "lc" in op_keys
+
+    def build(ops, lanes):
+        fn = fused_pull_pairs_lanes if lanes else fused_pull_pairs
+        check = (
+            (ops["need"], ops["alive"], ops["alive_owner"])
+            if do_check
+            else None
+        )
+        fd = (
+            (ops["tick"], ops["lc"], ops["im"], ops["ic"],
+             ops.get("hb0"), ops["phi"])
+            if do_fd
+            else None
+        )
+        out = fn(
+            ops["w"],
+            ops.get("hb"),
+            ops["gm"],
+            ops["c"],
+            ops["valid"],
+            ops["salt"],
+            ops["run_salt"],
+            budget,
+            interpret=interpret,
+            mv=ops.get("mv"),
+            hbv=ops.get("hbv"),
+            owner_offset=ops["owner_offset"],
+            totals=ops.get("totals"),
+            check=check,
+            fd=fd,
+            fd_params=fd_params,
+            alias_hb=alias_hb,
+        )
+        # Flatten to one tuple so primal and vmap rule agree on the
+        # output pytree: (w, hb?, lc, im, ic, live?; flag?).
+        if do_check:
+            out, flag = out
+        flat = out if isinstance(out, tuple) else (out,)
+        if do_check:
+            flat = flat + (flag,)
+        return flat
+
+    @jax.custom_batching.custom_vmap
+    def run(ops):
+        return build(ops, lanes=False)
+
+    @run.def_vmap
+    def _rule(axis_size, in_batched, ops):
+        batched = in_batched[0]  # one positional arg: the ops dict
+        ops = {
+            k: _bcast_lane(v, batched[k], axis_size)
+            for k, v in ops.items()
+        }
+        out = build(ops, lanes=True)
+        return out, tuple(True for _ in out)
+
+    return run
+
+
+def pairs_pull(ops: dict, *, budget, interpret, alias_hb, fd_params=None):
+    """The sim_step-facing pairs entry: dict-of-operands in, flat tuple
+    out — (w', hb'?, last_change'?, imean'?, icount'?, live'?, flag?)
+    with the optional parts keyed off which operands are present. Under
+    jax.vmap (a sweep's lane axis) the custom_vmap rule reroutes to the
+    lane-lifted kernel; called unbatched it is exactly
+    fused_pull_pairs."""
+    return _pairs_dispatcher(
+        frozenset(ops), budget, interpret, alias_hb, fd_params
+    )(ops)
+
+
+@functools.lru_cache(maxsize=32)
+def _pairs_totals_dispatcher(op_keys, interpret):
+    op_keys = frozenset(op_keys)
+
+    def build(ops, lanes):
+        fn = (
+            fused_pull_pairs_totals_lanes if lanes else fused_pull_pairs_totals
+        )
+        return fn(
+            ops["w"],
+            ops["gm"],
+            ops["c"],
+            ops["valid"],
+            interpret=interpret,
+            mv=ops.get("mv"),
+            owner_offset=ops["owner_offset"],
+        )
+
+    @jax.custom_batching.custom_vmap
+    def run(ops):
+        return build(ops, lanes=False)
+
+    @run.def_vmap
+    def _rule(axis_size, in_batched, ops):
+        batched = in_batched[0]
+        ops = {
+            k: _bcast_lane(v, batched[k], axis_size)
+            for k, v in ops.items()
+        }
+        return build(ops, lanes=True), True
+
+    return run
+
+
+def pairs_totals(ops: dict, *, interpret):
+    """sim_step-facing totals pass A (sharded path): vmap-aware like
+    ``pairs_pull`` — lanes hit the lane-lifted totals kernel."""
+    return _pairs_totals_dispatcher(frozenset(ops), interpret)(ops)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -22,7 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.gossip import (
     convergence_metrics,
-    pallas_fd_engaged,
+    fd_phase_engaged,
     pallas_path_engaged,
     sim_step,
     version_spread,
@@ -91,7 +91,9 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
     )
 
 
-def _check_vma(cfg: SimConfig, mesh: Mesh, topology: bool) -> bool:
+def _check_vma(
+    cfg: SimConfig, mesh: Mesh, topology: bool, sweep: bool = False
+) -> bool:
     """Keep shard_map's varying-manual-axes checker ON except when a
     Pallas kernel engages for this config: the checker cannot see
     through pallas_call's internal block slicing (interpret mode trips
@@ -99,12 +101,21 @@ def _check_vma(cfg: SimConfig, mesh: Mesh, topology: bool) -> bool:
     error text itself prescribes check_vma=False). Pure-XLA sharded
     runs keep the static safety net (ADVICE r2); kernel configs rely on
     the stronger bit-identity tests (tests/test_sim_sharded.py,
-    tests/test_pallas_fd.py, tests/test_pallas_sharded.py)."""
+    tests/test_pallas_fd.py, tests/test_pallas_sharded.py,
+    tests/test_fused_kernel.py). ``sweep`` mirrors sim_step's gate for
+    BOTH kernel families: a sweep chunk whose shape falls off the pairs
+    domain runs pure XLA (the standalone FD kernel has no lane axis
+    either), so it KEEPS the static safety net — resolving the FD term
+    through fd_phase_engaged with the same sweep flag sim_step uses."""
     n_local = cfg.n_nodes // mesh.size
+    axis = None if mesh.size == 1 else AXIS
     return not (
-        pallas_fd_engaged(cfg, n_local)
+        fd_phase_engaged(
+            cfg, axis, n_local, has_topology=topology, sweep=sweep
+        )
+        in ("fused", "kernel")
         or pallas_path_engaged(
-            cfg, AXIS, has_topology=topology, n_local=n_local
+            cfg, AXIS, has_topology=topology, n_local=n_local, sweep=sweep
         )
     )
 
@@ -254,10 +265,10 @@ def sharded_sweep_chunk_fn(cfg: SimConfig, mesh: Mesh, *, tracked: bool = False)
     import jax.numpy as jnp
 
     spec = sweep_state_partition_spec()
-    # Sweeps pin the XLA path inside sim_step, so the vma checker has no
-    # pallas_call to trip over; _check_vma still consults the gates in
-    # case a future kernel learns a lane axis.
-    check = _check_vma(cfg, mesh, False)
+    # Sweeps engage the lane-lifted pairs kernels when the shape allows
+    # (sim_step's sweep-aware gate), so the vma checker must stand down
+    # for exactly those configs.
+    check = _check_vma(cfg, mesh, False, sweep=True)
 
     if not tracked:
 

@@ -1,4 +1,5 @@
-"""Bytes-budget mode: tie the sim's key-version budget to the real MTU.
+"""Bytes models: the MTU <-> key-version budget bridge and the
+per-round HBM-traffic model behind the bench roofline.
 
 The tensor sim bounds each exchange by ``SimConfig.budget`` key-versions —
 an abstraction of the object model's byte-exact MTU packer (reference
@@ -22,7 +23,103 @@ from ..core.identity import NodeId
 from ..core.messages import KeyValueUpdate, VersionStatusEnum
 from ..wire.sizes import DeltaSizeModel
 
-__all__ = ("budget_from_mtu",)
+__all__ = ("budget_from_mtu", "per_round_bytes", "roofline_models")
+
+
+# -- per-round HBM traffic model ----------------------------------------------
+#
+# Analytic bytes one gossip round moves through device memory, per
+# execution path — the denominator of the bench roofline
+# (bench.py::sim_rounds_per_sec). Passes per (N, N) matrix per
+# sub-exchange:
+#
+# - "pairs": the pair-fused kernel reads and writes every row exactly
+#   once (2 passes) — visiting pair (g, gm[g]) covers both directions.
+# - "m8": the single-pass kernel streams each row as self, gathers it
+#   again as its partner's peer, and writes it (3 passes).
+# - "xla": the plain XLA matching path materializes the peer-row gather
+#   (read w + write gather + read gather + write result = 4 passes).
+#
+# FD phase (full profiles):
+#
+# - "kernel"/"xla": a separate pass over the heartbeat matrices — hb +
+#   round-start hb reads, last_change/imean/icount read+write, live
+#   read+write (the accounting every BENCH record through r05 used).
+# - "fused": the FD rides the round's last pairs sub-exchange, which
+#   already holds the post-exchange hb tiles in VMEM — only the
+#   bookkeeping moves (last_change/imean/icount in-place read+write,
+#   one live write), plus one round-start hb read when fanout > 1
+#   (at fanout == 1 the sub-exchange's own input IS round-start).
+#
+# The fully-fused model ("pairs" + "fused") is the minimal-traffic
+# denominator — one read and one write of w/hb per sub-exchange, FD for
+# the price of its bookkeeping — which is what the ≥0.6-of-HBM-peak
+# target in ROADMAP item 3 is measured against.
+
+_PULL_PASSES = {"pairs": 2, "m8": 3, "xla": 4}
+
+
+def per_round_bytes(
+    cfg, *, variant: str = "pairs", fd_phase: str | None = None
+) -> int:
+    """Analytic HBM bytes of one gossip round for ``cfg`` executed on
+    the given pull ``variant`` ("pairs"/"m8"/"xla") and FD phase
+    ("fused"/"kernel"/"xla"/"off"; None derives off/xla from the
+    config). Shared by bench.py's roofline block so the recorded
+    fractions always divide by a model named next to the variant
+    provenance."""
+    import jax.numpy as jnp
+
+    if variant not in _PULL_PASSES:
+        raise ValueError(f"unknown variant {variant!r}")
+    if fd_phase is None:
+        fd_phase = "xla" if cfg.track_failure_detector else "off"
+    if fd_phase == "off" and cfg.track_failure_detector:
+        raise ValueError("fd_phase='off' on an FD-tracking config")
+    n2 = cfg.n_nodes * cfg.n_nodes
+    m_w = n2 * jnp.dtype(cfg.version_dtype).itemsize
+    m_hb = (
+        n2 * jnp.dtype(cfg.heartbeat_dtype).itemsize
+        if cfg.track_heartbeats
+        else 0
+    )
+    total = cfg.fanout * _PULL_PASSES[variant] * (m_w + m_hb)
+    if cfg.track_failure_detector:
+        m_fd = n2 * jnp.dtype(cfg.fd_dtype).itemsize
+        m_lc = m_hb  # last_change is heartbeat-dtype
+        if fd_phase == "fused":
+            if cfg.fanout > 1:
+                total += m_hb  # round-start hb0 stream
+            total += 2 * m_lc  # last_change r/w (in place)
+            total += 2 * m_fd  # imean r/w
+            total += 2 * n2 * 2  # icount int16 r/w
+            total += n2  # live_view bool write
+        else:
+            total += 2 * m_hb  # hb + round-start hb reads
+            total += 2 * m_lc  # last_change r/w
+            total += 2 * m_fd  # imean r/w
+            total += 2 * n2 * 2  # icount int16 r/w
+            total += 2 * n2  # live_view bool r/w
+    return int(total)
+
+
+def roofline_models(cfg, *, variant: str, fd_phase: str) -> dict:
+    """The three denominators a BENCH roofline block reports: the
+    ENGAGED path's bytes (what actually ran — the headline fraction),
+    the fully-fused minimal-traffic model, and the plain-XLA model.
+    ``variant``/``fd_phase`` come from the same gossip.py resolutions
+    sim_step dispatches on (pallas_variant_engaged / fd_phase_engaged),
+    so the stamp can never drift from the compiled step."""
+    fd_on = cfg.track_failure_detector
+    return {
+        "engaged": per_round_bytes(cfg, variant=variant, fd_phase=fd_phase),
+        "fused": per_round_bytes(
+            cfg, variant="pairs", fd_phase="fused" if fd_on else "off"
+        ),
+        "xla": per_round_bytes(
+            cfg, variant="xla", fd_phase="xla" if fd_on else "off"
+        ),
+    }
 
 
 def budget_from_mtu(

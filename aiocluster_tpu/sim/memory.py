@@ -49,13 +49,15 @@ class MemoryPlan:
         return self.per_shard_bytes <= int(hbm_bytes_per_chip * 0.8)
 
 
-def engaged_variant(cfg: SimConfig, shards: int = 1) -> str:
+def engaged_variant(cfg: SimConfig, shards: int = 1, lanes: int = 1) -> str:
     """Which pull path would actually dispatch for ``cfg`` on the chip:
     "pairs", "m8", or "xla". THE single resolution shared by the
     analytic plan and the measured-boundary key — the two must never
     key memory behavior off different answers. Resolves the env
     override and "auto" as if on the accelerator (planning hosts must
-    agree with the chip)."""
+    agree with the chip). ``lanes > 1`` asks for the SWEEP dispatch
+    (sim_step's sweep-aware gate: the lane-lifted pairs kernels or
+    nothing — m8 has no lane axis)."""
     from ..ops.gossip import (
         pallas_path_engaged,
         pallas_variant_engaged,
@@ -66,7 +68,8 @@ def engaged_variant(cfg: SimConfig, shards: int = 1) -> str:
     axis = None if shards == 1 else "owners"
     n_local = cfg.n_nodes // shards
     if not pallas_path_engaged(
-        cfg, axis, n_local=n_local, assume_accelerator=True
+        cfg, axis, n_local=n_local, assume_accelerator=True,
+        sweep=lanes > 1,
     ):
         return "xla"
     return pallas_variant_engaged(cfg, axis, n_local)
@@ -75,9 +78,11 @@ def engaged_variant(cfg: SimConfig, shards: int = 1) -> str:
 def plan(cfg: SimConfig, shards: int = 1, lanes: int = 1) -> MemoryPlan:
     """Bytes needed for ``cfg`` sharded ``shards`` ways on the owner
     axis. ``lanes`` > 1 models a SweepSimulator run: state and step
-    transients scale linearly with the lane count, and sweeps always
-    take the XLA path (the in-place pairs-kernel discount below never
-    applies to them)."""
+    transients scale linearly with the lane count. Sweeps served by the
+    lane-lifted pairs kernels (engaged_variant(cfg, shards, lanes) ==
+    "pairs") earn the same in-place discount as single runs — per lane;
+    sweeps off the pairs domain run XLA and pay the gathered-operand
+    transients per lane."""
     if lanes < 1:
         raise ValueError("lanes must be >= 1")
     n = cfg.n_nodes
@@ -110,11 +115,14 @@ def plan(cfg: SimConfig, shards: int = 1, lanes: int = 1) -> MemoryPlan:
     # (input_output_aliases) and never materializes a gather: its
     # steady-state peak is the resident state alone. Decided by the
     # same resolution sim_step dispatches on (engaged_variant: env
-    # override folded in, "auto" resolved as if on the accelerator) —
-    # the planner answers "will it fit the chip?" and must give the
-    # same answer from a CPU planning host (tests/test_benchmarks.py
-    # pins it to bench's constant).
-    if lanes == 1 and engaged_variant(cfg, shards) == "pairs":
+    # override folded in, "auto" resolved as if on the accelerator,
+    # lane-batched sweeps resolved through the sweep gate) — the
+    # planner answers "will it fit the chip?" and must give the same
+    # answer from a CPU planning host (tests/test_benchmarks.py pins
+    # it to bench's constant). Since the lane-lifted kernels landed,
+    # the discount applies per LANE too: a pairs-served sweep holds
+    # one resident copy per lane, no gathers.
+    if engaged_variant(cfg, shards, lanes) == "pairs":
         # FD configs retain the round-start heartbeat matrix for the
         # phi phase, so the first sub-exchange does NOT alias hb
         # (gossip.py alias_hb) — a second full (N, N) heartbeat matrix
@@ -157,14 +165,18 @@ def _boundaries_path() -> str:
 
 
 def _boundary_key(
-    cfg: SimConfig, shards: int, hbm_bytes_per_chip: int
+    cfg: SimConfig, shards: int, hbm_bytes_per_chip: int, lanes: int = 1
 ) -> dict:
     """The signature a measured verdict is valid for: the execution
-    path (kernel variant + profile + shards) AND the chip capacity it
-    was observed on — a 16 GiB no-fit says nothing about a 32 GiB
-    part."""
+    path (kernel variant + profile + shards + sweep lanes) AND the chip
+    capacity it was observed on — a 16 GiB no-fit says nothing about a
+    32 GiB part, and an 8-lane sweep OOM says nothing about a
+    single-run fit at the same (variant, profile, shards): lanes
+    multiply resident state, so they are part of the key (entries
+    recorded before the sweep engine carry no ``lanes`` field and read
+    as 1 — see fits_verdict)."""
     return {
-        "variant": engaged_variant(cfg, shards),
+        "variant": engaged_variant(cfg, shards, lanes),
         "version_dtype": cfg.version_dtype,
         "heartbeat_dtype": cfg.heartbeat_dtype if cfg.track_heartbeats else None,
         "fd_dtype": cfg.fd_dtype if cfg.track_failure_detector else None,
@@ -172,6 +184,7 @@ def _boundary_key(
         "track_failure_detector": cfg.track_failure_detector,
         "pairing": cfg.pairing,
         "shards": shards,
+        "lanes": lanes,
         "hbm_bytes_per_chip": hbm_bytes_per_chip,
     }
 
@@ -193,6 +206,7 @@ def record_boundary(
     source: str = "",
     path: str | None = None,
     hbm_bytes_per_chip: int = 16 * 1024**3,
+    lanes: int = 1,
 ) -> dict:
     """Append one measured fit/no-fit outcome (atomic rewrite under an
     inter-process lock — the bench ladder and the battery can both run
@@ -204,7 +218,7 @@ def record_boundary(
 
     path = path or _boundaries_path()
     entry = {
-        **_boundary_key(cfg, shards, hbm_bytes_per_chip),
+        **_boundary_key(cfg, shards, hbm_bytes_per_chip, lanes),
         "n_nodes": cfg.n_nodes,
         "fits": bool(fits),
         "rounds_per_sec": rounds_per_sec,
@@ -234,6 +248,7 @@ def fits_verdict(
     shards: int = 1,
     hbm_bytes_per_chip: int = 16 * 1024**3,
     path: str | None = None,
+    lanes: int = 1,
 ) -> dict:
     """Will this config fit one chip's HBM — measured evidence first,
     model second.
@@ -250,12 +265,19 @@ def fits_verdict(
     it. Otherwise the analytic MemoryPlan answers, flagged
     ``measured=False`` so consumers (bench, README claims) can label
     planner-derived numbers honestly."""
-    p = plan(cfg, shards)
-    key = _boundary_key(cfg, shards, hbm_bytes_per_chip)
+    p = plan(cfg, shards, lanes)
+    key = _boundary_key(cfg, shards, hbm_bytes_per_chip, lanes)
     # Latest-per-n first: re-measuring a rung supersedes its old verdict.
     latest: dict[int, dict] = {}
     for e in load_boundaries(path):
-        if any(e.get(k) != v for k, v in key.items()):
+        # Entries recorded before the sweep engine carry no "lanes"
+        # field: they were single runs, so they read as lanes=1 — a
+        # sweep OOM can therefore never poison single-run verdicts for
+        # the same (variant, profile, shards) key, and vice versa.
+        if any(
+            (e.get(k, 1) if k == "lanes" else e.get(k)) != v
+            for k, v in key.items()
+        ):
             continue
         n = e["n_nodes"]
         if n not in latest or e.get("ts", "") >= latest[n].get("ts", ""):

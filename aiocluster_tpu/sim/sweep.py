@@ -28,12 +28,18 @@ Sweeps compose with the ``owners`` shard axis (parallel/mesh.py): lane
 x owner-sharded matrices are (S, N, n_local) with lanes and rows
 unsharded, and every collective becomes one batched (S,)-wide dispatch.
 
-Bit-identity contract (tests/test_sweep.py): an S-lane sweep is
-bit-identical to S sequential single-sim runs with the same seeds and
-the lane's values applied as static config fields — unsharded and under
-a mesh. Sweep steps run the plain XLA path (the fused Pallas kernels
-carry no lane axis), which preserves that contract on every backend
-because the kernels are bit-identical to XLA by construction.
+Bit-identity contract (tests/test_sweep.py, tests/test_fused_kernel.py):
+an S-lane sweep is bit-identical to S sequential single-sim runs with
+the same seeds and the lane's values applied as static config fields —
+unsharded and under a mesh. Sweep steps engage the fused Pallas path
+whenever the pairs variant serves the shape: the pairs kernels carry a
+lane grid axis (ops/pallas_pull.py custom_vmap dispatch lifts the
+vmapped call onto it, per-lane scalars riding scalar prefetch), so the
+multi-scenario path — the one an operator actually runs — is no longer
+pinned to the slowest backend. Off the pairs domain sweeps run plain
+XLA; either way every lane matches the equivalent sequential run
+bit-for-bit because the kernels are bit-identical to XLA by
+construction.
 """
 
 from __future__ import annotations

@@ -1,0 +1,14 @@
+"""A Pallas kernel wrapper whose differential test IS registered:
+bit-identity to the XLA path pinned in tests/test_fused_kernel.py
+(an existing file — the rule checks the reference resolves)."""
+# analyze-domain: ops
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def tested_kernel_wrapper(x):
+    return pl.pallas_call(
+        lambda x_ref, o_ref: None,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,15 @@
+"""A Pallas kernel wrapper with NO registered differential test: neither
+this docstring nor the function's references an existing tests/test_*.py
+path, so the kernel's parity with the XLA path is unpinned."""
+# analyze-domain: ops
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def untested_kernel_wrapper(x):
+    """Streams x through VMEM (no parity suite registered)."""
+    return pl.pallas_call(
+        lambda x_ref, o_ref: None,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,468 @@
+"""Fused round kernel: one-pass pull + FD with sweep-lane support.
+
+Interpret-mode differential suite for the PR-6 tentpole
+(ops/pallas_pull.py ``fd=`` epilogue + lane-lifted kernels,
+ops/gossip.py ``fd_phase_engaged`` dispatch): the fused path must be
+bit-identical to the XLA path for the lean, full-FD, dead-grace,
+fault-masked and multi-lane sweep configs — unsharded and under a
+2-shard mesh — and every config that WANTS the kernels but cannot have
+them must fall back loudly (the ``pallas_fallbacks`` metric counter,
+not a print). ``make kernel-parity`` runs this file; the compiled path
+is exercised on real TPU by bench.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+from jax import random
+
+from aiocluster_tpu.ops.gossip import (
+    fd_phase_engaged,
+    pallas_fallback_reason,
+    pallas_fallbacks,
+    pallas_path_engaged,
+    pallas_variant_engaged,
+    sim_step,
+)
+from aiocluster_tpu.sim import SimConfig, Simulator
+from aiocluster_tpu.sim.state import init_state
+from aiocluster_tpu.sim.sweep import SweepSimulator
+
+FD_FIELDS = ("w", "hb_known", "last_change", "imean", "icount", "live_view")
+
+
+def _assert_states_equal(a, b, fields, msg=""):
+    for f in fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+            err_msg=f"{msg}:{f}",
+        )
+
+
+# -- the fused round: pull + FD in ONE dispatch -------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fanout", [1, 3])
+def test_fused_round_full_fd_matches_xla(fanout):
+    """Full-FD profile: the FD phase rides the round's last pairs
+    sub-exchange (fanout == 1: zero extra heartbeat traffic; fanout > 1:
+    the streamed-hb0 form) and the whole trajectory — watermarks AND all
+    four FD outputs — equals the XLA path bit-for-bit, churn included."""
+    base = dict(
+        n_nodes=128, keys_per_node=6, budget=24, fanout=fanout,
+        death_rate=0.08, revival_rate=0.2, writes_per_round=1,
+        version_dtype="int16", heartbeat_dtype="int16", fd_dtype="bfloat16",
+    )
+    cfg_p = SimConfig(**base, use_pallas=True, pallas_variant="pairs")
+    assert fd_phase_engaged(cfg_p) == "fused"
+    cfg_x = SimConfig(**base)
+    assert fd_phase_engaged(cfg_x) == "xla"
+    sp, sx = init_state(cfg_p), init_state(cfg_x)
+    key = random.key(11)
+    for _ in range(5):
+        sp = sim_step(sp, key, cfg_p)
+        sx = sim_step(sx, key, cfg_x)
+    _assert_states_equal(sp, sx, FD_FIELDS, f"fanout={fanout}")
+
+
+@pytest.mark.slow
+def test_fused_round_lean_profile_matches_xla():
+    """Lean (convergence-only) profile through the same dispatch: no FD
+    epilogue exists, the kernel path still equals XLA."""
+    base = dict(
+        n_nodes=128, keys_per_node=4, fanout=2, budget=16,
+        writes_per_round=1, version_dtype="int16",
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    cfg_p = SimConfig(**base, use_pallas=True, pallas_variant="pairs")
+    assert fd_phase_engaged(cfg_p) == "off"
+    cfg_x = SimConfig(**base)
+    sp, sx = init_state(cfg_p), init_state(cfg_x)
+    key = random.key(3)
+    for _ in range(3):
+        sp = sim_step(sp, key, cfg_p)
+        sx = sim_step(sx, key, cfg_x)
+    _assert_states_equal(sp, sx, ("w",), "lean")
+
+
+@pytest.mark.slow
+def test_fused_round_with_converged_flag_and_fd():
+    """check + fd ride the SAME last sub-exchange (fanout == 1 worst
+    case: diag refresh + convergence check + FD epilogue in one call)."""
+    base = dict(n_nodes=128, keys_per_node=4, fanout=1, budget=4096)
+    cfg_p = SimConfig(**base, use_pallas=True, pallas_variant="pairs")
+    cfg_x = SimConfig(**base)
+    sp, sx = init_state(cfg_p), init_state(cfg_x)
+    key = random.key(4)
+    saw = False
+    # fanout == 1 doubles knowledge at best one matching per round:
+    # expect convergence near log2(n) rounds, bound it well above.
+    for _ in range(18):
+        sp, fp = sim_step(sp, key, cfg_p, return_converged=True)
+        sx, fx = sim_step(sx, key, cfg_x, return_converged=True)
+        assert bool(fp) == bool(fx)
+        saw = saw or bool(fp)
+        if saw:
+            break
+    assert saw
+    _assert_states_equal(sp, sx, FD_FIELDS, "check+fd")
+
+
+@pytest.mark.slow
+def test_fd_ab_seam_keeps_pull_fused():
+    """use_pallas_fd=False pins the FD phase to XLA while the pull stays
+    on the pairs kernel — and the trajectory still matches the all-XLA
+    run (the on-chip A/B seam's contract, now across the fused round)."""
+    base = dict(n_nodes=128, keys_per_node=6, fanout=2, budget=32)
+    cfg_ab = SimConfig(**base, use_pallas=True, use_pallas_fd=False)
+    assert fd_phase_engaged(cfg_ab) == "xla"
+    assert pallas_path_engaged(cfg_ab)
+    cfg_x = SimConfig(**base)
+    sa, sx = init_state(cfg_ab), init_state(cfg_x)
+    key = random.key(7)
+    for _ in range(3):
+        sa = sim_step(sa, key, cfg_ab)
+        sx = sim_step(sx, key, cfg_x)
+    _assert_states_equal(sa, sx, FD_FIELDS, "ab-seam")
+
+
+# -- dead-grace / fault-masked configs: XLA fallback, loudly ------------------
+
+
+def test_dead_grace_config_falls_back_loudly():
+    """The two-stage lifecycle stays off every kernel; a kernel-wanting
+    dead-grace config degrades to XLA AND bumps the metric counter
+    (silently-but-loudly: a counter, not a print)."""
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=4, budget=16, use_pallas=True,
+        dead_grace_ticks=20,
+    )
+    assert not pallas_path_engaged(cfg)
+    assert fd_phase_engaged(cfg) == "xla"
+    assert pallas_fallback_reason(cfg) == "lifecycle"
+    before = pallas_fallbacks["lifecycle"]
+    st = sim_step(init_state(cfg), random.key(0), cfg)
+    assert int(st.tick) == 1
+    assert pallas_fallbacks["lifecycle"] == before + 1
+    # The fallback trajectory IS the XLA trajectory (same dispatch).
+    cfg_x = dataclasses.replace(cfg, use_pallas=False)
+    _assert_states_equal(
+        st, sim_step(init_state(cfg_x), random.key(0), cfg_x),
+        FD_FIELDS, "dead-grace",
+    )
+
+
+def test_fault_masked_config_falls_back_loudly():
+    """A fault plan with EFFECTIVE behavior keeps the kernels off (they
+    carry no link mask) — counted, and bit-identical to the XLA path by
+    construction (it IS the XLA path)."""
+    from aiocluster_tpu.faults.scenarios import flaky_links
+
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=4, budget=16, use_pallas=True,
+        fault_plan=flaky_links(drop=0.3, seed=7),
+    )
+    assert pallas_fallback_reason(cfg) == "fault_plan"
+    before = pallas_fallbacks["fault_plan"]
+    st = sim_step(init_state(cfg), random.key(1), cfg)
+    assert pallas_fallbacks["fault_plan"] == before + 1
+    cfg_x = dataclasses.replace(cfg, use_pallas=False)
+    _assert_states_equal(
+        st, sim_step(init_state(cfg_x), random.key(1), cfg_x),
+        FD_FIELDS, "fault-masked",
+    )
+
+
+def test_just_past_supported_falls_back_loudly(monkeypatch):
+    """A config one step off the supported() domain (here: a VMEM
+    budget no block fits) silently degrades to XLA — and the regression
+    this test pins is that 'silently' still means a metric counter
+    fires, so the degradation is observable without reading stderr."""
+    from aiocluster_tpu.ops import pallas_pull
+
+    monkeypatch.setattr(pallas_pull, "VMEM_BUDGET", 1024)
+    cfg = SimConfig(n_nodes=128, keys_per_node=4, budget=16, use_pallas=True)
+    assert not pallas_path_engaged(cfg)
+    assert pallas_fallback_reason(cfg) == "vmem_or_width"
+    # Off-shape (n % 128 != 0) is the other boundary of supported().
+    cfg_shape = SimConfig(
+        n_nodes=136, keys_per_node=4, budget=16, use_pallas=True
+    )
+    assert pallas_fallback_reason(cfg_shape) == "shape"
+
+
+def test_sweep_off_pairs_domain_reason():
+    """Sweeps engage only the lane-lifted pairs family: a pinned-m8
+    sweep reports the dedicated reason."""
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=4, budget=16, use_pallas=True,
+        pallas_variant="m8",
+    )
+    assert pallas_path_engaged(cfg) and not pallas_path_engaged(
+        cfg, sweep=True
+    )
+    assert pallas_fallback_reason(cfg, sweep=True) == "sweep_needs_pairs"
+    assert fd_phase_engaged(cfg, sweep=True) == "xla"
+
+
+# -- FD dispatch resolution ----------------------------------------------------
+
+
+def test_fd_phase_resolution_matrix():
+    """fd_phase_engaged is THE dispatch resolution (sim_step and bench
+    both read it): fused on the pairs path, standalone kernel elsewhere
+    kernels are wanted, XLA for lifecycle/pinned/unsupported, off
+    without the FD."""
+    assert fd_phase_engaged(SimConfig(n_nodes=128, use_pallas=True)) == "fused"
+    assert (
+        fd_phase_engaged(
+            SimConfig(n_nodes=128, use_pallas=True, pallas_variant="m8")
+        )
+        == "kernel"
+    )
+    assert (
+        fd_phase_engaged(
+            SimConfig(
+                n_nodes=128, use_pallas=True, pairing="choice",
+                peer_mode="view",
+            )
+        )
+        == "kernel"
+    )
+    assert (
+        fd_phase_engaged(
+            SimConfig(n_nodes=128, use_pallas=True, use_pallas_fd=False)
+        )
+        == "xla"
+    )
+    assert (
+        fd_phase_engaged(
+            SimConfig(n_nodes=128, use_pallas=True, dead_grace_ticks=20)
+        )
+        == "xla"
+    )
+    assert (
+        fd_phase_engaged(
+            SimConfig(
+                n_nodes=128, use_pallas=True,
+                track_failure_detector=False, track_heartbeats=False,
+            )
+        )
+        == "off"
+    )
+    # Sharded: the fused form follows the pairs gate at the LOCAL width.
+    assert (
+        fd_phase_engaged(SimConfig(n_nodes=256, use_pallas=True), "owners", 128)
+        == "fused"
+    )
+    assert (
+        fd_phase_engaged(SimConfig(n_nodes=256, use_pallas=True), "owners", 64)
+        == "xla"
+    )
+
+
+# -- supported() / _pick_block boundaries -------------------------------------
+
+
+def test_pairs_fd_vmem_accounting_boundaries():
+    """The fused-FD epilogue charges its tiles in the variant fit check:
+    there are widths the pairs kernel serves lean/plain that it must
+    REFUSE once the FD epilogue rides along — and the no-FD numbers are
+    unchanged (the existing pairs domain is not regressed)."""
+    from aiocluster_tpu.ops.pallas_pull import pairs_nbuf, pairs_supported
+
+    # No-FD accounting unchanged (same pins as tests/test_pallas_pairs).
+    assert pairs_nbuf(65_536, 2, track_hb=False) == 2
+    assert pairs_nbuf(65_664, 2, track_hb=False) is None
+    # With the FD epilogue charged, the ceiling drops but stays real.
+    fd16 = (2, 2)  # int16 heartbeats, bfloat16 means
+    assert pairs_supported(1024, 2, track_hb=True, fd_sizes=fd16)
+    wide = 65_536
+    assert pairs_supported(wide, 2, track_hb=False)
+    assert not pairs_supported(wide, 2, track_hb=True, fd_sizes=fd16)
+    # Monotone: the first unsupported width upward stays unsupported.
+    widths = [n for n in range(1024, 32_768 + 1, 1024)]
+    flags = [
+        pairs_supported(n, 2, track_hb=True, fd_sizes=fd16) for n in widths
+    ]
+    assert flags == sorted(flags, reverse=True)  # True...True,False...False
+    # The gate the variant decision consults agrees with the wrapper:
+    # a supported FD config resolves to pairs and engages.
+    cfg = SimConfig(
+        n_nodes=1024, use_pallas=True, version_dtype="int16",
+        heartbeat_dtype="int16", fd_dtype="bfloat16",
+    )
+    assert pallas_variant_engaged(cfg) == "pairs"
+    assert fd_phase_engaged(cfg) == "fused"
+
+
+def test_pick_block_m8_boundaries():
+    """largest-fitting-block search edges for the single-pass kernel
+    (unchanged by this PR — pinned so the fused work can't regress the
+    fallback kernel's domain)."""
+    from aiocluster_tpu.ops.pallas_pull import _pick_block, supported
+
+    assert supported(128, 2)
+    assert not supported(120, 2)  # off the 128-lane domain
+    assert not supported(1024, 2, n_local=64)  # partial-tile shard width
+    b = _pick_block(1024, 2)
+    assert b is not None and 1024 % b == 0 and b % 8 == 0
+
+
+# -- sweep lanes through the fused kernels ------------------------------------
+
+
+@pytest.mark.slow
+def test_sweep_lanes_fused_matches_sequential():
+    """A 4-lane sweep (fanout + phi + writes all swept) through the
+    lane-lifted fused kernels equals 4 sequential kernel-served runs —
+    which are themselves pinned bit-identical to XLA — lane for lane,
+    bit for bit. This is the acceptance gate: sim_step engages Pallas
+    with ``sweep is not None``."""
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=16, budget=32, fanout=3,
+        use_pallas=True, pallas_variant="pairs", version_dtype="int16",
+    )
+    assert pallas_path_engaged(cfg, sweep=True)
+    assert fd_phase_engaged(cfg, sweep=True) == "fused"
+    seeds = [0, 1, 2, 3]
+    phis = [7.0, 8.0, 9.5, 6.0]
+    wprs = [0, 1, 2, 1]
+    fans = [1, 2, 3, 3]
+    sweep = SweepSimulator(
+        cfg, seeds, phi_threshold=phis, writes_per_round=wprs,
+        fanout=fans, chunk=4,
+    )
+    sweep.run(6)
+    for lane, seed in enumerate(seeds):
+        cfg_lane = dataclasses.replace(
+            cfg, phi_threshold=phis[lane], writes_per_round=wprs[lane],
+            fanout=fans[lane],
+        )
+        sim = Simulator(cfg_lane, seed=seed, chunk=4)
+        sim.run(6)
+        for f in FD_FIELDS + ("max_version", "heartbeat"):
+            a = np.asarray(getattr(sim.state, f))
+            b = np.asarray(getattr(sweep.states, f))[lane]
+            assert np.array_equal(a, b), f"lane {lane} field {f}"
+
+
+@pytest.mark.slow
+def test_sweep_lanes_fused_sharded_matches_sequential():
+    """Lane kernels compose with the owners shard axis: a 4-lane sweep
+    under a 2-shard mesh (two-pass totals + psum per lane, fused FD per
+    shard) equals the sequential single-device runs."""
+    from aiocluster_tpu.parallel.mesh import make_mesh
+
+    cfg = SimConfig(
+        n_nodes=256, keys_per_node=16, budget=32, fanout=2,
+        use_pallas=True, pallas_variant="pairs", version_dtype="int16",
+    )
+    mesh = make_mesh(jax.devices()[:2])
+    seeds = [0, 1, 2, 3]
+    phis = [7.0, 8.0, 9.5, 6.0]
+    fans = [1, 2, 2, 1]
+    sweep = SweepSimulator(
+        cfg, seeds, phi_threshold=phis, fanout=fans, chunk=4, mesh=mesh
+    )
+    sweep.run(4)
+    for lane, seed in enumerate(seeds):
+        cfg_lane = dataclasses.replace(
+            cfg, phi_threshold=phis[lane], fanout=fans[lane]
+        )
+        sim = Simulator(cfg_lane, seed=seed, chunk=4)
+        sim.run(4)
+        for f in FD_FIELDS:
+            a = np.asarray(getattr(sim.state, f))
+            b = np.asarray(getattr(sweep.states, f))[lane]
+            assert np.array_equal(a, b), f"lane {lane} field {f}"
+
+
+@pytest.mark.slow
+def test_tracked_sweep_converged_flag_through_lane_kernel():
+    """run_until_converged through the lane-lifted kernel: the per-lane
+    converged flag rides each lane's last sub-exchange and the exact
+    first-converged round equals the sequential answer."""
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=4, budget=4096, fanout=2,
+        use_pallas=True, pallas_variant="pairs",
+    )
+    seeds = [0, 1, 2, 3]
+    sweep = SweepSimulator(cfg, seeds, chunk=4)
+    got = sweep.run_until_converged(max_rounds=40)
+    assert all(r is not None for r in got)
+    for lane, seed in enumerate(seeds):
+        sim = Simulator(cfg, seed=seed, chunk=4)
+        want = sim.run_until_converged(max_rounds=40)
+        assert got[lane] == want, (lane, got[lane], want)
+
+
+# -- bytes model / provenance stamps ------------------------------------------
+
+
+def test_per_round_bytes_fused_entry():
+    """The fused-path bytes model is strictly below the XLA model (it
+    is the minimal-traffic denominator) and tracks the fanout == 1
+    hb0-free form; lean profiles model the pull only."""
+    from aiocluster_tpu.sim.bytes import per_round_bytes, roofline_models
+
+    full = SimConfig(
+        n_nodes=1024, version_dtype="int16", heartbeat_dtype="int16",
+        fd_dtype="bfloat16",
+    )
+    fused = per_round_bytes(full, variant="pairs", fd_phase="fused")
+    kernel = per_round_bytes(full, variant="pairs", fd_phase="kernel")
+    xla = per_round_bytes(full, variant="xla", fd_phase="xla")
+    m8 = per_round_bytes(full, variant="m8", fd_phase="kernel")
+    assert fused < kernel < m8 < xla
+    # fanout == 1 drops the hb0 stream (one heartbeat matrix read).
+    f1 = dataclasses.replace(full, fanout=1)
+    n2 = full.n_nodes * full.n_nodes
+    # Saved at fanout == 1: both heartbeat-matrix reads (hb + hb0, 2 B
+    # each) and the live read (the fused form only writes live).
+    assert (
+        per_round_bytes(f1, variant="pairs", fd_phase="kernel")
+        - per_round_bytes(f1, variant="pairs", fd_phase="fused")
+        == 2 * (2 * n2) + n2
+    )
+    models = roofline_models(full, variant="pairs", fd_phase="fused")
+    assert models["engaged"] == models["fused"] < models["xla"]
+    lean = SimConfig(
+        n_nodes=1024, version_dtype="int16",
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    assert per_round_bytes(lean, variant="pairs") == 2 * 3 * 1024 * 1024 * 2
+    with pytest.raises(ValueError):
+        per_round_bytes(full, variant="warp")
+
+
+def test_boundary_key_carries_lanes(tmp_path):
+    """A sweep OOM cannot poison single-run verdicts for the same
+    (variant, profile, shards) key — ``lanes`` scopes the evidence, and
+    pre-sweep entries (no lanes field) read as single runs."""
+    from aiocluster_tpu.sim.memory import (
+        fits_verdict,
+        lean_config,
+        record_boundary,
+    )
+
+    path = str(tmp_path / "b.json")
+    cfg = lean_config(12_800, pallas_variant="m8")
+    record_boundary(cfg, 1, False, source="sweep-oom", path=path, lanes=8)
+    # The 8-lane OOM decides 8-lane queries...
+    v8 = fits_verdict(cfg, path=path, lanes=8)
+    assert v8["measured"] is True and v8["fits"] is False
+    # ...but says nothing about the single run.
+    v1 = fits_verdict(cfg, path=path)
+    assert v1["measured"] is False
+    # And a legacy entry (written without a lanes field) still answers
+    # single-run queries: simulate by recording lanes=1 explicitly.
+    record_boundary(cfg, 1, True, source="single", path=path)
+    v1b = fits_verdict(cfg, path=path)
+    assert v1b["measured"] is True and v1b["fits"] is True

@@ -352,18 +352,24 @@ def test_sweep_checkpoint_rejected_by_single_loader(tmp_path):
 
 
 def test_memory_plan_lane_aware():
-    from aiocluster_tpu.sim.memory import lean_config, plan
+    from aiocluster_tpu.sim.memory import engaged_variant, lean_config, plan
 
     cfg = lean_config(1024)
     one = plan(cfg)
     eight = plan(cfg, lanes=8)
     assert one.lanes == 1 and eight.lanes == 8
     assert eight.state_bytes == 8 * one.state_bytes
-    # Sweeps run the XLA path: the pairs-kernel zero-transient discount
-    # must NOT apply to a multi-lane plan even when the single-lane
-    # config would earn it.
-    assert eight.transient_bytes >= 8 * one.transient_bytes
-    assert eight.transient_bytes > 0
+    # Since the lane-lifted pairs kernels landed, a pairs-served sweep
+    # earns the in-place discount PER LANE (the "discount never applies
+    # to sweeps" assumption is retired with sim_step's sweep gate).
+    assert engaged_variant(cfg, 1, 8) == "pairs"
+    assert eight.transient_bytes == 8 * one.transient_bytes == 0
+    # A config pinned off the kernels still pays the gathered-operand
+    # transients once per lane.
+    xla = dataclasses.replace(cfg, use_pallas=False)
+    assert engaged_variant(xla, 1, 8) == "xla"
+    eight_x = plan(xla, lanes=8)
+    assert eight_x.transient_bytes == 8 * plan(xla).transient_bytes > 0
     with pytest.raises(ValueError):
         plan(cfg, lanes=0)
 

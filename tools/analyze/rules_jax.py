@@ -232,6 +232,73 @@ def check_lane_sync_in_sweep_loop(ctx: FileContext):
             )
 
 
+@rule(
+    "ACT024",
+    "pallas-kernel-untested",
+    "pl.pallas_call site without a registered XLA differential test",
+)
+def check_pallas_differential_test(ctx: FileContext):
+    """Every Pallas kernel in this repo is pinned bit-identical to the
+    XLA path by an interpret-mode differential suite (the `make
+    kernel-parity` gate) — a kernel without one is exactly how a silent
+    numerics drift ships. The registration convention is textual and
+    checkable: the function wrapping the ``pl.pallas_call`` (or its
+    module docstring) must reference an EXISTING ``tests/test_*.py``
+    file. Scoped to the ops domain (kernels live there; fixtures opt in
+    via ``# analyze-domain: ops``)."""
+    import re
+
+    from .core import REPO_ROOT
+
+    if ctx.tree is None or "ops" not in ctx.domains:
+        return
+    test_ref = re.compile(r"tests/test_[A-Za-z0-9_]+\.py")
+
+    def has_registered_test(doc: str | None) -> bool:
+        for ref in test_ref.findall(doc or ""):
+            if (REPO_ROOT / ref).is_file():
+                return True
+        return False
+
+    mod_ok = has_registered_test(ast.get_docstring(ctx.tree))
+    if mod_ok:
+        return
+    funcs = [
+        fn
+        for fn in ast.walk(ctx.tree)
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    # Pass 1: a credited function covers every call site under it
+    # (nested defs included) — collected first so an uncredited OUTER
+    # function cannot flag a credited inner one's site.
+    seen: set[tuple[int, int]] = set()
+    for fn in funcs:
+        if has_registered_test(ast.get_docstring(fn)):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    seen.add((node.lineno, node.col_offset))
+    for fn in funcs:
+        if has_registered_test(ast.get_docstring(fn)):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue  # nested defs: report each call site once
+            target = ctx.resolve(node.func)
+            if target is not None and target.endswith("pallas_call"):
+                seen.add(key)
+                yield ctx.finding(
+                    node,
+                    "ACT024",
+                    f"'pl.pallas_call' in '{fn.name}' has no registered "
+                    "XLA differential test (reference an existing "
+                    "tests/test_*.py in the function or module "
+                    "docstring; see docs/static-analysis.md)",
+                )
+
+
 @rule("ACT022", "import-time-jnp", "jnp computation at module import time")
 def check_import_time_jnp(ctx: FileContext):
     tree = ctx.tree
