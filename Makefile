@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos sweep-bench kernel-parity check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos sweep-bench kernel-parity multihost-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -51,12 +51,21 @@ sweep-bench:
 kernel-parity:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fused_kernel.py -q
 
+# Multihost smoke (benchmarks/multihost_bench.py): TWO real processes
+# join a localhost coordinator (4 virtual CPU devices each, gloo
+# collectives) and run the sharded lean profile — a measured rounds/s
+# figure with bit-parity against the single-process 8-device run
+# asserted in-band. ~1 min on a 1-core host.
+multihost-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/multihost_bench.py --smoke
+
 # What CI runs; a red suite, dirty lint, new analysis finding, a failed
-# chaos soak, a sweep-amortization regression, or a kernel-parity break
-# cannot land through this gate. (kernel-parity re-runs one test file
-# that test-all also covers — the explicit target keeps the merge gate
-# for kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench test-all
+# chaos soak, a sweep-amortization regression, a kernel-parity break,
+# or a multihost parity/measurement failure cannot land through this
+# gate. (kernel-parity re-runs one test file that test-all also covers
+# — the explicit target keeps the merge gate for kernel work nameable
+# and runnable alone.)
+check: lint analyze kernel-parity sweep-bench multihost-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

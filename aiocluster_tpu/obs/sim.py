@@ -97,6 +97,21 @@ class SimMetrics:
             "(bounded; sim/simulator.py BoundedFnCache)",
             labels=("engine",),
         ).labels(engine)
+        self._state_bytes = self.registry.gauge(
+            "aiocluster_sim_state_bytes",
+            "Planned resident SimState bytes for this run's memory-"
+            "ladder rung (sim.memory.plan; set once at construction)",
+            labels=("engine",),
+        ).labels(engine)
+        self._pallas_fallbacks = self.registry.gauge(
+            "aiocluster_sim_pallas_fallbacks",
+            "Traced configs that WANTED the Pallas kernels but degraded "
+            "to XLA, by reason — the PROCESS-WIDE trace-time ledger "
+            "(ops.gossip.pallas_fallbacks), mirrored at flush; "
+            "deliberately NOT engine-labelled, because the ledger spans "
+            "every engine/run in the process",
+            labels=("reason",),
+        )
         self._pending: list[tuple[int, float, dict]] = []
         # Rounds run before the sampler existed (a resumed checkpoint's
         # tick) must not inflate the rounds counter at the first sample.
@@ -115,6 +130,22 @@ class SimMetrics:
         """Driver hook: current compiled-chunk cache entry count (pure
         host bookkeeping — no device traffic)."""
         self._chunk_cache.set(n)
+
+    def set_state_bytes(self, n: int) -> None:
+        """Driver hook: the run's planned resident state bytes (the
+        memory ladder's figure for this rung — host arithmetic only)."""
+        self._state_bytes.set(n)
+
+    def _export_pallas_fallbacks(self) -> None:
+        """Mirror the trace-time loud-fallback ledger into labeled
+        gauges so kernel degradation shows up on /metrics, not only in
+        test assertions. The ledger is process-global (one count per
+        compiled config, whichever engine traced it), so the gauge
+        carries only the reason label."""
+        from ..ops.gossip import pallas_fallbacks
+
+        for reason, count in pallas_fallbacks.items():
+            self._pallas_fallbacks.labels(reason).set(count)
 
     def due(self, tick: int) -> bool:
         """Host-side stride gate: true when ``tick`` crossed into a new
@@ -185,6 +216,7 @@ class SimMetrics:
             ):
                 if short in last:
                     self._gauges[gauge].set(last[short])
+        self._export_pallas_fallbacks()
         return [
             {k: v for k, v in s.items() if k != "_wall"} for s in self.samples
         ]

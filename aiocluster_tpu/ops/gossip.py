@@ -46,7 +46,8 @@ import jax.numpy as jnp
 from jax import lax, random
 
 from ..sim.config import SimConfig
-from ..sim.state import SimState
+from ..sim.packed import U4_MAX, imean_f32, is_packed_w, pack_bits, watermarks_i32
+from ..sim.state import SimState, state_n_local
 
 NEG_INF = -1e30
 
@@ -236,6 +237,99 @@ def _budgeted_advance(
     return jnp.minimum(floor.astype(jnp.int32) + bump, d.astype(jnp.int32)).astype(dt)
 
 
+# -- packed u4 residual rung: byte-space gossip math --------------------------
+#
+# version_dtype="u4r" stores watermarks as saturating residuals below
+# the owner's max_version, two per byte (sim/packed.py). The sub-
+# exchange math is closed in residual space — the deficit of one
+# handshake direction is max(r_recv - r_send, 0) because the per-owner
+# max_version cancels out of (w_send - w_recv) — so the helpers below
+# compute DIRECTLY on the nibbles: the packed (N, n_local/2) matrix is
+# the only (N, N)-class array that ever exists in HBM; lo/hi halves are
+# fusion intermediates. Every value reproduces _budgeted_advance's
+# proportional path bit-for-bit (same f32 totals — deficit sums are
+# exact integers < 2^24 in any association — same scale, same dither
+# hash on the same (row, GLOBAL owner, salt) triples), which is what
+# the rung's bit-parity merge gate pins (tests/test_memory_ladder.py).
+
+
+def _pack_halves(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def _packed_adv_halves(
+    r: jax.Array,
+    r_peer: jax.Array,
+    budget: int,
+    valid: jax.Array,
+    axis_name: str | None,
+    salt: jax.Array,
+    owners: jax.Array,
+    run_salt: jax.Array | None,
+) -> tuple[jax.Array, jax.Array]:
+    """Budgeted advance of each receiver row toward its peer row for the
+    packed rung: (a_lo, a_hi) int32 nibble advances (the receiver's
+    residual shrinks by them). Proportional policy only — the config
+    validates that; the greedy global cumsum would interleave nibbles."""
+    lo = (r & 0xF).astype(jnp.int32)
+    hi = (r >> 4).astype(jnp.int32)
+    plo = (r_peer & 0xF).astype(jnp.int32)
+    phi = (r_peer >> 4).astype(jnp.int32)
+    v32 = valid[:, None].astype(jnp.int32)
+    d_lo = jnp.maximum(lo - plo, 0) * v32
+    d_hi = jnp.maximum(hi - phi, 0) * v32
+    # f32 row totals: every partial sum is an exact integer (< 2^24), so
+    # summing the halves separately equals the unpacked column-order sum.
+    total = d_lo.sum(axis=1, dtype=jnp.float32) + d_hi.sum(
+        axis=1, dtype=jnp.float32
+    )
+    if axis_name is not None:
+        total = lax.psum(total, axis_name)
+    scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
+
+    def half(d: jax.Array, owner_ids: jax.Array) -> jax.Array:
+        x = d.astype(jnp.float32) * scale[:, None]
+        floor = jnp.floor(x)
+        bump = _hash_uniform(salt, d.shape[0], owner_ids, run_salt) < (
+            x - floor
+        )
+        return jnp.minimum(floor.astype(jnp.int32) + bump, d)
+
+    return half(d_lo, owners[0::2]), half(d_hi, owners[1::2])
+
+
+def _packed_apply(r: jax.Array, a_lo: jax.Array, a_hi: jax.Array) -> jax.Array:
+    """Apply nibble advances: the receiver's residual shrinks in place
+    (w += adv in watermark space)."""
+    lo = (r & 0xF).astype(jnp.int32) - a_lo
+    hi = (r >> 4).astype(jnp.int32) - a_hi
+    return _pack_halves(lo, hi)
+
+
+def _packed_diag_zero(r: jax.Array, owners: jax.Array, n: int) -> jax.Array:
+    """Owner-diagonal refresh in residual space: an owner's residual on
+    itself is 0 by definition (w[j, j] = max_version[j])."""
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    r = jnp.where(rows == owners[0::2][None, :], r & 0xF0, r)
+    return jnp.where(rows == owners[1::2][None, :], r & 0x0F, r)
+
+
+def _packed_writes_shift(
+    r: jax.Array, bump: jax.Array, owners: jax.Array
+) -> jax.Array:
+    """Owner-side writes raise max_version, which raises every stale
+    observer's residual by the same amount (w unchanged). Saturating at
+    the nibble ceiling — the horizon guard keeps valid runs below it,
+    so in-domain trajectories never actually clip."""
+    lo = jnp.minimum(
+        (r & 0xF).astype(jnp.int32) + bump[owners[0::2]][None, :], U4_MAX
+    )
+    hi = jnp.minimum(
+        (r >> 4).astype(jnp.int32) + bump[owners[1::2]][None, :], U4_MAX
+    )
+    return _pack_halves(lo, hi)
+
+
 def _view_peer_choice(
     live_view: jax.Array,
     salt: jax.Array,
@@ -414,6 +508,11 @@ def pallas_fallback_reason(
         return "fault_plan"
     if cfg.pairing != "matching":
         return "pairing"
+    if cfg.version_dtype == "u4r":
+        # The kernels are unpacked-only: they DMA whole dtype lanes and
+        # widen transiently in VMEM, but carry no nibble codec. Packed
+        # rungs run the byte-space XLA path — loudly.
+        return "packed_dtype"
     if cfg.fanout < 1:
         return "fanout"
     if cfg.n_nodes % 128 != 0:
@@ -486,6 +585,10 @@ def pallas_path_engaged(
         # behavior keeps the kernels — sim_step injects nothing then.
         and not _fault_plan_active(cfg)
         and cfg.pairing == "matching"
+        # Unpacked rungs only: the kernels widen dtype lanes in VMEM
+        # but carry no u4 nibble codec (pallas_fallback_reason
+        # "packed_dtype" keeps the degradation loud).
+        and cfg.version_dtype != "u4r"
         # fanout >= 1 so the round's first kernel call exists to carry
         # the owner-diagonal refresh (a fanout=0 round must still
         # refresh diagonals, which the XLA path does unconditionally).
@@ -595,15 +698,43 @@ def pallas_variant_engaged(
     return "pairs" if use_pairs else "m8"
 
 
+def _fd_bookkeeping_packed(cfg: SimConfig) -> bool:
+    """Whether the FD bookkeeping sits below what the kernels model
+    (int8 sample counters / the live bitmap) — THE single predicate
+    shared by the fusion-candidate VMEM charge, fd_phase_engaged's
+    dispatch, and the loud-fallback ledger, so the three can never
+    drift (they are one decision)."""
+    return cfg.icount_dtype != "int16" or cfg.live_bits
+
+
+def fd_fallback_reason(cfg: SimConfig) -> str | None:
+    """Why a config that WANTED the FD kernels runs the FD phase on
+    XLA anyway — currently the one packed-bookkeeping cause — or None.
+    The FD-phase analogue of pallas_fallback_reason; sim_step feeds the
+    ``pallas_fallbacks`` ledger from this, never from a re-derived
+    predicate."""
+    if (
+        cfg.track_failure_detector
+        and _pallas_wanted(cfg)
+        and not _lifecycle_enabled(cfg)
+        and cfg.use_pallas_fd is not False
+        and _fd_bookkeeping_packed(cfg)
+    ):
+        return "fd_packed_bookkeeping"
+    return None
+
+
 def _fd_fusion_candidate(cfg: SimConfig) -> bool:
     """Whether a pairs-served round would carry the fused FD epilogue —
     the term the variant decision charges VMEM for. use_pallas_fd=False
     pins the FD phase to XLA (the A/B seam), so those configs don't pay
-    the epilogue's footprint."""
+    the epilogue's footprint; neither do the shrunk-bookkeeping rungs
+    the kernels don't model (_fd_bookkeeping_packed)."""
     return (
         cfg.track_failure_detector
         and not _lifecycle_enabled(cfg)
         and cfg.use_pallas_fd is not False
+        and not _fd_bookkeeping_packed(cfg)
     )
 
 
@@ -635,6 +766,12 @@ def fd_phase_engaged(
     if not cfg.track_failure_detector:
         return "off"
     if _lifecycle_enabled(cfg) or cfg.use_pallas_fd is False:
+        return "xla"
+    if _fd_bookkeeping_packed(cfg):
+        # Shrunk bookkeeping rungs: neither the fused epilogue nor the
+        # standalone FD kernel models int8 counters / the live bitmap —
+        # the XLA block does (sim_step bumps the loud-fallback counter
+        # via fd_fallback_reason, the same predicate).
         return "xla"
     if pallas_path_engaged(
         cfg,
@@ -723,8 +860,20 @@ def sim_step(
     sweep runs plain XLA — and either way every lane stays bit-identical
     to the equivalent sequential run (tests/test_fused_kernel.py)."""
     n = cfg.n_nodes
-    n_local = state.w.shape[1]
+    n_local = state_n_local(state)
     owners = _local_owner_ids(n_local, axis_name)
+    # Packed u4 residual rung: the watermark matrix stays byte-packed in
+    # HBM for the whole round — the branches below compute on nibbles
+    # inside the fusion (sim/packed.py). The config validates the rung's
+    # domain (matching/permutation, proportional, no lifecycle); a
+    # topology run would force the choice path, which has no byte-space
+    # form, so refuse it here where adjacency is visible.
+    packed = is_packed_w(state.w)
+    if packed and adjacency is not None:
+        raise ValueError(
+            "version_dtype='u4r' does not support topology runs (the "
+            "adjacency path's scatter-max is unpacked-only)"
+        )
     sw_fanout = None if sweep is None else sweep.fanout
     sw_phi = None if sweep is None else sweep.phi_threshold
     sw_wpr = None if sweep is None else sweep.writes_per_round
@@ -825,9 +974,35 @@ def sim_step(
         )
         if reason is not None:
             pallas_fallbacks[reason] += 1
+    if fd_phase == "xla":
+        # The FD-phase analogue of the pull fallback above: a config
+        # that wanted the FD kernels but shrank its bookkeeping below
+        # what they model degrades to the XLA block — counted, not
+        # silent (one predicate, shared with fd_phase_engaged).
+        fd_reason = fd_fallback_reason(cfg)
+        if fd_reason is not None:
+            pallas_fallbacks[fd_reason] += 1
     if use_pallas:
         diag = None
         w, hb = state.w, state.hb_known
+    elif packed:
+        diag = jnp.arange(n, dtype=jnp.int32)[:, None] == owners[None, :]
+        w = state.w
+        if cfg.writes_per_round != 0 or sw_wpr is not None:
+            # Owner writes raised max_version above; every observer's
+            # residual rises with it (its watermark didn't move).
+            w = _packed_writes_shift(w, max_version - state.max_version,
+                                     owners)
+        w = _packed_diag_zero(w, owners, n)
+        hb = (
+            jnp.where(
+                diag,
+                hbv_vec[None, :].astype(state.hb_known.dtype),
+                state.hb_known,
+            )
+            if track_hb
+            else state.hb_known
+        )
     else:
         diag = jnp.arange(n, dtype=jnp.int32)[:, None] == owners[None, :]
         w = jnp.where(diag, mv_vec[None, :].astype(state.w.dtype), state.w)
@@ -873,6 +1048,24 @@ def sim_step(
             col_ok=None if sched is None else ~sched[peer, :],
         )
         return adv, valid
+
+    def packed_peer_adv(r, peer, salt, active=None):
+        """peer_adv for the packed u4 residual rung: gathers the PEER'S
+        PACKED rows (0.5 B/pair — the only per-sub-exchange HBM
+        transient) and computes the budgeted advance on the nibbles.
+        The lifecycle's column mask never applies (the config excludes
+        it from this rung)."""
+        valid = eff_alive & eff_alive[peer]
+        if active is not None:
+            valid = valid & active
+        f_ok = fault_ok(peer, rows, salt)
+        if f_ok is not None:
+            valid = valid & f_ok
+        a_lo, a_hi = _packed_adv_halves(
+            r, r[peer, :], cfg.budget, valid, axis_name, salt, owners,
+            run_salt,
+        )
+        return a_lo, a_hi, valid
 
     def hb_absorb(hb, peer, valid):
         ok = valid[:, None]
@@ -1089,16 +1282,33 @@ def sim_step(
                     )
                     w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
-                adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
-                adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1), sub_active(c))
-                w = w + jnp.maximum(adv_p, adv_i)
+                if packed:
+                    pl, ph, valid_p = packed_peer_adv(
+                        w, p, sub_salt(c, 0), sub_active(c)
+                    )
+                    il, ih, valid_i = packed_peer_adv(
+                        w, inv, sub_salt(c, 1), sub_active(c)
+                    )
+                    w = _packed_apply(
+                        w, jnp.maximum(pl, il), jnp.maximum(ph, ih)
+                    )
+                else:
+                    adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
+                    adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1), sub_active(c))
+                    w = w + jnp.maximum(adv_p, adv_i)
                 if track_hb:
                     hb = jnp.maximum(
                         hb_absorb(hb, p, valid_p), hb_absorb(hb, inv, valid_i)
                     )
             else:
-                adv, valid = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
-                w = w + adv
+                if packed:
+                    a_lo, a_hi, valid = packed_peer_adv(
+                        w, p, sub_salt(c, 0), sub_active(c)
+                    )
+                    w = _packed_apply(w, a_lo, a_hi)
+                else:
+                    adv, valid = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
+                    w = w + adv
                 if track_hb:
                     hb = hb_absorb(hb, p, valid)
     else:
@@ -1206,10 +1416,10 @@ def sim_step(
         # exactly the old sum-form with one window-mean's worth of mass
         # evicted per new sample.
         icount = jnp.minimum(
-            state.icount + sampled.astype(jnp.int16),
-            jnp.int16(cfg.window_ticks),
+            state.icount + sampled.astype(state.icount.dtype),
+            jnp.asarray(cfg.window_ticks, state.icount.dtype),
         )
-        mean_f32 = state.imean.astype(jnp.float32)
+        mean_f32 = imean_f32(state.imean)
         denom = jnp.maximum(icount.astype(jnp.float32), 1.0)
         imean = jnp.where(
             sampled, mean_f32 + (interval - mean_f32) / denom, mean_f32
@@ -1238,7 +1448,7 @@ def sim_step(
         # Going (or staying) dead wipes the window: a returning node must
         # re-earn liveness with fresh samples (core/failure.py reset rule).
         imean = jnp.where(live, imean, 0.0).astype(state.imean.dtype)
-        icount = jnp.where(live, icount, jnp.int16(0))
+        icount = jnp.where(live, icount, jnp.asarray(0, state.icount.dtype))
         if lifecycle:
             # Dead-stamp on the live->dead transition, but only for KNOWN
             # nodes (present in the observer's "cluster state", i.e. some
@@ -1273,6 +1483,11 @@ def sim_step(
             dead_since = jnp.where(gc_now, 0, ds).astype(state.dead_since.dtype)
         else:
             dead_since = state.dead_since
+        if cfg.live_bits:
+            # Bit-packed liveness storage (the shrunk-FD rung): the bool
+            # matrix above is a fusion intermediate; only the bitmap
+            # lands in HBM (1 bit/pair).
+            live = pack_bits(live)
     else:
         last_change, imean, icount, live, dead_since = (
             state.last_change,
@@ -1317,16 +1532,32 @@ def all_converged_flag(
     ``convergence_metrics()["all_converged"]`` (same excusals: dead
     observers and dead owners). Used by the in-chunk exact convergence
     tracker, where it runs once per ROUND, so it must stay one fused
-    read of w (no fraction/mean reductions)."""
-    n_local = state.w.shape[1]
+    read of w (no fraction/mean reductions). On the packed u4 rung the
+    check is nibble == 0 (a zero residual IS "caught up"), read straight
+    off the bytes — no widening."""
+    n_local = state_n_local(state)
     owners = _local_owner_ids(n_local, axis_name)
-    needed = state.max_version[owners][None, :]
-    ok = (
-        (state.w >= needed)
-        | ~state.alive[:, None]
-        | ~state.alive[owners][None, :]
-    )
-    flag = ok.all()
+    if is_packed_w(state.w):
+        row_dead = ~state.alive[:, None]
+        lo_ok = (
+            ((state.w & 0xF) == 0)
+            | row_dead
+            | ~state.alive[owners[0::2]][None, :]
+        )
+        hi_ok = (
+            ((state.w >> 4) == 0)
+            | row_dead
+            | ~state.alive[owners[1::2]][None, :]
+        )
+        flag = lo_ok.all() & hi_ok.all()
+    else:
+        needed = state.max_version[owners][None, :]
+        ok = (
+            (state.w >= needed)
+            | ~state.alive[:, None]
+            | ~state.alive[owners][None, :]
+        )
+        flag = ok.all()
     if axis_name is not None:
         flag = lax.pmin(flag.astype(jnp.int32), axis_name) > 0
     return flag
@@ -1341,16 +1572,21 @@ def convergence_metrics(
     reached the owner's max_version (dead observers and dead owners are
     excused). ``min_fraction`` is the worst watermark/max_version ratio
     over alive pairs — the sim's staleness_score analogue.
+
+    Rung-agnostic: the packed u4 residual rung decodes through the
+    sanctioned widen helper (sim/packed.py) — this is a metrics pass,
+    sampled at the obs stride, not the hot loop.
     """
-    n_local = state.w.shape[1]
+    n_local = state_n_local(state)
     owners = _local_owner_ids(n_local, axis_name)
+    wv = watermarks_i32(state, owners)
     needed = state.max_version[owners][None, :]
     alive_rows = state.alive[:, None]
-    caught_up = (state.w >= needed) | ~alive_rows
+    caught_up = (wv >= needed) | ~alive_rows
     owner_ok = caught_up.all(axis=0) | ~state.alive[owners]
     frac = jnp.where(
         alive_rows & state.alive[owners][None, :],
-        state.w / jnp.maximum(needed, 1),
+        wv / jnp.maximum(needed, 1),
         1.0,
     )
     pair_mask = alive_rows & state.alive[owners][None, :]
@@ -1367,7 +1603,7 @@ def convergence_metrics(
     kv_known = jnp.sum(
         jnp.where(
             pair_mask,
-            jnp.minimum(state.w.astype(jnp.float32), needed.astype(jnp.float32)),
+            jnp.minimum(wv, needed).astype(jnp.float32),
             0.0,
         )
     )
@@ -1396,11 +1632,11 @@ def version_spread(
     convergence; the obs layer samples it as the sim's staleness-depth
     gauge (companion to convergence_metrics' fractions, which normalise
     this away)."""
-    n_local = state.w.shape[1]
+    n_local = state_n_local(state)
     owners = _local_owner_ids(n_local, axis_name)
     needed = state.max_version[owners][None, :]
     pair_mask = state.alive[:, None] & state.alive[owners][None, :]
-    lag = jnp.where(pair_mask, needed - state.w.astype(jnp.int32), 0)
+    lag = jnp.where(pair_mask, needed - watermarks_i32(state, owners), 0)
     spread = jnp.maximum(lag.max(), 0)
     if axis_name is not None:
         spread = lax.pmax(spread, axis_name)

@@ -64,24 +64,76 @@ def make_mesh(devices: list[Any] | None = None) -> Mesh:
     return Mesh(jax.devices() if devices is None else devices, (AXIS,))
 
 
+# -- rule-based partition specs ------------------------------------------------
+#
+# One `match_partition_rules`-style table (SNIPPETS [2]'s exemplar,
+# first-match-wins regex over FIELD NAMES) assigns every SimState leaf
+# its PartitionSpec — for the single-run layout AND the lane-batched
+# sweep layout, which merely prepends an unsharded lane axis. Rules are
+# name-based, not shape-based, so the memory-ladder rungs (packed u4
+# watermarks at half width, the live bitmap at eighth width) inherit the
+# owner-column sharding without touching this file: packing is along the
+# column axis, so a packed column block is still an owner block.
+#
+# There is deliberately NO catch-all: a new SimState field must be
+# classified here — in ONE place — or spec construction fails loudly
+# naming it (the alternative, silent replication of a new (N, N) matrix,
+# is a 20 GB-at-100k mistake). Donation follows the same single rule:
+# every chunk builder below donates the whole state pytree (argnums 0),
+# so a field added to the table is donated too —
+# tests/test_partition_rules.py audits the lowered aliasing.
+
+PARTITION_RULES: tuple[tuple[str, P], ...] = (
+    # (N, n_local)-class knowledge matrices (packed or wide): columns
+    # are owners — shard them. Rows stay unsharded so peer-row gathers
+    # are shard-local (module docstring).
+    (r"^(w|hb_known|last_change|imean|icount|live_view|dead_since)$",
+     P(None, AXIS)),
+    # Scalars and (N,) per-owner vectors: replicated.
+    (r"^(tick|max_version|heartbeat|alive)$", P()),
+)
+
+
+def match_partition_rules(
+    rules: tuple[tuple[str, P], ...], names: list[str]
+) -> dict[str, P]:
+    """First-match-wins regex table over field names -> PartitionSpec.
+    Unmatched names raise, naming both the field and the table."""
+    import re
+
+    out: dict[str, P] = {}
+    for name in names:
+        for pattern, spec in rules:
+            if re.fullmatch(pattern, name):
+                out[name] = spec
+                break
+        else:
+            raise ValueError(
+                f"SimState field {name!r} matches no partition rule; add "
+                "it to parallel.mesh.PARTITION_RULES (the single place "
+                "fields are classified for sharding)"
+            )
+    return out
+
+
+def _spec_pytree(sweep: bool) -> SimState:
+    import dataclasses
+
+    names = [f.name for f in dataclasses.fields(SimState)]
+    specs = match_partition_rules(PARTITION_RULES, names)
+    if sweep:
+        # Lane-batched layout: a leading unsharded scenario axis on
+        # every leaf; replicated leaves stay fully replicated.
+        specs = {
+            k: (s if s == P() else P(None, *s)) for k, s in specs.items()
+        }
+    return SimState(**specs)
+
+
 def state_partition_spec() -> SimState:
     """PartitionSpec pytree matching SimState: matrices column-sharded,
-    vectors/scalars replicated."""
-    mat = P(None, AXIS)
-    rep = P()
-    return SimState(
-        tick=rep,
-        max_version=rep,
-        heartbeat=rep,
-        alive=rep,
-        w=mat,
-        hb_known=mat,
-        last_change=mat,
-        imean=mat,
-        icount=mat,
-        live_view=mat,
-        dead_since=mat,
-    )
+    vectors/scalars replicated — assigned by PARTITION_RULES."""
+    return _spec_pytree(sweep=False)
 
 
 def shard_state(state: SimState, mesh: Mesh) -> SimState:
@@ -228,22 +280,9 @@ def sharded_tracked_chunk_fn(
 def sweep_state_partition_spec() -> SimState:
     """PartitionSpec pytree for lane-batched SimState: (S, N, n_local)
     matrices column-sharded on the owner axis, everything else
-    replicated."""
-    mat = P(None, None, AXIS)
-    rep = P()
-    return SimState(
-        tick=rep,
-        max_version=rep,
-        heartbeat=rep,
-        alive=rep,
-        w=mat,
-        hb_known=mat,
-        last_change=mat,
-        imean=mat,
-        icount=mat,
-        live_view=mat,
-        dead_since=mat,
-    )
+    replicated — the same PARTITION_RULES table with a lane axis
+    prepended."""
+    return _spec_pytree(sweep=True)
 
 
 def shard_sweep_state(states: SimState, mesh: Mesh) -> SimState:

@@ -17,7 +17,13 @@ Usage, on every participating process:
 
 Verified end-to-end by tests/test_multihost.py, which launches two real
 processes over a localhost coordinator and checks the trajectory is
-bit-identical to a single-process run.
+bit-identical to a single-process run — and MEASURED by
+benchmarks/multihost_bench.py (``make multihost-smoke``, part of
+``make check``), which stamps a parity-asserted 2-process rounds/s
+figure into every BENCH/MULTICHIP record. Capacity planning treats the
+host spread as a first-class dimension: ``sim.memory.plan(...,
+hosts=)`` and ``fits_verdict(..., hosts=)`` key their models and
+measured-boundary evidence per (rung, shards, hosts).
 """
 
 from __future__ import annotations
@@ -33,7 +39,29 @@ def initialize(
     num_processes: int,
     process_id: int,
 ) -> None:
-    """Join the distributed runtime. Call once, before any device use."""
+    """Join the distributed runtime. Call once, before any device use.
+
+    On CPU platforms this also selects jaxlib's gloo cross-process
+    collectives (when the installed jax exposes the knob and the caller
+    hasn't pinned one): without it, XLA:CPU rejects every multiprocess
+    computation outright ("Multiprocess computations aren't implemented
+    on the CPU backend") — which silently reduced the 2-process CPU
+    path to a smoke claim. TPU jobs are unaffected (collectives ride
+    ICI/DCN through the plugin)."""
+    values = getattr(jax.config, "values", {})
+    platforms = str(values.get("jax_platforms") or "")
+    if (
+        "jax_cpu_collectives_implementation" in values
+        and values.get("jax_cpu_collectives_implementation")
+        in (None, "", "none")
+        # Unset platforms may still resolve to CPU (the default on a
+        # CPU-only host — exactly the case that used to break), so only
+        # an EXPLICIT non-cpu pin skips the knob; the option configures
+        # the CPU client alone, so accelerator jobs are unaffected by
+        # setting it.
+        and (platforms == "" or "cpu" in platforms.split(","))
+    ):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -49,3 +77,9 @@ def global_mesh() -> Mesh:
 def is_primary() -> bool:
     """True on the process that should do host-side reporting."""
     return jax.process_index() == 0
+
+
+def process_count() -> int:
+    """How many processes (hosts) the job spans — the ``hosts=``
+    argument capacity planning wants (sim.memory.plan/fits_verdict)."""
+    return jax.process_count()

@@ -23,7 +23,81 @@ from ..core.identity import NodeId
 from ..core.messages import KeyValueUpdate, VersionStatusEnum
 from ..wire.sizes import DeltaSizeModel
 
-__all__ = ("budget_from_mtu", "per_round_bytes", "roofline_models")
+__all__ = (
+    "budget_from_mtu",
+    "ladder",
+    "per_round_bytes",
+    "roofline_models",
+    "state_bytes_per_pair",
+)
+
+
+# -- the memory ladder: resident bytes per (observer, owner) pair -------------
+#
+# Storage width per rung of each SimState matrix. Fractional entries are
+# the packed forms (sim/packed.py): "u4r" stores two saturating
+# watermark residuals per byte; live_bits stores eight liveness bits per
+# byte. THE single per-pair accounting — sim/memory.py's plan() and the
+# docs/sim.md ladder table both read it, so a new rung changes one dict.
+
+W_BYTES = {"int32": 4.0, "int16": 2.0, "int8": 1.0, "u4r": 0.5}
+HB_BYTES = {"int32": 4.0, "int16": 2.0, "int8": 1.0}
+FD_BYTES = {"float32": 4.0, "bfloat16": 2.0}
+ICOUNT_BYTES = {"int16": 2.0, "int8": 1.0}
+
+
+def state_bytes_per_pair(cfg) -> float:
+    """Resident SimState bytes per (observer, owner) pair for this
+    config's rung — the ladder's figure of merit (may be fractional for
+    the packed forms; multiply by N^2 and round for totals)."""
+    b = W_BYTES[cfg.version_dtype]
+    if cfg.track_heartbeats:
+        b += HB_BYTES[cfg.heartbeat_dtype]  # hb_known
+    if cfg.track_failure_detector:
+        b += HB_BYTES[cfg.heartbeat_dtype]  # last_change
+        b += FD_BYTES[cfg.fd_dtype]  # imean
+        b += ICOUNT_BYTES[cfg.icount_dtype]  # icount
+        b += 0.125 if cfg.live_bits else 1.0  # live_view
+        if cfg.dead_grace_ticks is not None:
+            b += HB_BYTES[cfg.heartbeat_dtype]  # dead_since
+    return b
+
+
+def ladder(n_nodes: int = 1024) -> list[dict]:
+    """The per-rung B/pair table (docs/sim.md "memory ladder"): one row
+    per named rung of each profile family, with the exact SimConfig
+    overrides that select it. ``n_nodes`` only shapes the illustrative
+    config (the per-pair figure is N-independent)."""
+    from .memory import full_config, lean_config
+
+    rows = []
+    for family, builder, rungs in (
+        ("full-fd", full_config, ("int32", "int16", "shrunk", "deep")),
+        ("lean", lean_config, ("int32", "int16", "int8", "u4r")),
+    ):
+        for rung in rungs:
+            cfg = builder(n_nodes, rung=rung)
+            rows.append(
+                {
+                    "family": family,
+                    "rung": rung,
+                    "bytes_per_pair": state_bytes_per_pair(cfg),
+                    "version_dtype": cfg.version_dtype,
+                    "heartbeat_dtype": (
+                        cfg.heartbeat_dtype if cfg.track_heartbeats else None
+                    ),
+                    "fd_dtype": (
+                        cfg.fd_dtype if cfg.track_failure_detector else None
+                    ),
+                    "icount_dtype": (
+                        cfg.icount_dtype
+                        if cfg.track_failure_detector
+                        else None
+                    ),
+                    "live_bits": cfg.live_bits,
+                }
+            )
+    return rows
 
 
 # -- per-round HBM traffic model ----------------------------------------------
@@ -67,9 +141,10 @@ def per_round_bytes(
     ("fused"/"kernel"/"xla"/"off"; None derives off/xla from the
     config). Shared by bench.py's roofline block so the recorded
     fractions always divide by a model named next to the variant
-    provenance."""
-    import jax.numpy as jnp
-
+    provenance. Rung-aware: packed forms move their PACKED bytes (the
+    byte-space XLA path never materializes a wide matrix —
+    sim/packed.py), so the traffic model reads the same W_BYTES/HB_BYTES
+    tables the resident ladder does."""
     if variant not in _PULL_PASSES:
         raise ValueError(f"unknown variant {variant!r}")
     if fd_phase is None:
@@ -77,29 +152,27 @@ def per_round_bytes(
     if fd_phase == "off" and cfg.track_failure_detector:
         raise ValueError("fd_phase='off' on an FD-tracking config")
     n2 = cfg.n_nodes * cfg.n_nodes
-    m_w = n2 * jnp.dtype(cfg.version_dtype).itemsize
-    m_hb = (
-        n2 * jnp.dtype(cfg.heartbeat_dtype).itemsize
-        if cfg.track_heartbeats
-        else 0
-    )
+    m_w = n2 * W_BYTES[cfg.version_dtype]
+    m_hb = n2 * HB_BYTES[cfg.heartbeat_dtype] if cfg.track_heartbeats else 0
     total = cfg.fanout * _PULL_PASSES[variant] * (m_w + m_hb)
     if cfg.track_failure_detector:
-        m_fd = n2 * jnp.dtype(cfg.fd_dtype).itemsize
+        m_fd = n2 * FD_BYTES[cfg.fd_dtype]
         m_lc = m_hb  # last_change is heartbeat-dtype
+        m_ic = n2 * ICOUNT_BYTES[cfg.icount_dtype]
+        m_live = n2 * (0.125 if cfg.live_bits else 1.0)
         if fd_phase == "fused":
             if cfg.fanout > 1:
                 total += m_hb  # round-start hb0 stream
             total += 2 * m_lc  # last_change r/w (in place)
             total += 2 * m_fd  # imean r/w
-            total += 2 * n2 * 2  # icount int16 r/w
-            total += n2  # live_view bool write
+            total += 2 * m_ic  # icount r/w
+            total += m_live  # live_view write
         else:
             total += 2 * m_hb  # hb + round-start hb reads
             total += 2 * m_lc  # last_change r/w
             total += 2 * m_fd  # imean r/w
-            total += 2 * n2 * 2  # icount int16 r/w
-            total += 2 * n2  # live_view bool r/w
+            total += 2 * m_ic  # icount r/w
+            total += 2 * m_live  # live_view r/w
     return int(total)
 
 

@@ -62,6 +62,33 @@ def _encode_fields(state: SimState) -> tuple[dict, dict[str, str]]:
     return arrays, dtypes
 
 
+def _check_layout(cfg: SimConfig, dtypes: dict[str, str], path) -> None:
+    """Loud cross-rung rejection: the stored field dtypes must be the
+    layout the (decoded) config implies. The memory ladder makes the
+    same VALUES representable several ways — packed u4 residual bytes
+    reinterpreted as int16 watermarks would be silent garbage — so a
+    checkpoint whose arrays and config disagree (tampered meta, a
+    writer/loader drift) is refused by name instead of loaded."""
+    from .state import expected_dtypes
+
+    exp = expected_dtypes(cfg)
+    bad = {
+        name: (stored, exp[name])
+        for name, stored in dtypes.items()
+        if name in exp and jnp.dtype(stored) != jnp.dtype(exp[name])
+    }
+    if bad:
+        detail = ", ".join(
+            f"{k}: stored {s!r} != rung-expected {e!r}"
+            for k, (s, e) in sorted(bad.items())
+        )
+        raise ValueError(
+            f"checkpoint {path} layout does not match its config's "
+            f"memory-ladder rung ({detail}); refuse to reinterpret "
+            "packed/narrow state across rungs"
+        )
+
+
 def _decode_fields(data, dtypes: dict[str, str]) -> SimState:
     """Inverse of _encode_fields, onto device arrays."""
     fields = {}
@@ -145,6 +172,7 @@ def load_sweep(path: str | Path) -> tuple[SimState, SimConfig, dict]:
                 "not a sweep checkpoint (single-sim file? use load_state)"
             )
         cfg = _config_from_meta(dict(meta["config"]))
+        _check_layout(cfg, meta["dtypes"], path)
         states = _decode_fields(data, meta["dtypes"])
         out_meta = dict(meta["sweep"])
         out_meta["first"] = np.asarray(data["__first__"])
@@ -179,5 +207,6 @@ def load_state(
                 stacklevel=2,
             )
         cfg = _config_from_meta(raw)
+        _check_layout(cfg, meta["dtypes"], path)
         state = _decode_fields(data, meta["dtypes"])
     return state, cfg, meta

@@ -98,12 +98,29 @@ class SimConfig:
     #   for the responder side. Topology (adjacency) runs force this mode.
     pairing: str = "matching"
 
-    # Dtypes for the big (N, N) knowledge matrices. "int32" is always
-    # safe; "int16" halves HBM traffic and footprint and is exact whenever
-    # the quantity fits: watermarks need max total versions per owner
-    # (initial + writes_per_round * horizon) < 32768; heartbeat knowledge
-    # needs the run horizon in ticks < 32768. init_state validates the
-    # initial versions; the horizon bound is the caller's contract.
+    # Dtypes for the big (N, N) knowledge matrices — the memory-ladder
+    # rungs (docs/sim.md "memory ladder"). "int32" is always safe;
+    # each narrower rung is bit-identical in trajectory whenever the
+    # stored quantity fits, and init_state / the horizon guards enforce
+    # the fit loudly instead of wrapping:
+    #
+    # - "int16": watermarks need max total versions per owner
+    #   (initial + writes_per_round * horizon) < 32768; heartbeat
+    #   knowledge needs the run horizon in ticks < 32768.
+    # - "int8": the same bounds at < 128 — the lean-ladder rung the
+    #   fused pairs kernel serves natively (values widen transiently in
+    #   VMEM, never in HBM).
+    # - "u4r" (version_dtype only): watermarks stored as a SATURATING
+    #   RESIDUAL below the owner's max_version, two per byte
+    #   (0.5 B/pair; sim/packed.py). Residual space is closed under the
+    #   gossip math, so the XLA path computes on the nibbles inside the
+    #   fusion and never materializes a wide matrix in HBM. Bound: max
+    #   total versions per owner <= 15. Packed-rung restrictions
+    #   (validated below): matching/permutation pairing only (the
+    #   choice path's scatter-max has no byte-space form), proportional
+    #   budget, no dead-node lifecycle, even n_nodes. The Pallas
+    #   kernels are unpacked-only — u4r runs the XLA path, loudly
+    #   (ops/gossip.pallas_fallbacks reason "packed_dtype").
     version_dtype: str = "int32"
     heartbeat_dtype: str = "int32"
 
@@ -112,6 +129,17 @@ class SimConfig:
     # the stored mean is rounded (≤0.4% relative) — far inside the
     # phi-threshold's slack.
     fd_dtype: str = "float32"
+
+    # Failure-detector bookkeeping rungs (the shrunk-FD ladder toward
+    # 9.125 B/pair): "int8" icount needs window_ticks + 1 < 128 (the
+    # kernel-order increment-then-clamp contract below); live_bits
+    # packs live_view as a column bitmap (1 bit/pair; n_nodes % 8 == 0,
+    # not peer_mode="view" — the view draw reads bool rows). Shrunk
+    # bookkeeping is unpacked-only for the FD kernels: those configs
+    # run the FD phase on XLA (loudly — pallas_fallbacks reason
+    # "fd_packed_bookkeeping") while the pull kernels stay engaged.
+    icount_dtype: str = "int16"
+    live_bits: bool = False
 
     # How an exchange's key-version budget is split across stale owners:
     # - "proportional" (default): every stale owner's deficit is scaled by
@@ -190,16 +218,65 @@ class SimConfig:
             raise ValueError("peer_mode='view' requires track_failure_detector")
         if self.pairing not in ("permutation", "matching", "choice"):
             raise ValueError(f"unknown pairing: {self.pairing}")
-        if self.version_dtype not in ("int32", "int16"):
+        if self.version_dtype not in ("int32", "int16", "int8", "u4r"):
             raise ValueError(f"unknown version_dtype: {self.version_dtype}")
-        if self.heartbeat_dtype not in ("int32", "int16"):
+        if self.heartbeat_dtype not in ("int32", "int16", "int8"):
             raise ValueError(f"unknown heartbeat_dtype: {self.heartbeat_dtype}")
         if self.fd_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown fd_dtype: {self.fd_dtype}")
+        if self.icount_dtype not in ("int16", "int8"):
+            raise ValueError(f"unknown icount_dtype: {self.icount_dtype}")
+        # The kernel increments the sample counter BEFORE clamping to
+        # the cap, so window_ticks + 1 must also fit the counter dtype.
         if self.window_ticks >= 2**15 - 1:
-            # The kernel increments the int16 counter BEFORE clamping to
-            # the cap, so window_ticks + 1 must also fit.
             raise ValueError("window_ticks must fit the int16 sample counter")
+        if self.icount_dtype == "int8" and self.window_ticks >= 2**7 - 1:
+            raise ValueError(
+                "window_ticks must fit the int8 sample counter "
+                "(icount_dtype='int8' needs window_ticks <= 126)"
+            )
+        if self.version_dtype == "u4r":
+            # The packed residual rung's domain (sim/packed.py): the
+            # choice path's responder scatter-max has no byte-space
+            # form, the greedy policy's global cumsum would interleave
+            # nibbles, the lifecycle's forget rewrites w to 0 = a
+            # residual of max_version (unrepresentable), and packing
+            # pairs columns.
+            if self.pairing == "choice":
+                raise ValueError(
+                    "version_dtype='u4r' requires pairing='matching' or "
+                    "'permutation' (the choice scatter path is unpacked-only)"
+                )
+            if self.budget_policy != "proportional":
+                raise ValueError(
+                    "version_dtype='u4r' requires budget_policy="
+                    "'proportional' (greedy's owner-order cumsum has no "
+                    "byte-space form)"
+                )
+            if self.dead_grace_ticks is not None:
+                raise ValueError(
+                    "version_dtype='u4r' does not support the dead-node "
+                    "lifecycle (forgetting rewrites w outside the "
+                    "residual range)"
+                )
+            if self.n_nodes % 2 != 0:
+                raise ValueError(
+                    "version_dtype='u4r' packs two owners per byte; "
+                    "n_nodes must be even"
+                )
+        if self.live_bits:
+            if not self.track_failure_detector:
+                raise ValueError("live_bits requires track_failure_detector")
+            if self.peer_mode == "view":
+                raise ValueError(
+                    "live_bits with peer_mode='view' is unsupported (the "
+                    "view draw samples from bool live rows)"
+                )
+            if self.n_nodes % 8 != 0:
+                raise ValueError(
+                    "live_bits packs eight owners per byte; n_nodes must "
+                    "be a multiple of 8"
+                )
         if self.peer_mode == "view" and self.pairing != "choice":
             raise ValueError(
                 "peer_mode='view' requires pairing='choice' (a matching "

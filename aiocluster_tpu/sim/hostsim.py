@@ -38,7 +38,9 @@ import ctypes
 import json
 import os
 import time
+from dataclasses import dataclass
 from pathlib import Path
+from typing import Callable
 
 import numpy as np
 
@@ -116,68 +118,163 @@ def available() -> bool:
     return _lib() is not None
 
 
-def supported(cfg: SimConfig) -> bool:
-    """The exact domain on which HostSimulator's trajectory equals
-    Simulator's. Everything here mirrors a branch sim_step would take
-    differently (and the kernel only implements int16/int8).
+# -- the support domain, AS DATA ----------------------------------------------
+#
+# The exact domain on which HostSimulator's trajectory equals
+# Simulator's, one row per FEATURE: each row classifies the config into
+# a value and names the admissible values. ``supported()`` is the
+# conjunction; ``unsupported_features()`` names the offending rows. A
+# new memory-ladder rung (or any future feature) extends ONE row here
+# — and tests/test_hostsim.py enumerates the whole matrix off this
+# table, so the gate and its test cannot drift apart.
+#
+# Domain rationale (everything mirrors a branch sim_step would take
+# differently):
+# - profiles: lean (no hb/FD matrices) and — since round 5 — FULL
+#   (heartbeats + phi-accrual FD, the reference's operating shape,
+#   server.py:471-474 + failure_detector.py:56-128) at int16 hb ticks
+#   and int16 sample counters with bool liveness: the FD block is then
+#   purely elementwise (_hostsim.cpp::acg_hostsim_fd, op-for-op).
+# - "choice" pairing (reference server.py:699 independent sampling) is
+#   native for the lean profile only: the responder-side heartbeat
+#   absorb would need a scatter the hb kernel doesn't model, and
+#   "view" sampling reads live_view.
+# - version rungs int16 AND int8 qualify (the kernel stores int8
+#   internally either way — lossless while watermarks fit int8, which
+#   the keys_per_node row guarantees on this no-writes domain); the
+#   packed u4r rung does not (no byte-space form in the C kernel).
+# - deficit-total exactness: XLA sums deficits in f32, the kernel in
+#   int32; they agree only below 2^24 (_hostsim.cpp header). Max
+#   possible row total = K * (n - 1).
+# - fault plans lower to per-round link/crash masks the native kernel
+#   does not model (docs/faults.md); a plan with no effective behavior
+#   injects nothing and stays native.
 
-    Two profiles qualify: the lean convergence-only profile (round 4)
-    and — new in round 5 — the FULL profile (heartbeats + phi-accrual
-    failure detector, the reference's actual operating shape,
-    server.py:471-474 + failure_detector.py:56-128), as long as the
-    heartbeat matrices are int16 and there is no churn/lifecycle/writes:
-    on that domain the FD block is purely elementwise
-    (_hostsim.cpp::acg_hostsim_fd mirrors it op-for-op) and peer
-    validity masks are all-true, so the w trajectory is shared with the
-    lean profile while hb/FD state walks the exact XLA trajectory."""
-    profile_ok = (
-        # lean: no heartbeat/FD matrices at all
-        (not cfg.track_heartbeats and not cfg.track_failure_detector)
-        # full: hb (+ optionally FD) at int16 ticks; the FD pass stamps
-        # last_change with an int16 tick, so the horizon contract is
-        # the same one Simulator's int16 heartbeat_dtype carries
-        or (
-            cfg.track_heartbeats
-            and cfg.heartbeat_dtype == "int16"
-            and cfg.dead_grace_ticks is None
-        )
-    )
-    # "choice" (the reference's independent-sampling semantics,
-    # server.py:699) is native too — lean profile only: the responder
-    # side of its heartbeat absorb would need a scatter the hb kernel
-    # doesn't model, and FD-faithful "view" sampling reads live_view.
-    pairing_ok = cfg.pairing == "matching" or (
-        cfg.pairing == "choice"
-        and cfg.peer_mode == "alive"
-        and not cfg.track_heartbeats
-    )
-    return (
-        profile_ok
-        and pairing_ok
-        and cfg.budget_policy == "proportional"
-        and cfg.n_nodes % 128 == 0
-        and cfg.version_dtype == "int16"
-        # Watermarks never exceed keys_per_node on this domain (no
-        # writes), so the native kernel's lossless int8 representation
-        # (half the DRAM traffic) requires the bound to fit int8.
-        and cfg.keys_per_node <= 127
-        # The bit-exactness argument needs every row-deficit total to
-        # stay an exact f32 integer: XLA sums deficits in f32, the
-        # kernel in int32, and the two agree only below 2^24
-        # (_hostsim.cpp header). Max possible total = K * (n - 1).
-        and cfg.keys_per_node * (cfg.n_nodes - 1) < 2**24
-        and cfg.death_rate == 0.0
-        and cfg.revival_rate == 0.0
-        and cfg.writes_per_round == 0
-        # Fault plans lower to per-round link/crash masks the native
-        # kernel does not model (docs/faults.md) — those configs run on
-        # the XLA engine, where the masks are implemented. A plan with
-        # no effective behavior injects nothing and stays native.
-        and not (
-            _faults_sim.plan_affects_links(cfg.fault_plan)
-            or _faults_sim.plan_affects_nodes(cfg.fault_plan)
-        )
-    )
+
+@dataclass(frozen=True)
+class DomainRow:
+    """One feature of the native fast path's support domain."""
+
+    feature: str
+    allowed: tuple
+    value: "Callable[[SimConfig], object]"
+    note: str = ""
+
+
+# (No "profile" row: SimConfig validation already makes lean / full the
+# only constructible profiles — an FD without heartbeats is rejected at
+# construction — so the hb/FD features below cover the whole space.)
+SUPPORT_DOMAIN: tuple[DomainRow, ...] = (
+    DomainRow(
+        "heartbeat_dtype",
+        ("int16", None),
+        lambda c: c.heartbeat_dtype if c.track_heartbeats else None,
+        "the C FD/hb kernels stamp int16 ticks",
+    ),
+    DomainRow(
+        "icount_dtype",
+        ("int16", None),
+        lambda c: c.icount_dtype if c.track_failure_detector else None,
+        "the C FD kernel's sample counters are int16",
+    ),
+    DomainRow(
+        "live_bits",
+        (False,),
+        lambda c: c.live_bits,
+        "the C FD kernel writes bool liveness, not the bitmap rung",
+    ),
+    DomainRow(
+        "dead_grace",
+        (None,),
+        lambda c: c.dead_grace_ticks,
+        "no dead-node lifecycle (column masks / forgets)",
+    ),
+    DomainRow(
+        "pairing",
+        ("matching", "choice-lean"),
+        lambda c: (
+            "matching"
+            if c.pairing == "matching"
+            else (
+                "choice-lean"
+                if (
+                    c.pairing == "choice"
+                    and c.peer_mode == "alive"
+                    and not c.track_heartbeats
+                )
+                else c.pairing
+            )
+        ),
+        "matching, or lean-profile alive-mode choice",
+    ),
+    DomainRow(
+        "budget_policy",
+        ("proportional",),
+        lambda c: c.budget_policy,
+        "greedy's owner-order cumsum is not mirrored",
+    ),
+    DomainRow(
+        "shape_mod_128",
+        (True,),
+        lambda c: c.n_nodes % 128 == 0,
+        "the grouped-matching family's domain",
+    ),
+    DomainRow(
+        "version_dtype",
+        ("int16", "int8"),
+        lambda c: c.version_dtype,
+        "unpacked narrow rungs; kernel storage is int8 either way",
+    ),
+    DomainRow(
+        "keys_fit_int8",
+        (True,),
+        lambda c: c.keys_per_node <= 127,
+        "watermarks never exceed keys_per_node here (no writes)",
+    ),
+    DomainRow(
+        "deficit_total_f32_exact",
+        (True,),
+        lambda c: c.keys_per_node * (c.n_nodes - 1) < 2**24,
+        "f32 vs int32 deficit-sum agreement bound",
+    ),
+    DomainRow(
+        "churn_free",
+        (True,),
+        lambda c: c.death_rate == 0.0 and c.revival_rate == 0.0,
+        "peer validity masks must be all-true",
+    ),
+    DomainRow(
+        "writes_free",
+        (True,),
+        lambda c: c.writes_per_round == 0,
+        "owner-side writes are not mirrored",
+    ),
+    DomainRow(
+        "fault_plan_inert",
+        (True,),
+        lambda c: not (
+            _faults_sim.plan_affects_links(c.fault_plan)
+            or _faults_sim.plan_affects_nodes(c.fault_plan)
+        ),
+        "link/crash masks run on the XLA engine",
+    ),
+)
+
+
+def supported(cfg: SimConfig) -> bool:
+    """Whether ``cfg`` is inside the native fast path's domain — the
+    conjunction of SUPPORT_DOMAIN's rows (see the table above)."""
+    return all(row.value(cfg) in row.allowed for row in SUPPORT_DOMAIN)
+
+
+def unsupported_features(cfg: SimConfig) -> list[str]:
+    """The SUPPORT_DOMAIN feature names ``cfg`` violates (empty when
+    supported) — for error messages and the domain-matrix test."""
+    return [
+        row.feature
+        for row in SUPPORT_DOMAIN
+        if row.value(cfg) not in row.allowed
+    ]
 
 
 class HostSimulator:
@@ -199,8 +296,9 @@ class HostSimulator:
     ) -> None:
         if not supported(cfg):
             raise ValueError(
-                "config outside the host fast-path domain "
-                "(see hostsim.supported)"
+                "config outside the host fast-path domain — offending "
+                f"features: {unsupported_features(cfg)} "
+                "(see hostsim.SUPPORT_DOMAIN)"
             )
         lib = _lib()
         if lib is None:
@@ -562,6 +660,10 @@ class HostSimulator:
             "budget": self.cfg.budget,
             "extras": extras,
             "fd_dtype": self.cfg.fd_dtype if self._track_fd else None,
+            # Rung provenance: the ladder makes the same VALUES
+            # representable several ways; a resume must not silently
+            # reinterpret a checkpoint across rungs.
+            "version_dtype": self.cfg.version_dtype,
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
         with open(f"{path}.json.tmp", "w") as f:
@@ -578,6 +680,15 @@ class HostSimulator:
                     f"checkpoint {field}={meta[field]} != cfg "
                     f"{getattr(cfg, field)}"
                 )
+        # Loud cross-rung rejection (checkpoints written before the
+        # ladder carry no rung field and were int16-only).
+        saved_rung = meta.get("version_dtype", "int16")
+        if saved_rung != cfg.version_dtype:
+            raise ValueError(
+                f"checkpoint version_dtype={saved_rung!r} != cfg "
+                f"{cfg.version_dtype!r} (cross-rung resume refused; load "
+                "under the rung that wrote it)"
+            )
         saved = set(meta.get("extras", []))
         wanted = {
             f

@@ -22,8 +22,6 @@ import json
 import os
 from dataclasses import dataclass
 
-import jax.numpy as jnp
-
 from .config import SimConfig
 
 
@@ -32,13 +30,18 @@ class MemoryPlan:
     """Estimated device bytes for one simulated cluster (or a sweep of
     ``lanes`` of them — the sweep memory model is ``lanes x per-lane
     bytes``: every lane holds its own full state and its own step
-    transients)."""
+    transients). ``shards`` counts GLOBAL shards; ``hosts`` records how
+    they are spread across processes (parallel/multihost.py) — memory-
+    neutral (each shard sees the same per-chip HBM either way), but part
+    of the planning identity so the largest-N tables and the measured-
+    boundary evidence are keyed per (rung, shards, hosts)."""
 
     n_nodes: int
     state_bytes: int  # resident SimState matrices (all lanes)
     transient_bytes: int  # largest gathered operand alive during a step
     shards: int
     lanes: int = 1
+    hosts: int = 1
 
     @property
     def per_shard_bytes(self) -> int:
@@ -75,42 +78,51 @@ def engaged_variant(cfg: SimConfig, shards: int = 1, lanes: int = 1) -> str:
     return pallas_variant_engaged(cfg, axis, n_local)
 
 
-def plan(cfg: SimConfig, shards: int = 1, lanes: int = 1) -> MemoryPlan:
-    """Bytes needed for ``cfg`` sharded ``shards`` ways on the owner
-    axis. ``lanes`` > 1 models a SweepSimulator run: state and step
-    transients scale linearly with the lane count. Sweeps served by the
-    lane-lifted pairs kernels (engaged_variant(cfg, shards, lanes) ==
-    "pairs") earn the same in-place discount as single runs — per lane;
-    sweeps off the pairs domain run XLA and pay the gathered-operand
-    transients per lane."""
+def plan(
+    cfg: SimConfig, shards: int = 1, lanes: int = 1, hosts: int = 1
+) -> MemoryPlan:
+    """Bytes needed for ``cfg`` sharded ``shards`` ways (globally, over
+    ``hosts`` processes) on the owner axis. ``lanes`` > 1 models a
+    SweepSimulator run: state and step transients scale linearly with
+    the lane count. Sweeps served by the lane-lifted pairs kernels
+    (engaged_variant(cfg, shards, lanes) == "pairs") earn the same
+    in-place discount as single runs — per lane; sweeps off the pairs
+    domain run XLA and pay the gathered-operand transients per lane.
+
+    Per-pair resident bytes come from ONE table
+    (sim.bytes.state_bytes_per_pair — the memory ladder), so every rung
+    including the packed forms is planned from the same accounting the
+    docs publish. Transients are rung-aware too: the packed u4 path
+    gathers PACKED peer rows and computes on the nibbles inside the
+    fusion (ops/gossip.py), so its gather transient is the packed width,
+    and FD configs off the fused path additionally retain the
+    round-start heartbeat matrix (hb0) for the phi phase."""
+    from .bytes import HB_BYTES, W_BYTES, state_bytes_per_pair
+
     if lanes < 1:
         raise ValueError("lanes must be >= 1")
+    if hosts < 1 or shards % hosts != 0:
+        raise ValueError("hosts must divide the global shard count")
     n = cfg.n_nodes
-    pair = jnp.dtype(cfg.version_dtype).itemsize  # w
-    if cfg.track_heartbeats:
-        pair += jnp.dtype(cfg.heartbeat_dtype).itemsize  # hb_known
-    if cfg.track_failure_detector:
-        pair += jnp.dtype(cfg.heartbeat_dtype).itemsize  # last_change
-        pair += jnp.dtype(cfg.fd_dtype).itemsize  # imean
-        pair += 2  # icount int16
-        pair += 1  # live_view bool
-        # dead_since is (N, N) only when the two-stage lifecycle is on
-        # (init_state's ds_shape; zero-sized otherwise) — round 4's plan
-        # neither charged it when it was allocated nor does the state
-        # allocate it unused any more.
-        if cfg.dead_grace_ticks is not None:
-            pair += jnp.dtype(cfg.heartbeat_dtype).itemsize
-    state = pair * n * n
+    state = int(state_bytes_per_pair(cfg) * n * n)
     # Permuted gathers of w (and hb when tracked) are live alongside the
     # donated state during a pull. The 'permutation' pairing
     # computes BOTH handshake directions from pre-round state, so two
     # gathered peer matrices (plus their advance temporaries, bounded by
     # the same size) can be live at peak; 'matching' needs only one.
-    gathered = jnp.dtype(cfg.version_dtype).itemsize * n * n
-    if cfg.track_heartbeats:
-        gathered += jnp.dtype(cfg.heartbeat_dtype).itemsize * n * n
+    hb_bytes = (
+        int(HB_BYTES[cfg.heartbeat_dtype] * n * n)
+        if cfg.track_heartbeats
+        else 0
+    )
+    gathered = int(W_BYTES[cfg.version_dtype] * n * n) + hb_bytes
     directions = 2 if cfg.pairing == "permutation" else 1
     transient = directions * gathered
+    if cfg.track_failure_detector:
+        # The XLA FD phase compares post-exchange heartbeats against the
+        # retained round-start matrix (hb_round_start) — a full second
+        # hb matrix live at peak that earlier plans never charged.
+        transient += hb_bytes
     # The pair-fused kernel path updates w/hb IN PLACE
     # (input_output_aliases) and never materializes a gather: its
     # steady-state peak is the resident state alone. Decided by the
@@ -129,10 +141,11 @@ def plan(cfg: SimConfig, shards: int = 1, lanes: int = 1) -> MemoryPlan:
         # is live at peak alongside the resident state (ADVICE r3).
         # Only heartbeat-free profiles earn the zero-transient claim.
         if cfg.track_failure_detector and cfg.track_heartbeats:
-            transient = jnp.dtype(cfg.heartbeat_dtype).itemsize * n * n
+            transient = hb_bytes
         else:
             transient = 0
-    return MemoryPlan(n, state * lanes, transient * lanes, shards, lanes)
+    return MemoryPlan(n, state * lanes, transient * lanes, shards, lanes,
+                      hosts)
 
 
 # -- measured fit/no-fit boundaries -------------------------------------------
@@ -165,26 +178,38 @@ def _boundaries_path() -> str:
 
 
 def _boundary_key(
-    cfg: SimConfig, shards: int, hbm_bytes_per_chip: int, lanes: int = 1
+    cfg: SimConfig,
+    shards: int,
+    hbm_bytes_per_chip: int,
+    lanes: int = 1,
+    hosts: int = 1,
 ) -> dict:
     """The signature a measured verdict is valid for: the execution
-    path (kernel variant + profile + shards + sweep lanes) AND the chip
-    capacity it was observed on — a 16 GiB no-fit says nothing about a
-    32 GiB part, and an 8-lane sweep OOM says nothing about a
-    single-run fit at the same (variant, profile, shards): lanes
-    multiply resident state, so they are part of the key (entries
-    recorded before the sweep engine carry no ``lanes`` field and read
-    as 1 — see fits_verdict)."""
+    path (kernel variant + profile + shards + sweep lanes + host
+    spread) AND the chip capacity it was observed on — a 16 GiB no-fit
+    says nothing about a 32 GiB part, and an 8-lane sweep OOM says
+    nothing about a single-run fit at the same (variant, profile,
+    shards): lanes multiply resident state, so they are part of the key
+    (entries recorded before the sweep engine carry no ``lanes`` field
+    and read as 1 — see fits_verdict; ``hosts`` likewise — pre-
+    multihost entries were single-process). The bookkeeping rungs
+    (icount_dtype, live_bits) are part of the profile too: shrinking
+    them changes resident bytes, so evidence must not cross rungs."""
     return {
         "variant": engaged_variant(cfg, shards, lanes),
         "version_dtype": cfg.version_dtype,
         "heartbeat_dtype": cfg.heartbeat_dtype if cfg.track_heartbeats else None,
         "fd_dtype": cfg.fd_dtype if cfg.track_failure_detector else None,
+        "icount_dtype": (
+            cfg.icount_dtype if cfg.track_failure_detector else None
+        ),
+        "live_bits": cfg.live_bits,
         "track_heartbeats": cfg.track_heartbeats,
         "track_failure_detector": cfg.track_failure_detector,
         "pairing": cfg.pairing,
         "shards": shards,
         "lanes": lanes,
+        "hosts": hosts,
         "hbm_bytes_per_chip": hbm_bytes_per_chip,
     }
 
@@ -207,6 +232,7 @@ def record_boundary(
     path: str | None = None,
     hbm_bytes_per_chip: int = 16 * 1024**3,
     lanes: int = 1,
+    hosts: int = 1,
 ) -> dict:
     """Append one measured fit/no-fit outcome (atomic rewrite under an
     inter-process lock — the bench ladder and the battery can both run
@@ -218,7 +244,7 @@ def record_boundary(
 
     path = path or _boundaries_path()
     entry = {
-        **_boundary_key(cfg, shards, hbm_bytes_per_chip, lanes),
+        **_boundary_key(cfg, shards, hbm_bytes_per_chip, lanes, hosts),
         "n_nodes": cfg.n_nodes,
         "fits": bool(fits),
         "rounds_per_sec": rounds_per_sec,
@@ -249,6 +275,7 @@ def fits_verdict(
     hbm_bytes_per_chip: int = 16 * 1024**3,
     path: str | None = None,
     lanes: int = 1,
+    hosts: int = 1,
 ) -> dict:
     """Will this config fit one chip's HBM — measured evidence first,
     model second.
@@ -265,18 +292,24 @@ def fits_verdict(
     it. Otherwise the analytic MemoryPlan answers, flagged
     ``measured=False`` so consumers (bench, README claims) can label
     planner-derived numbers honestly."""
-    p = plan(cfg, shards, lanes)
-    key = _boundary_key(cfg, shards, hbm_bytes_per_chip, lanes)
+    p = plan(cfg, shards, lanes, hosts)
+    key = _boundary_key(cfg, shards, hbm_bytes_per_chip, lanes, hosts)
+    # Fields added to the key AFTER evidence was first recorded read at
+    # their historical value when absent, so old entries keep deciding
+    # the queries they were measured for: pre-sweep entries were single
+    # runs (lanes=1), pre-multihost entries single-process (hosts=1),
+    # pre-ladder entries the int16 bookkeeping profile.
+    legacy_defaults = {
+        "lanes": 1,
+        "hosts": 1,
+        "icount_dtype": "int16" if cfg.track_failure_detector else None,
+        "live_bits": False,
+    }
     # Latest-per-n first: re-measuring a rung supersedes its old verdict.
     latest: dict[int, dict] = {}
     for e in load_boundaries(path):
-        # Entries recorded before the sweep engine carry no "lanes"
-        # field: they were single runs, so they read as lanes=1 — a
-        # sweep OOM can therefore never poison single-run verdicts for
-        # the same (variant, profile, shards) key, and vice versa.
         if any(
-            (e.get(k, 1) if k == "lanes" else e.get(k)) != v
-            for k, v in key.items()
+            e.get(k, legacy_defaults.get(k)) != v for k, v in key.items()
         ):
             continue
         n = e["n_nodes"]
@@ -313,30 +346,76 @@ def fits_verdict(
     }
 
 
-def lean_config(n_nodes: int, **overrides) -> SimConfig:
-    """The memory-lean convergence profile used for max-scale runs:
-    int16 watermarks, no heartbeat matrix, no failure detector."""
+# -- the memory ladder's named rungs ------------------------------------------
+#
+# One override table per profile family (docs/sim.md "memory ladder"):
+# a rung name selects the dtype/packing set, and a NEW rung is one new
+# dict entry here — the planners, the bytes table (sim/bytes.ladder)
+# and the docs all read these builders.
+#
+# Horizon contracts per rung (enforced by init_state + _check_horizon):
+#   int16  — versions/ticks < 32768
+#   int8   — versions/ticks < 128
+#   u4r    — max versions per owner <= 15 (watermarks live as packed
+#            saturating residuals; keys_per_node drops to 15)
+#   shrunk/deep (full-FD) — icount_dtype int8 caps window_ticks at 126.
+
+_LEAN_RUNGS: dict[str, dict] = {
+    "int32": dict(version_dtype="int32"),
+    "int16": dict(version_dtype="int16"),
+    "int8": dict(version_dtype="int8"),
+    "u4r": dict(version_dtype="u4r", keys_per_node=15),
+}
+
+_FULL_RUNGS: dict[str, dict] = {
+    "int32": dict(
+        version_dtype="int32", heartbeat_dtype="int32", fd_dtype="float32"
+    ),
+    "int16": dict(),  # the r5 profile — full_config's defaults
+    # Shrunk FD bookkeeping: int8 sample counters + bit-packed liveness
+    # (9.125 B/pair — the VERDICT target figure at int16 matrices).
+    "shrunk": dict(icount_dtype="int8", live_bits=True, window_ticks=100),
+    # The deepest rung: int8 watermarks/ticks on top of the shrunk
+    # bookkeeping (6.125 B/pair; horizon < 128 rounds — the 100k-class
+    # convergence runs finish in ~20).
+    "deep": dict(
+        version_dtype="int8",
+        heartbeat_dtype="int8",
+        icount_dtype="int8",
+        live_bits=True,
+        window_ticks=100,
+    ),
+}
+
+
+def lean_config(n_nodes: int, rung: str = "int16", **overrides) -> SimConfig:
+    """The memory-lean convergence profile used for max-scale runs: no
+    heartbeat matrix, no failure detector, watermarks at the named
+    ladder rung (default int16 — the profile every committed boundary
+    measurement ran). Explicit ``overrides`` win over the rung's."""
     defaults = dict(
         n_nodes=n_nodes,
         keys_per_node=16,
         fanout=3,
         budget=2048,
-        version_dtype="int16",
         track_failure_detector=False,
         track_heartbeats=False,
     )
+    defaults.update(_LEAN_RUNGS[rung])
     defaults.update(overrides)
     return SimConfig(**defaults)
 
 
-def full_config(n_nodes: int, **overrides) -> SimConfig:
+def full_config(n_nodes: int, rung: str = "int16", **overrides) -> SimConfig:
     """The scale-tuned FULL profile: heartbeats + phi-accrual failure
     detector (the reference's actual operating shape — it never gossips
-    without heartbeats, reference server.py:471-474) at the narrowest
-    exact dtypes: int16 watermarks and heartbeat ticks (horizon < 32768
-    rounds), bfloat16 stored interval means (update math stays f32).
-    This is the profile the full-FD scale ladder and the full-profile
-    exact-R datum run."""
+    without heartbeats, reference server.py:471-474) at the named
+    ladder rung. The default "int16" is the r5 profile: int16
+    watermarks and heartbeat ticks (horizon < 32768 rounds), bfloat16
+    stored interval means (update math stays f32) — the profile the
+    full-FD scale ladder and the full-profile exact-R datum ran.
+    "shrunk" and "deep" descend the bookkeeping ladder toward (and
+    past) the 9.125 B/pair target. Explicit ``overrides`` win."""
     defaults = dict(
         n_nodes=n_nodes,
         keys_per_node=16,
@@ -348,5 +427,98 @@ def full_config(n_nodes: int, **overrides) -> SimConfig:
         track_failure_detector=True,
         track_heartbeats=True,
     )
+    defaults.update(_FULL_RUNGS[rung])
     defaults.update(overrides)
     return SimConfig(**defaults)
+
+
+def max_scale_model(
+    profile: str = "lean",
+    rung: str = "int16",
+    shards: int = 1,
+    hosts: int = 1,
+    hbm_bytes_per_chip: int = 16 * 1024**3,
+) -> dict:
+    """Largest aligned population the ANALYTIC plan fits for one
+    (profile, rung, shards, hosts) cell — the planner's answer to "how
+    far does this rung scale?", labelled a MODEL (``certified: false``)
+    until a chip calibrates the boundary table for that execution path
+    (the round-3 honesty discipline: the model has been wrong before;
+    fits_verdict consults measured evidence first).
+
+    Alignment: 128 x shards, so every shard's column block stays
+    lane-aligned (the fused kernels' domain and the measured-fastest
+    XLA shape)."""
+    from .bytes import state_bytes_per_pair
+
+    builder = {"lean": lean_config, "full": full_config}[profile]
+    step = 128 * shards
+    lo, hi = step, step * 20_000  # 2.56M at 1 shard — beyond any model
+    while lo + step <= hi:
+        mid = ((lo + hi) // 2) // step * step
+        if mid <= lo:
+            break
+        if plan(builder(mid, rung=rung), shards, hosts=hosts).fits(
+            hbm_bytes_per_chip
+        ):
+            lo = mid
+        else:
+            hi = mid
+    p = plan(builder(lo, rung=rung), shards, hosts=hosts)
+    return {
+        "profile": profile,
+        "rung": rung,
+        "shards": shards,
+        "hosts": hosts,
+        "max_nodes_model": lo,
+        "bytes_per_pair": state_bytes_per_pair(builder(lo, rung=rung)),
+        "per_shard_bytes": p.per_shard_bytes,
+        "variant": engaged_variant(builder(lo, rung=rung), shards),
+        "certified": False,  # analytic model, not a chip measurement
+    }
+
+
+def ladder_models(hbm_bytes_per_chip: int = 16 * 1024**3) -> dict:
+    """The memory ladder's headline planning claims, machine-readable
+    (bench.py stamps this into records as ``memory_ladder``, each entry
+    carrying ``certified: false`` until a tunnel window measures it):
+
+    - the deepest full-FD rung's B/pair (the <= 9.125 target) and
+      whether 100k-class full-FD fits a modeled 16 GB x 8 mesh;
+    - the lean ladder's largest modeled single-chip population per rung
+      (the >= 100k / >= 3x-over-32k claim rides the u4r rung).
+    """
+    from .bytes import state_bytes_per_pair
+
+    # 102,400 = 128 * 800: the smallest 1024-aligned 100k-class shape,
+    # so an 8-shard mesh keeps lane-aligned column blocks.
+    n100k = 102_400
+    deep = full_config(n100k, rung="deep")
+    deep_plan = plan(deep, shards=8, hosts=1)
+    out = {
+        "full_fd_deepest": {
+            "rung": "deep",
+            "bytes_per_pair": state_bytes_per_pair(deep),
+            "target_bytes_per_pair": 9.125,
+            "meets_target": state_bytes_per_pair(deep) <= 9.125,
+            "n_nodes": n100k,
+            "fits_16gb_x8_model": deep_plan.fits(hbm_bytes_per_chip),
+            "per_shard_bytes": deep_plan.per_shard_bytes,
+            "certified": False,
+        },
+        "lean_single_chip": {
+            rung: max_scale_model(
+                "lean", rung, hbm_bytes_per_chip=hbm_bytes_per_chip
+            )
+            for rung in _LEAN_RUNGS
+        },
+    }
+    deepest_lean = out["lean_single_chip"]["u4r"]
+    out["lean_max_scale_claim"] = {
+        "rung": "u4r",
+        "max_nodes_model": deepest_lean["max_nodes_model"],
+        "baseline_measured_nodes": 32_768,  # bench.py SCALE_PROBE_N
+        "lift": round(deepest_lean["max_nodes_model"] / 32_768, 2),
+        "certified": False,
+    }
+    return out

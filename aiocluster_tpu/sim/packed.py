@@ -1,0 +1,148 @@
+"""Packed state dtypes: the u4 residual watermark rung and bit-packed
+liveness, plus THE sanctioned widen helpers.
+
+The memory ladder (docs/sim.md) ends in storage forms narrower than any
+machine dtype:
+
+- ``version_dtype="u4r"`` stores each watermark as a **saturating
+  residual below the owner's max_version** — ``r[i, j] =
+  clip(max_version[j] - w[i, j], 0, 15)`` — two residuals per byte
+  (0.5 B/pair). Residual space is closed under the gossip math: the
+  deficit of one handshake direction is ``max(r_recv - r_send, 0)``
+  (the per-owner ``max_version`` cancels out of ``w_send - w_recv``),
+  an advance of ``a`` key-versions is ``r -= a``, the owner-diagonal
+  refresh is ``r = 0``, and full convergence is ``r == 0``. The hot
+  path (ops/gossip.py) therefore never unpacks the matrix into HBM: it
+  computes on the nibbles inside the XLA fusion (byte-space), and only
+  planners/metrics/checkpoint inspection widen — through the helpers
+  here.
+- ``live_bits=True`` stores the failure detector's live_view as a
+  column-packed bitmap (1 bit/pair instead of bool's byte).
+
+Every *deliberate* widening of a packed (or narrow) state field routes
+through this module: the static analyzer's ACT025 rule flags
+``astype``/int32-promotion on ``w``/``hb_known``/``imean``-named targets
+anywhere else in sim//ops/ — a silent widen materializes the wide matrix
+in HBM and quietly un-earns the rung's memory claim.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+U4_MAX = 15  # saturating residual ceiling (one nibble)
+
+__all__ = (
+    "U4_MAX",
+    "imean_f32",
+    "is_packed_live",
+    "is_packed_w",
+    "live_view_bool",
+    "pack_bits",
+    "pack_u4",
+    "residuals_u4",
+    "unpack_bits",
+    "unpack_u4",
+    "watermarks_i32",
+)
+
+
+# -- u4 residual codec (two values per byte, column-packed) -------------------
+
+
+def pack_u4(values) -> jnp.ndarray:
+    """(…, n) integer residuals in [0, 15] -> (…, n // 2) uint8, column
+    2k in the low nibble and 2k + 1 in the high nibble. Saturates (does
+    not wrap) values above 15 — the rung's overflow discipline; the
+    horizon guards keep valid runs below the ceiling."""
+    v = jnp.clip(values, 0, U4_MAX).astype(jnp.uint8)
+    lo = v[..., 0::2]
+    hi = v[..., 1::2]
+    return lo | (hi << 4)
+
+
+def unpack_u4(packed) -> jnp.ndarray:
+    """Inverse of :func:`pack_u4`: (…, n // 2) uint8 -> (…, n) int32
+    residuals. A SANCTIONED widen — callers materialize the wide form
+    only off the hot path (metrics, checkpoint inspection, parity
+    tests); ops/gossip.py computes on the nibbles in place instead."""
+    lo = (packed & 0xF).astype(jnp.int32)
+    hi = (packed >> 4).astype(jnp.int32)
+    return jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
+
+
+def is_packed_w(w) -> bool:
+    """Whether a state's watermark matrix is the packed u4 residual
+    form. Dtype IS the discriminator: every unpacked rung is signed
+    (int32/int16/int8); only the packed rung stores uint8 bytes."""
+    return jnp.dtype(w.dtype) == jnp.uint8
+
+
+# -- liveness bitmap (eight pairs per byte, column-packed) --------------------
+
+
+def pack_bits(mask) -> jnp.ndarray:
+    """(…, n) bool -> (…, n // 8) uint8 bitmap, column j in bit
+    j % 8 of byte j // 8."""
+    b = mask.astype(jnp.uint8).reshape(*mask.shape[:-1], -1, 8)
+    weights = (1 << jnp.arange(8, dtype=jnp.uint8))[None, :]
+    return (b * weights).sum(axis=-1).astype(jnp.uint8)
+
+
+def unpack_bits(packed) -> jnp.ndarray:
+    """Inverse of :func:`pack_bits`: (…, n // 8) uint8 -> (…, n) bool."""
+    bits = (packed[..., :, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    return (bits > 0).reshape(*packed.shape[:-1], -1)
+
+
+def is_packed_live(live_view) -> bool:
+    """Whether a state's live_view is the packed bitmap form (unpacked
+    states store bool)."""
+    return jnp.dtype(live_view.dtype) == jnp.uint8
+
+
+# -- sanctioned widen helpers -------------------------------------------------
+#
+# These are the ONLY places a packed/narrow state field may be widened
+# by name (analyzer rule ACT025). They exist so consumers that need the
+# canonical wide view — planners, metrics, tests, host tooling — share
+# one correct decode instead of re-deriving residual semantics.
+
+
+def watermarks_i32(state, owners=None) -> jnp.ndarray:
+    """The watermark matrix as int32 VALUES for any rung.
+
+    Packed states store residuals relative to the owner's max_version,
+    so the decode needs the owner ids of this block's columns
+    (``owners``: global owner index per local column; defaults to
+    ``arange`` — the unsharded layout)."""
+    w = state.w
+    if not is_packed_w(w):
+        return w.astype(jnp.int32)
+    r = unpack_u4(w)
+    if owners is None:
+        owners = jnp.arange(r.shape[-1], dtype=jnp.int32)
+    return state.max_version[owners].astype(jnp.int32)[None, :] - r
+
+
+def residuals_u4(state) -> jnp.ndarray:
+    """The stored residuals of a packed state as int32 (raises on
+    unpacked rungs — callers wanting values use watermarks_i32)."""
+    if not is_packed_w(state.w):
+        raise ValueError("state.w is not the packed u4 residual rung")
+    return unpack_u4(state.w)
+
+
+def live_view_bool(state) -> jnp.ndarray:
+    """live_view as bool for any rung (unpacks the bitmap form)."""
+    lv = state.live_view
+    if is_packed_live(lv):
+        return unpack_bits(lv)
+    return lv
+
+
+def imean_f32(imean) -> jnp.ndarray:
+    """The failure detector's stored interval mean widened to the f32
+    the update math runs in (bfloat16 storage rounds only the stored
+    value — SimConfig.fd_dtype)."""
+    return imean.astype(jnp.float32)

@@ -55,6 +55,17 @@ class SimCluster:
         trace: bool = False,
     ) -> None:
         n = cfg.n_nodes
+        if cfg.version_dtype == "u4r":
+            # SimCluster's write flush bumps max_version by direct state
+            # surgery, which on the packed residual rung would require a
+            # matching residual shift outside sim_step — an invariant too
+            # easy to silently break. The KV-faithful host layer targets
+            # small-N fidelity anyway; packed rungs are for scale runs.
+            raise ValueError(
+                "SimCluster does not support version_dtype='u4r' "
+                "(host-side write flush bypasses the residual encoding); "
+                "use an unpacked rung"
+            )
         self.cfg = cfg
         self.names = names or [f"node-{i}" for i in range(n)]
         if len(self.names) != n:
@@ -236,8 +247,10 @@ class SimCluster:
         track_failure_detector)."""
         if not self.cfg.track_failure_detector:
             raise ValueError("failure detector disabled for this sim")
+        from .packed import live_view_bool
+
         i = self._index[observer]
-        row = np.asarray(self.sim.state.live_view[i])
+        row = np.asarray(live_view_bool(self.sim.state)[i])
         return [self.names[j] for j in np.flatnonzero(row)]
 
     def alive_nodes(self) -> list[str]:

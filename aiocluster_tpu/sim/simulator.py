@@ -136,6 +136,11 @@ class Simulator:
     ) -> None:
         if topology is not None and topology.n_nodes != cfg.n_nodes:
             raise ValueError("topology size != cfg.n_nodes")
+        if topology is not None and cfg.version_dtype == "u4r":
+            raise ValueError(
+                "version_dtype='u4r' does not support topology runs "
+                "(the adjacency path's scatter-max is unpacked-only)"
+            )
         from ..ops.gossip import resolve_variant_env
 
         # Fold the AIOCLUSTER_TPU_PALLAS_VARIANT override into the config
@@ -186,6 +191,13 @@ class Simulator:
             self._obs = SimMetrics(
                 metrics, trace_writer, stride=metrics_stride, engine="xla",
                 start_tick=self._host_tick,
+            )
+            # Memory-ladder provenance gauge: the rung's planned
+            # resident bytes (host arithmetic; docs/observability.md).
+            from .memory import plan as _mem_plan
+
+            self._obs.set_state_bytes(
+                _mem_plan(cfg, 1 if mesh is None else mesh.size).state_bytes
             )
         # select_peers' churn-free 'choice' fast path samples uniformly
         # over ALL nodes (the alive mask is statically all-true for
@@ -255,32 +267,43 @@ class Simulator:
         self._known_max_version += int(delta)
 
     def _check_horizon(self, rounds: int) -> None:
-        """Raise before an int16 profile silently wraps: heartbeats store
-        the tick (horizon < 2^15), and int16 watermarks store versions
-        (known max + writes_per_round per tick run < 2^15). Host-side
-        arithmetic from construction-time facts (the dtype knobs are the
-        validated literal strings "int16"/"int32") — zero device
-        traffic, so timing loops see no sync."""
+        """Raise before a narrow rung silently wraps: heartbeats store
+        the tick (horizon < the rung's limit — 2^15 int16, 2^7 int8),
+        and narrow watermarks store versions (known max +
+        writes_per_round per tick run < the rung's limit; the packed
+        u4 residual rung bounds max_version itself at 15, since a
+        never-contacted observer's residual equals it). Host-side
+        arithmetic from construction-time facts (the dtype knobs are
+        validated literal strings) — zero device traffic, so timing
+        loops see no sync. Limits live in sim/state.py next to
+        init_state's initial-version checks, so a new rung extends one
+        table."""
+        from .state import HEARTBEAT_LIMITS, VERSION_LIMITS
+
         end_tick = self._host_tick + rounds
+        hb_limit = HEARTBEAT_LIMITS[self.cfg.heartbeat_dtype]
         if (
             self.cfg.track_heartbeats
-            and self.cfg.heartbeat_dtype == "int16"
-            and end_tick >= 2**15
+            and hb_limit < 2**31
+            and end_tick >= hb_limit
         ):
             raise ValueError(
-                f"running to tick {end_tick} overflows int16 heartbeats "
-                "(heartbeat_dtype='int16' stores the tick; use int32 for "
-                "horizons >= 32768 rounds)"
+                f"running to tick {end_tick} overflows "
+                f"{self.cfg.heartbeat_dtype} heartbeats (heartbeat_dtype "
+                f"stores the tick; horizons >= {hb_limit} rounds need a "
+                "wider rung)"
             )
-        if self.cfg.version_dtype == "int16":
+        v_limit = VERSION_LIMITS[self.cfg.version_dtype]
+        if v_limit < 2**31:
             bound = self._known_max_version + self.cfg.writes_per_round * (
                 end_tick - self._version_base_tick
             )
-            if bound >= 2**15:
+            if bound >= v_limit:
                 raise ValueError(
                     f"versions may reach {bound} by tick {end_tick}, "
-                    "overflowing version_dtype='int16' (lower "
-                    "writes_per_round/horizon or use int32)"
+                    f"overflowing version_dtype='{self.cfg.version_dtype}' "
+                    f"(limit {v_limit}; lower writes_per_round/horizon or "
+                    "use a wider rung)"
                 )
 
     def run(self, rounds: int) -> None:

@@ -78,11 +78,55 @@ class SweepParams:
     fault_seed: jax.Array | None = None
 
 
+# Largest representable watermark per version-dtype rung (docs/sim.md
+# "memory ladder"): init_state and the horizon guards
+# (Simulator._check_horizon) enforce these BOUNDS loudly instead of
+# letting a narrow rung wrap. The u4r rung stores residuals below the
+# owner's max_version, so the bound applies to max_version itself (a
+# never-contacted observer's residual equals it).
+VERSION_LIMITS = {"int32": 2**31, "int16": 2**15, "int8": 2**7, "u4r": 16}
+HEARTBEAT_LIMITS = {"int32": 2**31, "int16": 2**15, "int8": 2**7}
+
+
+def state_n_local(state: SimState) -> int:
+    """This block's LOCAL owner-column count, decoding the packed u4
+    rung (whose stored width is halved). The single derivation every
+    shape-driven consumer (sim_step, the convergence metrics) uses."""
+    w = state.w
+    if jnp.dtype(w.dtype) == jnp.uint8:  # packed u4 residual rung
+        return int(w.shape[-1]) * 2
+    return int(w.shape[-1])
+
+
+def expected_dtypes(cfg: SimConfig) -> dict[str, str]:
+    """Storage dtype per SimState field for this config's rung — the
+    layout contract checkpoints are validated against (a packed-rung
+    file loaded under an unpacked config would silently reinterpret
+    residual bytes as watermarks; sim/checkpoint.py rejects it loudly)."""
+    vdt = "uint8" if cfg.version_dtype == "u4r" else cfg.version_dtype
+    hdt = cfg.heartbeat_dtype
+    return {
+        "tick": "int32",
+        "max_version": "int32",
+        "heartbeat": "int32",
+        "alive": "bool",
+        "w": vdt,
+        "hb_known": hdt,
+        "last_change": hdt,
+        "imean": cfg.fd_dtype,
+        "icount": cfg.icount_dtype,
+        "live_view": "uint8" if cfg.live_bits else "bool",
+        "dead_since": hdt,
+    }
+
+
 def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> SimState:
     """Fresh cluster: every node owns ``keys_per_node`` versions (versions
     1..K) — or per-node counts via ``initial_versions`` — knows only
     itself, and has heartbeat 1 (parity with the runtime seeding one
     heartbeat at boot, runtime/cluster.py)."""
+    from .packed import pack_bits, pack_u4
+
     n = cfg.n_nodes
     fd_shape = (n, n) if cfg.track_failure_detector else (0, 0)
     # dead_since only drives the two-stage lifecycle; without it the FD
@@ -94,26 +138,41 @@ def init_state(cfg: SimConfig, initial_versions: jax.Array | None = None) -> Sim
         else (0, 0)
     )
     eye = jnp.eye(n, dtype=bool)
-    vdt = jnp.dtype(cfg.version_dtype)
     hdt = jnp.dtype(cfg.heartbeat_dtype)
     if initial_versions is None:
         initial_versions = jnp.full((n,), cfg.keys_per_node, jnp.int32)
     initial_versions = jnp.asarray(initial_versions, jnp.int32)
-    if vdt == jnp.int16 and int(jnp.max(initial_versions)) >= 2**15:
-        raise ValueError("initial versions overflow version_dtype=int16")
+    limit = VERSION_LIMITS[cfg.version_dtype]
+    if int(jnp.max(initial_versions)) >= limit:
+        raise ValueError(
+            f"initial versions overflow version_dtype={cfg.version_dtype} "
+            f"(must stay < {limit})"
+        )
+    if cfg.version_dtype == "u4r":
+        # Packed residual rung: a fresh observer's residual on owner j
+        # IS j's initial version count (w = 0 off-diagonal), 0 on the
+        # diagonal — stored two per byte.
+        w = pack_u4(jnp.where(eye, 0, initial_versions[None, :]))
+    else:
+        w = jnp.where(eye, initial_versions[None, :], 0).astype(
+            jnp.dtype(cfg.version_dtype)
+        )
+    if cfg.track_failure_detector:
+        live0 = jnp.eye(*fd_shape, dtype=bool)
+        live_view = pack_bits(live0) if cfg.live_bits else live0
+    else:
+        live_view = jnp.zeros(fd_shape, bool)
     return SimState(
         tick=jnp.asarray(0, jnp.int32),
         max_version=initial_versions,
         heartbeat=jnp.ones((n,), jnp.int32),
         alive=jnp.ones((n,), bool),
-        w=jnp.where(eye, initial_versions[None, :], 0).astype(vdt),
+        w=w,
         hb_known=eye.astype(hdt) if cfg.track_heartbeats
         else jnp.zeros((0, 0), hdt),
         last_change=jnp.zeros(fd_shape, hdt),
         imean=jnp.zeros(fd_shape, jnp.dtype(cfg.fd_dtype)),
-        icount=jnp.zeros(fd_shape, jnp.int16),
-        live_view=jnp.eye(*fd_shape, dtype=bool)
-        if cfg.track_failure_detector
-        else jnp.zeros(fd_shape, bool),
+        icount=jnp.zeros(fd_shape, jnp.dtype(cfg.icount_dtype)),
+        live_view=live_view,
         dead_since=jnp.zeros(ds_shape, hdt),
     )

@@ -303,25 +303,28 @@ class SweepSimulator:
 
     def _check_horizon(self, rounds: int) -> None:
         """Simulator._check_horizon with the sweep's worst-lane write
-        rate (host-side arithmetic; no device traffic)."""
+        rate (host-side arithmetic; no device traffic). Same per-rung
+        limit tables (sim/state.py), so new rungs extend one place."""
+        from .state import HEARTBEAT_LIMITS, VERSION_LIMITS
+
         end_tick = self._host_tick + rounds
         cfg = self.cfg
-        if (
-            cfg.track_heartbeats
-            and cfg.heartbeat_dtype == "int16"
-            and end_tick >= 2**15
-        ):
+        hb_limit = HEARTBEAT_LIMITS[cfg.heartbeat_dtype]
+        if cfg.track_heartbeats and hb_limit < 2**31 and end_tick >= hb_limit:
             raise ValueError(
-                f"running to tick {end_tick} overflows int16 heartbeats"
+                f"running to tick {end_tick} overflows "
+                f"{cfg.heartbeat_dtype} heartbeats"
             )
-        if cfg.version_dtype == "int16":
+        v_limit = VERSION_LIMITS[cfg.version_dtype]
+        if v_limit < 2**31:
             bound = self._known_max_version + self._max_wpr * (
                 end_tick - self._version_base_tick
             )
-            if bound >= 2**15:
+            if bound >= v_limit:
                 raise ValueError(
                     f"versions may reach {bound} by tick {end_tick}, "
-                    "overflowing version_dtype='int16'"
+                    f"overflowing version_dtype='{cfg.version_dtype}' "
+                    f"(limit {v_limit})"
                 )
 
     def _sharded_chunk(self, tracked: bool):

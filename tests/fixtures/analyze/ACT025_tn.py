@@ -1,0 +1,23 @@
+# analyze-domain: sim
+"""TN: sanctioned-helper widens, same-width copies, and non-state
+names stay quiet."""
+
+import jax.numpy as jnp
+
+from aiocluster_tpu.sim.packed import imean_f32, watermarks_i32
+
+
+def widen_via_helpers(state):
+    # THE sanctioned route: the decode lives in sim/packed.py.
+    return watermarks_i32(state).sum() + imean_f32(state.imean).sum()
+
+
+def matching_width_copy(w_ref, out_ref):
+    # astype to a reference's own dtype is a copy, not a widen.
+    out_ref[...] = w_ref[...].astype(out_ref.dtype)
+
+
+def unrelated_names(counts):
+    # Widening a non-state local is fine.
+    totals = counts.astype(jnp.int32)
+    return totals

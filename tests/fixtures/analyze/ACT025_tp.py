@@ -1,0 +1,14 @@
+# analyze-domain: sim
+"""TP: widening astype/promotion on packed state fields outside the
+sanctioned helpers (sim domain)."""
+
+import jax.numpy as jnp
+
+
+def leak_wide_watermarks(state):
+    wide = state.w.astype(jnp.int32)  # materializes the wide matrix
+    return wide.sum()
+
+
+def leak_wide_mean(state):
+    return jnp.float32(state.imean)

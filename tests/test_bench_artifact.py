@@ -233,3 +233,49 @@ def test_resolve_platform_fast_path_on_fresh_down(monkeypatch):
     import jax
 
     assert jax.config.jax_platforms == "cpu"
+
+
+def test_uncertified_anchors_carry_machine_readable_flag(tmp_path, monkeypatch):
+    """BENCH honesty flag (VERDICT item 8): every stamped number whose
+    anchor is uncertified carries ``certified: false`` IN THE RECORD —
+    machine-readable, not prose — and the flag survives the full-record
+    writer (grep a fresh CPU-shaped record off disk)."""
+    # The preserved round-3 best is flagged at its source.
+    assert bench.UNCERTIFIED_BEST_ONCHIP["certified"] is False
+    # The fused-roofline projection (CPU fallback) is flagged.
+    onchip = bench.load_last_onchip_record(lambda _m: None)
+    proj = bench.fused_roofline_projection(onchip, lambda _m: None)
+    assert proj is not None and proj["certified"] is False
+    # A planner verdict resting on the analytic model alone is flagged
+    # (point the boundary table at an empty file: no measured evidence).
+    monkeypatch.setenv(
+        "AIOCLUSTER_TPU_BOUNDARIES_PATH", str(tmp_path / "empty.json")
+    )
+    verdict = bench._planner_verdict_summary(lambda _m: None)
+    assert verdict["measured"] is False and verdict["certified"] is False
+    # Every memory-ladder model entry is a flagged projection.
+    ladder = bench.memory_ladder_models(lambda _m: None)
+    assert ladder["full_fd_deepest"]["certified"] is False
+    assert ladder["lean_max_scale_claim"]["certified"] is False
+    for rung in ladder["lean_single_chip"].values():
+        assert rung["certified"] is False
+    # Writer round-trip: assemble a CPU-fallback-shaped record carrying
+    # the uncertified anchors, write it with bench's own writer, and
+    # grep the fresh file for the machine-readable flags.
+    result = _worst_case_result()
+    result["extra"]["last_onchip"]["uncertified_best"] = (
+        bench.UNCERTIFIED_BEST_ONCHIP
+    )
+    result["extra"]["roofline_fused_projection"] = proj
+    result["extra"]["max_scale_planner_verdict"] = verdict
+    result["extra"]["memory_ladder"] = ladder
+    rel = bench.write_full_record(result, lambda _m: None)
+    assert rel is not None
+    path = os.path.join(REPO, rel)
+    text = open(path).read()
+    assert '"certified": false' in text
+    rec = json.loads(text)["record"]["extra"]
+    assert rec["last_onchip"]["uncertified_best"]["certified"] is False
+    assert rec["roofline_fused_projection"]["certified"] is False
+    assert rec["max_scale_planner_verdict"]["certified"] is False
+    assert rec["memory_ladder"]["full_fd_deepest"]["certified"] is False

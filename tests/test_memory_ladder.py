@@ -1,0 +1,544 @@
+"""Memory-ladder merge gate: every new dtype rung (int8, packed u4
+residual, shrunk FD bookkeeping) must be BIT-IDENTICAL in trajectory to
+the int32 reference path at small N — unsharded, under a 2-shard mesh,
+and composed with an S-lane sweep — plus the ladder's overflow guards,
+checkpoint rung discipline, loud Pallas fallbacks, and the planner's
+headline claims (docs/sim.md "memory ladder")."""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from aiocluster_tpu.parallel.mesh import make_mesh
+from aiocluster_tpu.sim import SimConfig, Simulator, init_state
+from aiocluster_tpu.sim.packed import (
+    live_view_bool,
+    pack_bits,
+    pack_u4,
+    unpack_bits,
+    unpack_u4,
+    watermarks_i32,
+)
+
+LEAN = dict(
+    n_nodes=64, keys_per_node=8, fanout=3, budget=24,
+    track_failure_detector=False, track_heartbeats=False,
+)
+FULL = dict(
+    n_nodes=64, keys_per_node=8, fanout=2, budget=24,
+    version_dtype="int16", heartbeat_dtype="int16", fd_dtype="bfloat16",
+    window_ticks=100,
+)
+
+
+def _wtraj(cfg, rounds=12, seed=3, mesh=None):
+    sim = Simulator(cfg, seed=seed, chunk=4, mesh=mesh)
+    out = []
+    for _ in range(rounds // 4):
+        sim.run(4)
+        out.append(np.asarray(watermarks_i32(jax.device_get(sim.state))))
+    return out, sim
+
+
+# -- trajectory parity: unsharded ---------------------------------------------
+
+
+@pytest.mark.parametrize("pairing", ["matching", "permutation"])
+@pytest.mark.parametrize("rung", ["int16", "int8", "u4r"])
+def test_lean_rung_parity_unsharded(rung, pairing):
+    ref, _ = _wtraj(SimConfig(version_dtype="int32", pairing=pairing, **LEAN))
+    got, _ = _wtraj(SimConfig(version_dtype=rung, pairing=pairing, **LEAN))
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+
+
+def test_u4r_parity_with_writes_and_churn():
+    base = dict(
+        n_nodes=64, keys_per_node=4, fanout=2, budget=16,
+        writes_per_round=1, death_rate=0.02, revival_rate=0.1,
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    ref, _ = _wtraj(SimConfig(version_dtype="int32", **base), rounds=8)
+    got, _ = _wtraj(SimConfig(version_dtype="u4r", **base), rounds=8)
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+
+
+def test_u4r_exact_convergence_round_matches_reference():
+    r_ref = Simulator(
+        SimConfig(version_dtype="int32", **LEAN), seed=0
+    ).run_until_converged(200)
+    r_u4 = Simulator(
+        SimConfig(version_dtype="u4r", **LEAN), seed=0
+    ).run_until_converged(200)
+    assert r_ref == r_u4 is not None
+
+
+def _assert_fd_state_equal(sa, sb):
+    assert np.array_equal(
+        np.asarray(watermarks_i32(sa)), np.asarray(watermarks_i32(sb))
+    )
+    assert np.array_equal(
+        np.asarray(sa.hb_known, np.int32), np.asarray(sb.hb_known, np.int32)
+    )
+    assert np.array_equal(
+        np.asarray(sa.last_change, np.int32),
+        np.asarray(sb.last_change, np.int32),
+    )
+    assert np.array_equal(
+        np.asarray(sa.icount, np.int32), np.asarray(sb.icount, np.int32)
+    )
+    assert np.array_equal(
+        np.asarray(sa.imean).astype(np.float32),
+        np.asarray(sb.imean).astype(np.float32),
+    )
+    assert np.array_equal(
+        np.asarray(live_view_bool(sa)), np.asarray(live_view_bool(sb))
+    )
+
+
+def test_shrunk_fd_rung_parity_unsharded():
+    """int8 watermarks/ticks + int8 sample counters + bit-packed
+    liveness == the established int16/bool full profile, field for
+    field (imean compared as the stored bf16 values — both rungs store
+    bf16, so equality is exact)."""
+    ref = Simulator(SimConfig(**FULL), seed=5, chunk=4)
+    shr = Simulator(
+        SimConfig(**{
+            **FULL, "version_dtype": "int8", "heartbeat_dtype": "int8",
+            "icount_dtype": "int8", "live_bits": True,
+        }),
+        seed=5, chunk=4,
+    )
+    ref.run(12)
+    shr.run(12)
+    _assert_fd_state_equal(jax.device_get(ref.state), jax.device_get(shr.state))
+
+
+# -- trajectory parity: 2-shard mesh ------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        SimConfig(n_nodes=256, keys_per_node=8, fanout=3, budget=24,
+                  version_dtype="u4r", track_failure_detector=False,
+                  track_heartbeats=False),
+        SimConfig(n_nodes=256, keys_per_node=8, fanout=3, budget=24,
+                  version_dtype="int8", track_failure_detector=False,
+                  track_heartbeats=False),
+        SimConfig(**{**FULL, "n_nodes": 256, "heartbeat_dtype": "int8",
+                     "icount_dtype": "int8", "live_bits": True}),
+    ],
+    ids=["u4r-lean", "int8-lean", "shrunk-full"],
+)
+def test_rung_parity_two_shard_mesh(cfg):
+    mesh = make_mesh(jax.devices()[:2])
+    single = Simulator(cfg, seed=2, chunk=4)
+    sharded = Simulator(cfg, seed=2, chunk=4, mesh=mesh)
+    single.run(8)
+    sharded.run(8)
+    sa, sb = jax.device_get(single.state), jax.device_get(sharded.state)
+    assert np.array_equal(
+        np.asarray(watermarks_i32(sa)), np.asarray(watermarks_i32(sb))
+    )
+    if cfg.track_failure_detector:
+        _assert_fd_state_equal(sa, sb)
+
+
+# -- trajectory parity: S-lane sweeps -----------------------------------------
+
+
+def test_u4r_sweep_lanes_match_sequential():
+    from aiocluster_tpu.sim.sweep import SweepSimulator
+
+    cfg = SimConfig(n_nodes=64, keys_per_node=4, fanout=3, budget=16,
+                    version_dtype="u4r", track_failure_detector=False,
+                    track_heartbeats=False)
+    # Worst lane: 4 initial versions + 1 write/round * 8 rounds = 12,
+    # inside the u4r residual ceiling of 15 (the horizon guard enforces
+    # this — test_horizon_guard_mirrors_int16_checks_per_rung).
+    seeds, wpr, fan = [1, 2, 3], [0, 0, 1], [3, 2, 1]
+    sw = SweepSimulator(cfg, seeds, writes_per_round=wpr, fanout=fan, chunk=4)
+    sw.run(8)
+    states = jax.device_get(sw.states)
+    for lane, (s, w_, f_) in enumerate(zip(seeds, wpr, fan)):
+        seq = Simulator(
+            dataclasses.replace(cfg, writes_per_round=w_, fanout=f_),
+            seed=s, chunk=4,
+        )
+        seq.run(8)
+        a = np.asarray(
+            watermarks_i32(jax.tree.map(lambda x: x[lane], states))
+        )
+        b = np.asarray(watermarks_i32(jax.device_get(seq.state)))
+        assert np.array_equal(a, b)
+
+
+def test_shrunk_fd_sweep_lanes_match_sequential():
+    from aiocluster_tpu.sim.sweep import SweepSimulator
+
+    cfg = SimConfig(**{
+        **FULL, "version_dtype": "int8", "heartbeat_dtype": "int8",
+        "icount_dtype": "int8", "live_bits": True, "window_ticks": 64,
+    })
+    phis = [4.0, 8.0]
+    sw = SweepSimulator(cfg, [7, 8], phi_threshold=phis, chunk=4)
+    sw.run(8)
+    states = jax.device_get(sw.states)
+    for lane, (s, ph) in enumerate(zip([7, 8], phis)):
+        seq = Simulator(
+            dataclasses.replace(cfg, phi_threshold=ph), seed=s, chunk=4
+        )
+        seq.run(8)
+        _assert_fd_state_equal(
+            jax.tree.map(lambda x: x[lane], states),
+            jax.device_get(seq.state),
+        )
+
+
+# -- int8 rides the Pallas kernels (interpret mode) ---------------------------
+
+
+@pytest.mark.slow
+def test_int8_rung_pairs_kernel_parity():
+    """The lean int8 rung must ENGAGE the pairs kernel (the ladder's
+    modeled single-chip discount depends on it) and stay bit-identical
+    to XLA; the full int8 profile engages the fused FD epilogue too."""
+    from aiocluster_tpu.ops.gossip import (
+        fd_phase_engaged,
+        pallas_path_engaged,
+        pallas_variant_engaged,
+    )
+
+    lean8 = SimConfig(n_nodes=256, keys_per_node=8, fanout=2, budget=24,
+                      version_dtype="int8", track_failure_detector=False,
+                      track_heartbeats=False, use_pallas=True)
+    assert pallas_path_engaged(lean8)
+    assert pallas_variant_engaged(lean8) == "pairs"
+    a = Simulator(lean8, seed=1, chunk=2)
+    b = Simulator(dataclasses.replace(lean8, use_pallas=False), seed=1, chunk=2)
+    a.run(4)
+    b.run(4)
+    assert np.array_equal(np.asarray(a.state.w), np.asarray(b.state.w))
+
+    full8 = SimConfig(n_nodes=256, keys_per_node=8, fanout=2, budget=24,
+                      version_dtype="int8", heartbeat_dtype="int8",
+                      fd_dtype="bfloat16", window_ticks=100, use_pallas=True)
+    assert fd_phase_engaged(full8) == "fused"
+    a = Simulator(full8, seed=1, chunk=2)
+    b = Simulator(
+        dataclasses.replace(full8, use_pallas=False, use_pallas_fd=False),
+        seed=1, chunk=2,
+    )
+    a.run(4)
+    b.run(4)
+    _assert_fd_state_equal(jax.device_get(a.state), jax.device_get(b.state))
+
+
+# -- loud fallbacks -----------------------------------------------------------
+
+
+def test_u4r_wanting_kernels_falls_back_loudly():
+    from aiocluster_tpu.ops.gossip import (
+        pallas_fallback_reason,
+        pallas_fallbacks,
+        pallas_path_engaged,
+    )
+
+    cfg = SimConfig(n_nodes=256, keys_per_node=8, budget=24,
+                    version_dtype="u4r", track_failure_detector=False,
+                    track_heartbeats=False, use_pallas=True)
+    assert not pallas_path_engaged(cfg)
+    assert pallas_fallback_reason(cfg) == "packed_dtype"
+    before = pallas_fallbacks["packed_dtype"]
+    Simulator(cfg, seed=0, chunk=2).run(2)
+    assert pallas_fallbacks["packed_dtype"] == before + 1
+
+
+def test_shrunk_fd_wanting_kernels_falls_back_loudly():
+    from aiocluster_tpu.ops.gossip import fd_phase_engaged, pallas_fallbacks
+
+    cfg = SimConfig(**{
+        **FULL, "n_nodes": 256, "icount_dtype": "int8", "live_bits": True,
+        "use_pallas": True,
+    })
+    # The PULL kernels still serve the round; only the FD phase degrades.
+    assert fd_phase_engaged(cfg) == "xla"
+    before = pallas_fallbacks["fd_packed_bookkeeping"]
+    Simulator(cfg, seed=0, chunk=2).run(2)
+    assert pallas_fallbacks["fd_packed_bookkeeping"] == before + 1
+
+
+# -- codec + overflow guards --------------------------------------------------
+
+
+def test_u4_and_bit_codecs_roundtrip():
+    rng = np.random.default_rng(0)
+    r = rng.integers(0, 16, size=(6, 10), dtype=np.int32)
+    assert np.array_equal(np.asarray(unpack_u4(pack_u4(r))), r)
+    assert np.asarray(pack_u4(np.full((2, 2), 99))).max() <= 0xFF  # saturates
+    m = rng.random((5, 16)) < 0.5
+    assert np.array_equal(np.asarray(unpack_bits(pack_bits(m))), m)
+
+
+@pytest.mark.parametrize(
+    "rung,bad",
+    [("int16", 2**15), ("int8", 2**7), ("u4r", 16)],
+)
+def test_init_state_rejects_rung_overflow(rung, bad):
+    cfg = SimConfig(n_nodes=64, keys_per_node=4, version_dtype=rung,
+                    track_failure_detector=False, track_heartbeats=False)
+    with pytest.raises(ValueError, match="overflow"):
+        init_state(cfg, np.full((64,), bad, np.int32))
+    init_state(cfg, np.full((64,), bad - 1, np.int32))  # inside: fine
+
+
+def test_horizon_guard_mirrors_int16_checks_per_rung():
+    # int8 heartbeats store the tick: horizon < 128.
+    hb8 = SimConfig(n_nodes=8, keys_per_node=2, heartbeat_dtype="int8",
+                    window_ticks=64)
+    with pytest.raises(ValueError, match="int8 heartbeats"):
+        Simulator(hb8, seed=0).run(2**7)
+    # int8 watermarks: version growth < 128.
+    v8 = SimConfig(n_nodes=8, keys_per_node=2, version_dtype="int8",
+                   heartbeat_dtype="int32", writes_per_round=10,
+                   track_failure_detector=False)
+    with pytest.raises(ValueError, match="int8"):
+        Simulator(v8, seed=0).run(100)
+    # u4r residuals: max_version may not pass 15.
+    u4 = SimConfig(n_nodes=8, keys_per_node=2, version_dtype="u4r",
+                   writes_per_round=1, track_failure_detector=False,
+                   track_heartbeats=False)
+    with pytest.raises(ValueError, match="u4r"):
+        Simulator(u4, seed=0).run(20)  # 2 + 20 = 22 > 15
+    Simulator(u4, seed=0).run(8)  # 2 + 8 = 10 <= 15: fine
+
+
+def test_config_validation_rejects_off_domain_packed_configs():
+    lean = dict(track_failure_detector=False, track_heartbeats=False)
+    with pytest.raises(ValueError, match="choice"):
+        SimConfig(n_nodes=64, version_dtype="u4r", pairing="choice", **lean)
+    with pytest.raises(ValueError, match="proportional"):
+        SimConfig(n_nodes=64, version_dtype="u4r",
+                  budget_policy="greedy", **lean)
+    with pytest.raises(ValueError, match="lifecycle|dead-node"):
+        SimConfig(n_nodes=64, version_dtype="u4r", dead_grace_ticks=8)
+    with pytest.raises(ValueError, match="even"):
+        SimConfig(n_nodes=63, version_dtype="u4r", **lean)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        SimConfig(n_nodes=12, live_bits=True)
+    with pytest.raises(ValueError, match="int8 sample counter"):
+        SimConfig(n_nodes=64, icount_dtype="int8", window_ticks=1000)
+    with pytest.raises(ValueError, match="live_bits"):
+        SimConfig(n_nodes=64, live_bits=True, track_failure_detector=False,
+                  track_heartbeats=False)
+
+
+# -- checkpoints: packed round-trip + loud cross-rung rejection ---------------
+
+
+def test_packed_checkpoint_roundtrip_continues_trajectory(tmp_path):
+    cfg = SimConfig(**{
+        **FULL, "version_dtype": "u4r", "keys_per_node": 8,
+        "icount_dtype": "int8", "live_bits": True,
+    })
+    base = Simulator(cfg, seed=4, chunk=4)
+    base.run(4)
+    path = tmp_path / "packed.npz"
+    base.save(path)
+    resumed = Simulator.resume(path, chunk=4)
+    assert resumed.cfg == cfg
+    base.run(4)
+    resumed.run(4)
+    _assert_fd_state_equal(
+        jax.device_get(base.state), jax.device_get(resumed.state)
+    )
+
+
+def test_cross_rung_checkpoint_load_rejected(tmp_path):
+    """A checkpoint whose arrays and config disagree on the rung —
+    tampered meta, or a writer/loader drift — must be refused loudly,
+    not reinterpreted (packed residual bytes read as int16 watermarks
+    would be silent garbage)."""
+    from aiocluster_tpu.sim.checkpoint import load_state
+
+    cfg = SimConfig(n_nodes=64, keys_per_node=8, version_dtype="u4r",
+                    track_failure_detector=False, track_heartbeats=False)
+    sim = Simulator(cfg, seed=0, chunk=4)
+    sim.run(4)
+    path = tmp_path / "u4r.npz"
+    sim.save(path)
+    # Tamper: claim the file is the int16 rung.
+    data = dict(np.load(path))
+    meta = json.loads(bytes(data["__meta__"]).decode())
+    meta["config"]["version_dtype"] = "int16"
+    data["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(path, **data)
+    with pytest.raises(ValueError, match="rung"):
+        load_state(path)
+
+
+def test_hostsim_resume_rejects_cross_rung(tmp_path):
+    from aiocluster_tpu.sim import hostsim
+
+    if not hostsim.available():
+        pytest.skip("native hostsim unavailable")
+    cfg = hostsim_cfg = SimConfig(
+        n_nodes=128, keys_per_node=8, budget=24,
+        version_dtype="int16", track_failure_detector=False,
+        track_heartbeats=False,
+    )
+    hs = hostsim.HostSimulator(cfg, seed=0)
+    hs.run(2)
+    hs.save(str(tmp_path / "hs"))
+    other = dataclasses.replace(hostsim_cfg, version_dtype="int8")
+    with pytest.raises(ValueError, match="cross-rung"):
+        hostsim.HostSimulator.resume(str(tmp_path / "hs"), other)
+    hostsim.HostSimulator.resume(str(tmp_path / "hs"), cfg)  # same rung: fine
+
+
+# -- hostsim support domain as data -------------------------------------------
+
+
+def test_hostsim_domain_matrix():
+    """supported() must be EXACTLY the conjunction of SUPPORT_DOMAIN's
+    rows: a base in-domain config passes every row, and each row's
+    violation is detected (and attributed) independently — so a new
+    rung extends the one table and this matrix follows it."""
+    from aiocluster_tpu.faults import FaultPlan, LinkFault, NodeSet
+    from aiocluster_tpu.sim import hostsim
+
+    base = SimConfig(n_nodes=128, keys_per_node=8, budget=24,
+                     version_dtype="int16", track_failure_detector=False,
+                     track_heartbeats=False)
+    assert hostsim.supported(base)
+    assert hostsim.unsupported_features(base) == []
+    # Every allowed version rung stays in-domain.
+    for rung in ("int16", "int8"):
+        assert hostsim.supported(dataclasses.replace(base, version_dtype=rung))
+    # One violation per row, each attributed to its feature.
+    full = SimConfig(n_nodes=128, keys_per_node=8, budget=24,
+                     version_dtype="int16", heartbeat_dtype="int16",
+                     fd_dtype="bfloat16", window_ticks=100)
+    violations = {
+        "heartbeat_dtype": dataclasses.replace(full, heartbeat_dtype="int8"),
+        "icount_dtype": dataclasses.replace(
+            full, icount_dtype="int8", window_ticks=100
+        ),
+        "live_bits": dataclasses.replace(full, live_bits=True),
+        "dead_grace": dataclasses.replace(full, dead_grace_ticks=8),
+        "pairing": dataclasses.replace(base, pairing="permutation"),
+        "budget_policy": dataclasses.replace(base, budget_policy="greedy"),
+        "shape_mod_128": dataclasses.replace(base, n_nodes=100),
+        "version_dtype": dataclasses.replace(
+            base, version_dtype="u4r", keys_per_node=8
+        ),
+        "keys_fit_int8": dataclasses.replace(base, keys_per_node=200),
+        "deficit_total_f32_exact": dataclasses.replace(
+            base, n_nodes=2**18, keys_per_node=127
+        ),
+        "churn_free": dataclasses.replace(base, death_rate=0.1),
+        "writes_free": dataclasses.replace(base, writes_per_round=1),
+        "fault_plan_inert": dataclasses.replace(
+            base,
+            fault_plan=FaultPlan(
+                seed=1,
+                links=(
+                    LinkFault(src=NodeSet(frac=(0.0, 0.5)),
+                              dst=NodeSet(frac=(0.5, 1.0)),
+                              drop=1.0),
+                ),
+            ),
+        ),
+    }
+    # The matrix covers every row in the table — a new row without a
+    # violation case here fails the gate's own test.
+    assert set(violations) == {
+        row.feature for row in hostsim.SUPPORT_DOMAIN
+    }
+    for feature, cfg in violations.items():
+        assert not hostsim.supported(cfg), feature
+        assert feature in hostsim.unsupported_features(cfg), feature
+    # The full profile itself is in-domain (round 5's contract).
+    assert hostsim.supported(full)
+
+
+# -- planner claims (the tentpole's acceptance numbers) -----------------------
+
+
+def test_ladder_bytes_per_pair_targets():
+    from aiocluster_tpu.sim.bytes import state_bytes_per_pair
+    from aiocluster_tpu.sim.memory import full_config, lean_config
+
+    # The VERDICT target: shrink full-FD state to 9.125 B/pair.
+    assert state_bytes_per_pair(full_config(1024, rung="shrunk")) == 9.125
+    # The deepest rung goes past it.
+    assert state_bytes_per_pair(full_config(1024, rung="deep")) <= 9.125
+    # Lean ladder: 2 / 1 / 0.5 B/pair.
+    assert state_bytes_per_pair(lean_config(1024)) == 2.0
+    assert state_bytes_per_pair(lean_config(1024, rung="int8")) == 1.0
+    assert state_bytes_per_pair(lean_config(1024, rung="u4r")) == 0.5
+
+
+def test_plan_certifies_100k_full_fd_on_modeled_v5e8():
+    from aiocluster_tpu.sim.memory import full_config, plan
+
+    p = plan(full_config(102_400, rung="deep"), shards=8)
+    assert p.fits()  # 100k-class full-FD on a modeled 16 GiB x 8 mesh
+
+
+def test_lean_rung_max_scale_model_lifts_3x_past_100k():
+    from aiocluster_tpu.sim.memory import ladder_models
+
+    lm = ladder_models()
+    claim = lm["lean_max_scale_claim"]
+    assert claim["max_nodes_model"] >= 100_000
+    assert claim["max_nodes_model"] >= 3 * 32_768
+    # Honesty discipline: every ladder claim is a labelled projection
+    # until the chip calibrates the new execution paths.
+    assert claim["certified"] is False
+    assert lm["full_fd_deepest"]["certified"] is False
+    assert lm["full_fd_deepest"]["meets_target"] is True
+    for rung in lm["lean_single_chip"].values():
+        assert rung["certified"] is False
+
+
+def test_fits_verdict_keys_evidence_by_hosts(tmp_path):
+    from aiocluster_tpu.sim.memory import (
+        fits_verdict,
+        lean_config,
+        record_boundary,
+    )
+
+    path = str(tmp_path / "b.json")
+    cfg = lean_config(12_800, pallas_variant="m8")
+    record_boundary(cfg, 8, False, source="2-host-oom", path=path, hosts=2)
+    v2 = fits_verdict(cfg, shards=8, path=path, hosts=2)
+    assert v2["measured"] is True and v2["fits"] is False
+    # A 2-host OOM says nothing about the single-host spread...
+    v1 = fits_verdict(cfg, shards=8, path=path)
+    assert v1["measured"] is False
+    # ...and legacy single-host entries (no hosts field) still answer
+    # hosts=1 queries.
+    record_boundary(cfg, 8, True, source="1-host", path=path)
+    v1b = fits_verdict(cfg, shards=8, path=path)
+    assert v1b["measured"] is True and v1b["fits"] is True
+
+
+def test_plan_charges_hb0_retention_on_xla_fd_path():
+    """The XLA FD phase retains the round-start heartbeat matrix; the
+    plan must charge it (honesty fix riding the ladder)."""
+    from aiocluster_tpu.sim.memory import plan
+
+    cfg = SimConfig(n_nodes=10_000, version_dtype="int16",
+                    heartbeat_dtype="int16", fd_dtype="bfloat16")
+    n2 = 10_000 * 10_000
+    # gathered (w 2 + hb 2) + retained hb0 (2) = 6 B/pair transient.
+    assert plan(cfg).transient_bytes == 6 * n2

@@ -329,3 +329,90 @@ def check_import_time_jnp(ctx: FileContext):
                     "backend before main() (build constants lazily or "
                     "inside the traced function)",
                 )
+
+
+# -- ACT025: silent widening of packed/narrow state fields --------------------
+#
+# The memory ladder (docs/sim.md) earns its B/pair figures only while
+# the packed/narrow state matrices stay packed in HBM: one stray
+# `state.w.astype(jnp.int32)` materializes the wide matrix and quietly
+# un-earns the rung. Every DELIBERATE widen therefore routes through the
+# sanctioned helpers in sim/packed.py (watermarks_i32, unpack_u4,
+# imean_f32, ...); this rule flags widening conversions applied to the
+# packed-state field NAMES anywhere else in the sim/ops domains.
+
+WIDEN_TARGET_NAMES = {"w", "hb_known", "imean"}
+WIDEN_DTYPES = {"int32", "int64", "float32", "float64"}
+_SANCTIONED_FILE_SUFFIX = "sim/packed.py"
+
+
+def _trailing_name(node: ast.AST) -> str | None:
+    """`state.w` -> "w", `w` -> "w", anything else -> None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_widen_dtype(arg: ast.AST, ctx: FileContext) -> bool:
+    """Whether an astype/constructor argument names one of the wide
+    dtypes (jnp.int32 / np.float32 / "int32" / int). Dtype expressions
+    like `out_ref.dtype` are matching-width copies, not widens."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value in WIDEN_DTYPES
+    d = dotted_name(arg)
+    if d is None:
+        return False
+    tail = d.rsplit(".", 1)[-1]
+    return tail in WIDEN_DTYPES or d in ("int", "float")
+
+
+@rule(
+    "ACT025",
+    "silent-widen-packed-state",
+    "widening conversion on a packed state field outside the sanctioned helpers",
+)
+def check_silent_widen_packed_state(ctx: FileContext):
+    if ctx.tree is None or not ({"sim", "ops"} & ctx.domains):
+        return
+    if ctx.relpath.replace("\\", "/").endswith(_SANCTIONED_FILE_SUFFIX):
+        return  # THE sanctioned widen module
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # Form 1: <target>.astype(<wide dtype>)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+        ):
+            name = _trailing_name(node.func.value)
+            if name in WIDEN_TARGET_NAMES and _is_widen_dtype(
+                node.args[0], ctx
+            ):
+                yield ctx.finding(
+                    node,
+                    "ACT025",
+                    f"'{name}.astype(...)' widens a packed/narrow state "
+                    "field in place — route through the sanctioned "
+                    "helpers in sim/packed.py (watermarks_i32 / "
+                    "imean_f32 / unpack_u4) so the wide form never "
+                    "lands in HBM unaudited",
+                )
+            continue
+        # Form 2: jnp.int32(<target>) / np.float32(<target>)
+        target = ctx.resolve(node.func)
+        if (
+            target is not None
+            and target.rsplit(".", 1)[-1] in WIDEN_DTYPES
+            and len(node.args) == 1
+            and _trailing_name(node.args[0]) in WIDEN_TARGET_NAMES
+        ):
+            yield ctx.finding(
+                node,
+                "ACT025",
+                f"'{target}' promotes packed state field "
+                f"'{_trailing_name(node.args[0])}' — use the sanctioned "
+                "widen helpers in sim/packed.py",
+            )
