@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos sweep-bench kernel-parity multihost-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -35,6 +35,20 @@ analyze:
 chaos:
 	$(PY) -m pytest tests/test_chaos.py -q -m "not slow"
 
+# Byzantine tolerance atlas (benchmarks/byzantine_bench.py,
+# docs/faults.md "byzantine"): the (byz fraction x phi_threshold x
+# fanout) phase map as sweep lanes under ONE compile, written to
+# build/atlas.json — convergence/false-positive phase boundaries per
+# detector operating point. Full grid ~36 lanes at 512 nodes (CPU, a
+# few minutes); the smoke grid (3x3 sheet, 128 nodes, ~30 s) gates CI.
+atlas:
+	mkdir -p build
+	JAX_PLATFORMS=cpu $(PY) benchmarks/byzantine_bench.py --out build/atlas.json
+
+atlas-smoke:
+	mkdir -p build
+	JAX_PLATFORMS=cpu $(PY) benchmarks/byzantine_bench.py --smoke --out build/atlas.json
+
 # Sweep-engine smoke (benchmarks/sweep_bench.py): an 8-lane vmapped
 # sweep must finish the same scenarios in < 0.5x the wall time of 8
 # sequential runs (compile amortization), with per-lane
@@ -61,11 +75,11 @@ multihost-smoke:
 
 # What CI runs; a red suite, dirty lint, new analysis finding, a failed
 # chaos soak, a sweep-amortization regression, a kernel-parity break,
-# or a multihost parity/measurement failure cannot land through this
-# gate. (kernel-parity re-runs one test file that test-all also covers
-# — the explicit target keeps the merge gate for kernel work nameable
-# and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke test-all
+# a multihost parity/measurement failure, or a red byzantine-atlas
+# baseline cannot land through this gate. (kernel-parity re-runs one
+# test file that test-all also covers — the explicit target keeps the
+# merge gate for kernel work nameable and runnable alone.)
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

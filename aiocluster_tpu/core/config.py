@@ -11,8 +11,13 @@ import ssl
 from dataclasses import dataclass, field
 from datetime import timedelta
 
+from typing import TYPE_CHECKING
+
 from ..faults.plan import FaultPlan
 from .identity import Address, NodeId
+
+if TYPE_CHECKING:  # keep core/ numpy-free: models.topology imports numpy
+    from ..models.topology import Heterogeneity
 
 # The reference's default delta MTU (entities.py:105): the cap on one
 # encoded DeltaPb. The number happens to be the classic UDP-payload
@@ -87,3 +92,12 @@ class Config:
     # partitions, crash windows. None (the default) constructs none of
     # it: every path is byte-identical to the fault-free build.
     fault_plan: FaultPlan | None = None
+    # New in aiocluster_tpu: heterogeneity classes
+    # (models/topology.Heterogeneity, docs/faults.md). Cadence classes
+    # scale this node's gossip interval by its class
+    # (``Cluster.effective_gossip_interval``); WAN latency/loss classes
+    # compile to derived LinkFaults appended to the effective fault
+    # plan (one injection machinery for configured and derived faults);
+    # zone_bias biases live-target selection toward the node's own
+    # zone. None (or the all-defaults instance) changes nothing.
+    heterogeneity: "Heterogeneity | None" = None

@@ -1,0 +1,139 @@
+"""Byzantine defense guards for the apply-delta path (docs/faults.md).
+
+ScuttleButt's reconciliation correctness rests on two assumptions a
+hostile fleet violates: each node is the sole writer of its own keyspace
+(van Renesse et al.), and advertised version state is honest. These
+guards re-establish what is *verifiable without signatures* at the
+receiver, as pure self-consistency checks on the inbound
+:class:`~aiocluster_tpu.core.messages.Delta` — no receiver state is
+consulted, so a verdict depends only on the message:
+
+1. **Owner-write guard** — a NodeDelta targeting the RECEIVER'S own
+   node id is rejected whole (kind ``owner_violation``): the receiver
+   is the sole writer of its keyspace, and no honest peer ever sends a
+   node its own state (a peer's digest view of you can never be ahead
+   of you). The ACT03x static invariant, enforced at runtime against
+   remote writers.
+2. **Floor guard** — a key-value at or below the delta's own
+   ``from_version_excluded`` is dropped (kind ``stale_replay``): the
+   delta claims to carry "everything strictly above the floor", so a
+   below-floor entry is self-inconsistent — the stale-version replay
+   shape, whose real payload is the ``max_version`` stamp that would
+   fast-forward the receiver past data it never got.
+3. **Over-stamp guard** — a key-value whose version exceeds the delta's
+   own ``max_version`` stamp is dropped (kind ``owner_violation``): an
+   honest sender's stamp is the highest version it has seen, so carried
+   data past it is fabricated.
+4. **Support guard** — a ``max_version`` fast-forward must be supported
+   by the delta itself: honest senders always satisfy
+   ``max_version <= max(carried key-value versions, last_gc_version)``
+   (every version the owner ever issued is live, tombstoned, or GC'd —
+   the invariant is preserved inductively through apply_delta, including
+   under concurrent handshakes). An unsupported stamp is refused — set
+   to None, the truncated-delta semantics — and counted (kind
+   ``digest_inflation``). A delta that lost ANY key-value to guards 2/3
+   also has its stamp refused (uncounted): fast-forwarding past dropped
+   data would be exactly the poison the attack intends.
+
+Honest traffic is untouched — ``sanitize_delta`` returns the original
+``Delta`` object (and an empty rejection dict) on the clean path, so the
+fault-free hot path allocates nothing. The GossipEngine counts
+rejections in ``aiocluster_byzantine_rejected_total{kind}``; rejection
+units match the injector's (faults/runtime.py): per key-value for floor
+and over-stamp violations and for owner-guard hits (fabricated
+NodeDeltas carry one key-value each), per stamp for support refusals —
+so a test can assert EXACT injected == rejected equality.
+
+A residual surface remains by construction: a fabricator that invents a
+self-consistent future history (stamp raised to match its fabrication)
+is detectable only by the true owner (guard 1). That surface is what
+the tolerance atlas maps (benchmarks/byzantine_bench.py).
+"""
+
+from __future__ import annotations
+
+from .identity import NodeId
+from .messages import Delta, NodeDelta
+
+# Rejection-metric label values (aiocluster_byzantine_rejected_total).
+REJECT_KINDS = ("owner_violation", "stale_replay", "digest_inflation")
+
+
+def _bump(rejections: dict[str, int], kind: str, n: int = 1) -> None:
+    rejections[kind] = rejections.get(kind, 0) + n
+
+
+def sanitize_node_delta(
+    nd: NodeDelta, self_id: NodeId, rejections: dict[str, int]
+) -> NodeDelta | None:
+    """One NodeDelta through the guards: the (possibly rebuilt) delta to
+    apply, or None when nothing survives. ``rejections`` is bumped in
+    place. Returns the ORIGINAL object when clean."""
+    if nd.node_id == self_id:
+        # Guard 1: nobody writes our keyspace but us.
+        _bump(rejections, "owner_violation", max(1, len(nd.key_values)))
+        return None
+    floor = nd.from_version_excluded
+    stamp = nd.max_version
+    kept = []
+    dropped = False
+    for kv in nd.key_values:
+        if kv.version <= floor:
+            _bump(rejections, "stale_replay")
+            dropped = True
+            continue
+        if stamp is not None and kv.version > stamp:
+            _bump(rejections, "owner_violation")
+            dropped = True
+            continue
+        kept.append(kv)
+    new_stamp = stamp
+    if stamp is not None:
+        if dropped:
+            # Data was rejected: fast-forwarding past it would be the
+            # poison itself. Truncated-delta semantics, not counted
+            # (the per-kv rejections above already were).
+            new_stamp = None
+        else:
+            support = max(
+                (kv.version for kv in kept), default=0
+            )
+            support = max(support, nd.last_gc_version)
+            if stamp > support:
+                # Guard 4: the stamp claims versions the delta itself
+                # cannot account for.
+                _bump(rejections, "digest_inflation")
+                new_stamp = None
+    if not dropped and new_stamp == stamp:
+        return nd
+    if not kept and new_stamp is None and nd.last_gc_version == 0:
+        return None  # nothing left to apply
+    return NodeDelta(
+        node_id=nd.node_id,
+        from_version_excluded=nd.from_version_excluded,
+        last_gc_version=nd.last_gc_version,
+        key_values=kept,
+        max_version=new_stamp,
+    )
+
+
+def sanitize_delta(
+    delta: Delta, self_id: NodeId
+) -> tuple[Delta, dict[str, int]]:
+    """The whole inbound delta through the guards: (clean delta,
+    rejection counts by kind). The clean path returns ``delta`` itself
+    and ``{}`` — zero allocation for honest traffic."""
+    rejections: dict[str, int] = {}
+    out: list[NodeDelta] = []
+    dirty = False
+    for nd in delta.node_deltas:
+        clean = sanitize_node_delta(nd, self_id, rejections)
+        if clean is None:
+            dirty = True
+            continue
+        if clean is not nd:
+            dirty = True
+        out.append(clean)
+    if not dirty:
+        return delta, rejections
+    return Delta(node_deltas=out), rejections

@@ -15,14 +15,19 @@
 
 from .plan import (
     ALL_NODES,
+    BYZANTINE_KINDS,
+    ByzantineFault,
     FaultPlan,
     LinkFault,
     NodeCrash,
     NodeSet,
     Partition,
+    with_extra_links,
 )
 from .scenarios import (
     SCENARIOS,
+    byzantine_fraction,
+    byzantine_storm,
     flaky_links,
     rolling_restart,
     round_robin_groups,
@@ -32,15 +37,20 @@ from .scenarios import (
 
 __all__ = (
     "ALL_NODES",
+    "BYZANTINE_KINDS",
+    "ByzantineFault",
     "FaultPlan",
     "LinkFault",
     "NodeCrash",
     "NodeSet",
     "Partition",
     "SCENARIOS",
+    "byzantine_fraction",
+    "byzantine_storm",
     "flaky_links",
     "rolling_restart",
     "round_robin_groups",
     "slow_third",
     "split_brain",
+    "with_extra_links",
 )

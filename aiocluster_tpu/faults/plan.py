@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import json
 import zlib
-from dataclasses import asdict, dataclass, fields
+from dataclasses import asdict, dataclass, fields, replace
 
 
 def _frac_of(name: str) -> float:
@@ -185,6 +185,62 @@ class NodeCrash:
         return self.at <= t < self.at + self.down_for
 
 
+BYZANTINE_KINDS = ("stale_replay", "digest_inflation", "owner_violation")
+
+
+@dataclass(frozen=True, slots=True, eq=True)
+class ByzantineFault:
+    """Wrong-data faults: nodes in ``nodes`` actively lie on the wire
+    while the window is open (versus everything above, which only
+    degrades delivery). The three kinds violate the two assumptions the
+    paper's correctness rests on — each node is the sole writer of its
+    own keyspace (van Renesse et al.), and advertised state is honest:
+
+    - ``stale_replay``: the attacker re-advertises OLD versions for the
+      ``victims``' keys — its digests claim ancient knowledge of them
+      (heartbeat included: stale heartbeat adverts are the phi-accrual
+      attack surface) and its outbound deltas replay below-floor
+      versions while keeping the ``max_version`` stamp, the poison that
+      would fast-forward an unguarded receiver past data it never got.
+    - ``digest_inflation``: the attacker's digests claim ``max_version``
+      for ``victims`` AHEAD of reality by ``amount``, and its outbound
+      delta stamps are inflated the same way — honest responders
+      withhold the victims' data from it (it "already has" everything),
+      and an unguarded receiver of an inflated stamp would skip every
+      future legitimate update below it.
+    - ``owner_violation``: the attacker ships deltas mutating keyspaces
+      it does not own — the ACT03x invariant as a runtime attack:
+      fabricated key-values (version ``amount`` past the stamp) replace
+      its genuine relays for each victim, including deltas that target
+      the receiver's OWN keyspace when it gossips with a victim.
+
+    Defenses land with the kinds (docs/faults.md "byzantine"): the
+    apply-delta path rejects self-keyspace writes, below-floor replays,
+    over-stamp key-values and unsupported ``max_version`` fast-forwards
+    (core/guards.py), counting each in
+    ``aiocluster_byzantine_rejected_total{kind}``; the sim lowers the
+    guarded outcome as per-round masks (faults/sim.py). A combined
+    attack that fabricates a self-consistent future history is
+    detectable only by the true owner — that residual surface is what
+    the tolerance atlas (benchmarks/byzantine_bench.py) maps.
+
+    ``rate`` is the per-message injection probability (runtime) and the
+    per-(src, dst, tick) mask probability (sim). ``amount`` is the
+    version-space offset inflation/fabrication uses.
+    """
+
+    kind: str
+    nodes: NodeSet = ALL_NODES
+    victims: NodeSet = ALL_NODES
+    rate: float = 1.0
+    amount: int = 1 << 20
+    start: float = 0.0
+    end: float | None = None
+
+    def active(self, t: float) -> bool:
+        return t >= self.start and (self.end is None or t < self.end)
+
+
 @dataclass(frozen=True, slots=True, eq=True)
 class FaultPlan:
     """A complete, seeded fault scenario (see module docstring)."""
@@ -193,6 +249,7 @@ class FaultPlan:
     links: tuple[LinkFault, ...] = ()
     partitions: tuple[Partition, ...] = ()
     crashes: tuple[NodeCrash, ...] = ()
+    byzantine: tuple[ByzantineFault, ...] = ()
 
     # -- validation -----------------------------------------------------------
 
@@ -212,6 +269,18 @@ class FaultPlan:
         for cr in self.crashes:
             if cr.down_for <= 0:
                 raise ValueError("NodeCrash.down_for must be > 0")
+        for bf in self.byzantine:
+            if bf.kind not in BYZANTINE_KINDS:
+                raise ValueError(
+                    f"unknown ByzantineFault.kind {bf.kind!r} "
+                    f"(one of {BYZANTINE_KINDS})"
+                )
+            if not 0.0 <= bf.rate <= 1.0:
+                raise ValueError(
+                    f"ByzantineFault.rate must be in [0, 1], got {bf.rate}"
+                )
+            if bf.amount < 1:
+                raise ValueError("ByzantineFault.amount must be >= 1")
 
     def check_sim_compatible(self) -> None:
         """The sim addresses nodes by index fraction only: a plan whose
@@ -221,6 +290,10 @@ class FaultPlan:
         sets = [(lf.src, "LinkFault.src") for lf in self.links]
         sets += [(lf.dst, "LinkFault.dst") for lf in self.links]
         sets += [(cr.nodes, "NodeCrash.nodes") for cr in self.crashes]
+        sets += [(bf.nodes, "ByzantineFault.nodes") for bf in self.byzantine]
+        sets += [
+            (bf.victims, "ByzantineFault.victims") for bf in self.byzantine
+        ]
         for ns, where in sets:
             if ns.names:
                 raise ValueError(
@@ -280,8 +353,29 @@ class FaultPlan:
             crashes=tuple(
                 _load(NodeCrash, d, ("nodes",)) for d in data.get("crashes", ())
             ),
+            byzantine=tuple(
+                _load(ByzantineFault, d, ("nodes", "victims"))
+                for d in data.get("byzantine", ())
+            ),
         )
 
     @classmethod
     def from_json(cls, raw: str) -> "FaultPlan":
         return cls.from_dict(json.loads(raw))
+
+
+def with_extra_links(
+    plan: "FaultPlan | None", links: tuple[LinkFault, ...]
+) -> "FaultPlan | None":
+    """``plan`` with ``links`` appended (a fresh plan when None) — how
+    heterogeneity's WAN classes (models/topology.py) fold into the one
+    fault-injection machinery on both backends. Appending keeps every
+    existing entry's index, so the plan's probabilistic draw streams
+    (keyed per link-fault index) are unchanged for the original links."""
+    if not links:
+        return plan
+    if plan is None:
+        return FaultPlan(links=tuple(links))
+    # dataclasses.replace keeps the copy complete by construction: a
+    # future FaultPlan field cannot be silently dropped here.
+    return replace(plan, links=plan.links + tuple(links))

@@ -303,3 +303,24 @@ class ChaosHarness:
                     kind = key.split("kind=")[1].rstrip("}")
                     totals[kind] = totals.get(kind, 0) + int(value)
         return totals
+
+    def byzantine_counts(self) -> dict[str, dict[str, int]]:
+        """Fleet-wide byzantine accounting: ``injected`` sums the
+        attacker-side ``byz_*`` kinds of
+        ``aiocluster_faults_injected_total``; ``rejected`` sums the
+        receiver-side ``aiocluster_byzantine_rejected_total`` guards by
+        kind. Under a single-kind plan on a loss-free loopback fleet
+        the two sides match EXACTLY (tests/test_byzantine.py)."""
+        injected: dict[str, int] = {}
+        rejected: dict[str, int] = {}
+        for registry in self.registries.values():
+            for key, value in registry.snapshot().items():
+                if key.startswith("aiocluster_faults_injected_total{"):
+                    kind = key.split("kind=")[1].rstrip("}")
+                    if kind.startswith("byz_"):
+                        short = kind[len("byz_"):]
+                        injected[short] = injected.get(short, 0) + int(value)
+                elif key.startswith("aiocluster_byzantine_rejected_total{"):
+                    kind = key.split("kind=")[1].rstrip("}")
+                    rejected[kind] = rejected.get(kind, 0) + int(value)
+        return {"injected": injected, "rejected": rejected}

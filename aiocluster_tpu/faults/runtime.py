@@ -197,6 +197,258 @@ class FaultController:
         if self._injected is not None:
             self._injected.labels(kind).inc()
 
+    # -- byzantine message rewriting (wrong-data faults) ----------------------
+    #
+    # When THIS node matches an active ByzantineFault's attacker set,
+    # its outbound handshake messages are rewritten in flight
+    # (FaultyTransport routes every write through rewrite_packet /
+    # rewrite_syn_bytes). Injection units mirror the receiver guards'
+    # rejection units (core/guards.py) so tests can assert EXACT
+    # injected == rejected equality: per key-value for stale_replay,
+    # per delta stamp for digest_inflation, per fabricated NodeDelta
+    # (one key-value each) for owner_violation. Digest rewrites are
+    # counted separately ("byz_digest_rewrite") — digests are observed,
+    # not rejected.
+
+    def byzantine_active(self, t: float | None = None) -> list:
+        """(index, fault) pairs of byzantine entries whose window is
+        open and whose attacker set matches THIS node."""
+        t = self.elapsed() if t is None else t
+        return [
+            (i, bf)
+            for i, bf in enumerate(self._plan.byzantine)
+            if bf.active(t) and bf.nodes.matches_name(self._self)
+        ]
+
+    def _byz_rate_ok(self, idx: int, bf, dst: str, op: str) -> bool:
+        """Per-message injection draw for entry ``idx`` — same blake2b
+        stream as every other decision (deterministic given the
+        per-link message order; rate=1.0 plans skip the draw and are
+        order-independent)."""
+        if bf.rate >= 1.0:
+            return True
+        key = (dst, f"byz{idx}:{op}")
+        k = self._op_index[key] = self._op_index.get(key, 0) + 1
+        return self._u(dst, f"byz{idx}:{op}", k, "rate") < bf.rate
+
+    def _rewrite_digest(self, digest, active, dst: str):
+        """Apply digest-visible kinds: stale_replay re-advertises
+        ancient knowledge of the victims (heartbeat 1, max_version 0 —
+        the stale heartbeat advert is the phi-accrual attack), and
+        digest_inflation claims their max_versions ``amount`` ahead.
+        Returns the ORIGINAL object when nothing applies (the engine's
+        digest objects are shared caches and must never be mutated)."""
+        from ..core.messages import Digest, NodeDigest
+
+        entries = None
+        for node_id, nd in digest.node_digests.items():
+            replacement = None
+            for idx, bf in active:
+                if not bf.victims.matches_name(node_id.name):
+                    continue
+                if bf.kind == "stale_replay":
+                    if self._byz_rate_ok(idx, bf, dst, "digest"):
+                        replacement = NodeDigest(node_id, 1, 0, 0)
+                elif bf.kind == "digest_inflation":
+                    if self._byz_rate_ok(idx, bf, dst, "digest"):
+                        cur = replacement or nd
+                        replacement = NodeDigest(
+                            node_id,
+                            cur.heartbeat,
+                            cur.last_gc_version,
+                            cur.max_version + bf.amount,
+                        )
+            if replacement is not None:
+                if entries is None:
+                    entries = dict(digest.node_digests)
+                entries[node_id] = replacement
+                self._count("byz_digest_rewrite")
+        if entries is None:
+            return digest
+        return Digest(entries)
+
+    def _rewrite_delta(self, delta, active, dst: str, digest=None):
+        """Apply delta-visible kinds to an outbound delta (original
+        object when nothing applies — delta parts may be shared):
+
+        - stale_replay: victims' key-values replayed at the delta's own
+          floor (below-floor — guard 2 rejects each), stamp kept: the
+          poison is the fast-forward past data never delivered.
+        - digest_inflation: victims' ``max_version`` stamps inflated by
+          ``amount`` (guard 4 refuses each); genuine key-values ride
+          along untouched.
+        - owner_violation: each victim NodeDelta's key-values replaced
+          by ONE fabricated entry ``amount`` past the stamp (guard 3 —
+          or guard 1 when the receiver IS the victim); a truncated
+          relay's None stamp is pinned to the delta's floor so guard 3
+          keeps a bound to catch the fabrication against. With a digest
+          in hand (SynAck), victims the delta did not mention get a
+          fabricated NodeDelta appended — including the receiver's own
+          keyspace when it is a victim, the ACT03x attack proper. The
+          attacker never fabricates its OWN keyspace (it owns it).
+        """
+        from ..core.messages import (
+            Delta, KeyValueUpdate, NodeDelta,
+        )
+        from ..core.values import KeyStatus
+
+        out = []
+        dirty = False
+        for nd in delta.node_deltas:
+            cur = nd
+            for idx, bf in active:
+                if not bf.victims.matches_name(nd.node_id.name):
+                    continue
+                if bf.kind == "stale_replay":
+                    if cur.key_values and self._byz_rate_ok(
+                        idx, bf, dst, "delta"
+                    ):
+                        floor = cur.from_version_excluded
+                        cur = NodeDelta(
+                            node_id=cur.node_id,
+                            from_version_excluded=floor,
+                            last_gc_version=cur.last_gc_version,
+                            key_values=[
+                                KeyValueUpdate(
+                                    kv.key, kv.value, floor, kv.status
+                                )
+                                for kv in cur.key_values
+                            ],
+                            max_version=cur.max_version,
+                        )
+                        for _ in cur.key_values:
+                            self._count("byz_stale_replay")
+                elif bf.kind == "digest_inflation":
+                    if cur.max_version is not None and self._byz_rate_ok(
+                        idx, bf, dst, "delta"
+                    ):
+                        cur = NodeDelta(
+                            node_id=cur.node_id,
+                            from_version_excluded=cur.from_version_excluded,
+                            last_gc_version=cur.last_gc_version,
+                            key_values=list(cur.key_values),
+                            max_version=cur.max_version + bf.amount,
+                        )
+                        self._count("byz_digest_inflation")
+                elif bf.kind == "owner_violation":
+                    if nd.node_id.name == self._self:
+                        continue  # we own our keyspace: not a violation
+                    if self._byz_rate_ok(idx, bf, dst, "delta"):
+                        stamp = cur.max_version
+                        base = (
+                            stamp
+                            if stamp is not None
+                            else cur.from_version_excluded
+                        )
+                        # The fabricated stamp is pinned to ``base``
+                        # (not the original, possibly-None stamp): a
+                        # truncated relay's stamp-less delta would
+                        # otherwise carry the fabrication PAST guard 3's
+                        # reach — a self-consistent future history, the
+                        # documented residual surface, not the pure kind
+                        # this injector pins injected == rejected for.
+                        cur = NodeDelta(
+                            node_id=cur.node_id,
+                            from_version_excluded=cur.from_version_excluded,
+                            last_gc_version=cur.last_gc_version,
+                            key_values=[
+                                KeyValueUpdate(
+                                    "byz", "byzantine", base + bf.amount,
+                                    KeyStatus.SET,
+                                )
+                            ],
+                            max_version=base,
+                        )
+                        self._count("byz_owner_violation")
+            if cur is not nd:
+                dirty = True
+            out.append(cur)
+        if digest is not None:
+            # SynAck: fabricate for victims the delta did not cover —
+            # the receiver's own keyspace included, when it matches.
+            present = {nd.node_id for nd in out}
+            for node_id, dg in digest.node_digests.items():
+                if node_id in present or node_id.name == self._self:
+                    continue
+                for idx, bf in active:
+                    if bf.kind != "owner_violation":
+                        continue
+                    if not bf.victims.matches_name(node_id.name):
+                        continue
+                    if not self._byz_rate_ok(idx, bf, dst, "delta"):
+                        continue
+                    out.append(
+                        NodeDelta(
+                            node_id=node_id,
+                            from_version_excluded=dg.max_version,
+                            last_gc_version=dg.last_gc_version,
+                            key_values=[
+                                KeyValueUpdate(
+                                    "byz", "byzantine",
+                                    dg.max_version + bf.amount,
+                                    KeyStatus.SET,
+                                )
+                            ],
+                            max_version=dg.max_version,
+                        )
+                    )
+                    dirty = True
+                    self._count("byz_owner_violation")
+                    break
+        if not dirty:
+            return delta
+        return Delta(node_deltas=out)
+
+    def rewrite_packet(self, packet, dst: str | None):
+        """Outbound handshake packet through the active byzantine
+        kinds. Returns the ORIGINAL packet when this node is honest (or
+        no window is open) — the fault-free path stays byte-identical.
+        ``dst`` may be None for responder-side writes (the inbound peer
+        is unlabelled before its first Syn resolves); the draw stream
+        then keys on "?" — rate < 1 responder schedules are
+        deterministic given a deterministic arrival order."""
+        from ..core.messages import Ack, Packet, Syn, SynAck
+
+        active = self.byzantine_active()
+        if not active:
+            return packet
+        dst = dst or "?"
+        msg = packet.msg
+        if isinstance(msg, Syn):
+            dg = self._rewrite_digest(msg.digest, active, dst)
+            if dg is msg.digest:
+                return packet
+            return Packet(packet.cluster_id, Syn(dg))
+        if isinstance(msg, SynAck):
+            dg = self._rewrite_digest(msg.digest, active, dst)
+            dl = self._rewrite_delta(
+                msg.delta, active, dst, digest=msg.digest
+            )
+            if dg is msg.digest and dl is msg.delta:
+                return packet
+            return Packet(packet.cluster_id, SynAck(dg, dl))
+        if isinstance(msg, Ack):
+            dl = self._rewrite_delta(msg.delta, active, dst)
+            if dl is msg.delta:
+                return packet
+            return Packet(packet.cluster_id, Ack(dl))
+        return packet
+
+    def rewrite_syn_bytes(self, payload: bytes, dst: str | None) -> bytes:
+        """The pre-encoded Syn fast path (GossipEngine.make_syn_bytes):
+        decode, rewrite, re-encode — only when a byzantine window is
+        actually open for this node; honest bytes pass through
+        untouched."""
+        if not self.byzantine_active():
+            return payload
+        from ..wire import decode_packet, encode_packet
+
+        packet = decode_packet(payload)
+        rewritten = self.rewrite_packet(packet, dst)
+        if rewritten is packet:
+            return payload
+        return encode_packet(rewritten)
+
     def apply(self, dst: str, op: str) -> Decision:
         """Decide, count, and raise injected failures (as the exception
         the real network would produce). Returns the Decision; the
@@ -283,6 +535,12 @@ class FaultyTransport:
 
     async def write_packet(self, writer, packet) -> None:
         label = self._peer_of.get(writer)
+        # Byzantine rewriting applies to EVERY outbound packet this
+        # node writes — including the responder role's SynAck on
+        # connections it did not dial (label None there: the initiator
+        # fault ops below stay initiator-side, but an attacker lies in
+        # both roles).
+        packet = self._ctl.rewrite_packet(packet, label)
         if label is None:
             return await self._inner.write_packet(writer, packet)
         d = self._ctl.apply(label, "write")
@@ -296,6 +554,11 @@ class FaultyTransport:
 
     async def write_framed(self, writer, payload: bytes, kind: str) -> None:
         label = self._peer_of.get(writer)
+        if kind == "syn":
+            # The engine's pre-encoded Syn bytes: a byzantine window
+            # rewrites the digest in flight (decode/re-encode only
+            # while a window is actually open).
+            payload = self._ctl.rewrite_syn_bytes(payload, label)
         if label is None:
             return await self._inner.write_framed(writer, payload, kind)
         d = self._ctl.apply(label, "write")

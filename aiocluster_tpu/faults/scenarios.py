@@ -12,7 +12,15 @@ the sim (the reference's 1 s interval makes them coincide).
 
 from __future__ import annotations
 
-from .plan import ALL_NODES, FaultPlan, LinkFault, NodeCrash, NodeSet, Partition
+from .plan import (
+    ALL_NODES,
+    ByzantineFault,
+    FaultPlan,
+    LinkFault,
+    NodeCrash,
+    NodeSet,
+    Partition,
+)
 
 
 def split_brain(
@@ -118,11 +126,74 @@ def slow_third(
     )
 
 
+def byzantine_fraction(
+    kind: str = "stale_replay",
+    frac: float = 0.25,
+    *,
+    victims: NodeSet = ALL_NODES,
+    rate: float = 1.0,
+    amount: int = 1 << 20,
+    start: float = 0.0,
+    end: float | None = None,
+    seed: int = 0,
+) -> FaultPlan:
+    """The first index-fraction ``frac`` of the cluster turns byzantine
+    with one wrong-data ``kind`` (docs/faults.md "byzantine") — the
+    attacker window [0, frac) is exactly what a ``byz_frac`` sweep lane
+    overrides, so this is the tolerance atlas's base plan
+    (benchmarks/byzantine_bench.py)."""
+    return FaultPlan(
+        seed=seed,
+        byzantine=(
+            ByzantineFault(
+                kind=kind,
+                nodes=NodeSet(frac=(0.0, frac)),
+                victims=victims,
+                rate=rate,
+                amount=amount,
+                start=start,
+                end=end,
+            ),
+        ),
+    )
+
+
+def byzantine_storm(
+    frac: float = 0.25,
+    *,
+    victims: NodeSet = ALL_NODES,
+    start: float = 0.0,
+    end: float | None = None,
+    seed: int = 0,
+) -> FaultPlan:
+    """All three byzantine kinds at once from the same attacker
+    fraction — the composite worst case the defense guards and the
+    atlas are exercised against."""
+    attackers = NodeSet(frac=(0.0, frac))
+    return FaultPlan(
+        seed=seed,
+        byzantine=tuple(
+            ByzantineFault(
+                kind=kind,
+                nodes=attackers,
+                victims=victims,
+                start=start,
+                end=end,
+            )
+            for kind in (
+                "stale_replay", "digest_inflation", "owner_violation"
+            )
+        ),
+    )
+
+
 SCENARIOS = {
     "split_brain": split_brain,
     "flaky_links": flaky_links,
     "rolling_restart": rolling_restart,
     "slow_third": slow_third,
+    "byzantine_fraction": byzantine_fraction,
+    "byzantine_storm": byzantine_storm,
 }
 
 

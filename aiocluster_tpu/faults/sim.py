@@ -182,3 +182,217 @@ def plan_affects_links(plan: FaultPlan | None) -> bool:
 
 def plan_affects_nodes(plan: FaultPlan | None) -> bool:
     return plan is not None and bool(plan.crashes)
+
+
+def plan_affects_byzantine(plan: FaultPlan | None) -> bool:
+    return plan is not None and any(bf.rate > 0.0 for bf in plan.byzantine)
+
+
+# -- byzantine lowering (defense-on semantics) --------------------------------
+#
+# The sim has no way to store a lie — its watermark matrix IS the truth
+# — so byzantine kinds lower as the GUARDED outcome the runtime's
+# apply-delta defenses produce (core/guards.py; docs/faults.md):
+#
+# - stale_replay / owner_violation destroy the attacker's adverts for
+#   the victims' keyspaces (replayed below-floor versions and fabricated
+#   over-stamp key-values are rejected at every receiver): advances
+#   PULLED FROM an attacker on victim owner-columns are zeroed
+#   (byz_out_block). stale_replay additionally re-advertises stale
+#   heartbeats, so heartbeat absorption from the attacker is masked on
+#   the same columns (byz_hb_block) — the phi-accrual attack surface.
+#   owner_violation never blocks the attacker's OWN column (it owns it;
+#   its self-keyspace adverts stay genuine); stale_replay does when the
+#   victims set matches it (it can lie about itself).
+# - digest_inflation starves the attacker: honest responders withhold
+#   the victims' data from a peer whose digest already claims it, so
+#   advances INTO an attacker row on victim columns are zeroed
+#   (byz_in_block). The inflated delta stamps it ships are refused by
+#   the receivers' support-invariant guard, so nothing else changes.
+#
+# All masks are pure functions of (plan, tick, GLOBAL indices) via the
+# shared multiplicative hash — shard-exact, PRNG-independent; ``seed``
+# (the sweep's per-lane fault salt) and ``byz_frac`` (a traced attacker
+# fraction overriding every entry's ``nodes`` window with [0, frac))
+# reproduce ``replace(plan, ...)`` tick-for-tick under one compile.
+
+# Disjoint draw-stream id base so byzantine rate draws never collide
+# with link-fault draws of the same plan (both feed _fault_salt).
+_BYZ_SALT_BASE = 0x10000
+
+
+def _byz_attackers(
+    bf, idx: jax.Array, n: int, byz_frac: jax.Array | None
+) -> jax.Array:
+    """(len(idx),) bool: which global indices attack under this entry.
+    ``byz_frac`` (traced f32) overrides the entry's ``nodes`` window
+    with [0, byz_frac) — the sweepable attacker fraction."""
+    if byz_frac is not None:
+        return idx.astype(jnp.float32) / n < byz_frac
+    m = _member_mask(bf.nodes, idx, n)
+    return jnp.ones(idx.shape, bool) if m is None else m
+
+
+def _byz_pair_mask(
+    plan: FaultPlan,
+    bf_idx: int,
+    bf,
+    n: int,
+    tick: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    sub,
+    seed: jax.Array | None,
+    byz_frac: jax.Array | None,
+) -> jax.Array:
+    """(N,) bool: entry ``bf`` applies to the directed pair
+    src[i] -> dst[i] this tick (window, attacker membership, rate)."""
+    t = tick.astype(jnp.float32)
+    end = jnp.inf if bf.end is None else bf.end
+    hit = (t >= bf.start) & (t < end)
+    hit = hit & _byz_attackers(bf, src, n, byz_frac)
+    if bf.rate < 1.0:
+        u = _pair_uniform(
+            src, dst, _fault_salt(plan, tick, _BYZ_SALT_BASE + bf_idx, sub, seed)
+        )
+        hit = hit & (u < bf.rate)
+    return hit
+
+
+def _byz_block(
+    plan: FaultPlan,
+    kinds: tuple[str, ...],
+    n: int,
+    tick: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    owners: jax.Array,
+    sub,
+    seed: jax.Array | None,
+    byz_frac: jax.Array | None,
+    exclude_own_col_kinds: tuple[str, ...] = (),
+) -> jax.Array | None:
+    """(N, n_local) bool: owner-columns of the src[i] -> dst[i] transfer
+    voided by the named byzantine kinds (None = nothing applies)."""
+    block = None
+    for bf_idx, bf in enumerate(plan.byzantine):
+        if bf.kind not in kinds or bf.rate <= 0.0:
+            continue
+        pair = _byz_pair_mask(
+            plan, bf_idx, bf, n, tick, src, dst, sub, seed, byz_frac
+        )
+        vic = _member_mask(bf.victims, owners, n)
+        b = pair[:, None] & (
+            jnp.ones((owners.shape[0],), bool)[None, :]
+            if vic is None
+            else vic[None, :]
+        )
+        if bf.kind in exclude_own_col_kinds:
+            # The attacker owns its own column — adverts for it are
+            # genuine, so the block never applies there.
+            b = b & (owners[None, :] != src[:, None])
+        block = b if block is None else block | b
+    return block
+
+
+def byz_out_block(
+    plan: FaultPlan,
+    n: int,
+    tick: jax.Array,
+    peer: jax.Array,
+    owners: jax.Array,
+    sub,
+    *,
+    seed: jax.Array | None = None,
+    byz_frac: jax.Array | None = None,
+) -> jax.Array | None:
+    """Advances pulled FROM peer[i] (the sender) on owner column j that
+    the receiver's guards reject — stale_replay + owner_violation."""
+    rows = jnp.arange(peer.shape[0], dtype=jnp.int32)
+    return _byz_block(
+        plan,
+        ("stale_replay", "owner_violation"),
+        n, tick, peer, rows, owners, sub, seed, byz_frac,
+        exclude_own_col_kinds=("owner_violation",),
+    )
+
+
+def byz_hb_block(
+    plan: FaultPlan,
+    n: int,
+    tick: jax.Array,
+    peer: jax.Array,
+    owners: jax.Array,
+    sub,
+    *,
+    seed: jax.Array | None = None,
+    byz_frac: jax.Array | None = None,
+) -> jax.Array | None:
+    """Heartbeat knowledge absorbed from peer[i] on victim columns that
+    the attacker's stale digests withhold — stale_replay only."""
+    rows = jnp.arange(peer.shape[0], dtype=jnp.int32)
+    return _byz_block(
+        plan, ("stale_replay",), n, tick, peer, rows, owners, sub, seed,
+        byz_frac,
+    )
+
+
+def byz_in_block(
+    plan: FaultPlan,
+    n: int,
+    tick: jax.Array,
+    owners: jax.Array,
+    *,
+    seed: jax.Array | None = None,
+    byz_frac: jax.Array | None = None,
+) -> jax.Array | None:
+    """Advances INTO attacker row i on victim column j that honest
+    responders withhold (the attacker's digest already claims them) —
+    digest_inflation. Receiver-side, so it is peer-independent: one
+    mask per round, ANDed into every pull."""
+    rows = jnp.arange(n, dtype=jnp.int32)
+    return _byz_block(
+        plan, ("digest_inflation",), n, tick, rows, rows, owners, 0, seed,
+        byz_frac,
+    )
+
+
+# -- heterogeneity lowering ---------------------------------------------------
+#
+# Heterogeneity (models/topology.Heterogeneity) rides the same mask
+# machinery: WAN latency/loss classes compile to derived LinkFaults
+# appended to the effective plan (effective_fault_plan), and cadence
+# classes lower to a per-tick initiator mask folded into sub-exchange
+# validity. Zone-aware peer bias is lowered inside select_peers
+# (ops/gossip.py) — it shapes the draw, not the mask.
+
+
+def effective_fault_plan(
+    plan: FaultPlan | None, heterogeneity
+) -> FaultPlan | None:
+    """The plan the sim actually injects: the configured plan plus the
+    heterogeneity model's derived WAN LinkFaults (None when neither
+    contributes). Static — evaluated at trace time off the config."""
+    from .plan import with_extra_links
+
+    if heterogeneity is None:
+        return plan
+    return with_extra_links(plan, heterogeneity.wan_link_faults())
+
+
+def cadence_on(heterogeneity, n: int, tick: jax.Array) -> jax.Array:
+    """(N,) bool: nodes whose cadence class initiates gossip this tick
+    (class-k nodes fire when tick % gossip_every[k] == 0). A pure
+    function of (tick, global index) — shard-exact like every mask
+    here."""
+    pos = jnp.arange(n, dtype=jnp.float32) / n
+    on = jnp.zeros((n,), bool)
+    cum = 0.0
+    for k, frac in enumerate(heterogeneity.class_frac):
+        lo, cum = cum, cum + frac
+        period = int(heterogeneity.gossip_every[k])
+        fires = (tick % period) == 0
+        member = (pos >= lo) & (pos < cum if k < len(
+            heterogeneity.class_frac) - 1 else jnp.ones((n,), bool))
+        on = on | (member & fires)
+    return on

@@ -1,7 +1,10 @@
 """Cluster topology models for the simulator (ring, small-world,
-scale-free, hierarchical racks, full)."""
+scale-free, hierarchical racks, full) plus the heterogeneity classes
+(per-node gossip cadence, WAN latency/loss zones, zone-aware bias)
+shared by both backends."""
 
-from .topology import (Topology, hierarchical, ring, scale_free,
-                       small_world)
+from .topology import (Heterogeneity, Topology, hierarchical, ring,
+                       scale_free, small_world)
 
-__all__ = ("Topology", "hierarchical", "ring", "scale_free", "small_world")
+__all__ = ("Heterogeneity", "Topology", "hierarchical", "ring",
+           "scale_free", "small_world")

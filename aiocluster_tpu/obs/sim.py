@@ -37,6 +37,11 @@ _SAMPLE_GAUGES = (
     ("aiocluster_sim_converged_owners", "Owners fully replicated to all alive nodes"),
     ("aiocluster_sim_alive_nodes", "Nodes currently alive in the simulation"),
     ("aiocluster_sim_version_spread", "Worst key-version lag over alive pairs"),
+    (
+        "aiocluster_sim_fd_false_positive_fraction",
+        "Alive off-diagonal pairs the observer believes dead "
+        "(FD liveness quality; present when the FD is tracked)",
+    ),
 )
 
 
@@ -213,6 +218,10 @@ class SimMetrics:
                 ("converged_owners", "aiocluster_sim_converged_owners"),
                 ("alive_count", "aiocluster_sim_alive_nodes"),
                 ("version_spread", "aiocluster_sim_version_spread"),
+                (
+                    "fd_false_positive_fraction",
+                    "aiocluster_sim_fd_false_positive_fraction",
+                ),
             ):
                 if short in last:
                     self._gauges[gauge].set(last[short])

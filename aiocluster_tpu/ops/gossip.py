@@ -421,9 +421,41 @@ def select_peers(
         # (categorical materializes (n, fanout, n) noise: ~3.2e9
         # samples at 32k — minutes per round on a CPU host, and wasted
         # HBM traffic on chip).
-        return random.randint(key, (n, cfg.fanout), 0, n)
-    logits = jnp.where(alive, 0.0, NEG_INF)
-    return random.categorical(key, logits, shape=(n, cfg.fanout))
+        peers = random.randint(key, (n, cfg.fanout), 0, n)
+    else:
+        logits = jnp.where(alive, 0.0, NEG_INF)
+        peers = random.categorical(key, logits, shape=(n, cfg.fanout))
+    return _zone_biased(peers, key, cfg)
+
+
+def _zone_biased(
+    peers: jax.Array, key: jax.Array, cfg: SimConfig
+) -> jax.Array:
+    """Zone-aware peer bias (models/topology.Heterogeneity): with
+    probability ``zone_bias`` a draw is replaced by a uniform pick from
+    the node's OWN zone (contiguous coordinate blocks — the same
+    bucketing the partition masks use). A biased pick may land on a
+    dead node or the node itself: a no-op exchange, standing in for the
+    reference's failed connection exactly like the unbiased draw's
+    self-picks. Unchanged (same object) when the config carries no
+    bias."""
+    het = cfg.heterogeneity
+    if het is None or het.zone_bias <= 0.0:
+        return peers
+    import numpy as np
+
+    n, fanout = peers.shape
+    z = (np.arange(n) * het.zones) // n
+    starts = np.searchsorted(z, np.arange(het.zones))
+    counts = np.bincount(z, minlength=het.zones)
+    zstart = jnp.asarray(starts[z], jnp.int32)  # (N,) own-zone first index
+    zcount = jnp.asarray(counts[z], jnp.int32)  # (N,) own-zone size
+    kz, kb = random.split(random.fold_in(key, 0x5A))
+    local = zstart[:, None] + random.randint(
+        kz, (n, fanout), 0, zcount[:, None]
+    )
+    biased = random.bernoulli(kb, het.zone_bias, (n, fanout))
+    return jnp.where(biased, local, peers)
 
 
 def scheduled_for_deletion_mask(
@@ -460,14 +492,26 @@ def _lifecycle_enabled(cfg: SimConfig) -> bool:
 
 
 def _fault_plan_active(cfg: SimConfig) -> bool:
-    """Whether the config's fault plan carries ANY behavior the masks
-    would have to inject — the predicate sim_step itself branches on,
-    so a no-op plan (empty, or all-zero probabilities) costs nothing
-    and keeps the fused-kernel fast paths engaged."""
-    from ..faults.sim import plan_affects_links, plan_affects_nodes
+    """Whether the config's EFFECTIVE fault plan — the configured plan
+    plus heterogeneity's derived WAN LinkFaults — carries ANY behavior
+    the masks would have to inject (link, crash or byzantine): the
+    predicate sim_step itself branches on, so a no-op plan (empty, or
+    all-zero probabilities) costs nothing and keeps the fused-kernel
+    fast paths engaged. Cadence classes are deliberately NOT in this
+    predicate: they fold into pair validity, which the kernels carry
+    natively."""
+    from ..faults.sim import (
+        effective_fault_plan,
+        plan_affects_byzantine,
+        plan_affects_links,
+        plan_affects_nodes,
+    )
 
-    return plan_affects_links(cfg.fault_plan) or plan_affects_nodes(
-        cfg.fault_plan
+    plan = effective_fault_plan(cfg.fault_plan, cfg.heterogeneity)
+    return (
+        plan_affects_links(plan)
+        or plan_affects_nodes(plan)
+        or plan_affects_byzantine(plan)
     )
 
 
@@ -907,14 +951,26 @@ def sim_step(
         revives = random.bernoulli(rk, cfg.revival_rate, (n,))
         alive = jnp.where(alive, ~dies, revives)
 
-    # -- fault plan (docs/faults.md) -----------------------------------------
+    # -- fault plan + heterogeneity (docs/faults.md) -------------------------
     # Crash windows override EFFECTIVE liveness for the round — the
     # node's process isn't running, so its heartbeat/writes freeze and
     # its exchanges no-op — without touching the churn ground truth
     # (state.alive), so the window's end is the restart. Link faults
-    # lower to per-direction masks ANDed into exchange validity below.
-    plan = cfg.fault_plan
-    from ..faults.sim import link_ok, plan_affects_links, plan_affects_nodes
+    # (including heterogeneity's derived WAN class faults) lower to
+    # per-direction masks ANDed into exchange validity below; byzantine
+    # kinds lower to owner-column blocks (the guarded-defense outcome —
+    # faults/sim.py); cadence classes lower to a per-tick initiator
+    # mask folded into pair validity.
+    from ..faults.sim import (
+        effective_fault_plan,
+        link_ok,
+        plan_affects_byzantine,
+        plan_affects_links,
+        plan_affects_nodes,
+    )
+
+    het = cfg.heterogeneity
+    plan = effective_fault_plan(cfg.fault_plan, het)
 
     eff_alive = alive
     if plan_affects_nodes(plan):
@@ -922,6 +978,19 @@ def sim_step(
 
         eff_alive = alive & ~crash_mask(plan, n, tick)
     faulty_links = plan_affects_links(plan)
+    byz_active = plan_affects_byzantine(plan)
+    sw_byz = None if sweep is None else sweep.byz_frac
+    if sw_byz is not None and not (plan is not None and plan.byzantine):
+        raise ValueError(
+            "byz_frac sweep lanes require a fault plan with byzantine "
+            "entries (the lane value overrides their attacker windows)"
+        )
+
+    cad = None
+    if het is not None and het.cadence_effective():
+        from ..faults.sim import cadence_on
+
+        cad = cadence_on(het, n, tick)
 
     def fault_ok(src: jax.Array, dst: jax.Array, sub) -> jax.Array | None:
         """(N,) permit mask for traffic src[i] -> dst[i] this round, or
@@ -932,6 +1001,45 @@ def sim_step(
         if not faulty_links:
             return None
         return link_ok(plan, n, tick, src, dst, sub, seed=sw_fault_seed)
+
+    # Receiver-side byzantine block (digest_inflation starves the
+    # attacker) — peer-independent, so one mask serves the whole round.
+    byz_in = None
+    if byz_active:
+        from ..faults.sim import byz_in_block
+
+        byz_in = byz_in_block(
+            plan, n, tick, owners, seed=sw_fault_seed, byz_frac=sw_byz
+        )
+
+    def byz_pull_block(peer: jax.Array, sub) -> jax.Array | None:
+        """(N, n_local) owner-columns of this pull whose advances the
+        receiver's guards reject (sender-side stale_replay /
+        owner_violation plus the receiver-side inflation starvation),
+        or None without byzantine behavior."""
+        if not byz_active:
+            return byz_in
+        from ..faults.sim import byz_out_block
+
+        ob = byz_out_block(
+            plan, n, tick, peer, owners, sub,
+            seed=sw_fault_seed, byz_frac=sw_byz,
+        )
+        if ob is None:
+            return byz_in
+        return ob if byz_in is None else ob | byz_in
+
+    def byz_hb_mask(peer: jax.Array, sub) -> jax.Array | None:
+        """Heartbeat-absorption block for this pull (stale_replay's
+        stale digest adverts), or None."""
+        if not byz_active:
+            return None
+        from ..faults.sim import byz_hb_block
+
+        return byz_hb_block(
+            plan, n, tick, peer, owners, sub,
+            seed=sw_fault_seed, byz_frac=sw_byz,
+        )
 
     # -- owner-side activity: heartbeat tick + workload writes ---------------
     wpr = cfg.writes_per_round if sw_wpr is None else sw_wpr
@@ -1028,17 +1136,24 @@ def sim_step(
 
     rows = jnp.arange(n, dtype=jnp.int32)
 
-    def peer_adv(w, peer, salt, active=None):
+    def peer_adv(w, peer, salt, active=None, pair_ok=None):
         """The budgeted watermark advance of each row toward its peer row
         (one handshake direction), masked to alive pairs, to the fault
-        plan's link permits (traffic peer -> row), and to owner columns
-        the sender has not scheduled for deletion. ``active`` (scalar
-        bool) voids the whole sub-exchange — how a lane whose swept
-        fanout is below the static bound skips its excess
-        sub-exchanges."""
+        plan's link permits (traffic peer -> row), to cadence (an
+        off-cadence pair skips the round), to owner columns the sender
+        has not scheduled for deletion, and to the byzantine guard
+        blocks (rejected poison advances nothing — but the budget was
+        spent negotiating for it, so blocked columns still consume
+        their share, exactly like a runtime MTU wasted on rejected
+        key-values). ``active`` (scalar bool) voids the whole
+        sub-exchange — how a lane whose swept fanout is below the
+        static bound skips its excess sub-exchanges; ``pair_ok`` (N,)
+        is the cadence gate."""
         valid = eff_alive & eff_alive[peer]
         if active is not None:
             valid = valid & active
+        if pair_ok is not None:
+            valid = valid & pair_ok
         f_ok = fault_ok(peer, rows, salt)
         if f_ok is not None:
             valid = valid & f_ok
@@ -1047,17 +1162,22 @@ def sim_step(
             cfg.budget_policy, salt, owners, run_salt,
             col_ok=None if sched is None else ~sched[peer, :],
         )
+        blk = byz_pull_block(peer, salt)
+        if blk is not None:
+            adv = jnp.where(blk, 0, adv)
         return adv, valid
 
-    def packed_peer_adv(r, peer, salt, active=None):
+    def packed_peer_adv(r, peer, salt, active=None, pair_ok=None):
         """peer_adv for the packed u4 residual rung: gathers the PEER'S
         PACKED rows (0.5 B/pair — the only per-sub-exchange HBM
         transient) and computes the budgeted advance on the nibbles.
-        The lifecycle's column mask never applies (the config excludes
-        it from this rung)."""
+        The lifecycle's column mask never applies, and neither do the
+        byzantine blocks (the config excludes both from this rung)."""
         valid = eff_alive & eff_alive[peer]
         if active is not None:
             valid = valid & active
+        if pair_ok is not None:
+            valid = valid & pair_ok
         f_ok = fault_ok(peer, rows, salt)
         if f_ok is not None:
             valid = valid & f_ok
@@ -1067,10 +1187,14 @@ def sim_step(
         )
         return a_lo, a_hi, valid
 
-    def hb_absorb(hb, peer, valid):
+    def hb_absorb(hb, peer, valid, salt=None):
         ok = valid[:, None]
         if sched is not None:
             ok = ok & ~sched[peer, :]
+        if salt is not None:
+            hblk = byz_hb_mask(peer, salt)
+            if hblk is not None:
+                ok = ok & ~hblk
         return jnp.maximum(hb, jnp.where(ok, hb[peer, :], 0))
 
     def sub_salt(c: int, direction: int) -> jax.Array:
@@ -1143,6 +1267,12 @@ def sim_step(
                 first = c == 0
                 last = c == cfg.fanout - 1
                 valid_pair = eff_alive & eff_alive[p]
+                if cad is not None:
+                    # Cadence gate: a matched pair exchanges when either
+                    # side is on-cadence this tick (the quiet side still
+                    # responds). Folds into the kernel's validity mask,
+                    # so cadence classes keep the fused path engaged.
+                    valid_pair = valid_pair & (cad | cad[p])
                 # A lane sweeping fanout below the static bound voids
                 # its excess sub-exchanges by zeroing the alive-pair
                 # mask — the kernel then writes identical tiles back
@@ -1282,35 +1412,51 @@ def sim_step(
                     )
                     w, hb = pulled if track_hb else (pulled, hb)
             elif dual:
+                # Cadence: the i -> p[i] handshake is INITIATED by row i,
+                # the inverse pull belongs to the handshake initiated by
+                # inv[i] — each direction is gated by its initiator's
+                # cadence (responders always serve).
+                cad_p = cad
+                cad_i = None if cad is None else cad[inv]
                 if packed:
                     pl, ph, valid_p = packed_peer_adv(
-                        w, p, sub_salt(c, 0), sub_active(c)
+                        w, p, sub_salt(c, 0), sub_active(c), cad_p
                     )
                     il, ih, valid_i = packed_peer_adv(
-                        w, inv, sub_salt(c, 1), sub_active(c)
+                        w, inv, sub_salt(c, 1), sub_active(c), cad_i
                     )
                     w = _packed_apply(
                         w, jnp.maximum(pl, il), jnp.maximum(ph, ih)
                     )
                 else:
-                    adv_p, valid_p = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
-                    adv_i, valid_i = peer_adv(w, inv, sub_salt(c, 1), sub_active(c))
+                    adv_p, valid_p = peer_adv(
+                        w, p, sub_salt(c, 0), sub_active(c), cad_p
+                    )
+                    adv_i, valid_i = peer_adv(
+                        w, inv, sub_salt(c, 1), sub_active(c), cad_i
+                    )
                     w = w + jnp.maximum(adv_p, adv_i)
                 if track_hb:
                     hb = jnp.maximum(
-                        hb_absorb(hb, p, valid_p), hb_absorb(hb, inv, valid_i)
+                        hb_absorb(hb, p, valid_p, sub_salt(c, 0)),
+                        hb_absorb(hb, inv, valid_i, sub_salt(c, 1)),
                     )
             else:
+                # Matching: one bidirectional handshake per pair — it
+                # runs when either side is on-cadence.
+                cad_pair = None if cad is None else cad | cad[p]
                 if packed:
                     a_lo, a_hi, valid = packed_peer_adv(
-                        w, p, sub_salt(c, 0), sub_active(c)
+                        w, p, sub_salt(c, 0), sub_active(c), cad_pair
                     )
                     w = _packed_apply(w, a_lo, a_hi)
                 else:
-                    adv, valid = peer_adv(w, p, sub_salt(c, 0), sub_active(c))
+                    adv, valid = peer_adv(
+                        w, p, sub_salt(c, 0), sub_active(c), cad_pair
+                    )
                     w = w + adv
                 if track_hb:
-                    hb = hb_absorb(hb, p, valid)
+                    hb = hb_absorb(hb, p, valid, sub_salt(c, 0))
     else:
         # Independent choice (reference semantics: inbound load varies) or
         # adjacency-constrained topology; responder side needs scatter-max.
@@ -1327,24 +1473,57 @@ def sim_step(
             w, hb = carry
             p = peers[:, c]
             valid = eff_alive & eff_alive[p]
+            if cad is not None:
+                # Choice pairing: row i initiates this handshake, so its
+                # cadence gates BOTH directions (responders always serve
+                # but never initiate).
+                valid = valid & cad
+            salt_in = sub_salt(0, 0) + 2 * c
+            salt_out = sub_salt(0, 1) + 2 * c
             # Per-direction fault permits: the two halves of one
             # handshake can fail independently (asymmetric links).
-            f_in = fault_ok(p, rows, sub_salt(0, 0) + 2 * c)
-            f_out = fault_ok(rows, p, sub_salt(0, 1) + 2 * c)
+            f_in = fault_ok(p, rows, salt_in)
+            f_out = fault_ok(rows, p, salt_out)
             valid_in = valid if f_in is None else valid & f_in
             valid_out = valid if f_out is None else valid & f_out
             w_peer = w[p, :]
             ok_from_peer = None if sched is None else ~sched[p, :]
             adv_in = _budgeted_advance(
                 w, w_peer, cfg.budget, valid_in, axis_name,
-                cfg.budget_policy, sub_salt(0, 0) + 2 * c, owners, run_salt,
+                cfg.budget_policy, salt_in, owners, run_salt,
                 col_ok=ok_from_peer,
             )
             adv_out = _budgeted_advance(
                 w_peer, w, cfg.budget, valid_out, axis_name,
-                cfg.budget_policy, sub_salt(0, 1) + 2 * c, owners, run_salt,
+                cfg.budget_policy, salt_out, owners, run_salt,
                 col_ok=None if sched is None else ~sched,
             )
+            hb_blk_in = hb_blk_out = None
+            if byz_active:
+                from ..faults.sim import byz_hb_block, byz_out_block
+
+                # Inbound direction: row i receives from sender p[i].
+                blk_in = byz_pull_block(p, salt_in)
+                if blk_in is not None:
+                    adv_in = jnp.where(blk_in, 0, adv_in)
+                # Outbound direction: p[i] receives from sender i — the
+                # sender-side blocks index by row i (the delta is built
+                # there and scattered to p), the receiver-side
+                # inflation starvation gathers the receiver's rows.
+                blk_out = byz_out_block(
+                    plan, n, tick, rows, owners, salt_out,
+                    seed=sw_fault_seed, byz_frac=sw_byz,
+                )
+                if byz_in is not None:
+                    at_p = byz_in[p, :]
+                    blk_out = at_p if blk_out is None else blk_out | at_p
+                if blk_out is not None:
+                    adv_out = jnp.where(blk_out, 0, adv_out)
+                hb_blk_in = byz_hb_mask(p, salt_in)
+                hb_blk_out = byz_hb_block(
+                    plan, n, tick, rows, owners, salt_out,
+                    seed=sw_fault_seed, byz_frac=sw_byz,
+                )
             w_next = w + adv_in  # initiator applies the responder's delta
             w_next = w_next.at[p].max(w_peer + adv_out)  # responder applies ours
             if track_hb:
@@ -1353,6 +1532,10 @@ def sim_step(
                 out_col = valid_out[:, None]
                 in_ok = in_col if sched is None else in_col & ok_from_peer
                 out_ok = out_col if sched is None else out_col & ~sched
+                if hb_blk_in is not None:
+                    in_ok = in_ok & ~hb_blk_in
+                if hb_blk_out is not None:
+                    out_ok = out_ok & ~hb_blk_out
                 hb_next = jnp.maximum(hb, jnp.where(in_ok, hb_peer, 0))
                 hb_next = hb_next.at[p].max(jnp.where(out_ok, hb, 0))
             else:
@@ -1607,14 +1790,34 @@ def convergence_metrics(
             0.0,
         )
     )
+    # Failure-detector false positives: alive (observer, owner) pairs
+    # the observer currently believes dead (self excused). THE liveness
+    # quality datum the byzantine tolerance atlas maps against
+    # phi_threshold (stale heartbeat adverts starve the FD) — zero at a
+    # quiet steady state, elevated under attack or an aggressive
+    # threshold. Keys present only when the config tracks the FD (the
+    # zero-sized live_view makes the branch trace-static).
+    fd_fp = fd_denom = None
+    if state.live_view.size:
+        from ..sim.packed import live_view_bool
+
+        lv = live_view_bool(state)
+        rows_idx = jnp.arange(state.alive.shape[0], dtype=jnp.int32)[:, None]
+        off_diag = rows_idx != owners[None, :]
+        fp_pairs = pair_mask & off_diag & ~lv
+        fd_fp = jnp.sum(fp_pairs)
+        fd_denom = jnp.sum(pair_mask & off_diag)
     if axis_name is not None:
         n_converged = lax.psum(n_converged, axis_name)
         min_frac = lax.pmin(min_frac, axis_name)
         frac_sum = lax.psum(frac_sum, axis_name)
         pair_count = lax.psum(pair_count, axis_name)
         kv_known = lax.psum(kv_known, axis_name)
+        if fd_fp is not None:
+            fd_fp = lax.psum(fd_fp, axis_name)
+            fd_denom = lax.psum(fd_denom, axis_name)
     total = state.alive.shape[0]
-    return {
+    out = {
         "converged_owners": n_converged,
         "all_converged": n_converged == total,
         "min_fraction": jnp.minimum(min_frac, 1.0),
@@ -1622,6 +1825,10 @@ def convergence_metrics(
         "alive_count": state.alive.sum(),
         "kv_known": kv_known,
     }
+    if fd_fp is not None:
+        out["fd_false_positives"] = fd_fp
+        out["fd_false_positive_fraction"] = fd_fp / jnp.maximum(fd_denom, 1)
+    return out
 
 
 def version_spread(

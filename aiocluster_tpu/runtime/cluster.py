@@ -136,15 +136,24 @@ class Cluster:
             tls_server_hostname=config.tls_server_hostname,
             metrics=self._metrics,
         )
-        # Deterministic fault injection (docs/faults.md): only a set
-        # fault_plan constructs the controller/wrapper — with None the
-        # transport above is used as-is, byte-identical to before.
+        # Deterministic fault injection (docs/faults.md): only an
+        # EFFECTIVE plan — the configured fault_plan plus
+        # heterogeneity's derived WAN LinkFaults — constructs the
+        # controller/wrapper; with neither the transport above is used
+        # as-is, byte-identical to before.
         self._fault_controller = None
-        if config.fault_plan is not None:
+        effective_plan = config.fault_plan
+        if config.heterogeneity is not None:
+            from ..faults.plan import with_extra_links
+
+            effective_plan = with_extra_links(
+                effective_plan, config.heterogeneity.wan_link_faults()
+            )
+        if effective_plan is not None:
             from ..faults.runtime import FaultController, FaultyTransport
 
             self._fault_controller = FaultController(
-                config.fault_plan,
+                effective_plan,
                 config.node_id.name,
                 metrics=self._metrics,
             )
@@ -162,14 +171,40 @@ class Cluster:
             idle_timeout=config.pool_idle_timeout,
             metrics=self._metrics,
         )
+        # Cadence classes (docs/faults.md "heterogeneity"): this node's
+        # gossip interval is scaled by its class, derived from the same
+        # stable name coordinate the fault plan uses — the runtime
+        # analogue of the sim's per-tick initiator mask.
+        self.effective_gossip_interval = config.gossip_interval
+        # Zone lookups are pure functions of the (immutable) node name,
+        # so the per-peer zones accrete in one cache instead of
+        # re-hashing the whole membership every round (departed
+        # addresses linger harmlessly: reads are keyed by live peers).
+        self._zone_cache: dict[Address, int] = {}
+        self._self_zone: int | None = None
+        if config.heterogeneity is not None:
+            self.effective_gossip_interval *= (
+                config.heterogeneity.gossip_every_of_name(
+                    config.node_id.name
+                )
+            )
+            if config.heterogeneity.zone_bias > 0:
+                self._self_zone = config.heterogeneity.zone_of_name(
+                    config.node_id.name
+                )
+        # Jitter scales with the EFFECTIVE interval: a slow-cadence
+        # class desynchronized over a fraction of the base interval
+        # would still fire near-simultaneously within its own period.
         initial_delay = (
-            self._rng.uniform(0, config.gossip_jitter * config.gossip_interval)
+            self._rng.uniform(
+                0, config.gossip_jitter * self.effective_gossip_interval
+            )
             if config.gossip_jitter > 0
             else 0.0
         )
         self._ticker = Ticker(
             self._gossip_round,
-            config.gossip_interval,
+            self.effective_gossip_interval,
             initial_delay=initial_delay,
             on_error=lambda exc: self._log.exception(f"Gossip round error: {exc}"),
             metrics=self._metrics,
@@ -408,9 +443,27 @@ class Cluster:
         }
         seeds = set(self._config.seed_nodes)
 
+        het = self._config.heterogeneity
+        zone_of = None
+        self_zone = None
+        if het is not None and het.zone_bias > 0:
+            # Zone-aware bias: addresses we can attribute to a known
+            # node get that node's zone (same stable name coordinate
+            # the sim buckets by); unresolved bootstrap addresses stay
+            # unbiased. Zones are cached per address — only members not
+            # seen before pay the name hash.
+            zone_of = self._zone_cache
+            for n in self._cluster_state.nodes():
+                addr = n.gossip_advertise_addr
+                if addr not in zone_of:
+                    zone_of[addr] = het.zone_of_name(n.name)
+            self_zone = self._self_zone
         targets, dead_target, seed_target = select_gossip_targets(
             peers, live, dead, seeds, rng=self._rng,
             gossip_count=self._config.gossip_count,
+            zone_bias=0.0 if het is None else het.zone_bias,
+            self_zone=self_zone,
+            zone_of=zone_of,
         )
         if targets:
             self._peer_selection.labels("live").inc(len(targets))

@@ -13,6 +13,7 @@ from __future__ import annotations
 from ..core.cluster_state import ClusterState
 from ..core.config import Config
 from ..core.failure import FailureDetector
+from ..core.guards import sanitize_delta
 from ..core.identity import NodeId
 from ..core.kvstate import KeyChangeFn
 from ..core.messages import Ack, BadCluster, Delta, Digest, Packet, Syn, SynAck
@@ -44,7 +45,19 @@ class GossipEngine:
         # applied (the transport counts the wire bytes; this counts the
         # anti-entropy work those bytes bought).
         self._steps = self._delta_kvs = self._digest_events = None
+        self._byz_rejected = None
         if metrics is not None:
+            # Byzantine defense accounting (core/guards.py): every
+            # rejected violation, by kind. EXACTLY zero on honest
+            # traffic (tests/test_byzantine.py pins the fault-free
+            # soak), and exactly equal to the injected violation count
+            # under an attack plan.
+            self._byz_rejected = metrics.counter(
+                "aiocluster_byzantine_rejected_total",
+                "Inbound delta entries rejected by the byzantine "
+                "defense guards, by violation kind",
+                labels=("kind",),
+            )
             self._steps = metrics.counter(
                 "aiocluster_handshake_steps_total",
                 "Handshake state-machine steps executed, by step",
@@ -156,21 +169,35 @@ class GossipEngine:
             self._config.cluster_id, SynAck(self._self_digest(excluded), delta)
         )
 
+    def _apply_guarded(self, delta: Delta) -> Delta:
+        """The apply-delta path: inbound deltas pass the byzantine
+        defense guards (core/guards.py — owner-write, floor, over-stamp
+        and max_version-support checks) before touching state. Honest
+        deltas apply unchanged (the guards return the original object);
+        every rejection is counted by kind. Returns what was actually
+        applied."""
+        clean, rejected = sanitize_delta(delta, self._config.node_id)
+        if rejected and self._byz_rejected is not None:
+            for kind, count in rejected.items():
+                self._byz_rejected.labels(kind).inc(count)
+        self._state.apply_delta(clean, on_key_change=self._on_key_change)
+        return clean
+
     def handle_synack(self, packet: Packet) -> Packet:
-        """Initiator step 2: apply the responder's delta, reply with the
-        delta the responder is missing."""
+        """Initiator step 2: apply the responder's delta (guarded),
+        reply with the delta the responder is missing."""
         assert isinstance(packet.msg, SynAck)
         excluded = self._excluded()
         self._observe_digest(packet.msg.digest)
-        self._state.apply_delta(packet.msg.delta, on_key_change=self._on_key_change)
+        applied = self._apply_guarded(packet.msg.delta)
         delta = self._state.compute_partial_delta_respecting_mtu(
             packet.msg.digest, self._config.max_payload_size, excluded
         )
-        self._note("handle_synack", sent=delta, applied=packet.msg.delta)
+        self._note("handle_synack", sent=delta, applied=applied)
         return Packet(self._config.cluster_id, Ack(delta))
 
     def handle_ack(self, packet: Packet) -> None:
-        """Responder final step: apply the initiator's delta."""
+        """Responder final step: apply the initiator's delta (guarded)."""
         assert isinstance(packet.msg, Ack)
-        self._note("handle_ack", applied=packet.msg.delta)
-        self._state.apply_delta(packet.msg.delta, on_key_change=self._on_key_change)
+        applied = self._apply_guarded(packet.msg.delta)
+        self._note("handle_ack", applied=applied)

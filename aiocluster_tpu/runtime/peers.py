@@ -48,6 +48,33 @@ def pick_seed_node(
     return None
 
 
+def _zone_biased_sample(
+    pool: list[Address],
+    count: int,
+    rng: Random,
+    zone_bias: float,
+    self_zone: int | None,
+    zone_of: dict[Address, int],
+) -> list[Address]:
+    """``count`` targets without replacement: each slot prefers the
+    node's own zone with probability ``zone_bias`` (falling back to the
+    whole remaining pool when no same-zone candidate is left) —
+    heterogeneity's zone-aware selection (models/topology.py). The
+    unbiased path never reaches here, so reference-parity sampling
+    stays byte-identical."""
+    remaining = list(pool)
+    targets: list[Address] = []
+    for _ in range(min(count, len(pool))):
+        same = [a for a in remaining if zone_of.get(a) == self_zone]
+        candidates = (
+            same if same and rng.random() < zone_bias else remaining
+        )
+        pick = rng.choice(candidates)
+        remaining.remove(pick)
+        targets.append(pick)
+    return targets
+
+
 def select_gossip_targets(
     peer_nodes: set[Address],
     live_nodes: set[Address],
@@ -55,13 +82,21 @@ def select_gossip_targets(
     seed_nodes: set[Address],
     rng: Random,
     gossip_count: int = 3,
+    zone_bias: float = 0.0,
+    self_zone: int | None = None,
+    zone_of: dict[Address, int] | None = None,
 ) -> tuple[list[Address], Address | None, Address | None]:
     """Returns (live targets, optional dead target, optional seed target)."""
     live_count = len(live_nodes)
     dead_count = len(dead_nodes)
 
     pool = sorted(peer_nodes if live_count == 0 else live_nodes)
-    targets = rng.sample(pool, min(gossip_count, len(pool)))
+    if zone_bias > 0 and zone_of:
+        targets = _zone_biased_sample(
+            pool, gossip_count, rng, zone_bias, self_zone, zone_of
+        )
+    else:
+        targets = rng.sample(pool, min(gossip_count, len(pool)))
 
     dead_target = pick_dead_node(dead_nodes, live_count, dead_count, rng)
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..faults.plan import FaultPlan
+from ..models.topology import Heterogeneity
 
 
 @dataclass(frozen=True, slots=True, eq=True)
@@ -169,6 +170,21 @@ class SimConfig:
     # carrying effective behavior; a no-op plan keeps the fast paths).
     fault_plan: FaultPlan | None = None
 
+    # Heterogeneity (models/topology.Heterogeneity, docs/faults.md):
+    # per-node gossip-cadence classes (a class-k node initiates every
+    # k-th tick; a "matching" pair exchanges when either side is
+    # on-cadence, the directional "permutation"/"choice" pairings gate
+    # each handshake by its initiator), WAN latency/loss
+    # classes (lowered as derived LinkFaults appended to the effective
+    # fault plan, so they ride the exact link-mask machinery), and
+    # zone-aware peer bias (choice pairing only: with probability
+    # zone_bias a draw stays in the node's own zone). Hashable, so it
+    # is jit-static like the plan. None (or the all-defaults instance)
+    # changes nothing. Effective WAN classes take the XLA path like any
+    # fault plan; cadence masks fold into pair validity, which the
+    # fused kernels carry natively.
+    heterogeneity: Heterogeneity | None = None
+
     # Run each sub-exchange through the fused Pallas TPU kernel
     # (ops/pallas_pull.py): one pass over HBM instead of several, exact
     # same results (the XLA matching path shares the kernel's
@@ -288,6 +304,28 @@ class SimConfig:
             if not isinstance(self.fault_plan, FaultPlan):
                 raise ValueError("fault_plan must be a faults.FaultPlan")
             self.fault_plan.check_sim_compatible()
+            if self.fault_plan.byzantine and self.version_dtype == "u4r":
+                raise ValueError(
+                    "byzantine fault kinds are unpacked-only (the guard "
+                    "masks are owner-column selects with no byte-space "
+                    "form); version_dtype='u4r' cannot run them"
+                )
+        if self.heterogeneity is not None:
+            if not isinstance(self.heterogeneity, Heterogeneity):
+                raise ValueError(
+                    "heterogeneity must be a models.topology.Heterogeneity"
+                )
+            if self.heterogeneity.zone_bias > 0 and self.pairing != "choice":
+                raise ValueError(
+                    "zone_bias requires pairing='choice' (a global "
+                    "matching cannot honour per-node zone preference)"
+                )
+            if self.heterogeneity.zone_bias > 0 and self.peer_mode != "alive":
+                raise ValueError(
+                    "zone_bias requires peer_mode='alive' (the view-mode "
+                    "Gumbel-max draw carries no zone bias; refusing "
+                    "beats silently sampling unbiased)"
+                )
         if self.track_failure_detector and not self.track_heartbeats:
             raise ValueError("failure detector requires track_heartbeats")
         if self.dead_grace_ticks is not None:

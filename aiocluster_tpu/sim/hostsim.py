@@ -253,10 +253,24 @@ SUPPORT_DOMAIN: tuple[DomainRow, ...] = (
         "fault_plan_inert",
         (True,),
         lambda c: not (
-            _faults_sim.plan_affects_links(c.fault_plan)
+            _faults_sim.plan_affects_links(
+                _faults_sim.effective_fault_plan(c.fault_plan, c.heterogeneity)
+            )
             or _faults_sim.plan_affects_nodes(c.fault_plan)
+            or _faults_sim.plan_affects_byzantine(c.fault_plan)
         ),
-        "link/crash masks run on the XLA engine",
+        "link/crash/byzantine masks (incl. derived WAN faults) run on "
+        "the XLA engine",
+    ),
+    DomainRow(
+        "heterogeneity_inert",
+        (True,),
+        lambda c: c.heterogeneity is None or not (
+            c.heterogeneity.cadence_effective()
+            or c.heterogeneity.zone_bias > 0
+        ),
+        "cadence masks / zone-biased draws are not mirrored in the C "
+        "kernels (WAN classes already fail the fault row)",
     ),
 )
 

@@ -141,6 +141,16 @@ class Simulator:
                 "version_dtype='u4r' does not support topology runs "
                 "(the adjacency path's scatter-max is unpacked-only)"
             )
+        if (
+            topology is not None
+            and cfg.heterogeneity is not None
+            and cfg.heterogeneity.zone_bias > 0
+        ):
+            raise ValueError(
+                "zone_bias does not support topology runs (the "
+                "adjacency draw carries no zone bias; refusing beats "
+                "silently sampling unbiased)"
+            )
         from ..ops.gossip import resolve_variant_env
 
         # Fold the AIOCLUSTER_TPU_PALLAS_VARIANT override into the config

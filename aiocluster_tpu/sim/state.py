@@ -70,12 +70,19 @@ class SweepParams:
     - ``fault_seed`` (uint32, pre-masked to 32 bits): overrides
       ``fault_plan.seed`` in the probabilistic link draws — one lane
       per plan-ensemble member (faults/sim.py).
+    - ``byz_frac`` (float32 in [0, 1]): overrides the attacker windows
+      of every byzantine entry in the plan with [0, byz_frac) — the
+      tolerance atlas's swept axis (benchmarks/byzantine_bench.py). A
+      lane equals a sequential run whose plan addresses its attackers
+      as ``NodeSet(frac=(0, value))``; requires a plan with byzantine
+      entries (their kinds/victims/windows stay static).
     """
 
     fanout: jax.Array | None = None
     phi_threshold: jax.Array | None = None
     writes_per_round: jax.Array | None = None
     fault_seed: jax.Array | None = None
+    byz_frac: jax.Array | None = None
 
 
 # Largest representable watermark per version-dtype rung (docs/sim.md

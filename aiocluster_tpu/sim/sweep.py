@@ -119,6 +119,12 @@ class SweepResult:
         self.mean_fraction = np.asarray(metrics["mean_fraction"]).tolist()
         self.min_fraction = np.asarray(metrics["min_fraction"]).tolist()
         self.alive_count = np.asarray(metrics["alive_count"]).tolist()
+        # FD liveness quality (present only when the config tracks the
+        # failure detector) — the byzantine atlas's second axis.
+        fp = metrics.get("fd_false_positive_fraction")
+        self.fd_false_positive_fraction = (
+            None if fp is None else np.asarray(fp).tolist()
+        )
 
     def rows(self) -> list[dict]:
         """One dict per lane — the table the bench/CLI prints."""
@@ -134,6 +140,10 @@ class SweepResult:
                 "min_fraction": self.min_fraction[lane],
                 "alive_count": self.alive_count[lane],
             }
+            if self.fd_false_positive_fraction is not None:
+                row["fd_false_positive_fraction"] = (
+                    self.fd_false_positive_fraction[lane]
+                )
             for name, values in self.params.items():
                 row[name] = values[lane]
             out.append(row)
@@ -170,6 +180,7 @@ class SweepSimulator:
         phi_threshold=None,
         writes_per_round=None,
         fault_seeds=None,
+        byz_frac=None,
         mesh: Mesh | None = None,
         chunk: int = 8,
         initial_versions=None,
@@ -220,6 +231,14 @@ class SweepSimulator:
         fault_seeds = lane_list("fault_seeds", fault_seeds)
         if fault_seeds is not None and cfg.fault_plan is None:
             raise ValueError("fault_seeds sweep requires cfg.fault_plan")
+        byz_frac = lane_list("byz_frac", byz_frac, lo=0.0, hi=1.0)
+        if byz_frac is not None and not (
+            cfg.fault_plan is not None and cfg.fault_plan.byzantine
+        ):
+            raise ValueError(
+                "byz_frac sweep requires a cfg.fault_plan with byzantine "
+                "entries (the lane value overrides their attacker windows)"
+            )
 
         self.params: dict[str, list] = {}
         for name, values in (
@@ -227,6 +246,7 @@ class SweepSimulator:
             ("phi_threshold", phi_threshold),
             ("writes_per_round", writes_per_round),
             ("fault_seeds", fault_seeds),
+            ("byz_frac", byz_frac),
         ):
             if values is not None:
                 self.params[name] = values
@@ -248,6 +268,11 @@ class SweepSimulator:
                 else jnp.asarray(
                     [int(s) & 0xFFFFFFFF for s in fault_seeds], jnp.uint32
                 )
+            ),
+            byz_frac=(
+                None
+                if byz_frac is None
+                else jnp.asarray(byz_frac, jnp.float32)
             ),
         )
         # Horizon guard facts (host arithmetic only, like Simulator's):
@@ -470,6 +495,7 @@ class SweepSimulator:
             phi_threshold=params.get("phi_threshold"),
             writes_per_round=params.get("writes_per_round"),
             fault_seeds=params.get("fault_seeds"),
+            byz_frac=params.get("byz_frac"),
             mesh=mesh,
             chunk=chunk,
             states=states,  # __init__ skips the fresh broadcast

@@ -672,6 +672,19 @@ def convergence_under_fault_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def byzantine_atlas_bench(log, smoke: bool) -> dict | None:
+    """The wrong-data tolerance datum (benchmarks/byzantine_bench.py):
+    the (byzantine fraction x phi_threshold x fanout) phase map, all
+    cells as sweep lanes under one compile — headline
+    ``byzantine_tolerated_frac`` is the largest attacker fraction the
+    reference operating point (largest phi/fanout in the grid) rides
+    out with honest convergence intact and honest-pair FD false
+    positives under budget (docs/faults.md "byzantine")."""
+    return _run_benchmarks_helper(
+        "byzantine_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 # Hard cap on the stdout record line. Round 3's full record grew to
 # ~4.5 KB and the driver's capture kept only an unparseable tail
 # (BENCH_r03.json "parsed": null); the compact line stays ~an order of
@@ -683,6 +696,8 @@ STDOUT_LINE_CAP = 2000
 # least-essential provenance first; the headline fields
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
+    "atlas_cells",
+    "byzantine_tolerated_frac",
     "budget",
     "full_fd_deepest_bytes_per_pair",
     "lean_max_scale_model_nodes",
@@ -748,6 +763,12 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         "sim_fault_reconverge_rounds": (fb.get("sim") or {}).get(
             "sim_fault_reconverge_rounds"
         ),
+        # Wrong-data tolerance atlas headline: the largest byzantine
+        # fraction the reference operating point rides out, + map size.
+        "byzantine_tolerated_frac": (ex.get("byzantine_atlas") or {}).get(
+            "byzantine_tolerated_frac"
+        ),
+        "atlas_cells": (ex.get("byzantine_atlas") or {}).get("atlas_cells"),
         # S-lane sweep throughput + compile amortization (sweep_bench).
         "sim_sweep_lane_rounds_per_sec": (ex.get("sweep_bench") or {}).get(
             "sim_sweep_lane_rounds_per_sec"
@@ -1358,6 +1379,9 @@ def main() -> None:
         # handshake datum, also on every record (sim arm at 10k nodes
         # in full runs, 1,280 in smoke).
         fault_rec = convergence_under_fault_bench(log, args.smoke)
+        # Wrong-data tolerance atlas (byzantine_bench.py): always the
+        # smoke grid inside bench.py — `make atlas` owns the full map.
+        byz_rec = byzantine_atlas_bench(log, smoke=True)
         # Sweep engine: S-lane vmapped multi-scenario wall time vs S
         # sequential single-scenario runs (compile amortization is the
         # point — benchmarks/sweep_bench.py).
@@ -1428,6 +1452,9 @@ def main() -> None:
                 # Reconvergence after a healed 3-way partition, both
                 # backends, one seeded plan (benchmarks/fault_bench.py).
                 "fault_bench": fault_rec,
+                # Wrong-data tolerance: the (byz fraction x phi x
+                # fanout) phase map, one compile (byzantine_bench.py).
+                "byzantine_atlas": byz_rec,
                 # S-lane sweep vs S sequential runs: lane-rounds/s and
                 # the compile-amortization ratio (sweep_bench.py).
                 "sweep_bench": sweep_rec,

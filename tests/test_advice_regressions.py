@@ -1,0 +1,209 @@
+"""Regression pins for the four ADVICE-r5 fixes (ISSUE 8 satellites):
+
+1. bench.load_staleness_record orders candidates by the record's OWN
+   ``ts`` (mtime only as fallback) and labels the source with the
+   winning record's head — a fresh clone (mtimes rewritten) must not
+   let an old-commit record win.
+2. Simulator's dead-node resume guard fires only for the exact
+   select_peers fast path it protects (churn-free choice + alive mode);
+   view-mode resumes with dead nodes are legitimate.
+3. hostsim's ``take()``/``extra`` checkpoint plumbing is hoisted above
+   both profile blocks — the FD block must not depend on the heartbeat
+   block having run.
+4. bench.resolve_platform's watcher-says-down fast path distinguishes
+   the deterministic 'cpu' probe verdict (plugin absent) from a flaky
+   tunnel 'down'.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(_REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench():
+    return _load_bench()
+
+
+# -- 1. staleness-record ts ordering ------------------------------------------
+
+
+def test_staleness_record_ts_beats_mtime(bench, tmp_path, monkeypatch):
+    old = tmp_path / "r1_measurements.json"
+    new = tmp_path / "r2_measurements.json"
+    old.write_text(json.dumps({
+        "ts": "2026-01-01T00:00:00Z", "head": "oldhead",
+        "staleness": {"n_nodes": 1, "marker": "old"},
+    }))
+    new.write_text(json.dumps({
+        "ts": "2026-06-01T00:00:00Z", "head": "newhead",
+        "staleness": {"n_nodes": 2, "marker": "new"},
+    }))
+    # Fresh-clone shape: the OLD record gets the NEWEST mtime.
+    now = time.time()
+    os.utime(new, (now - 1000, now - 1000))
+    os.utime(old, (now, now))
+    monkeypatch.setattr(bench, "RECORDS_DIR", str(tmp_path))
+    rec = bench.load_staleness_record(lambda m: None)
+    assert rec is not None
+    assert rec["marker"] == "new"
+    assert "newhead" in rec["source"]
+
+
+def test_staleness_record_ts_less_falls_back_to_mtime(
+    bench, tmp_path, monkeypatch
+):
+    a = tmp_path / "a_measurements.json"
+    b = tmp_path / "b_measurements.json"
+    a.write_text(json.dumps({"staleness": {"n_nodes": 1, "marker": "a"}}))
+    b.write_text(json.dumps({"staleness": {"n_nodes": 2, "marker": "b"}}))
+    now = time.time()
+    os.utime(a, (now - 50, now - 50))
+    os.utime(b, (now, now))
+    monkeypatch.setattr(bench, "RECORDS_DIR", str(tmp_path))
+    rec = bench.load_staleness_record(lambda m: None)
+    assert rec["marker"] == "b"
+
+
+# -- 2. simulator dead-node resume guard --------------------------------------
+
+
+def _dead_state(cfg):
+    from aiocluster_tpu.sim.state import init_state
+
+    state = init_state(cfg)
+    alive = np.ones((cfg.n_nodes,), bool)
+    alive[3] = False
+    import jax.numpy as jnp
+
+    return state.replace(alive=jnp.asarray(alive))
+
+
+def test_choice_alive_resume_with_dead_nodes_refused():
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    cfg = SimConfig(
+        n_nodes=16, keys_per_node=2, pairing="choice", peer_mode="alive",
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    with pytest.raises(ValueError, match="churn-free 'choice'"):
+        Simulator(cfg, seed=0, state=_dead_state(cfg))
+
+
+def test_view_mode_resume_with_dead_nodes_allowed():
+    """peer_mode='view' samples from live_view, not the alive mask —
+    the guard must NOT refuse it (the ADVICE r5 fix)."""
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    cfg = SimConfig(
+        n_nodes=16, keys_per_node=2, pairing="choice", peer_mode="view",
+        track_failure_detector=True,
+    )
+    sim = Simulator(cfg, seed=0, state=_dead_state(cfg))  # must not raise
+    sim.run(2)
+
+
+def test_matching_resume_with_dead_nodes_allowed():
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    cfg = SimConfig(
+        n_nodes=16, keys_per_node=2, pairing="matching",
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    sim = Simulator(cfg, seed=0, state=_dead_state(cfg))
+    sim.run(2)
+
+
+# -- 3. hostsim take()/extra hoisted ------------------------------------------
+
+
+def test_hostsim_state_extra_restores_fd_profile():
+    """state_extra round-trips the FD matrices through the hoisted
+    take() path (and validates shapes loudly)."""
+    hostsim = pytest.importorskip("aiocluster_tpu.sim.hostsim")
+    from aiocluster_tpu.sim.config import SimConfig
+
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=8, fanout=2, budget=32,
+        version_dtype="int16",
+    )
+    if not hostsim.supported(cfg):
+        pytest.skip("full-profile config outside host fast-path domain")
+    if hostsim._lib() is None:
+        pytest.skip("native hostsim library unavailable")
+    n = cfg.n_nodes
+    lc = np.zeros((n, n), np.int16)
+    lc[0, 1] = 7
+    sim = hostsim.HostSimulator(
+        cfg, seed=0, state_extra={"last_change": lc}
+    )
+    assert sim.last_change[0, 1] == 7
+    with pytest.raises(ValueError, match="checkpoint"):
+        hostsim.HostSimulator(
+            cfg, seed=0,
+            state_extra={"last_change": np.zeros((2, 2), np.int16)},
+        )
+
+
+def test_hostsim_take_defined_before_profile_blocks():
+    """Source-order pin for the hoist: ``extra =`` and ``def take`` sit
+    ABOVE the first profile block (``if self._track_hb``) — the FD
+    block must never again depend on the heartbeat block defining
+    them."""
+    src_path = os.path.join(
+        _REPO, "aiocluster_tpu", "sim", "hostsim.py"
+    )
+    src = open(src_path).read()
+    assert src.index("extra = state_extra or {}") < src.index(
+        "if self._track_hb:"
+    )
+    assert src.index("def take(") < src.index("if self._track_hb:")
+
+
+# -- 4. resolve_platform 'cpu' verdict on the watcher-down fast path ----------
+
+
+def test_watcher_down_cpu_verdict_message(bench, monkeypatch):
+    monkeypatch.setattr(
+        bench, "_tunnel_watcher_verdict", lambda log: "down"
+    )
+    monkeypatch.setattr(
+        bench,
+        "_probe_accelerator",
+        lambda log, timeout_s=None: "cpu",
+    )
+    with pytest.raises(RuntimeError, match="resolved to CPU"):
+        bench.resolve_platform("tpu", lambda m: None)
+
+
+def test_watcher_down_down_verdict_message(bench, monkeypatch):
+    monkeypatch.setattr(
+        bench, "_tunnel_watcher_verdict", lambda log: "down"
+    )
+    monkeypatch.setattr(
+        bench,
+        "_probe_accelerator",
+        lambda log, timeout_s=None: "down",
+    )
+    with pytest.raises(RuntimeError) as err:
+        bench.resolve_platform("tpu", lambda m: None)
+    assert "resolved to CPU" not in str(err.value)
+    assert "watcher: down" in str(err.value)

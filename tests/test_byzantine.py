@@ -1,0 +1,630 @@
+"""Byzantine fault kinds + defenses, across both backends
+(docs/faults.md "byzantine"; ROADMAP item 4).
+
+Covers the plan model, the receiver guards (core/guards.py), runtime
+injection exactness (injected == rejected, zero on honest traffic), the
+sim lowering's outcomes, DIFFERENTIAL runtime-vs-sim reconvergence
+agreement per kind, and byzantine sweep-lane parity. The unmarked tests
+stay tier-1-fast on a 1-core CPU host.
+"""
+
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+
+from aiocluster_tpu.core.guards import sanitize_delta
+from aiocluster_tpu.core.identity import NodeId
+from aiocluster_tpu.core.messages import Delta, KeyValueUpdate, NodeDelta
+from aiocluster_tpu.core.values import KeyStatus
+from aiocluster_tpu.faults import (
+    BYZANTINE_KINDS,
+    ByzantineFault,
+    FaultPlan,
+    NodeSet,
+    byzantine_fraction,
+    byzantine_storm,
+)
+from aiocluster_tpu.faults.plan import _frac_of
+from aiocluster_tpu.faults.runner import ChaosHarness
+
+INTERVAL = 0.05
+
+
+def _nid(name: str, port: int = 1000) -> NodeId:
+    return NodeId(name=name, gossip_advertise_addr=("127.0.0.1", port))
+
+
+# -- plan model ----------------------------------------------------------------
+
+
+def test_byzantine_plan_validation():
+    with pytest.raises(ValueError, match="unknown ByzantineFault.kind"):
+        FaultPlan(byzantine=(ByzantineFault(kind="nope"),))
+    with pytest.raises(ValueError, match="rate"):
+        FaultPlan(byzantine=(ByzantineFault(kind="stale_replay", rate=1.5),))
+    with pytest.raises(ValueError, match="amount"):
+        FaultPlan(byzantine=(ByzantineFault(kind="stale_replay", amount=0),))
+    for kind in BYZANTINE_KINDS:
+        FaultPlan(byzantine=(ByzantineFault(kind=kind),))  # all legal
+
+
+def test_byzantine_plan_round_trips_json():
+    plan = byzantine_storm(0.25, end=30.0, seed=7)
+    assert FaultPlan.from_json(plan.to_json()) == plan
+    assert len(plan.byzantine) == 3
+
+
+def test_byzantine_sim_compat_rejects_names():
+    plan = FaultPlan(
+        byzantine=(
+            ByzantineFault(kind="stale_replay", nodes=NodeSet(names=("a",))),
+        )
+    )
+    with pytest.raises(ValueError, match="ByzantineFault.nodes"):
+        plan.check_sim_compatible()
+    byzantine_fraction("stale_replay", 0.5).check_sim_compatible()  # ok
+
+
+def test_packed_rung_rejects_byzantine():
+    from aiocluster_tpu.sim.config import SimConfig
+
+    with pytest.raises(ValueError, match="unpacked-only"):
+        SimConfig(
+            n_nodes=64,
+            version_dtype="u4r",
+            keys_per_node=4,
+            track_failure_detector=False,
+            track_heartbeats=False,
+            fault_plan=byzantine_fraction("stale_replay", 0.5),
+        )
+
+
+# -- receiver guards (core/guards.py) -----------------------------------------
+
+
+def _delta(*nds: NodeDelta) -> Delta:
+    return Delta(node_deltas=list(nds))
+
+
+def _kv(key: str, version: int, value: str = "v") -> KeyValueUpdate:
+    return KeyValueUpdate(key, value, version, KeyStatus.SET)
+
+
+def test_guards_pass_honest_delta_unchanged():
+    me = _nid("me")
+    nd = NodeDelta(
+        node_id=_nid("peer"),
+        from_version_excluded=2,
+        last_gc_version=0,
+        key_values=[_kv("a", 3), _kv("b", 5)],
+        max_version=5,
+    )
+    delta = _delta(nd)
+    clean, rejections = sanitize_delta(delta, me)
+    assert clean is delta  # identity: zero-allocation honest path
+    assert rejections == {}
+
+
+def test_guards_pass_gc_supported_stamp():
+    # max_version covered by last_gc_version, not by any carried kv —
+    # the honest GC shape the support guard must not flag.
+    nd = NodeDelta(
+        node_id=_nid("peer"),
+        from_version_excluded=0,
+        last_gc_version=6,
+        key_values=[_kv("a", 5)],
+        max_version=6,
+    )
+    clean, rejections = sanitize_delta(_delta(nd), _nid("me"))
+    assert clean.node_deltas[0] is nd and rejections == {}
+
+
+def test_guard_owner_violation_self_keyspace():
+    me = _nid("me")
+    nd = NodeDelta(
+        node_id=me,
+        from_version_excluded=0,
+        last_gc_version=0,
+        key_values=[_kv("byz", 100), _kv("byz2", 101)],
+        max_version=None,
+    )
+    clean, rejections = sanitize_delta(_delta(nd), me)
+    assert clean.node_deltas == []
+    assert rejections == {"owner_violation": 2}  # per key-value
+
+
+def test_guard_stale_replay_below_floor():
+    nd = NodeDelta(
+        node_id=_nid("peer"),
+        from_version_excluded=4,
+        last_gc_version=0,
+        key_values=[_kv("a", 4), _kv("b", 2), _kv("c", 5)],
+        max_version=6,
+    )
+    clean, rejections = sanitize_delta(_delta(nd), _nid("me"))
+    out = clean.node_deltas[0]
+    assert [kv.version for kv in out.key_values] == [5]
+    # Fast-forward refused once data was dropped (truncated semantics),
+    # without a separate digest_inflation count.
+    assert out.max_version is None
+    assert rejections == {"stale_replay": 2}
+
+
+def test_guard_over_stamp_kv():
+    nd = NodeDelta(
+        node_id=_nid("peer"),
+        from_version_excluded=0,
+        last_gc_version=0,
+        key_values=[_kv("a", 3), _kv("byz", 50)],
+        max_version=3,
+    )
+    clean, rejections = sanitize_delta(_delta(nd), _nid("me"))
+    out = clean.node_deltas[0]
+    assert [kv.version for kv in out.key_values] == [3]
+    assert out.max_version is None
+    assert rejections == {"owner_violation": 1}
+
+
+def test_guard_unsupported_stamp_refused():
+    nd = NodeDelta(
+        node_id=_nid("peer"),
+        from_version_excluded=0,
+        last_gc_version=0,
+        key_values=[_kv("a", 3)],
+        max_version=1000,  # inflated: no carried/gc support
+    )
+    clean, rejections = sanitize_delta(_delta(nd), _nid("me"))
+    out = clean.node_deltas[0]
+    assert [kv.version for kv in out.key_values] == [3]
+    assert out.max_version is None
+    assert rejections == {"digest_inflation": 1}
+
+
+def test_injected_owner_violation_on_truncated_relay_is_caught():
+    """Closed loop over an MTU-truncated relay (max_version=None): the
+    injector must pin the fabricated stamp to the delta's floor so
+    guard 3 keeps a bound — a None-stamped fabrication would sail past
+    every guard (applied AND counted as injected), breaking the
+    injected == rejected invariant (regression: review of PR 8)."""
+    from aiocluster_tpu.faults.runtime import FaultController
+
+    plan = FaultPlan(
+        seed=11,
+        byzantine=(
+            ByzantineFault(
+                kind="owner_violation", nodes=NodeSet(names=("att",))
+            ),
+        ),
+    )
+    ctl = FaultController(plan, "att", clock=lambda: 1.0)
+    truncated = NodeDelta(
+        node_id=_nid("victim"),
+        from_version_excluded=7,
+        last_gc_version=0,
+        key_values=[_kv("a", 8)],
+        max_version=None,  # MTU cut this relay: stamp withheld
+    )
+    rewritten = ctl._rewrite_delta(_delta(truncated), ctl.byzantine_active(),
+                                   "dst")
+    nd = rewritten.node_deltas[0]
+    assert nd.key_values[0].key == "byz"  # fabrication replaced the relay
+    assert nd.max_version == 7  # stamp pinned to the floor, NOT None
+    clean, rejections = sanitize_delta(rewritten, _nid("me"))
+    assert rejections == {"owner_violation": 1}
+    assert clean.node_deltas == []  # nothing of the fabrication survives
+
+
+def test_guards_never_fire_across_live_cluster_state():
+    """Property-style honest soak: deltas produced by the real packer
+    between two honestly-evolving ClusterStates never trip a guard."""
+    from datetime import datetime, timezone
+
+    from aiocluster_tpu.core.cluster_state import ClusterState
+
+    ts = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    a, b = ClusterState(), ClusterState()
+    ida, idb = _nid("a", 1), _nid("b", 2)
+    rng = np.random.default_rng(0)
+    for step in range(30):
+        sa = a.node_state_or_default(ida)
+        sa.set(f"k{rng.integers(8)}", f"v{step}", ts=ts)
+        if step % 7 == 3:
+            sa.delete(f"k{rng.integers(8)}", ts=ts)
+        digest_b = b.compute_digest(set())
+        delta = a.compute_partial_delta_respecting_mtu(digest_b, 600, set())
+        clean, rejections = sanitize_delta(delta, idb)
+        assert rejections == {}, (step, rejections)
+        assert clean is delta
+        b.apply_delta(clean, ts=ts)
+
+
+# -- runtime injection: exactness + honest soak --------------------------------
+
+
+ATTACK_WINDOW_S = 2.0
+
+
+def _single_kind_plan(kind: str) -> FaultPlan:
+    # A FINITE window: injection stops at its end while the fleet keeps
+    # gossiping, so every in-flight violation is delivered and judged
+    # before the counters are compared — exact equality with no
+    # mid-handshake race.
+    return FaultPlan(
+        byzantine=(
+            ByzantineFault(
+                kind=kind,
+                nodes=NodeSet(names=("n00",)),
+                end=ATTACK_WINDOW_S,
+            ),
+        )
+    )
+
+
+async def _window_closed_counts(h: ChaosHarness) -> dict:
+    """byzantine_counts once the attack window is over and the wire has
+    drained (a poll-until-stable backstop guards a loaded host)."""
+    while h.elapsed() < ATTACK_WINDOW_S + 6 * INTERVAL:
+        await asyncio.sleep(INTERVAL)
+    prev = h.byzantine_counts()
+    for _ in range(50):
+        await asyncio.sleep(4 * INTERVAL)
+        cur = h.byzantine_counts()
+        if cur == prev:
+            return cur
+        prev = cur
+    return prev
+
+
+@pytest.mark.parametrize("kind", BYZANTINE_KINDS)
+async def test_runtime_injected_equals_rejected(kind):
+    """2-node loopback fleet, attacker n00: every injected violation of
+    a pure kind reaches the one honest receiver and is rejected — the
+    two counters match EXACTLY. The attacker keeps writing so deltas
+    keep flowing (a quiescent digest_inflation attacker has no stamps
+    left to inflate)."""
+    async with ChaosHarness(
+        2, _single_kind_plan(kind), gossip_interval=INTERVAL
+    ) as h:
+        step = 0
+        while h.elapsed() < ATTACK_WINDOW_S:
+            h.clusters["n00"].set(f"w{step}", "x")
+            step += 1
+            await asyncio.sleep(2 * INTERVAL)
+        counts = await _window_closed_counts(h)
+    assert counts["injected"].get(kind, 0) > 0, counts
+    assert counts["injected"][kind] == counts["rejected"].get(kind, 0), counts
+
+
+async def test_runtime_fault_free_soak_zero_rejections():
+    """Honest fleets NEVER trip a guard: the acceptance criterion's
+    zero-rejections-on-a-fault-free-soak half."""
+    async with ChaosHarness(4, None, gossip_interval=INTERVAL) as h:
+        await h.wait_converged(timeout=20.0)
+        # Live writes + deletes after convergence exercise GC shapes.
+        h.clusters["n00"].set("late", "x")
+        h.clusters["n01"].delete("from-n01")
+        await asyncio.sleep(12 * INTERVAL)
+        counts = h.byzantine_counts()
+    assert counts["rejected"] == {}, counts
+    assert counts["injected"] == {}, counts
+
+
+async def test_runtime_owner_violation_converges_and_rejects():
+    """owner_violation against a victim with honest direct links: the
+    fabrications are rejected everywhere (self-keyspace guard at the
+    victim, over-stamp guard elsewhere) and the fleet still converges —
+    the defense holds the line."""
+    plan = FaultPlan(
+        byzantine=(
+            ByzantineFault(
+                kind="owner_violation",
+                nodes=NodeSet(names=("n00",)),
+                victims=NodeSet(names=("n02",)),
+                end=ATTACK_WINDOW_S,
+            ),
+        )
+    )
+    async with ChaosHarness(3, plan, gossip_interval=INTERVAL) as h:
+        await h.wait_converged(timeout=20.0)
+        counts = await _window_closed_counts(h)
+    assert counts["injected"].get("owner_violation", 0) > 0
+    assert counts["injected"]["owner_violation"] == counts["rejected"].get(
+        "owner_violation", 0
+    ), counts
+
+
+# -- differential: runtime and sim agree on reconvergence outcome --------------
+
+
+def _sim_outcome(plan: FaultPlan, max_rounds: int = 120):
+    """(converged_at | None, metrics) for the standard differential
+    shape: 64 nodes, lean profile."""
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    cfg = SimConfig(
+        n_nodes=64,
+        keys_per_node=4,
+        fanout=2,
+        budget=32,
+        track_failure_detector=False,
+        track_heartbeats=False,
+        fault_plan=plan,
+    )
+    sim = Simulator(cfg, seed=3)
+    r = sim.run_until_converged(max_rounds=max_rounds)
+    return r, sim.metrics()
+
+
+async def _runtime_outcome(plan: FaultPlan, n: int = 5, wait_s: float = 6.0):
+    """True iff an n-node loopback fleet under ``plan`` fully converges
+    within ``wait_s`` (generous vs the fault-free ~1 s)."""
+    async with ChaosHarness(n, plan, gossip_interval=INTERVAL) as h:
+        try:
+            await h.wait_converged(timeout=wait_s)
+            return True
+        except TimeoutError:
+            return False
+
+
+@pytest.mark.parametrize("kind", ["stale_replay", "owner_violation"])
+async def test_differential_outcome_hostile(kind):
+    """The SAME fraction-addressed plan on both backends, hostile cell:
+    stale_replay with victims=ALL blocks even the attackers' own
+    keyspace from propagating — NEITHER backend converges.
+    owner_violation excludes self-owned keyspaces by definition, so the
+    same plan CONVERGES on both (the defense rejects fabrications while
+    genuine self-adverts flow) — agreement either way, per kind."""
+    plan = byzantine_fraction(kind, 0.3, seed=5)
+    attackers = [
+        name
+        for name in (f"n{i:02d}" for i in range(5))
+        if _frac_of(name) < 0.3
+    ]
+    assert attackers, "differential fleet needs at least one attacker"
+    sim_r, _ = _sim_outcome(plan)
+    run_conv = await _runtime_outcome(plan)
+    if kind == "stale_replay":
+        assert sim_r is None and run_conv is False
+    else:
+        assert sim_r is not None and run_conv is True
+
+
+async def test_differential_outcome_digest_inflation_heals():
+    """digest_inflation with a finite window: both backends FAIL to
+    converge while the window is open (the attacker cannot learn) and
+    BOTH reconverge after it closes — the same plan, the same verdict,
+    tick-comparable."""
+    open_plan = byzantine_fraction("digest_inflation", 0.3, seed=5)
+    sim_open, _ = _sim_outcome(open_plan)
+    assert sim_open is None  # attacker rows never catch up
+    # Runtime, window open: not converged within the deadline.
+    run_open = await _runtime_outcome(open_plan)
+    assert run_open is False
+    # Healing window: seconds in the runtime, ticks in the sim.
+    sim_heal, _ = _sim_outcome(
+        byzantine_fraction("digest_inflation", 0.3, seed=5, end=20.0),
+        max_rounds=200,
+    )
+    assert sim_heal is not None and sim_heal > 20
+    run_heal = await _runtime_outcome(
+        byzantine_fraction("digest_inflation", 0.3, seed=5, end=2.0),
+        wait_s=12.0,
+    )
+    assert run_heal is True
+
+
+# -- sim lowering details ------------------------------------------------------
+
+
+def test_sim_stale_replay_blocks_attacker_columns_only():
+    plan = byzantine_fraction("stale_replay", 0.25, seed=1)
+    r, metrics = _sim_outcome(plan)
+    assert r is None
+    # Exactly the 48 honest owners converge; 16 attacker columns stuck.
+    assert int(metrics["converged_owners"]) == 48
+
+
+def test_sim_fp_fraction_zero_clean_elevated_under_attack():
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    base = dict(n_nodes=64, keys_per_node=4, fanout=2, budget=32)
+    clean = Simulator(SimConfig(**base), seed=3)
+    clean.run(30)
+    assert float(clean.metrics()["fd_false_positive_fraction"]) == 0.0
+    hostile = Simulator(
+        SimConfig(**base, fault_plan=byzantine_storm(0.25, seed=3)), seed=3
+    )
+    hostile.run(30)
+    assert float(hostile.metrics()["fd_false_positive_fraction"]) > 0.1
+
+
+def test_sim_byzantine_rate_scales_damage():
+    """rate < 1 injects probabilistically (hash-driven, deterministic):
+    a 30%-rate attack hurts measurably less than a 100%-rate one."""
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    def mean_frac(rate):
+        plan = byzantine_fraction("stale_replay", 0.5, rate=rate, seed=2)
+        cfg = SimConfig(
+            n_nodes=64, keys_per_node=4, fanout=2, budget=32,
+            track_failure_detector=False, track_heartbeats=False,
+            fault_plan=plan,
+        )
+        sim = Simulator(cfg, seed=3)
+        sim.run(10)
+        return float(sim.metrics()["mean_fraction"])
+
+    assert mean_frac(0.3) > mean_frac(1.0)
+
+
+def test_sim_byzantine_pallas_fallback_reason():
+    """Byzantine plans force the XLA path LOUDLY, under the existing
+    fault_plan reason (the kernels carry no guard masks)."""
+    from aiocluster_tpu.ops.gossip import (
+        pallas_fallback_reason,
+        pallas_path_engaged,
+    )
+    from aiocluster_tpu.sim.config import SimConfig
+
+    cfg = SimConfig(
+        n_nodes=256,
+        use_pallas=True,
+        fault_plan=byzantine_fraction("stale_replay", 0.25),
+    )
+    assert not pallas_path_engaged(cfg)
+    assert pallas_fallback_reason(cfg) == "fault_plan"
+
+
+# -- sweep lanes ---------------------------------------------------------------
+
+
+def test_sweep_byz_frac_lane_equals_static_plan():
+    """A byz_frac lane is tick-identical to a sequential run whose plan
+    addresses its attackers as NodeSet(frac=(0, value)) — including the
+    rate < 1 hash draws re-rolled per fault_seed."""
+    import jax
+
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+    from aiocluster_tpu.sim.sweep import SweepSimulator
+
+    base_plan = byzantine_fraction("stale_replay", 0.5, rate=0.7, seed=11)
+    cfg = SimConfig(
+        n_nodes=64, keys_per_node=4, fanout=2, budget=32,
+        track_failure_detector=True, fault_plan=base_plan,
+    )
+    fracs = [0.0, 0.25, 0.5]
+    sweep = SweepSimulator(cfg, seeds=[9] * 3, byz_frac=fracs)
+    sweep.run(12)
+    states = jax.device_get(sweep.states)
+    for lane, frac in enumerate(fracs):
+        plan_l = FaultPlan(
+            seed=base_plan.seed,
+            byzantine=(
+                dataclasses.replace(
+                    base_plan.byzantine[0], nodes=NodeSet(frac=(0.0, frac))
+                ),
+            ),
+        )
+        seq = Simulator(
+            dataclasses.replace(cfg, fault_plan=plan_l), seed=9
+        )
+        seq.run(12)
+        ref = jax.device_get(seq.state)
+        for field in ("w", "hb_known", "live_view", "imean", "icount"):
+            assert np.array_equal(
+                np.asarray(getattr(states, field)[lane]),
+                np.asarray(getattr(ref, field)),
+            ), (lane, field)
+
+
+def test_sweep_fault_seed_salts_byzantine_draws():
+    """fault_seed lanes re-roll the byzantine rate draws exactly as
+    replace(plan, seed=...) — the byzantine-salt half of the link-fault
+    contract."""
+    import jax
+
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+    from aiocluster_tpu.sim.sweep import SweepSimulator
+
+    base_plan = byzantine_fraction("stale_replay", 0.5, rate=0.5, seed=0)
+    cfg = SimConfig(
+        n_nodes=64, keys_per_node=4, fanout=2, budget=32,
+        track_failure_detector=False, track_heartbeats=False,
+        fault_plan=base_plan,
+    )
+    seeds = [123, 456]
+    sweep = SweepSimulator(cfg, seeds=[9, 9], fault_seeds=seeds)
+    sweep.run(10)
+    states = jax.device_get(sweep.states)
+    w0 = np.asarray(states.w[0])
+    w1 = np.asarray(states.w[1])
+    assert not np.array_equal(w0, w1)  # salts actually re-roll
+    for lane, fs in enumerate(seeds):
+        seq = Simulator(
+            dataclasses.replace(
+                cfg, fault_plan=dataclasses.replace(base_plan, seed=fs)
+            ),
+            seed=9,
+        )
+        seq.run(10)
+        assert np.array_equal(
+            np.asarray(states.w[lane]), np.asarray(jax.device_get(seq.state.w))
+        ), lane
+
+
+def test_sweep_byz_frac_requires_byzantine_plan():
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.sweep import SweepSimulator
+
+    cfg = SimConfig(n_nodes=64, keys_per_node=4)
+    with pytest.raises(ValueError, match="byz_frac sweep requires"):
+        SweepSimulator(cfg, seeds=[1, 2], byz_frac=[0.0, 0.5])
+
+
+@pytest.mark.slow
+def test_sweep_byz_frac_sharded_matches_unsharded():
+    """byz masks are global-index hashes: a 2-shard mesh sweep is
+    bit-identical to the single-device sweep."""
+    import jax
+
+    from aiocluster_tpu.parallel.mesh import make_mesh
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.sweep import SweepSimulator
+
+    plan = byzantine_fraction("stale_replay", 0.5, rate=0.6, seed=4)
+    cfg = SimConfig(
+        n_nodes=64, keys_per_node=4, fanout=2, budget=32,
+        track_failure_detector=True, fault_plan=plan,
+    )
+    fracs = [0.25, 0.75]
+    single = SweepSimulator(cfg, seeds=[5, 5], byz_frac=fracs)
+    single.run(10)
+    mesh = make_mesh(jax.devices()[:2])
+    sharded = SweepSimulator(cfg, seeds=[5, 5], byz_frac=fracs, mesh=mesh)
+    sharded.run(10)
+    a = jax.device_get(single.states)
+    b = jax.device_get(sharded.states)
+    for field in ("w", "hb_known", "live_view"):
+        assert np.array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field))
+        ), field
+
+
+# -- atlas ---------------------------------------------------------------------
+
+
+def test_atlas_measure_smoke():
+    """The smoke atlas: >= 3x3 (frac x phi) cells from ONE compile, the
+    fault-free column tolerated, the compact keys present — what `make
+    atlas-smoke` gates in `make check`."""
+    import os
+    import sys
+
+    bench_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+    )
+    sys.path.insert(0, bench_dir)
+    try:
+        import byzantine_bench
+
+        record = byzantine_bench.measure(smoke=True)
+    finally:
+        sys.path.remove(bench_dir)
+    assert record is not None
+    assert record["atlas_cells"] >= 9
+    fracs = {c["byz_frac"] for c in record["cells"]}
+    phis = {c["phi_threshold"] for c in record["cells"]}
+    assert len(fracs) >= 3 and len(phis) >= 3
+    assert record["byzantine_tolerated_frac"] is not None
+    base = [c for c in record["cells"] if c["byz_frac"] == 0.0]
+    assert base and all(c["tolerated"] for c in base)
+    # The compact-record keys bench.py stamps.
+    assert "byzantine_tolerated_frac" in record and "atlas_cells" in record

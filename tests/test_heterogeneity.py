@@ -1,0 +1,366 @@
+"""Heterogeneity classes across both backends (docs/faults.md
+"heterogeneity"; models/topology.Heterogeneity): per-node gossip-cadence
+classes, WAN latency/loss zones (derived LinkFaults), zone-aware peer
+bias — plus the runtime lowering (ticker scaling, plan merging, biased
+target sampling)."""
+
+import asyncio
+import dataclasses
+from random import Random
+
+import numpy as np
+import pytest
+
+from aiocluster_tpu.faults.plan import FaultPlan, _frac_of
+from aiocluster_tpu.models import Heterogeneity
+from aiocluster_tpu.sim.config import SimConfig
+from aiocluster_tpu.sim.simulator import Simulator
+
+BASE = dict(
+    n_nodes=64, keys_per_node=4, fanout=2, budget=32,
+    track_failure_detector=False, track_heartbeats=False,
+)
+
+
+# -- model ---------------------------------------------------------------------
+
+
+def test_heterogeneity_validation():
+    with pytest.raises(ValueError, match="same length"):
+        Heterogeneity(gossip_every=(1, 2), class_frac=(1.0,))
+    with pytest.raises(ValueError, match="sum to 1"):
+        Heterogeneity(gossip_every=(1, 2), class_frac=(0.5, 0.3))
+    with pytest.raises(ValueError, match=">= 1"):
+        Heterogeneity(gossip_every=(0,), class_frac=(1.0,))
+    with pytest.raises(ValueError, match="zones >= 2"):
+        Heterogeneity(wan_loss=0.1)
+    with pytest.raises(ValueError, match="zone_bias"):
+        Heterogeneity(zone_bias=1.5)
+    assert not Heterogeneity().effective()
+    assert Heterogeneity(gossip_every=(2,), class_frac=(1.0,)).effective()
+    assert Heterogeneity(zones=2, wan_loss=0.1).effective()
+    assert Heterogeneity(zones=2, zone_bias=0.5).effective()
+
+
+def test_class_and_zone_of_frac():
+    het = Heterogeneity(
+        gossip_every=(1, 2, 4), class_frac=(0.5, 0.25, 0.25), zones=4
+    )
+    assert het.class_of_frac(0.0) == 0
+    assert het.class_of_frac(0.49) == 0
+    assert het.class_of_frac(0.5) == 1
+    assert het.class_of_frac(0.74) == 1
+    assert het.class_of_frac(0.75) == 2
+    assert het.class_of_frac(0.999) == 2
+    assert het.zone_of_frac(0.0) == 0
+    assert het.zone_of_frac(0.26) == 1
+    assert het.zone_of_frac(0.999) == 3
+    # Runtime name addressing rides the same coordinate.
+    name = "n07"
+    assert het.class_of_name(name) == het.class_of_frac(_frac_of(name))
+    assert het.zone_of_name(name) == het.zone_of_frac(_frac_of(name))
+
+
+def test_wan_link_faults_derivation():
+    het = Heterogeneity(zones=3, wan_loss=0.2, wan_delay=1.5)
+    links = het.wan_link_faults()
+    assert len(links) == 6  # 3 * 2 ordered cross-zone pairs
+    for lf in links:
+        assert lf.drop == 0.2 and lf.delay == 1.5 and lf.delay_prob == 1.0
+        assert lf.src.frac != lf.dst.frac  # never intra-zone
+    assert Heterogeneity(zones=3).wan_link_faults() == ()
+
+
+def test_simconfig_zone_bias_requires_choice():
+    with pytest.raises(ValueError, match="zone_bias requires"):
+        SimConfig(
+            **BASE, heterogeneity=Heterogeneity(zones=2, zone_bias=0.5)
+        )
+    SimConfig(
+        **{**BASE, "pairing": "choice"},
+        heterogeneity=Heterogeneity(zones=2, zone_bias=0.5),
+    )  # ok
+
+
+def test_zone_bias_unbiased_modes_refused_loudly():
+    """Peer draws that carry no zone bias — view-mode Gumbel-max and
+    adjacency picks — must refuse a zone_bias config instead of
+    silently sampling unbiased (regression: review of PR 8)."""
+    from aiocluster_tpu.models.topology import ring
+
+    het = Heterogeneity(zones=2, zone_bias=0.5)
+    with pytest.raises(ValueError, match="peer_mode='alive'"):
+        SimConfig(
+            **{**BASE, "pairing": "choice", "peer_mode": "view",
+               "track_heartbeats": True, "heartbeat_dtype": "int16",
+               "track_failure_detector": True, "fd_dtype": "bfloat16",
+               "window_ticks": 100},
+            heterogeneity=het,
+        )
+    cfg = SimConfig(
+        **{**BASE, "pairing": "choice"}, heterogeneity=het
+    )
+    with pytest.raises(ValueError, match="topology"):
+        Simulator(cfg, seed=0, topology=ring(BASE["n_nodes"]))
+
+
+# -- sim lowering --------------------------------------------------------------
+
+
+def test_cadence_slows_but_converges():
+    """Half the fleet at quarter cadence: convergence still completes,
+    strictly slower than the homogeneous fleet."""
+    het = Heterogeneity(gossip_every=(1, 4), class_frac=(0.5, 0.5))
+    slow = Simulator(SimConfig(**BASE, heterogeneity=het), seed=3)
+    r_het = slow.run_until_converged(max_rounds=200)
+    fast = Simulator(SimConfig(**BASE), seed=3)
+    r_homo = fast.run_until_converged(max_rounds=200)
+    assert r_het is not None and r_homo is not None
+    assert r_het > r_homo
+
+
+def test_all_defaults_heterogeneity_is_identity():
+    """The all-defaults instance changes NOTHING: bit-identical
+    trajectory to heterogeneity=None."""
+    import jax
+
+    a = Simulator(SimConfig(**BASE), seed=7)
+    a.run(10)
+    b = Simulator(
+        SimConfig(**BASE, heterogeneity=Heterogeneity()), seed=7
+    )
+    b.run(10)
+    assert np.array_equal(
+        np.asarray(jax.device_get(a.state.w)),
+        np.asarray(jax.device_get(b.state.w)),
+    )
+
+
+def test_wan_classes_equal_explicit_link_faults():
+    """The WAN lowering IS the link-fault machinery: a heterogeneity
+    config and a hand-built plan with the same derived LinkFaults
+    produce bit-identical trajectories."""
+    import jax
+
+    het = Heterogeneity(zones=2, wan_loss=0.3)
+    via_het = Simulator(SimConfig(**BASE, heterogeneity=het), seed=5)
+    via_het.run(12)
+    plan = FaultPlan(links=het.wan_link_faults())
+    via_plan = Simulator(SimConfig(**BASE, fault_plan=plan), seed=5)
+    via_plan.run(12)
+    assert np.array_equal(
+        np.asarray(jax.device_get(via_het.state.w)),
+        np.asarray(jax.device_get(via_plan.state.w)),
+    )
+
+
+def test_wan_delay_over_one_tick_blocks_cross_zone():
+    """A >= 1-tick WAN delay (delay_prob 1) misses every round deadline:
+    cross-zone traffic is fully cut, zones converge internally only."""
+    het = Heterogeneity(zones=2, wan_delay=1.0)
+    sim = Simulator(SimConfig(**BASE, heterogeneity=het), seed=3)
+    r = sim.run_until_converged(max_rounds=60)
+    assert r is None
+    # Both zones converged internally: every owner's non-converged
+    # observers are exactly the other zone.
+    m = sim.metrics()
+    assert float(m["mean_fraction"]) == pytest.approx(0.5, abs=0.1)
+
+
+def test_zone_bias_full_creates_islands():
+    het = Heterogeneity(zones=4, zone_bias=1.0)
+    cfg = SimConfig(**{**BASE, "pairing": "choice"}, heterogeneity=het)
+    sim = Simulator(cfg, seed=3)
+    assert sim.run_until_converged(max_rounds=60) is None
+    # Partial bias still converges (cross-zone picks happen).
+    het2 = Heterogeneity(zones=4, zone_bias=0.8)
+    cfg2 = SimConfig(**{**BASE, "pairing": "choice"}, heterogeneity=het2)
+    sim2 = Simulator(cfg2, seed=3)
+    assert sim2.run_until_converged(max_rounds=200) is not None
+
+
+def test_cadence_keeps_pallas_engaged():
+    """Cadence classes fold into the kernel's validity mask — a
+    kernel-shaped config with cadence-only heterogeneity stays on the
+    fused path (no fallback reason)."""
+    from aiocluster_tpu.ops.gossip import (
+        pallas_fallback_reason,
+        pallas_path_engaged,
+    )
+
+    het = Heterogeneity(gossip_every=(1, 2), class_frac=(0.5, 0.5))
+    cfg = SimConfig(n_nodes=256, use_pallas=True, heterogeneity=het)
+    assert pallas_path_engaged(cfg)
+    assert pallas_fallback_reason(cfg) is None
+    # WAN classes carry real link masks: XLA, loudly, like any plan.
+    wan = Heterogeneity(zones=2, wan_loss=0.1)
+    cfg2 = SimConfig(n_nodes=256, use_pallas=True, heterogeneity=wan)
+    assert not pallas_path_engaged(cfg2)
+    assert pallas_fallback_reason(cfg2) == "fault_plan"
+
+
+def test_cadence_pallas_parity():
+    """Flipping use_pallas (interpret mode) under a cadence config does
+    not change the trajectory — the mask rides `valid` identically."""
+    import jax
+
+    het = Heterogeneity(gossip_every=(1, 3), class_frac=(0.5, 0.5))
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=4, fanout=2, budget=32,
+        track_failure_detector=True, heterogeneity=het,
+    )
+    xla = Simulator(dataclasses.replace(cfg, use_pallas=False), seed=2)
+    xla.run(6)
+    pallas = Simulator(dataclasses.replace(cfg, use_pallas=True), seed=2)
+    pallas.run(6)
+    for field in ("w", "hb_known", "live_view"):
+        assert np.array_equal(
+            np.asarray(jax.device_get(getattr(xla.state, field))),
+            np.asarray(jax.device_get(getattr(pallas.state, field))),
+        ), field
+
+
+def test_hostsim_domain_excludes_heterogeneity():
+    from aiocluster_tpu.sim import hostsim
+
+    cfg = SimConfig(
+        n_nodes=128, keys_per_node=8, fanout=2, budget=32,
+        track_failure_detector=False, track_heartbeats=False,
+        version_dtype="int16",
+        heterogeneity=Heterogeneity(
+            gossip_every=(1, 2), class_frac=(0.5, 0.5)
+        ),
+    )
+    assert "heterogeneity_inert" in hostsim.unsupported_features(cfg)
+    wan_cfg = dataclasses.replace(
+        cfg, heterogeneity=Heterogeneity(zones=2, wan_loss=0.1)
+    )
+    assert "fault_plan_inert" in hostsim.unsupported_features(wan_cfg)
+
+
+# -- runtime lowering ----------------------------------------------------------
+
+
+def test_runtime_ticker_scales_by_cadence_class():
+    from aiocluster_tpu.core.config import Config
+    from aiocluster_tpu.core.identity import NodeId
+    from aiocluster_tpu.runtime.cluster import Cluster
+
+    het = Heterogeneity(
+        gossip_every=(1, 4), class_frac=(0.5, 0.5)
+    )
+    # Pick names deterministically on each side of the class cut.
+    fast_name = next(
+        f"n{i}" for i in range(100) if _frac_of(f"n{i}") < 0.5
+    )
+    slow_name = next(
+        f"n{i}" for i in range(100) if _frac_of(f"n{i}") >= 0.5
+    )
+    for name, factor in ((fast_name, 1), (slow_name, 4)):
+        cfg = Config(
+            node_id=NodeId(
+                name=name, gossip_advertise_addr=("127.0.0.1", 1)
+            ),
+            gossip_interval=0.5,
+            heterogeneity=het,
+        )
+        cluster = Cluster(cfg)
+        assert cluster.effective_gossip_interval == 0.5 * factor
+
+
+def test_runtime_wan_builds_fault_controller():
+    """WAN classes alone construct the FaultController from the derived
+    links — no explicit fault_plan needed — and cross-zone ops degrade
+    while intra-zone ops stay clean."""
+    from aiocluster_tpu.faults.runtime import FaultController
+    from aiocluster_tpu.faults.plan import with_extra_links
+
+    het = Heterogeneity(zones=2, wan_loss=1.0)
+    plan = with_extra_links(None, het.wan_link_faults())
+    names = [f"n{i}" for i in range(40)]
+    zone0 = [n for n in names if het.zone_of_name(n) == 0]
+    zone1 = [n for n in names if het.zone_of_name(n) == 1]
+    assert zone0 and zone1
+    ctl = FaultController(plan, zone0[0], clock=lambda: 0.0)
+    ctl.start(0.0)
+    cross = ctl.decide(zone1[0], "write", t=1.0)
+    intra = ctl.decide(zone0[1], "write", t=1.0)
+    assert cross.action == "drop"
+    assert intra.action == "ok"
+
+
+async def test_runtime_cluster_wires_wan_without_plan():
+    from aiocluster_tpu.core.config import Config
+    from aiocluster_tpu.core.identity import NodeId
+    from aiocluster_tpu.runtime.cluster import Cluster
+
+    het = Heterogeneity(zones=2, wan_loss=0.5)
+    cfg = Config(
+        node_id=NodeId(name="x", gossip_advertise_addr=("127.0.0.1", 0)),
+        heterogeneity=het,
+    )
+    cluster = Cluster(cfg)
+    assert cluster.fault_controller is not None
+    assert len(cluster.fault_controller.plan.links) == 2
+    # No heterogeneity, no plan: nothing constructed (byte-identical
+    # fault-free path).
+    plain = Cluster(
+        Config(
+            node_id=NodeId(
+                name="x", gossip_advertise_addr=("127.0.0.1", 0)
+            )
+        )
+    )
+    assert plain.fault_controller is None
+    await asyncio.sleep(0)  # silence unused-loop warnings on some runners
+
+
+def test_zone_biased_sampling():
+    from aiocluster_tpu.runtime.peers import select_gossip_targets
+
+    addrs = [("10.0.0.1", p) for p in range(1, 21)]
+    zone_of = {a: (0 if a[1] <= 10 else 1) for a in addrs}
+    pool = set(addrs)
+    # Full bias: every pick lands in the self zone while same-zone
+    # candidates remain.
+    targets, _, _ = select_gossip_targets(
+        pool, pool, set(), set(), rng=Random(1), gossip_count=5,
+        zone_bias=1.0, self_zone=0, zone_of=zone_of,
+    )
+    assert len(targets) == 5
+    assert all(zone_of[t] == 0 for t in targets)
+    # Zero bias: the reference path — byte-identical sampling to a call
+    # without zone arguments (same rng, same draws).
+    t1, _, _ = select_gossip_targets(
+        pool, pool, set(), set(), rng=Random(2), gossip_count=5,
+    )
+    t2, _, _ = select_gossip_targets(
+        pool, pool, set(), set(), rng=Random(2), gossip_count=5,
+        zone_bias=0.0, self_zone=0, zone_of=zone_of,
+    )
+    assert t1 == t2
+    # Bias exhausts the zone, then falls back to the rest of the pool.
+    t3, _, _ = select_gossip_targets(
+        pool, pool, set(), set(), rng=Random(3), gossip_count=15,
+        zone_bias=1.0, self_zone=0, zone_of=zone_of,
+    )
+    assert len(t3) == 15 and len(set(t3)) == 15
+
+
+async def test_runtime_wan_two_zone_fleet_converges_through_loss():
+    """End to end: a 4-node fleet split over two WAN zones with 40%
+    cross-zone loss still converges (slower, through retries) — the
+    runtime analogue of the sim's WAN mask."""
+    from aiocluster_tpu.faults.runner import ChaosHarness
+
+    het = Heterogeneity(zones=2, wan_loss=0.4)
+    async with ChaosHarness(
+        4,
+        None,
+        gossip_interval=0.05,
+        config_overrides={"heterogeneity": het},
+    ) as h:
+        await h.wait_converged(timeout=25.0)
+        counts = h.fault_counts()
+    # The derived WAN links really injected (drops show up as faults).
+    assert counts.get("drop", 0) > 0

@@ -413,6 +413,7 @@ def test_hostsim_domain_matrix():
     violation is detected (and attributed) independently — so a new
     rung extends the one table and this matrix follows it."""
     from aiocluster_tpu.faults import FaultPlan, LinkFault, NodeSet
+    from aiocluster_tpu.models.topology import Heterogeneity
     from aiocluster_tpu.sim import hostsim
 
     base = SimConfig(n_nodes=128, keys_per_node=8, budget=24,
@@ -455,6 +456,12 @@ def test_hostsim_domain_matrix():
                               dst=NodeSet(frac=(0.5, 1.0)),
                               drop=1.0),
                 ),
+            ),
+        ),
+        "heterogeneity_inert": dataclasses.replace(
+            base,
+            heterogeneity=Heterogeneity(
+                gossip_every=(1, 2), class_frac=(0.5, 0.5)
             ),
         ),
     }
