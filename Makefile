@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -65,6 +65,18 @@ sweep-bench:
 kernel-parity:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fused_kernel.py -q
 
+# Serve tier (benchmarks/serve_bench.py, docs/serving.md): epoch-cached
+# snapshot fan-out against a real loopback fleet. Full scale drives
+# 10k+ child-process long-poll watchers at 64 nodes and GATES on
+# measured encode-once (exactly one payload encode per epoch bump) and
+# a >= 10x cached-vs-per-request-encode reader ratio; the smoke variant
+# (64 watchers, 8 nodes, >= 2x floor) gates CI via `check`.
+serve-bench:
+	$(PY) benchmarks/serve_bench.py
+
+serve-smoke:
+	$(PY) benchmarks/serve_bench.py --smoke
+
 # Multihost smoke (benchmarks/multihost_bench.py): TWO real processes
 # join a localhost coordinator (4 virtual CPU devices each, gloo
 # collectives) and run the sharded lean profile — a measured rounds/s
@@ -75,11 +87,12 @@ multihost-smoke:
 
 # What CI runs; a red suite, dirty lint, new analysis finding, a failed
 # chaos soak, a sweep-amortization regression, a kernel-parity break,
-# a multihost parity/measurement failure, or a red byzantine-atlas
-# baseline cannot land through this gate. (kernel-parity re-runs one
-# test file that test-all also covers — the explicit target keeps the
-# merge gate for kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke test-all
+# a multihost parity/measurement failure, a red byzantine-atlas
+# baseline, or a serve-tier encode-once/ratio regression cannot land
+# through this gate. (kernel-parity re-runs one test file that
+# test-all also covers — the explicit target keeps the merge gate for
+# kernel work nameable and runnable alone.)
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

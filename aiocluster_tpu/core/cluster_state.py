@@ -114,10 +114,18 @@ class ClusterState:
         return tuple(self._node_states)
 
     def node_states(self) -> dict[NodeId, NodeState]:
-        """Shallow copy of the per-node state map — the snapshot surface
-        (Cluster.snapshot), so readers never hold the live dict while
-        gossip mutates it."""
+        """Shallow copy of the per-node state map (live NodeState refs) —
+        for synchronous O(changes) readers, so they never hold the live
+        dict while gossip mutates it."""
         return dict(self._node_states)
+
+    def node_states_copy(self) -> dict[NodeId, NodeState]:
+        """Detached deep copy of every node's state — the snapshot
+        surface (Cluster.snapshot): mutating the fleet afterwards can
+        never retroactively mutate a taken snapshot (delete/TTL rewrite
+        VersionedValues in place, so sharing refs would leak future
+        mutations into it)."""
+        return {nid: ns.copy() for nid, ns in self._node_states.items()}
 
     def seed_addrs(self) -> Sequence[Address]:
         return tuple(self._seed_addrs)

@@ -140,6 +140,25 @@ class NodeState:
             self.node, self.heartbeat, self.last_gc_version, self.max_version
         )
 
+    def copy(self) -> "NodeState":
+        """A detached copy: scalars plus per-key VersionedValue copies
+        (``delete``/``delete_after_ttl`` mutate values IN PLACE, so
+        sharing refs would leak future mutations into snapshots). The
+        copy carries no change hook; its version index rebuilds lazily
+        on first stale scan."""
+        return NodeState(
+            self.node,
+            heartbeat=self.heartbeat,
+            key_values={
+                k: VersionedValue(
+                    vv.value, vv.version, vv.status, vv.status_change_ts
+                )
+                for k, vv in self.key_values.items()
+            },
+            max_version=self.max_version,
+            last_gc_version=self.last_gc_version,
+        )
+
     # -- owner-side writes ---------------------------------------------------
 
     def set(self, key: str, value: str, ts: datetime | None = None) -> None:

@@ -246,10 +246,13 @@ class ChaosHarness:
         return [n for n in self.names if n not in self._crashed]
 
     def sees(self, observer: str, owner: str) -> bool:
-        """Does ``observer`` hold ``owner``'s marker key?"""
+        """Does ``observer`` hold ``owner``'s marker key? (Reads the
+        live state view — convergence polls run O(fleet²) of these, and
+        a detached ``snapshot()`` deep copy per probe would swamp the
+        soak.)"""
         cluster = self.clusters[observer]
         key = f"from-{owner}"
-        for node_id, ns in cluster.snapshot().node_states.items():
+        for node_id, ns in cluster.node_states_view().items():
             if node_id.name == owner and ns.get(key) is not None:
                 return True
         return False

@@ -55,11 +55,22 @@ NodeEventCallback = Callable[[NodeId], Awaitable[None]]
 
 @dataclass(frozen=True, slots=True)
 class ClusterSnapshot:
+    """A point-in-time, *detached* view of the cluster.
+
+    ``epoch`` is the monotonic state generation
+    (``ClusterState.digest_epoch``) at capture: equal epochs imply
+    identical state, which is what the serve tier keys its
+    encode-once-per-epoch payload cache (and HTTP ETags) on. The node
+    states are deep copies — mutating the fleet after ``snapshot()``
+    never mutates an already-taken snapshot.
+    """
+
     cluster_id: str
     self_node_id: NodeId
     node_states: dict[NodeId, NodeState]
     live_nodes: list[NodeId]
     dead_nodes: list[NodeId]
+    epoch: int = 0
 
 
 class Cluster:
@@ -333,10 +344,25 @@ class Cluster:
         return ClusterSnapshot(
             cluster_id=self._config.cluster_id,
             self_node_id=self.self_node_id,
-            node_states=self._cluster_state.node_states(),
+            node_states=self._cluster_state.node_states_copy(),
             live_nodes=self._failure_detector.live_nodes(),
             dead_nodes=self._failure_detector.dead_nodes(),
+            epoch=self._cluster_state.digest_epoch,
         )
+
+    def state_epoch(self) -> int:
+        """The monotonic state generation (``ClusterState.digest_epoch``):
+        bumps on every digest-field or membership change, never
+        regresses. Equal epochs ⇒ identical state — the int the serve
+        tier compares before deciding whether anything needs encoding."""
+        return self._cluster_state.digest_epoch
+
+    def node_states_view(self) -> dict[NodeId, NodeState]:
+        """The *live* per-node states (shallow dict copy, uncopied
+        NodeState refs) for O(changes) synchronous readers — the serve
+        tier's delta scans. Read-only by contract: callers must not
+        mutate, and must not hold it across an await."""
+        return self._cluster_state.node_states()
 
     def hook_stats(self) -> HookStats:
         return self._hooks.stats()
@@ -373,6 +399,23 @@ class Cluster:
 
     def on_key_change(self, callback: KeyChangeCallback) -> None:
         self._on_key_change.append(callback)
+
+    # Removal mirrors registration so embedders with their own lifecycle
+    # (the serve tier's ServeApp, tests) can detach without leaking the
+    # callback — and whatever it closes over — for the cluster's
+    # lifetime. Removing a callback that is not registered is a no-op.
+
+    def remove_on_node_join(self, callback: NodeEventCallback) -> None:
+        with suppress(ValueError):
+            self._on_node_join.remove(callback)
+
+    def remove_on_node_leave(self, callback: NodeEventCallback) -> None:
+        with suppress(ValueError):
+            self._on_node_leave.remove(callback)
+
+    def remove_on_key_change(self, callback: KeyChangeCallback) -> None:
+        with suppress(ValueError):
+            self._on_key_change.remove(callback)
 
     def _emit_key_change(
         self,

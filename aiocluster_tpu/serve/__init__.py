@@ -1,0 +1,36 @@
+"""Serve tier: the service-discovery read path over a running Cluster.
+
+ScuttleButt replication (the runtime) answers "how does every node
+learn the state"; this package answers "how do a fleet's *clients* read
+it" — and the answer must be O(changes), not O(state), per client:
+
+- :mod:`cache` — ``SnapshotCache``: one canonical JSON encode per
+  state epoch, shared as the same ``bytes`` object by every concurrent
+  reader and watcher; epoch-floor history powers ``GET /state?since=E``
+  delta reads off the version-indexed stale scans.
+- :mod:`hub` — ``WatchHub``: the fan-out point. Membership and
+  key-change hooks kick it; bursts coalesce into one publish per epoch;
+  parked long-pollers and bounded-queue stream watchers all receive the
+  single shared encoded payload. Slow stream watchers drop to a counted
+  "resync from snapshot" instead of growing unbounded queues.
+- :mod:`http` — ``ServeApp``: the stdlib-asyncio HTTP surface
+  (``/state`` with ETag/304 and ``?since=`` deltas, ``/watch``
+  long-poll + chunked streaming, the reference example's KV endpoints,
+  ``/metrics``, ``/healthz``).
+
+See docs/serving.md for the endpoint contract and bench methodology
+(benchmarks/serve_bench.py is the 10k-watcher load generator).
+"""
+
+from .cache import EncodedSnapshot, SnapshotCache, encode_snapshot
+from .http import ServeApp
+from .hub import StreamWatcher, WatchHub
+
+__all__ = [
+    "EncodedSnapshot",
+    "ServeApp",
+    "SnapshotCache",
+    "StreamWatcher",
+    "WatchHub",
+    "encode_snapshot",
+]

@@ -1,0 +1,322 @@
+"""Epoch-keyed snapshot encoding: pay the O(state) walk once, share it.
+
+``ClusterState.digest_epoch`` is a monotonic generation that bumps on
+every digest-field or membership change — equal epochs imply identical
+cluster state. ``SnapshotCache`` keys the canonical JSON encoding of
+``Cluster.snapshot()`` on it (the ``make_syn_bytes`` caching pattern
+from the gossip engine, applied at the serving layer): the first reader
+of a new epoch pays one snapshot + one ``json.dumps``; every other
+concurrent reader — and every watcher the hub wakes — gets the same
+``bytes`` object. All methods are synchronous (no awaits), so under
+asyncio a second encode of the same epoch cannot even race in.
+
+In a LIVE fleet the digest epoch also bumps on every gossip heartbeat,
+so raw-epoch caching alone would re-encode per round and make watch
+long-polls degenerate into busy-polls (``epoch_now() > since`` is true
+within one round of any reply). The cache therefore dedups on CONTENT,
+in two tiers: an O(nodes) fingerprint (live/dead membership + every
+node's ``max_version``/``last_gc_version`` — visible state cannot
+change without one of these moving) filters heartbeat-only bumps
+without walking or encoding anything, and when the fingerprint DID
+move but the fresh encode is byte-identical (a same-value rewrite) the
+previous epoch's ``EncodedSnapshot`` keeps serving — identical bytes
+mean identical visible state, so the older validator stays correct.
+Both tiers count as ``dedup`` events and remember the newest cluster
+epoch verified. Watchers and ETags key on the *content* epoch;
+heartbeat-only bumps wake nobody and cost no walk.
+
+Delta reads ride the raw epoch currency: every encode (full, delta, or
+dedup check) records the per-node ``max_version`` floors at that epoch
+in a bounded history, and ``delta_since(E)`` replays only key-versions
+above the client's floors via the version-indexed ``stale_key_values``
+scans — O(changes), never O(state). A floor set that has aged out of
+the history makes ``delta_since`` return None and the caller resyncs
+the client from the full snapshot (counted, by design).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from ..obs.registry import MetricsRegistry
+from ..runtime.cluster import Cluster, ClusterSnapshot
+
+# How many epochs of per-node version floors delta_since keeps. Bounded
+# so a hot fleet cannot grow serve-side memory: one floor set is
+# O(nodes) ints, and a client older than the window just resyncs.
+DEFAULT_FLOOR_HISTORY = 1024
+
+
+def _content_dict(snap: ClusterSnapshot) -> dict:
+    """The epoch-free content of the ``GET /state`` payload: visible
+    key-values only (tombstones and TTL-scheduled keys hidden). Equal
+    *visible* cluster states produce equal dicts regardless of how many
+    heartbeat-only digest-epoch bumps separate them — this is what the
+    cache's dedup compares.
+
+    The per-key value is bound ONCE — the reference example evaluated
+    ``s.get(k)`` twice per key (guard, then value) and a GC between the
+    two evaluations turned a tombstone into ``AttributeError``.
+    """
+    nodes: dict[str, dict[str, str]] = {}
+    for node_id, ns in snap.node_states.items():
+        visible: dict[str, str] = {}
+        for key, vv in ns.key_values.items():
+            if not vv.is_deleted():
+                visible[key] = vv.value
+        nodes[node_id.name] = visible
+    return {
+        "cluster_id": snap.cluster_id,
+        "self": snap.self_node_id.name,
+        "live": sorted(n.name for n in snap.live_nodes),
+        "dead": sorted(n.name for n in snap.dead_nodes),
+        "nodes": nodes,
+    }
+
+
+def _dumps(payload: dict) -> bytes:
+    return json.dumps(payload, separators=(",", ":"), sort_keys=True).encode()
+
+
+def encode_snapshot(snap: ClusterSnapshot) -> bytes:
+    """The canonical ``GET /state`` payload: the content dict plus the
+    snapshot's epoch (the body-level resume token; the ETag carries the
+    same value), deterministic key order so equal states encode to
+    equal bytes."""
+    return _dumps({**_content_dict(snap), "epoch": snap.epoch})
+
+
+@dataclass(frozen=True, slots=True)
+class EncodedSnapshot:
+    """One epoch's encoded payload — the unit the cache shares."""
+
+    epoch: int
+    payload: bytes
+    etag: str  # '"<epoch>"', the HTTP validator form
+
+
+def parse_etag(value: str | None) -> int | None:
+    """The epoch inside an ``If-None-Match`` header value (weak
+    validators and quoting tolerated), or None when absent/garbage."""
+    if not value:
+        return None
+    token = value.strip()
+    if token.startswith(("W/", "w/")):
+        token = token[2:]
+    token = token.strip().strip('"')
+    try:
+        return int(token)
+    except ValueError:
+        return None
+
+
+class SnapshotCache:
+    """Encode-once-per-epoch snapshot fan-out for one serving Cluster."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        *,
+        metrics: MetricsRegistry | None = None,
+        floor_history: int = DEFAULT_FLOOR_HISTORY,
+    ) -> None:
+        self._cluster = cluster
+        self._current: EncodedSnapshot | None = None
+        # _current.payload minus its epoch field: the content bytes the
+        # dedup compares. The payload itself embeds the (raw) epoch, so
+        # comparing payloads would never match across epochs and every
+        # heartbeat bump would re-encode + wake every watcher.
+        self._current_content: bytes | None = None
+        # The content fingerprint at _current's encode: the O(nodes)
+        # first-tier dedup check (see module docstring).
+        self._current_fp: tuple | None = None
+        # Newest cluster epoch verified content-identical to _current
+        # (heartbeat-only bumps): epochs in
+        # [_current.epoch, _checked_epoch] all serve _current.
+        self._checked_epoch: int = -1
+        # epoch -> {node name: max_version at that epoch}; insertion
+        # order is ascending epoch, popped FIFO at the bound.
+        self._floors: OrderedDict[int, dict[str, int]] = OrderedDict()
+        self._floor_history = max(1, floor_history)
+        self._events = None
+        self._bytes_gauge = None
+        if metrics is not None:
+            self._events = metrics.counter(
+                "aiocluster_serve_snapshot_events_total",
+                "Snapshot cache activity: encode (one per served epoch), "
+                "hit (reader shared an existing encode), dedup (newer "
+                "epoch verified content-identical — fingerprint or "
+                "byte compare; previous payload kept), "
+                "not_modified (ETag short-circuit), delta (since= reply "
+                "built), delta_empty (client already current), "
+                "resync_full (since= floor aged out; full payload served)",
+                labels=("event",),
+            )
+            self._bytes_gauge = metrics.gauge(
+                "aiocluster_serve_snapshot_bytes",
+                "Size of the most recently encoded snapshot payload",
+            )
+
+    def _count(self, event: str) -> None:
+        if self._events is not None:
+            self._events.labels(event).inc()
+
+    # -- full snapshots -------------------------------------------------------
+
+    def epoch_now(self) -> int:
+        """The cluster's current state epoch — a cheap int read, the
+        zero-encode short-circuit for ``If-None-Match`` checks."""
+        return self._cluster.state_epoch()
+
+    def note_not_modified(self) -> None:
+        """Count an ETag short-circuit (the 304 path encodes nothing)."""
+        self._count("not_modified")
+
+    def _fingerprint(self) -> tuple:
+        """O(nodes) content-change pre-check: live/dead membership plus
+        every node's version watermarks. Visible content cannot change
+        through the sanctioned mutators without a key write (bumps that
+        node's ``max_version``), a GC pass (``last_gc_version``), or a
+        membership/liveness transition — so an unchanged fingerprint
+        proves a raw-epoch bump was heartbeat-only, with no state walk
+        and no encode."""
+        states = self._cluster.node_states_view()
+        return (
+            tuple(sorted(n.name for n in self._cluster.live_nodes())),
+            tuple(sorted(n.name for n in self._cluster.dead_nodes())),
+            tuple(
+                sorted(
+                    (nid.name, ns.max_version, ns.last_gc_version)
+                    for nid, ns in states.items()
+                )
+            ),
+        )
+
+    def get(self) -> EncodedSnapshot:
+        """The current state's encoded snapshot; walks + encodes only
+        when the epoch moved since the last call, and dedups
+        heartbeat-only bumps (fingerprint tier — no walk) and
+        byte-identical re-encodes (same-value rewrites) to the previous
+        ``EncodedSnapshot``, so churn never invalidates every client's
+        validator."""
+        epoch = self._cluster.state_epoch()
+        current = self._current
+        if current is not None and (
+            current.epoch == epoch or self._checked_epoch == epoch
+        ):
+            self._count("hit")
+            return current
+        fp = self._fingerprint()
+        if current is not None and fp == self._current_fp:
+            # Heartbeat-only bump: no walk, no encode, no floor entry —
+            # a pump polling through churn costs O(nodes) per check and
+            # cannot evict the content epoch's floors from the history.
+            self._checked_epoch = epoch
+            if current.epoch in self._floors:
+                self._floors.move_to_end(current.epoch)
+            self._count("dedup")
+            return current
+        snap = self._cluster.snapshot()
+        content = _content_dict(snap)
+        content_bytes = _dumps(content)
+        self._record_floors(
+            snap.epoch,
+            {n.name: ns.max_version for n, ns in snap.node_states.items()},
+        )
+        self._checked_epoch = snap.epoch
+        self._current_fp = fp
+        if current is not None and content_bytes == self._current_content:
+            self._count("dedup")
+            return current
+        encoded = EncodedSnapshot(
+            epoch=snap.epoch,
+            payload=_dumps({**content, "epoch": snap.epoch}),
+            etag=f'"{snap.epoch}"',
+        )
+        self._current_content = content_bytes
+        self._count("encode")
+        if self._bytes_gauge is not None:
+            self._bytes_gauge.set(len(encoded.payload))
+        self._current = encoded
+        return encoded
+
+    def _record_floors(self, epoch: int, floors: dict[str, int]) -> None:
+        if epoch in self._floors:
+            self._floors.move_to_end(epoch)
+            return
+        self._floors[epoch] = floors
+        while len(self._floors) > self._floor_history:
+            self._floors.popitem(last=False)
+
+    # -- delta reads ----------------------------------------------------------
+
+    def delta_since(self, since: int) -> bytes | None:
+        """The ``GET /state?since=E`` payload: per node, only key-values
+        with versions above the client's floor at epoch ``E`` (straight
+        off the version-indexed stale scans — tombstones included, so
+        deletes replicate to clients too), plus nodes that departed.
+
+        Returns None when ``E`` is not in the floor history (client too
+        far behind, or a made-up epoch): the caller serves the full
+        snapshot instead — the counted "resync" path.
+        """
+        epoch = self._cluster.state_epoch()
+        floors = self._floors.get(since)
+        if floors is None:
+            self._count("resync_full")
+            return None
+        if since >= epoch:
+            self._count("delta_empty")
+            return json.dumps(
+                {"epoch": epoch, "since": since, "delta": {}, "departed": []},
+                separators=(",", ":"),
+                sort_keys=True,
+            ).encode()
+        states = self._cluster.node_states_view()
+        delta: dict[str, dict] = {}
+        new_floors: dict[str, int] = {}
+        present: set[str] = set()
+        for node_id, ns in states.items():
+            name = node_id.name
+            present.add(name)
+            new_floors[name] = ns.max_version
+            floor = floors.get(name, 0)
+            if ns.last_gc_version > floor:
+                # The GC horizon passed the client's knowledge: purged
+                # tombstones can no longer be replayed, so resend this
+                # node's keyspace from scratch (the gossip reset rule,
+                # applied to serve clients).
+                floor = 0
+            if ns.max_version <= floor:
+                continue
+            key_values = {
+                key: {
+                    "value": vv.value,
+                    "version": vv.version,
+                    "status": int(vv.status),
+                }
+                for key, vv in ns.stale_key_values(floor)
+            }
+            delta[name] = {
+                "floor": floor,
+                "max_version": ns.max_version,
+                "last_gc_version": ns.last_gc_version,
+                "key_values": key_values,
+            }
+        departed = sorted(name for name in floors if name not in present)
+        # The reply advertises `epoch`, so the NEXT `since=epoch` request
+        # must find floors for it — record them at build time (O(nodes)).
+        self._record_floors(epoch, new_floors)
+        self._count("delta")
+        return json.dumps(
+            {
+                "epoch": epoch,
+                "since": since,
+                "delta": delta,
+                "departed": departed,
+            },
+            separators=(",", ":"),
+            sort_keys=True,
+        ).encode()

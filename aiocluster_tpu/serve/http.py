@@ -1,0 +1,437 @@
+"""``ServeApp``: the stdlib-asyncio HTTP API over one cluster member.
+
+Endpoints (full contract in docs/serving.md):
+
+  GET  /state                 full snapshot; ``ETag: "<epoch>"``; an
+                              ``If-None-Match`` naming the CURRENT epoch
+                              short-circuits to 304 with zero encodes
+  GET  /state?since=E         delta read: only key-versions above the
+                              client's epoch-E floors (O(changes));
+                              floors aged out of history → full payload
+                              (the counted resync path)
+  GET  /watch?since=E         long-poll: responds the moment the epoch
+                              passes E (or immediately when it already
+                              has), 204 after ``timeout`` seconds idle
+  GET  /watch?since=E&stream=1  chunked stream: one JSON payload chunk
+                              per epoch bump until the client leaves
+  GET  /kv/<key>              this node's visible value
+  PUT  /kv/<key>?v=...[&ttl=1]  owner write (replicates via gossip)
+  DELETE /kv/<key>            owner tombstone
+  POST /kv_mark/<key>         delete-after-TTL mark (reference parity)
+  GET  /metrics               Prometheus text (the cluster's registry)
+  GET  /healthz               liveness
+
+The hot path does zero redundant work per client: every 200 ``/state``
+and every watch wake serves the SnapshotCache's per-epoch ``bytes``;
+``cache_enabled=False`` keeps the naive re-walk-and-re-encode-per-
+request behavior as the benchmark's control arm (and the reference
+example's semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from contextlib import suppress
+from urllib.parse import parse_qs, unquote, urlparse
+
+from ..obs.expo import render_prometheus
+from ..obs.registry import MetricsRegistry
+from ..runtime.cluster import Cluster
+from .cache import SnapshotCache, encode_snapshot, parse_etag
+from .hub import WatchHub
+
+# Long-poll parking ceiling: a client asking for more still gets its
+# 204 heartbeat by then (idle connections stay bounded server-side).
+MAX_LONG_POLL_S = 300.0
+DEFAULT_LONG_POLL_S = 30.0
+
+# Request bodies are read-and-discarded (values ride query params), so
+# there is no reason to buffer more than this before dropping the
+# connection as abusive.
+MAX_BODY_BYTES = 1 << 20
+
+# Header-count ceiling per request: no endpoint needs more, and an
+# uncapped header dict is per-connection unbounded memory (the same
+# discipline ACT026 enforces for queues).
+MAX_HEADERS = 100
+
+_JSON = "application/json"
+_TEXT = "text/plain"
+
+
+class _Request:
+    __slots__ = ("method", "path", "query", "headers")
+
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query: dict[str, list[str]],
+        headers: dict[str, str],
+    ) -> None:
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+
+    def q1(self, name: str) -> str | None:
+        values = self.query.get(name)
+        return values[0] if values else None
+
+
+class ServeApp:
+    """The serve tier for one Cluster: cache + hub + HTTP front."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        *,
+        metrics: MetricsRegistry | None = None,
+        cache_enabled: bool = True,
+        watch_queue_maxsize: int = 2,
+        hub_poll_interval: float = 0.25,
+        floor_history: int = 1024,
+    ) -> None:
+        self._cluster = cluster
+        self._metrics = (
+            metrics if metrics is not None else cluster.metrics_registry()
+        )
+        self.cache_enabled = cache_enabled
+        self.cache = SnapshotCache(
+            cluster, metrics=self._metrics, floor_history=floor_history
+        )
+        self.hub = WatchHub(
+            self.cache,
+            metrics=self._metrics,
+            poll_interval=hub_poll_interval,
+            queue_maxsize=watch_queue_maxsize,
+        )
+        self._requests = self._metrics.counter(
+            "aiocluster_serve_requests_total",
+            "HTTP requests served, by endpoint and status code",
+            labels=("endpoint", "status"),
+        )
+        self._server: asyncio.Server | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+        self.port: int | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Bind, register the hub's hook feeds, start the pump; returns
+        the bound port."""
+        # Membership and key-change hooks kick the hub (dispatched
+        # through the runtime's bounded hook queue — drops cost latency
+        # only; the hub's poll fallback guarantees liveness).
+        self._cluster.on_key_change(self._on_key_change)
+        self._cluster.on_node_join(self._on_membership)
+        self._cluster.on_node_leave(self._on_membership)
+        self.hub.start()
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        # Detach from the cluster's hook feeds: a stopped app must not
+        # keep receiving kick dispatches (crowding the bounded hook
+        # queue) or pin its cache/payloads via the registered closures.
+        self._cluster.remove_on_key_change(self._on_key_change)
+        self._cluster.remove_on_node_join(self._on_membership)
+        self._cluster.remove_on_node_leave(self._on_membership)
+        await self.hub.stop()
+        if self._server is not None:
+            self._server.close()
+            # Parked watch handlers hold open connections; close them so
+            # their tasks finish now instead of at client timeout.
+            for writer in list(self._conns):
+                writer.close()
+                with suppress(Exception):
+                    await writer.wait_closed()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def __aenter__(self) -> "ServeApp":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    async def _on_key_change(self, *_args) -> None:
+        self.hub.kick()
+
+    async def _on_membership(self, *_args) -> None:
+        self.hub.kick()
+
+    # -- HTTP plumbing --------------------------------------------------------
+
+    async def _read_request(
+        self, reader: asyncio.StreamReader
+    ) -> _Request | None:
+        line = await reader.readline()
+        if not line.strip():
+            return None
+        try:
+            method, target, _version = line.decode("latin-1").split()
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            raw = await reader.readline()
+            if raw in (b"\r\n", b"\n", b""):
+                break
+            if len(headers) >= MAX_HEADERS:
+                return None  # header flood: bounded memory, drop it
+            name, _, value = raw.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        try:
+            length = int(headers.get("content-length") or 0)
+        except ValueError:
+            return None  # malformed Content-Length: drop the connection
+        if not 0 <= length <= MAX_BODY_BYTES:
+            return None  # refuse to buffer arbitrary client-claimed sizes
+        if length:
+            await reader.readexactly(length)  # read and discard bodies
+        url = urlparse(target)
+        return _Request(method, url.path, parse_qs(url.query), headers)
+
+    @staticmethod
+    def _response(
+        status: str,
+        body: bytes,
+        content_type: str = _TEXT,
+        extra_headers: tuple[tuple[str, str], ...] = (),
+        keep_alive: bool = True,
+    ) -> bytes:
+        headers = [
+            f"HTTP/1.1 {status}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}",
+            "Connection: " + ("keep-alive" if keep_alive else "close"),
+        ]
+        headers.extend(f"{k}: {v}" for k, v in extra_headers)
+        return ("\r\n".join(headers) + "\r\n\r\n").encode() + body
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """Serve requests on one connection (HTTP/1.1 keep-alive) until
+        the client leaves — watcher fleets reconnect-storm without it."""
+        self._conns.add(writer)
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    return
+                close = request.headers.get("connection", "").lower() == "close"
+                if request.path == "/watch" and request.q1("stream"):
+                    await self._stream_watch(request, writer)
+                    return  # stream ends with the connection
+                endpoint, status, payload = await self._route(request)
+                self._requests.labels(endpoint, status.split()[0]).inc()
+                writer.write(
+                    self._response(
+                        status,
+                        payload[0],
+                        payload[1],
+                        payload[2],
+                        keep_alive=not close,
+                    )
+                )
+                await writer.drain()
+                if close:
+                    return
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+            TimeoutError,
+            asyncio.TimeoutError,
+            OSError,
+            # StreamReader.readline raises ValueError (wrapping
+            # LimitOverrunError) past its 64 KB line limit — an
+            # over-long request/header line is malformed input, not an
+            # unhandled-task-exception event.
+            ValueError,
+        ):
+            pass  # client went away or sent garbage; drop the connection
+        finally:
+            self._conns.discard(writer)
+            writer.close()
+            with suppress(Exception):
+                await writer.wait_closed()
+
+    # -- routing --------------------------------------------------------------
+
+    async def _route(
+        self, request: _Request
+    ) -> tuple[str, str, tuple[bytes, str, tuple[tuple[str, str], ...]]]:
+        """(endpoint label, status line, (body, content type, headers))."""
+        method, path = request.method, request.path
+        if path == "/state" and method == "GET":
+            return ("state",) + self._handle_state(request)
+        if path == "/watch" and method == "GET":
+            return ("watch",) + await self._handle_watch(request)
+        if path == "/metrics" and method == "GET":
+            body = render_prometheus(self._metrics).encode()
+            return (
+                "metrics",
+                "200 OK",
+                (body, "text/plain; version=0.0.4; charset=utf-8", ()),
+            )
+        if path == "/healthz" and method == "GET":
+            return ("healthz", "200 OK", (b"ok\n", _TEXT, ()))
+        parts = [p for p in path.split("/") if p]
+        if len(parts) == 2 and parts[0] == "kv":
+            return ("kv",) + self._handle_kv(request, unquote(parts[1]))
+        if len(parts) == 2 and parts[0] == "kv_mark" and method == "POST":
+            key = unquote(parts[1])
+            if self._cluster.get(key) is not None:
+                self._cluster.delete_after_ttl(key)
+                return ("kv_mark", "200 OK", (b"ok", _TEXT, ()))
+            return ("kv_mark", "404 Not Found", (b"not found", _TEXT, ()))
+        return ("other", "404 Not Found", (b"not found", _TEXT, ()))
+
+    def _handle_state(
+        self, request: _Request
+    ) -> tuple[str, tuple[bytes, str, tuple[tuple[str, str], ...]]]:
+        if not self.cache_enabled:
+            # Control arm (and the pre-serve example's behavior): walk
+            # and encode the full state on every request.
+            body = encode_snapshot(self._cluster.snapshot())
+            return "200 OK", (body, _JSON, ())
+        since_raw = request.q1("since")
+        if since_raw is not None:
+            try:
+                since = int(since_raw)
+            except ValueError:
+                return "400 Bad Request", (b"bad since", _TEXT, ())
+            delta = self.cache.delta_since(since)
+            if delta is not None:
+                return "200 OK", (
+                    delta,
+                    _JSON,
+                    (("ETag", f'"{self.cache.epoch_now()}"'), ("X-Delta", "1")),
+                )
+            # Floors aged out: resync the client with the full payload.
+            encoded = self.cache.get()
+            return "200 OK", (
+                encoded.payload,
+                _JSON,
+                (("ETag", encoded.etag), ("X-Resync", "1")),
+            )
+        client_epoch = parse_etag(request.headers.get("if-none-match"))
+        if client_epoch is not None and client_epoch == self.cache.epoch_now():
+            # Zero encodes on this path: the epoch compare is an int read.
+            self.cache.note_not_modified()
+            return "304 Not Modified", (
+                b"",
+                _JSON,
+                (("ETag", f'"{client_epoch}"'),),
+            )
+        encoded = self.cache.get()
+        if client_epoch is not None and client_epoch == encoded.epoch:
+            # The raw epoch moved (heartbeats) but the cache deduped to
+            # the same content epoch the client already holds.
+            self.cache.note_not_modified()
+            return "304 Not Modified", (b"", _JSON, (("ETag", encoded.etag),))
+        return "200 OK", (
+            encoded.payload,
+            _JSON,
+            (("ETag", encoded.etag),),
+        )
+
+    async def _handle_watch(
+        self, request: _Request
+    ) -> tuple[str, tuple[bytes, str, tuple[tuple[str, str], ...]]]:
+        try:
+            since = int(request.q1("since") or self.cache.epoch_now())
+        except ValueError:
+            return "400 Bad Request", (b"bad since", _TEXT, ())
+        try:
+            timeout = float(request.q1("timeout") or DEFAULT_LONG_POLL_S)
+        except ValueError:
+            return "400 Bad Request", (b"bad timeout", _TEXT, ())
+        if not math.isfinite(timeout):
+            # nan survives min() and makes wait_for never fire — a
+            # ?timeout=nan client would park forever past the ceiling.
+            return "400 Bad Request", (b"bad timeout", _TEXT, ())
+        timeout = min(timeout, MAX_LONG_POLL_S)
+        encoded = await self.hub.wait_newer(since, timeout)
+        if encoded is None:
+            # Timed out ⇒ no content newer than `since` was published.
+            # The resume token must not be the raw epoch_now(): that can
+            # cover a content change the pump has not published yet, and
+            # a client resuming from it would never be woken for that
+            # change. `since` is always safe; cap it at the raw epoch so
+            # a client that overshot (bogus future `since`) realigns.
+            resume = min(since, self.cache.epoch_now())
+            return "204 No Content", (b"", _JSON, (("ETag", f'"{resume}"'),))
+        return "200 OK", (
+            encoded.payload,
+            _JSON,
+            (("ETag", encoded.etag),),
+        )
+
+    async def _stream_watch(
+        self, request: _Request, writer: asyncio.StreamWriter
+    ) -> None:
+        """Chunked streaming watch: one JSON payload chunk per epoch
+        bump. A slow consumer overflows its bounded queue and receives
+        a full resync payload instead of the missed epochs."""
+        self._requests.labels("watch_stream", "200").inc()
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        watcher = self.hub.subscribe()
+        try:
+            since_raw = request.q1("since")
+            if since_raw is not None:
+                try:
+                    since = int(since_raw)
+                except ValueError:
+                    since = None
+                # Catch the client up first when it is behind (content
+                # epoch: heartbeat-only bumps owe it nothing).
+                if since is not None and since < self.cache.epoch_now():
+                    encoded = self.cache.get()
+                    if since < encoded.epoch:
+                        await self._write_chunk(writer, encoded.payload)
+            while True:
+                encoded = await watcher.next()
+                if encoded is None or watcher.closed:
+                    break
+                await self._write_chunk(writer, encoded.payload)
+        finally:
+            watcher.close()
+            with suppress(Exception):
+                await self._write_chunk(writer, b"")  # terminal chunk
+
+    @staticmethod
+    async def _write_chunk(writer: asyncio.StreamWriter, data: bytes) -> None:
+        writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        await writer.drain()
+
+    def _handle_kv(
+        self, request: _Request, key: str
+    ) -> tuple[str, tuple[bytes, str, tuple[tuple[str, str], ...]]]:
+        method = request.method
+        if method == "GET":
+            value = self._cluster.get(key)
+            if value is None:
+                return "404 Not Found", (b"not found", _TEXT, ())
+            return "200 OK", (value.encode(), _TEXT, ())
+        if method == "PUT":
+            value = request.q1("v") or ""
+            if (request.q1("ttl") or "0") in ("1", "true"):
+                self._cluster.set_with_ttl(key, value)
+            else:
+                self._cluster.set(key, value)
+            return "200 OK", (b"ok", _TEXT, ())
+        if method == "DELETE":
+            self._cluster.delete(key)
+            return "200 OK", (b"ok", _TEXT, ())
+        return "405 Method Not Allowed", (b"method not allowed", _TEXT, ())

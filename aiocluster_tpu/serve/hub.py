@@ -1,0 +1,279 @@
+"""Watch fan-out hub: one epoch bump wakes every watcher with one encode.
+
+Design contract (docs/serving.md):
+
+- **Level-triggered.** The hub never trusts an event payload; a kick
+  (from the cluster's membership/key-change hooks) or a poll tick just
+  makes the pump compare ``SnapshotCache.epoch_now()`` against the last
+  published epoch. Hook events ride the runtime's bounded
+  ``HookDispatcher`` queue and may legitimately be DROPPED under load —
+  a drop costs wake latency (bounded by ``poll_interval``), never a
+  missed epoch.
+- **Coalescing.** Any number of kicks between two pump iterations
+  collapse into one publish; a publish encodes once (via the cache) and
+  hands the *same* ``EncodedSnapshot`` to every parked long-poller and
+  every stream watcher.
+- **Backpressure.** Long-pollers are client-paced by construction (one
+  future per request). Stream watchers hold a bounded queue; when it
+  overflows the publish is dropped *and counted* and the watcher is
+  marked lagged — its next read resyncs from the current snapshot
+  instead of replaying missed epochs, so serve-side memory is bounded
+  by ``watchers * queue_maxsize`` payload references, always.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from contextlib import suppress
+
+from ..obs.registry import MetricsRegistry
+from .cache import EncodedSnapshot, SnapshotCache
+
+# A stream watcher that has fallen `queue_maxsize` publishes behind is
+# lagging; 2 keeps worst-case hub memory at ~two shared payload refs per
+# watcher while riding out one slow read.
+DEFAULT_QUEUE_MAXSIZE = 2
+
+# Liveness fallback for dropped hook events: the pump re-checks the
+# epoch this often even with no kicks. Latency floor for a watcher whose
+# wake-up hook was dropped; pure-int compare when nothing changed.
+DEFAULT_POLL_INTERVAL = 0.25
+
+
+class StreamWatcher:
+    """One subscribed streaming client: a bounded queue of shared
+    payloads plus the lagged→resync escape hatch."""
+
+    __slots__ = ("_hub", "_queue", "lagged", "closed")
+
+    def __init__(self, hub: "WatchHub", maxsize: int) -> None:
+        self._hub = hub
+        # None is the close sentinel (hub shutdown / unsubscribe).
+        self._queue: asyncio.Queue[EncodedSnapshot | None] = asyncio.Queue(
+            maxsize=maxsize
+        )
+        self.lagged = False
+        self.closed = False
+
+    def _offer(self, encoded: EncodedSnapshot) -> bool:
+        """Hub-side delivery; False (and lagged) when the queue is full."""
+        try:
+            self._queue.put_nowait(encoded)
+            return True
+        except asyncio.QueueFull:
+            self.lagged = True
+            return False
+
+    def _wake_closed(self) -> None:
+        """Unblock a parked ``next()`` after close (sentinel delivery;
+        a full queue is drained first — the reader is gone anyway)."""
+        self.closed = True
+        try:
+            self._queue.put_nowait(None)
+        except asyncio.QueueFull:
+            while not self._queue.empty():
+                self._queue.get_nowait()
+            self._queue.put_nowait(None)
+
+    async def next(self, timeout: float | None = None) -> EncodedSnapshot | None:
+        """The next payload for this watcher, or None on timeout/close.
+
+        A lagged watcher drains its stale queue and is served the
+        *current* snapshot (one shared cache encode) — it resynchronises
+        instead of silently missing the dropped epochs.
+        """
+        if self.closed:
+            return None
+        if self.lagged:
+            self.lagged = False
+            while not self._queue.empty():
+                self._queue.get_nowait()
+            self._hub.count_watch("resync")
+            return self._hub.cache.get()
+        if timeout is None:
+            return await self._queue.get()
+        try:
+            return await asyncio.wait_for(self._queue.get(), timeout)
+        except (TimeoutError, asyncio.TimeoutError):
+            return None
+
+    def close(self) -> None:
+        self._hub._unsubscribe(self)
+
+
+class WatchHub:
+    """Fan-out of epoch bumps to long-pollers and stream watchers."""
+
+    def __init__(
+        self,
+        cache: SnapshotCache,
+        *,
+        metrics: MetricsRegistry | None = None,
+        poll_interval: float = DEFAULT_POLL_INTERVAL,
+        queue_maxsize: int = DEFAULT_QUEUE_MAXSIZE,
+    ) -> None:
+        self.cache = cache
+        self._poll_interval = poll_interval
+        self._queue_maxsize = max(1, queue_maxsize)
+        self._kick = asyncio.Event()
+        self._pump_task: asyncio.Task | None = None
+        self._published_epoch: int | None = None
+        # fut -> the client's `since` epoch: a publish only wakes the
+        # futures it is actually NEWER than (a waiter parked at the
+        # current epoch must sleep through the pump's first iteration).
+        self._parked: dict[asyncio.Future[EncodedSnapshot], int] = {}
+        self._stream: set[StreamWatcher] = set()
+        self._watch_events = None
+        self._hub_events = None
+        self._watchers_gauge = None
+        if metrics is not None:
+            self._watch_events = metrics.counter(
+                "aiocluster_serve_watch_events_total",
+                "Watcher outcomes: immediate (long-poll answered without "
+                "parking), wake (parked long-poll answered by a publish), "
+                "timeout (long-poll expired empty), stream (payload "
+                "queued to a stream watcher), drop (stream queue full; "
+                "publish dropped, watcher marked lagged), resync (lagged "
+                "watcher served the current snapshot)",
+                labels=("event",),
+            )
+            self._hub_events = metrics.counter(
+                "aiocluster_serve_hub_events_total",
+                "Pump activity: kick (hook-driven wakeups), publish "
+                "(epoch bumps fanned out), idle (pump woke to an "
+                "unchanged epoch)",
+                labels=("event",),
+            )
+            self._watchers_gauge = metrics.gauge(
+                "aiocluster_serve_watchers",
+                "Currently connected watchers (parked long-polls + "
+                "stream subscriptions)",
+            )
+
+    def count_watch(self, event: str) -> None:
+        if self._watch_events is not None:
+            self._watch_events.labels(event).inc()
+
+    def _count_hub(self, event: str) -> None:
+        if self._hub_events is not None:
+            self._hub_events.labels(event).inc()
+
+    def _sync_gauge(self) -> None:
+        if self._watchers_gauge is not None:
+            self._watchers_gauge.set(len(self._parked) + len(self._stream))
+
+    @property
+    def published_epoch(self) -> int | None:
+        return self._published_epoch
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._pump_task is None:
+            # Anchor at the current epoch so the pump's first iteration
+            # is an idle compare, not a spurious publish/encode.
+            self._published_epoch = self.cache.epoch_now()
+            self._pump_task = asyncio.create_task(self._pump())
+
+    async def stop(self) -> None:
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            with suppress(asyncio.CancelledError):  # noqa: ACT013 -- joining our own cancelled pump at shutdown
+                await self._pump_task
+            self._pump_task = None
+        for fut in self._parked:
+            if not fut.done():
+                fut.cancel()
+        self._parked.clear()
+        for watcher in list(self._stream):
+            watcher._wake_closed()
+        self._stream.clear()
+        self._sync_gauge()
+
+    # -- producers ------------------------------------------------------------
+
+    def kick(self) -> None:
+        """Hint that the epoch may have moved (hook callbacks call this;
+        any number of kicks coalesce into the pump's next iteration)."""
+        self._count_hub("kick")
+        self._kick.set()
+
+    async def _pump(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(
+                    self._kick.wait(), timeout=self._poll_interval
+                )
+            except (TimeoutError, asyncio.TimeoutError):
+                pass  # poll tick: liveness through dropped hook events
+            self._kick.clear()
+            if self.cache.epoch_now() == self._published_epoch:
+                self._count_hub("idle")  # pure int compare, no walk
+                continue
+            encoded = self.cache.get()  # ONE encode, shared below
+            if (
+                self._published_epoch is not None
+                and encoded.epoch <= self._published_epoch
+            ):
+                # Heartbeat-only epoch bump: the cache deduped it to the
+                # already-published content. Nobody wakes.
+                self._count_hub("idle")
+                continue
+            self._published_epoch = encoded.epoch
+            self._count_hub("publish")
+            parked, self._parked = self._parked, {}
+            for fut, since in parked.items():
+                if fut.done():
+                    continue
+                if encoded.epoch > since:
+                    fut.set_result(encoded)
+                else:
+                    self._parked[fut] = since  # still not newer: re-park
+            for watcher in self._stream:
+                if watcher._offer(encoded):
+                    self.count_watch("stream")
+                else:
+                    self.count_watch("drop")
+            self._sync_gauge()
+
+    # -- consumers ------------------------------------------------------------
+
+    async def wait_newer(
+        self, since: int, timeout: float
+    ) -> EncodedSnapshot | None:
+        """Long-poll: the current payload immediately when the *content*
+        is already past ``since``, otherwise the next publish (shared
+        object), or None when ``timeout`` elapses first. Heartbeat-only
+        epoch bumps dedup in the cache and park the caller — a live
+        fleet's long-polls stay long, not busy-polls."""
+        if self.cache.epoch_now() > since:
+            encoded = self.cache.get()
+            if encoded.epoch > since:
+                self.count_watch("immediate")
+                return encoded
+        fut: asyncio.Future[EncodedSnapshot] = (
+            asyncio.get_running_loop().create_future()
+        )
+        self._parked[fut] = since
+        self._sync_gauge()
+        try:
+            encoded = await asyncio.wait_for(fut, timeout)
+            self.count_watch("wake")
+            return encoded
+        except (TimeoutError, asyncio.TimeoutError):
+            self.count_watch("timeout")
+            return None
+        finally:
+            self._parked.pop(fut, None)
+            self._sync_gauge()
+
+    def subscribe(self) -> StreamWatcher:
+        watcher = StreamWatcher(self, self._queue_maxsize)
+        self._stream.add(watcher)
+        self._sync_gauge()
+        return watcher
+
+    def _unsubscribe(self, watcher: StreamWatcher) -> None:
+        watcher._wake_closed()
+        self._stream.discard(watcher)
+        self._sync_gauge()

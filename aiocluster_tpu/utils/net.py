@@ -1,0 +1,24 @@
+"""Loopback port allocation shared by benchmarks and test harnesses.
+
+All sockets are held open until every port is picked so the kernel
+cannot hand the same ephemeral port out twice within one call — the
+usual bind-then-close race when ports are allocated one at a time.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def free_ports(n: int) -> list[int]:
+    """``n`` distinct free loopback TCP ports."""
+    socks: list[socket.socket] = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()

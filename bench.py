@@ -685,6 +685,19 @@ def byzantine_atlas_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def serve_tier_bench(log, smoke: bool) -> dict | None:
+    """The serve-tier datum (benchmarks/serve_bench.py): epoch-cached
+    snapshot fan-out against a real loopback fleet — 10k child-process
+    long-poll watchers (64 in smoke) with measured encodes-per-epoch
+    (must be ~1, not ~watchers) and wake p50/p99, plus the closed-loop
+    cached vs walk-and-encode-per-request control reader ratio
+    (docs/serving.md). The read path toward the millions-of-clients
+    north star rides every record."""
+    return _run_benchmarks_helper(
+        "serve_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 # Hard cap on the stdout record line. Round 3's full record grew to
 # ~4.5 KB and the driver's capture kept only an unparseable tail
 # (BENCH_r03.json "parsed": null); the compact line stays ~an order of
@@ -696,6 +709,10 @@ STDOUT_LINE_CAP = 2000
 # least-essential provenance first; the headline fields
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
+    "serve_encodes_per_epoch",
+    "serve_cached_vs_control",
+    "serve_watch_p99_ms",
+    "serve_snapshots_per_sec",
     "atlas_cells",
     "byzantine_tolerated_frac",
     "budget",
@@ -769,6 +786,20 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
             "byzantine_tolerated_frac"
         ),
         "atlas_cells": (ex.get("byzantine_atlas") or {}).get("atlas_cells"),
+        # Serve tier: cached-read throughput, 10k-watcher wake p99, and
+        # the measured encode-once + vs-control evidence (serve_bench).
+        "serve_snapshots_per_sec": (ex.get("serve_bench") or {}).get(
+            "serve_snapshots_per_sec"
+        ),
+        "serve_watch_p99_ms": (ex.get("serve_bench") or {}).get(
+            "serve_watch_p99_ms"
+        ),
+        "serve_cached_vs_control": (ex.get("serve_bench") or {}).get(
+            "cached_vs_control"
+        ),
+        "serve_encodes_per_epoch": (ex.get("serve_bench") or {}).get(
+            "encodes_per_epoch"
+        ),
         # S-lane sweep throughput + compile amortization (sweep_bench).
         "sim_sweep_lane_rounds_per_sec": (ex.get("sweep_bench") or {}).get(
             "sim_sweep_lane_rounds_per_sec"
@@ -1390,6 +1421,10 @@ def main() -> None:
         # bit-parity asserted (benchmarks/multihost_bench.py); on every
         # record — the MULTICHIP smoke line grew into a figure.
         mh_rec = multihost_bench(log, args.smoke)
+        # Serve tier: snapshot fan-out + long-poll watchers against a
+        # real loopback fleet (benchmarks/serve_bench.py) — 10k
+        # watchers in full runs, 64 in smoke.
+        serve_rec = serve_tier_bench(log, args.smoke)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1460,6 +1495,9 @@ def main() -> None:
                 "sweep_bench": sweep_rec,
                 # 2-process multihost mesh, measured + parity-gated.
                 "multihost_bench": mh_rec,
+                # Serve tier: encode-once fan-out measured against a
+                # per-request-encode control arm (serve_bench.py).
+                "serve_bench": serve_rec,
                 # The memory ladder's planning claims (per-rung B/pair,
                 # modeled max scale) — every entry certified: false
                 # until the chip calibrates the new paths.

@@ -33,7 +33,6 @@ import argparse
 import asyncio
 import json
 import os
-import socket
 import sys
 import time
 
@@ -44,17 +43,7 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 
-def _free_ports(n: int) -> list[int]:
-    socks = []
-    try:
-        for _ in range(n):
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-        return [s.getsockname()[1] for s in socks]
-    finally:
-        for s in socks:
-            s.close()
+from aiocluster_tpu.utils.net import free_ports  # noqa: E402  (needs the repo-root path above)
 
 
 def _filler_delta(n_nodes: int, keys_per_node: int):
@@ -94,7 +83,7 @@ async def _bench_arm(
     from aiocluster_tpu import Cluster, Config, NodeId
     from aiocluster_tpu.obs import MetricsRegistry
 
-    p_a, p_b = _free_ports(2)
+    p_a, p_b = free_ports(2)
     registries = [MetricsRegistry(), MetricsRegistry()]
     clusters = [
         Cluster(

@@ -73,6 +73,10 @@ def _import_ours():
 
 
 def _free_ports(n: int) -> list[int]:
+    # Deliberately NOT aiocluster_tpu.utils.net.free_ports: the
+    # reference arm must run without the repo root ever entering
+    # sys.path (only _import_ours adds it), so this file keeps a
+    # dependency-free copy.
     import socket
 
     socks = []

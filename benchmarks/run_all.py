@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import contextlib
 import functools
 import json
 import os
@@ -30,6 +29,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from aiocluster_tpu.utils.aio import timeout_after  # noqa: E402  (needs the repo-root path above)
+from aiocluster_tpu.utils.net import free_ports  # noqa: E402  (needs the repo-root path above)
 
 
 def log(msg: str) -> None:
@@ -73,23 +73,9 @@ def _mtu_budget() -> int:
 # -- config 1: asyncio 3-node loopback cluster --------------------------------
 
 
-def _free_ports(n: int) -> list[int]:
-    import socket
-
-    ports = []
-    with contextlib.ExitStack() as stack:
-        # Hold ALL sockets open while choosing, so the kernel can't hand
-        # the same ephemeral port out twice within one call.
-        for _ in range(n):
-            s = stack.enter_context(socket.socket())
-            s.bind(("127.0.0.1", 0))
-            ports.append(s.getsockname()[1])
-    return ports
-
-
 async def _boot_loopback_clusters(
     gossip_interval: float,
-    choose_ports=_free_ports,
+    choose_ports=free_ports,
     attempts: int = 5,
 ):
     """Start the 3-node ring-seeded loopback cluster, retrying with fresh

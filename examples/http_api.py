@@ -2,27 +2,31 @@
 FastAPI example examples/api/app.py, built on stdlib asyncio only since this
 image ships no fastapi).
 
-Endpoints:
-  GET  /state            -> cluster snapshot as JSON
+The handlers are the production serve tier (``aiocluster_tpu.serve``,
+docs/serving.md) rather than hand-rolled HTTP parsing — which also
+means the example gains the epoch-cached read path for free:
+
+  GET  /state            -> cluster snapshot as JSON (ETag = state epoch,
+                            If-None-Match -> 304, ?since=E -> delta)
+  GET  /watch            -> long-poll for the next state change
   GET  /kv/<key>         -> this node's value for <key>
   PUT  /kv/<key>?v=...   -> set <key> on this node (replicates via gossip)
   PUT  /kv/<key>?v=...&ttl=1 -> set <key> with the TTL mark already applied
   DELETE /kv/<key>       -> tombstone <key>
   POST /kv_mark/<key>    -> mark <key> delete-after-TTL (reference
                             examples/api/app.py:100-113 /kv_mark parity)
+  GET  /metrics          -> Prometheus text for this node's registry
 
 Run two nodes and watch state replicate:
   python examples/http_api.py --port 8001 --gossip 7001 --seed 7002
   python examples/http_api.py --port 8002 --gossip 7002 --seed 7001
   curl -X PUT 'localhost:8001/kv/color?v=red'; sleep 2
   curl localhost:8002/state
+  curl 'localhost:8002/watch?since=0'   # parks until the next change
 """
 
 import argparse
 import asyncio
-import dataclasses
-import json
-from urllib.parse import parse_qs, urlparse
 
 import sys
 from pathlib import Path
@@ -30,26 +34,14 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root run
 
 from aiocluster_tpu import Cluster, Config, NodeId
+from aiocluster_tpu.serve import ServeApp, encode_snapshot
 
 
 def snapshot_json(cluster: Cluster) -> str:
-    snap = cluster.snapshot()
-    return json.dumps(
-        {
-            "cluster_id": snap.cluster_id,
-            "self": snap.self_node_id.name,
-            "live": [n.name for n in snap.live_nodes],
-            "dead": [n.name for n in snap.dead_nodes],
-            "nodes": {
-                n.name: {
-                    k: s.get(k).value for k in list(s.key_values) if s.get(k)
-                }
-                for n, s in snap.node_states.items()
-            },
-            "hook_stats": dataclasses.asdict(cluster.hook_stats()),
-        },
-        indent=2,
-    )
+    """The /state payload for a cluster (kept for importers of this
+    example; the server below serves the identical bytes from the
+    per-epoch cache instead of re-encoding per request)."""
+    return encode_snapshot(cluster.snapshot()).decode()
 
 
 async def serve_http(
@@ -58,59 +50,14 @@ async def serve_http(
     """Serve the HTTP API until cancelled. ``started`` (when given) is
     set once the listening socket is bound — callers that fire requests
     immediately (tests) wait on it instead of sleeping."""
-    async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        try:
-            request = await reader.readline()
-            while (await reader.readline()).strip():
-                pass  # drain headers
-            try:
-                method, target, _ = request.decode().split()
-            except ValueError:
-                return
-            url = urlparse(target)
-            parts = url.path.strip("/").split("/")
-            status, body = "404 Not Found", "not found"
-            if url.path == "/state" and method == "GET":
-                status, body = "200 OK", snapshot_json(cluster)
-            elif len(parts) == 2 and parts[0] == "kv":
-                key = parts[1]
-                if method == "GET":
-                    value = cluster.get(key)
-                    if value is not None:
-                        status, body = "200 OK", value
-                elif method == "PUT":
-                    query = parse_qs(url.query)
-                    value = query.get("v", [""])[0]
-                    if query.get("ttl", ["0"])[0] in ("1", "true"):
-                        cluster.set_with_ttl(key, value)
-                    else:
-                        cluster.set(key, value)
-                    status, body = "200 OK", "ok"
-                elif method == "DELETE":
-                    cluster.delete(key)
-                    status, body = "200 OK", "ok"
-            elif (
-                len(parts) == 2 and parts[0] == "kv_mark" and method == "POST"
-            ):
-                # Grace-period delete: replicas keep serving the key until
-                # its TTL elapses, then it tombstones cluster-wide.
-                if cluster.get(parts[1]) is not None:
-                    cluster.delete_after_ttl(parts[1])
-                    status, body = "200 OK", "ok"
-            payload = body.encode()
-            writer.write(
-                f"HTTP/1.1 {status}\r\nContent-Length: {len(payload)}\r\n"
-                f"Content-Type: text/plain\r\n\r\n".encode() + payload
-            )
-            await writer.drain()
-        finally:
-            writer.close()
-
-    server = await asyncio.start_server(handle, "127.0.0.1", port)
+    app = ServeApp(cluster)
+    await app.start("127.0.0.1", port)
     if started is not None:
         started.set()
-    async with server:
-        await server.serve_forever()
+    try:
+        await asyncio.Event().wait()  # serve until cancelled
+    finally:
+        await app.stop()
 
 
 async def main() -> None:

@@ -1,0 +1,14 @@
+# analyze-domain: serve
+"""TN: bounded queues (literal and variable maxsize), and an unbounded
+queue OUTSIDE the runtime/serve domains is out of scope (this file
+opts into "serve", so everything here must be bounded — the variable
+case is accepted as the binding site's contract)."""
+
+import asyncio
+
+
+class Hub:
+    def __init__(self, maxsize: int):
+        self.events = asyncio.Queue(maxsize=8)
+        self.configured = asyncio.Queue(maxsize=maxsize)
+        self.positional = asyncio.Queue(16)

@@ -1,0 +1,15 @@
+# analyze-domain: serve
+"""TP: unbounded asyncio queues on the runtime/serve dispatch paths —
+no maxsize, an explicit literal 0, a negative maxsize (asyncio treats
+any maxsize <= 0 as infinite), and a 0-maxsize LifoQueue."""
+
+import asyncio
+
+
+class Hub:
+    def __init__(self):
+        self.events = asyncio.Queue()  # unbounded: slow consumer -> OOM
+        self.infinite = asyncio.Queue(maxsize=0)  # 0 means unbounded
+        self.ported = asyncio.Queue(-1)  # other APIs' unbounded idiom
+        self.negative_kw = asyncio.Queue(maxsize=-1)
+        self.stack = asyncio.LifoQueue(0)

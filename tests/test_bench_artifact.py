@@ -99,6 +99,21 @@ def _worst_case_result():
                 "nodes": 65_536, "planner_limit_nodes": 65_536,
                 "profile": "lean", "rounds_per_sec": 6.1,
             },
+            "serve_bench": {
+                "n_nodes": 64,
+                "watchers": 10_000,
+                "watchers_connected": 10_000,
+                "watch_epoch_bumps": 5,
+                "watch_encodes": 5,
+                "encodes_per_epoch": 1.0,
+                "serve_watch_p50_ms": 1650.4,
+                "serve_watch_p99_ms": 3380.18,
+                "serve_snapshots_per_sec": 785.2,
+                "control_snapshots_per_sec": 32.6,
+                "cached_vs_control": 24.09,
+                "not_modified_per_sec": 1771.6,
+                "smoke": False,
+            },
             "fd_kernel": False,
             "xla_path_rounds_per_sec": 43.2,
             "pallas_speedup": 1.56,
@@ -135,6 +150,13 @@ def test_stdout_line_stays_under_cap():
     assert ex["roofline_fraction_of_peak"] == 0.467
     assert ex["max_scale_nodes"] == 65_536
     assert ex["full_record"] == "benchmarks/records/bench_last_run.json"
+    # The serve-tier keys round-trip the writer as flat scalars: the
+    # cached-read rate, the 10k-watcher wake p99, and the measured
+    # encode-once + vs-control evidence.
+    assert ex["serve_snapshots_per_sec"] == 785.2
+    assert ex["serve_watch_p99_ms"] == 3380.18
+    assert ex["serve_cached_vs_control"] == 24.09
+    assert ex["serve_encodes_per_epoch"] == 1.0
     # The on-chip pointer survives a CPU fallback as scalars.
     assert ex["last_onchip_value"] > 1
     # And no nested structures sneak back in (flat extras only).

@@ -45,8 +45,8 @@ def test_config1_retries_port_collision():
             def choose(n):
                 calls["n"] += 1
                 if calls["n"] == 1:
-                    return [taken] + mod._free_ports(n - 1)
-                return mod._free_ports(n)
+                    return [taken] + mod.free_ports(n - 1)
+                return mod.free_ports(n)
 
             clusters = await mod._boot_loopback_clusters(0.05, choose_ports=choose)
             try:

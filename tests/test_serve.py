@@ -1,0 +1,748 @@
+"""Serve tier (aiocluster_tpu/serve, docs/serving.md).
+
+Pins the tentpole contracts:
+- snapshot epoch + immutability (mutating the fleet after ``snapshot()``
+  never mutates an already-taken snapshot);
+- SnapshotCache encode-once-per-epoch, asserted via the serve METRICS
+  counters with concurrent HTTP readers (not by code inspection);
+- ``If-None-Match`` on the current epoch → 304 with ZERO encodes;
+- ``GET /state?since=E`` differential-tested against a full-snapshot
+  diff oracle (only key-versions above the client's epoch-E floors);
+- watch long-poll / chunked streaming, hub burst coalescing;
+- backpressure: a slow stream watcher's bounded queue drops are counted
+  and its next read resyncs from the snapshot — never unbounded memory;
+- a full HookDispatcher queue feeding the hub costs wake LATENCY only
+  (poll fallback), never a missed epoch;
+- chaos availability: watchers long-polling through a healed
+  split-brain observe monotonically non-decreasing epochs and converge
+  to the same final state a direct ``cluster.snapshot()`` reports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from contextlib import suppress
+
+from conftest import wait_for
+
+from aiocluster_tpu import Cluster, Config, NodeId
+from aiocluster_tpu.core import (
+    Delta,
+    KeyValueUpdate,
+    NodeDelta,
+    VersionStatusEnum,
+)
+from aiocluster_tpu.core.identity import NodeId as CoreNodeId
+from aiocluster_tpu.faults.runner import ChaosHarness
+from aiocluster_tpu.faults.scenarios import split_brain
+from aiocluster_tpu.obs import MetricsRegistry
+from aiocluster_tpu.serve import ServeApp, SnapshotCache, encode_snapshot
+from aiocluster_tpu.utils.aio import timeout_after
+
+
+def _make_cluster(port: int, registry=None, **overrides) -> Cluster:
+    return Cluster(
+        Config(
+            node_id=NodeId(
+                name=f"serve-{port}",
+                gossip_advertise_addr=("127.0.0.1", port),
+            ),
+            cluster_id="serve-test",
+            gossip_interval=60.0,  # quiescent: tests drive every change
+            **overrides,
+        ),
+        metrics=registry if registry is not None else MetricsRegistry(),
+    )
+
+
+def _filler_delta(names: list[str], keys: int, base_version: int = 0) -> Delta:
+    """Replica state installed through the sanctioned apply_delta path."""
+    return Delta(
+        node_deltas=[
+            NodeDelta(
+                node_id=CoreNodeId(name, 1, ("10.9.0.1", 9000 + i)),
+                from_version_excluded=base_version,
+                last_gc_version=0,
+                key_values=[
+                    KeyValueUpdate(
+                        f"key-{j:03d}",
+                        f"{name}:{base_version + j + 1}",
+                        base_version + j + 1,
+                        VersionStatusEnum.SET,
+                    )
+                    for j in range(keys)
+                ],
+                max_version=base_version + keys,
+            )
+            for i, name in enumerate(names)
+        ]
+    )
+
+
+async def _request(
+    port: int,
+    method: str,
+    path: str,
+    headers: tuple[tuple[str, str], ...] = (),
+) -> tuple[str, dict[str, str], bytes]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        extra = "".join(f"{k}: {v}\r\n" for k, v in headers)
+        writer.write(
+            f"{method} {path} HTTP/1.1\r\nHost: t\r\n{extra}\r\n".encode()
+        )
+        await writer.drain()
+        status = (await reader.readline()).decode().split(" ", 1)[1].strip()
+        hdrs: dict[str, str] = {}
+        while True:
+            line = (await reader.readline()).decode().strip()
+            if not line:
+                break
+            name, _, value = line.partition(":")
+            hdrs[name.lower()] = value.strip()
+        body = b""
+        length = int(hdrs.get("content-length") or 0)
+        if length:
+            body = await reader.readexactly(length)
+        return status, hdrs, body
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+def _serve_events(registry, event: str) -> int:
+    key = f"aiocluster_serve_snapshot_events_total{{event={event}}}"
+    return int(registry.snapshot().get(key, 0))
+
+
+def _watch_events(registry, event: str) -> int:
+    key = f"aiocluster_serve_watch_events_total{{event={event}}}"
+    return int(registry.snapshot().get(key, 0))
+
+
+# -- snapshot epoch + immutability (runtime satellite) ------------------------
+
+
+async def test_snapshot_carries_epoch_and_is_immutable(free_port):
+    c = _make_cluster(free_port)
+    c.set("color", "red")
+    c.set("shape", "round")
+    snap = c.snapshot()
+    assert snap.epoch == c.state_epoch() > 0
+
+    # Owner mutations after the snapshot: overwrite, tombstone, TTL.
+    c.set("color", "blue")
+    c.delete("shape")
+    c.set("new", "later")
+    ns = {n.name: s for n, s in snap.node_states.items()}[c.self_node_id.name]
+    assert ns.get("color").value == "red"  # not "blue"
+    assert ns.get("shape").value == "round"  # not tombstoned in the snapshot
+    assert ns.get("new") is None
+    # And the epoch moved on, monotonically.
+    snap2 = c.snapshot()
+    assert snap2.epoch > snap.epoch
+    assert c.snapshot().epoch >= snap2.epoch
+
+
+async def test_snapshot_immune_to_replica_deltas(free_port):
+    c = _make_cluster(free_port)
+    c._cluster_state.apply_delta(_filler_delta(["peer-a"], 3))
+    snap = c.snapshot()
+    # A later delta rewrites peer-a's keyspace at higher versions.
+    c._cluster_state.apply_delta(_filler_delta(["peer-a"], 3, base_version=10))
+    ns = {n.name: s for n, s in snap.node_states.items()}["peer-a"]
+    assert ns.get("key-000").value == "peer-a:1"
+    assert ns.max_version == 3
+
+
+# -- SnapshotCache ------------------------------------------------------------
+
+
+async def test_cache_encodes_once_per_epoch(free_port):
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("a", "1")
+    cache = SnapshotCache(c, metrics=reg)
+    first = cache.get()
+    for _ in range(10):
+        assert cache.get() is first  # the SAME bytes object, shared
+    assert _serve_events(reg, "encode") == 1
+    assert _serve_events(reg, "hit") == 10
+    c.set("a", "2")  # epoch bump
+    second = cache.get()
+    assert second.epoch > first.epoch
+    assert _serve_events(reg, "encode") == 2
+
+
+async def test_encode_snapshot_shape_and_tombstone_hiding(free_port):
+    c = _make_cluster(free_port)
+    c.set("live", "yes")
+    c.set("gone", "soon")
+    c.delete("gone")
+    payload = json.loads(encode_snapshot(c.snapshot()))
+    me = c.self_node_id.name
+    assert payload["cluster_id"] == "serve-test"
+    assert payload["self"] == me
+    assert payload["epoch"] == c.state_epoch()
+    assert payload["nodes"][me]["live"] == "yes"
+    assert "gone" not in payload["nodes"][me]  # tombstones hidden
+
+
+# -- HTTP: encode-once with concurrent readers, ETag/304 ----------------------
+
+
+async def test_concurrent_readers_share_one_encode(free_port):
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("svc", "addr")
+    async with c:
+        app = ServeApp(c)
+        port = await app.start()
+        # Settle to one cached epoch (boot heartbeats bump it), then
+        # measure: N concurrent readers across one fresh epoch bump.
+        app.cache.get()
+        c.set("svc", "addr-2")  # THE epoch bump under test
+        before = _serve_events(reg, "encode")
+        results = await asyncio.gather(
+            *(_request(port, "GET", "/state") for _ in range(32))
+        )
+        assert all(status == "200 OK" for status, _, _ in results)
+        bodies = {body for _, _, body in results}
+        assert len(bodies) == 1  # every reader saw the same payload
+        # Exactly ONE encode for 32 concurrent readers of the new epoch.
+        assert _serve_events(reg, "encode") - before == 1
+        await app.stop()
+
+
+async def test_heartbeat_only_bumps_dedup_and_wake_nobody(free_port):
+    """A LIVE fleet bumps the digest epoch every gossip round via
+    heartbeats. The cache must dedup those to the already-served
+    CONTENT (same ETag, zero new encodes) and the hub must not wake a
+    parked long-poll — the regression here was comparing payloads WITH
+    the epoch field baked in, which never matched, re-encoding per
+    heartbeat and busy-waking every watcher."""
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("svc", "addr")
+    async with c:
+        app = ServeApp(c, hub_poll_interval=0.02)
+        port = await app.start()
+        status, hdrs, body = await _request(port, "GET", "/state")
+        assert status == "200 OK"
+        etag = hdrs["etag"]
+        served_epoch = json.loads(body)["epoch"]
+        encodes = _serve_events(reg, "encode")
+
+        task = asyncio.ensure_future(
+            _request(port, "GET", f"/watch?since={served_epoch}&timeout=5")
+        )
+        await wait_for(lambda: len(app.hub._parked) == 1)
+
+        # Heartbeat-only churn: the raw epoch moves, the content does
+        # not. Let the pump observe several bumps.
+        for _ in range(5):
+            c.self_node_state().inc_heartbeat()
+            await asyncio.sleep(0.05)
+        assert c.state_epoch() > served_epoch  # the churn really bumped
+        assert not task.done(), "watcher woke on heartbeat-only churn"
+        assert _serve_events(reg, "encode") == encodes  # dedup, not encode
+        assert _serve_events(reg, "dedup") >= 1
+        # The validator survives the churn: same ETag, 304, zero walks
+        # on the short-circuit-after-dedup path.
+        status2, hdrs2, _ = await _request(
+            port, "GET", "/state", headers=(("If-None-Match", etag),)
+        )
+        assert status2 == "304 Not Modified" and hdrs2["etag"] == etag
+        # A watch that times out during the churn must hand back the
+        # client's own `since` as the resume token — NOT the raw epoch,
+        # which could cover a not-yet-published content change and make
+        # the client skip it forever.
+        status_t, hdrs_t, _ = await _request(
+            port, "GET", f"/watch?since={served_epoch}&timeout=0.05"
+        )
+        assert status_t == "204 No Content"
+        assert hdrs_t["etag"] == f'"{served_epoch}"'
+
+        # A real content change publishes exactly once and wakes it.
+        c.set("svc", "addr-2")
+        status3, _, body3 = await asyncio.wait_for(task, 5)
+        assert status3 == "200 OK"
+        doc = json.loads(body3)
+        assert doc["nodes"][c.self_node_id.name]["svc"] == "addr-2"
+        assert _serve_events(reg, "encode") == encodes + 1
+        await app.stop()
+
+
+async def test_heartbeat_churn_cannot_evict_delta_floors(free_port):
+    """Heartbeat-only dedup checks must not append floor-history
+    entries: with a bounded history, per-poll recording would evict the
+    one content-epoch entry every full-GET client actually holds and
+    degrade ``?since=`` to full resyncs on a QUIET fleet."""
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("k", "v")
+    async with c:
+        app = ServeApp(c, floor_history=4)
+        content_epoch = app.cache.get().epoch
+        # Far more heartbeat-only churn + pump-style polls than the
+        # history holds.
+        for _ in range(16):
+            c.self_node_state().inc_heartbeat()
+            app.cache.get()
+        assert app.cache.delta_since(content_epoch) is not None
+        assert _serve_events(reg, "resync_full") == 0
+
+
+async def test_malformed_content_length_drops_connection_cleanly(free_port):
+    """'Content-Length: abc' (or an absurd size) must close that
+    connection without an unhandled task exception — and the server
+    keeps serving new connections."""
+    c = _make_cluster(free_port)
+    c.set("k", "v")
+    async with c:
+        app = ServeApp(c)
+        port = await app.start()
+        flood = "".join(f"X-{i}: a\r\n" for i in range(200))
+        for bad_request in (
+            "PUT /kv/x?v=1 HTTP/1.1\r\nHost: t\r\nContent-Length: abc\r\n\r\n",
+            f"PUT /kv/x?v=1 HTTP/1.1\r\nContent-Length: {1 << 40}\r\n\r\n",
+            f"GET /state HTTP/1.1\r\n{flood}\r\n",  # header flood
+            f"GET /{'a' * (80 << 10)} HTTP/1.1\r\n\r\n",  # over-long line
+        ):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(bad_request.encode())
+            await writer.drain()
+            assert await reader.read() == b""  # dropped, no response
+            writer.close()
+            with suppress(Exception):
+                await writer.wait_closed()
+        # A non-finite long-poll timeout must not park forever.
+        status, _, _ = await _request(port, "GET", "/watch?timeout=nan")
+        assert status == "400 Bad Request"
+        status, _, _ = await _request(port, "GET", "/state")
+        assert status == "200 OK"  # server unharmed
+        await app.stop()
+
+
+async def test_stop_detaches_cluster_hooks(free_port):
+    """ServeApp.stop() must unregister its hook callbacks — a stopped
+    (or restarted) app may not keep receiving kick dispatches through
+    the bounded hook queue or pin its cache via the registered
+    closures."""
+    c = _make_cluster(free_port)
+    async with c:
+        baseline = (
+            len(c._on_node_join),
+            len(c._on_node_leave),
+            len(c._on_key_change),
+        )
+        app = ServeApp(c)
+        await app.start()
+        assert len(c._on_key_change) == baseline[2] + 1
+        await app.stop()
+        assert baseline == (
+            len(c._on_node_join),
+            len(c._on_node_leave),
+            len(c._on_key_change),
+        )
+        # Restart serves again; a second stop stays a no-op.
+        port = await app.start()
+        status, _, _ = await _request(port, "GET", "/healthz")
+        assert status == "200 OK"
+        await app.stop()
+        await app.stop()
+        assert len(c._on_key_change) == baseline[2]
+
+
+async def test_if_none_match_304_with_zero_encodes(free_port):
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("k", "v")
+    async with c:
+        app = ServeApp(c)
+        port = await app.start()
+        status, hdrs, body = await _request(port, "GET", "/state")
+        assert status == "200 OK" and hdrs["etag"]
+        encodes = _serve_events(reg, "encode")
+        status2, hdrs2, body2 = await _request(
+            port, "GET", "/state", headers=(("If-None-Match", hdrs["etag"]),)
+        )
+        assert status2 == "304 Not Modified"
+        assert body2 == b""
+        assert hdrs2["etag"] == hdrs["etag"]
+        assert _serve_events(reg, "encode") == encodes  # ZERO new encodes
+        assert _serve_events(reg, "not_modified") == 1
+        # A stale validator still gets the full body.
+        c.set("k", "v2")
+        status3, _, body3 = await _request(
+            port, "GET", "/state", headers=(("If-None-Match", hdrs["etag"]),)
+        )
+        assert status3 == "200 OK" and body3
+        await app.stop()
+
+
+# -- delta reads: differential oracle -----------------------------------------
+
+
+def _snapshot_versions(snap) -> dict[str, dict[str, int]]:
+    return {
+        n.name: {k: vv.version for k, vv in ns.key_values.items()}
+        for n, ns in snap.node_states.items()
+    }
+
+
+async def test_delta_since_matches_full_snapshot_diff_oracle(free_port):
+    """GET /state?since=E must return exactly the key-versions above the
+    client's epoch-E floors — differential-tested against the diff of
+    two full snapshots (the oracle never looks at the delta code)."""
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("own-a", "1")
+    c._cluster_state.apply_delta(_filler_delta(["p0", "p1", "p2"], 4))
+    async with c:
+        app = ServeApp(c)
+        port = await app.start()
+        # Pin epoch E (and its floors) by reading the full state once.
+        _, hdrs, body_e = await _request(port, "GET", "/state")
+        since = json.loads(body_e)["epoch"]
+        snap_e = c.snapshot()
+
+        # Mutations of every flavor, across owner AND replica states:
+        c.set("own-a", "2")  # overwrite
+        c.set("own-b", "new")  # fresh key
+        c.delete("own-a")  # tombstone (must replicate to clients!)
+        c.set_with_ttl("own-c", "ttl")  # TTL mark
+        c._cluster_state.apply_delta(  # replica catches up
+            _filler_delta(["p1"], 3, base_version=4)
+        )
+        snap_now = c.snapshot()
+
+        status, hdrs, body = await _request(
+            port, "GET", f"/state?since={since}"
+        )
+        assert status == "200 OK" and hdrs.get("x-delta") == "1"
+        reply = json.loads(body)
+        assert reply["since"] == since
+        assert reply["epoch"] == snap_now.epoch
+        assert reply["departed"] == []
+
+        # Oracle: every (node, key) whose version moved between the two
+        # snapshots — nothing more, nothing less.
+        before = _snapshot_versions(snap_e)
+        after = _snapshot_versions(snap_now)
+        expected = {
+            (node, key): version
+            for node, keys in after.items()
+            for key, version in keys.items()
+            if before.get(node, {}).get(key) != version
+        }
+        got = {
+            (node, key): kv["version"]
+            for node, entry in reply["delta"].items()
+            for key, kv in entry["key_values"].items()
+        }
+        assert got == expected
+        # "Only key-versions above E": every delta kv clears its floor.
+        for node, entry in reply["delta"].items():
+            for kv in entry["key_values"].values():
+                assert kv["version"] > entry["floor"]
+        # The tombstone rides the delta with its DELETED status.
+        own = reply["delta"][c.self_node_id.name]["key_values"]
+        assert own["own-a"]["status"] == int(VersionStatusEnum.DELETED)
+        assert own["own-c"]["status"] == int(
+            VersionStatusEnum.DELETE_AFTER_TTL
+        )
+
+        # A client at the delta's advertised epoch gets an EMPTY delta.
+        status, _, body = await _request(
+            port, "GET", f"/state?since={reply['epoch']}"
+        )
+        assert json.loads(body)["delta"] == {}
+        await app.stop()
+
+
+async def test_delta_unknown_epoch_resyncs_full(free_port):
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("a", "1")
+    async with c:
+        app = ServeApp(c)
+        port = await app.start()
+        status, hdrs, body = await _request(port, "GET", "/state?since=123456")
+        assert status == "200 OK"
+        assert hdrs.get("x-resync") == "1"  # full payload, not a delta
+        assert json.loads(body)["nodes"]  # the whole snapshot
+        assert _serve_events(reg, "resync_full") == 1
+        status, _, _ = await _request(port, "GET", "/state?since=bogus")
+        assert status == "400 Bad Request"
+        await app.stop()
+
+
+# -- watch: long-poll, streaming, coalescing ----------------------------------
+
+
+async def test_watch_long_poll_wake_and_timeout(free_port):
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("x", "0")
+    async with c:
+        app = ServeApp(c, hub_poll_interval=0.05)
+        port = await app.start()
+        status, hdrs, body = await _request(port, "GET", "/watch?since=0")
+        assert status == "200 OK"  # already newer: immediate
+        epoch = json.loads(body)["epoch"]
+
+        async def bump():
+            await asyncio.sleep(0.15)
+            c.set("x", "1")
+
+        task = asyncio.create_task(bump())
+        status, hdrs, body = await _request(
+            port, "GET", f"/watch?since={epoch}&timeout=5"
+        )
+        await task
+        payload = json.loads(body)
+        assert status == "200 OK"
+        assert payload["epoch"] > epoch
+        assert payload["nodes"][c.self_node_id.name]["x"] == "1"
+
+        status, hdrs, body = await _request(
+            port, "GET", f"/watch?since={payload['epoch']}&timeout=0.2"
+        )
+        assert status == "204 No Content" and body == b""
+        assert _watch_events(reg, "timeout") == 1
+        await app.stop()
+
+
+async def test_watch_burst_coalesces_to_one_wake(free_port):
+    """A burst of writes between hub pump iterations is one epoch bump
+    for watchers: one publish, one shared encode — not one per write."""
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("x", "0")
+    async with c:
+        app = ServeApp(c, hub_poll_interval=0.05)
+        port = await app.start()
+        app.cache.get()
+        epoch = c.state_epoch()
+        encodes_before = _serve_events(reg, "encode")
+
+        async def burst():
+            await asyncio.sleep(0.15)
+            for i in range(50):  # no awaits between writes: one burst
+                c.set(f"burst-{i}", str(i))
+
+        task = asyncio.create_task(burst())
+        status, _, body = await _request(
+            port, "GET", f"/watch?since={epoch}&timeout=5"
+        )
+        await task
+        assert status == "200 OK"
+        payload = json.loads(body)
+        assert payload["nodes"][c.self_node_id.name]["burst-49"] == "49"
+        # The 50-write burst cost ONE encode (one publish woke us).
+        assert _serve_events(reg, "encode") - encodes_before == 1
+        await app.stop()
+
+
+async def test_watch_stream_chunks(free_port):
+    c = _make_cluster(free_port)
+    c.set("x", "0")
+    async with c:
+        app = ServeApp(c, hub_poll_interval=0.05)
+        port = await app.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /watch?stream=1 HTTP/1.1\r\nHost: t\r\n\r\n")
+        await writer.drain()
+        status = (await reader.readline()).decode()
+        assert "200" in status
+        while (await reader.readline()).strip():
+            pass  # headers
+
+        async def read_chunk() -> bytes:
+            size = int((await reader.readline()).strip(), 16)
+            data = await reader.readexactly(size)
+            await reader.readline()  # trailing CRLF
+            return data
+
+        async with timeout_after(5.0):
+            c.set("x", "1")
+            first = json.loads(await read_chunk())
+            c.set("x", "2")
+            second = json.loads(await read_chunk())
+        assert second["epoch"] > first["epoch"]
+        assert second["nodes"][c.self_node_id.name]["x"] == "2"
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+        await app.stop()
+
+
+# -- backpressure: bounded queues, drop + resync ------------------------------
+
+
+async def test_slow_stream_watcher_drops_and_resyncs(free_port):
+    """A stream watcher that stops reading overflows its BOUNDED queue:
+    the hub drops (counted), marks it lagged, and its next read serves
+    the current snapshot — it never misses the final state and the hub
+    never buffers more than queue_maxsize payloads for it."""
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg)
+    c.set("x", "0")
+    async with c:
+        app = ServeApp(c, hub_poll_interval=0.02, watch_queue_maxsize=1)
+        await app.start()
+        hub = app.hub
+        watcher = hub.subscribe()
+        # Publish several epochs while the watcher reads NOTHING.
+        for i in range(4):
+            c.set("x", str(i + 1))
+            hub.kick()
+            await wait_for(lambda i=i: hub.published_epoch is not None
+                           and _serve_events(reg, "encode") >= i + 1,
+                           timeout=2.0)
+            await asyncio.sleep(0.03)
+        assert _watch_events(reg, "drop") > 0
+        assert watcher.lagged
+        # The resumed watcher RESYNCS to the current snapshot instead of
+        # replaying the dropped epochs.
+        payload = await watcher.next(timeout=1.0)
+        assert payload is not None
+        assert json.loads(payload.payload)["nodes"][c.self_node_id.name][
+            "x"
+        ] == "4"
+        assert _watch_events(reg, "resync") == 1
+        watcher.close()
+        await app.stop()
+
+
+async def test_hook_queue_overflow_costs_latency_not_epochs(free_port):
+    """The hub is fed through the runtime's BOUNDED hook queue; under a
+    flood the dispatcher drops events (counted) — and the watcher still
+    converges to the final epoch via the hub's poll fallback, never
+    silently missing it."""
+    reg = MetricsRegistry()
+    c = _make_cluster(free_port, registry=reg, hook_queue_maxsize=1)
+    c.set("x", "0")
+    async with c:
+        app = ServeApp(c, hub_poll_interval=0.05)
+        port = await app.start()
+        app.cache.get()
+        epoch = c.state_epoch()
+
+        async def flood():
+            # Yield between writes so the single-slot hook queue is
+            # genuinely overrun while the worker is mid-dispatch.
+            for i in range(200):
+                c.set("flood", str(i))
+                if i % 10 == 0:
+                    await asyncio.sleep(0)
+
+        status = body = None
+
+        async def watch():
+            nonlocal status, body
+            status, _, body = await _request(
+                port, "GET", f"/watch?since={epoch}&timeout=5"
+            )
+
+        await asyncio.gather(flood(), watch())
+        assert c.hook_stats().dropped > 0  # the flood DID overflow hooks
+        assert status == "200 OK"
+        # Let the poll fallback surface the final epoch, then confirm a
+        # fresh read holds the last write — nothing was lost.
+        await wait_for(
+            lambda: app.cache.get().epoch == c.state_epoch(), timeout=2.0
+        )
+        final = json.loads(app.cache.get().payload)
+        assert final["nodes"][c.self_node_id.name]["flood"] == "199"
+        await app.stop()
+
+
+# -- kv endpoints (example parity lives in test_http_api_example.py) ----------
+
+
+async def test_kv_endpoints_roundtrip(free_port):
+    c = _make_cluster(free_port)
+    async with c:
+        app = ServeApp(c)
+        port = await app.start()
+        status, _, _ = await _request(port, "PUT", "/kv/color?v=red")
+        assert status == "200 OK"
+        status, _, body = await _request(port, "GET", "/kv/color")
+        assert (status, body) == ("200 OK", b"red")
+        status, _, _ = await _request(port, "DELETE", "/kv/color")
+        assert status == "200 OK"
+        status, _, _ = await _request(port, "GET", "/kv/color")
+        assert status == "404 Not Found"
+        status, _, _ = await _request(port, "GET", "/healthz")
+        assert status == "200 OK"
+        status, _, body = await _request(port, "GET", "/metrics")
+        assert status == "200 OK"
+        assert b"aiocluster_serve_requests_total" in body
+        await app.stop()
+
+
+# -- chaos availability -------------------------------------------------------
+
+
+async def test_serving_through_split_brain_heal():
+    """Watchers long-polling THROUGH a split-brain heal: every epoch
+    sequence observed is monotonically non-decreasing, and the final
+    payload matches a direct cluster.snapshot() of the serving node."""
+    plan = lambda h: split_brain(  # noqa: E731
+        2, start=0.0, heal=0.8, seed=7, groups=h.name_groups(2)
+    )
+    async with ChaosHarness(6, plan, gossip_interval=0.05) as harness:
+        serve_cluster = harness.clusters["n00"]
+        app = ServeApp(serve_cluster, hub_poll_interval=0.05)
+        port = await app.start()
+        observed: list[list[int]] = [[] for _ in range(4)]
+        stop = asyncio.Event()
+
+        async def watcher(slot: int) -> None:
+            epoch = 0
+            while not stop.is_set():
+                try:
+                    status, hdrs, _body = await _request(
+                        port, "GET", f"/watch?since={epoch}&timeout=0.5"
+                    )
+                except OSError:
+                    continue
+                new_epoch = int(hdrs.get("etag", f'"{epoch}"').strip('"'))
+                observed[slot].append(new_epoch)
+                epoch = max(epoch, new_epoch)
+
+        tasks = [asyncio.create_task(watcher(i)) for i in range(4)]
+        # Ride through the partition and its heal to full convergence.
+        await harness.wait_converged(timeout=30.0)
+        stop.set()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+        for seq in observed:
+            assert seq, "watcher never heard from the serving node"
+            assert all(
+                a <= b for a, b in zip(seq, seq[1:])
+            ), f"epoch regressed for a watcher: {seq}"
+
+        # Quiesce the fleet, then compare the served payload against a
+        # direct snapshot taken from the serving cluster itself.
+        await asyncio.gather(
+            *(c._ticker.stop() for c in harness.clusters.values())
+        )
+        served = json.loads(app.cache.get().payload)
+        direct = json.loads(encode_snapshot(serve_cluster.snapshot()).decode())
+        assert served["nodes"] == direct["nodes"]
+        # The served (content) epoch may trail the raw digest epoch when
+        # the last bumps were heartbeat-only (cache dedup), never lead it.
+        assert served["epoch"] <= direct["epoch"]
+        # The healed view really is the whole fleet's state.
+        for name in harness.names:
+            assert served["nodes"][name][f"from-{name}"] == name
+        await app.stop()

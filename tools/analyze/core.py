@@ -188,6 +188,10 @@ def _compute_domains(relpath: str, src: str) -> set[str]:
         domains.add("ops")
     if "/core/" in p:
         domains.add("core")
+    if "/runtime/" in p:
+        domains.add("runtime")
+    if "/serve/" in p:
+        domains.add("serve")
     if p.endswith("core/kvstate.py"):
         domains.add("kvstate")
     if p.endswith("core/cluster_state.py"):

@@ -275,6 +275,67 @@ def check_unjoined_writer_close(ctx: FileContext):
                 )
 
 
+@rule("ACT026", "unbounded-asyncio-queue", "asyncio.Queue() without maxsize in runtime/serve")
+def check_unbounded_queue(ctx: FileContext):
+    """The runtime's dispatch discipline (HookDispatcher, the serve
+    tier's watch hub): every ``asyncio.Queue`` between a producer that
+    cannot block and a consumer that can lag must be BOUNDED, with the
+    overflow dropped and counted — an unbounded queue turns one slow
+    consumer into unbounded process memory. Flags ``asyncio.Queue()``
+    constructed with no ``maxsize`` (or a literal ``maxsize`` <= 0 —
+    asyncio treats ANY non-positive maxsize as infinite, so
+    ``Queue(-1)``, the unbounded idiom of other queue APIs, is just as
+    flagged as ``Queue(0)``) inside the runtime/ and serve/ trees. A
+    maxsize passed as a variable is accepted — boundedness is then the
+    binding site's contract."""
+    if ctx.tree is None or not ({"runtime", "serve"} & ctx.domains):
+        return
+
+    def literal_maxsize(expr: ast.expr) -> int | float | None:
+        # -1 parses as UnaryOp(USub, Constant(1)), not Constant(-1).
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+            inner = literal_maxsize(expr.operand)
+            return None if inner is None else -inner
+        if (
+            isinstance(expr, ast.Constant)
+            and isinstance(expr.value, (int, float))
+            and not isinstance(expr.value, bool)
+        ):
+            return expr.value
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = ctx.resolve(node.func)
+        if target not in (
+            "asyncio.Queue",
+            "asyncio.LifoQueue",
+            "asyncio.PriorityQueue",
+        ):
+            continue
+        if node.args:
+            size = literal_maxsize(node.args[0])
+            unbounded = size is not None and size <= 0
+        else:
+            kw = next(
+                (k for k in node.keywords if k.arg == "maxsize"), None
+            )
+            if kw is None:
+                unbounded = True
+            else:
+                size = literal_maxsize(kw.value)
+                unbounded = size is not None and size <= 0
+        if unbounded:
+            yield ctx.finding(
+                node,
+                "ACT026",
+                f"unbounded {target.rsplit('.', 1)[-1]}: pass a nonzero "
+                "maxsize and count drops — one lagging consumer must "
+                "degrade (drop/resync), not grow process memory",
+            )
+
+
 @rule("ACT013", "swallowed-cancellation", "CancelledError caught without re-raise")
 def check_swallowed_cancel(ctx: FileContext):
     if ctx.tree is None:
