@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -77,6 +77,19 @@ serve-bench:
 serve-smoke:
 	$(PY) benchmarks/serve_bench.py --smoke
 
+# Overload & degradation (benchmarks/overload_bench.py,
+# docs/robustness.md): a slow-peer storm (adaptive timeouts + circuit
+# breakers) plus a reader surge against serve-tier admission control,
+# layer ON vs OFF on real loopback fleets. GATES on shedding-arm
+# availability >= 2x the no-layer control at the same load, monotone
+# serve epochs through the storm, at least one breaker opened, and the
+# adaptive-p99 datum present. ~1 min on a 2-core host.
+overload-bench:
+	$(PY) benchmarks/overload_bench.py
+
+overload-smoke:
+	$(PY) benchmarks/overload_bench.py --smoke
+
 # Multihost smoke (benchmarks/multihost_bench.py): TWO real processes
 # join a localhost coordinator (4 virtual CPU devices each, gloo
 # collectives) and run the sharded lean profile — a measured rounds/s
@@ -88,11 +101,12 @@ multihost-smoke:
 # What CI runs; a red suite, dirty lint, new analysis finding, a failed
 # chaos soak, a sweep-amortization regression, a kernel-parity break,
 # a multihost parity/measurement failure, a red byzantine-atlas
-# baseline, or a serve-tier encode-once/ratio regression cannot land
-# through this gate. (kernel-parity re-runs one test file that
+# baseline, a serve-tier encode-once/ratio regression, or an
+# overload-degradation regression (availability ratio, breaker
+# opening, epoch monotonicity) cannot land through this gate. (kernel-parity re-runs one test file that
 # test-all also covers — the explicit target keeps the merge gate for
 # kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke test-all
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

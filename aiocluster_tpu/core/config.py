@@ -84,6 +84,34 @@ class Config:
     # responder waits the same window for the next Syn on a persistent
     # connection before closing it.
     pool_idle_timeout: float = 60.0
+    # New in aiocluster_tpu: overload & degradation control
+    # (docs/robustness.md). When True (the default) every handshake's
+    # measured RTT feeds a per-peer EWMA mean + variance estimator
+    # (runtime/health.py) and the gossip path's connect/read/write
+    # waits use the ADAPTIVE per-peer timeout
+    # ``mean + adaptive_timeout_k * stddev`` clamped to
+    # [adaptive_timeout_min, read_timeout] — a slow peer surfaces as a
+    # fast, cheap failure instead of burning the full fixed constant.
+    # False restores the reference's fixed-constant liveness posture
+    # exactly (like persistent_connections): no estimator is built and
+    # every operation uses the configured constants.
+    adaptive_timeouts: bool = True
+    adaptive_timeout_k: float = 4.0
+    adaptive_timeout_min: float = 0.25
+    # Per-peer circuit breaker (docs/robustness.md): after
+    # ``breaker_failure_threshold`` CONSECUTIVE handshake failures a
+    # peer is quarantined from the gossip target draw (closed -> open)
+    # and redialed on a decorrelated-jitter exponential backoff; when
+    # the backoff expires, exactly one probe handshake is admitted
+    # (half-open) — success closes the breaker, failure re-opens it
+    # with a grown backoff. Backoff is measured in EFFECTIVE gossip
+    # intervals so the quarantine cadence follows the round clock.
+    # False constructs no breaker: failing peers are redialed at full
+    # cadence forever, the reference behavior.
+    circuit_breaker: bool = True
+    breaker_failure_threshold: int = 3
+    breaker_base_backoff_intervals: float = 2.0
+    breaker_max_backoff_intervals: float = 64.0
     # New in aiocluster_tpu: deterministic fault injection
     # (docs/faults.md). When set, the cluster's transport (and, through
     # its dial path, the connection pool) is wrapped by a

@@ -141,6 +141,24 @@ class ChaosHarness:
             initial_key_values={f"from-{name}": name},
             metrics=registry,
         )
+        # Static label table for the fault transport: fraction-addressed
+        # NodeSets must mean the same nodes from the FIRST handshake.
+        # The cluster's own resolver learns names only as identities
+        # replicate, and an unresolved "host:port" fallback label hashes
+        # into an arbitrary frac bucket — bootstrap traffic would then
+        # leak through (or get caught by) the wrong set, making
+        # runtime-vs-sim differential verdicts racy. The harness owns
+        # the whole fleet's name<->port map up front, so it resolves
+        # statically (unknown addresses keep the cluster's fallback).
+        transport = cluster._transport
+        if hasattr(transport, "_resolve"):
+            addr_names = {
+                ("127.0.0.1", p): n for n, p in self._ports.items()
+            }
+            fallback = cluster._peer_label
+            transport._resolve = lambda host, port: (
+                addr_names.get((host, port)) or fallback(host, port)
+            )
         self.generations.setdefault(name, []).append(node_id.generation_id)
         return cluster
 

@@ -511,13 +511,24 @@ class FaultyTransport:
             return await make_coro()
         return await asyncio.wait_for(delayed(), timeout=budget)
 
-    async def connect(self, host: str, port: int, tls_name: str | None = None):
+    async def connect(
+        self,
+        host: str,
+        port: int,
+        tls_name: str | None = None,
+        *,
+        timeout: float | None = None,
+    ):
         label = self._resolve(host, port)
         d = self._ctl.apply(label, "connect")
+        # An adaptive per-peer timeout (runtime/health.py) replaces the
+        # configured constant as the delay + operation budget: a
+        # slow-peer plan must exhaust the budget the caller is actually
+        # waiting on.
         reader, writer = await self._with_delay(
             d.delay,
-            lambda: self._inner.connect(host, port, tls_name),
-            self._inner._connect_timeout,
+            lambda: self._inner.connect(host, port, tls_name, timeout=timeout),
+            self._inner._connect_timeout if timeout is None else timeout,
         )
         self._peer_of[reader] = label
         self._peer_of[writer] = label
@@ -533,7 +544,9 @@ class FaultyTransport:
             d.delay, lambda: self._inner.read_packet(reader, timeout), budget
         )
 
-    async def write_packet(self, writer, packet) -> None:
+    async def write_packet(
+        self, writer, packet, *, timeout: float | None = None
+    ) -> None:
         label = self._peer_of.get(writer)
         # Byzantine rewriting applies to EVERY outbound packet this
         # node writes — including the responder role's SynAck on
@@ -542,17 +555,21 @@ class FaultyTransport:
         # both roles).
         packet = self._ctl.rewrite_packet(packet, label)
         if label is None:
-            return await self._inner.write_packet(writer, packet)
+            return await self._inner.write_packet(
+                writer, packet, timeout=timeout
+            )
         d = self._ctl.apply(label, "write")
         if d.duplicate:
-            await self._inner.write_packet(writer, packet)
+            await self._inner.write_packet(writer, packet, timeout=timeout)
         await self._with_delay(
             d.delay,
-            lambda: self._inner.write_packet(writer, packet),
-            self._inner._write_timeout,
+            lambda: self._inner.write_packet(writer, packet, timeout=timeout),
+            self._inner._write_timeout if timeout is None else timeout,
         )
 
-    async def write_framed(self, writer, payload: bytes, kind: str) -> None:
+    async def write_framed(
+        self, writer, payload: bytes, kind: str, *, timeout: float | None = None
+    ) -> None:
         label = self._peer_of.get(writer)
         if kind == "syn":
             # The engine's pre-encoded Syn bytes: a byzantine window
@@ -560,14 +577,18 @@ class FaultyTransport:
             # while a window is actually open).
             payload = self._ctl.rewrite_syn_bytes(payload, label)
         if label is None:
-            return await self._inner.write_framed(writer, payload, kind)
+            return await self._inner.write_framed(
+                writer, payload, kind, timeout=timeout
+            )
         d = self._ctl.apply(label, "write")
         if d.duplicate:
-            await self._inner.write_framed(writer, payload, kind)
+            await self._inner.write_framed(writer, payload, kind, timeout=timeout)
         await self._with_delay(
             d.delay,
-            lambda: self._inner.write_framed(writer, payload, kind),
-            self._inner._write_timeout,
+            lambda: self._inner.write_framed(
+                writer, payload, kind, timeout=timeout
+            ),
+            self._inner._write_timeout if timeout is None else timeout,
         )
 
     async def start_server(self, host, port, handler):

@@ -184,6 +184,71 @@ def plan_affects_nodes(plan: FaultPlan | None) -> bool:
     return plan is not None and bool(plan.crashes)
 
 
+# -- breaker-quarantine lowering (docs/robustness.md) -------------------------
+#
+# The runtime's per-peer circuit breaker (runtime/health.py) quarantines
+# a peer from the gossip target draw after a handful of consecutive
+# failures. Its sim analogue is a per-round PEER-SELECTION mask, lowered
+# from the fault plan the same way crash windows were: a link fault that
+# makes a destination set effectively unreachable (per-direction failure
+# probability ~1 — the deterministic regime where a breaker must open)
+# quarantines those destinations for every initiator, starting
+# ``open_after`` ticks into the fault window (the failures-to-open
+# threshold at one contact per round) and ending when the window heals
+# (the half-open probe then succeeds immediately at tick resolution).
+# Pure function of (plan, tick, global index): shard-exact and
+# PRNG-independent like every mask here.
+
+# Only a near-certain per-round failure opens a breaker deterministically
+# enough to lower as a mask; sub-threshold flakiness stays un-modelled
+# (the runtime's breaker may or may not open there, and the sim must not
+# guess).
+QUARANTINE_MIN_PFAIL = 0.999
+
+
+def plan_quarantines(plan: FaultPlan | None) -> bool:
+    """Whether the plan carries any link fault the quarantine mask
+    would act on (all-initiator src, dst-restricted, effectively-total
+    failure)."""
+    if plan is None:
+        return False
+    return any(
+        lf.src.matches_all()
+        and not lf.dst.matches_all()
+        and _link_failure_prob(lf) >= QUARANTINE_MIN_PFAIL
+        for lf in plan.links
+    )
+
+
+def quarantine_mask(
+    plan: FaultPlan, n: int, tick: jax.Array, *, open_after: int = 3
+) -> jax.Array:
+    """(N,) bool: peers every breaker-equipped initiator has
+    quarantined from its target draw this tick (see the block comment
+    above). Entries whose ``dst`` matches all nodes contribute nothing:
+    they degrade the *initiator's* own operations everywhere, which is
+    not a per-peer breaker signal. Entries whose ``src`` is restricted
+    contribute nothing either — only the affected initiators' breakers
+    would open in the runtime, and this mask is applied to EVERY
+    initiator's draw (a per-initiator mask has no expression in the
+    single alive-vector categorical); the sim must not quarantine more
+    than the runtime would."""
+    i = jnp.arange(n, dtype=jnp.int32)
+    t = tick.astype(jnp.float32)
+    q = jnp.zeros((n,), bool)
+    for lf in plan.links:
+        if not lf.src.matches_all() or lf.dst.matches_all():
+            continue
+        if _link_failure_prob(lf) < QUARANTINE_MIN_PFAIL:
+            continue
+        end = jnp.inf if lf.end is None else lf.end
+        active = (t >= lf.start + open_after) & (t < end)
+        members = _member_mask(lf.dst, i, n)
+        if members is not None:
+            q = q | (active & members)
+    return q
+
+
 def plan_affects_byzantine(plan: FaultPlan | None) -> bool:
     return plan is not None and any(bf.rate > 0.0 for bf in plan.byzantine)
 

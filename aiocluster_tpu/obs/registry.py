@@ -71,6 +71,15 @@ class _Family:
                 child = self._children.setdefault(key, self._make_child())
         return child
 
+    def remove(self, *values: str) -> None:
+        """Drop one concrete label series. Per-peer families (e.g.
+        ``aiocluster_breaker_state{peer}``) call this when the peer is
+        garbage-collected from membership — without eviction the series
+        set grows monotonically with cumulative address churn."""
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            self._children.pop(key, None)
+
     def _make_child(self) -> object:
         raise NotImplementedError
 

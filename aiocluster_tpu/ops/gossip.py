@@ -387,6 +387,7 @@ def select_peers(
     axis_name: str | None = None,
     view_salt: jax.Array | None = None,
     run_salt: jax.Array | None = None,
+    force_masked: bool = False,
 ) -> jax.Array:
     """(N, fanout) peer indices for this round.
 
@@ -398,6 +399,12 @@ def select_peers(
 
     Self/dead picks are legal — they degenerate to no-op exchanges, which
     also stands in for the reference's failed connections to dead peers.
+
+    ``force_masked`` pins the masked categorical draw even on a
+    statically churn-free config — the breaker-quarantine path
+    (docs/robustness.md) passes an ``alive`` mask that excludes
+    quarantined peers, which the uniform-integer fast path below would
+    ignore.
     """
     n = cfg.n_nodes
     if adjacency is not None:
@@ -413,7 +420,7 @@ def select_peers(
             for c in range(cfg.fanout)
         ]
         return jnp.stack(cols, axis=1)
-    if cfg.death_rate == 0.0 and cfg.revival_rate == 0.0:
+    if cfg.death_rate == 0.0 and cfg.revival_rate == 0.0 and not force_masked:
         # Statically churn-free: the alive mask is all-true forever, so
         # the uniform categorical degenerates to a uniform integer draw
         # — same distribution (self-picks included, no-op exchanges),
@@ -1464,9 +1471,31 @@ def sim_step(
         # View-mode salts live in the negatives so they never collide with
         # the budget dither's non-negative sub_salt space.
         view_salt = (-(tick + 1) * cfg.fanout).astype(jnp.int32)
+        # Breaker quarantine (docs/robustness.md): the runtime circuit
+        # breaker lowered to a peer-selection mask — quarantined peers
+        # leave the target draw instead of burning a no-op exchange,
+        # exactly like runtime/peers.py under the same plan. Static
+        # predicate: a plan with nothing to quarantine keeps the
+        # unmasked draw (and its exact bit-stream).
+        sel_alive = eff_alive
+        quarantine_active = False
+        if cfg.quarantine:
+            from ..faults.sim import plan_quarantines, quarantine_mask
+
+            if adjacency is not None:
+                raise ValueError(
+                    "quarantine is not supported with a topology (the "
+                    "adjacency draw carries no per-peer mask)"
+                )
+            if plan_quarantines(plan):
+                quarantine_active = True
+                sel_alive = eff_alive & ~quarantine_mask(
+                    plan, n, tick, open_after=cfg.quarantine_open_after
+                )
         peers = select_peers(
-            peer_key, eff_alive, live_view, cfg, adjacency, degrees,
+            peer_key, sel_alive, live_view, cfg, adjacency, degrees,
             axis_name=axis_name, view_salt=view_salt, run_salt=run_salt,
+            force_masked=quarantine_active,
         )
 
         def exchange(c, carry: tuple[jax.Array, jax.Array]):

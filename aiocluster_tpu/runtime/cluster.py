@@ -172,16 +172,6 @@ class Cluster:
                 transport, self._fault_controller, self._peer_label
             )
         self._transport = transport
-        self._pool = ConnectionPool(
-            self._transport.connect,
-            max_idle_per_peer=(
-                config.pool_max_idle_per_peer
-                if config.persistent_connections
-                else 0
-            ),
-            idle_timeout=config.pool_idle_timeout,
-            metrics=self._metrics,
-        )
         # Cadence classes (docs/faults.md "heterogeneity"): this node's
         # gossip interval is scaled by its class, derived from the same
         # stable name coordinate the fault plan uses — the runtime
@@ -203,6 +193,52 @@ class Cluster:
                 self._self_zone = config.heterogeneity.zone_of_name(
                     config.node_id.name
                 )
+        # Overload & degradation control (docs/robustness.md): per-peer
+        # EWMA RTT -> adaptive timeouts on the gossip path, plus a
+        # per-peer circuit breaker quarantining broken peers from the
+        # target draw. Constructed only when a flag is on — with both
+        # off, every path below is byte-identical to the fixed-constant
+        # reference posture. Backoff windows are configured in
+        # effective-gossip-interval units, so the quarantine cadence
+        # follows this node's actual round clock.
+        self._health = None
+        if config.adaptive_timeouts or config.circuit_breaker:
+            from .health import HealthTracker
+
+            self._health = HealthTracker(
+                adaptive=config.adaptive_timeouts,
+                breaker=config.circuit_breaker,
+                k=config.adaptive_timeout_k,
+                min_timeout=config.adaptive_timeout_min,
+                max_timeout=config.read_timeout,
+                failure_threshold=config.breaker_failure_threshold,
+                base_backoff=(
+                    config.breaker_base_backoff_intervals
+                    * self.effective_gossip_interval
+                ),
+                max_backoff=(
+                    config.breaker_max_backoff_intervals
+                    * self.effective_gossip_interval
+                ),
+                metrics=self._metrics,
+            )
+        self._pool = ConnectionPool(
+            self._transport.connect,
+            max_idle_per_peer=(
+                config.pool_max_idle_per_peer
+                if config.persistent_connections
+                else 0
+            ),
+            idle_timeout=config.pool_idle_timeout,
+            metrics=self._metrics,
+            on_dial=(
+                None
+                if self._health is None or not config.adaptive_timeouts
+                else lambda key, dt: self._health.record_rtt(
+                    (key[0], key[1]), dt
+                )
+            ),
+        )
         # Jitter scales with the EFFECTIVE interval: a slow-cadence
         # class desynchronized over a fraction of the base interval
         # would still fire near-simultaneously within its own period.
@@ -367,6 +403,42 @@ class Cluster:
     def hook_stats(self) -> HookStats:
         return self._hooks.stats()
 
+    @property
+    def is_closed(self) -> bool:
+        """True once close() has begun (or the cluster never started) —
+        what the serve tier's /healthz turns into a 503."""
+        return self._closing or not self._started
+
+    @property
+    def health(self):
+        """The HealthTracker driving adaptive timeouts and circuit
+        breaking (None when both ``Config.adaptive_timeouts`` and
+        ``Config.circuit_breaker`` are off)."""
+        return self._health
+
+    def health_summary(self) -> dict:
+        """Degraded-state report (docs/robustness.md): FD liveness plus
+        the overload layer's current posture — what /healthz serves."""
+        now = utc_now()
+        phis = [
+            phi
+            for node_id in self._cluster_state.nodes()
+            if node_id != self.self_node_id
+            and (phi := self._failure_detector.phi(node_id, ts=now))
+            is not None
+        ]
+        summary = {
+            "live": len(self._failure_detector.live_nodes()),
+            "dead": len(self._failure_detector.dead_nodes()),
+            "epoch": self._cluster_state.digest_epoch,
+            "max_phi": round(max(phis), 3) if phis else None,
+        }
+        if self._health is not None:
+            summary.update(self._health.summary())
+        else:
+            summary["breaker_open_peers"] = []
+        return summary
+
     def metrics_registry(self) -> MetricsRegistry:
         """The registry this cluster reports through (the process default
         unless one was injected) — hand it to ``obs.render_prometheus`` or
@@ -501,12 +573,33 @@ class Cluster:
                 if addr not in zone_of:
                     zone_of[addr] = het.zone_of_name(n.name)
             self_zone = self._self_zone
+        # Circuit-breaker quarantine (docs/robustness.md): peers inside
+        # an open backoff window are removed from every pick so a
+        # broken peer stops burning a sub-exchange per round; an
+        # expired window drops the peer from this set, and the next
+        # draw that lands on it is the half-open probe. None (breaker
+        # off, or nothing open) keeps the selection path — and its rng
+        # draw sequence — byte-identical to the reference's.
+        quarantined = (
+            self._health.quarantined_peers()
+            if self._health is not None
+            else None
+        )
+        if quarantined and not live:
+            # An isolated node (bootstrap against a still-booting seed,
+            # or a fully-partitioned fleet) has no live peer to spend
+            # the saved sub-exchange on — quarantine would only delay
+            # the join by the accrued backoff (up to 64 intervals)
+            # after the seed finally comes up. With nothing useful to
+            # protect, retry at the reference cadence.
+            quarantined = None
         targets, dead_target, seed_target = select_gossip_targets(
             peers, live, dead, seeds, rng=self._rng,
             gossip_count=self._config.gossip_count,
             zone_bias=0.0 if het is None else het.zone_bias,
             self_zone=self_zone,
             zone_of=zone_of,
+            quarantined=quarantined or None,
         )
         if targets:
             self._peer_selection.labels("live").inc(len(targets))
@@ -566,7 +659,22 @@ class Cluster:
         EOF/reset on first use and is retried exactly once on a fresh
         dial. A fresh connection failing the same way is a real peer
         problem and is not retried.
+
+        Overload layer (docs/robustness.md): with adaptive timeouts on,
+        every wait below runs under the peer's ``mean + k*stddev``
+        budget instead of the fixed constants (None until the first RTT
+        sample); the measured Syn→SynAck round trip feeds the estimator
+        on success, and failures feed the peer's circuit breaker. With
+        both flags off ``self._health`` is None and this body is the
+        reference path unchanged.
         """
+        addr = (host, port)
+        health = self._health
+        budget = health.timeout_for(addr) if health is not None else None
+        if health is not None:
+            # An open breaker whose backoff just expired: this
+            # handshake IS the half-open probe.
+            health.begin_attempt(addr)
         async with self._gossip_semaphore:
             for attempt in (0, 1):
                 conn: PooledConnection | None = None
@@ -577,31 +685,58 @@ class Cluster:
                     # idle sibling of the connection that just died would
                     # burn the retry on the same peer restart.
                     conn = await self._pool.acquire(
-                        host, port, tls_name, fresh=attempt > 0
+                        host, port, tls_name, fresh=attempt > 0,
+                        connect_timeout=budget,
                     )
                     reused = conn.reused
+                    rtt_start = time.perf_counter()
                     await self._transport.write_framed(
-                        conn.writer, syn_bytes, "syn"
+                        conn.writer, syn_bytes, "syn", timeout=budget
                     )
-                    reply = await self._transport.read_packet(conn.reader)
+                    reply = await self._transport.read_packet(
+                        conn.reader, timeout=budget
+                    )
+                    if health is not None:
+                        # The Syn→SynAck round trip is the RTT sample
+                        # (Karn's rule holds: timed-out reads never
+                        # reach this line).
+                        health.record_rtt(
+                            addr, time.perf_counter() - rtt_start
+                        )
                     if isinstance(reply.msg, BadCluster):
                         self._log.warning(
                             f"Peer {host}:{port} rejected us: wrong cluster "
                             f"(ours={self._config.cluster_id!r})"
                         )
+                        if health is not None:
+                            # A policy rejection over a healthy link
+                            # closes the breaker — quarantine is for
+                            # peers that cost time, not ones that say no.
+                            health.record_success(addr)
                     elif isinstance(reply.msg, SynAck):
                         ack = self._engine.handle_synack(reply)
-                        await self._transport.write_packet(conn.writer, ack)
+                        await self._transport.write_packet(
+                            conn.writer, ack, timeout=budget
+                        )
                         if self._config.persistent_connections:
                             # Settled: the finally below must not discard.
                             await self._pool.release(conn)
                             conn = None
                         # else: reference lifecycle — teardown per round,
                         # via the finally's discard.
+                        if health is not None:
+                            health.record_success(addr)
                     else:
                         self._log.debug(
                             f"Unexpected gossip reply from {label} {host}:{port}"
                         )
+                        if health is not None:
+                            # The peer answered promptly over a healthy
+                            # link (same rationale as BadCluster): the
+                            # breaker must settle — a half-open probe
+                            # left unreported would quarantine the peer
+                            # until its probe window lapsed.
+                            health.record_success(addr)
                     return
                 except _PEER_CLOSED_ERRORS as exc:
                     if reused and attempt == 0:
@@ -609,12 +744,16 @@ class Cluster:
                         # normal against close-per-handshake peers.
                         self._pool.note_reconnect()
                         continue
+                    if health is not None:
+                        health.record_failure(addr)
                     self._log.debug(
                         f"Gossip with {label} {host}:{port} failed: {exc}"
                     )
                     return
                 except (TimeoutError, asyncio.TimeoutError, OSError,
                         ValueError) as exc:
+                    if health is not None:
+                        health.record_failure(addr)
                     self._log.debug(
                         f"Gossip with {label} {host}:{port} failed: {exc}"
                     )
@@ -757,3 +896,9 @@ class Cluster:
         self._dead_gauge.set(len(self._failure_detector.dead_nodes()))
         for node_id in self._failure_detector.garbage_collect():
             self._cluster_state.remove_node(node_id)
+            if self._health is not None:
+                # Departed for good: evict the peer's RTT/breaker state
+                # and gauge series (bounded by live membership, not by
+                # cumulative address churn). Dead-but-known peers keep
+                # their breakers — that quarantine is the feature.
+                self._health.forget(node_id.gossip_advertise_addr)

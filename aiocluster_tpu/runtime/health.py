@@ -1,0 +1,337 @@
+"""Per-peer health economics: adaptive timeouts + circuit breaking.
+
+The reference inherits chitchat's fixed-constant liveness posture: every
+transport operation waits the same static 3 s (core/config.py), and a
+peer that keeps failing is redialed at full cadence forever. Both are
+wrong under load — a slow peer burns a full timeout per round per
+initiator (timeout pileup is how gossip fleets collapse), and a dead
+peer keeps costing a sub-exchange every round. The phi-accrual detector
+already proves the fix: per-peer interarrival statistics. This module
+applies the same idea to *timeouts and retry policy* (the way
+Cassandra's dynamic snitch turns its phi detector into routing):
+
+- :class:`PeerRtt` — EWMA mean + variance of measured handshake RTTs
+  (TCP-RTO style: ``alpha=1/8``, ``beta=1/4``; the first sample seeds
+  ``mean=rtt, stddev=rtt/2``). The adaptive timeout is
+  ``mean + k*stddev`` clamped to ``[min_timeout, max_timeout]`` —
+  failures on a healthy link surface in tens of milliseconds instead
+  of the configured ceiling. Only successful handshakes feed the
+  estimator (Karn's rule: a timed-out exchange has no RTT).
+- :class:`PeerBreaker` — closed → open → half-open per peer. ``open``
+  quarantines the peer from the gossip target draw for a
+  decorrelated-jitter exponential backoff (``uniform(base, 3*prev)``
+  capped); when it expires the next draw admits exactly one probe
+  (half-open). Success closes, failure re-opens with a grown window.
+- :class:`HealthTracker` — the per-cluster container the runtime wires
+  in (runtime/cluster.py), keyed by peer address. Metrics:
+  ``aiocluster_peer_rtt_seconds`` (histogram),
+  ``aiocluster_breaker_state{peer}`` (0 closed / 1 open / 2 half-open)
+  and ``aiocluster_breaker_transitions_total{to}``.
+
+Both behaviors are feature-flagged on :class:`~..core.config.Config`
+(``adaptive_timeouts``, ``circuit_breaker``, default on); with both off
+the cluster constructs no tracker and every code path is byte-identical
+to the reference posture (docs/robustness.md). The sim lowers the
+breaker's quarantine to a per-round peer-selection mask
+(faults/sim.quarantine_mask) so fleet-scale scenarios stay
+differentially comparable.
+
+All time is ``time.monotonic`` unless a clock is injected (the
+determinism seam for transition tests, like FaultController).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections.abc import Callable
+from random import Random
+
+from ..obs.registry import MetricsRegistry
+
+# Breaker states, exported as the aiocluster_breaker_state gauge value.
+CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+_STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
+
+# EWMA gains (RFC 6298's srtt/rttvar shape, variance instead of mean
+# deviation so the timeout is literally mean + k*stddev).
+_ALPHA = 0.125
+_BETA = 0.25
+
+Address = tuple[str, int]
+
+
+class PeerRtt:
+    """EWMA mean/variance of one peer's handshake RTTs."""
+
+    __slots__ = ("mean", "var", "samples")
+
+    def __init__(self) -> None:
+        self.mean = 0.0
+        self.var = 0.0
+        self.samples = 0
+
+    def observe(self, rtt: float) -> None:
+        if self.samples == 0:
+            self.mean = rtt
+            self.var = (rtt / 2.0) ** 2
+        else:
+            delta = rtt - self.mean
+            self.mean += _ALPHA * delta
+            self.var = (1.0 - _BETA) * self.var + _BETA * delta * delta
+        self.samples += 1
+
+    def timeout(self, k: float, lo: float, hi: float) -> float | None:
+        """``mean + k*stddev`` clamped to [lo, hi]; None before the
+        first sample (callers fall back to the configured constant)."""
+        if self.samples == 0:
+            return None
+        return min(hi, max(lo, self.mean + k * math.sqrt(self.var)))
+
+
+class PeerBreaker:
+    """Closed → open (backoff) → half-open (single probe) for one peer."""
+
+    __slots__ = ("state", "failures", "backoff", "open_until", "opens")
+
+    def __init__(self) -> None:
+        self.state = CLOSED
+        self.failures = 0  # consecutive
+        self.backoff = 0.0  # current open window, seconds
+        self.open_until = 0.0
+        self.opens = 0  # closed/half-open -> open transitions, lifetime
+
+    def quarantined(self, now: float) -> bool:
+        """Excluded from the gossip target draw? Open-with-expired-
+        backoff is NOT quarantined — the next draw is the probe.
+        Half-open quarantines only until ``open_until`` (the probe
+        window stamped by ``begin_attempt``): a probe whose handshake
+        dies without reporting (cancellation, an unclassified
+        exception path) must not quarantine the peer forever — the
+        window lapsing re-admits the next draw as a fresh probe."""
+        if self.state == CLOSED:
+            return False
+        return now < self.open_until
+
+
+class HealthTracker:
+    """Per-peer RTT estimators + breakers for one cluster (see module
+    docstring). ``base_backoff``/``max_backoff`` are in seconds — the
+    cluster scales its configured interval counts by the effective
+    gossip interval before constructing this."""
+
+    def __init__(
+        self,
+        *,
+        adaptive: bool = True,
+        breaker: bool = True,
+        k: float = 4.0,
+        min_timeout: float = 0.25,
+        max_timeout: float = 3.0,
+        failure_threshold: int = 3,
+        base_backoff: float = 2.0,
+        max_backoff: float = 64.0,
+        rng: Random | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        metrics: MetricsRegistry | None = None,
+    ) -> None:
+        self.adaptive = adaptive
+        self.breaker = breaker
+        self._k = k
+        self._min_timeout = min_timeout
+        self._max_timeout = max_timeout
+        self._threshold = max(1, failure_threshold)
+        self._base_backoff = max(1e-6, base_backoff)
+        self._max_backoff = max(self._base_backoff, max_backoff)
+        self._rng = rng if rng is not None else Random()
+        self._clock = clock
+        self._rtt: dict[Address, PeerRtt] = {}
+        self._breakers: dict[Address, PeerBreaker] = {}
+        self._rtt_hist = self._state_gauge = self._transitions = None
+        if metrics is not None:
+            self._rtt_hist = metrics.histogram(
+                "aiocluster_peer_rtt_seconds",
+                "Measured gossip handshake round-trip times, per sample",
+                buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                         0.5, 1.0, 2.5, 5.0),
+            )
+            self._state_gauge = metrics.gauge(
+                "aiocluster_breaker_state",
+                "Per-peer circuit-breaker state "
+                "(0 closed, 1 open, 2 half-open)",
+                labels=("peer",),
+            )
+            self._transitions = metrics.counter(
+                "aiocluster_breaker_transitions_total",
+                "Circuit-breaker state transitions, by new state",
+                labels=("to",),
+            )
+
+    # -- internals ------------------------------------------------------------
+
+    def _breaker_for(self, addr: Address) -> PeerBreaker:
+        b = self._breakers.get(addr)
+        if b is None:
+            b = self._breakers[addr] = PeerBreaker()
+        return b
+
+    def _set_state(self, addr: Address, b: PeerBreaker, state: int) -> None:
+        if state == b.state:
+            return
+        b.state = state
+        if self._state_gauge is not None:
+            self._state_gauge.labels(f"{addr[0]}:{addr[1]}").set(state)
+        if self._transitions is not None:
+            self._transitions.labels(_STATE_NAMES[state]).inc()
+
+    # -- adaptive timeouts ----------------------------------------------------
+
+    def record_rtt(self, addr: Address, rtt: float) -> None:
+        """One measured successful-operation RTT (a pooled dial, a
+        Syn→SynAck round trip). Feeds the estimator regardless of the
+        adaptive flag — the stats are cheap and /healthz reports them —
+        but only ``timeout_for`` consults the flag."""
+        stats = self._rtt.get(addr)
+        if stats is None:
+            stats = self._rtt[addr] = PeerRtt()
+        stats.observe(rtt)
+        if self._rtt_hist is not None:
+            self._rtt_hist.observe(rtt)
+
+    def timeout_for(self, addr: Address) -> float | None:
+        """The per-peer adaptive timeout in force, or None (use the
+        configured constants: adaptive disabled, or no samples yet)."""
+        if not self.adaptive:
+            return None
+        stats = self._rtt.get(addr)
+        if stats is None:
+            return None
+        return stats.timeout(self._k, self._min_timeout, self._max_timeout)
+
+    # -- circuit breaker ------------------------------------------------------
+
+    def begin_attempt(self, addr: Address) -> None:
+        """Called at handshake start: an open breaker whose backoff has
+        expired transitions to half-open — THIS attempt is the probe.
+        The probe holds the quarantine for one base-backoff window
+        only; if its result never lands the window lapses and the next
+        draw probes again (see ``PeerBreaker.quarantined``)."""
+        if not self.breaker:
+            return
+        b = self._breakers.get(addr)
+        if b is None or b.state not in (OPEN, HALF_OPEN):
+            return
+        if self._clock() >= b.open_until:
+            b.open_until = self._clock() + self._base_backoff
+            self._set_state(addr, b, HALF_OPEN)
+
+    def record_success(self, addr: Address) -> None:
+        if not self.breaker:
+            return
+        b = self._breakers.get(addr)
+        if b is None:
+            return
+        b.failures = 0
+        b.backoff = 0.0
+        self._set_state(addr, b, CLOSED)
+
+    def record_failure(self, addr: Address) -> None:
+        """One failed handshake. At ``failure_threshold`` consecutive
+        failures (or any half-open probe failure) the breaker opens
+        with decorrelated-jitter backoff: uniform(base, 3*prev) capped
+        at max — desynchronizing a fleet's retries against a struggling
+        peer instead of thundering at a shared cadence."""
+        if not self.breaker:
+            return
+        b = self._breaker_for(addr)
+        b.failures += 1
+        if b.state == HALF_OPEN or (
+            b.state == CLOSED and b.failures >= self._threshold
+        ):
+            self._open(addr, b)
+        elif b.state == OPEN and self._clock() >= b.open_until:
+            # A non-probe path (a dead/seed pick raced the draw) failed
+            # after expiry: re-open rather than leaving a stale window.
+            self._open(addr, b)
+
+    def _open(self, addr: Address, b: PeerBreaker) -> None:
+        prev = b.backoff if b.backoff > 0 else self._base_backoff
+        b.backoff = min(
+            self._max_backoff, self._rng.uniform(self._base_backoff, prev * 3)
+        )
+        b.open_until = self._clock() + b.backoff
+        b.opens += 1
+        # Force the transition even from OPEN (re-open = new window).
+        if b.state == OPEN:
+            if self._transitions is not None:
+                self._transitions.labels("open").inc()
+        else:
+            self._set_state(addr, b, OPEN)
+
+    def forget(self, addr: Address) -> None:
+        """Evict one peer's estimator, breaker and gauge series — the
+        membership-GC hook (runtime/cluster.py): a node garbage-
+        collected out of cluster state will never be drawn again, and
+        without eviction the per-peer maps (and the
+        ``aiocluster_breaker_state{peer}`` label set) grow forever
+        under restart-with-fresh-port churn. A merely-DEAD peer is
+        never forgotten: its breaker state is the point."""
+        self._rtt.pop(addr, None)
+        if self._breakers.pop(addr, None) is not None and (
+            self._state_gauge is not None
+        ):
+            self._state_gauge.remove(f"{addr[0]}:{addr[1]}")
+
+    def quarantined_peers(self) -> set[Address]:
+        """Peers currently excluded from the gossip target draw (open
+        inside their backoff window, or half-open probe in flight).
+        Empty when the breaker is disabled."""
+        if not self.breaker:
+            return set()
+        now = self._clock()
+        return {a for a, b in self._breakers.items() if b.quarantined(now)}
+
+    def open_peer_labels(self) -> list[str]:
+        """``host:port`` labels of peers whose breaker is not closed —
+        the /healthz degraded-state field."""
+        return sorted(
+            f"{a[0]}:{a[1]}"
+            for a, b in self._breakers.items()
+            if b.state != CLOSED
+        )
+
+    # -- reporting ------------------------------------------------------------
+
+    def breaker_state(self, addr: Address) -> int:
+        b = self._breakers.get(addr)
+        return CLOSED if b is None else b.state
+
+    def breaker_opens(self, addr: Address) -> int:
+        b = self._breakers.get(addr)
+        return 0 if b is None else b.opens
+
+    def timeouts_in_force(self) -> list[float]:
+        """The adaptive timeouts currently in force across sampled
+        peers (empty when adaptive is off) — benchmarks quantile this
+        into ``adaptive_timeout_p99_ms``."""
+        if not self.adaptive:
+            return []
+        return [
+            t
+            for s in self._rtt.values()
+            if (t := s.timeout(self._k, self._min_timeout, self._max_timeout))
+            is not None
+        ]
+
+    def summary(self) -> dict:
+        """Compact degraded-state summary for /healthz."""
+        timeouts = self.timeouts_in_force()
+        return {
+            "adaptive_timeouts": self.adaptive,
+            "circuit_breaker": self.breaker,
+            "peers_sampled": len(self._rtt),
+            "breaker_open_peers": self.open_peer_labels(),
+            "adaptive_timeout_max_ms": (
+                round(max(timeouts) * 1000.0, 3) if timeouts else None
+            ),
+        }

@@ -85,8 +85,23 @@ def select_gossip_targets(
     zone_bias: float = 0.0,
     self_zone: int | None = None,
     zone_of: dict[Address, int] | None = None,
+    quarantined: set[Address] | None = None,
 ) -> tuple[list[Address], Address | None, Address | None]:
-    """Returns (live targets, optional dead target, optional seed target)."""
+    """Returns (live targets, optional dead target, optional seed target).
+
+    ``quarantined`` (runtime/health.py circuit breakers, docs/
+    robustness.md) removes broken peers from EVERY pick — live draw,
+    dead probe and seed fallback alike: a peer inside its backoff
+    window must not burn a sub-exchange in any role; the half-open
+    probe is the sanctioned re-contact (an expired backoff drops the
+    peer from the set before this is called). None/empty leaves all
+    four candidate sets — and the rng draw sequence — untouched.
+    """
+    if quarantined:
+        peer_nodes = peer_nodes - quarantined
+        live_nodes = live_nodes - quarantined
+        dead_nodes = dead_nodes - quarantined
+        seed_nodes = seed_nodes - quarantined
     live_count = len(live_nodes)
     dead_count = len(dead_nodes)
 

@@ -40,7 +40,9 @@ from dataclasses import dataclass, field
 from ..obs.registry import MetricsRegistry
 
 PoolKey = tuple[str, int, str | None]
-ConnectFn = Callable[[str, int, str | None], Awaitable[tuple[StreamReader, StreamWriter]]]
+# Dial function; must additionally accept ``timeout=`` when the caller
+# passes an adaptive connect budget (GossipTransport.connect does).
+ConnectFn = Callable[..., Awaitable[tuple[StreamReader, StreamWriter]]]
 
 
 @dataclass
@@ -69,10 +71,15 @@ class ConnectionPool:
         max_idle_per_peer: int = 2,
         idle_timeout: float = 60.0,
         metrics: MetricsRegistry | None = None,
+        on_dial: Callable[[PoolKey, float], None] | None = None,
     ) -> None:
         self._connect = connect
         self._max_idle_per_peer = max(0, max_idle_per_peer)
         self._idle_timeout = idle_timeout
+        # Dial-latency observer (runtime/health.py): every successful
+        # fresh dial reports its duration so the per-peer RTT estimator
+        # is fed from the pool too, not only from completed handshakes.
+        self._on_dial = on_dial
         self._idle: dict[PoolKey, deque[PooledConnection]] = {}
         self._open = 0
         self._closed = False
@@ -122,13 +129,17 @@ class ConnectionPool:
         tls_name: str | None = None,
         *,
         fresh: bool = False,
+        connect_timeout: float | None = None,
     ) -> PooledConnection:
         """Borrow a connection to ``(host, port)``: the freshest live
         idle one, else a new dial. The caller owns it until ``release``
         or ``discard``. ``fresh=True`` (the EOF-retry path) flushes any
         remaining idle connections for the peer and always dials — a
         reused connection just died, so its idle siblings predate the
-        same peer restart and must not consume the retry."""
+        same peer restart and must not consume the retry.
+        ``connect_timeout`` overrides the transport's configured dial
+        timeout (the adaptive per-peer budget, runtime/health.py); None
+        keeps the configured constant and the exact legacy call shape."""
         key: PoolKey = (host, port, tls_name)
         queue = self._idle.get(key)
         while queue:
@@ -143,7 +154,15 @@ class ConnectionPool:
             self._note("hit")
             return conn
         self._note("miss")
-        reader, writer = await self._connect(host, port, tls_name)
+        dial_start = time.monotonic()
+        if connect_timeout is None:
+            reader, writer = await self._connect(host, port, tls_name)
+        else:
+            reader, writer = await self._connect(
+                host, port, tls_name, timeout=connect_timeout
+            )
+        if self._on_dial is not None:
+            self._on_dial(key, time.monotonic() - dial_start)
         self._track_open(+1)
         return PooledConnection(key, reader, writer)
 

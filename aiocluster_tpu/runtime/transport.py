@@ -61,8 +61,16 @@ class GossipTransport:
     # -- client side ----------------------------------------------------------
 
     async def connect(
-        self, host: str, port: int, tls_name: str | None = None
+        self,
+        host: str,
+        port: int,
+        tls_name: str | None = None,
+        *,
+        timeout: float | None = None,
     ) -> tuple[StreamReader, StreamWriter]:
+        """Dial a peer. ``timeout`` overrides the configured connect
+        timeout — the adaptive per-peer budget (runtime/health.py);
+        None keeps the configured constant."""
         if self._tls_client_context is None:
             coro = asyncio.open_connection(host, port)
         else:
@@ -72,7 +80,10 @@ class GossipTransport:
                 ssl=self._tls_client_context,
                 server_hostname=tls_name or self._tls_server_hostname or host,
             )
-        return await asyncio.wait_for(coro, timeout=self._connect_timeout)
+        return await asyncio.wait_for(
+            coro,
+            timeout=self._connect_timeout if timeout is None else timeout,
+        )
 
     # -- server side ----------------------------------------------------------
 
@@ -109,9 +120,14 @@ class GossipTransport:
         self, reader: StreamReader, timeout: float | None = None
     ) -> Packet:
         """Read one framed packet. ``timeout`` overrides the configured
-        read timeout for the header wait only — the server loop waits
-        longer between handshakes on a persistent connection than it
-        would mid-handshake."""
+        read timeout for the header wait; the payload wait takes the
+        TIGHTER of the override and the configured constant — the
+        server loop passes its long pool-idle window for the
+        between-handshakes header wait (which must not license a
+        mid-payload stall), while the client's adaptive per-peer budget
+        (clamped to ``read_timeout``, runtime/health.py) must govern
+        the payload too or a peer stalling after the 4-byte header
+        burns the full fixed constant per round."""
         header = await asyncio.wait_for(
             reader.readexactly(HEADER_SIZE),
             timeout=self._read_timeout if timeout is None else timeout,
@@ -120,7 +136,12 @@ class GossipTransport:
         if size <= 0 or size > self._max_payload_size:
             raise ValueError(f"invalid message size: {size}")
         raw = await asyncio.wait_for(
-            reader.readexactly(size), timeout=self._read_timeout
+            reader.readexactly(size),
+            timeout=(
+                self._read_timeout
+                if timeout is None
+                else min(self._read_timeout, timeout)
+            ),
         )
         packet = decode_packet(raw)
         if self._packets is not None:
@@ -129,23 +150,45 @@ class GossipTransport:
             self._bytes.labels(kind, "in").inc(HEADER_SIZE + size)
         return packet
 
-    async def write_packet(self, writer: StreamWriter, packet: Packet) -> None:
+    async def write_packet(
+        self,
+        writer: StreamWriter,
+        packet: Packet,
+        *,
+        timeout: float | None = None,
+    ) -> None:
         raw = frame(encode_packet(packet))
-        await self._write_raw(writer, raw, type(packet.msg).__name__.lower())
+        await self._write_raw(
+            writer, raw, type(packet.msg).__name__.lower(), timeout=timeout
+        )
 
     async def write_framed(
-        self, writer: StreamWriter, payload: bytes, kind: str
+        self,
+        writer: StreamWriter,
+        payload: bytes,
+        kind: str,
+        *,
+        timeout: float | None = None,
     ) -> None:
         """Write an already-encoded packet body (the engine's cached Syn
         bytes), framing it here. ``kind`` labels the packet metrics the
-        same way ``write_packet`` derives from the message type."""
-        await self._write_raw(writer, frame(payload), kind)
+        same way ``write_packet`` derives from the message type;
+        ``timeout`` overrides the configured write timeout (the
+        adaptive per-peer budget)."""
+        await self._write_raw(writer, frame(payload), kind, timeout=timeout)
 
     async def _write_raw(
-        self, writer: StreamWriter, raw: bytes, kind: str
+        self,
+        writer: StreamWriter,
+        raw: bytes,
+        kind: str,
+        timeout: float | None = None,
     ) -> None:
         if self._packets is not None:
             self._packets.labels(kind, "out").inc()
             self._bytes.labels(kind, "out").inc(len(raw))
         writer.write(raw)
-        await asyncio.wait_for(writer.drain(), timeout=self._write_timeout)
+        await asyncio.wait_for(
+            writer.drain(),
+            timeout=self._write_timeout if timeout is None else timeout,
+        )

@@ -16,18 +16,22 @@ it" — and the answer must be O(changes), not O(state), per client:
 - :mod:`http` — ``ServeApp``: the stdlib-asyncio HTTP surface
   (``/state`` with ETag/304 and ``?since=`` deltas, ``/watch``
   long-poll + chunked streaming, the reference example's KV endpoints,
-  ``/metrics``, ``/healthz``).
+  ``/metrics``, ``/healthz``), fronted by ``OverloadPolicy`` admission
+  control — event-loop-lag + in-flight shedding with ``429`` +
+  ``Retry-After``, and a real degraded-state ``/healthz``
+  (docs/robustness.md).
 
 See docs/serving.md for the endpoint contract and bench methodology
 (benchmarks/serve_bench.py is the 10k-watcher load generator).
 """
 
 from .cache import EncodedSnapshot, SnapshotCache, encode_snapshot
-from .http import ServeApp
+from .http import OverloadPolicy, ServeApp
 from .hub import StreamWatcher, WatchHub
 
 __all__ = [
     "EncodedSnapshot",
+    "OverloadPolicy",
     "ServeApp",
     "SnapshotCache",
     "StreamWatcher",

@@ -31,8 +31,10 @@ example's semantics).
 from __future__ import annotations
 
 import asyncio
+import json
 import math
 from contextlib import suppress
+from dataclasses import dataclass
 from urllib.parse import parse_qs, unquote, urlparse
 
 from ..obs.expo import render_prometheus
@@ -58,6 +60,40 @@ MAX_HEADERS = 100
 
 _JSON = "application/json"
 _TEXT = "text/plain"
+
+# Loop-lag hysteresis: one saturated probe decays over a few intervals
+# instead of flapping the shed decision per probe.
+_LAG_DECAY = 0.5
+
+
+@dataclass(frozen=True, slots=True)
+class OverloadPolicy:
+    """Serve-tier admission control (docs/robustness.md).
+
+    Past either threshold a request is shed with ``429`` +
+    ``Retry-After`` instead of joining a queue that has already lost —
+    an overloaded tier that answers *some* requests on time degrades;
+    one that answers *all* of them late collapses:
+
+    - ``max_inflight`` bounds concurrently *executing* requests
+      (parked ``/watch`` long-polls are excluded — they cost a future,
+      not CPU; their backpressure is the hub's bounded queues with
+      counted drop→resync).
+    - ``shed_lag_s`` sheds on measured event-loop lag — the signal
+      that the process (gossip rounds included) is past saturation;
+      applies to every endpoint including ``/watch``.
+    - ``/healthz`` and ``/metrics`` are never shed: the operator's
+      view must survive the storm it is diagnosing.
+
+    ``enabled=False`` restores the accept-everything behavior (the
+    overload benchmark's control arm).
+    """
+
+    enabled: bool = True
+    max_inflight: int = 256
+    shed_lag_s: float = 1.0
+    probe_interval_s: float = 0.1
+    retry_after_s: float = 1.0
 
 
 class _Request:
@@ -92,12 +128,14 @@ class ServeApp:
         watch_queue_maxsize: int = 2,
         hub_poll_interval: float = 0.25,
         floor_history: int = 1024,
+        overload: OverloadPolicy | None = None,
     ) -> None:
         self._cluster = cluster
         self._metrics = (
             metrics if metrics is not None else cluster.metrics_registry()
         )
         self.cache_enabled = cache_enabled
+        self.overload = overload if overload is not None else OverloadPolicy()
         self.cache = SnapshotCache(
             cluster, metrics=self._metrics, floor_history=floor_history
         )
@@ -112,6 +150,23 @@ class ServeApp:
             "HTTP requests served, by endpoint and status code",
             labels=("endpoint", "status"),
         )
+        self._sheds = self._metrics.counter(
+            "aiocluster_serve_shed_total",
+            "Requests shed by admission control (429), by reason",
+            labels=("reason",),
+        )
+        self._lag_gauge = self._metrics.gauge(
+            "aiocluster_loop_lag_seconds",
+            "Measured event-loop lag (decayed max over recent probes)",
+        )
+        self._inflight_gauge = self._metrics.gauge(
+            "aiocluster_serve_inflight",
+            "Requests currently executing (parked watches excluded)",
+        )
+        self._lag = 0.0
+        self._inflight = 0
+        self._shed_total = 0
+        self._lag_task: asyncio.Task | None = None
         self._server: asyncio.Server | None = None
         self._conns: set[asyncio.StreamWriter] = set()
         self.port: int | None = None
@@ -128,9 +183,27 @@ class ServeApp:
         self._cluster.on_node_join(self._on_membership)
         self._cluster.on_node_leave(self._on_membership)
         self.hub.start()
+        # The loop-lag probe runs regardless of the shed policy —
+        # /healthz reports the lag either way.
+        if self._lag_task is None:
+            self._lag_task = asyncio.create_task(self._lag_probe())
         self._server = await asyncio.start_server(self._handle, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
+
+    async def _lag_probe(self) -> None:
+        """Measure event-loop lag: sleep a fixed interval and see how
+        late the wakeup lands. A decayed max (not the raw sample) feeds
+        the shed decision, so one saturated probe holds the degraded
+        state for a few intervals instead of flapping."""
+        loop = asyncio.get_running_loop()
+        interval = self.overload.probe_interval_s
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(interval)
+            lag = max(0.0, loop.time() - t0 - interval)
+            self._lag = max(lag, self._lag * _LAG_DECAY)
+            self._lag_gauge.set(self._lag)
 
     async def stop(self) -> None:
         # Detach from the cluster's hook feeds: a stopped app must not
@@ -139,6 +212,13 @@ class ServeApp:
         self._cluster.remove_on_key_change(self._on_key_change)
         self._cluster.remove_on_node_join(self._on_membership)
         self._cluster.remove_on_node_leave(self._on_membership)
+        if self._lag_task is not None:
+            self._lag_task.cancel()
+            try:
+                await self._lag_task
+            except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued at app teardown
+                pass
+            self._lag_task = None
         await self.hub.stop()
         if self._server is not None:
             self._server.close()
@@ -225,10 +305,66 @@ class ServeApp:
                 if request is None:
                     return
                 close = request.headers.get("connection", "").lower() == "close"
+                # Admission control (docs/robustness.md): past the
+                # thresholds the request is answered 429 + Retry-After
+                # immediately — cheap for the server, honest to the
+                # client — instead of joining a doomed queue. The
+                # connection stays usable (clients retry on it).
+                reason = self._shed_reason(request.path)
+                if reason is not None:
+                    self._shed_total += 1
+                    self._sheds.labels(reason).inc()
+                    self._requests.labels("shed", "429").inc()
+                    writer.write(
+                        self._response(
+                            "429 Too Many Requests",
+                            b"overloaded\n",
+                            _TEXT,
+                            (
+                                (
+                                    "Retry-After",
+                                    str(
+                                        max(
+                                            1,
+                                            math.ceil(
+                                                self.overload.retry_after_s
+                                            ),
+                                        )
+                                    ),
+                                ),
+                            ),
+                            keep_alive=not close,
+                        )
+                    )
+                    await writer.drain()
+                    if close:
+                        return
+                    continue
                 if request.path == "/watch" and request.q1("stream"):
                     await self._stream_watch(request, writer)
                     return  # stream ends with the connection
-                endpoint, status, payload = await self._route(request)
+                is_watch = request.path == "/watch"
+                if not is_watch:
+                    # Parked long-polls are excluded: they hold a
+                    # future, not the CPU — counting them would shed
+                    # /state the moment a watcher fleet connects.
+                    self._inflight += 1
+                    self._inflight_gauge.set(self._inflight)
+                try:
+                    if not is_watch:
+                        # Yield once before routing: synchronous
+                        # endpoint bodies (the /state encode) otherwise
+                        # run to completion inside one task step, the
+                        # gauge never observes real concurrency, and a
+                        # queued wave of requests would ALL pass the
+                        # in-flight check before the first encode runs
+                        # — the cap must bound the admitted wave.
+                        await asyncio.sleep(0)
+                    endpoint, status, payload = await self._route(request)
+                finally:
+                    if not is_watch:
+                        self._inflight -= 1
+                        self._inflight_gauge.set(self._inflight)
                 self._requests.labels(endpoint, status.split()[0]).inc()
                 writer.write(
                     self._response(
@@ -281,7 +417,7 @@ class ServeApp:
                 (body, "text/plain; version=0.0.4; charset=utf-8", ()),
             )
         if path == "/healthz" and method == "GET":
-            return ("healthz", "200 OK", (b"ok\n", _TEXT, ()))
+            return self._handle_healthz()
         parts = [p for p in path.split("/") if p]
         if len(parts) == 2 and parts[0] == "kv":
             return ("kv",) + self._handle_kv(request, unquote(parts[1]))
@@ -292,6 +428,52 @@ class ServeApp:
                 return ("kv_mark", "200 OK", (b"ok", _TEXT, ()))
             return ("kv_mark", "404 Not Found", (b"not found", _TEXT, ()))
         return ("other", "404 Not Found", (b"not found", _TEXT, ()))
+
+    def _shed_reason(self, path: str) -> str | None:
+        """Why this request should be shed right now, or None to admit
+        it (see OverloadPolicy). Lag sheds everything; the in-flight
+        bound spares /watch (parked long-polls are not executing)."""
+        pol = self.overload
+        if not pol.enabled or path in ("/healthz", "/metrics"):
+            return None
+        if self._lag > pol.shed_lag_s:
+            return "lag"
+        if path != "/watch" and self._inflight >= pol.max_inflight:
+            return "inflight"
+        return None
+
+    def _shedding(self) -> bool:
+        # One source of truth with the admission check: would a plain
+        # executing request be shed right now?
+        return self._shed_reason("/") is not None
+
+    def _handle_healthz(
+        self,
+    ) -> tuple[str, str, tuple[bytes, str, tuple[tuple[str, str], ...]]]:
+        """The real degraded-state report (docs/robustness.md): 503
+        once the cluster is closed, otherwise 200 with
+        ok/degraded status plus loop lag, shed counts, open breakers
+        and the FD's phi summary — not the static "ok" the reference
+        example serves regardless of cluster state."""
+        summary = self._cluster.health_summary()
+        closed = self._cluster.is_closed
+        degraded = self._shedding() or bool(summary.get("breaker_open_peers"))
+        status = "closed" if closed else ("degraded" if degraded else "ok")
+        body = (
+            json.dumps(
+                {
+                    "status": status,
+                    "loop_lag_s": round(self._lag, 4),
+                    "inflight": self._inflight,
+                    "shed_total": self._shed_total,
+                    **summary,
+                },
+                sort_keys=True,
+            ).encode()
+            + b"\n"
+        )
+        http_status = "503 Service Unavailable" if closed else "200 OK"
+        return ("healthz", http_status, (body, _JSON, ()))
 
     def _handle_state(
         self, request: _Request

@@ -170,6 +170,21 @@ class SimConfig:
     # carrying effective behavior; a no-op plan keeps the fast paths).
     fault_plan: FaultPlan | None = None
 
+    # Breaker quarantine (docs/robustness.md): the runtime's per-peer
+    # circuit breaker lowered to a per-round peer-selection mask
+    # (faults/sim.quarantine_mask) — peers a link fault makes
+    # effectively unreachable are removed from the target draw
+    # ``quarantine_open_after`` ticks into the fault window (the
+    # failures-to-open threshold at one contact per round), so the
+    # fleet stops burning sub-exchanges on them, exactly like the
+    # runtime under the same plan. Requires pairing="choice" with
+    # peer_mode="alive" (the draw the mask biases; matchings pair over
+    # all nodes and the view draw has its own belief mask) and no
+    # topology. False (the default) keeps the peer draw — and every
+    # existing trace — byte-identical.
+    quarantine: bool = False
+    quarantine_open_after: int = 3
+
     # Heterogeneity (models/topology.Heterogeneity, docs/faults.md):
     # per-node gossip-cadence classes (a class-k node initiates every
     # k-th tick; a "matching" pair exchanges when either side is
@@ -309,6 +324,30 @@ class SimConfig:
                     "byzantine fault kinds are unpacked-only (the guard "
                     "masks are owner-column selects with no byte-space "
                     "form); version_dtype='u4r' cannot run them"
+                )
+        if self.quarantine:
+            if self.pairing != "choice":
+                raise ValueError(
+                    "quarantine requires pairing='choice' (the matching/"
+                    "permutation pairings draw over all nodes; only the "
+                    "choice draw can honour a per-peer quarantine mask)"
+                )
+            if self.peer_mode != "alive":
+                raise ValueError(
+                    "quarantine requires peer_mode='alive' (the view-mode "
+                    "Gumbel-max draw carries its own belief mask)"
+                )
+            if self.quarantine_open_after < 0:
+                raise ValueError("quarantine_open_after must be >= 0")
+            if self.heterogeneity is not None and any(
+                k != 1 for k in self.heterogeneity.gossip_every
+            ):
+                raise ValueError(
+                    "quarantine cannot combine with heterogeneity cadence "
+                    "classes: a class-k initiator accumulates its "
+                    "failures-to-open k times slower, but the mask opens "
+                    "at a fixed start+open_after for every initiator — "
+                    "the sim would quarantine more than the runtime does"
                 )
         if self.heterogeneity is not None:
             if not isinstance(self.heterogeneity, Heterogeneity):

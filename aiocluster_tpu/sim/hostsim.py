@@ -272,6 +272,13 @@ SUPPORT_DOMAIN: tuple[DomainRow, ...] = (
         "cadence masks / zone-biased draws are not mirrored in the C "
         "kernels (WAN classes already fail the fault row)",
     ),
+    DomainRow(
+        "quarantine",
+        (False,),
+        lambda c: c.quarantine,
+        "breaker-quarantine peer masks run on the XLA engine (the C "
+        "matching draw carries no per-peer mask)",
+    ),
 )
 
 

@@ -698,6 +698,18 @@ def serve_tier_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def overload_degradation_bench(log, smoke: bool) -> dict | None:
+    """The overload/degradation datum (benchmarks/overload_bench.py,
+    docs/robustness.md): a slow-peer storm (adaptive timeouts + circuit
+    breakers on a real loopback fleet) plus a reader surge against the
+    serve tier's admission control, layer ON vs OFF at the same load —
+    the graceful-degradation claim (availability ratio, breakers
+    opened, adaptive p99) measured, not asserted."""
+    return _run_benchmarks_helper(
+        "overload_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 # Hard cap on the stdout record line. Round 3's full record grew to
 # ~4.5 KB and the driver's capture kept only an unparseable tail
 # (BENCH_r03.json "parsed": null); the compact line stays ~an order of
@@ -709,6 +721,10 @@ STDOUT_LINE_CAP = 2000
 # least-essential provenance first; the headline fields
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
+    "adaptive_timeout_p99_ms",
+    "breaker_open_peers",
+    "overload_availability_frac_control",
+    "overload_availability_frac",
     "serve_encodes_per_epoch",
     "serve_cached_vs_control",
     "serve_watch_p99_ms",
@@ -799,6 +815,22 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         ),
         "serve_encodes_per_epoch": (ex.get("serve_bench") or {}).get(
             "encodes_per_epoch"
+        ),
+        # Graceful degradation under overload (overload_bench.py):
+        # shedding-arm availability vs the no-layer control at the same
+        # load, breakers the slow-peer storm opened, and the p99
+        # adaptive timeout in force on the fast subset.
+        "overload_availability_frac": (ex.get("overload_bench") or {}).get(
+            "overload_availability_frac"
+        ),
+        "overload_availability_frac_control": (
+            ex.get("overload_bench") or {}
+        ).get("overload_availability_frac_control"),
+        "breaker_open_peers": (ex.get("overload_bench") or {}).get(
+            "breaker_open_peers"
+        ),
+        "adaptive_timeout_p99_ms": (ex.get("overload_bench") or {}).get(
+            "adaptive_timeout_p99_ms"
         ),
         # S-lane sweep throughput + compile amortization (sweep_bench).
         "sim_sweep_lane_rounds_per_sec": (ex.get("sweep_bench") or {}).get(
@@ -1425,6 +1457,9 @@ def main() -> None:
         # real loopback fleet (benchmarks/serve_bench.py) — 10k
         # watchers in full runs, 64 in smoke.
         serve_rec = serve_tier_bench(log, args.smoke)
+        # Overload & degradation: slow-peer storm + reader surge with
+        # the robustness layer on vs off (benchmarks/overload_bench.py).
+        overload_rec = overload_degradation_bench(log, args.smoke)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1498,6 +1533,10 @@ def main() -> None:
                 # Serve tier: encode-once fan-out measured against a
                 # per-request-encode control arm (serve_bench.py).
                 "serve_bench": serve_rec,
+                # Graceful degradation under storm + surge: layer
+                # on-vs-off availability, breakers, adaptive p99
+                # (overload_bench.py, docs/robustness.md).
+                "overload_bench": overload_rec,
                 # The memory ladder's planning claims (per-rung B/pair,
                 # modeled max scale) — every entry certified: false
                 # until the chip calibrates the new paths.

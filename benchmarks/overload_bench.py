@@ -1,0 +1,491 @@
+"""Overload & degradation benchmark: slow-peer storm + reader surge,
+with the overload layer ON vs OFF (docs/robustness.md).
+
+The claim under test is *graceful degradation*: under the same hostile
+load, the layer keeps useful work flowing (bounded tail latency,
+monotone serve epochs, breakers quarantining the broken third) where
+the fixed-constant posture piles up timeouts and misses every deadline.
+Two storms, each measured on a REAL loopback fleet:
+
+1. **Gossip storm** (adaptive timeouts + circuit breaker): a
+   ``slow_third``-shaped plan makes every operation touching the slow
+   set stall past any timeout, starting after a healthy warm-up (so the
+   RTT estimators hold real samples when the storm lands). Mid-storm, a
+   fast node writes a probe key; we measure how long the FAST subset
+   takes to replicate it. ON: operations against slow peers fail at the
+   adaptive ``mean + k*stddev`` budget (~tens of ms on loopback) and
+   the breaker quarantines them from the draw; OFF: every round burns
+   the full fixed constant per slow target. Also recorded: open-breaker
+   count and the p99 adaptive timeout in force
+   (``breaker_open_peers`` / ``adaptive_timeout_p99_ms``).
+
+2. **Reader surge** (serve-tier admission control): R closed-loop
+   clients hammer ``GET /state`` on a walk-per-request app
+   (``cache_enabled=False`` — the expensive read path that actually
+   saturates a serving member) with a per-request deadline.
+   ON (``OverloadPolicy``): past ``max_inflight`` the server answers
+   ``429`` + ``Retry-After`` immediately, so admitted requests finish
+   inside the deadline; OFF: everything queues and (almost) everything
+   misses its deadline. Availability = timely 200s / attempts; the
+   gate is ON >= 2x OFF at the same load. A side channel polls
+   ``/healthz`` (never shed) through the storm and pins serve-epoch
+   monotonicity.
+
+Usage: python benchmarks/overload_bench.py [--smoke] [--json]
+Importable: bench.py calls measure() for its BENCH record
+(``extra.overload_bench``; compact ``overload_availability_frac`` /
+``breaker_open_peers`` / ``adaptive_timeout_p99_ms`` keys).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+_BENCH_DIR = os.path.join(_REPO, "benchmarks")
+if _BENCH_DIR not in sys.path:
+    sys.path.insert(0, _BENCH_DIR)
+
+from serve_bench import _Conn, _percentile  # noqa: E402  (needs the paths above)
+
+# Gossip-storm shape: the slow set stalls this long per operation —
+# far past every budget in play, so only the budget (fixed vs adaptive)
+# and the breaker decide how much time a round loses to it.
+_SLOW_DELAY_S = 30.0
+# Fixed-constant posture, scaled to the smoke fleet's round clock the
+# way an operator would scale the reference's 3 s for a 50 ms interval.
+_FIXED_TIMEOUT_S = 0.5
+_WARM_S = 1.5  # healthy window before the storm: RTT samples accrue
+
+
+# -- part 1: gossip storm -----------------------------------------------------
+
+
+async def _fast_see(harness, fast: list[str], owner: str, key: str) -> bool:
+    for observer in fast:
+        if observer == owner:
+            continue
+        cluster = harness.clusters[observer]
+        seen = False
+        for node_id, ns in cluster.node_states_view().items():
+            if node_id.name == owner and ns.get(key) is not None:
+                seen = True
+                break
+        if not seen:
+            return False
+    return True
+
+
+async def _storm_arm(layer_on: bool, log) -> dict:
+    from aiocluster_tpu.faults import FaultPlan, LinkFault
+    from aiocluster_tpu.faults.runner import ChaosHarness
+
+    n_nodes, n_slow = 6, 2
+    interval = 0.05
+
+    def plan(h: ChaosHarness) -> FaultPlan:
+        slow = h.node_set(*h.names[:n_slow])
+        return FaultPlan(
+            links=(
+                LinkFault(src=slow, delay=_SLOW_DELAY_S, delay_prob=1.0,
+                          start=_WARM_S),
+                LinkFault(dst=slow, delay=_SLOW_DELAY_S, delay_prob=1.0,
+                          start=_WARM_S),
+            ),
+        )
+
+    overrides = {
+        "connect_timeout": _FIXED_TIMEOUT_S,
+        "read_timeout": _FIXED_TIMEOUT_S,
+        "write_timeout": _FIXED_TIMEOUT_S,
+        "adaptive_timeouts": layer_on,
+        "circuit_breaker": layer_on,
+        "adaptive_timeout_min": 0.05,
+    }
+    async with ChaosHarness(
+        n_nodes, plan, gossip_interval=interval, config_overrides=overrides
+    ) as harness:
+        fast = harness.names[n_slow:]
+        # Healthy warm-up: full-fleet convergence feeds every estimator.
+        await harness.wait_converged(timeout=30.0)
+        # Let the storm open (plus a few failed rounds so breakers can
+        # trip before the probe write lands).
+        while harness.elapsed() < _WARM_S + 10 * interval:
+            await asyncio.sleep(interval)
+
+        owner = fast[0]
+        t0 = time.monotonic()
+        harness.clusters[owner].set("storm-probe", "x")
+        visible_s = None
+        open_peers: set[str] = set()
+
+        def sample_breakers() -> None:
+            # Union over the whole soak: a breaker that opened and is
+            # now between windows still counts as "the storm opened it"
+            # (one early point sample races the 3-failure threshold —
+            # each failure costs a full budget, serialized behind the
+            # gossip semaphore).
+            for name in fast:
+                cluster = harness.clusters[name]
+                if cluster.health is not None:
+                    open_peers.update(cluster.health.open_peer_labels())
+
+        # Soak through the storm: the probe write's visibility is the
+        # degradation figure; the soak floor gives every fast node
+        # enough failed budgets against the slow set for breakers to
+        # cross the consecutive-failure threshold.
+        soak_floor = _WARM_S + 4.0
+        deadline = t0 + 30.0
+        while time.monotonic() < deadline:
+            sample_breakers()
+            if visible_s is None and await _fast_see(
+                harness, fast, owner, "storm-probe"
+            ):
+                visible_s = time.monotonic() - t0
+            if visible_s is not None and harness.elapsed() >= soak_floor:
+                break
+            await asyncio.sleep(interval / 2)
+        sample_breakers()
+
+        timeouts: list[float] = []
+        round_means: list[float] = []
+        for name in fast:
+            cluster = harness.clusters[name]
+            if cluster.health is not None:
+                timeouts.extend(cluster.health.timeouts_in_force())
+            hist = harness.registries[name].snapshot().get(
+                "aiocluster_round_seconds"
+            )
+            if isinstance(hist, dict) and hist.get("mean") is not None:
+                round_means.append(hist["mean"])
+        arm = {
+            "layer_on": layer_on,
+            "storm_write_visible_s": (
+                round(visible_s, 3) if visible_s is not None else None
+            ),
+            "breaker_open_peers": len(open_peers),
+            "round_mean_s": (
+                round(sum(round_means) / len(round_means), 4)
+                if round_means
+                else None
+            ),
+        }
+        if layer_on and timeouts:
+            arm["adaptive_timeout_p99_ms"] = round(
+                _percentile(sorted(timeouts), 0.99) * 1000.0, 2
+            )
+        log(f"storm arm layer_on={layer_on}: {arm}")
+        return arm
+
+
+# -- part 2: reader surge -----------------------------------------------------
+
+
+async def _surge_child_main(
+    port: int, clients: int, window_s: float, deadline_s: float
+) -> None:
+    """Child-process client fleet: its OWN event loop, so per-request
+    deadlines are real wall-clock deadlines. (Run in the server's
+    process, the saturated loop delivers late responses BEFORE the
+    even-later timeout callbacks — every arm then looks healthy.)
+    Prints one JSON stats line on stdout."""
+    stop = asyncio.Event()
+    stats = {"attempts": 0, "success": 0, "shed": 0, "timeout": 0}
+    latencies: list[float] = []
+
+    async def client() -> None:
+        conn = None
+        try:
+            while not stop.is_set():
+                if conn is None:
+                    conn = await _Conn.open(port)
+                stats["attempts"] += 1
+                t0 = time.monotonic()
+                try:
+                    status, hdrs, _body = await asyncio.wait_for(
+                        conn.request("GET", "/state"), timeout=deadline_s
+                    )
+                except (TimeoutError, asyncio.TimeoutError):
+                    # Missed deadline: the response is useless — abandon
+                    # the connection (its reply is in flight) and retry.
+                    stats["timeout"] += 1
+                    await conn.close()
+                    conn = None
+                    continue
+                if status.startswith("200"):
+                    stats["success"] += 1
+                    latencies.append(time.monotonic() - t0)
+                elif status.startswith("429"):
+                    # A well-behaved client honors Retry-After — the
+                    # feedback loop shedding exists to create: refused
+                    # work leaves, the admitted wave stays timely, and
+                    # the system stabilizes instead of collapsing.
+                    stats["shed"] += 1
+                    retry_after = min(
+                        2.0, float(hdrs.get("retry-after") or 1.0)
+                    )
+                    await asyncio.sleep(retry_after)
+        except (OSError, asyncio.IncompleteReadError, ValueError):
+            pass  # teardown races
+        finally:
+            if conn is not None:
+                await conn.close()
+
+    tasks = [asyncio.create_task(client()) for _ in range(clients)]
+    await asyncio.sleep(window_s)
+    stop.set()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    latencies.sort()
+    attempts = max(1, stats["attempts"])
+    print(
+        json.dumps(
+            {
+                **stats,
+                "availability_frac": round(stats["success"] / attempts, 4),
+                "p99_ms": (
+                    round(_percentile(latencies, 0.99) * 1000.0, 2)
+                    if latencies
+                    else None
+                ),
+            }
+        ),
+        flush=True,
+    )
+
+
+async def _surge_window(
+    port: int,
+    clients: int,
+    window_s: float,
+    deadline_s: float,
+) -> dict:
+    """One surge window: the client fleet runs in a CHILD process (real
+    deadlines — see _surge_child_main); the parent keeps serving and
+    polls /healthz (never shed) for the epoch-monotonicity pin."""
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable,
+        os.path.abspath(__file__),
+        "--surge-child",
+        str(port),
+        str(clients),
+        str(window_s),
+        str(deadline_s),
+        stdout=asyncio.subprocess.PIPE,
+    )
+
+    epochs: list[int] = []
+    stop = asyncio.Event()
+
+    async def epoch_sampler() -> None:
+        # /healthz is never shed: the operator view (and its epoch
+        # field) must survive the storm it is diagnosing. No deadline —
+        # a slow answer is still a monotone sample.
+        conn = await _Conn.open(port)
+        try:
+            while not stop.is_set():
+                status, _h, body = await conn.request("GET", "/healthz")
+                if status.startswith("200"):
+                    epochs.append(int(json.loads(body)["epoch"]))
+                await asyncio.sleep(0.05)
+        except (OSError, asyncio.IncompleteReadError, ValueError):
+            pass
+        finally:
+            await conn.close()
+
+    sampler = asyncio.create_task(epoch_sampler())
+    out, _ = await proc.communicate()
+    stop.set()
+    await sampler
+    stats = json.loads(out.splitlines()[-1])
+    stats["epochs_monotone"] = all(
+        a <= b for a, b in zip(epochs, epochs[1:])
+    )
+    stats["epoch_samples"] = len(epochs)
+    return stats
+
+
+async def _surge_bench(smoke: bool, log) -> dict:
+    from aiocluster_tpu import Cluster, Config, NodeId
+    from aiocluster_tpu.obs import MetricsRegistry
+    from aiocluster_tpu.serve import OverloadPolicy, ServeApp
+    from aiocluster_tpu.utils.net import free_ports
+
+    clients = 96 if smoke else 384
+    window_s = 3.0 if smoke else 8.0
+    deadline_s = 0.5
+    # The walk-per-request encode must cost enough that the CONTROL
+    # arm's closed-loop queue (clients x encode, one event loop)
+    # structurally overshoots the deadline — while the shedding arm's
+    # max_inflight-deep admitted queue stays well inside it. At ~9 ms
+    # per 6k-key encode: control ~96 x 9 ms ~ 0.9 s >> 0.5 s deadline;
+    # admitted ~4 x 9 ms ~ 36 ms.
+    keys = 6000 if smoke else 12000
+
+    ports = free_ports(2)
+    registries = [MetricsRegistry(), MetricsRegistry()]
+    clusters = [
+        Cluster(
+            Config(
+                node_id=NodeId(
+                    name=f"s{i}", gossip_advertise_addr=("127.0.0.1", p)
+                ),
+                cluster_id="overloadbench",
+                gossip_interval=0.1,
+                seed_nodes=[("127.0.0.1", ports[1 - i])],
+            ),
+            metrics=registries[i],
+        )
+        for i, p in enumerate(ports)
+    ]
+    await asyncio.gather(*(c.start() for c in clusters))
+    serve_cluster = clusters[0]
+    # A service-discovery-sized keyspace: the walk-per-request path must
+    # cost real CPU, or nothing saturates and both arms trivially pass.
+    for j in range(keys):
+        serve_cluster.set(f"svc-{j:04d}", f"value-{j:04d}-" + "x" * 64)
+
+    # Shed EARLY: a 429 is only useful if it arrives before the
+    # client's deadline, so the lag trigger sits well under it — the
+    # server starts refusing while it can still answer promptly.
+    shed_policy = OverloadPolicy(
+        enabled=True,
+        max_inflight=4,
+        shed_lag_s=0.1,
+        probe_interval_s=0.05,
+        retry_after_s=1.0,
+    )
+    # Writer keeps epochs moving through both windows so the
+    # monotonicity pin means something.
+    async def writer() -> None:
+        i = 0
+        while True:
+            serve_cluster.set("storm-write", f"v{i}")
+            i += 1
+            await asyncio.sleep(0.1)
+
+    writer_task = asyncio.create_task(writer())
+    try:
+        results: dict[str, dict] = {}
+        for arm, policy in (
+            ("off", OverloadPolicy(enabled=False)),
+            ("on", shed_policy),
+        ):
+            app = ServeApp(
+                serve_cluster, cache_enabled=False, overload=policy
+            )
+            port = await app.start()
+            try:
+                results[arm] = await _surge_window(
+                    port, clients, window_s, deadline_s
+                )
+                results[arm]["shed_total_server"] = app._shed_total
+            finally:
+                await app.stop()
+            log(f"surge arm {arm}: {results[arm]}")
+    finally:
+        writer_task.cancel()
+        try:
+            await writer_task
+        except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued at bench teardown
+            pass
+        await asyncio.gather(*(c.close() for c in clusters))
+    return {
+        "clients": clients,
+        "window_s": window_s,
+        "deadline_s": deadline_s,
+        "keys": keys,
+        "on": results["on"],
+        "off": results["off"],
+    }
+
+
+# -- entry points -------------------------------------------------------------
+
+
+async def _measure_async(smoke: bool, log) -> dict:
+    storm_on = await _storm_arm(True, log)
+    storm_off = await _storm_arm(False, log)
+    surge = await _surge_bench(smoke, log)
+    on_frac = surge["on"]["availability_frac"]
+    off_frac = surge["off"]["availability_frac"]
+    record = {
+        "smoke": smoke,
+        "storm": {"on": storm_on, "off": storm_off},
+        "surge": surge,
+        # Compact-line keys (bench.compact_record).
+        "overload_availability_frac": on_frac,
+        "overload_availability_frac_control": off_frac,
+        "breaker_open_peers": storm_on["breaker_open_peers"],
+        "adaptive_timeout_p99_ms": storm_on.get("adaptive_timeout_p99_ms"),
+    }
+    return record
+
+
+def measure(smoke: bool = True, log=print) -> dict:
+    return asyncio.run(_measure_async(smoke, log))
+
+
+def check_gates(record: dict) -> list[str]:
+    """The degradation claims `make overload-smoke` enforces; returns
+    human-readable failures (empty = green)."""
+    failures: list[str] = []
+    on, off = record["surge"]["on"], record["surge"]["off"]
+    if not (
+        on["availability_frac"] >= 2.0 * off["availability_frac"]
+        and on["availability_frac"] > 0.0
+    ):
+        failures.append(
+            "availability with shedding must be >= 2x the no-layer control "
+            f"(on={on['availability_frac']}, off={off['availability_frac']})"
+        )
+    if not on["epochs_monotone"] or on["epoch_samples"] < 3:
+        failures.append(
+            "serve epochs must stay monotone (and observable) through "
+            f"the storm: {on}"
+        )
+    if record["breaker_open_peers"] < 1:
+        failures.append(
+            "the slow-peer storm must open at least one breaker "
+            f"(got {record['breaker_open_peers']})"
+        )
+    storm_on = record["storm"]["on"]
+    if storm_on["storm_write_visible_s"] is None:
+        failures.append("mid-storm write never replicated to the fast subset")
+    if record["adaptive_timeout_p99_ms"] is None or not math.isfinite(
+        record["adaptive_timeout_p99_ms"]
+    ):
+        failures.append("adaptive_timeout_p99_ms missing from the record")
+    return failures
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--surge-child":
+        port, clients = int(sys.argv[2]), int(sys.argv[3])
+        window_s, deadline_s = float(sys.argv[4]), float(sys.argv[5])
+        asyncio.run(_surge_child_main(port, clients, window_s, deadline_s))
+        return
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+    log = (lambda _m: None) if args.json else print
+    record = measure(smoke=args.smoke, log=log)
+    failures = check_gates(record)
+    print(json.dumps(record, indent=None if args.json else 1))
+    if failures:
+        for f in failures:
+            print(f"GATE FAILED: {f}", file=sys.stderr)
+        sys.exit(1)
+    print("overload gates OK", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

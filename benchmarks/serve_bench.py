@@ -403,7 +403,7 @@ async def _bench(
     reader_seconds: float,
     log,
 ) -> dict:
-    from aiocluster_tpu.serve import ServeApp
+    from aiocluster_tpu.serve import OverloadPolicy, ServeApp
 
     # Server-side fds: ONE per watcher (the client ends live in the
     # child processes) + reader pools + fleet sockets + slack.
@@ -425,11 +425,21 @@ async def _bench(
         # stay up — the fleet is connected, just silent.
         await asyncio.gather(*(c._ticker.stop() for c in clusters))
 
-        cached_app = ServeApp(serve_cluster, hub_poll_interval=0.05)
+        # Admission control OFF on both arms: this bench measures the
+        # encode-once/fan-out behavior; with the (default-on) overload
+        # layer engaged, the 10k-watcher fan-out's loop lag would shed
+        # readers and watchers mid-measurement and skew the very
+        # ratios the gate certifies (docs/robustness.md owns that
+        # regime via benchmarks/overload_bench.py).
+        no_shed = OverloadPolicy(enabled=False)
+        cached_app = ServeApp(
+            serve_cluster, hub_poll_interval=0.05, overload=no_shed
+        )
         control_app = ServeApp(
             serve_cluster,
             metrics=registries[1],  # separate registry: distinct counters
             cache_enabled=False,
+            overload=no_shed,
         )
         await cached_app.start()
         await control_app.start()

@@ -1,0 +1,29 @@
+# analyze-domain: runtime
+"""TN: retry loops whose delay grows (backoff lives at the binding
+site), and constant-cadence loops that are NOT retries (no try/except:
+pollers and probes sleep a fixed interval legitimately)."""
+
+import asyncio
+import random
+
+
+async def dial_with_backoff(connect):
+    delay = 0.1
+    while True:
+        try:
+            return await connect()
+        except ConnectionError:
+            await asyncio.sleep(delay)  # variable: backoff at the binding
+            delay = min(5.0, delay * 3 * random.random())
+
+
+async def poll_status(probe, interval=0.25):
+    while True:  # a cadence loop, not a retry loop: no try in the body
+        await probe()
+        await asyncio.sleep(interval)
+
+
+async def heartbeat_pump(emit):
+    while True:
+        await emit()
+        await asyncio.sleep(1.0)  # constant, but nothing is retried here

@@ -1,0 +1,24 @@
+# analyze-domain: runtime
+"""TP: fixed-sleep retry loops — a constant cadence between retries
+(while-True and bounded-for variants) hammers the struggling peer in
+phase with every other retrier."""
+
+import asyncio
+
+
+async def dial_forever(connect):
+    while True:
+        try:
+            return await connect()
+        except ConnectionError:
+            pass
+        await asyncio.sleep(0.5)  # constant cadence: thundering herd
+
+
+async def dial_bounded(connect):
+    for _ in range(10):
+        try:
+            return await connect()
+        except OSError:
+            await asyncio.sleep(2)  # constant, inside the handler too
+    return None

@@ -114,6 +114,26 @@ def _worst_case_result():
                 "not_modified_per_sec": 1771.6,
                 "smoke": False,
             },
+            "overload_bench": {
+                "smoke": False,
+                "storm": {
+                    "on": {
+                        "layer_on": True,
+                        "storm_write_visible_s": 0.41,
+                        "breaker_open_peers": 2,
+                        "adaptive_timeout_p99_ms": 50.98,
+                    },
+                    "off": {
+                        "layer_on": False,
+                        "storm_write_visible_s": 2.87,
+                        "breaker_open_peers": 0,
+                    },
+                },
+                "overload_availability_frac": 0.3024,
+                "overload_availability_frac_control": 0.0782,
+                "breaker_open_peers": 2,
+                "adaptive_timeout_p99_ms": 50.98,
+            },
             "fd_kernel": False,
             "xla_path_rounds_per_sec": 43.2,
             "pallas_speedup": 1.56,
@@ -157,6 +177,13 @@ def test_stdout_line_stays_under_cap():
     assert ex["serve_watch_p99_ms"] == 3380.18
     assert ex["serve_cached_vs_control"] == 24.09
     assert ex["serve_encodes_per_epoch"] == 1.0
+    # The overload/degradation keys round-trip as flat scalars: the
+    # shedding-arm availability vs the no-layer control, the breakers
+    # the storm opened, and the p99 adaptive timeout in force.
+    assert ex["overload_availability_frac"] == 0.3024
+    assert ex["overload_availability_frac_control"] == 0.0782
+    assert ex["breaker_open_peers"] == 2
+    assert ex["adaptive_timeout_p99_ms"] == 50.98
     # The on-chip pointer survives a CPU fallback as scalars.
     assert ex["last_onchip_value"] > 1
     # And no nested structures sneak back in (flat extras only).

@@ -464,6 +464,9 @@ def test_hostsim_domain_matrix():
                 gossip_every=(1, 2), class_frac=(0.5, 0.5)
             ),
         ),
+        "quarantine": dataclasses.replace(
+            base, quarantine=True, pairing="choice"
+        ),
     }
     # The matrix covers every row in the table — a new row without a
     # violation case here fails the gate's own test.

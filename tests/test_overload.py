@@ -1,0 +1,574 @@
+"""Overload & degradation control (docs/robustness.md; ISSUE 10).
+
+- PeerRtt EWMA estimator seeding + the mean+k*stddev clamp semantics;
+- HealthTracker circuit breaker closed -> open -> half-open transitions
+  under an INJECTED clock and seeded rng: exact transition counts,
+  decorrelated-jitter backoff bounds, and the disabled-flag identity;
+- runtime peer selection: quarantined peers leave EVERY pick (live,
+  dead, seed); None/empty leaves the rng draw sequence byte-identical;
+- sim lowering (faults/sim.quarantine_mask): mask timing against the
+  fault window, the plan_quarantines static predicate, SimConfig
+  validation, and bit-identity when the plan quarantines nothing;
+- DIFFERENTIAL: the same slow-third plan on both backends — runtime
+  breakers vs sim masks — agrees on the convergence verdict, hostile
+  (no heal: neither converges) and healed (both reconverge), the
+  test_byzantine.py discipline.
+"""
+
+import asyncio
+from random import Random
+
+import numpy as np
+import pytest
+
+from aiocluster_tpu.faults import FaultPlan, LinkFault, NodeSet
+from aiocluster_tpu.faults.plan import _frac_of
+from aiocluster_tpu.faults.runner import ChaosHarness
+from aiocluster_tpu.faults.scenarios import flaky_links, slow_third
+from aiocluster_tpu.obs import MetricsRegistry
+from aiocluster_tpu.runtime.health import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    HealthTracker,
+    PeerRtt,
+)
+from aiocluster_tpu.runtime.peers import select_gossip_targets
+
+INTERVAL = 0.05
+ADDR = ("10.0.0.1", 9000)
+
+
+# -- PeerRtt estimator ---------------------------------------------------------
+
+
+def test_peer_rtt_seeds_and_clamps():
+    r = PeerRtt()
+    assert r.timeout(4.0, 0.0, 10.0) is None  # no samples yet
+    r.observe(0.1)
+    # First sample seeds mean=rtt, stddev=rtt/2 -> mean + 4*stddev.
+    assert r.timeout(4.0, 0.0, 10.0) == pytest.approx(0.1 + 4 * 0.05)
+    assert r.timeout(4.0, 0.5, 10.0) == 0.5  # floor clamp
+    assert r.timeout(4.0, 0.0, 0.2) == 0.2  # ceiling clamp
+
+
+def test_peer_rtt_variance_decays_on_steady_link():
+    r = PeerRtt()
+    for _ in range(200):
+        r.observe(0.01)
+    # A steady link's adaptive timeout converges toward its RTT.
+    assert r.mean == pytest.approx(0.01)
+    assert r.timeout(4.0, 0.0, 10.0) < 0.012
+
+
+def test_adaptive_flag_gates_timeout_not_sampling():
+    t_on = HealthTracker(adaptive=True, breaker=False)
+    t_off = HealthTracker(adaptive=False, breaker=False)
+    for t in (t_on, t_off):
+        t.record_rtt(ADDR, 0.02)
+    assert t_on.timeout_for(ADDR) is not None
+    assert t_on.timeout_for(("1.2.3.4", 1)) is None  # unsampled peer
+    # Off: the stats exist (healthz reports them) but no budget is
+    # ever returned — the fixed constants stay in force.
+    assert t_off.timeout_for(ADDR) is None
+    assert t_off.timeouts_in_force() == []
+    assert t_on.timeouts_in_force() != []
+
+
+# -- circuit breaker -----------------------------------------------------------
+
+
+def _tracker(reg=None, **kw):
+    now = {"t": 0.0}
+    tracker = HealthTracker(
+        adaptive=False,
+        breaker=True,
+        failure_threshold=3,
+        base_backoff=1.0,
+        max_backoff=8.0,
+        rng=Random(7),
+        clock=lambda: now["t"],
+        metrics=reg,
+        **kw,
+    )
+    return tracker, now
+
+
+def _transitions(reg: MetricsRegistry) -> dict[str, int]:
+    return {
+        key.split("to=")[1].rstrip("}"): int(v)
+        for key, v in reg.snapshot().items()
+        if key.startswith("aiocluster_breaker_transitions_total{")
+    }
+
+
+def test_breaker_exact_transitions_under_injected_clock():
+    reg = MetricsRegistry()
+    tracker, now = _tracker(reg)
+
+    # Two failures: still closed, nothing quarantined.
+    tracker.record_failure(ADDR)
+    tracker.record_failure(ADDR)
+    assert tracker.breaker_state(ADDR) == CLOSED
+    assert tracker.quarantined_peers() == set()
+
+    # Third consecutive failure opens with uniform(base, 3*base) backoff.
+    tracker.record_failure(ADDR)
+    assert tracker.breaker_state(ADDR) == OPEN
+    assert tracker.quarantined_peers() == {ADDR}
+    b = tracker._breakers[ADDR]
+    assert 1.0 <= b.backoff <= 3.0
+    assert tracker.open_peer_labels() == ["10.0.0.1:9000"]
+
+    # Inside the window: quarantined. At expiry: released for a probe.
+    now["t"] = b.open_until - 1e-6
+    assert tracker.quarantined_peers() == {ADDR}
+    now["t"] = b.open_until
+    assert tracker.quarantined_peers() == set()
+
+    # The next attempt IS the half-open probe — and a probe in flight
+    # re-quarantines (exactly one probe per window).
+    tracker.begin_attempt(ADDR)
+    assert tracker.breaker_state(ADDR) == HALF_OPEN
+    assert tracker.quarantined_peers() == {ADDR}
+
+    # Probe failure re-opens with a GROWN decorrelated window.
+    prev = b.backoff
+    tracker.record_failure(ADDR)
+    assert tracker.breaker_state(ADDR) == OPEN
+    assert 1.0 <= b.backoff <= min(8.0, 3 * prev)
+    assert b.opens == 2
+
+    # Heal: expire, probe, success -> closed, failure streak reset.
+    now["t"] = b.open_until
+    tracker.begin_attempt(ADDR)
+    tracker.record_success(ADDR)
+    assert tracker.breaker_state(ADDR) == CLOSED
+    assert b.failures == 0
+    assert tracker.quarantined_peers() == set()
+    assert tracker.open_peer_labels() == []
+
+    # Exact lifetime transition counts: 2 opens, 2 half-opens, 1 close.
+    assert _transitions(reg) == {"open": 2, "half_open": 2, "closed": 1}
+
+
+def test_half_open_probe_window_lapses_instead_of_sticking():
+    """A half-open probe whose handshake dies without reporting
+    (cancellation, an unclassified exception path) must not quarantine
+    the peer forever: the probe holds the quarantine for one
+    base-backoff window, then the next draw re-probes."""
+    reg = MetricsRegistry()
+    tracker, now = _tracker(reg)
+    for _ in range(3):
+        tracker.record_failure(ADDR)
+    b = tracker._breakers[ADDR]
+    now["t"] = b.open_until
+    tracker.begin_attempt(ADDR)
+    assert tracker.breaker_state(ADDR) == HALF_OPEN
+    assert tracker.quarantined_peers() == {ADDR}
+    # The probe never reports. Its window (one base backoff) lapses:
+    now["t"] = b.open_until
+    assert tracker.quarantined_peers() == set()
+    # The next attempt is a fresh probe — same state, a new window,
+    # NO extra half_open transition counted.
+    tracker.begin_attempt(ADDR)
+    assert tracker.breaker_state(ADDR) == HALF_OPEN
+    assert tracker.quarantined_peers() == {ADDR}
+    assert _transitions(reg) == {"open": 1, "half_open": 1}
+    tracker.record_success(ADDR)
+    assert tracker.breaker_state(ADDR) == CLOSED
+
+
+def test_breaker_backoff_capped_at_max():
+    tracker, now = _tracker()
+    for _ in range(40):  # repeated probe failures grow the window
+        for _ in range(3):
+            tracker.record_failure(ADDR)
+        b = tracker._breakers[ADDR]
+        assert b.backoff <= 8.0
+        now["t"] = b.open_until
+        tracker.begin_attempt(ADDR)
+
+
+def test_breaker_disabled_is_inert():
+    tracker = HealthTracker(adaptive=False, breaker=False)
+    for _ in range(10):
+        tracker.record_failure(ADDR)
+    assert tracker.breaker_state(ADDR) == CLOSED
+    assert tracker.quarantined_peers() == set()
+    assert tracker.summary()["breaker_open_peers"] == []
+
+
+def test_forget_evicts_peer_state_and_gauge_series():
+    """Membership GC must bound the per-peer maps: forget() drops the
+    estimator, the breaker AND the ``aiocluster_breaker_state{peer}``
+    gauge series — without it, restart-with-fresh-port churn grows
+    health memory and the /metrics payload forever."""
+    reg = MetricsRegistry()
+    tracker, _ = _tracker(reg)
+    tracker.record_rtt(ADDR, 0.01)
+    for _ in range(3):
+        tracker.record_failure(ADDR)
+    label = f"aiocluster_breaker_state{{peer={ADDR[0]}:{ADDR[1]}}}"
+    assert label in reg.snapshot()
+    tracker.forget(ADDR)
+    assert tracker._rtt == {} and tracker._breakers == {}
+    assert label not in reg.snapshot()
+    assert tracker.quarantined_peers() == set()
+    # Forgetting an unknown peer is a no-op.
+    tracker.forget(("10.1.1.1", 1))
+
+
+def test_success_resets_consecutive_failure_streak():
+    tracker, _ = _tracker()
+    tracker.record_failure(ADDR)
+    tracker.record_failure(ADDR)
+    tracker.record_success(ADDR)
+    tracker.record_failure(ADDR)
+    tracker.record_failure(ADDR)
+    # 2 + 2 failures with a success between: never reaches 3 in a row.
+    assert tracker.breaker_state(ADDR) == CLOSED
+
+
+# -- runtime peer selection ----------------------------------------------------
+
+
+def _addrs(lo: int, hi: int) -> set[tuple[str, int]]:
+    return {("10.0.0.1", p) for p in range(lo, hi)}
+
+
+def test_select_targets_quarantine_excluded_from_every_role():
+    peers = _addrs(0, 8)
+    live = _addrs(0, 5)
+    dead = _addrs(5, 7)
+    seeds = _addrs(7, 8)
+    quarantined = {("10.0.0.1", 1), ("10.0.0.1", 5), ("10.0.0.1", 7)}
+    rng = Random(3)
+    for _ in range(50):
+        targets, dead_t, seed_t = select_gossip_targets(
+            peers, live, dead, seeds, rng=rng, gossip_count=3,
+            quarantined=quarantined,
+        )
+        for pick in (*targets, dead_t, seed_t):
+            assert pick not in quarantined
+
+
+async def test_isolated_node_never_quarantines_its_seed():
+    """Bootstrap ordering: a node whose only contact is a still-down
+    seed must keep dialing it at the reference cadence — quarantine
+    with an EMPTY live set would delay the eventual join by the
+    accrued backoff (up to 64 intervals) after the seed comes up."""
+    import socket
+
+    from aiocluster_tpu import Cluster, Config, NodeId
+    from aiocluster_tpu.obs import MetricsRegistry
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    my_port = s.getsockname()[1]
+    s2 = socket.socket()
+    s2.bind(("127.0.0.1", 0))
+    seed_port = s2.getsockname()[1]
+    s.close(), s2.close()  # seed stays DOWN: connects are refused
+    reg = MetricsRegistry()
+    c = Cluster(
+        Config(
+            node_id=NodeId(
+                name="boot", gossip_advertise_addr=("127.0.0.1", my_port)
+            ),
+            cluster_id="bootq",
+            gossip_interval=0.02,
+            seed_nodes=[("127.0.0.1", seed_port)],
+        ),
+        metrics=reg,
+    )
+    await c.start()
+    try:
+        # Let the breaker open against the dead seed, then keep
+        # counting seed picks: the empty-live-set carve-out must keep
+        # drawing it every round (no quarantine gap).
+        seed_addr = ("127.0.0.1", seed_port)
+        for _ in range(200):
+            if c.health.breaker_state(seed_addr) != CLOSED:
+                break
+            await asyncio.sleep(0.02)
+        assert c.health.breaker_state(seed_addr) != CLOSED
+
+        def seed_picks() -> int:
+            key = "aiocluster_peer_selection_total{kind=seed}"
+            return int(reg.snapshot().get(key, 0))
+
+        before = seed_picks()
+        await asyncio.sleep(0.5)  # ~25 rounds at 20ms
+        picks = seed_picks() - before
+        assert picks >= 10, picks  # quarantined would be ~0
+    finally:
+        await c.close()
+
+
+def test_select_targets_no_quarantine_keeps_rng_sequence():
+    """None (breaker off) and the empty set leave the draw sequence —
+    not just the distribution — byte-identical to the reference path."""
+    peers, live = _addrs(0, 8), _addrs(0, 6)
+    dead, seeds = _addrs(6, 7), _addrs(7, 8)
+
+    def draws(**kw):
+        rng = Random(11)
+        return [
+            select_gossip_targets(
+                peers, live, dead, seeds, rng=rng, gossip_count=3, **kw
+            )
+            for _ in range(20)
+        ]
+
+    assert draws() == draws(quarantined=None) == draws(quarantined=set())
+
+
+async def test_flags_off_constructs_no_tracker():
+    """``adaptive_timeouts=False`` + ``circuit_breaker=False`` is the
+    reference posture: no HealthTracker exists, /healthz still reports
+    an (empty) breaker field, and the gossip path budgets fall back to
+    the configured constants (every ``timeout=None`` default)."""
+    from aiocluster_tpu import Cluster, Config, NodeId
+    from aiocluster_tpu.obs import MetricsRegistry
+
+    c = Cluster(
+        Config(
+            node_id=NodeId(
+                name="ref", gossip_advertise_addr=("127.0.0.1", 19876)
+            ),
+            cluster_id="identity",
+            adaptive_timeouts=False,
+            circuit_breaker=False,
+        ),
+        metrics=MetricsRegistry(),
+    )
+    assert c.health is None
+    summary = c.health_summary()
+    assert summary["breaker_open_peers"] == []
+    assert "adaptive_timeouts" not in summary  # no tracker to report
+
+
+# -- sim lowering --------------------------------------------------------------
+
+
+def test_quarantine_mask_timing_follows_fault_window():
+    import jax.numpy as jnp
+
+    from aiocluster_tpu.faults.sim import quarantine_mask
+
+    n = 12
+    plan = slow_third(delay=30.0, start=5.0, end=10.0)
+    slow = np.arange(n) / n < 1.0 / 3.0
+
+    def mask(tick):
+        return np.asarray(
+            quarantine_mask(plan, n, jnp.asarray(tick), open_after=3)
+        )
+
+    # Before the window, and during the failures-to-open ramp: nothing.
+    assert not mask(4).any()
+    assert not mask(7).any()
+    # Open: exactly the slow destination set, from start+open_after.
+    assert (mask(8) == slow).all()
+    assert (mask(9) == slow).all()
+    # Healed: the half-open probe succeeds at tick resolution.
+    assert not mask(10).any()
+
+
+def test_plan_quarantines_predicate():
+    from aiocluster_tpu.faults.sim import plan_quarantines
+
+    assert plan_quarantines(slow_third(delay=30.0))
+    # Sub-tick delays never fail a sim exchange: nothing to lower.
+    assert not plan_quarantines(slow_third(delay=0.5))
+    # All-destination faults degrade the initiator everywhere — not a
+    # per-peer breaker signal.
+    assert not plan_quarantines(flaky_links(1.0))
+    # Sub-certain failure probability: the breaker may or may not open.
+    assert not plan_quarantines(
+        FaultPlan(
+            links=(LinkFault(dst=NodeSet(frac=(0.0, 0.5)), drop=0.5),)
+        )
+    )
+    # A src-restricted fault opens breakers only on the affected
+    # initiators — the all-initiator mask must not model it.
+    assert not plan_quarantines(
+        FaultPlan(
+            links=(
+                LinkFault(
+                    src=NodeSet(frac=(0.0, 0.1)),
+                    dst=NodeSet(frac=(0.5, 1.0)),
+                    drop=1.0,
+                ),
+            )
+        )
+    )
+    assert not plan_quarantines(None)
+    assert not plan_quarantines(FaultPlan())
+
+
+def test_sim_quarantine_config_validation():
+    from aiocluster_tpu.sim.config import SimConfig
+
+    base = dict(n_nodes=16, keys_per_node=2)
+    with pytest.raises(ValueError, match="pairing='choice'"):
+        SimConfig(pairing="matching", quarantine=True, **base)
+    with pytest.raises(ValueError, match="peer_mode='alive'"):
+        SimConfig(
+            pairing="choice", peer_mode="view", quarantine=True,
+            track_failure_detector=True, **base
+        )
+    with pytest.raises(ValueError, match="quarantine_open_after"):
+        SimConfig(
+            pairing="choice", quarantine=True,
+            quarantine_open_after=-1, **base
+        )
+    SimConfig(pairing="choice", quarantine=True, **base)  # fine
+    # Cadence classes accumulate failures k times slower than the
+    # fixed-open_after mask models: the combination is refused.
+    from aiocluster_tpu.models.topology import Heterogeneity
+
+    with pytest.raises(ValueError, match="cadence"):
+        SimConfig(
+            pairing="choice", quarantine=True,
+            heterogeneity=Heterogeneity(
+                gossip_every=(1, 2), class_frac=(0.5, 0.5)
+            ),
+            **base,
+        )
+    # Cadence-uniform heterogeneity (WAN zones only) stays allowed.
+    SimConfig(
+        pairing="choice", quarantine=True,
+        heterogeneity=Heterogeneity(gossip_every=(1,), class_frac=(1.0,)),
+        **base,
+    )
+
+
+def _watermark_traj(cfg, seed=3, rounds=12):
+    import jax
+
+    from aiocluster_tpu.sim.packed import watermarks_i32
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    sim = Simulator(cfg, seed=seed)
+    out = []
+    for _ in range(rounds // 4):
+        sim.run(4)
+        out.append(np.asarray(watermarks_i32(jax.device_get(sim.state))))
+    return out
+
+
+def test_sim_quarantine_static_noop_is_bit_identical():
+    """quarantine=True with a plan that quarantines NOTHING keeps the
+    unmasked draw and its exact bit-stream (the static predicate)."""
+    from aiocluster_tpu.sim.config import SimConfig
+
+    base = dict(
+        n_nodes=32, keys_per_node=4, fanout=2, budget=16,
+        pairing="choice", track_failure_detector=False,
+        track_heartbeats=False, fault_plan=flaky_links(0.3, seed=2),
+    )
+    ref = _watermark_traj(SimConfig(quarantine=False, **base))
+    got = _watermark_traj(SimConfig(quarantine=True, **base))
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+
+
+def test_sim_quarantine_changes_draw_only_inside_window():
+    """An effective plan engages the mask: the trajectory may differ
+    from the unquarantined run, but the fleet still converges once the
+    window heals — quarantine redirects sub-exchanges, it never loses
+    updates."""
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    plan = slow_third(delay=30.0, start=0.0, end=12.0, seed=3)
+    base = dict(
+        n_nodes=32, keys_per_node=4, fanout=2, budget=32,
+        pairing="choice", track_failure_detector=False,
+        track_heartbeats=False, fault_plan=plan,
+    )
+    r_q = Simulator(
+        SimConfig(quarantine=True, **base), seed=3
+    ).run_until_converged(max_rounds=200)
+    r_ref = Simulator(
+        SimConfig(quarantine=False, **base), seed=3
+    ).run_until_converged(max_rounds=200)
+    # Both converge only after the heal; the quarantined run spends no
+    # sub-exchanges on the slow set while the window is open.
+    assert r_q is not None and r_q > 12
+    assert r_ref is not None and r_ref > 12
+
+
+def test_sim_quarantine_rejects_topology():
+    from aiocluster_tpu.models.topology import ring
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    cfg = SimConfig(
+        n_nodes=16, keys_per_node=2, quarantine=True, pairing="choice",
+        fault_plan=slow_third(delay=30.0),
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    sim = Simulator(cfg, seed=1, topology=ring(16))
+    with pytest.raises(ValueError, match="topology"):
+        sim.run(1)
+
+
+# -- differential: runtime breakers vs sim masks -------------------------------
+
+
+def _sim_verdict(plan, max_rounds=200):
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    cfg = SimConfig(
+        n_nodes=64, keys_per_node=4, fanout=2, budget=32,
+        pairing="choice", track_failure_detector=False,
+        track_heartbeats=False, fault_plan=plan, quarantine=True,
+    )
+    return Simulator(cfg, seed=3).run_until_converged(max_rounds=max_rounds)
+
+
+async def _runtime_verdict(plan, n=6, wait_s=6.0) -> bool:
+    # Breakers + adaptive timeouts are DEFAULT-ON: the runtime arm is
+    # the shipped posture, not a tuned one.
+    async with ChaosHarness(n, plan, gossip_interval=INTERVAL) as h:
+        try:
+            await h.wait_converged(timeout=wait_s)
+            return True
+        except TimeoutError:
+            return False
+
+
+def _slow_names(n: int) -> list[str]:
+    return [
+        name
+        for name in (f"n{i:02d}" for i in range(n))
+        if _frac_of(name) < 1.0 / 3.0
+    ]
+
+
+async def test_differential_slow_third_hostile_neither_converges():
+    """The same un-healed slow-third plan on both backends: the slow
+    set is unreachable in both directions, so full convergence is
+    impossible — runtime (breakers quarantining) and sim (masks) agree
+    on the FAIL verdict."""
+    plan = slow_third(delay=30.0)
+    slow = _slow_names(6)
+    assert slow and len(slow) < 6, slow  # the fleet has both classes
+    assert _sim_verdict(plan) is None
+    assert await _runtime_verdict(plan) is False
+
+
+async def test_differential_slow_third_healed_both_reconverge():
+    """A healing window: the breakers' half-open probes readmit the
+    slow set on the runtime, the mask lifts in the sim — the SAME
+    verdict (reconverges after the heal) on both backends."""
+    sim_r = _sim_verdict(slow_third(delay=30.0, end=20.0), max_rounds=240)
+    assert sim_r is not None and sim_r > 20
+    run_conv = await _runtime_verdict(
+        slow_third(delay=30.0, end=2.0), wait_s=20.0
+    )
+    assert run_conv is True

@@ -378,3 +378,137 @@ async def test_engine_syn_bytes_cache_quiescent(free_port_factory):
     assert second is not first
     pkt = decode_packet(second)
     assert pkt.msg.digest.node_digests[nid].max_version == 2
+
+
+async def test_breaker_storm_exact_transitions_and_zero_redials_while_open(
+    free_port_factory,
+):
+    """The per-peer circuit breaker under a sustained connect-refused
+    storm (docs/robustness.md), injected clocks on BOTH the fault
+    controller and the HealthTracker so every transition is scheduled,
+    not raced:
+
+    - three consecutive refused handshakes open the breaker (exact
+      decorrelated backoff bounds, seeded rng);
+    - while open, gossip rounds burn ZERO redials on the peer — the
+      quarantine removes it from every pick (pool event counts pinned);
+    - at backoff expiry the next handshake IS the half-open probe; its
+      failure re-opens with a grown window;
+    - after the storm heals, the probe succeeds and the breaker closes.
+
+    Lifetime transition counts are asserted EXACTLY via a dedicated
+    registry: open 2, half_open 2, closed 1.
+    """
+    from random import Random
+
+    from aiocluster_tpu.faults import FaultPlan, LinkFault, NodeSet
+    from aiocluster_tpu.runtime.health import CLOSED, OPEN, HealthTracker
+
+    p1, p2 = free_port_factory(), free_port_factory()
+    peer = NodeSet(names=("two", f"127.0.0.1:{p2}"))
+    plan = FaultPlan(
+        links=(LinkFault(dst=peer, drop=1.0, start=10.0, end=20.0),),
+    )
+    r1 = MetricsRegistry()
+    c1 = _mk_cluster("one", p1, p2, metrics=r1, fault_plan=plan)
+    c2 = _mk_cluster("two", p2, p1, metrics=MetricsRegistry())
+
+    now = {"t": 0.0}
+    ctl = c1.fault_controller
+    ctl._clock = lambda: now["t"]
+    ctl._t0 = 0.0
+    # The breaker under test: deterministic clock + seeded backoff rng,
+    # its own registry so transition counts start at zero.
+    r_health = MetricsRegistry()
+    health = HealthTracker(
+        adaptive=False,
+        breaker=True,
+        failure_threshold=3,
+        base_backoff=1.0,
+        max_backoff=8.0,
+        rng=Random(7),
+        clock=lambda: now["t"],
+        metrics=r_health,
+    )
+    c1._health = health
+    addr = ("127.0.0.1", p2)
+
+    def transitions() -> dict:
+        return {
+            key.split("to=")[1].rstrip("}"): int(v)
+            for key, v in r_health.snapshot().items()
+            if key.startswith("aiocluster_breaker_transitions_total{")
+        }
+
+    for c in (c1, c2):
+        host, port = c._config.node_id.gossip_advertise_addr
+        c._server = await c._transport.start_server(
+            host, port, c._handle_connection
+        )
+    try:
+        # Healthy handshake: pooled conn, breaker stays closed.
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert health.breaker_state(addr) == CLOSED
+        assert _pool_events(r1) == {"miss": 1}
+
+        # Storm (t=15): handshake 1 loses the pooled conn (reconnect
+        # consumed, redial refused), handshakes 2-3 are fresh refused
+        # dials -> the third consecutive failure OPENS the breaker.
+        now["t"] = 15.0
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert health.breaker_state(addr) == CLOSED
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert health.breaker_state(addr) == CLOSED
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert health.breaker_state(addr) == OPEN
+        assert health.quarantined_peers() == {addr}
+        b = health._breakers[addr]
+        assert 1.0 <= b.backoff <= 3.0  # uniform(base, 3*base)
+        assert transitions() == {"open": 1}
+
+        # While open: full gossip rounds burn ZERO redials — the peer
+        # (also the seed) is quarantined out of every pick. The FD must
+        # believe the peer is live first (it would be, this early in a
+        # real storm): with an EMPTY live set the quarantine disarms by
+        # design — an isolated node has nothing better to do than
+        # redial (see the bootstrap carve-out in _gossip_round).
+        two = next(n for n in c1._cluster_state.nodes() if n.name == "two")
+        # Two heartbeats build the interarrival sample phi needs.
+        c1._failure_detector.report_heartbeat(two)
+        c1._failure_detector.report_heartbeat(two)
+        c1._failure_detector.update_node_liveness(two)
+        assert two in c1._failure_detector.live_nodes()
+        before = dict(_pool_events(r1))
+        for _ in range(5):
+            await c1._gossip_round()
+        assert _pool_events(r1) == before
+
+        # Backoff expiry, storm still on: the next handshake is the
+        # half-open probe; its failure re-opens with a grown window.
+        now["t"] = b.open_until
+        assert health.quarantined_peers() == set()
+        prev_backoff = b.backoff
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert health.breaker_state(addr) == OPEN
+        assert b.opens == 2
+        assert 1.0 <= b.backoff <= min(8.0, 3 * prev_backoff)
+        assert transitions() == {"open": 2, "half_open": 1}
+
+        # Healed (t=25 > end) and past the window: probe succeeds,
+        # breaker closes, the peer pools a live connection again.
+        now["t"] = max(25.0, b.open_until)
+        before = dict(_pool_events(r1))
+        await c1._gossip_with("127.0.0.1", p2, "live")
+        assert health.breaker_state(addr) == CLOSED
+        assert b.failures == 0
+        assert c1._pool.idle_connections() == 1
+        assert transitions() == {"open": 2, "half_open": 2, "closed": 1}
+    finally:
+        for c in (c1, c2):
+            await c._pool.close()
+            for writer in list(c._inbound):
+                writer.close()
+                with __import__("contextlib").suppress(Exception):
+                    await writer.wait_closed()
+            c._server.close()
+            await c._server.wait_closed()

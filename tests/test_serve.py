@@ -37,7 +37,12 @@ from aiocluster_tpu.core.identity import NodeId as CoreNodeId
 from aiocluster_tpu.faults.runner import ChaosHarness
 from aiocluster_tpu.faults.scenarios import split_brain
 from aiocluster_tpu.obs import MetricsRegistry
-from aiocluster_tpu.serve import ServeApp, SnapshotCache, encode_snapshot
+from aiocluster_tpu.serve import (
+    OverloadPolicy,
+    ServeApp,
+    SnapshotCache,
+    encode_snapshot,
+)
 from aiocluster_tpu.utils.aio import timeout_after
 
 
@@ -746,3 +751,150 @@ async def test_serving_through_split_brain_heal():
         for name in harness.names:
             assert served["nodes"][name][f"from-{name}"] == name
         await app.stop()
+
+
+# -- overload & degradation (docs/robustness.md) ------------------------------
+
+
+async def test_healthz_is_a_real_degraded_state_report(free_port):
+    """/healthz is no longer the reference example's static "ok": a
+    healthy serving member reports the full degraded-state JSON, and a
+    CLOSED cluster turns into a 503 — load balancers must stop routing
+    to a member whose cluster is gone, static-ok can't tell them."""
+    c = _make_cluster(free_port)
+    await c.start()
+    app = ServeApp(c)
+    port = await app.start()
+    try:
+        status, _, body = await _request(port, "GET", "/healthz")
+        assert status == "200 OK"
+        rep = json.loads(body)
+        assert rep["status"] == "ok"
+        # The degraded-state fields (docs/robustness.md): loop lag,
+        # shed counts, overload posture, breakers, FD liveness + phi.
+        for field in (
+            "loop_lag_s", "inflight", "shed_total", "live", "dead",
+            "epoch", "max_phi", "breaker_open_peers",
+            "adaptive_timeouts", "circuit_breaker",
+        ):
+            assert field in rep, field
+        assert rep["shed_total"] == 0
+        assert rep["breaker_open_peers"] == []
+        assert rep["epoch"] == c.state_epoch()
+
+        # Cluster closed, app still up: 503 + "closed".
+        await c.close()
+        status, _, body = await _request(port, "GET", "/healthz")
+        assert status == "503 Service Unavailable"
+        assert json.loads(body)["status"] == "closed"
+    finally:
+        await app.stop()
+
+
+async def test_healthz_reports_open_breakers_as_degraded(free_port):
+    c = _make_cluster(free_port)
+    async with c:
+        # Three consecutive failures: the default-on breaker opens.
+        for _ in range(3):
+            c.health.record_failure(("10.9.0.9", 1234))
+        app = ServeApp(c)
+        port = await app.start()
+        try:
+            status, _, body = await _request(port, "GET", "/healthz")
+            rep = json.loads(body)
+            assert status == "200 OK"
+            assert rep["status"] == "degraded"
+            assert rep["breaker_open_peers"] == ["10.9.0.9:1234"]
+        finally:
+            await app.stop()
+
+
+async def test_inflight_shed_429_spares_watch_and_operator_view(free_port):
+    """Past ``max_inflight`` every executing endpoint sheds with 429 +
+    Retry-After; /watch (parked, not executing), /healthz and /metrics
+    are never shed by the in-flight bound."""
+    c = _make_cluster(free_port)
+    async with c:
+        c.set("k", "v")
+        app = ServeApp(
+            c,
+            overload=OverloadPolicy(
+                enabled=True, max_inflight=0, retry_after_s=1.5,
+                probe_interval_s=60.0,
+            ),
+        )
+        port = await app.start()
+        try:
+            status, hdrs, _ = await _request(port, "GET", "/state")
+            assert status == "429 Too Many Requests"
+            assert hdrs["retry-after"] == "2"  # ceil(1.5)
+            status, _, _ = await _request(port, "GET", "/kv/k")
+            assert status == "429 Too Many Requests"
+            # The in-flight bound spares parked long-polls...
+            status, _, _ = await _request(
+                port, "GET", "/watch?timeout=0.02"
+            )
+            assert status == "204 No Content"
+            # ...and the operator's view is NEVER shed.
+            status, _, body = await _request(port, "GET", "/healthz")
+            assert status == "200 OK"
+            rep = json.loads(body)
+            assert rep["status"] == "degraded"
+            assert rep["shed_total"] == 2
+            status, _, body = await _request(port, "GET", "/metrics")
+            assert status == "200 OK"
+            assert b'aiocluster_serve_shed_total{reason="inflight"} 2' in body
+        finally:
+            await app.stop()
+
+
+async def test_lag_shed_applies_to_watch_and_recovers(free_port):
+    """Measured event-loop lag past the threshold sheds EVERYTHING
+    (including /watch — a lagging loop can't keep wake latency either);
+    when the lag decays the tier readmits."""
+    c = _make_cluster(free_port)
+    async with c:
+        c.set("k", "v")
+        app = ServeApp(
+            c,
+            overload=OverloadPolicy(
+                enabled=True, shed_lag_s=1.0, probe_interval_s=60.0,
+            ),
+        )
+        port = await app.start()
+        try:
+            app._lag = 5.0  # the probe is parked for 60s: ours to set
+            status, _, _ = await _request(port, "GET", "/state")
+            assert status == "429 Too Many Requests"
+            status, _, _ = await _request(port, "GET", "/watch?timeout=0.02")
+            assert status == "429 Too Many Requests"
+            status, _, body = await _request(port, "GET", "/healthz")
+            rep = json.loads(body)
+            assert (status, rep["status"]) == ("200 OK", "degraded")
+            assert rep["loop_lag_s"] == 5.0
+
+            app._lag = 0.0  # decayed: back to admitting
+            status, _, _ = await _request(port, "GET", "/state")
+            assert status == "200 OK"
+            status, _, body = await _request(port, "GET", "/healthz")
+            assert json.loads(body)["status"] == "ok"
+        finally:
+            await app.stop()
+
+
+async def test_overload_disabled_is_reference_behavior(free_port):
+    """``OverloadPolicy(enabled=False)`` (the bench control arm): no
+    request is ever shed, whatever the gauges say."""
+    c = _make_cluster(free_port)
+    async with c:
+        c.set("k", "v")
+        app = ServeApp(c, overload=OverloadPolicy(enabled=False))
+        port = await app.start()
+        try:
+            app._lag = 99.0
+            app._inflight = 10**6
+            status, _, _ = await _request(port, "GET", "/state")
+            assert status == "200 OK"
+            app._inflight = 0
+        finally:
+            await app.stop()

@@ -336,6 +336,70 @@ def check_unbounded_queue(ctx: FileContext):
             )
 
 
+@rule("ACT027", "fixed-sleep-retry", "retry loop sleeps a constant with no backoff")
+def check_fixed_sleep_retry(ctx: FileContext):
+    """The overload layer's retry discipline (runtime/health.py,
+    docs/robustness.md): a retry loop that sleeps a CONSTANT between
+    attempts hammers a struggling peer at a fixed cadence — and a fleet
+    of such loops thunders in phase. Flags a ``while``/``for`` loop in
+    the runtime/ or serve/ trees whose body contains BOTH a
+    ``try``/``except`` (the retry shape: the failure is absorbed and
+    the loop goes around) AND an awaited ``asyncio.sleep`` whose delay
+    is a numeric literal. A delay held in a variable or expression is
+    accepted — growth/jitter then lives at the binding site (the
+    decorrelated-jitter backoff the breaker uses); a constant cannot
+    back off by construction. Cadence loops without a try (pollers,
+    probes) are out of scope."""
+    if ctx.tree is None or not ({"runtime", "serve"} & ctx.domains):
+        return
+
+    def is_const_delay(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+            return is_const_delay(expr.operand)
+        # sleep(0) is the canonical cooperative-yield idiom, not a
+        # retry cadence — a zero delay cannot thunder.
+        return (
+            isinstance(expr, ast.Constant)
+            and isinstance(expr.value, (int, float))
+            and not isinstance(expr.value, bool)
+            and expr.value != 0
+        )
+
+    flagged: set[ast.AST] = set()
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+            continue
+        body = walk_excluding_nested_functions(loop.body)
+        has_try = False
+        sleeps: list[ast.AST] = []
+        for node in body:
+            if isinstance(node, ast.Try):
+                has_try = True
+            elif isinstance(node, ast.Await) and isinstance(
+                node.value, ast.Call
+            ):
+                call = node.value
+                if (
+                    ctx.resolve(call.func) == "asyncio.sleep"
+                    and call.args
+                    and is_const_delay(call.args[0])
+                ):
+                    sleeps.append(node)
+        if not has_try:
+            continue
+        for node in sleeps:
+            if node not in flagged:  # nested loops walk the same body
+                flagged.add(node)
+                yield ctx.finding(
+                    node,
+                    "ACT027",
+                    "fixed-sleep retry loop: a constant asyncio.sleep "
+                    "between attempts retries at full cadence forever — "
+                    "use exponential backoff with (decorrelated) jitter, "
+                    "or the peer circuit breaker (runtime/health.py)",
+                )
+
+
 @rule("ACT013", "swallowed-cancellation", "CancelledError caught without re-raise")
 def check_swallowed_cancel(ctx: FileContext):
     if ctx.tree is None:
