@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -90,6 +90,19 @@ overload-bench:
 overload-smoke:
 	$(PY) benchmarks/overload_bench.py --smoke
 
+# Durable node state (benchmarks/restart_bench.py, docs/robustness.md
+# "Durability & lifecycle"): a rolling restart run warm (persistence on,
+# graceful close, store-restored rejoin) vs cold (the reference's
+# amnesiac reboot) on real loopback fleets. GATES: warm re-replication
+# bytes <= 0.1x cold AND strictly faster reconvergence, plus graceful
+# leave detected by peers faster than the measured phi window. The
+# smoke (4 nodes, ~5 s) gates CI via `check`.
+restart-bench:
+	$(PY) benchmarks/restart_bench.py
+
+restart-smoke:
+	$(PY) benchmarks/restart_bench.py --smoke
+
 # Multihost smoke (benchmarks/multihost_bench.py): TWO real processes
 # join a localhost coordinator (4 virtual CPU devices each, gloo
 # collectives) and run the sharded lean profile — a measured rounds/s
@@ -101,12 +114,13 @@ multihost-smoke:
 # What CI runs; a red suite, dirty lint, new analysis finding, a failed
 # chaos soak, a sweep-amortization regression, a kernel-parity break,
 # a multihost parity/measurement failure, a red byzantine-atlas
-# baseline, a serve-tier encode-once/ratio regression, or an
+# baseline, a serve-tier encode-once/ratio regression, an
 # overload-degradation regression (availability ratio, breaker
-# opening, epoch monotonicity) cannot land through this gate. (kernel-parity re-runs one test file that
+# opening, epoch monotonicity), or a durability regression (warm rejoin
+# ratio/speed, leave-vs-phi detection) cannot land through this gate. (kernel-parity re-runs one test file that
 # test-all also covers — the explicit target keeps the merge gate for
 # kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke test-all
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

@@ -5,7 +5,7 @@ source of truth (SURVEY.md §7)."""
 
 from .cluster_state import ClusterState, Staleness, staleness_score
 from .config import (DEFAULT_MAX_PAYLOAD_SIZE, Config,
-                     FailureDetectorConfig)
+                     FailureDetectorConfig, PersistenceConfig)
 from .failure import BoundedWindow, FailureDetector, HeartbeatWindow
 from .identity import Address, NodeId
 from .kvstate import NodeState
@@ -15,6 +15,7 @@ from .messages import (
     Delta,
     Digest,
     KeyValueUpdate,
+    Leave,
     NodeDelta,
     NodeDigest,
     Packet,
@@ -38,11 +39,13 @@ __all__ = (
     "FailureDetectorConfig",
     "KeyStatus",
     "KeyValueUpdate",
+    "Leave",
     "NodeDelta",
     "NodeDigest",
     "NodeId",
     "NodeState",
     "Packet",
+    "PersistenceConfig",
     "Staleness",
     "Syn",
     "SynAck",

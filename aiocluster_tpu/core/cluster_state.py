@@ -97,6 +97,16 @@ class ClusterState:
             self.mark_dirty(node_id)
         return ns
 
+    def install_node_state(self, ns: NodeState) -> None:
+        """Install a fully-built NodeState — the persistence layer's
+        recovery path (runtime/persist.py): a restored keyspace (own or
+        peer hint) enters through the same hook-wiring and dirty-marking
+        as ``node_state_or_default``, so digest caching stays sound.
+        Replaces any existing state for the node."""
+        ns._on_change = lambda: self.mark_dirty(ns.node)
+        self._node_states[ns.node] = ns
+        self.mark_dirty(ns.node)
+
     def mark_dirty(self, node_id: NodeId) -> None:
         """Invalidate the cached digest entry for ``node_id``. Fired
         automatically by every NodeState mutator; call it manually after

@@ -41,6 +41,40 @@ class FailureDetectorConfig:
 
 
 @dataclass(frozen=True, slots=True, eq=True)
+class PersistenceConfig:
+    """Durable node state (runtime/persist.py, docs/robustness.md
+    "Durability & lifecycle"). ``path`` is this node's private store
+    directory (one node per directory). Every snapshot/marker file is
+    written tmp+fsync+``os.replace``; the intent log is CRC-framed and
+    torn tails truncate at the last valid frame. A corrupt snapshot is
+    refused loudly (counted fallback to the reference's amnesiac boot —
+    never a wrong recovery)."""
+
+    path: str
+    # Snapshot the keyspace every N initiated gossip rounds (the intent
+    # log covers writes between snapshots), or earlier once the log
+    # outgrows ``log_max_bytes``.
+    snapshot_interval_rounds: int = 64
+    log_max_bytes: int = 1 << 20
+    # Also persist the replicated peer view (peer NodeStates) so a warm
+    # rejoin advertises real digest floors and peers send deltas, not
+    # full keyspaces. Recovered peer entries are HINTS: they re-verify
+    # through normal digests and never bypass newer-generation-wins.
+    restore_peers: bool = True
+    # fsync the intent log on every appended write. Off by default: the
+    # log is flushed per write and fsync'd at every snapshot/close, and
+    # the CRC framing guarantees recovery is the pre- or post-write
+    # state either way; per-write fsync only narrows the window in
+    # which a power loss drops the tail writes. NOTE: the journal write
+    # runs inline on the event loop (the KV API is synchronous), so
+    # turning this on blocks the loop for one fsync per owner write —
+    # milliseconds to tens of milliseconds on loaded disks, enough to
+    # skew adaptive-timeout RTT samples and trip serve-tier loop-lag
+    # shedding under write bursts.
+    fsync_writes: bool = False
+
+
+@dataclass(frozen=True, slots=True, eq=True)
 class Config:
     """Runtime configuration for one cluster node."""
 
@@ -129,3 +163,12 @@ class Config:
     # zone_bias biases live-target selection toward the node's own
     # zone. None (or the all-defaults instance) changes nothing.
     heterogeneity: "Heterogeneity | None" = None
+    # New in aiocluster_tpu: durable node state (runtime/persist.py,
+    # docs/robustness.md). When set, the cluster journals its own
+    # keyspace to a crash-safe local store, restores it at boot (keeping
+    # its previous generation when the store proves a clean shutdown,
+    # else bumping it while still seeding version/GC watermarks for
+    # delta catch-up), and ``Cluster.leave()`` drains gracefully. None
+    # (the default) constructs none of it: every path is byte-identical
+    # to the reference's amnesiac restart semantics.
+    persistence: PersistenceConfig | None = None

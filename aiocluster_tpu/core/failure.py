@@ -177,6 +177,25 @@ class FailureDetector:
                 window.reset()
         return phi
 
+    def mark_dead(self, node_id: NodeId, ts: datetime | None = None) -> bool:
+        """Administratively move a peer to the dead set NOW — the
+        graceful-departure path (a ``Leave`` announcement is proof of
+        death no phi accrual needs to infer). Returns True when this
+        call actually transitioned the node (already-dead peers keep
+        their original time of death, so the two-stage GC clock is not
+        reset by duplicate announcements). The window resets like a
+        phi-detected death: a returning incarnation re-earns liveness
+        with fresh samples."""
+        now = ts if ts is not None else utc_now()
+        self._live.discard(node_id)
+        if node_id in self._dead:
+            return False
+        self._dead[node_id] = now
+        window = self._windows.get(node_id)
+        if window is not None:
+            window.reset()
+        return True
+
     # -- dead-node lifecycle --------------------------------------------------
 
     def scheduled_for_deletion_nodes(self, ts: datetime | None = None) -> list[NodeId]:

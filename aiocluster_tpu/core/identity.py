@@ -42,6 +42,22 @@ def next_generation_id() -> int:
         return generation
 
 
+def observe_generation(generation: int) -> None:
+    """Raise the strictly-increasing guard's floor to a generation issued
+    OUTSIDE this process — the durable half of the promise. The in-memory
+    ``_last_generation`` dies with the process, so a rebooted node under
+    a regressed wall clock could reissue a generation at or below its
+    previous incarnation's and lose newer-generation-wins; the
+    persistence layer (runtime/persist.py) records the last generation it
+    saw and replays it here at boot, making ``next_generation_id()``
+    return ``max(persisted + 1, time_ns)`` no matter what the clock says.
+    """
+    global _last_generation
+    with _generation_lock:
+        if generation > _last_generation:
+            _last_generation = generation
+
+
 @dataclass(frozen=True, slots=True, eq=True)
 class NodeId:
     """Unique identity of one cluster member."""

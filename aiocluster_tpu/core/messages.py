@@ -112,8 +112,31 @@ class BadCluster:
 
 
 @dataclass(slots=True)
+class Leave:
+    """Graceful-departure announcement (docs/robustness.md "Durability &
+    lifecycle"): the sender is shutting down ON PURPOSE. ``delta``
+    carries the sender's final flush of its OWN keyspace (guarded on
+    apply like any delta); receivers move the node to dead-with-reason
+    immediately instead of waiting out the phi window. ``heartbeat`` is
+    the sender's FINAL heartbeat (it stops responding before
+    announcing, so no higher value can ever exist for this
+    incarnation): receivers hold the death until they see evidence
+    ABOVE it — in-flight digests of older heartbeats can never
+    resurrect a drained node, while a genuine rejoin (which resumes
+    past the final value) lifts the hold immediately. Fire-and-forget:
+    no reply is expected. New beyond the reference schema (envelope
+    field 6) — reference peers skip unknown fields and at worst see a
+    message-less packet, which they drop like any malformed frame."""
+
+    node_id: NodeId
+    delta: Delta
+    reason: str = "leave"
+    heartbeat: int = 0
+
+
+@dataclass(slots=True)
 class Packet:
     """Top-level envelope: cluster id + exactly one handshake message."""
 
     cluster_id: str
-    msg: Syn | SynAck | Ack | BadCluster
+    msg: Syn | SynAck | Ack | BadCluster | Leave

@@ -167,19 +167,40 @@ class Partition:
         return min(g, self.n_groups - 1)
 
 
+RECOVERY_KINDS = ("amnesia", "warm")
+
+
 @dataclass(frozen=True, slots=True, eq=True)
 class NodeCrash:
     """Nodes in ``nodes`` crash at ``at`` and restart ``down_for``
-    later. In the runtime the ChaosHarness actually closes the cluster
-    and reboots it with a **bumped generation** (newer-generation-wins);
-    while down, peers' connects to it are refused. In the sim the node's
-    heartbeat and writes freeze and all its exchanges no-op for the
-    window — the restart keeps the node's identity (the sim's watermark
-    model has no generations; see docs/faults.md)."""
+    later. While down, peers' connects to it are refused (runtime) /
+    its exchanges no-op and its heartbeat and writes freeze (sim).
+    ``recovery`` names what the restart comes back WITH
+    (docs/robustness.md "Durability & lifecycle"):
+
+    - ``"amnesia"`` (the default — the reference's restart semantics):
+      the node reboots with an empty keyspace. The ChaosHarness boots a
+      fresh Cluster with a **bumped generation** (newer-generation-wins
+      exercised for real); the sim resets the node's knowledge row at
+      the restart tick, so it re-replicates the whole cluster from
+      zero — the full-state anti-entropy cost a rolling restart pays.
+      (The sim's watermark model has no generations: owner ground truth
+      persists and only the replica knowledge resets; the runtime's
+      generation bump additionally re-replicates the node's OWN state,
+      which the sim does not model.)
+    - ``"warm"``: the node reboots with its durable store
+      (``Config.persistence`` — the ChaosHarness requires a
+      ``persist_root``). The crash itself is an ``abort()`` (no clean
+      marker), so the generation still bumps, but the restored
+      version/GC watermarks turn rejoin into delta catch-up. In the sim
+      the crash window freezes and nothing resets — the watermarks ARE
+      the persisted knowledge.
+    """
 
     nodes: NodeSet = ALL_NODES
     at: float = 0.0
     down_for: float = 1.0
+    recovery: str = "amnesia"
 
     def down(self, t: float) -> bool:
         return self.at <= t < self.at + self.down_for
@@ -269,6 +290,11 @@ class FaultPlan:
         for cr in self.crashes:
             if cr.down_for <= 0:
                 raise ValueError("NodeCrash.down_for must be > 0")
+            if cr.recovery not in RECOVERY_KINDS:
+                raise ValueError(
+                    f"unknown NodeCrash.recovery {cr.recovery!r} "
+                    f"(one of {RECOVERY_KINDS})"
+                )
         for bf in self.byzantine:
             if bf.kind not in BYZANTINE_KINDS:
                 raise ValueError(

@@ -42,12 +42,20 @@ class ChaosHarness:
         cluster_id: str = "chaos",
         gossip_interval: float = 0.05,
         config_overrides: dict | None = None,
+        persist_root: str | None = None,
     ) -> None:
         self.n_nodes = n_nodes
         self.names = [f"n{i:02d}" for i in range(n_nodes)]
         self._cluster_id = cluster_id
         self._interval = gossip_interval
         self._overrides = config_overrides or {}
+        # Durable-store root (docs/robustness.md): when set, every node
+        # gets ``Config.persistence`` pointing at its own subdirectory,
+        # and crash windows with ``recovery="warm"`` reboot FROM the
+        # store (the crash is an ``abort()`` — no clean marker, so the
+        # generation still bumps while the keyspace survives). Without
+        # it, warm-recovery plans are refused at start.
+        self._persist_root = persist_root
         self.clusters: dict[str, Cluster] = {}
         self.registries: dict[str, MetricsRegistry] = {}
         # Ports are allocated up front so plans can address nodes by
@@ -60,9 +68,22 @@ class ChaosHarness:
         # building explicit groups over the fleet's real labels:
         #   ChaosHarness(6, lambda h: split_brain(2, groups=h.name_groups(2)))
         self.plan: FaultPlan | None = plan(self) if callable(plan) else plan
+        if (
+            self.plan is not None
+            and self._persist_root is None
+            and any(cr.recovery == "warm" for cr in self.plan.crashes)
+        ):
+            raise ValueError(
+                "recovery='warm' crash windows need a persist_root (the "
+                "reboot restores the durable store; without one there is "
+                "nothing to restore)"
+            )
         self._epoch: float | None = None
         self._crash_task: asyncio.Task | None = None
         self._crashed: set[str] = set()
+        # name -> the recovery mode of the crash window that took the
+        # node down (drives how the restart reboots it).
+        self._crash_recovery: dict[str, str] = {}
         self.generations: dict[str, list[int]] = {}
 
     def addr_label(self, name: str) -> str:
@@ -113,7 +134,27 @@ class ChaosHarness:
             for s in socks:
                 s.close()
 
-    def _make_cluster(self, name: str, generation: int | None = None) -> Cluster:
+    def _wipe_store(self, name: str) -> None:
+        """An amnesiac reboot is a reimaged machine: the node's store
+        directory is deleted, so a LATER warm restart cannot resurrect
+        the pre-amnesia keyspace (stale keys re-advertising as current
+        — and diverging from the sim, whose warm recovery keeps CURRENT
+        watermarks)."""
+        if self._persist_root is None:
+            return
+        import os
+        import shutil
+
+        shutil.rmtree(
+            os.path.join(self._persist_root, name), ignore_errors=True
+        )
+
+    def _make_cluster(
+        self,
+        name: str,
+        generation: int | None = None,
+        persisted: bool | None = None,
+    ) -> Cluster:
         port = self._ports[name]
         seeds = [
             ("127.0.0.1", p) for n, p in self._ports.items() if n != name
@@ -127,12 +168,22 @@ class ChaosHarness:
                 gossip_advertise_addr=("127.0.0.1", port),
             )
         )
+        persistence = None
+        if self._persist_root is not None and persisted is not False:
+            import os
+
+            from ..core.config import PersistenceConfig
+
+            persistence = PersistenceConfig(
+                path=os.path.join(self._persist_root, name)
+            )
         config = Config(
             node_id=node_id,
             cluster_id=self._cluster_id,
             gossip_interval=self._interval,
             seed_nodes=seeds,
             fault_plan=self.plan,
+            persistence=persistence,
             **self._overrides,
         )
         registry = self.registries.setdefault(name, MetricsRegistry())
@@ -159,7 +210,12 @@ class ChaosHarness:
             transport._resolve = lambda host, port: (
                 addr_names.get((host, port)) or fallback(host, port)
             )
-        self.generations.setdefault(name, []).append(node_id.generation_id)
+        # Read the generation off the CLUSTER: the persistence layer may
+        # have rewritten it (clean store keeps the previous one, unclean
+        # bumps above the store's floor).
+        self.generations.setdefault(name, []).append(
+            cluster.self_node_id.generation_id
+        )
         return cluster
 
     async def start(self) -> None:
@@ -206,11 +262,20 @@ class ChaosHarness:
 
     # -- crash/restart driver -------------------------------------------------
 
-    def _down_now(self, name: str, t: float) -> bool:
-        return any(
-            cr.down(t) and cr.nodes.matches_name(name)
+    def _down_now(self, name: str, t: float) -> str | None:
+        """The recovery mode of a crash window covering ``name`` at plan
+        time ``t``, or None when the node should be up. A node matched
+        by several simultaneous windows crashes once; "warm" wins only
+        if every covering window is warm (one amnesiac crash wipes the
+        disk story regardless of the others)."""
+        modes = [
+            cr.recovery
             for cr in self.plan.crashes
-        )
+            if cr.down(t) and cr.nodes.matches_name(name)
+        ]
+        if not modes:
+            return None
+        return "amnesia" if "amnesia" in modes else "warm"
 
     async def _drive_crashes(self) -> None:
         """Close clusters whose crash window opened; reboot them (bumped
@@ -229,12 +294,36 @@ class ChaosHarness:
             for name in self.names:
                 down = self._down_now(name, t)
                 try:
-                    if down and name not in self._crashed:
-                        await self.clusters[name].close()
+                    if down is not None and name not in self._crashed:
+                        # A crash is a crash: abort() skips the graceful
+                        # persistence flush (no clean marker), so a warm
+                        # reboot recovers from the journaled store, not
+                        # from a tidy shutdown that never happened.
+                        await self.clusters[name].abort()
                         self._crashed.add(name)
-                    elif not down and name in self._crashed:
-                        cluster = self._make_cluster(
-                            name, generation=next_generation_id()
+                        self._crash_recovery[name] = down
+                    elif down is None and name in self._crashed:
+                        warm = (
+                            self._crash_recovery.get(name) == "warm"
+                            and self._persist_root is not None
+                        )
+                        # Warm: the store decides the generation (unclean
+                        # ⇒ bumped above its durable floor) and restores
+                        # the keyspace. Amnesia: the reference reboot — a
+                        # fresh cluster, explicitly bumped generation,
+                        # and the on-disk store WIPED (a reimaged
+                        # machine; a later warm window must not
+                        # resurrect pre-amnesia state).
+                        if not warm:
+                            self._wipe_store(name)
+                        cluster = (
+                            self._make_cluster(name, generation=None)
+                            if warm
+                            else self._make_cluster(
+                                name,
+                                generation=next_generation_id(),
+                                persisted=False,
+                            )
                         )
                         # Rejoin the fleet's ORIGINAL epoch before any
                         # boot traffic runs — the restarted node must
@@ -257,6 +346,40 @@ class ChaosHarness:
                         f"(retrying next poll): {exc!r}"
                     )
             await asyncio.sleep(_CRASH_POLL_S)
+
+    async def restart_node(
+        self, name: str, recovery: str = "amnesia", *, graceful: bool = False
+    ) -> None:
+        """Take one node down and immediately reboot it — the
+        rolling-restart building block ``benchmarks/restart_bench.py``
+        drives directly (no plan windows to wait out). ``graceful=True``
+        closes cleanly (with a store: clean marker ⇒ the reboot keeps
+        its generation — the deploy path); False aborts (a crash: the
+        generation bumps either way). ``recovery="warm"`` reboots from
+        the durable store (requires ``persist_root``), ``"amnesia"``
+        reboots empty with an explicitly bumped generation, the
+        reference semantics."""
+        if recovery == "warm" and self._persist_root is None:
+            raise ValueError("recovery='warm' needs a persist_root")
+        cluster = self.clusters[name]
+        if graceful:
+            await cluster.close()
+        else:
+            await cluster.abort()
+        if recovery != "warm":
+            self._wipe_store(name)  # amnesia = reimaged machine
+        new = (
+            self._make_cluster(name, generation=None)
+            if recovery == "warm"
+            else self._make_cluster(
+                name, generation=next_generation_id(), persisted=False
+            )
+        )
+        ctl = new.fault_controller
+        if ctl is not None and self._epoch is not None:
+            ctl.start(self._epoch)
+        await new.start()
+        self.clusters[name] = new
 
     # -- observation ----------------------------------------------------------
 

@@ -79,17 +79,23 @@ def rolling_restart(
     wave_every: float = 2.0,
     down_for: float = 1.0,
     seed: int = 0,
+    recovery: str = "amnesia",
 ) -> FaultPlan:
     """Restart the cluster one index-fraction wave at a time: wave ``k``
     (nodes in [k/n_waves, (k+1)/n_waves)) goes down at
-    ``start + k * wave_every`` for ``down_for``. Runtime restarts bump
-    the generation (newer-generation-wins exercised); the sim freezes the
-    wave's heartbeats/writes for the window."""
+    ``start + k * wave_every`` for ``down_for``. ``recovery`` picks the
+    rejoin semantics (NodeCrash docstring): ``"amnesia"`` reboots empty
+    with a bumped generation (the reference's restart — the sim resets
+    the wave's knowledge rows at restart), ``"warm"`` reboots from the
+    durable store (``Config.persistence``) and catches up by delta —
+    ``benchmarks/restart_bench.py`` runs this plan both ways and gates
+    the ratio."""
     crashes = tuple(
         NodeCrash(
             nodes=NodeSet(frac=(k / n_waves, (k + 1) / n_waves)),
             at=start + k * wave_every,
             down_for=down_for,
+            recovery=recovery,
         )
         for k in range(n_waves)
     )

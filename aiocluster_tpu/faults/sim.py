@@ -184,6 +184,45 @@ def plan_affects_nodes(plan: FaultPlan | None) -> bool:
     return plan is not None and bool(plan.crashes)
 
 
+def plan_amnesia_restarts(plan: FaultPlan | None) -> bool:
+    """Whether any crash window restarts with ``recovery="amnesia"`` —
+    the static predicate gating the knowledge-row reset in ``sim_step``
+    (plans without one keep the exact pre-existing trace)."""
+    return plan is not None and any(
+        cr.recovery == "amnesia" for cr in plan.crashes
+    )
+
+
+def amnesia_restart_mask(plan: FaultPlan, n: int, tick: jax.Array) -> jax.Array:
+    """(N,) bool: nodes whose ``recovery="amnesia"`` crash window ended
+    EXACTLY at this tick — the restart instant. ``sim_step`` resets
+    their knowledge rows (w, hb_known, FD bookkeeping) to the
+    fresh-boot state: an amnesiac reboot re-replicates the whole
+    cluster from zero, which is precisely the recovery cost the
+    restart benchmark maps against ``recovery="warm"`` (where the
+    persisted watermarks survive and nothing resets). Owner ground
+    truth (``max_version``) persists — the sim has no generations; see
+    NodeCrash's docstring for what that abstracts away. Pure function
+    of (plan, tick, global index): shard-exact, PRNG-independent."""
+    i = jnp.arange(n, dtype=jnp.int32)
+    t = tick.astype(jnp.float32)
+    reset = jnp.zeros((n,), bool)
+    for cr in plan.crashes:
+        if cr.recovery != "amnesia":
+            continue
+        end = cr.at + cr.down_for
+        # Integer ticks: the restart tick is the first with t >= end.
+        just_restarted = (t >= end) & (t - 1 < end)
+        members = _member_mask(cr.nodes, i, n)
+        hit = (
+            just_restarted
+            if members is None
+            else just_restarted & members
+        )
+        reset = reset | hit
+    return reset
+
+
 # -- breaker-quarantine lowering (docs/robustness.md) -------------------------
 #
 # The runtime's per-peer circuit breaker (runtime/health.py) quarantines

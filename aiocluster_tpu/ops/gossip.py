@@ -981,9 +981,56 @@ def sim_step(
 
     eff_alive = alive
     if plan_affects_nodes(plan):
-        from ..faults.sim import crash_mask
+        from ..faults.sim import (
+            amnesia_restart_mask,
+            crash_mask,
+            plan_amnesia_restarts,
+        )
 
         eff_alive = alive & ~crash_mask(plan, n, tick)
+        if plan_amnesia_restarts(plan):
+            # Amnesiac restart (docs/robustness.md): at the tick a
+            # recovery="amnesia" crash window ends, the node reboots
+            # EMPTY — its knowledge rows reset to the fresh-boot state
+            # and the whole cluster re-replicates into it (the cost
+            # recovery="warm" exists to avoid; restart_bench maps the
+            # ratio). Owner ground truth (max_version/heartbeat)
+            # persists: the sim has no generations, so only the replica
+            # knowledge resets. Static predicate: plans without amnesia
+            # restarts trace the exact pre-existing step. Config
+            # validation excludes the packed rungs (u4r w / live_bits),
+            # whose reset has no byte-space form.
+            reset = amnesia_restart_mask(plan, n, tick)
+            reset_col = reset[:, None]
+            zeros_w = jnp.zeros((), state.w.dtype)
+            new_w = jnp.where(reset_col, zeros_w, state.w)
+            new_hb = state.hb_known
+            if cfg.track_heartbeats:
+                new_hb = jnp.where(
+                    reset_col, jnp.zeros((), state.hb_known.dtype), state.hb_known
+                )
+            updates = {"w": new_w, "hb_known": new_hb}
+            if cfg.track_failure_detector:
+                self_col = owners[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+                updates["last_change"] = jnp.where(
+                    reset_col, jnp.zeros((), state.last_change.dtype),
+                    state.last_change,
+                )
+                updates["imean"] = jnp.where(
+                    reset_col, jnp.zeros((), state.imean.dtype), state.imean
+                )
+                updates["icount"] = jnp.where(
+                    reset_col, jnp.zeros((), state.icount.dtype), state.icount
+                )
+                updates["live_view"] = jnp.where(
+                    reset_col, self_col, state.live_view
+                )
+                if state.dead_since.size:
+                    updates["dead_since"] = jnp.where(
+                        reset_col, jnp.zeros((), state.dead_since.dtype),
+                        state.dead_since,
+                    )
+            state = state.replace(**updates)
     faulty_links = plan_affects_links(plan)
     byz_active = plan_affects_byzantine(plan)
     sw_byz = None if sweep is None else sweep.byz_frac

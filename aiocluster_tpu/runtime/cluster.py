@@ -23,7 +23,17 @@ from ..core.config import Config
 from ..core.failure import FailureDetector
 from ..core.identity import Address, NodeId
 from ..core.kvstate import NodeState
-from ..core.messages import Ack, BadCluster, Packet, Syn, SynAck
+from ..core.messages import (
+    Ack,
+    BadCluster,
+    Delta,
+    Digest,
+    Leave,
+    NodeDigest,
+    Packet,
+    Syn,
+    SynAck,
+)
 from ..core.values import VersionedValue
 from ..obs.registry import MetricsRegistry, default_registry
 from ..obs.trace import TraceWriter
@@ -36,6 +46,17 @@ from .peers import select_gossip_targets
 from .pool import ConnectionPool, PooledConnection
 from .ticker import Ticker
 from .transport import GossipTransport
+
+# Bound on how far a Leave announcement's claimed FINAL heartbeat may
+# exceed our own knowledge of the leaver when recording the departed
+# hold. An honest final value leads any peer's view by at most the
+# in-flight window (a handful of rounds); an attacker's inflated claim
+# (heartbeat=2**60 would otherwise make the hold unliftable and
+# quarantine a LIVE victim until dead-node GC — the one field
+# handle_leave's delta guards don't cover) is capped so the victim's
+# real heartbeats walk past the hold and phi restores it within a
+# bounded window.
+LEAVE_HB_SLACK = 1000
 
 # Failure modes meaning "the peer ended the connection" — on a REUSED
 # pooled connection these are expected (close-per-handshake peers, idle
@@ -84,9 +105,7 @@ class Cluster:
         metrics: MetricsRegistry | None = None,
         trace: TraceWriter | None = None,
     ) -> None:
-        self._config = config
         self._rng = rng if rng is not None else Random()
-        self._log = node_logger(config.node_id.long_name())
 
         # Telemetry (obs/): every subsystem reports through one registry —
         # the process default unless the caller injects its own (tests and
@@ -95,6 +114,57 @@ class Cluster:
         # and per membership transition.
         self._metrics = metrics if metrics is not None else default_registry()
         self._trace = trace
+        self._lifecycle_events = self._metrics.counter(
+            "aiocluster_lifecycle_events_total",
+            "Node lifecycle events: rejoin_clean (warm rejoin, previous "
+            "generation kept), rejoin_unclean (keyspace restored, "
+            "generation bumped), leave_initiated, leave_announced (one "
+            "per peer successfully notified), leave_received (a peer's "
+            "departure announcement applied)",
+            labels=("event",),
+        )
+
+        # Durable node state (docs/robustness.md "Durability &
+        # lifecycle"): recovery runs BEFORE anything reads
+        # config.node_id. A store proving a clean shutdown lets this
+        # boot resume the previous incarnation (same generation — its
+        # keyspace was fully flushed, so its version counter is safe to
+        # continue); an unclean store bumps the generation, seeded by
+        # the store's durable guard so even a regressed wall clock
+        # cannot reissue an old one. ``Config.persistence=None`` builds
+        # none of this — the reference's amnesiac boot, byte-identical.
+        self._persist = None
+        self._recovered = None
+        self._persist_clean_on_close = True
+        self._snapshotting = False
+        if config.persistence is not None:
+            from dataclasses import replace as _dc_replace
+
+            from ..core.identity import next_generation_id
+            from .persist import NodeStore
+
+            self._persist = NodeStore(
+                config.persistence, metrics=self._metrics
+            )
+            self._recovered = self._persist.load()
+            if self._recovered is not None:
+                if self._recovered.clean:
+                    generation = self._recovered.generation
+                    self._lifecycle_events.labels("rejoin_clean").inc()
+                else:
+                    # load() already seeded the guard with the store's
+                    # floor, so this is strictly above every generation
+                    # the store ever recorded.
+                    generation = next_generation_id()
+                    self._lifecycle_events.labels("rejoin_unclean").inc()
+                config = _dc_replace(
+                    config,
+                    node_id=_dc_replace(
+                        config.node_id, generation_id=generation
+                    ),
+                )
+        self._config = config
+        self._log = node_logger(config.node_id.long_name())
         self._round_seconds = self._metrics.histogram(
             "aiocluster_round_seconds",
             "Wall-clock duration of one initiated gossip round",
@@ -265,6 +335,16 @@ class Cluster:
         self._on_node_leave: list[NodeEventCallback] = []
         self._on_key_change: list[KeyChangeCallback] = []
         self._prev_live: set[NodeId] = set()
+        # Peers that announced a graceful departure (Leave), with the
+        # reason and the heartbeat we held for them at that moment:
+        # _update_liveness keeps them dead (no phi re-evaluation) until
+        # fresh heartbeat EVIDENCE proves a comeback — phi alone would
+        # resurrect them for the rest of the sampling window.
+        self._departed: dict[NodeId, tuple[str, int]] = {}
+        # Epidemic relays of departure announcements (one per FIRST
+        # receipt): retained so the tasks are not GC'd mid-flight and
+        # can be cancelled at close.
+        self._leave_forwards: set[asyncio.Task] = set()
 
         self._server: asyncio.Server | None = None
         self._inbound: set[StreamWriter] = set()
@@ -272,11 +352,43 @@ class Cluster:
         self._started = False
         self._closing = False
 
-        # Seed our own state: one heartbeat + initial keys.
+        # Seed our own state: the recovered keyspace (when a store was
+        # restored), one heartbeat, then initial keys (idempotent — a
+        # recovered live value is not re-written).
+        if self._recovered is not None:
+            self._install_recovered_state()
         me = self.self_node_state()
         me.inc_heartbeat()
         for key, value in (initial_key_values or {}).items():
             me.set(key, value)
+
+    def _install_recovered_state(self) -> None:
+        """Wire the recovered store into the fresh ClusterState: our own
+        keyspace at its persisted versions (and, on a clean rejoin, the
+        previous incarnation's heartbeat, so peers — who only credit
+        increases — see the same counter resume), plus the persisted
+        peer view as HINTS (they re-verify via normal digests; a peer
+        restarted with a newer generation is a different NodeId and
+        wins exactly as before)."""
+        rec = self._recovered
+        own = NodeState(
+            self._config.node_id,
+            heartbeat=rec.heartbeat if rec.clean else 0,
+            key_values=dict(rec.key_values),
+            max_version=rec.max_version,
+            last_gc_version=rec.last_gc_version,
+        )
+        self._cluster_state.install_node_state(own)
+        if self._config.persistence.restore_peers:
+            for peer in rec.peers:
+                if peer.node == self._config.node_id:
+                    continue
+                # An unclean reboot bumped our generation: our own OLD
+                # incarnation must not be reinstalled as a "peer" — its
+                # state would shadow-advertise until the FD aged it out.
+                if peer.node.name == self._config.node_id.name:
+                    continue
+                self._cluster_state.install_node_state(peer)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -316,14 +428,81 @@ class Cluster:
             self._codec_warmup = asyncio.create_task(
                 asyncio.to_thread(wire_native.warmup)
             )
+        if self._persist is not None and self._recovered is None:
+            # A store with intent-log records but no snapshot cannot be
+            # recovered (no generation to anchor them to) — seed the
+            # snapshot at first boot so every journaled write is
+            # anchored from the start.
+            await self._write_persist_snapshot()
         self._hooks.start()
         self._ticker.start()
+
+    async def _write_persist_snapshot(self) -> None:
+        """One atomic store snapshot off-loop. Copies are taken
+        synchronously (no await between copy and dispatch), so the
+        written snapshot is a consistent point-in-time view even while
+        gossip keeps mutating the live state."""
+        if self._persist is None or self._snapshotting:
+            return
+        self._snapshotting = True
+        try:
+            own = self.self_node_state().copy()
+            peers = None
+            if self._config.persistence.restore_peers:
+                peers = [
+                    ns.copy()
+                    for nid, ns in self._cluster_state.node_states().items()
+                    if nid != self.self_node_id
+                ]
+            # Rotate the intent log SYNCHRONOUSLY with the copies:
+            # writes journaled after this instant postdate the copied
+            # state and must survive the snapshot (runtime/persist.py
+            # begin_snapshot). The sequence makes overlapping writer
+            # threads (a shutdown-orphaned one racing close()'s final
+            # snapshot) last-copy-wins, never last-thread-wins.
+            seq = self._persist.begin_snapshot()
+            await asyncio.to_thread(
+                self._persist.write_snapshot,
+                own,
+                self.self_node_id.generation_id,
+                peers,
+                seq,
+            )
+        except Exception as exc:
+            # A failed snapshot must never take the node down — the
+            # store just stays one interval staler.
+            self._log.warning(f"persist snapshot failed: {exc!r}")
+        finally:
+            self._snapshotting = False
 
     async def close(self) -> None:
         if self._closing or not self._started:
             return
         self._closing = True
         await self._ticker.stop()
+        # Stop responding BEFORE the persistence flush: an inbound
+        # handshake still being served would bump our heartbeat after
+        # the clean marker sampled its "final" value — and advertise
+        # the higher one to peers, who only credit INCREASES, leaving
+        # the clean rejoin below its own floor for several rounds.
+        await self._stop_server()
+        if self._persist is not None:
+            if self._persist_clean_on_close:
+                # Graceful close: flush the final snapshot, then — and
+                # only then — the clean marker. The marker is the proof
+                # the next boot needs to keep this generation; a crash
+                # between the two reads as unclean, which is correct
+                # (the snapshot may predate the crash's last writes).
+                await self._write_persist_snapshot()
+                try:
+                    await asyncio.to_thread(
+                        self._persist.write_clean_marker,
+                        self.self_node_id.generation_id,
+                        self.self_node_state().heartbeat,
+                    )
+                except Exception as exc:
+                    self._log.warning(f"clean marker write failed: {exc!r}")
+            self._persist.close()
         if self._codec_warmup is not None:
             # Don't wait for a cold-cache native build (g++, up to 120s)
             # whose result nobody needs anymore — cancel and move on; the
@@ -343,23 +522,162 @@ class Cluster:
         # Ticker is stopped, so no new borrows: close the idle pool
         # before the server so peers see orderly FINs, not RSTs.
         await self._pool.close()
-        if self._server is not None:
-            self._server.close()
-            # Persistent inbound channels may be parked waiting for their
-            # next Syn; close them so the handler tasks finish now rather
-            # than lingering for the idle window (on 3.12+ wait_closed
-            # would block on them). Each handler's finally joins its own
-            # writer; the join here covers a handler that already left.
-            for writer in list(self._inbound):
-                writer.close()
-                with suppress(Exception):
-                    await writer.wait_closed()
-            await self._server.wait_closed()
-            self._server = None
+        for task in list(self._leave_forwards):
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued; terminal join at close
+                pass
+            except Exception:
+                pass  # a failed relay is already just best-effort
+        await self._stop_server()
         await self._hooks.stop()
+
+    async def _stop_server(self) -> None:
+        """Stop accepting and serving handshakes (idempotent). Split out
+        of close() because ``leave()`` must stop responding BEFORE it
+        announces: the announced final heartbeat is only final if no
+        later inbound handshake can bump the counter."""
+        if self._server is None:
+            return
+        self._server.close()
+        # Persistent inbound channels may be parked waiting for their
+        # next Syn; close them so the handler tasks finish now rather
+        # than lingering for the idle window (on 3.12+ wait_closed
+        # would block on them). Each handler's finally joins its own
+        # writer; the join here covers a handler that already left.
+        for writer in list(self._inbound):
+            writer.close()
+            with suppress(Exception):
+                await writer.wait_closed()
+        await self._server.wait_closed()
+        self._server = None
 
     async def shutdown(self) -> None:
         await self.close()
+
+    async def abort(self) -> None:
+        """Close WITHOUT the graceful-shutdown persistence flush: no
+        final snapshot, no clean marker — the process-kill path the
+        chaos harness uses to model a real crash. With persistence off
+        this is exactly ``close()``."""
+        self._persist_clean_on_close = False
+        await self.close()
+
+    async def leave(self, reason: str = "leave") -> None:
+        """Graceful leave/drain (docs/robustness.md): stop initiating
+        gossip, flush the intent log into a final snapshot, best-effort
+        push a final delta of our own keyspace plus a departure
+        announcement to up to ``gossip_count`` live peers (they move us
+        to dead-with-reason immediately — no phi window to wait out),
+        write the clean marker, then close. Every step is best-effort:
+        a dead peer cannot block a drain."""
+        if self._closing or not self._started:
+            await self.close()
+            return
+        self._lifecycle_events.labels("leave_initiated").inc()
+        # 1. Stop initiating AND responding (close() repeats both
+        #    harmlessly). Stopping the responder freezes our heartbeat —
+        #    the announcement below carries the FINAL value, so no
+        #    in-flight digest can ever look like fresher evidence and
+        #    resurrect us in a peer's view.
+        await self._ticker.stop()
+        await self._stop_server()
+        # 2. The final delta: our own keyspace, packed under the MTU.
+        #    Built by the normal packer against a digest that claims the
+        #    peer knows everything EXCEPT us — so only our node delta is
+        #    emitted, MTU-bounded, in version order.
+        digest = Digest(
+            {
+                nid: ns.digest()
+                for nid, ns in self._cluster_state.node_states().items()
+            }
+        )
+        digest.node_digests[self.self_node_id] = NodeDigest(
+            self.self_node_id, 0, 0, 0
+        )
+        delta = self._cluster_state.compute_partial_delta_respecting_mtu(
+            digest, self._config.max_payload_size, set()
+        )
+        packet = Packet(
+            self._config.cluster_id,
+            Leave(
+                self.self_node_id,
+                delta,
+                reason,
+                heartbeat=self.self_node_state().heartbeat,
+            ),
+        )
+        # 3. Announce to live peers (fanout = gossip_count), fresh
+        #    connections so a stale pooled channel cannot eat the only
+        #    announcement a peer would have received. Liveness is an
+        #    ORDERING, not a filter: a node draining before its phi
+        #    detector warmed up (liveness needs interval samples;
+        #    replication does not) has an empty live set but perfectly
+        #    reachable known peers — announcing to nobody would leave
+        #    the whole fleet to the phi window.
+        live = [
+            n.gossip_advertise_addr
+            for n in self._failure_detector.live_nodes()
+        ]
+        self._rng.shuffle(live)
+        seen = set(live)
+        known = [
+            n.gossip_advertise_addr
+            for n in self._cluster_state.nodes()
+            if n != self.self_node_id
+            and n.gossip_advertise_addr not in seen
+        ]
+        self._rng.shuffle(known)
+        targets = live + known
+        announced = await self._announce_packet(
+            packet, targets[: max(1, self._config.gossip_count)]
+        )
+        self._lifecycle_events.labels("leave_announced").inc(announced)
+        # 4. Graceful close: final snapshot + clean marker (persistence
+        #    on), orderly teardown either way.
+        await self.close()
+
+    async def _announce_packet(
+        self, packet: Packet, targets: list[Address]
+    ) -> int:
+        """Best-effort one-shot delivery of ``packet`` to each target —
+        CONCURRENTLY, so one dead peer costs its own connect timeout,
+        not a serial stall for everyone behind it (a rolling deploy has
+        several nodes down at once; detection latency is the whole
+        point of the announcement). Returns how many deliveries
+        succeeded. Fresh connections: a stale pooled channel must not
+        eat the only announcement a peer would have received."""
+        tls_names = {
+            n.gossip_advertise_addr: n.tls_name
+            for n in self._cluster_state.nodes()
+        }
+
+        async def one(host: str, port: int) -> bool:
+            writer = None
+            try:
+                _reader, writer = await self._transport.connect(
+                    host, port, tls_names.get((host, port))
+                )
+                await self._transport.write_packet(writer, packet)
+                return True
+            except Exception as exc:
+                self._log.debug(
+                    f"announcement to {host}:{port} failed: {exc}"
+                )
+                return False
+            finally:
+                if writer is not None:
+                    writer.close()
+                    with suppress(Exception):
+                        await writer.wait_closed()
+
+        if not targets:
+            return 0
+        results = await asyncio.gather(
+            *(one(host, port) for host, port in targets)
+        )
+        return sum(results)
 
     # -- observable surface ---------------------------------------------------
 
@@ -432,6 +750,13 @@ class Cluster:
             "dead": len(self._failure_detector.dead_nodes()),
             "epoch": self._cluster_state.digest_epoch,
             "max_phi": round(max(phis), 3) if phis else None,
+            # Peers dead on their own announcement (graceful Leave),
+            # with the announced reason — dead-with-reason, not
+            # phi-inferred (docs/robustness.md).
+            "departed": sorted(
+                f"{nid.name}:{reason}"
+                for nid, (reason, _hb) in self._departed.items()
+            ),
         }
         if self._health is not None:
             summary.update(self._health.summary())
@@ -509,6 +834,13 @@ class Cluster:
             or old_vv.status != new_vv.status
             or old_vv.value != new_vv.value
         ):
+            if self._persist is not None:
+                # Intent log: every effective owner write (sets,
+                # tombstones, TTL marks — all versioned) journals before
+                # the hooks see it, so a crash between snapshots loses
+                # at most an unflushed OS buffer, never an acknowledged
+                # frame (runtime/persist.py).
+                self._persist.record_write(key, new_vv)
             self._emit_key_change(self.self_node_id, key, old_vv, new_vv)
 
     # -- owner KV API ---------------------------------------------------------
@@ -613,6 +945,8 @@ class Cluster:
             timedelta(seconds=self._config.marked_for_deletion_grace_period)
         )
         await self._pool.evict_idle()
+        if self._persist is not None and self._persist.snapshot_due():
+            await self._write_persist_snapshot()
 
         # gather, not TaskGroup (3.11+): _gossip_with contains its own
         # failures, so plain fan-out-and-wait has identical semantics.
@@ -811,6 +1145,13 @@ class Cluster:
                     raise
                 # Inbound traffic counts as activity for our own heartbeat.
                 self.self_node_state().inc_heartbeat()
+                if isinstance(packet.msg, Leave):
+                    # Graceful departure: apply the final flush, move
+                    # the node to dead-with-reason NOW (docs/
+                    # robustness.md). Fire-and-forget — no reply.
+                    if packet.cluster_id == self._config.cluster_id:
+                        self._handle_leave_announcement(packet)
+                    return
                 if not isinstance(packet.msg, Syn):
                     self._log.debug("Unexpected first gossip message type")
                     return
@@ -840,6 +1181,91 @@ class Cluster:
             with suppress(Exception):
                 await writer.wait_closed()
 
+    def _handle_leave_announcement(self, packet: Packet) -> None:
+        """A peer told us it is draining: apply its final delta
+        (guarded), mark it dead immediately with the announced reason —
+        the phi window exists to infer deaths nobody announced — and
+        emit the leave hook now instead of a round later."""
+        msg = packet.msg
+        node_id = msg.node_id
+        if node_id == self.self_node_id or not node_id.name:
+            return
+        self._engine.handle_leave(packet)
+        self._lifecycle_events.labels("leave_received").inc()
+        # Hold threshold: the leaver's announced FINAL heartbeat (it
+        # stopped responding before announcing, so nothing higher can
+        # exist for this incarnation) — or whatever we hold if the
+        # announcement predates our knowledge somehow. The claim is
+        # CAPPED relative to our own knowledge (LEAVE_HB_SLACK): the
+        # one Leave field the delta guards don't cover must not let a
+        # forged announcement quarantine a live victim forever.
+        known = 0
+        ns = self._cluster_state.node_state(node_id)
+        if ns is not None:
+            known = ns.heartbeat
+        hb = max(known, min(msg.heartbeat, known + LEAVE_HB_SLACK))
+        first_receipt = node_id not in self._departed
+        self._departed.setdefault(node_id, (msg.reason, hb))
+        if first_receipt and not self._closing:
+            # Epidemic relay: the leaver only announced to ``fanout``
+            # peers; the FIRST receipt re-announces (sans delta — the
+            # flush rode the original hop) to every live peer, so one
+            # informed node guarantees fleet coverage in one more hop.
+            # Dedup by the departed map: each node forwards ONCE, so a
+            # departure costs O(N) messages per informed node exactly
+            # once — a fanout-bounded relay would be cheaper but a
+            # once-per-node flood can die before full coverage (no
+            # retransmission rounds), leaving stragglers to the phi
+            # window the announcement exists to beat. Departures are
+            # rare lifecycle events; at fleet sizes where O(N²) tiny
+            # packets bite, periodic re-announcement belongs in the
+            # digest instead.
+            fwd = Packet(
+                self._config.cluster_id,
+                Leave(node_id, Delta(), msg.reason, heartbeat=msg.heartbeat),
+            )
+            task = asyncio.create_task(self._forward_leave(fwd))
+            self._leave_forwards.add(task)
+            task.add_done_callback(self._leave_forwards.discard)
+        if self._failure_detector.mark_dead(node_id):
+            self._fd_transitions.labels("dead").inc()
+            if self._trace is not None:
+                self._trace.emit(
+                    "node_transition",
+                    node=self._config.node_id.name,
+                    peer=node_id.name,
+                    to="dead",
+                    reason=msg.reason,
+                )
+            if node_id in self._prev_live:
+                self._prev_live.discard(node_id)
+                self._hooks.emit(tuple(self._on_node_leave), (node_id,))
+            self._live_gauge.set(len(self._failure_detector.live_nodes()))
+            self._dead_gauge.set(len(self._failure_detector.dead_nodes()))
+
+    async def _forward_leave(self, packet: Packet) -> None:
+        """One best-effort relay hop of a departure announcement to
+        every known peer (excluding the departed node itself and other
+        departed peers) — fired once per departure per node (see
+        _handle_leave_announcement). Known, not live: a relayer whose
+        phi detector has not warmed up yet still covers the fleet, and
+        a failed connect to an actually-dead peer is a cheap no-op."""
+        departed_id = packet.msg.node_id
+        targets = [
+            n.gossip_advertise_addr
+            for n in self._cluster_state.nodes()
+            if n != departed_id
+            and n != self.self_node_id
+            and n not in self._departed
+        ]
+        await self._announce_packet(packet, targets)
+
+    def departed_peers(self) -> dict[NodeId, str]:
+        """Peers that announced a graceful departure and have not been
+        seen alive since, with the announced reason — the
+        dead-with-reason surface (/healthz includes the names)."""
+        return {nid: reason for nid, (reason, _hb) in self._departed.items()}
+
     def _verify_peer_tls_name(self, packet: Packet, writer: StreamWriter) -> bool:
         """mTLS policy (reference server.py:585-597): when serving TLS and
         the peer presented a cert, some node in its digest must claim a
@@ -863,8 +1289,18 @@ class Cluster:
         # the phi each decision actually used, so the histogram samples
         # exactly the decision values with no recomputation.
         now = utc_now()
+        # A departed peer (graceful Leave) stays dead on announcement
+        # authority — its recent heartbeats would otherwise keep phi low
+        # and resurrect it for the rest of the sampling window. Fresh
+        # heartbeat EVIDENCE (the counter moved past what we held at the
+        # announcement — a clean rejoin of the same incarnation, or a
+        # replica of a new one) lifts the hold and phi takes over again.
+        for node_id in list(self._departed):
+            ns = self._cluster_state.node_state(node_id)
+            if ns is not None and ns.heartbeat > self._departed[node_id][1]:
+                del self._departed[node_id]
         for node_id in self._cluster_state.nodes():
-            if node_id != self.self_node_id:
+            if node_id != self.self_node_id and node_id not in self._departed:
                 phi = self._failure_detector.update_node_liveness(
                     node_id, ts=now
                 )
@@ -896,6 +1332,7 @@ class Cluster:
         self._dead_gauge.set(len(self._failure_detector.dead_nodes()))
         for node_id in self._failure_detector.garbage_collect():
             self._cluster_state.remove_node(node_id)
+            self._departed.pop(node_id, None)
             if self._health is not None:
                 # Departed for good: evict the peer's RTT/breaker state
                 # and gauge series (bounded by live membership, not by

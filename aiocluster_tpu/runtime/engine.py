@@ -201,3 +201,15 @@ class GossipEngine:
         assert isinstance(packet.msg, Ack)
         applied = self._apply_guarded(packet.msg.delta)
         self._note("handle_ack", applied=applied)
+
+    def handle_leave(self, packet: Packet) -> Delta:
+        """Graceful departure (docs/robustness.md): apply the leaver's
+        final flush (guarded like any delta — a forged Leave cannot
+        smuggle what a forged Ack couldn't); the caller moves the node
+        to dead-with-reason. Returns what was actually applied."""
+        from ..core.messages import Leave
+
+        assert isinstance(packet.msg, Leave)
+        applied = self._apply_guarded(packet.msg.delta)
+        self._note("handle_leave", applied=applied)
+        return applied

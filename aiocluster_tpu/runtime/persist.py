@@ -1,0 +1,553 @@
+"""Crash-safe durable node state: snapshot + intent log + clean marker.
+
+The reference (and this runtime with ``Config.persistence=None``) is
+fully amnesiac: a restarted node boots with an empty keyspace and a
+bumped generation, so every reboot discards the node's own writes and
+forces the whole cluster to re-replicate its state — at scale a rolling
+deploy becomes a self-inflicted full-state anti-entropy storm. This
+module is the durability layer behind ``Config.persistence``
+(docs/robustness.md "Durability & lifecycle"):
+
+- **Snapshot** (``snapshot.bin``): the node's OWN keyspace — versions,
+  tombstones, TTL deadlines (``status_change_ts``), ``max_version``,
+  ``last_gc_version``, heartbeat, generation, the last generation this
+  store ever observed (the durable strictly-increasing guard), and
+  optionally the replicated peer view. Written tmp + fsync +
+  ``os.replace`` (atomic on POSIX), CRC-framed; a corrupt or
+  wrong-format snapshot is REFUSED loudly with a counted fallback to
+  the amnesiac boot — a wrong recovery is worse than no recovery.
+- **Intent log** (``intent.log``): append-only CRC-framed records, one
+  per owner write between snapshots. Replay is idempotent
+  (``set_versioned`` semantics); a torn tail — the kill-mid-write case
+  — truncates at the last valid frame, so recovery is always either
+  the pre-write or the post-write state, never a third thing
+  (tests/test_persist.py tortures every byte offset).
+- **Clean marker** (``clean.bin``): written ONLY by a graceful close
+  (``Cluster.close``/``Cluster.leave``) and removed as the first act of
+  the next boot, so its presence proves the previous shutdown flushed
+  everything. A clean store lets the reboot keep its previous
+  generation AND heartbeat (peers see the same incarnation resume); an
+  unclean store bumps the generation (seeded above every generation the
+  store ever saw, immune to a regressed wall clock) but still restores
+  the keyspace at its persisted versions so peers' digest floors mean
+  delta catch-up, not full re-replication.
+
+Every durable file is framed the same way: an 8-byte little-endian
+``(length, crc32)`` header followed by ``length`` payload bytes — one
+frame for snapshot/marker files, back-to-back frames for the log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+
+from ..core.config import PersistenceConfig
+from ..core.identity import Address, NodeId, observe_generation
+from ..core.kvstate import NodeState
+from ..core.values import VersionedValue, VersionStatusEnum
+from ..obs.registry import MetricsRegistry
+from ..utils.clock import UTC, utc_now
+from datetime import datetime
+
+# Store format version: bumped on any incompatible layout change; a
+# snapshot from a different format is refused (counted corrupt).
+FORMAT = 1
+
+SNAPSHOT_FILE = "snapshot.bin"
+LOG_FILE = "intent.log"
+# Rotated log segment covering writes up to an in-flight snapshot's
+# copy point: rotated out synchronously with the state copies
+# (begin_snapshot), deleted only once the covering snapshot has
+# atomically landed — a crash in between replays it on top of the older
+# snapshot (idempotent), so no acknowledged frame is ever orphaned.
+LOG_OLD_FILE = "intent.log.old"
+CLEAN_FILE = "clean.bin"
+
+_FRAME_HEADER = struct.Struct("<II")  # (payload length, crc32)
+
+# A frame larger than this is treated as corruption, not a record — an
+# absurd length word in a torn header must not make recovery attempt a
+# multi-GB read.
+MAX_FRAME_BYTES = 64 << 20
+
+
+def _frame(payload: bytes) -> bytes:
+    return _FRAME_HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def _read_frames(raw: bytes) -> tuple[list[bytes], int]:
+    """Decode back-to-back frames; returns (payloads, valid_bytes).
+    Stops at the first torn/corrupt frame — ``valid_bytes`` is where a
+    repairing truncate should cut."""
+    out: list[bytes] = []
+    pos = 0
+    n = len(raw)
+    while pos + _FRAME_HEADER.size <= n:
+        length, crc = _FRAME_HEADER.unpack_from(raw, pos)
+        start = pos + _FRAME_HEADER.size
+        if length > MAX_FRAME_BYTES or start + length > n:
+            break  # torn tail (or absurd length): cut here
+        payload = raw[start : start + length]
+        if zlib.crc32(payload) != crc:
+            break  # corrupt frame: nothing after it can be trusted
+        out.append(payload)
+        pos = start + length
+    return out, pos
+
+
+def _write_atomic(path: str, payload: bytes, *, fsync: bool = True) -> None:
+    """The tmp + fsync + ``os.replace`` discipline (analyzer rule
+    ACT028): the final path only ever names a COMPLETE file — a crash
+    mid-write leaves the previous version (or nothing), never a torn
+    one. The directory is fsync'd too so the rename itself is durable."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_frame(payload))
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if fsync:
+        dir_fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+
+
+def _read_framed_file(path: str) -> bytes | None:
+    """The single frame of a snapshot/marker file, or None when the
+    file is absent, torn, or corrupt (callers count + decide)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return None
+    frames, _ = _read_frames(raw)
+    return frames[0] if frames else None
+
+
+# -- (de)serialization --------------------------------------------------------
+
+
+def _ts_to_str(ts: datetime) -> str:
+    return ts.astimezone(UTC).isoformat()
+
+
+def _ts_from_str(raw: str) -> datetime:
+    ts = datetime.fromisoformat(raw)
+    return ts if ts.tzinfo is not None else ts.replace(tzinfo=UTC)
+
+
+def _vv_to_obj(key: str, vv: VersionedValue) -> dict:
+    return {
+        "k": key,
+        "v": vv.value,
+        "ver": vv.version,
+        "st": int(vv.status),
+        "ts": _ts_to_str(vv.status_change_ts),
+    }
+
+
+def _vv_from_obj(obj: dict) -> tuple[str, VersionedValue]:
+    return obj["k"], VersionedValue(
+        obj["v"],
+        int(obj["ver"]),
+        VersionStatusEnum(int(obj["st"])),
+        _ts_from_str(obj["ts"]),
+    )
+
+
+def _node_id_to_obj(node_id: NodeId) -> dict:
+    host, port = node_id.gossip_advertise_addr
+    return {
+        "name": node_id.name,
+        "gen": node_id.generation_id,
+        "host": host,
+        "port": port,
+        "tls": node_id.tls_name,
+    }
+
+
+def _node_id_from_obj(obj: dict) -> NodeId:
+    addr: Address = (obj["host"], int(obj["port"]))
+    return NodeId(obj["name"], int(obj["gen"]), addr, obj.get("tls"))
+
+
+def _node_state_to_obj(ns: NodeState) -> dict:
+    return {
+        "node": _node_id_to_obj(ns.node),
+        "heartbeat": ns.heartbeat,
+        "max_version": ns.max_version,
+        "last_gc_version": ns.last_gc_version,
+        "kvs": [_vv_to_obj(k, vv) for k, vv in ns.key_values.items()],
+    }
+
+
+def _node_state_from_obj(obj: dict) -> NodeState:
+    kvs = dict(_vv_from_obj(o) for o in obj["kvs"])
+    return NodeState(
+        _node_id_from_obj(obj["node"]),
+        heartbeat=int(obj["heartbeat"]),
+        key_values=kvs,
+        max_version=int(obj["max_version"]),
+        last_gc_version=int(obj["last_gc_version"]),
+    )
+
+
+@dataclass(slots=True)
+class RecoveredState:
+    """What ``NodeStore.load()`` hands the booting Cluster."""
+
+    clean: bool  # previous shutdown proved graceful (marker present)
+    generation: int  # generation of the incarnation that wrote the store
+    heartbeat: int  # final heartbeat (clean marker beats snapshot)
+    max_version: int
+    last_gc_version: int
+    key_values: dict[str, VersionedValue]
+    last_generation_seen: int  # durable strictly-increasing guard floor
+    peers: list[NodeState] = field(default_factory=list)  # hints only
+
+
+class NodeStore:
+    """One node's durable store (see module docstring). Synchronous by
+    design — callers run the slow paths (snapshot) off-loop via
+    ``asyncio.to_thread``; the per-write log append is a buffered write
+    + flush, cheap enough for the owner KV API to call inline."""
+
+    def __init__(
+        self,
+        cfg: PersistenceConfig,
+        *,
+        metrics: MetricsRegistry | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.path = cfg.path
+        os.makedirs(self.path, exist_ok=True)
+        self._log_fh = None
+        self._log_bytes = 0
+        self._rounds_since_snapshot = 0
+        # Snapshot writes run off-loop (asyncio.to_thread) and a
+        # cancelled dispatcher cannot cancel a running thread — two
+        # writes CAN overlap (a periodic one orphaned by shutdown
+        # cancellation racing close()'s final one). The lock serializes
+        # them and the loop-side sequence (issued by begin_snapshot,
+        # single-threaded on the event loop) makes the race
+        # last-COPY-wins, not last-THREAD-wins: a stale orphan arriving
+        # late skips its write instead of clobbering the newer state.
+        self._snap_lock = threading.Lock()
+        self._snap_seq = 0
+        self._snap_written = 0
+        self._events = None
+        if metrics is not None:
+            self._events = metrics.counter(
+                "aiocluster_persist_events_total",
+                "Durable-store activity: snapshot (atomic keyspace "
+                "snapshot written), log_append (intent record "
+                "journaled), log_truncated (torn tail repaired at "
+                "recovery), recovered_clean / recovered_unclean "
+                "(keyspace restored, by previous-shutdown verdict), "
+                "recovered_fresh (no usable store; reference amnesiac "
+                "boot), corrupt_fallback (snapshot refused loudly; "
+                "amnesiac boot), clean_marker (graceful-shutdown "
+                "marker written)",
+                labels=("event",),
+            )
+
+    def _count(self, event: str) -> None:
+        if self._events is not None:
+            self._events.labels(event).inc()
+
+    def _file(self, name: str) -> str:
+        return os.path.join(self.path, name)
+
+    # -- recovery -------------------------------------------------------------
+
+    def load(self) -> RecoveredState | None:
+        """Recover the persisted state, or None for an amnesiac boot
+        (fresh store, or a corrupt snapshot refused loudly). Always
+        consumes the clean marker and repairs the log tail, so the
+        running incarnation starts from a consistent dirty store."""
+        marker_payload = _read_framed_file(self._file(CLEAN_FILE))
+        # Consume the marker FIRST: from here until the next graceful
+        # close, a crash must read as unclean. The removal is made
+        # DURABLE (directory fsync) — an un-fsync'd unlink can
+        # resurrect after power loss and make the crashed incarnation's
+        # next boot falsely claim a clean shutdown.
+        try:
+            os.remove(self._file(CLEAN_FILE))
+        except FileNotFoundError:
+            pass
+        else:
+            dir_fd = os.open(self.path, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+        snap_exists = os.path.exists(self._file(SNAPSHOT_FILE))
+        snap_payload = _read_framed_file(self._file(SNAPSHOT_FILE))
+        snap = None
+        if snap_payload is not None:
+            try:
+                obj = json.loads(snap_payload)
+                if obj.get("format") != FORMAT:
+                    raise ValueError(f"unknown store format {obj.get('format')!r}")
+                snap = obj
+            except (ValueError, KeyError, TypeError):
+                snap = None
+        if snap is None:
+            if snap_exists:
+                # A snapshot file exists but cannot be trusted: refuse
+                # it LOUDLY (counted) and boot amnesiac — never guess.
+                # The generation guard is still seeded from whatever IS
+                # readable (the marker records the last generation this
+                # store issued): even a corrupt-store reboot under a
+                # regressed wall clock must win newer-generation-wins.
+                if marker_payload is not None:
+                    try:
+                        marker = json.loads(marker_payload)
+                        observe_generation(
+                            max(
+                                int(marker.get("generation", 0)),
+                                int(marker.get("last_generation_seen", 0)),
+                            )
+                        )
+                    except (ValueError, TypeError):
+                        pass
+                self._count("corrupt_fallback")
+            else:
+                self._count("recovered_fresh")
+            self._truncate_log(0)
+            return None
+
+        own = _node_state_from_obj(snap["own"])
+        last_gen_seen = int(snap.get("last_generation_seen", 0))
+
+        # Replay the intent log(s) on top of the snapshot (idempotent:
+        # set_versioned skips anything at or below what we hold). A
+        # rotated segment still on disk means a snapshot was in flight
+        # at the crash — its frames may predate OR postdate the
+        # snapshot that survived; idempotent replay covers both.
+        records = self._read_rotated_log() + self._read_log()[0]
+        for rec in records:
+            try:
+                obj = json.loads(rec)
+                key, vv = _vv_from_obj(obj)
+            except (ValueError, KeyError, TypeError):
+                continue  # an unreadable record body: skip, keep framing
+            own.set_versioned(key, vv)
+
+        clean = False
+        heartbeat = own.heartbeat
+        generation = int(snap["generation"])
+        if marker_payload is not None:
+            try:
+                marker = json.loads(marker_payload)
+                if int(marker.get("generation", -1)) == generation:
+                    clean = True
+                    heartbeat = max(heartbeat, int(marker.get("heartbeat", 0)))
+                    last_gen_seen = max(
+                        last_gen_seen, int(marker.get("last_generation_seen", 0))
+                    )
+            except (ValueError, TypeError):
+                clean = False  # unreadable marker proves nothing
+        peers = []
+        for obj in snap.get("peers", ()):
+            try:
+                peers.append(_node_state_from_obj(obj))
+            except (ValueError, KeyError, TypeError):
+                continue  # peers are hints; a bad one is just dropped
+        recovered = RecoveredState(
+            clean=clean,
+            generation=generation,
+            heartbeat=heartbeat,
+            max_version=own.max_version,
+            last_gc_version=own.last_gc_version,
+            key_values=own.key_values,
+            last_generation_seen=max(last_gen_seen, generation),
+            peers=peers,
+        )
+        # Seed the process-local generation guard with everything this
+        # store ever saw — the durable strictly-increasing promise.
+        observe_generation(recovered.last_generation_seen)
+        self._count("recovered_clean" if clean else "recovered_unclean")
+        return recovered
+
+    def _read_log(self) -> tuple[list[bytes], int]:
+        try:
+            with open(self._file(LOG_FILE), "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return [], 0
+        records, valid = _read_frames(raw)
+        if valid < len(raw):
+            # Torn tail (kill mid-append): truncate at the last valid
+            # frame so the log is append-consistent again.
+            self._truncate_log(valid)
+            self._count("log_truncated")
+        return records, valid
+
+    def _read_rotated_log(self) -> list[bytes]:
+        try:
+            with open(self._file(LOG_OLD_FILE), "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return []
+        records, valid = _read_frames(raw)
+        if valid < len(raw):
+            self._count("log_truncated")
+        return records
+
+    def _truncate_log(self, size: int) -> None:
+        try:
+            with open(self._file(LOG_FILE), "ab") as f:
+                f.truncate(size)
+        except OSError:
+            pass
+        self._log_bytes = size
+
+    # -- journaling -----------------------------------------------------------
+
+    def record_write(self, key: str, vv: VersionedValue) -> None:
+        """Append one owner write to the intent log (CRC-framed,
+        flushed; fsync per ``cfg.fsync_writes``)."""
+        if self._log_fh is None:
+            self._log_fh = open(self._file(LOG_FILE), "ab")
+            self._log_bytes = self._log_fh.tell()
+        raw = _frame(
+            json.dumps(_vv_to_obj(key, vv), separators=(",", ":")).encode()
+        )
+        self._log_fh.write(raw)
+        self._log_fh.flush()
+        if self.cfg.fsync_writes:
+            os.fsync(self._log_fh.fileno())
+        self._log_bytes += len(raw)
+        self._count("log_append")
+
+    def snapshot_due(self) -> bool:
+        """One call per initiated gossip round: time for a snapshot?"""
+        self._rounds_since_snapshot += 1
+        return (
+            self._rounds_since_snapshot >= self.cfg.snapshot_interval_rounds
+            or self._log_bytes > self.cfg.log_max_bytes
+        )
+
+    def begin_snapshot(self) -> int:
+        """Start one snapshot: called SYNCHRONOUSLY with the state
+        copies (on the event loop, so it is atomic with them), it
+        rotates the live intent log into the covered segment and issues
+        the write's sequence number. Everything journaled up to this
+        instant is inside the copies about to be written; everything
+        journaled after lands in the fresh live log and SURVIVES the
+        snapshot — the un-synchronized truncate-after-write would have
+        erased concurrent writes that the copied state predates."""
+        log_path = self._file(LOG_FILE)
+        old_path = self._file(LOG_OLD_FILE)
+        # Same lock as the snapshot writers: an in-flight write's
+        # covered-segment cleanup must not race this rotation's append
+        # into the segment (the removal would take the fresh frames
+        # with it). Contention is rare — the dispatcher already
+        # serializes snapshots; only a shutdown-orphaned thread overlaps.
+        with self._snap_lock:
+            try:
+                with open(log_path, "rb") as f:
+                    raw = f.read()
+            except FileNotFoundError:
+                raw = b""
+            if raw:
+                # Append (not replace): a still-pending previous
+                # rotation — its snapshot never landed — keeps its
+                # frames until a snapshot that covers them is durably
+                # on disk.
+                with open(old_path, "ab") as f:
+                    f.write(raw)
+                    f.flush()
+                    if self.cfg.fsync_writes:
+                        os.fsync(f.fileno())
+                self._truncate_log(0)
+            self._rounds_since_snapshot = 0
+            self._snap_seq += 1
+            return self._snap_seq
+
+    def write_snapshot(
+        self,
+        own: NodeState,
+        generation: int,
+        peers: list[NodeState] | None = None,
+        seq: int | None = None,
+    ) -> None:
+        """Atomically persist the keyspace; the covered log segment
+        (rotated out by ``begin_snapshot``) is deleted only AFTER the
+        snapshot has durably landed. ``own``/``peers`` must be detached
+        copies — this runs off-loop via ``asyncio.to_thread`` while
+        gossip keeps mutating the live state (concurrent owner writes
+        keep journaling to the fresh live log, untouched here).
+        ``seq=None`` (direct synchronous callers) performs the rotation
+        inline."""
+        if seq is None:
+            seq = self.begin_snapshot()
+        payload = json.dumps(
+            {
+                "format": FORMAT,
+                "generation": generation,
+                "last_generation_seen": generation,
+                "own": _node_state_to_obj(own),
+                "peers": [
+                    _node_state_to_obj(ns) for ns in (peers or ())
+                ],
+            },
+            separators=(",", ":"),
+        ).encode()
+        with self._snap_lock:
+            if seq < self._snap_written:
+                # A newer snapshot (taken from newer copies) already
+                # landed while this thread was orphaned mid-write
+                # (shutdown cancellation cannot stop a running thread):
+                # writing now would clobber newer state with older.
+                return
+            _write_atomic(self._file(SNAPSHOT_FILE), payload)
+            self._snap_written = seq
+            if seq == self._snap_seq:
+                # Only the LATEST rotation's writer may drop the
+                # rotated segment: a newer begin_snapshot may have
+                # appended frames this snapshot's copies predate — they
+                # must survive until THEIR covering snapshot lands (or
+                # be replayed at recovery if it never does).
+                try:
+                    os.remove(self._file(LOG_OLD_FILE))
+                except FileNotFoundError:
+                    pass
+        self._count("snapshot")
+
+    def write_clean_marker(self, generation: int, heartbeat: int) -> None:
+        """The graceful-shutdown proof: written ONLY after the final
+        snapshot landed, consumed at next boot. Records the final
+        heartbeat so a clean rejoin resumes the same incarnation's
+        counter (peers only credit INCREASES)."""
+        payload = json.dumps(
+            {
+                "format": FORMAT,
+                "generation": generation,
+                "heartbeat": heartbeat,
+                "last_generation_seen": generation,
+                "ts": _ts_to_str(utc_now()),
+            },
+            separators=(",", ":"),
+        ).encode()
+        _write_atomic(self._file(CLEAN_FILE), payload)
+        self._count("clean_marker")
+
+    def close(self) -> None:
+        if self._log_fh is not None:
+            try:
+                self._log_fh.flush()
+                os.fsync(self._log_fh.fileno())
+            except OSError:
+                pass
+            self._log_fh.close()
+            self._log_fh = None

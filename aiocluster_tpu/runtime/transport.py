@@ -35,6 +35,20 @@ class GossipTransport:
         metrics: MetricsRegistry | None = None,
     ) -> None:
         self._max_payload_size = max_payload_size
+        # The read-side frame bound. A reply frames digest + delta in
+        # ONE packet: the delta is packed to at most the MTU, and any
+        # functioning cluster's digest + envelope fit the MTU on their
+        # own (a Syn IS digest + envelope), so 2x admits every frame a
+        # correct peer can produce. The reference validates the whole
+        # frame against the bare MTU, which REJECTS its own MTU-full
+        # SynAcks — an anti-entropy backlog over one MTU (a rebooted
+        # amnesiac node's refill) then re-sends the same oversize reply
+        # every round and never converges (found by restart_bench's
+        # cold arm under a shrunk MTU; migration.md difference #14).
+        # Wire format and send-side packing are unchanged — this is
+        # only liberal acceptance; the bound still caps per-frame
+        # memory at a known multiple of the configured MTU.
+        self._max_frame_size = 2 * max_payload_size
         self._connect_timeout = connect_timeout
         self._read_timeout = read_timeout
         self._write_timeout = write_timeout
@@ -133,7 +147,7 @@ class GossipTransport:
             timeout=self._read_timeout if timeout is None else timeout,
         )
         size = read_frame_size(header)
-        if size <= 0 or size > self._max_payload_size:
+        if size <= 0 or size > self._max_frame_size:
             raise ValueError(f"invalid message size: {size}")
         raw = await asyncio.wait_for(
             reader.readexactly(size),

@@ -174,6 +174,12 @@ class SnapshotCache:
         """Count an ETag short-circuit (the 304 path encodes nothing)."""
         self._count("not_modified")
 
+    def note_resync_full(self) -> None:
+        """Count a full-payload resync decided OUTSIDE delta_since — the
+        /watch epoch-discontinuity path (a rebooted member's epoch
+        counter restarted below the client's resume token)."""
+        self._count("resync_full")
+
     def _fingerprint(self) -> tuple:
         """O(nodes) content-change pre-check: live/dead membership plus
         every node's version watermarks. Visible content cannot change

@@ -540,6 +540,22 @@ class ServeApp:
             # ?timeout=nan client would park forever past the ceiling.
             return "400 Bad Request", (b"bad timeout", _TEXT, ())
         timeout = min(timeout, MAX_LONG_POLL_S)
+        if since > self.cache.epoch_now():
+            # Epoch discontinuity: epochs never regress within one boot,
+            # so a `since` ahead of now is a resume token from a
+            # PREVIOUS incarnation (the member rebooted and its epoch
+            # counter restarted low) — or garbage. Either way no future
+            # publish can ever exceed it honestly; parking would strand
+            # the client until timeout and an empty 204 would strand it
+            # forever. Serve the full payload NOW, flagged X-Resync
+            # (counted), so the client realigns to this boot's epochs.
+            self.cache.note_resync_full()
+            encoded = self.cache.get()
+            return "200 OK", (
+                encoded.payload,
+                _JSON,
+                (("ETag", encoded.etag), ("X-Resync", "1")),
+            )
         encoded = await self.hub.wait_newer(since, timeout)
         if encoded is None:
             # Timed out ⇒ no content newer than `since` was published.
@@ -582,6 +598,13 @@ class ServeApp:
                     encoded = self.cache.get()
                     if since < encoded.epoch:
                         await self._write_chunk(writer, encoded.payload)
+                elif since is not None and since > self.cache.epoch_now():
+                    # Epoch discontinuity (see _handle_watch): a resume
+                    # token from a previous boot — realign the stream
+                    # with a full payload now rather than leaving the
+                    # client silent until the next bump.
+                    self.cache.note_resync_full()
+                    await self._write_chunk(writer, self.cache.get().payload)
             while True:
                 encoded = await watcher.next()
                 if encoded is None or watcher.closed:

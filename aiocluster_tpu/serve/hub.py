@@ -117,6 +117,7 @@ class WatchHub:
         self._queue_maxsize = max(1, queue_maxsize)
         self._kick = asyncio.Event()
         self._pump_task: asyncio.Task | None = None
+        self._stopping = False
         self._published_epoch: int | None = None
         # fut -> the client's `since` epoch: a publish only wakes the
         # futures it is actually NEWER than (a waiter parked at the
@@ -173,10 +174,20 @@ class WatchHub:
             # Anchor at the current epoch so the pump's first iteration
             # is an idle compare, not a spurious publish/encode.
             self._published_epoch = self.cache.epoch_now()
+            self._stopping = False
             self._pump_task = asyncio.create_task(self._pump())
 
     async def stop(self) -> None:
         if self._pump_task is not None:
+            # Belt AND suspenders: on 3.10, ``asyncio.wait_for`` can
+            # swallow a cancellation that races the awaited future's
+            # completion (bpo-42130) — and the pump's kick.wait()
+            # COMPLETES CONSTANTLY under a live gossiping fleet. The
+            # flag (checked every loop) ends the pump even when the
+            # CancelledError delivery is eaten; the cancel + kick cover
+            # the parked waits.
+            self._stopping = True
+            self._kick.set()
             self._pump_task.cancel()
             with suppress(asyncio.CancelledError):  # noqa: ACT013 -- joining our own cancelled pump at shutdown
                 await self._pump_task
@@ -199,13 +210,15 @@ class WatchHub:
         self._kick.set()
 
     async def _pump(self) -> None:
-        while True:
+        while not self._stopping:
             try:
                 await asyncio.wait_for(
                     self._kick.wait(), timeout=self._poll_interval
                 )
             except (TimeoutError, asyncio.TimeoutError):
                 pass  # poll tick: liveness through dropped hook events
+            if self._stopping:
+                return
             self._kick.clear()
             if self.cache.epoch_now() == self._published_epoch:
                 self._count_hub("idle")  # pure int compare, no walk

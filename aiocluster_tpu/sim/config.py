@@ -325,6 +325,23 @@ class SimConfig:
                     "masks are owner-column selects with no byte-space "
                     "form); version_dtype='u4r' cannot run them"
                 )
+            amnesia = any(
+                cr.recovery == "amnesia" for cr in self.fault_plan.crashes
+            )
+            if amnesia and self.version_dtype == "u4r":
+                raise ValueError(
+                    "recovery='amnesia' crash windows are unpacked-only "
+                    "(the knowledge-row reset writes w=0, which in "
+                    "residual space is a per-owner value, not a "
+                    "constant); version_dtype='u4r' cannot run them — "
+                    "use recovery='warm' or a wider rung"
+                )
+            if amnesia and self.live_bits:
+                raise ValueError(
+                    "recovery='amnesia' crash windows do not support "
+                    "live_bits (the live-view row reset has no packed "
+                    "form); use recovery='warm' or live_bits=False"
+                )
         if self.quarantine:
             if self.pairing != "choice":
                 raise ValueError(

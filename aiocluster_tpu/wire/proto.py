@@ -25,6 +25,7 @@ from ..core.messages import (
     Delta,
     Digest,
     KeyValueUpdate,
+    Leave,
     NodeDelta,
     NodeDigest,
     Packet,
@@ -552,6 +553,15 @@ def encode_packet(packet: Packet) -> bytes:
         _field_msg(out, 4, bytes(body))
     elif isinstance(msg, BadCluster):
         _field_msg(out, 5, b"")
+    elif isinstance(msg, Leave):
+        # New beyond the reference schema (field 6, skipped by its
+        # decoders): graceful-departure announcement + final flush.
+        body = bytearray()
+        _field_msg(body, 1, encode_node_id(msg.node_id))
+        _field_msg(body, 2, encode_delta(msg.delta))
+        _field_str(body, 3, msg.reason)
+        _field_varint(body, 4, msg.heartbeat)
+        _field_msg(out, 6, bytes(body))
     else:  # pragma: no cover - exhaustiveness guard
         raise WireError(f"unknown packet message type: {type(msg)!r}")
     return bytes(out)
@@ -584,6 +594,27 @@ def _decode_synack(body: bytes) -> SynAck:
     return SynAck(digest, delta)
 
 
+def _decode_leave(body: bytes) -> Leave:
+    r = _Reader(body)
+    node_id = _EMPTY_NODE_ID
+    delta = Delta()
+    reason = "leave"
+    heartbeat = 0
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            node_id = decode_node_id(r.chunk())
+        elif field == 2 and wt == _LEN:
+            delta = decode_delta(r.chunk())
+        elif field == 3 and wt == _LEN:
+            reason = _utf8(r.chunk()) or "leave"
+        elif field == 4 and wt == _VARINT:
+            heartbeat = r.varint()
+        else:
+            r.skip(wt)
+    return Leave(node_id, delta, reason, heartbeat)
+
+
 def _decode_ack(body: bytes) -> Ack:
     r = _Reader(body)
     delta = Delta()
@@ -599,7 +630,7 @@ def _decode_ack(body: bytes) -> Ack:
 def decode_packet(data: bytes) -> Packet:
     r = _Reader(data)
     cluster_id = ""
-    msg: Syn | SynAck | Ack | BadCluster | None = None
+    msg: Syn | SynAck | Ack | BadCluster | Leave | None = None
     while not r.at_end():
         field, wt = r.field()
         if field == 1 and wt == _LEN:
@@ -613,6 +644,8 @@ def decode_packet(data: bytes) -> Packet:
         elif field == 5 and wt == _LEN:
             r.chunk()
             msg = BadCluster()
+        elif field == 6 and wt == _LEN:
+            msg = _decode_leave(r.chunk())
         else:
             r.skip(wt)
     if msg is None:

@@ -698,6 +698,19 @@ def serve_tier_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def restart_durability_bench(log, smoke: bool) -> dict | None:
+    """The durability datum (benchmarks/restart_bench.py,
+    docs/robustness.md "Durability & lifecycle"): a rolling restart run
+    warm (persistent store, graceful close, store-restored rejoin) vs
+    cold (the reference's amnesiac reboot) on real loopback fleets —
+    the warm/cold re-replication byte ratio and reconvergence, plus
+    graceful-leave detection vs the measured phi window. Rides every
+    record with its gate verdicts machine-readable."""
+    return _run_benchmarks_helper(
+        "restart_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 def overload_degradation_bench(log, smoke: bool) -> dict | None:
     """The overload/degradation datum (benchmarks/overload_bench.py,
     docs/robustness.md): a slow-peer storm (adaptive timeouts + circuit
@@ -721,6 +734,9 @@ STDOUT_LINE_CAP = 2000
 # least-essential provenance first; the headline fields
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
+    "leave_detect_seconds",
+    "rejoin_warm_rounds",
+    "rejoin_warm_vs_cold_bytes",
     "adaptive_timeout_p99_ms",
     "breaker_open_peers",
     "overload_availability_frac_control",
@@ -831,6 +847,18 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         ),
         "adaptive_timeout_p99_ms": (ex.get("overload_bench") or {}).get(
             "adaptive_timeout_p99_ms"
+        ),
+        # Durable node state (restart_bench.py): warm-vs-cold rolling
+        # restart re-replication ratio, warm reconvergence, and the
+        # graceful-leave detection time vs the phi window.
+        "rejoin_warm_vs_cold_bytes": (ex.get("restart_bench") or {}).get(
+            "rejoin_warm_vs_cold_bytes"
+        ),
+        "rejoin_warm_rounds": (ex.get("restart_bench") or {}).get(
+            "rejoin_warm_rounds"
+        ),
+        "leave_detect_seconds": (ex.get("restart_bench") or {}).get(
+            "leave_detect_seconds"
         ),
         # S-lane sweep throughput + compile amortization (sweep_bench).
         "sim_sweep_lane_rounds_per_sec": (ex.get("sweep_bench") or {}).get(
@@ -1460,6 +1488,9 @@ def main() -> None:
         # Overload & degradation: slow-peer storm + reader surge with
         # the robustness layer on vs off (benchmarks/overload_bench.py).
         overload_rec = overload_degradation_bench(log, args.smoke)
+        # Durable node state: warm-vs-cold rolling restart + leave
+        # detection on real loopback fleets (restart_bench.py).
+        restart_rec = restart_durability_bench(log, args.smoke)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1537,6 +1568,10 @@ def main() -> None:
                 # on-vs-off availability, breakers, adaptive p99
                 # (overload_bench.py, docs/robustness.md).
                 "overload_bench": overload_rec,
+                # Durable node state: warm-vs-cold rejoin ratio, warm
+                # reconvergence, leave-vs-phi detection, gate verdicts
+                # (restart_bench.py, docs/robustness.md).
+                "restart_bench": restart_rec,
                 # The memory ladder's planning claims (per-rung B/pair,
                 # modeled max scale) — every entry certified: false
                 # until the chip calibrates the new paths.

@@ -1,0 +1,330 @@
+"""Warm-vs-cold rejoin benchmark: what durable node state buys a rolling
+restart (docs/robustness.md "Durability & lifecycle").
+
+Three arms on real loopback fleets (ChaosHarness):
+
+- **cold** — the reference's amnesiac restart: every node in turn is
+  closed and rebooted EMPTY with a bumped generation, so each reboot
+  re-pulls every peer's keyspace from scratch. Measures the fleet-wide
+  anti-entropy volume (key-version updates actually APPLIED, converted
+  to encoded bytes with the wire size model — digest chatter, which
+  both arms pay identically per round, is excluded by construction)
+  and the wall-clock reconvergence of the whole rolling pass.
+- **warm** — the same rolling pass with ``Config.persistence``: each
+  node closes GRACEFULLY (clean marker ⇒ the reboot keeps its
+  generation and heartbeat) and restores its keyspace + replicated
+  peer view from the store, so rejoin is delta catch-up. GATES (the
+  acceptance bar, enforced here and by ``make restart-smoke``):
+  warm applied bytes ≤ 0.1× cold AND warm reconvergence strictly
+  faster than cold.
+- **leave** — graceful-departure detection: one node ``leave()``s and
+  the time until every peer lists it dead is measured against the
+  measured phi window (an ``abort()`` of another node on the same
+  fleet — the control). GATE: leave detection strictly faster than
+  the phi window.
+
+Usage: python benchmarks/restart_bench.py [--smoke]
+Importable: bench.py calls measure() for its BENCH record
+(``extra.restart_bench``; compact keys ``rejoin_warm_vs_cold_bytes``,
+``rejoin_warm_rounds``, ``leave_detect_seconds``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+NODES = 6
+NODES_SMOKE = 4
+KEYS_PER_NODE = 96
+KEYS_PER_NODE_SMOKE = 48
+VALUE_BYTES = 96
+INTERVAL_S = 0.05
+# The rolling arms run under a SHRUNK delta MTU so a cold rejoin needs
+# several rounds of pulls (at the reference 64KB MTU a smoke-sized
+# keyspace refills in one handshake and the reconvergence comparison
+# measures scheduler noise, not anti-entropy).
+ROLLING_MTU = 8192
+APPLIED_KV_KEY = "aiocluster_delta_key_values_total{direction=applied}"
+
+
+def _fleet_applied_kvs(harness) -> int:
+    """Fleet-wide count of key-version updates actually applied — the
+    anti-entropy work, zero on a converged quiet fleet (heartbeats ride
+    digests, not deltas)."""
+    total = 0
+    for registry in harness.registries.values():
+        value = registry.snapshot().get(APPLIED_KV_KEY)
+        if value:
+            total += int(value)
+    return total
+
+
+def _kv_encoded_bytes(key: str, value: str) -> int:
+    """Encoded size of one KeyValueUpdate on the wire (field framing
+    included) — the per-kv byte cost the applied counter converts with."""
+    from aiocluster_tpu.core.messages import KeyValueUpdate
+    from aiocluster_tpu.core.values import KeyStatus
+    from aiocluster_tpu.wire.proto import encode_kv_update
+
+    body = encode_kv_update(KeyValueUpdate(key, value, 1 << 20, KeyStatus.SET))
+    return len(body) + 2  # tag + length framing inside the node delta
+
+
+def _replicated(harness, keys_per_node: int) -> bool:
+    """Every running node holds every running owner's CURRENT
+    incarnation at full version coverage (marker + workload keys)."""
+    running = harness.running()
+    latest = {
+        name: harness.clusters[name].self_node_id for name in running
+    }
+    for observer in running:
+        states = harness.clusters[observer].node_states_view()
+        for owner in running:
+            if owner == observer:
+                continue
+            own = harness.clusters[owner].self_node_state()
+            ns = states.get(latest[owner])
+            if ns is None or ns.max_version < own.max_version:
+                return False
+            if ns.get(f"from-{owner}") is None:
+                return False
+    return True
+
+
+async def _wait_replicated(harness, keys_per_node: int, timeout: float) -> float:
+    start = time.monotonic()
+    deadline = start + timeout
+    while time.monotonic() < deadline:
+        if _replicated(harness, keys_per_node):
+            return time.monotonic() - start
+        await asyncio.sleep(INTERVAL_S / 2)
+    raise TimeoutError(f"fleet did not fully replicate within {timeout}s")
+
+
+async def _wait_quiescent(harness, rounds: int = 6, timeout: float = 20.0) -> None:
+    """Drain in-flight anti-entropy before sampling a baseline: a Syn
+    whose digest was encoded BEFORE the workload writes legitimately
+    elicits full-keyspace deltas when answered after them (the receiver
+    discards the stale versions — correct, idempotent, but counted and
+    real bytes). Sampling while such handshakes are in flight would
+    charge that settling traffic to the measured window."""
+    deadline = time.monotonic() + timeout
+    last = _fleet_applied_kvs(harness)
+    stable = 0
+    while stable < rounds:
+        if time.monotonic() > deadline:
+            raise TimeoutError("fleet never went anti-entropy quiescent")
+        await asyncio.sleep(INTERVAL_S)
+        cur = _fleet_applied_kvs(harness)
+        if cur == last:
+            stable += 1
+        else:
+            stable, last = 0, cur
+
+
+async def _rolling_arm(
+    warm: bool, nodes: int, keys_per_node: int, persist_root: str | None
+) -> dict:
+    from aiocluster_tpu.faults.runner import ChaosHarness
+
+    harness = ChaosHarness(
+        nodes,
+        None,
+        cluster_id="restartbench",
+        gossip_interval=INTERVAL_S,
+        persist_root=persist_root if warm else None,
+        config_overrides={"max_payload_size": ROLLING_MTU},
+    )
+    value = "v" * VALUE_BYTES
+    async with harness:
+        await harness.wait_converged(timeout=30.0)
+        for name in harness.names:
+            cluster = harness.clusters[name]
+            for i in range(keys_per_node):
+                cluster.set(f"k{i:04d}", value)
+        await _wait_replicated(harness, keys_per_node, timeout=60.0)
+        await _wait_quiescent(harness)
+
+        applied0 = _fleet_applied_kvs(harness)
+        t0 = time.monotonic()
+        for name in harness.names:
+            await harness.restart_node(
+                name,
+                recovery="warm" if warm else "amnesia",
+                graceful=True,
+            )
+            await _wait_replicated(harness, keys_per_node, timeout=60.0)
+        reconverge_s = time.monotonic() - t0
+        applied = _fleet_applied_kvs(harness) - applied0
+    kv_bytes = _kv_encoded_bytes("k0000", value)
+    return {
+        "warm": warm,
+        "nodes": nodes,
+        "keys_per_node": keys_per_node,
+        "gossip_interval_s": INTERVAL_S,
+        "rolling_reconverge_seconds": round(reconverge_s, 3),
+        "rolling_reconverge_rounds": round(reconverge_s / INTERVAL_S, 1),
+        "applied_key_versions": applied,
+        "applied_bytes_model": applied * kv_bytes,
+    }
+
+
+async def _leave_arm(nodes: int) -> dict:
+    """Leave-vs-phi detection race on one fleet: graceful departure is
+    announced (milliseconds); a crash must accrue phi (seconds)."""
+    from datetime import timedelta
+
+    from aiocluster_tpu.core.config import FailureDetectorConfig
+    from aiocluster_tpu.faults.runner import ChaosHarness
+
+    # A tight phi configuration so the CONTROL (crash detection) settles
+    # in ~a second instead of the default config's tens — the gate is
+    # the RATIO (announced departure beats accrued suspicion), and the
+    # announcement path does not read these knobs at all.
+    fd = FailureDetectorConfig(
+        initial_interval=timedelta(seconds=8 * INTERVAL_S),
+        max_interval=timedelta(seconds=1.0),
+    )
+    harness = ChaosHarness(
+        nodes,
+        None,
+        cluster_id="restartbench",
+        gossip_interval=INTERVAL_S,
+        config_overrides={"failure_detector": fd},
+    )
+
+    def dead_everywhere(name: str) -> bool:
+        return all(
+            any(n.name == name for n in harness.clusters[o].dead_nodes())
+            for o in harness.running()
+            if o != name
+        )
+
+    async def time_until_dead(name: str, timeout: float) -> float:
+        start = time.monotonic()
+        deadline = start + timeout
+        while time.monotonic() < deadline:
+            if dead_everywhere(name):
+                return time.monotonic() - start
+            await asyncio.sleep(INTERVAL_S / 4)
+        raise TimeoutError(f"{name} not seen dead within {timeout}s")
+
+    async with harness:
+        await harness.wait_converged(timeout=30.0)
+        leaver, crasher = harness.names[-1], harness.names[-2]
+        await harness.clusters[leaver].leave("deploy")
+        harness._crashed.add(leaver)
+        leave_detect_s = await time_until_dead(leaver, timeout=10.0)
+        await harness.clusters[crasher].abort()
+        harness._crashed.add(crasher)
+        phi_window_s = await time_until_dead(crasher, timeout=60.0)
+        reasons = {
+            nid.name: reason
+            for nid, reason in harness.clusters[harness.names[0]]
+            .departed_peers()
+            .items()
+        }
+    return {
+        "nodes": nodes,
+        "leave_detect_seconds": round(leave_detect_s, 4),
+        "phi_window_seconds": round(phi_window_s, 4),
+        "departure_reasons": reasons,
+    }
+
+
+def measure(*, smoke: bool = False, log=lambda m: None) -> dict | None:
+    """The datum bench.py embeds (``extra.restart_bench``). Returns None
+    instead of raising — the BENCH record must survive a broken
+    loopback; the arms fail independently but the GATES only pass on a
+    complete record."""
+    nodes = NODES_SMOKE if smoke else NODES
+    keys = KEYS_PER_NODE_SMOKE if smoke else KEYS_PER_NODE
+    record: dict = {"scenario": "rolling_restart + leave", "smoke": smoke}
+    try:
+        with tempfile.TemporaryDirectory(prefix="aiocluster-restart-") as root:
+            record["cold"] = asyncio.run(
+                _rolling_arm(False, nodes, keys, None)
+            )
+            record["warm"] = asyncio.run(_rolling_arm(True, nodes, keys, root))
+        cold_b = record["cold"]["applied_bytes_model"]
+        warm_b = record["warm"]["applied_bytes_model"]
+        ratio = (warm_b / cold_b) if cold_b else None
+        record["rejoin_warm_vs_cold_bytes"] = (
+            None if ratio is None else round(ratio, 4)
+        )
+        record["rejoin_warm_rounds"] = record["warm"][
+            "rolling_reconverge_rounds"
+        ]
+        record["warm_strictly_faster"] = (
+            record["warm"]["rolling_reconverge_seconds"]
+            < record["cold"]["rolling_reconverge_seconds"]
+        )
+        log(
+            f"rolling restart: cold {cold_b}B applied / "
+            f"{record['cold']['rolling_reconverge_seconds']}s, warm "
+            f"{warm_b}B / {record['warm']['rolling_reconverge_seconds']}s "
+            f"(ratio {record['rejoin_warm_vs_cold_bytes']})"
+        )
+    except Exception as exc:
+        log(f"restart bench rolling arms failed: {exc!r}")
+        record["cold"] = record.get("cold")
+        record["warm"] = None
+    try:
+        record["leave"] = asyncio.run(_leave_arm(nodes))
+        record["leave_detect_seconds"] = record["leave"][
+            "leave_detect_seconds"
+        ]
+        log(
+            f"leave detected in {record['leave']['leave_detect_seconds']}s "
+            f"vs phi window {record['leave']['phi_window_seconds']}s"
+        )
+    except Exception as exc:
+        log(f"restart bench leave arm failed: {exc!r}")
+        record["leave"] = None
+    if record.get("warm") is None and record.get("leave") is None:
+        return None
+    # The acceptance gates, machine-readable in the record (and the exit
+    # code when run standalone / via make restart-smoke).
+    gates = {
+        "warm_bytes_le_tenth_cold": (
+            record.get("rejoin_warm_vs_cold_bytes") is not None
+            and record["rejoin_warm_vs_cold_bytes"] <= 0.1
+        ),
+        "warm_strictly_faster": bool(record.get("warm_strictly_faster")),
+        "leave_faster_than_phi": (
+            record.get("leave") is not None
+            and record["leave"]["leave_detect_seconds"]
+            < record["leave"]["phi_window_seconds"]
+        ),
+    }
+    record["gates"] = gates
+    record["gates_passed"] = all(gates.values())
+    return record
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    args = parser.parse_args()
+
+    def log(m: str) -> None:
+        print(f"[restartbench] {m}", file=sys.stderr, flush=True)
+
+    record = measure(smoke=args.smoke, log=log)
+    print(json.dumps(record, indent=1))
+    if record is None or not record.get("gates_passed"):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+# analyze-domain: runtime
+"""TN: the tmp + fsync + os.replace discipline (and the shapes the rule
+must not flag: append-mode logs, reads, temp-named paths)."""
+
+import json
+import os
+
+
+def save_membership_atomic(path, members):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:  # temp sibling: replaced below
+        json.dump(members, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def append_intent(path, record: bytes):
+    with open(path, "ab") as f:  # append-only log: torn tails truncate
+        f.write(record)
+
+
+def load_membership(path):
+    with open(path) as f:  # a read tears nothing
+        return json.load(f)

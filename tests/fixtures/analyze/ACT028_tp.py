@@ -1,0 +1,19 @@
+# analyze-domain: runtime
+"""TP: state files written in place on their final path — a crash
+mid-write leaves a torn file the next boot cannot trust (no tmp
+sibling, no os.replace in scope)."""
+
+import json
+
+
+def save_membership(path, members):
+    with open(path, "w") as f:  # final path, torn by any crash
+        json.dump(members, f)
+
+
+def save_checkpoint(path, blob: bytes):
+    f = open(path, mode="wb")  # keyword mode, same tear
+    try:
+        f.write(blob)
+    finally:
+        f.close()

@@ -134,6 +134,31 @@ def _worst_case_result():
                 "breaker_open_peers": 2,
                 "adaptive_timeout_p99_ms": 50.98,
             },
+            "restart_bench": {
+                "scenario": "rolling_restart + leave",
+                "smoke": False,
+                "cold": {
+                    "warm": False,
+                    "rolling_reconverge_seconds": 1.92,
+                    "applied_key_versions": 3480,
+                    "applied_bytes_model": 219240,
+                },
+                "warm": {
+                    "warm": True,
+                    "rolling_reconverge_seconds": 0.31,
+                    "applied_key_versions": 0,
+                    "applied_bytes_model": 0,
+                },
+                "rejoin_warm_vs_cold_bytes": 0.0,
+                "rejoin_warm_rounds": 6.2,
+                "leave_detect_seconds": 0.012,
+                "gates": {
+                    "warm_bytes_le_tenth_cold": True,
+                    "warm_strictly_faster": True,
+                    "leave_faster_than_phi": True,
+                },
+                "gates_passed": True,
+            },
             "fd_kernel": False,
             "xla_path_rounds_per_sec": 43.2,
             "pallas_speedup": 1.56,
@@ -184,6 +209,12 @@ def test_stdout_line_stays_under_cap():
     assert ex["overload_availability_frac_control"] == 0.0782
     assert ex["breaker_open_peers"] == 2
     assert ex["adaptive_timeout_p99_ms"] == 50.98
+    # The durability keys round-trip the writer as flat scalars: the
+    # warm/cold re-replication ratio, warm reconvergence, and the
+    # graceful-leave detection time (restart_bench.py).
+    assert ex["rejoin_warm_vs_cold_bytes"] == 0.0
+    assert ex["rejoin_warm_rounds"] == 6.2
+    assert ex["leave_detect_seconds"] == 0.012
     # The on-chip pointer survives a CPU fallback as scalars.
     assert ex["last_onchip_value"] > 1
     # And no nested structures sneak back in (flat extras only).

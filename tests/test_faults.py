@@ -464,3 +464,102 @@ async def test_cluster_partition_blocks_and_heals(free_port_factory):
         if key.startswith("aiocluster_faults_injected_total{")
     }
     assert blocked.get("partition", 0) > 0
+
+
+# -- amnesia vs warm recovery lowering (docs/robustness.md) -------------------
+
+
+def test_node_crash_recovery_validated_and_serialized():
+    plan = FaultPlan(
+        crashes=(NodeCrash(at=1.0, down_for=2.0, recovery="warm"),)
+    )
+    again = FaultPlan.from_json(plan.to_json())
+    assert again.crashes[0].recovery == "warm"
+    assert again == plan
+    with pytest.raises(ValueError, match="recovery"):
+        FaultPlan(crashes=(NodeCrash(down_for=1.0, recovery="tepid"),))
+
+
+def test_amnesia_restart_mask_fires_exactly_at_window_end():
+    import jax.numpy as jnp
+
+    from aiocluster_tpu.faults.sim import (
+        amnesia_restart_mask,
+        plan_amnesia_restarts,
+    )
+
+    plan = rolling_restart(2, start=4.0, wave_every=4.0, down_for=2.0)
+    assert plan_amnesia_restarts(plan)
+    n = 10
+    at = {
+        t: np.asarray(amnesia_restart_mask(plan, n, jnp.asarray(t)))
+        for t in (5, 6, 7, 9, 10, 11)
+    }
+    # Wave 0 (first half) restarts exactly at tick 6, wave 1 at tick 10.
+    assert not at[5].any() and not at[7].any() and not at[11].any()
+    assert at[6][: n // 2].all() and not at[6][n // 2 :].any()
+    assert at[10][n // 2 :].all() and not at[10][: n // 2].any()
+    # Warm plans never fire the mask path at all (static predicate).
+    warm = rolling_restart(2, recovery="warm")
+    assert not plan_amnesia_restarts(warm)
+
+
+def test_sim_amnesia_resets_knowledge_warm_keeps_it():
+    """The recovery-cost contract the sweep engine maps: an amnesiac
+    restart re-replicates the whole cluster into the rebooted wave (its
+    knowledge rows reset at the restart tick); a warm restart keeps the
+    persisted watermarks and catches up in ~a round."""
+    from aiocluster_tpu.sim.config import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    base = dict(
+        n_nodes=64,
+        keys_per_node=16,
+        track_failure_detector=False,
+        track_heartbeats=False,
+    )
+    results = {}
+    for recovery in ("amnesia", "warm"):
+        plan = rolling_restart(
+            1, start=20.0, down_for=4.0, recovery=recovery
+        )
+        sim = Simulator(SimConfig(**base, fault_plan=plan), seed=3)
+        first = sim.run_until_converged(max_rounds=19)
+        assert first is not None
+        sim.run(25 - sim.tick)  # through the window; restart at tick 24
+        w = np.asarray(sim.state.w)
+        results[recovery] = {
+            "known_after_restart": int((w > 0).sum()),
+            "reconverged": sim.run_until_converged(max_rounds=200),
+        }
+    assert results["warm"]["reconverged"] is not None
+    assert results["amnesia"]["reconverged"] is not None
+    # Warm kept every watermark; amnesia wiped the wave's rows and pays
+    # real recovery rounds for it.
+    assert (
+        results["warm"]["known_after_restart"]
+        > results["amnesia"]["known_after_restart"]
+    )
+    assert (
+        results["amnesia"]["reconverged"] > results["warm"]["reconverged"]
+    )
+
+
+def test_sim_amnesia_refused_on_packed_rungs():
+    from aiocluster_tpu.sim.config import SimConfig
+
+    plan = rolling_restart(2)
+    with pytest.raises(ValueError, match="amnesia"):
+        SimConfig(
+            n_nodes=64, version_dtype="u4r", pairing="matching",
+            track_failure_detector=False, track_heartbeats=False,
+            fault_plan=plan,
+        )
+    with pytest.raises(ValueError, match="live_bits"):
+        SimConfig(n_nodes=64, live_bits=True, fault_plan=plan)
+    # warm recovery stays allowed everywhere (nothing to reset).
+    SimConfig(
+        n_nodes=64, version_dtype="u4r", pairing="matching",
+        track_failure_detector=False, track_heartbeats=False,
+        fault_plan=rolling_restart(2, recovery="warm"),
+    )

@@ -175,9 +175,26 @@ async def test_read_packet_rejects_zero_size():
 
 
 async def test_read_packet_rejects_oversize():
-    header = (101).to_bytes(4, "big")
+    """The read-side frame bound is 2x the MTU (NOT the bare MTU): a
+    reply frames digest + delta together, and a correct peer's delta is
+    at most one MTU while its digest + envelope fit another (a Syn is
+    exactly that) — the reference's bare-MTU check rejects its own
+    MTU-full SynAcks and livelocks a backlogged refill (migration.md
+    difference #14)."""
+    header = (201).to_bytes(4, "big")
     with pytest.raises(ValueError, match="invalid message size"):
-        await make_transport(100).read_packet(FakeReader(header + b"x" * 101))
+        await make_transport(100).read_packet(FakeReader(header + b"x" * 201))
+
+
+async def test_read_packet_accepts_mtu_full_reply_frame():
+    """A frame between one and two MTUs (an MTU-full delta plus its
+    digest) must be READ, not rejected — it then fails packet DECODE
+    here (garbage body), which proves the size gate admitted it."""
+    from aiocluster_tpu.wire import WireError
+
+    header = (150).to_bytes(4, "big")
+    with pytest.raises(WireError):
+        await make_transport(100).read_packet(FakeReader(header + b"\xff" * 150))
 
 
 async def test_read_packet_rejects_truncated_body():

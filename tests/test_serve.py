@@ -898,3 +898,95 @@ async def test_overload_disabled_is_reference_behavior(free_port):
             app._inflight = 0
         finally:
             await app.stop()
+
+
+# -- reboot coverage (docs/robustness.md "Durability & lifecycle") ------------
+#
+# A rebooted member's digest epoch restarts low, so a client holding a
+# ``?since`` resume token from the previous boot is AHEAD of the new
+# epoch counter. Both read paths must resync it with a counted
+# full-payload ``X-Resync`` — never an empty/bogus delta, never a
+# parked-forever long-poll.
+
+
+async def test_state_since_across_restart_forces_resync(free_port_factory):
+    harness = ChaosHarness(2, None, gossip_interval=0.05)
+    async with harness:
+        await harness.wait_converged(timeout=20.0)
+        name = harness.names[0]
+        app = ServeApp(harness.clusters[name], hub_poll_interval=0.05)
+        port = await app.start()
+        # Grow the epoch well past anything a fresh boot starts at.
+        for i in range(50):
+            harness.clusters[name].set(f"k{i}", str(i))
+        status, hdrs, body = await _request(port, "GET", "/state")
+        assert status == "200 OK"
+        old_epoch = json.loads(body)["epoch"]
+        await app.stop()
+
+        # ChaosHarness restart: the member reboots (bumped generation,
+        # empty keyspace, epoch counter restarted low).
+        await harness.restart_node(name)
+        rebooted = harness.clusters[name]
+        reg = MetricsRegistry()
+        app = ServeApp(rebooted, metrics=reg, hub_poll_interval=0.05)
+        port = await app.start()
+        try:
+            assert rebooted.state_epoch() < old_epoch
+            status, hdrs, body = await _request(
+                port, "GET", f"/state?since={old_epoch}"
+            )
+            assert status == "200 OK"
+            assert hdrs.get("x-resync") == "1"
+            assert "x-delta" not in hdrs
+            payload = json.loads(body)
+            # A full payload of THIS boot, not a delta shape.
+            assert "nodes" in payload and "delta" not in payload
+            assert payload["epoch"] <= rebooted.state_epoch()
+            assert _serve_events(reg, "resync_full") >= 1
+        finally:
+            await app.stop()
+
+
+async def test_watch_since_across_restart_never_parks(free_port_factory):
+    harness = ChaosHarness(2, None, gossip_interval=0.05)
+    async with harness:
+        await harness.wait_converged(timeout=20.0)
+        name = harness.names[0]
+        for i in range(50):
+            harness.clusters[name].set(f"k{i}", str(i))
+        old_epoch = harness.clusters[name].state_epoch()
+
+        await harness.restart_node(name)
+        rebooted = harness.clusters[name]
+        reg = MetricsRegistry()
+        app = ServeApp(rebooted, metrics=reg, hub_poll_interval=0.05)
+        port = await app.start()
+        try:
+            assert rebooted.state_epoch() < old_epoch
+            # Long-poll with the stale-boot token: an immediate full
+            # resync, NOT a parked wait (the 10s test timeout is far
+            # below the requested 60 — parking would fail the test).
+            async with timeout_after(10.0):
+                status, hdrs, body = await _request(
+                    port, "GET", f"/watch?since={old_epoch}&timeout=60"
+                )
+            assert status == "200 OK"
+            assert hdrs.get("x-resync") == "1"
+            payload = json.loads(body)
+            assert "nodes" in payload
+            assert payload["epoch"] <= rebooted.state_epoch()
+            assert _serve_events(reg, "resync_full") >= 1
+            # A sane client adopts the reply's epoch; from there the
+            # normal long-poll contract resumes (the fleet is live, so
+            # the wake may carry any newer content — gossip membership
+            # included; the contract is monotone progress, not which
+            # change won the race).
+            rebooted.set("fresh", "1")
+            status, hdrs, body = await _request(
+                port, "GET", f"/watch?since={payload['epoch']}&timeout=5"
+            )
+            assert status == "200 OK"
+            assert json.loads(body)["epoch"] > payload["epoch"]
+        finally:
+            await app.stop()

@@ -375,3 +375,22 @@ def test_encode_node_id_is_cached():
     assert decode_node_id(first) == nid  # and they are the right bytes
     info = encode_node_id.cache_info()
     assert info.maxsize and info.maxsize >= 4096  # above any plausible population
+
+
+def test_leave_packet_round_trip():
+    """Graceful-departure envelope (field 6, beyond the reference
+    schema): node id, final delta, reason, and the FINAL heartbeat all
+    survive the wire; defaults decode when omitted."""
+    from aiocluster_tpu.core import Leave
+
+    pkt = Packet("my-cluster", Leave(N1, make_delta(), "deploy", heartbeat=77))
+    out = decode_packet(encode_packet(pkt))
+    assert isinstance(out.msg, Leave)
+    assert out.msg.node_id == N1
+    assert out.msg.reason == "deploy"
+    assert out.msg.heartbeat == 77
+    assert len(out.msg.delta.node_deltas) == len(make_delta().node_deltas)
+
+    bare = decode_packet(encode_packet(Packet("c", Leave(N1, Delta()))))
+    assert isinstance(bare.msg, Leave)
+    assert bare.msg.reason == "leave" and bare.msg.heartbeat == 0

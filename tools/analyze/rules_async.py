@@ -400,6 +400,77 @@ def check_fixed_sleep_retry(ctx: FileContext):
                 )
 
 
+@rule("ACT028", "non-atomic-state-write", "state file written in place without atomic replace")
+def check_non_atomic_state_write(ctx: FileContext):
+    """The durability layer's write discipline (runtime/persist.py,
+    docs/robustness.md): a state file opened ``"w"``/``"wb"`` on its
+    FINAL path is torn by any crash mid-write — the next boot reads
+    half a file where the tmp + fsync + ``os.replace`` idiom would have
+    left the previous complete version. Flags ``open(path, "w"|"wb")``
+    in the runtime/ or serve/ trees when (a) the path expression does
+    not name a temporary (no ``tmp`` in any name/attribute/string it is
+    built from — the ``path + ".tmp"`` idiom), and (b) no
+    ``os.replace``/``os.rename`` call appears in the same function
+    scope (which would promote the temp to final atomically). Append
+    mode is out of scope: logs are torn-tail-truncated at recovery, not
+    atomically replaced."""
+    if ctx.tree is None or not ({"runtime", "serve"} & ctx.domains):
+        return
+
+    def names_temp(expr: ast.expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if "tmp" in node.value.lower():
+                    return True
+            elif isinstance(node, ast.Name) and "tmp" in node.id.lower():
+                return True
+            elif isinstance(node, ast.Attribute) and "tmp" in node.attr.lower():
+                return True
+        return False
+
+    def write_mode(call: ast.Call) -> bool:
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+        return (
+            isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and mode.value in ("w", "wb")
+        )
+
+    scopes: list[list[ast.stmt]] = [ctx.tree.body]
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node.body)
+    for body in scopes:
+        opens: list[ast.Call] = []
+        has_replace = False
+        for node in walk_excluding_nested_functions(body):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func)
+            if target in ("os.replace", "os.rename"):
+                has_replace = True
+            elif target == "open" and write_mode(node):
+                if node.args and not names_temp(node.args[0]):
+                    opens.append(node)
+        if has_replace:
+            continue
+        for node in opens:
+            yield ctx.finding(
+                node,
+                "ACT028",
+                "state file opened 'w' on its final path with no "
+                "os.replace/os.rename in scope: a crash mid-write leaves "
+                "a torn file — write to a tmp sibling, fsync, then "
+                "os.replace (runtime/persist.py discipline)",
+            )
+
+
 @rule("ACT013", "swallowed-cancellation", "CancelledError caught without re-raise")
 def check_swallowed_cancel(ctx: FileContext):
     if ctx.tree is None:
