@@ -111,12 +111,24 @@ class SimMetrics:
         self._pallas_fallbacks = self.registry.gauge(
             "aiocluster_sim_pallas_fallbacks",
             "Traced configs that WANTED the Pallas kernels but degraded "
-            "to XLA, by reason — the PROCESS-WIDE trace-time ledger "
-            "(ops.gossip.pallas_fallbacks), mirrored at flush; "
-            "deliberately NOT engine-labelled, because the ledger spans "
-            "every engine/run in the process",
+            "to XLA, by reason — the DELTAS accrued on the trace-time "
+            "ledger (ops.gossip.pallas_fallbacks) since this sampler was "
+            "constructed, exported at flush; deltas rather than the raw "
+            "process-wide counts, so a scoped test "
+            "(pallas_fallbacks_scope) or an earlier run in the process "
+            "cannot masquerade as THIS run's degradation; deliberately "
+            "NOT engine-labelled",
             labels=("reason",),
         )
+        # Baseline for the delta export: the STABLE process-wide view
+        # (scope-parked counts included — gossip.pallas_fallbacks_total)
+        # of the ledger when this run's sampler came up; the raw
+        # counter would read zeroed inside a pallas_fallbacks_scope and
+        # the scope's exit would then masquerade ambient history as
+        # this run's degradation.
+        from ..ops.gossip import pallas_fallbacks_total
+
+        self._fallbacks_base: dict[str, int] = dict(pallas_fallbacks_total())
         self._pending: list[tuple[int, float, dict]] = []
         # Rounds run before the sampler existed (a resumed checkpoint's
         # tick) must not inflate the rounds counter at the first sample.
@@ -144,13 +156,21 @@ class SimMetrics:
     def _export_pallas_fallbacks(self) -> None:
         """Mirror the trace-time loud-fallback ledger into labeled
         gauges so kernel degradation shows up on /metrics, not only in
-        test assertions. The ledger is process-global (one count per
-        compiled config, whichever engine traced it), so the gauge
+        test assertions. Exports DELTAS of the stable scope-inclusive
+        view (gossip.pallas_fallbacks_total — invariant across
+        pallas_fallbacks_scope entry/exit, so neither a mid-scope flush
+        nor a sampler constructed inside a scope can misattribute
+        ambient history; max(0) is a belt against direct counter
+        surgery) against the construction-time snapshot — the gauge
+        answers "did THIS run degrade", not "did anything in the
+        process ever degrade". The ledger is process-global (one count
+        per compiled config, whichever engine traced it), so the gauge
         carries only the reason label."""
-        from ..ops.gossip import pallas_fallbacks
+        from ..ops.gossip import pallas_fallbacks_total
 
-        for reason, count in pallas_fallbacks.items():
-            self._pallas_fallbacks.labels(reason).set(count)
+        for reason, count in pallas_fallbacks_total().items():
+            delta = count - self._fallbacks_base.get(reason, 0)
+            self._pallas_fallbacks.labels(reason).set(max(delta, 0))
 
     def due(self, tick: int) -> bool:
         """Host-side stride gate: true when ``tick`` crossed into a new

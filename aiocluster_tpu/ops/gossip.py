@@ -38,6 +38,7 @@ shard_map; ``None`` runs the identical math on one device.
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 from functools import partial
 
@@ -531,6 +532,53 @@ def _fault_plan_active(cfg: SimConfig) -> bool:
 # is made.
 pallas_fallbacks: collections.Counter = collections.Counter()
 
+# Saved snapshots of scopes currently active (innermost last): the
+# counter itself holds only in-scope deltas while a scope is open, so
+# consumers that need the STABLE process-wide view (the obs delta
+# export — its baseline/flush pair must not jump when a scope exits
+# between them) read ``pallas_fallbacks_total`` instead of the raw
+# counter.
+_fallbacks_scope_stack: list[collections.Counter] = []
+
+
+def pallas_fallbacks_total() -> collections.Counter:
+    """The process-wide loud-fallback ledger INCLUDING any counts
+    temporarily parked by active ``pallas_fallbacks_scope``s — the view
+    that is invariant across scope entry/exit (inside a scope it equals
+    saved + in-scope deltas, which is exactly what the exit restores).
+    Telemetry baselines/exports read this; tests asserting deltas read
+    the scoped counter itself."""
+    total = collections.Counter(pallas_fallbacks)
+    for saved in _fallbacks_scope_stack:
+        total.update(saved)
+    return total
+
+
+@contextlib.contextmanager
+def pallas_fallbacks_scope():
+    """Scoped view of the loud-fallback ledger: on entry the ambient
+    counts are snapshotted and the counter zeroed, so reads INSIDE the
+    scope are exact deltas (``fb["packed_dtype"] == 1``, not
+    ``before + 1`` against whatever test ran earlier); on exit the
+    snapshot is restored WITH the in-scope counts folded back in, so
+    the process-wide ledger (and its /metrics mirror) sees every
+    fallback exactly once, scope or no scope. Counter-regression tests
+    (tests/test_fused_kernel.py, tests/test_memory_ladder.py) use this
+    instead of diffing ambient state, which bled across test ordering.
+
+    Yields the live Counter (the module global — sim_step keeps
+    bumping the same object inside the scope)."""
+    saved = collections.Counter(pallas_fallbacks)
+    pallas_fallbacks.clear()
+    _fallbacks_scope_stack.append(saved)
+    try:
+        yield pallas_fallbacks
+    finally:
+        _fallbacks_scope_stack.pop()
+        delta = collections.Counter(pallas_fallbacks)
+        pallas_fallbacks.clear()
+        pallas_fallbacks.update(saved + delta)
+
 
 def pallas_fallback_reason(
     cfg: SimConfig,
@@ -559,10 +607,15 @@ def pallas_fallback_reason(
         return "fault_plan"
     if cfg.pairing != "matching":
         return "pairing"
-    if cfg.version_dtype == "u4r":
-        # The kernels are unpacked-only: they DMA whole dtype lanes and
-        # widen transiently in VMEM, but carry no nibble codec. Packed
-        # rungs run the byte-space XLA path — loudly.
+    if cfg.version_dtype == "u4r" and (
+        cfg.track_heartbeats or cfg.pallas_variant == "m8"
+    ):
+        # The pairs kernel carries the u4 nibble codec for the LEAN
+        # (heartbeat-free) profile only, and the single-pass m8 kernel
+        # carries no codec at all: a heartbeat-tracking packed config
+        # or a pinned-m8 packed config runs the byte-space XLA path —
+        # loudly. (Packed widths off the kernel domain fall through to
+        # the vmem_or_width catch-all below, still counted.)
         return "packed_dtype"
     if cfg.fanout < 1:
         return "fanout"
@@ -636,10 +689,12 @@ def pallas_path_engaged(
         # behavior keeps the kernels — sim_step injects nothing then.
         and not _fault_plan_active(cfg)
         and cfg.pairing == "matching"
-        # Unpacked rungs only: the kernels widen dtype lanes in VMEM
-        # but carry no u4 nibble codec (pallas_fallback_reason
-        # "packed_dtype" keeps the degradation loud).
-        and cfg.version_dtype != "u4r"
+        # The packed u4 rung rides the pairs kernel's nibble codec —
+        # but only in the lean (heartbeat-free) profile: a packed w
+        # next to an unpacked hb would need two tile widths in one
+        # stream table, which no kernel carries (pallas_fallback_reason
+        # "packed_dtype" keeps that degradation loud).
+        and not (cfg.version_dtype == "u4r" and cfg.track_heartbeats)
         # fanout >= 1 so the round's first kernel call exists to carry
         # the owner-diagonal refresh (a fanout=0 round must still
         # refresh diagonals, which the XLA path does unconditionally).
@@ -658,6 +713,11 @@ def pallas_path_engaged(
     # rejected by the m8 block search.
     if pallas_variant_engaged(cfg, axis_name, n_local) == "pairs":
         return True  # pairs_supported held inside the variant decision
+    if cfg.version_dtype == "u4r":
+        # Only the pairs family carries the u4 nibble codec: a packed
+        # shape the pairs gate refuses (VMEM, a byte width off the
+        # 128-lane domain, a pinned m8 variant) has no m8 fallback.
+        return False
     if sweep:
         return False  # only the pairs family carries the lane axis
     itemsize = jnp.dtype(cfg.version_dtype).itemsize
@@ -727,42 +787,52 @@ def pallas_variant_engaged(
     if axis_name is not None and n_local is None:
         return "m8"  # sharded callers must say how wide a shard is
     width = n if axis_name is None else n_local
-    itemsize = jnp.dtype(cfg.version_dtype).itemsize
+    packed = cfg.version_dtype == "u4r"
+    itemsize = 1 if packed else jnp.dtype(cfg.version_dtype).itemsize
     if cfg.track_heartbeats:
         itemsize = max(itemsize, jnp.dtype(cfg.heartbeat_dtype).itemsize)
     # FD-fusing configs charge the epilogue's VMEM (last_change / imean
     # / icount / live tiles + the hb0 stream) in the pairs fit check:
     # the variant decision and the kernel that actually allocates must
     # read one accounting or a width could pass the gate and then fail
-    # pairs_nbuf inside the wrapper.
+    # pairs_nbuf inside the wrapper. The shrunk bookkeeping rungs
+    # charge their own widths (int8 counters, the 1-bit/pair bitmap).
     fd_sizes = (
         (
             jnp.dtype(cfg.heartbeat_dtype).itemsize,
             jnp.dtype(cfg.fd_dtype).itemsize,
+            jnp.dtype(cfg.icount_dtype).itemsize,
+            0.125 if cfg.live_bits else 4,
         )
         if _fd_fusion_candidate(cfg)
         else None
     )
     use_pairs = variant in ("auto", "pairs") and pallas_pull.pairs_supported(
-        n, itemsize, cfg.track_heartbeats, n_local=width, fd_sizes=fd_sizes
+        n, itemsize, cfg.track_heartbeats, n_local=width, fd_sizes=fd_sizes,
+        packed=packed,
     )
     return "pairs" if use_pairs else "m8"
 
 
 def _fd_bookkeeping_packed(cfg: SimConfig) -> bool:
-    """Whether the FD bookkeeping sits below what the kernels model
-    (int8 sample counters / the live bitmap) — THE single predicate
-    shared by the fusion-candidate VMEM charge, fd_phase_engaged's
-    dispatch, and the loud-fallback ledger, so the three can never
-    drift (they are one decision)."""
+    """Whether the FD bookkeeping sits below the r5 int16/bool profile
+    (int8 sample counters / the live bitmap). The FUSED pairs epilogue
+    models both shrunk forms natively (it widens per tile in VMEM and
+    writes the bitmap — ops/pallas_pull.py); only the STANDALONE
+    streaming kernel (ops/pallas_fd.py) remains unpacked-only, which is
+    what fd_phase_engaged and the loud-fallback ledger key off this
+    predicate for."""
     return cfg.icount_dtype != "int16" or cfg.live_bits
 
 
 def fd_fallback_reason(cfg: SimConfig) -> str | None:
     """Why a config that WANTED the FD kernels runs the FD phase on
-    XLA anyway — currently the one packed-bookkeeping cause — or None.
-    The FD-phase analogue of pallas_fallback_reason; sim_step feeds the
-    ``pallas_fallbacks`` ledger from this, never from a re-derived
+    XLA anyway — currently the one packed-bookkeeping cause (the
+    STANDALONE FD kernel carries no int8 counters / live bitmap; the
+    fused pairs epilogue does, so this fires only off the pairs path)
+    — or None. The FD-phase analogue of pallas_fallback_reason;
+    sim_step feeds the ``pallas_fallbacks`` ledger from this exactly
+    when fd_phase_engaged resolved "xla", never from a re-derived
     predicate."""
     if (
         cfg.track_failure_detector
@@ -779,13 +849,14 @@ def _fd_fusion_candidate(cfg: SimConfig) -> bool:
     """Whether a pairs-served round would carry the fused FD epilogue —
     the term the variant decision charges VMEM for. use_pallas_fd=False
     pins the FD phase to XLA (the A/B seam), so those configs don't pay
-    the epilogue's footprint; neither do the shrunk-bookkeeping rungs
-    the kernels don't model (_fd_bookkeeping_packed)."""
+    the epilogue's footprint. The shrunk bookkeeping rungs (int8
+    counters, the live bitmap) DO fuse — the epilogue widens per tile
+    in VMEM via the sanctioned nibble/bit algebra and their tile
+    widths are charged in the fit check."""
     return (
         cfg.track_failure_detector
         and not _lifecycle_enabled(cfg)
         and cfg.use_pallas_fd is not False
-        and not _fd_bookkeeping_packed(cfg)
     )
 
 
@@ -818,12 +889,6 @@ def fd_phase_engaged(
         return "off"
     if _lifecycle_enabled(cfg) or cfg.use_pallas_fd is False:
         return "xla"
-    if _fd_bookkeeping_packed(cfg):
-        # Shrunk bookkeeping rungs: neither the fused epilogue nor the
-        # standalone FD kernel models int8 counters / the live bitmap —
-        # the XLA block does (sim_step bumps the loud-fallback counter
-        # via fd_fallback_reason, the same predicate).
-        return "xla"
     if pallas_path_engaged(
         cfg,
         axis_name,
@@ -835,6 +900,13 @@ def fd_phase_engaged(
         return "fused"
     if sweep:
         return "xla"  # the standalone FD kernel has no lane axis
+    if _fd_bookkeeping_packed(cfg):
+        # Shrunk bookkeeping rungs off the pairs path: the STANDALONE
+        # FD kernel models neither int8 counters nor the live bitmap
+        # (only the fused pairs epilogue does) — the XLA block serves
+        # them (sim_step bumps the loud-fallback counter via
+        # fd_fallback_reason, the same predicate).
+        return "xla"
     from . import pallas_fd
 
     wanted = cfg.use_pallas_fd is True or _pallas_wanted(
@@ -1147,6 +1219,14 @@ def sim_step(
     if use_pallas:
         diag = None
         w, hb = state.w, state.hb_known
+        # Packed rung on the kernel path: the first sub-exchange's
+        # refresh operand is the per-owner WRITE BUMP — the kernel
+        # applies gossip._packed_writes_shift (saturating) and
+        # _packed_diag_zero on the nibbles in VMEM — instead of the
+        # unpacked rungs' max_version row.
+        kernel_refresh_vec = (
+            (max_version - state.max_version)[owners] if packed else mv_vec
+        )
     elif packed:
         diag = jnp.arange(n, dtype=jnp.int32)[:, None] == owners[None, :]
         w = state.w
@@ -1286,6 +1366,10 @@ def sim_step(
                 int(cfg.window_ticks),
                 float(cfg.prior_weight),
                 float(cfg.prior_mean_ticks),
+                # Shrunk-rung liveness: the epilogue writes the column
+                # BITMAP (sim/packed.pack_bits layout) straight from
+                # VMEM — the bool matrix never lands in HBM.
+                bool(cfg.live_bits),
             )
             if fd_phase == "fused"
             else None
@@ -1369,7 +1453,7 @@ def sim_step(
                             "owner_offset": owners[0],
                         }
                         if first:
-                            tops["mv"] = mv_vec
+                            tops["mv"] = kernel_refresh_vec
                         tot = pallas_pull.pairs_totals(
                             tops, interpret=interpret
                         )
@@ -1400,7 +1484,7 @@ def sim_step(
                     if track_hb:
                         ops["hb"] = hb
                     if first:
-                        ops["mv"] = mv_vec
+                        ops["mv"] = kernel_refresh_vec
                         if track_hb:
                             ops["hbv"] = hbv_vec
                     if tot is not None:

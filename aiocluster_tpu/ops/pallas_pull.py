@@ -36,6 +36,21 @@ enforces this). Both storage profiles qualify: with heartbeats the
 kernel fuses w and hb in one pass; the lean convergence-only profile
 (hb=None) runs the w-only variant with half the VMEM footprint.
 
+Packed rungs (PR 12): a uint8 w is the u4 residual rung
+(sim/packed.py, two saturating watermark residuals per byte) — the
+pairs family DMAs the PACKED rows, widens the two nibbles transiently
+in VMEM, runs the same budgeted advance in residual space
+(gossip._packed_adv_halves' arithmetic — one row total spans both
+halves, each half dithered against its true global owner id), applies
+the round-start refresh (saturating write-bump + diagonal zero) on the
+first sub-exchange's tiles, and repacks before the out DMA — with
+input_output_aliases on the packed buffers, so the wide matrix never
+exists in HBM. The fused FD epilogue likewise accepts the shrunk
+bookkeeping rungs: int8 icount widens per tile, and live_bits streams
+the column BITMAP straight from VMEM (the bool matrix is a kernel
+transient). tests/test_fused_kernel.py + tests/test_memory_ladder.py
+pin all of it bit-identical to the byte-space XLA path.
+
 Column sharding (the BASELINE config-5 north star): rows are unsharded,
 so each shard's peer DMA stays local to its (N, n_local) block; the one
 cross-shard quantity is each row's global deficit total. The sharded
@@ -145,6 +160,104 @@ def _advance(w_self32, w_peer32, valid_col, budget, r_k1, js, row0, totals=None)
     floor = jnp.floor(x)
     bump = _dither(r_k1, js, row0) < (x - floor)
     return jnp.minimum(floor.astype(jnp.int32) + bump, d)
+
+
+# -- packed u4 residual rung (version_dtype="u4r"): the VMEM nibble codec -----
+#
+# The packed rung stores two saturating watermark RESIDUALS per byte
+# (sim/packed.py); residual space is closed under the gossip math, so
+# the kernel DMAs the packed uint8 rows, widens the two nibbles
+# transiently in VMEM, runs the same budgeted-advance arithmetic as
+# gossip._packed_adv_halves on them, and repacks before the out DMA —
+# the wide matrix never exists in HBM (input_output_aliases keeps even
+# the packed copy single). Byte column j of a block whose first owner
+# is ``owner_off`` holds owners owner_off + 2j (low nibble) and
+# owner_off + 2j + 1 (high nibble), which is what the dither bases and
+# diagonal compares below key off.
+
+def _dither_base_packed(shape, salt, run_salt, col0):
+    """The packed analogue of ``_dither_base``: returns (r_k1, jm, sk)
+    where ``jm = j_lo * K2`` is keyed off the LOW-nibble owner ids
+    (col0 + 2j) and ``sk`` is the scalar salt mix. The two halves'
+    ``js`` inputs are derived per use — ``js_lo = jm ^ sk`` and
+    ``js_hi = (jm + K2) ^ sk`` (the high owner is j_lo + 1 and the
+    j-multiply distributes over +1 as one wrapping add) — so only two
+    (8, width) uint32 bases stay resident, same as the unpacked path."""
+    s = salt.astype(jnp.uint32) ^ run_salt.astype(jnp.uint32)
+    i = lax.broadcasted_iota(jnp.uint32, shape, 0)
+    j_lo = 2 * lax.broadcasted_iota(jnp.uint32, shape, 1) + col0.astype(
+        jnp.uint32
+    )
+    return (
+        i * jnp.uint32(0x9E3779B1),
+        j_lo * jnp.uint32(0x85EBCA77),
+        s * jnp.uint32(0xC2B2AE3D),
+    )
+
+
+def _advance_packed(
+    lo_self, hi_self, lo_peer, hi_peer, valid_col, budget,
+    r_k1, jm, sk, row0, totals=None,
+):
+    """gossip._packed_adv_halves in VMEM: ONE row total (and scale)
+    spans both nibble halves — f32 sums of integer deficits are exact
+    below 2^24, so summing the halves separately equals the unpacked
+    column-order total — then each half runs the dithered proportional
+    round against its own global-owner hash stream. Returns the
+    (a_lo, a_hi) int32 nibble advances (the receiver's residual
+    shrinks by them)."""
+    d_lo = jnp.maximum(lo_self - lo_peer, 0) * valid_col
+    d_hi = jnp.maximum(hi_self - hi_peer, 0) * valid_col
+    if totals is None:
+        total = jnp.sum(
+            d_lo.astype(jnp.float32), axis=1, keepdims=True
+        ) + jnp.sum(d_hi.astype(jnp.float32), axis=1, keepdims=True)
+    else:
+        total = totals
+    scale = jnp.minimum(1.0, budget / jnp.maximum(total, 1.0))
+
+    def half(d, js):
+        x = d.astype(jnp.float32) * scale
+        floor = jnp.floor(x)
+        bump = _dither(r_k1, js, row0) < (x - floor)
+        return jnp.minimum(floor.astype(jnp.int32) + bump, d)
+
+    return half(d_lo, jm ^ sk), half(d_hi, (jm + jnp.uint32(0x85EBCA77)) ^ sk)
+
+
+def _unpack_tile(t32):
+    """(8, width) widened uint8 tile -> (lo, hi) int32 nibble halves.
+    A VMEM-transient decode (the kernel repacks before the out DMA) —
+    NOT the sanctioned HBM widen (that is sim/packed.unpack_u4)."""
+    return t32 & 0xF, t32 >> 4
+
+
+def _pack_bump_nibbles(bump):
+    """(…, n_local) int32 per-owner write bump -> (…, n_local // 2)
+    packed nibble row, THE one packing both the apply pass and the
+    sharded totals pass feed their ``mv`` operand through (they must
+    see identical refreshed tiles or the psum'd budgets diverge from
+    single-device runs). Each half clips to [0, 15], which preserves
+    the kernel's saturating min(r + bump, 15) exactly: r >= 0, so any
+    bump >= 15 saturates either way."""
+    bq = jnp.clip(bump, 0, 15).astype(jnp.int32)
+    return bq[..., 0::2] | (bq[..., 1::2] << 4)
+
+
+def _refresh_packed(lo, hi, bump_ref, col_lo, rows, r8):
+    """The packed round-start refresh on one side's nibble halves:
+    owner writes raise every observer's residual (saturating at the
+    nibble ceiling — gossip._packed_writes_shift), then the owner
+    diagonal resets to 0 (gossip._packed_diag_zero). ``bump_ref`` is
+    the (1, width) per-owner write bump packed as nibbles (each half
+    pre-clipped to [0, 15], which preserves the saturating min)."""
+    b = bump_ref[:]
+    lo = jnp.minimum(lo + (b & 0xF), 15)
+    hi = jnp.minimum(hi + (b >> 4), 15)
+    self_rows = rows + r8
+    lo = jnp.where(col_lo == self_rows, 0, lo)
+    hi = jnp.where(col_lo + 1 == self_rows, 0, hi)
+    return lo, hi
 
 
 def _m8_kernel(
@@ -386,6 +499,8 @@ def _pairs_kernel(
     fd: bool,
     fd_hb0: bool,
     fd_consts: tuple | None,
+    packed: bool = False,
+    fd_live_bits: bool = False,
 ):
     """Both sides of every matched group pair in ONE visit (the
     pair-fused pull). The matching is an involution, so the single-pass
@@ -474,8 +589,19 @@ def _pairs_kernel(
     budget = at(meta_ref, 2).astype(jnp.float32)
     count = at(meta_ref, 3)
     owner_off = at(meta_ref, 4)
-    r_k1, js = _dither_base((8, n), salt, run_salt, owner_off)
-    col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
+    if packed:
+        # u4 residual rung: ``n`` is the BYTE width (two owners per
+        # column); ``col`` carries the LOW-nibble owner ids (the high
+        # owner is col + 1) and the dither bases key both halves off
+        # their true global owners.
+        r_k1, jm_p, sk_p = _dither_base_packed(
+            (8, n), salt, run_salt, owner_off
+        )
+        js = None
+        col = 2 * lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
+    else:
+        r_k1, js = _dither_base((8, n), salt, run_salt, owner_off)
+        col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
     r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
     # The per-row alive-pair mask arrives as one PACKED int32 per group
     # (bit r = row 8g+r): a (n, 1) VMEM column would lane-pad to 128
@@ -605,7 +731,24 @@ def _pairs_kernel(
             lcin[sl, :] = lc2.astype(lcin.dtype)
             imin[sl, :] = jnp.where(live, imean, 0.0).astype(imin.dtype)
             icin[sl, :] = jnp.where(live, icount, 0).astype(icin.dtype)
-            livescr[sl, :] = live
+            if fd_live_bits:
+                # Bit-packed liveness (the shrunk-FD rung): the bool
+                # tile is a VMEM transient; only the column bitmap
+                # (sim/packed.pack_bits layout — column j in bit j % 8
+                # of byte j // 8) streams out. NOTE for the tunnel
+                # window: the bitmap rows are n/8 bytes wide — at
+                # narrow shards the out copy is a partial 128-lane
+                # tile, to be verified on chip like the odd-multiple
+                # int16 copies were (certification owed either way).
+                lw = live.astype(jnp.int32).reshape(8, n // 8, 8)
+                weights = 1 << lax.broadcasted_iota(
+                    jnp.int32, (8, n // 8, 8), 2
+                )
+                livescr[sl, :] = jnp.sum(lw * weights, axis=2).astype(
+                    livescr.dtype
+                )
+            else:
+                livescr[sl, :] = live
 
     def body(s, _):
         base = (s % nbuf) * 16
@@ -629,24 +772,76 @@ def _pairs_kernel(
         ch = at(c_ref, h)
         vg = vmask(g)
         vh = vmask(h)
-        w_g = win[pl.ds(base, 8), :].astype(jnp.int32)
-        w_h = win[pl.ds(base + 8, 8), :].astype(jnp.int32)
-        if apply_diag:
-            mv_b = mv_ref[:]
-            w_g = jnp.where(col == 8 * g + r8, mv_b, w_g)
-            w_h = jnp.where(col == 8 * h + r8, mv_b, w_h)
         tg = tscr[pl.ds(base, 8), :] if use_totals else None
         th = tscr[pl.ds(base + 8, 8), :] if use_totals else None
-        adv_g = _advance(
-            w_g, pltpu.roll(w_h, cg, 0), vg, budget, r_k1, js, 8 * g, tg
-        )
-        adv_h = _advance(
-            w_h, pltpu.roll(w_g, ch, 0), vh, budget, r_k1, js, 8 * h, th
-        )
-        # w_g/w_h are loaded VALUES; overwriting their tiles is safe.
-        win[pl.ds(base, 8), :] = (w_g + adv_g).astype(win.dtype)
-        win[pl.ds(base + 8, 8), :] = (w_h + adv_h).astype(win.dtype)
-        if check:
+        if packed:
+            # u4 residual rung: widen the nibbles transiently, run the
+            # same budgeted advance in residual space (the deficit of a
+            # pull is max(r_self - r_peer, 0); an advance SHRINKS the
+            # receiver's residual), repack before the out DMA. The
+            # round-start refresh (owner-write shift + diagonal zero)
+            # rides the first sub-exchange via the packed bump row.
+            lo_g, hi_g = _unpack_tile(win[pl.ds(base, 8), :].astype(jnp.int32))
+            lo_h, hi_h = _unpack_tile(
+                win[pl.ds(base + 8, 8), :].astype(jnp.int32)
+            )
+            if apply_diag:
+                lo_g, hi_g = _refresh_packed(lo_g, hi_g, mv_ref, col, 8 * g, r8)
+                lo_h, hi_h = _refresh_packed(lo_h, hi_h, mv_ref, col, 8 * h, r8)
+            a_lo_g, a_hi_g = _advance_packed(
+                lo_g, hi_g, pltpu.roll(lo_h, cg, 0), pltpu.roll(hi_h, cg, 0),
+                vg, budget, r_k1, jm_p, sk_p, 8 * g, tg,
+            )
+            a_lo_h, a_hi_h = _advance_packed(
+                lo_h, hi_h, pltpu.roll(lo_g, ch, 0), pltpu.roll(hi_g, ch, 0),
+                vh, budget, r_k1, jm_p, sk_p, 8 * h, th,
+            )
+            new_lo_g, new_hi_g = lo_g - a_lo_g, hi_g - a_hi_g
+            new_lo_h, new_hi_h = lo_h - a_lo_h, hi_h - a_hi_h
+            win[pl.ds(base, 8), :] = (new_lo_g | (new_hi_g << 4)).astype(
+                win.dtype
+            )
+            win[pl.ds(base + 8, 8), :] = (new_lo_h | (new_hi_h << 4)).astype(
+                win.dtype
+            )
+            if check:
+                # Packed convergence: a zero residual IS "caught up"
+                # (all_converged_flag's byte-space arm); dead owners
+                # are excused by a zeroed need nibble, dead rows by the
+                # alive bits.
+                need = need_ref[:]
+                na_lo, na_hi = need & 0xF, need >> 4
+                ag = (at(ab_ref, g) >> sub8) & 1
+                ah = (at(ab_ref, h) >> sub8) & 1
+                row_ok_g = ((new_lo_g == 0) | (na_lo == 0)) & (
+                    (new_hi_g == 0) | (na_hi == 0)
+                )
+                row_ok_h = ((new_lo_h == 0) | (na_lo == 0)) & (
+                    (new_hi_h == 0) | (na_hi == 0)
+                )
+                ok_g = jnp.all(row_ok_g | (ag == 0))
+                ok_h = jnp.all(row_ok_h | (ah == 0))
+                ok_h = jnp.where(g == h, True, ok_h)
+                fscr[0, 0] = fscr[0, 0] * ok_g.astype(jnp.int32) * ok_h.astype(
+                    jnp.int32
+                )
+        else:
+            w_g = win[pl.ds(base, 8), :].astype(jnp.int32)
+            w_h = win[pl.ds(base + 8, 8), :].astype(jnp.int32)
+            if apply_diag:
+                mv_b = mv_ref[:]
+                w_g = jnp.where(col == 8 * g + r8, mv_b, w_g)
+                w_h = jnp.where(col == 8 * h + r8, mv_b, w_h)
+            adv_g = _advance(
+                w_g, pltpu.roll(w_h, cg, 0), vg, budget, r_k1, js, 8 * g, tg
+            )
+            adv_h = _advance(
+                w_h, pltpu.roll(w_g, ch, 0), vh, budget, r_k1, js, 8 * h, th
+            )
+            # w_g/w_h are loaded VALUES; overwriting their tiles is safe.
+            win[pl.ds(base, 8), :] = (w_g + adv_g).astype(win.dtype)
+            win[pl.ds(base + 8, 8), :] = (w_h + adv_h).astype(win.dtype)
+        if check and not packed:
             # Convergence on the freshly-computed output tiles (int32,
             # pre-cast — same values): a row passes where it has caught
             # up to the owner's target or the row is dead; dead OWNERS
@@ -723,6 +918,7 @@ def _pairs_totals_kernel(
     n: int,
     apply_diag: bool,
     lanes: bool = False,
+    packed: bool = False,
 ):
     """Pass A of the sharded pair-fused pull: LOCAL deficit row totals
     for this shard's (N, n_local) block, visiting each matched group
@@ -730,7 +926,10 @@ def _pairs_totals_kernel(
     twice: streamed as self, gathered as its partner's peer). The
     caller psums the (N,) result across shards and feeds it to
     fused_pull_pairs as ``totals``. ``lanes`` lifts the grid over the
-    sweep's leading S dimension exactly as in _pairs_kernel."""
+    sweep's leading S dimension exactly as in _pairs_kernel. ``packed``
+    runs the u4 residual decode: one row total spans both nibble
+    halves (mv_ref then carries the packed write-bump row, exactly as
+    the apply pass will see it)."""
     lane = pl.program_id(0) if lanes else None
 
     def at(ref, i):
@@ -740,7 +939,10 @@ def _pairs_totals_kernel(
     tot_dst = tot_hbm.at[lane] if lanes else tot_hbm
     count = at(meta_ref, 0)
     owner_off = at(meta_ref, 1)
-    col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
+    if packed:
+        col = 2 * lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
+    else:
+        col = lax.broadcasted_iota(jnp.int32, (8, n), 1) + owner_off
     r8 = lax.broadcasted_iota(jnp.int32, (8, n), 0)
     sub8 = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
 
@@ -803,6 +1005,27 @@ def _pairs_totals_kernel(
         h = at(gm_ref, g)
         cg = at(c_ref, g)
         ch = at(c_ref, h)
+        if packed:
+            lo_g, hi_g = _unpack_tile(win[pl.ds(base, 8), :].astype(jnp.int32))
+            lo_h, hi_h = _unpack_tile(
+                win[pl.ds(base + 8, 8), :].astype(jnp.int32)
+            )
+            if apply_diag:
+                lo_g, hi_g = _refresh_packed(lo_g, hi_g, mv_ref, col, 8 * g, r8)
+                lo_h, hi_h = _refresh_packed(lo_h, hi_h, mv_ref, col, 8 * h, r8)
+            vg, vh = vmask(g), vmask(h)
+            d_lo_g = jnp.maximum(lo_g - pltpu.roll(lo_h, cg, 0), 0) * vg
+            d_hi_g = jnp.maximum(hi_g - pltpu.roll(hi_h, cg, 0), 0) * vg
+            d_lo_h = jnp.maximum(lo_h - pltpu.roll(lo_g, ch, 0), 0) * vh
+            d_hi_h = jnp.maximum(hi_h - pltpu.roll(hi_g, ch, 0), 0) * vh
+            tout[pl.ds(base, 8), :] = jnp.sum(
+                d_lo_g.astype(jnp.float32), axis=1, keepdims=True
+            ) + jnp.sum(d_hi_g.astype(jnp.float32), axis=1, keepdims=True)
+            tout[pl.ds(base + 8, 8), :] = jnp.sum(
+                d_lo_h.astype(jnp.float32), axis=1, keepdims=True
+            ) + jnp.sum(d_hi_h.astype(jnp.float32), axis=1, keepdims=True)
+            start_out(s)
+            return 0
         w_g = win[pl.ds(base, 8), :].astype(jnp.int32)
         w_h = win[pl.ds(base + 8, 8), :].astype(jnp.int32)
         if apply_diag:
@@ -1063,7 +1286,8 @@ def pairs_nbuf(
     itemsize: int,
     track_hb: bool = True,
     n_local: int | None = None,
-    fd_sizes: tuple[int, int] | None = None,
+    fd_sizes: tuple | None = None,
+    packed: bool = False,
 ) -> int | None:
     """Scratch-buffer rotation depth for the pair-fused kernel at this
     shape, or None when it cannot run. 3 whenever VMEM allows — each
@@ -1081,27 +1305,63 @@ def pairs_nbuf(
     never admits a shape whose tracked instance exceeds VMEM. The
     sharded form adds only the tiny (16*nbuf, 1) totals scratch.
 
-    ``fd_sizes`` = (heartbeat itemsize, fd-mean itemsize) when the
-    round's last sub-exchange carries the fused FD epilogue: it adds
-    tile pairs for last_change, imean, icount (int16), the live matrix
-    (bool, held as 4 B/elem in VMEM — see pallas_fd._per_row_bytes)
-    and the streamed round-start hb0 (charged unconditionally — only
-    fanout > 1 streams it, but the gate must never admit a shape whose
-    multi-sub-exchange instance exceeds VMEM)."""
+    ``fd_sizes`` = (heartbeat itemsize, fd-mean itemsize[, icount
+    itemsize, live bytes/elem]) when the round's last sub-exchange
+    carries the fused FD epilogue: it adds tile pairs for last_change,
+    imean, icount, the live matrix (bool held as 4 B/elem in VMEM —
+    see pallas_fd._per_row_bytes — or the 0.125 B/elem bitmap when the
+    shrunk rung packs it) and the streamed round-start hb0 (charged
+    unconditionally — only fanout > 1 streams it, but the gate must
+    never admit a shape whose multi-sub-exchange instance exceeds
+    VMEM). The legacy 2-tuple reads as the int16/bool bookkeeping.
+
+    ``packed`` is the u4 residual rung (uint8 nibble pairs): the w
+    tiles shrink to the byte width (n_local // 2 columns, lane-aligned
+    — n_local % 256), the dither bases halve with them, and the
+    resident rows are the packed write-bump + packed need nibbles.
+    Lean-profile only (the packed kernel carries no hb/FD tiles)."""
     width = n if n_local is None else n_local
+    if packed:
+        if track_hb or fd_sizes is not None:
+            return None  # the nibble codec serves the lean profile only
+        if n % 128 != 0 or width % 256 != 0:
+            return None  # byte columns must stay 128-lane aligned
+        bw = width // 2
+        bases = 2 * 8 * bw * 4  # r_k1 + jm (js derived per use)
+        vecs = 2 * 8 * bw * 4  # packed bump row + packed need row
+        for nbuf in (3, 2):
+            tiles = 16 * nbuf * bw * 1  # uint8 tile pairs, in-place out
+            if tiles + bases + vecs <= VMEM_BUDGET:
+                return nbuf
+        return None
     if n % 128 != 0 or width % 128 != 0:
         return None
     bases = 2 * 8 * width * 4
     vecs = ((2 if track_hb else 1) + 1) * 8 * width * 4
+    if fd_sizes is not None:
+        hb_sz, fd_sz, ic_sz, live_sz = _norm_fd_sizes(fd_sizes)
+        # live scratch: lane-padded rows (the packed bitmap's n/8
+        # bytes can sit under one 128-lane tile at narrow shards).
+        live_row = max(int(width * live_sz), 128)
     for nbuf in (3, 2):
         per_tile = 16 * nbuf * width
         tiles = (2 if track_hb else 1) * per_tile * itemsize
         if fd_sizes is not None:
-            hb_sz, fd_sz = fd_sizes
-            tiles += per_tile * (hb_sz + fd_sz + 2 + 4 + hb_sz)
+            tiles += per_tile * (hb_sz + fd_sz + ic_sz + hb_sz)
+            tiles += 16 * nbuf * live_row
         if tiles + bases + vecs <= VMEM_BUDGET:
             return nbuf
     return None
+
+
+def _norm_fd_sizes(fd_sizes: tuple) -> tuple[int, int, int, float]:
+    """(hb, fd[, icount, live]) -> the full 4-tuple; the legacy 2-tuple
+    reads as the int16 counter + bool live accounting it was minted
+    for."""
+    if len(fd_sizes) == 2:
+        return (*fd_sizes, 2, 4.0)
+    hb_sz, fd_sz, ic_sz, live_sz = fd_sizes
+    return hb_sz, fd_sz, ic_sz, float(live_sz)
 
 
 def pairs_supported(
@@ -1109,28 +1369,36 @@ def pairs_supported(
     itemsize: int,
     track_hb: bool = True,
     n_local: int | None = None,
-    fd_sizes: tuple[int, int] | None = None,
+    fd_sizes: tuple | None = None,
+    packed: bool = False,
 ) -> bool:
     """Whether the pair-fused kernel can run this shape (see
     pairs_nbuf for the accounting)."""
-    return pairs_nbuf(n, itemsize, track_hb, n_local, fd_sizes) is not None
+    return (
+        pairs_nbuf(n, itemsize, track_hb, n_local, fd_sizes, packed)
+        is not None
+    )
 
 
 def pairs_supported_for(
     n: int,
     w: jax.Array,
     hb: jax.Array | None,
-    fd_sizes: tuple[int, int] | None = None,
+    fd_sizes: tuple | None = None,
 ) -> bool:
-    """pairs_supported with itemsize and local width derived from the
-    operands — the one eligibility rule shared by the sim_step dispatch
-    and the fused_pull_pairs wrapper."""
+    """pairs_supported with itemsize, packing and local width derived
+    from the operands — the one eligibility rule shared by the sim_step
+    dispatch and the fused_pull_pairs wrapper. A uint8 w IS the packed
+    u4 residual rung (sim/packed.is_packed_w): its stored width is the
+    byte width, so the logical local column count is doubled."""
+    packed = w.dtype == jnp.uint8
     itemsize = w.dtype.itemsize
     if hb is not None:
         itemsize = max(itemsize, hb.dtype.itemsize)
+    width = w.shape[-1] * 2 if packed else w.shape[-1]
     return pairs_supported(
-        n, itemsize, track_hb=hb is not None, n_local=w.shape[-1],
-        fd_sizes=fd_sizes,
+        n, itemsize, track_hb=hb is not None, n_local=width,
+        fd_sizes=fd_sizes, packed=packed,
     )
 
 
@@ -1164,12 +1432,23 @@ def _pairs_call(
     use_totals = totals is not None
     do_check = check is not None
     do_fd = fd is not None
+    # A uint8 w IS the packed u4 residual rung (sim/packed.is_packed_w):
+    # tiles stay byte-packed in VMEM, the compute widens the nibbles
+    # transiently, and ``mv`` carries the per-owner WRITE BUMP (the
+    # packed round-start refresh: saturating shift + diagonal zero)
+    # instead of the owner max_version row.
+    packed = w.dtype == jnp.uint8
+    if packed and (track_hb or do_fd):
+        raise ValueError(
+            "packed u4 w is lean-only in the pairs kernel (no hb/FD tiles)"
+        )
     if apply_diag and track_hb and hbv is None:
         raise ValueError("hbv required when mv is given and hb is tracked")
     if hbv is not None and not track_hb:
         raise ValueError("hbv given but no hb matrix to refresh (lean mode)")
     if hbv is not None and mv is None and not do_fd:
         raise ValueError("hbv given without mv: the diagonal refresh is all-or-none")
+    fd_live_bits = False
     if do_fd:
         if not track_hb:
             raise ValueError("fused FD requires the heartbeat matrix")
@@ -1179,6 +1458,10 @@ def _pairs_call(
             raise ValueError("fused FD requires fd_params statics")
         fd_tick, fd_lc, fd_im, fd_ic, fd_hb0_mat, fd_phi = fd
         fd_hb0 = fd_hb0_mat is not None
+        # The 5th fd_params slot (when present) says the live matrix
+        # stores as the column bitmap (SimConfig.live_bits); the legacy
+        # 4-tuple reads as the bool form.
+        fd_live_bits = len(fd_params) > 4 and bool(fd_params[4])
     else:
         fd_hb0 = False
     if lanes:
@@ -1189,9 +1472,20 @@ def _pairs_call(
     if track_hb:
         itemsize = max(itemsize, hb.dtype.itemsize)
     fd_sizes = (
-        (fd_lc.dtype.itemsize, fd_im.dtype.itemsize) if do_fd else None
+        (
+            fd_lc.dtype.itemsize,
+            fd_im.dtype.itemsize,
+            fd_ic.dtype.itemsize,
+            0.125 if fd_live_bits else 4,
+        )
+        if do_fd
+        else None
     )
-    nbuf = pairs_nbuf(n, itemsize, track_hb, n_local=n_cols, fd_sizes=fd_sizes)
+    nbuf = pairs_nbuf(
+        n, itemsize, track_hb,
+        n_local=n_cols * 2 if packed else n_cols,
+        fd_sizes=fd_sizes, packed=packed,
+    )
     if nbuf is None:
         raise ValueError(f"pair-fused kernel cannot run shape {w.shape}")
     gm = gm.astype(jnp.int32)
@@ -1262,10 +1556,20 @@ def _pairs_call(
             if lanes
             else _pack_row_bits(alive, n)
         )
-        # Dead owners are excused by zeroing their target: watermarks
-        # are non-negative, so w >= 0 holds everywhere — one broadcast
-        # row instead of a separate alive-owner mask row.
-        need = row_operand(jnp.where(alive_owner, needed.astype(jnp.int32), 0))
+        if packed:
+            # Packed convergence target: a zero residual IS "caught
+            # up", so the need row carries only the owner-ALIVE bit per
+            # nibble (0 excuses a dead owner); ``needed`` is unused.
+            ao = alive_owner.astype(jnp.int32)
+            need = row_operand(ao[..., 0::2] | (ao[..., 1::2] << 4))
+        else:
+            # Dead owners are excused by zeroing their target:
+            # watermarks are non-negative, so w >= 0 holds everywhere —
+            # one broadcast row instead of a separate alive-owner mask
+            # row.
+            need = row_operand(
+                jnp.where(alive_owner, needed.astype(jnp.int32), 0)
+            )
         need_spec = row_spec(n_cols)
     else:
         abits = jnp.zeros(
@@ -1275,6 +1579,8 @@ def _pairs_call(
         need_spec = dummy_spec
     use_hbv = (apply_diag and track_hb) or do_fd
     if apply_diag:
+        if packed:
+            mv = _pack_bump_nibbles(mv)  # the write-bump row
         mv = row_operand(mv)
         vec_spec = row_spec(n_cols)
     else:
@@ -1323,12 +1629,14 @@ def _pairs_call(
     ]
     if do_fd:
         out_specs += [any_spec] * 4
+        live_cols = n_cols // 8 if fd_live_bits else n_cols
+        live_dt = jnp.uint8 if fd_live_bits else jnp.bool_
         out_shapes += [
             jax.ShapeDtypeStruct(fd_lc.shape, fd_lc.dtype),
             jax.ShapeDtypeStruct(fd_im.shape, fd_im.dtype),
             jax.ShapeDtypeStruct(fd_ic.shape, fd_ic.dtype),
             jax.ShapeDtypeStruct(
-                (n_lanes, n, n_cols) if lanes else (n, n_cols), jnp.bool_
+                (n_lanes, n, live_cols) if lanes else (n, live_cols), live_dt
             ),
         ]
     n_in_streams = 1 + int(track_hb) + int(use_totals) + (
@@ -1347,7 +1655,7 @@ def _pairs_call(
             pltpu.VMEM((16 * nbuf, n_cols), fd_lc.dtype),  # lcin
             pltpu.VMEM((16 * nbuf, n_cols), fd_im.dtype),  # imin
             pltpu.VMEM((16 * nbuf, n_cols), fd_ic.dtype),  # icin
-            pltpu.VMEM((16 * nbuf, n_cols), jnp.bool_),  # livescr
+            pltpu.VMEM((16 * nbuf, live_cols), live_dt),  # livescr
         ]
         if fd_hb0:
             scratch.append(pltpu.VMEM((16 * nbuf, n_cols), hb.dtype))
@@ -1373,7 +1681,9 @@ def _pairs_call(
         lanes=lanes,
         fd=do_fd,
         fd_hb0=fd_hb0,
-        fd_consts=fd_params,
+        fd_consts=fd_params[:4] if fd_params is not None else None,
+        packed=packed,
+        fd_live_bits=fd_live_bits,
     )
     # w (and usually hb) update IN PLACE: every row is read exactly
     # once (wait_in of its own slot) strictly before its out DMA
@@ -1550,6 +1860,7 @@ def _pairs_slots(n: int, gm: jax.Array, valid: jax.Array):
 
 def _pairs_totals_call(w, gm, c, valid, interpret, mv, owner_offset, lanes):
     apply_diag = mv is not None
+    packed = w.dtype == jnp.uint8
     if lanes:
         n_lanes, n, n_cols = w.shape
     else:
@@ -1557,6 +1868,10 @@ def _pairs_totals_call(w, gm, c, valid, interpret, mv, owner_offset, lanes):
     if not pairs_supported_for(n, w, None):
         raise ValueError(f"pair-fused totals cannot run shape {w.shape}")
     gm = gm.astype(jnp.int32)
+    if apply_diag and packed:
+        # Packed rung: mv is the per-owner write bump — one shared
+        # packing with the apply pass (_pack_bump_nibbles).
+        mv = _pack_bump_nibbles(mv)
     if lanes:
         leaders, count, vbits = jax.vmap(
             lambda g, v: _pairs_slots(n, g, v)
@@ -1595,7 +1910,8 @@ def _pairs_totals_call(w, gm, c, valid, interpret, mv, owner_offset, lanes):
         ],
     )
     kernel = functools.partial(
-        _pairs_totals_kernel, n=n_cols, apply_diag=apply_diag, lanes=lanes
+        _pairs_totals_kernel, n=n_cols, apply_diag=apply_diag, lanes=lanes,
+        packed=packed,
     )
     (tot,) = pl.pallas_call(
         kernel,

@@ -155,6 +155,13 @@ def per_round_bytes(
     m_w = n2 * W_BYTES[cfg.version_dtype]
     m_hb = n2 * HB_BYTES[cfg.heartbeat_dtype] if cfg.track_heartbeats else 0
     total = cfg.fanout * _PULL_PASSES[variant] * (m_w + m_hb)
+    if cfg.version_dtype == "u4r" and variant != "pairs":
+        # The packed-KERNEL arm folds the round-start refresh (writes
+        # shift + diagonal zero) into the first sub-exchange's tiles;
+        # the byte-space XLA arm materializes the refreshed packed
+        # matrix before the first gather — one extra read + write of
+        # the packed width per round.
+        total += 2 * m_w
     if cfg.track_failure_detector:
         m_fd = n2 * FD_BYTES[cfg.fd_dtype]
         m_lc = m_hb  # last_change is heartbeat-dtype

@@ -119,8 +119,12 @@ class SimConfig:
     #   total versions per owner <= 15. Packed-rung restrictions
     #   (validated below): matching/permutation pairing only (the
     #   choice path's scatter-max has no byte-space form), proportional
-    #   budget, no dead-node lifecycle, even n_nodes. The Pallas
-    #   kernels are unpacked-only — u4r runs the XLA path, loudly
+    #   budget, no dead-node lifecycle, even n_nodes. On its lean
+    #   (heartbeat-free) matching domain the rung rides the pairs
+    #   kernel's VMEM nibble codec (ops/pallas_pull.py — DMA the packed
+    #   bytes, widen/advance/saturate/repack in VMEM, in place); off
+    #   that domain (heartbeats tracked, a pinned m8 variant, widths
+    #   off the 256-alignment) it runs byte-space XLA, loudly
     #   (ops/gossip.pallas_fallbacks reason "packed_dtype").
     version_dtype: str = "int32"
     heartbeat_dtype: str = "int32"
@@ -135,10 +139,13 @@ class SimConfig:
     # 9.125 B/pair): "int8" icount needs window_ticks + 1 < 128 (the
     # kernel-order increment-then-clamp contract below); live_bits
     # packs live_view as a column bitmap (1 bit/pair; n_nodes % 8 == 0,
-    # not peer_mode="view" — the view draw reads bool rows). Shrunk
-    # bookkeeping is unpacked-only for the FD kernels: those configs
-    # run the FD phase on XLA (loudly — pallas_fallbacks reason
-    # "fd_packed_bookkeeping") while the pull kernels stay engaged.
+    # not peer_mode="view" — the view draw reads bool rows). The FUSED
+    # pairs epilogue models both shrunk forms natively (int8 counters
+    # widen per tile in VMEM, the live bitmap is written straight from
+    # the kernel); only the STANDALONE FD kernel (non-pairs pull paths)
+    # remains unpacked-only — those configs run the FD phase on XLA,
+    # loudly (pallas_fallbacks reason "fd_packed_bookkeeping") while
+    # the pull kernels stay engaged.
     icount_dtype: str = "int16"
     live_bits: bool = False
 

@@ -92,11 +92,15 @@ def plan(
     Per-pair resident bytes come from ONE table
     (sim.bytes.state_bytes_per_pair — the memory ladder), so every rung
     including the packed forms is planned from the same accounting the
-    docs publish. Transients are rung-aware too: the packed u4 path
-    gathers PACKED peer rows and computes on the nibbles inside the
-    fusion (ops/gossip.py), so its gather transient is the packed width,
-    and FD configs off the fused path additionally retain the
-    round-start heartbeat matrix (hb0) for the phi phase."""
+    docs publish. Transients are rung-aware too: the packed u4 path's
+    XLA arm gathers PACKED peer rows and computes on the nibbles inside
+    the fusion (ops/gossip.py), so its gather transient is the packed
+    width; kernel-served packed rungs (the pairs kernel's VMEM nibble
+    codec) earn the same ZERO-gather in-place discount as the unpacked
+    rungs — that discount is what lifts the lean u4r single-chip
+    ceiling past the old 117k XLA-transient model; and FD configs off
+    the fused path additionally retain the round-start heartbeat
+    matrix (hb0) for the phi phase."""
     from .bytes import HB_BYTES, W_BYTES, state_bytes_per_pair
 
     if lanes < 1:
@@ -446,13 +450,20 @@ def max_scale_model(
     (the round-3 honesty discipline: the model has been wrong before;
     fits_verdict consults measured evidence first).
 
-    Alignment: 128 x shards, so every shard's column block stays
-    lane-aligned (the fused kernels' domain and the measured-fastest
-    XLA shape)."""
+    Alignment: 128 x shards (256 x shards for the packed u4r rung,
+    whose BYTE width must stay 128-lane aligned per shard), so every
+    shard's column block stays on the fused kernels' domain and the
+    measured-fastest XLA shape."""
     from .bytes import state_bytes_per_pair
 
     builder = {"lean": lean_config, "full": full_config}[profile]
-    step = 128 * shards
+    # The packed rung's kernel domain needs every shard's BYTE width
+    # lane-aligned (n_local % 256 — two owners per byte), so its ladder
+    # walks 256-aligned shapes; off-alignment steps would flap between
+    # the in-place kernel plan and the XLA gather plan and break the
+    # search's monotonicity.
+    align = 256 if rung == "u4r" else 128
+    step = align * shards
     lo, hi = step, step * 20_000  # 2.56M at 1 shard — beyond any model
     while lo + step <= hi:
         mid = ((lo + hi) // 2) // step * step
@@ -475,6 +486,33 @@ def max_scale_model(
         "per_shard_bytes": p.per_shard_bytes,
         "variant": engaged_variant(builder(lo, rung=rung), shards),
         "certified": False,  # analytic model, not a chip measurement
+    }
+
+
+def packed_kernel_engagement(n_nodes: int = 12_800) -> dict:
+    """Whether each PACKED ladder rung rides the in-place Pallas path
+    at a representative planning shape (12,800 — 256-aligned, inside
+    every rung's kernel domain, and exactly the per-shard width of the
+    102,400-node deep-rung target on a v5e-8): the u4r lean rung
+    through the pairs kernel's VMEM nibble codec, the shrunk/deep
+    full-FD rungs through the fused FD epilogue's packed bookkeeping. Resolved through the
+    SAME dispatch sim_step uses (assume-accelerator, env override
+    folded in), stamped into every BENCH record as
+    ``packed_kernel_engaged`` — so a dispatch regression that silently
+    returns a packed rung to the XLA gather path shows up in the
+    record diff, not in a tunnel-window surprise."""
+    from ..ops.gossip import fd_phase_engaged, resolve_variant_env
+
+    def fd_fused(cfg) -> bool:
+        cfg = resolve_variant_env(cfg)
+        return (
+            fd_phase_engaged(cfg, assume_accelerator=True) == "fused"
+        )
+
+    return {
+        "u4r": engaged_variant(lean_config(n_nodes, rung="u4r")) == "pairs",
+        "shrunk": fd_fused(full_config(n_nodes, rung="shrunk")),
+        "deep": fd_fused(full_config(n_nodes, rung="deep")),
     }
 
 

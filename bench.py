@@ -633,6 +633,22 @@ def multihost_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def packed_rung_engagement(log) -> dict | None:
+    """Per-rung packed-kernel engagement (sim.memory.
+    packed_kernel_engagement): does the u4r lean rung ride the pairs
+    kernel's VMEM nibble codec, and do the shrunk/deep full-FD rungs
+    fuse their packed bookkeeping — resolved through the same dispatch
+    sim_step uses, as the chip would see it. Compacted into the stdout
+    line as the comma-joined engaged-rung list."""
+    try:
+        from aiocluster_tpu.sim.memory import packed_kernel_engagement
+
+        return packed_kernel_engagement()
+    except Exception as exc:
+        log(f"packed-rung engagement unavailable: {exc!r}")
+        return None
+
+
 def memory_ladder_models(log) -> dict | None:
     """The memory ladder's planning claims (sim.memory.ladder_models):
     deepest full-FD rung B/pair vs the 9.125 target + the modeled
@@ -734,6 +750,7 @@ STDOUT_LINE_CAP = 2000
 # least-essential provenance first; the headline fields
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
+    "packed_kernel_engaged",
     "leave_detect_seconds",
     "rejoin_warm_rounds",
     "rejoin_warm_vs_cold_bytes",
@@ -777,6 +794,17 @@ _SACRIFICE_ORDER = (
     "roofline_fraction_of_peak",
     "rounds_to_convergence",
 )
+
+
+def _compact_packed_engaged(eng) -> str | None:
+    """The packed-rung engagement dict as one compact scalar: the
+    comma-joined engaged rungs ("u4r,shrunk,deep"), "none" when the
+    stamp exists but no packed rung rides a kernel (a loud value — the
+    dispatch regressed), None when the stamp is absent."""
+    if not isinstance(eng, dict):
+        return None
+    on = [rung for rung, engaged in eng.items() if engaged]
+    return ",".join(on) if on else "none"
 
 
 def compact_record(result: dict, record_path: str | None = None) -> dict:
@@ -877,6 +905,11 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
             (ex.get("memory_ladder") or {}).get("lean_max_scale_claim")
             or {}
         ).get("max_nodes_model"),
+        # Which packed rungs ride the in-place Pallas path (comma-
+        # joined; "none" = the dispatch regressed to the gather path).
+        "packed_kernel_engaged": _compact_packed_engaged(
+            ex.get("packed_kernel_engaged")
+        ),
         "full_fd_deepest_bytes_per_pair": (
             (ex.get("memory_ladder") or {}).get("full_fd_deepest") or {}
         ).get("bytes_per_pair"),
@@ -1576,6 +1609,11 @@ def main() -> None:
                 # modeled max scale) — every entry certified: false
                 # until the chip calibrates the new paths.
                 "memory_ladder": memory_ladder_models(log),
+                # Which PACKED rungs ride the in-place Pallas path
+                # under this build's dispatch (u4r via the VMEM nibble
+                # codec, shrunk/deep via the packed FD epilogue) — a
+                # dispatch regression shows up as a record diff.
+                "packed_kernel_engaged": packed_rung_engagement(log),
                 # Round-4 flagship: the measured (mesh-certified) 100k
                 # rounds-to-convergence + its v5e-8 projection.
                 "northstar_100k": load_northstar_record(log),

@@ -1,0 +1,37 @@
+# analyze-domain: ops
+"""TN: kernel bodies widen per tile in VMEM (exempt), byte-space
+nibble algebra never unpacks, and the value-level sanctioned helpers
+are the off-hot-path decode."""
+
+import jax.numpy as jnp
+
+from aiocluster_tpu.sim.packed import unpack_u4, watermarks_i32
+
+
+def _pull_tile_kernel(w_ref, out_ref):
+    # Inside a *_kernel body: the widen is a VMEM-tile transient.
+    tile = unpack_u4(w_ref[...])
+    out_ref[...] = tile.astype(out_ref.dtype)
+
+
+def _looped_kernel(w_ref, out_ref, count):
+    # Kernel bodies do per-tile work inside nested closures (the
+    # fori_loop body idiom) — still exempt, the closure IS the kernel.
+    def body(s, _):
+        out_ref[s] = unpack_u4(w_ref[s]).sum()
+        return 0
+
+    for s in range(count):
+        body(s, 0)
+
+
+def byte_space_advance(r, r_peer):
+    # Nibble algebra in place — no unpack call at all.
+    lo = (r & 0xF).astype(jnp.int32)
+    plo = (r_peer & 0xF).astype(jnp.int32)
+    return jnp.maximum(lo - plo, 0)
+
+
+def metrics_pass(state, owners):
+    # The sanctioned VALUE helper (decode lives in sim/packed.py).
+    return watermarks_i32(state, owners).sum()

@@ -1,0 +1,13 @@
+# analyze-domain: ops
+"""TP: unpack codec calls on ops/ paths outside kernel bodies — the
+full wide matrix lands in HBM (module scope AND a plain function)."""
+
+from aiocluster_tpu.sim.packed import unpack_bits, unpack_u4
+
+WIDE_AT_IMPORT = unpack_u4(b"\x00\x11")  # module scope
+
+
+def hot_path_widen(state):
+    wide = unpack_u4(state.w)  # materializes (N, N) int32 on the hot path
+    live = unpack_bits(state.live_view)
+    return wide.sum() + live.sum()

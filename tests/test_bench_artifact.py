@@ -163,6 +163,9 @@ def _worst_case_result():
             "xla_path_rounds_per_sec": 43.2,
             "pallas_speedup": 1.56,
             "pallas_variant_engaged": "pairs",
+            "packed_kernel_engaged": {
+                "u4r": True, "shrunk": True, "deep": True,
+            },
             "roofline": {
                 "bytes_per_round": 5_662_310_400,
                 "achieved_gb_per_sec": 382.2,
@@ -215,6 +218,16 @@ def test_stdout_line_stays_under_cap():
     assert ex["rejoin_warm_vs_cold_bytes"] == 0.0
     assert ex["rejoin_warm_rounds"] == 6.2
     assert ex["leave_detect_seconds"] == 0.012
+    # The packed-rung engagement dict compacts to the comma-joined
+    # engaged list (a dispatch regression would read "none" loudly).
+    assert ex["packed_kernel_engaged"] == "u4r,shrunk,deep"
+    assert (
+        bench._compact_packed_engaged(
+            {"u4r": False, "shrunk": False, "deep": False}
+        )
+        == "none"
+    )
+    assert bench._compact_packed_engaged(None) is None
     # The on-chip pointer survives a CPU fallback as scalars.
     assert ex["last_onchip_value"] > 1
     # And no nested structures sneak back in (flat extras only).

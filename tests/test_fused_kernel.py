@@ -139,6 +139,8 @@ def test_dead_grace_config_falls_back_loudly():
     """The two-stage lifecycle stays off every kernel; a kernel-wanting
     dead-grace config degrades to XLA AND bumps the metric counter
     (silently-but-loudly: a counter, not a print)."""
+    from aiocluster_tpu.ops.gossip import pallas_fallbacks_scope
+
     cfg = SimConfig(
         n_nodes=128, keys_per_node=4, budget=16, use_pallas=True,
         dead_grace_ticks=20,
@@ -146,10 +148,10 @@ def test_dead_grace_config_falls_back_loudly():
     assert not pallas_path_engaged(cfg)
     assert fd_phase_engaged(cfg) == "xla"
     assert pallas_fallback_reason(cfg) == "lifecycle"
-    before = pallas_fallbacks["lifecycle"]
-    st = sim_step(init_state(cfg), random.key(0), cfg)
-    assert int(st.tick) == 1
-    assert pallas_fallbacks["lifecycle"] == before + 1
+    with pallas_fallbacks_scope() as fb:
+        st = sim_step(init_state(cfg), random.key(0), cfg)
+        assert int(st.tick) == 1
+        assert fb["lifecycle"] == 1
     # The fallback trajectory IS the XLA trajectory (same dispatch).
     cfg_x = dataclasses.replace(cfg, use_pallas=False)
     _assert_states_equal(
@@ -163,15 +165,16 @@ def test_fault_masked_config_falls_back_loudly():
     carry no link mask) — counted, and bit-identical to the XLA path by
     construction (it IS the XLA path)."""
     from aiocluster_tpu.faults.scenarios import flaky_links
+    from aiocluster_tpu.ops.gossip import pallas_fallbacks_scope
 
     cfg = SimConfig(
         n_nodes=128, keys_per_node=4, budget=16, use_pallas=True,
         fault_plan=flaky_links(drop=0.3, seed=7),
     )
     assert pallas_fallback_reason(cfg) == "fault_plan"
-    before = pallas_fallbacks["fault_plan"]
-    st = sim_step(init_state(cfg), random.key(1), cfg)
-    assert pallas_fallbacks["fault_plan"] == before + 1
+    with pallas_fallbacks_scope() as fb:
+        st = sim_step(init_state(cfg), random.key(1), cfg)
+        assert fb["fault_plan"] == 1
     cfg_x = dataclasses.replace(cfg, use_pallas=False)
     _assert_states_equal(
         st, sim_step(init_state(cfg_x), random.key(1), cfg_x),
@@ -403,6 +406,242 @@ def test_tracked_sweep_converged_flag_through_lane_kernel():
         assert got[lane] == want, (lane, got[lane], want)
 
 
+# -- packed rungs through the kernels (PR 12 tentpole) ------------------------
+
+
+LEAN_U4R = dict(
+    n_nodes=256, keys_per_node=6, fanout=2, budget=16, writes_per_round=1,
+    death_rate=0.02, revival_rate=0.1, version_dtype="u4r",
+    track_failure_detector=False, track_heartbeats=False,
+)
+DEEP_FD = dict(
+    n_nodes=256, keys_per_node=8, fanout=2, budget=24,
+    version_dtype="int8", heartbeat_dtype="int8", fd_dtype="bfloat16",
+    icount_dtype="int8", live_bits=True, window_ticks=64,
+)
+
+
+def _packed_fd_equal(sa, sb, msg=""):
+    from aiocluster_tpu.sim.packed import live_view_bool, watermarks_i32
+
+    np.testing.assert_array_equal(
+        np.asarray(watermarks_i32(sa)), np.asarray(watermarks_i32(sb)),
+        err_msg=f"{msg}:w",
+    )
+    for f in ("hb_known", "last_change", "icount"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sa, f)), np.asarray(getattr(sb, f)),
+            err_msg=f"{msg}:{f}",
+        )
+    np.testing.assert_array_equal(
+        np.asarray(sa.imean, np.float32), np.asarray(sb.imean, np.float32),
+        err_msg=f"{msg}:imean",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(live_view_bool(sa)), np.asarray(live_view_bool(sb)),
+        err_msg=f"{msg}:live",
+    )
+
+
+@pytest.mark.slow
+def test_packed_u4r_pairs_kernel_matches_xla():
+    """The u4 nibble codec in VMEM (the PR-12 tentpole): the packed
+    lean rung ENGAGES the pairs kernel — DMA the packed bytes, widen/
+    advance/saturate/repack in VMEM, in place — and its trajectory with
+    writes AND churn equals the byte-space XLA path bit-for-bit; the
+    exact convergence round matches through the in-kernel packed
+    check."""
+    cfg_p = SimConfig(**LEAN_U4R, use_pallas=True, pallas_variant="pairs")
+    assert pallas_path_engaged(cfg_p)
+    assert pallas_variant_engaged(cfg_p) == "pairs"
+    assert pallas_fallback_reason(cfg_p) is None
+    cfg_x = SimConfig(**LEAN_U4R)
+    sp, sx = init_state(cfg_p), init_state(cfg_x)
+    key = random.key(13)
+    for _ in range(6):
+        sp = sim_step(sp, key, cfg_p)
+        sx = sim_step(sx, key, cfg_x)
+    _assert_states_equal(sp, sx, ("w",), "packed-lean")
+    # Exact convergence-round parity via the in-kernel nibble==0 check.
+    conv = dict(LEAN_U4R, writes_per_round=0, death_rate=0.0,
+                revival_rate=0.0, budget=4096)
+    r_p = Simulator(
+        SimConfig(**conv, use_pallas=True, pallas_variant="pairs"),
+        seed=0, chunk=4,
+    ).run_until_converged(60)
+    r_x = Simulator(SimConfig(**conv), seed=0, chunk=4).run_until_converged(60)
+    assert r_p == r_x is not None
+
+
+@pytest.mark.slow
+def test_packed_u4r_two_shard_mesh_matches_single():
+    """The packed kernel composes with the owners shard axis: the
+    two-pass packed totals (one psum) + in-place apply at n_local % 256
+    equals both the single-device kernel run and the XLA path."""
+    from aiocluster_tpu.parallel.mesh import make_mesh
+
+    cfg = SimConfig(**{**LEAN_U4R, "n_nodes": 512}, use_pallas=True,
+                    pallas_variant="pairs")
+    assert pallas_path_engaged(cfg, "owners", n_local=256)
+    mesh = make_mesh(jax.devices()[:2])
+    single = Simulator(cfg, seed=2, chunk=4)
+    sharded = Simulator(cfg, seed=2, chunk=4, mesh=mesh)
+    xla = Simulator(
+        dataclasses.replace(cfg, use_pallas=False), seed=2, chunk=4
+    )
+    for sim in (single, sharded, xla):
+        sim.run(8)
+    a = np.asarray(jax.device_get(single.state).w)
+    assert np.array_equal(a, np.asarray(jax.device_get(sharded.state).w))
+    assert np.array_equal(a, np.asarray(jax.device_get(xla.state).w))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fanout", [1, 2])
+def test_packed_fd_epilogue_matches_xla(fanout):
+    """The packed FD epilogue: int8 sample counters widen per tile in
+    VMEM and the live bitmap streams straight from the kernel — the
+    deep full-FD rung resolves "fused" and every FD output (bitmap
+    decoded) equals the XLA block bit-for-bit, fanout 1 (no hb0
+    stream) and 2."""
+    cfg = SimConfig(**{**DEEP_FD, "fanout": fanout}, use_pallas=True,
+                    pallas_variant="pairs")
+    assert fd_phase_engaged(cfg) == "fused"
+    x = Simulator(
+        dataclasses.replace(cfg, use_pallas=False, use_pallas_fd=False),
+        seed=5, chunk=4,
+    )
+    p = Simulator(cfg, seed=5, chunk=4)
+    x.run(12)
+    p.run(12)
+    sp, sx = jax.device_get(p.state), jax.device_get(x.state)
+    assert sp.live_view.dtype == np.uint8  # stored as the bitmap
+    _packed_fd_equal(sp, sx, f"deep-fd-fanout{fanout}")
+
+
+@pytest.mark.slow
+def test_packed_lane_sweep_matches_sequential():
+    """Packed operands ride the lane dispatch (custom_vmap -> the
+    lane-lifted kernels): a u4r sweep (fanout + writes swept) and a
+    deep full-FD sweep (phi swept) both equal their sequential runs
+    lane for lane — and the u4r lanes compose with a 2-shard mesh."""
+    from aiocluster_tpu.parallel.mesh import make_mesh
+    from aiocluster_tpu.sim.packed import watermarks_i32
+
+    cfg = SimConfig(**{**LEAN_U4R, "death_rate": 0.0, "revival_rate": 0.0,
+                       "writes_per_round": 0, "fanout": 3},
+                    use_pallas=True, pallas_variant="pairs")
+    assert pallas_path_engaged(cfg, sweep=True)
+    seeds, wpr, fan = [1, 2, 3], [0, 1, 0], [3, 2, 1]
+    sw = SweepSimulator(cfg, seeds, writes_per_round=wpr, fanout=fan, chunk=4)
+    sw.run(8)
+    states = jax.device_get(sw.states)
+    for lane, (s, w_, f_) in enumerate(zip(seeds, wpr, fan)):
+        seq = Simulator(
+            dataclasses.replace(cfg, writes_per_round=w_, fanout=f_),
+            seed=s, chunk=4,
+        )
+        seq.run(8)
+        a = np.asarray(watermarks_i32(jax.tree.map(lambda x: x[lane], states)))
+        b = np.asarray(watermarks_i32(jax.device_get(seq.state)))
+        assert np.array_equal(a, b), f"u4r lane {lane}"
+    deep = SimConfig(**DEEP_FD, use_pallas=True, pallas_variant="pairs")
+    assert fd_phase_engaged(deep, sweep=True) == "fused"
+    phis = [4.0, 8.0]
+    sw2 = SweepSimulator(deep, [7, 8], phi_threshold=phis, chunk=4)
+    sw2.run(8)
+    st2 = jax.device_get(sw2.states)
+    for lane, (s, ph) in enumerate(zip([7, 8], phis)):
+        seq = Simulator(
+            dataclasses.replace(deep, phi_threshold=ph, use_pallas=False,
+                                use_pallas_fd=False),
+            seed=s, chunk=4,
+        )
+        seq.run(8)
+        _packed_fd_equal(
+            jax.tree.map(lambda x: x[lane], st2), jax.device_get(seq.state),
+            f"deep lane {lane}",
+        )
+    sh = SimConfig(**{**LEAN_U4R, "n_nodes": 512, "death_rate": 0.0,
+                      "revival_rate": 0.0},
+                   use_pallas=True, pallas_variant="pairs")
+    mesh = make_mesh(jax.devices()[:2])
+    sw3 = SweepSimulator(sh, [0, 1], fanout=[1, 2], chunk=4, mesh=mesh)
+    sw3.run(6)
+    st3 = jax.device_get(sw3.states)
+    for lane, (s, f_) in enumerate(zip([0, 1], [1, 2])):
+        seq = Simulator(dataclasses.replace(sh, fanout=f_), seed=s, chunk=4)
+        seq.run(6)
+        a = np.asarray(watermarks_i32(jax.tree.map(lambda x: x[lane], st3)))
+        b = np.asarray(watermarks_i32(jax.device_get(seq.state)))
+        assert np.array_equal(a, b), f"sharded u4r lane {lane}"
+
+
+def test_packed_unsupported_shapes_fall_back_loudly():
+    """The loud-fallback contract survives the dispatch flip: packed
+    shapes the kernel does NOT serve (heartbeat-tracking u4r, a
+    pinned-m8 packed config, a shard width off the 256-alignment)
+    still degrade with a counted reason — asserted as exact in-scope
+    deltas via pallas_fallbacks_scope, not ambient diffs."""
+    from aiocluster_tpu.ops.gossip import pallas_fallbacks_scope
+
+    hb = SimConfig(n_nodes=256, keys_per_node=6, budget=16,
+                   version_dtype="u4r", track_failure_detector=False,
+                   track_heartbeats=True, use_pallas=True)
+    assert pallas_fallback_reason(hb) == "packed_dtype"
+    m8 = SimConfig(n_nodes=256, keys_per_node=6, budget=16,
+                   version_dtype="u4r", track_failure_detector=False,
+                   track_heartbeats=False, use_pallas=True,
+                   pallas_variant="m8")
+    assert pallas_fallback_reason(m8) == "packed_dtype"
+    assert not pallas_path_engaged(m8)
+    # A 256-node packed state sharded 128-wide: the byte width is a
+    # partial 128-lane tile — counted through the vmem/width catch-all.
+    narrow = SimConfig(n_nodes=256, keys_per_node=6, budget=16,
+                       version_dtype="u4r", track_failure_detector=False,
+                       track_heartbeats=False, use_pallas=True)
+    assert not pallas_path_engaged(narrow, "owners", n_local=128)
+    assert (
+        pallas_fallback_reason(narrow, "owners", n_local=128)
+        == "vmem_or_width"
+    )
+    with pallas_fallbacks_scope() as fb:
+        st = sim_step(init_state(hb), random.key(0), hb)
+        assert int(st.tick) == 1
+        assert fb["packed_dtype"] == 1
+
+
+def test_fallbacks_scope_snapshots_and_restores():
+    """pallas_fallbacks_scope: in-scope reads are exact deltas; the
+    process-wide ledger sees every count exactly once after exit (so
+    telemetry keeps its honesty while tests stop bleeding into each
+    other's ambient diffs)."""
+    from aiocluster_tpu.ops.gossip import (
+        pallas_fallbacks_scope,
+        pallas_fallbacks_total,
+    )
+
+    pallas_fallbacks["_scope_test"] = 3
+    try:
+        with pallas_fallbacks_scope() as fb:
+            assert fb["_scope_test"] == 0  # deltas, not ambient state
+            # The stable view (what the obs delta export baselines
+            # against) still sees the parked ambient counts — and is
+            # invariant across the scope's exit.
+            assert pallas_fallbacks_total()["_scope_test"] == 3
+            fb["_scope_test"] += 2
+            assert pallas_fallbacks_total()["_scope_test"] == 5
+            with pallas_fallbacks_scope() as inner:  # scopes nest
+                assert inner["_scope_test"] == 0
+                inner["_scope_test"] += 1
+                assert pallas_fallbacks_total()["_scope_test"] == 6
+            assert fb["_scope_test"] == 3
+        assert pallas_fallbacks["_scope_test"] == 6  # 3 ambient + 2 + 1
+        assert pallas_fallbacks_total()["_scope_test"] == 6
+    finally:
+        del pallas_fallbacks["_scope_test"]
+
+
 # -- bytes model / provenance stamps ------------------------------------------
 
 
@@ -440,6 +679,42 @@ def test_per_round_bytes_fused_entry():
     assert per_round_bytes(lean, variant="pairs") == 2 * 3 * 1024 * 1024 * 2
     with pytest.raises(ValueError):
         per_round_bytes(full, variant="warp")
+
+
+def test_per_round_bytes_packed_kernel_arm():
+    """The roofline model's packed arm: the kernel path moves the
+    PACKED bytes (0.5 B/pair, one read + one write per sub-exchange);
+    the byte-space XLA arm pays the 4-pass gather AND the round-start
+    refresh pass the kernel folds into its first sub-exchange."""
+    from aiocluster_tpu.sim.bytes import per_round_bytes, roofline_models
+
+    lean_u4 = SimConfig(
+        n_nodes=1024, version_dtype="u4r",
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    n2 = 1024 * 1024
+    assert per_round_bytes(lean_u4, variant="pairs") == int(3 * 2 * n2 * 0.5)
+    assert per_round_bytes(lean_u4, variant="xla") == int(
+        3 * 4 * n2 * 0.5 + 2 * n2 * 0.5
+    )
+    models = roofline_models(lean_u4, variant="pairs", fd_phase="off")
+    assert models["engaged"] == models["fused"] < models["xla"]
+    # The shrunk FD phase moves its true stored widths when fused.
+    shrunk = SimConfig(
+        n_nodes=1024, version_dtype="int16", heartbeat_dtype="int16",
+        fd_dtype="bfloat16", icount_dtype="int8", live_bits=True,
+        window_ticks=100,
+    )
+    wide = SimConfig(
+        n_nodes=1024, version_dtype="int16", heartbeat_dtype="int16",
+        fd_dtype="bfloat16",
+    )
+    saved = per_round_bytes(wide, variant="pairs", fd_phase="fused") - (
+        per_round_bytes(shrunk, variant="pairs", fd_phase="fused")
+    )
+    # icount r/w shrinks 2 B -> 1 B (2 B/pair saved) and the live
+    # write 1 B -> 0.125 B (0.875 B/pair saved).
+    assert saved == int(n2 * (2 * 1 + 0.875))
 
 
 def test_boundary_key_carries_lanes(tmp_path):

@@ -243,35 +243,88 @@ def test_int8_rung_pairs_kernel_parity():
 # -- loud fallbacks -----------------------------------------------------------
 
 
-def test_u4r_wanting_kernels_falls_back_loudly():
+def test_u4r_rung_rides_pairs_kernel():
+    """The packed rung now ENGAGES the pairs kernel's VMEM nibble codec
+    on its lean domain (PR 12's tentpole): no fallback reason fires,
+    and the kernel trajectory is bit-identical to the byte-space XLA
+    path (the ladder's parity contract, now across the dispatch
+    flip)."""
     from aiocluster_tpu.ops.gossip import (
         pallas_fallback_reason,
-        pallas_fallbacks,
+        pallas_fallbacks_scope,
         pallas_path_engaged,
+        pallas_variant_engaged,
     )
 
     cfg = SimConfig(n_nodes=256, keys_per_node=8, budget=24,
                     version_dtype="u4r", track_failure_detector=False,
                     track_heartbeats=False, use_pallas=True)
-    assert not pallas_path_engaged(cfg)
-    assert pallas_fallback_reason(cfg) == "packed_dtype"
-    before = pallas_fallbacks["packed_dtype"]
-    Simulator(cfg, seed=0, chunk=2).run(2)
-    assert pallas_fallbacks["packed_dtype"] == before + 1
+    assert pallas_path_engaged(cfg)
+    assert pallas_variant_engaged(cfg) == "pairs"
+    assert pallas_fallback_reason(cfg) is None
+    with pallas_fallbacks_scope() as fb:
+        a = Simulator(cfg, seed=1, chunk=2)
+        b = Simulator(
+            dataclasses.replace(cfg, use_pallas=False), seed=1, chunk=2
+        )
+        a.run(4)
+        b.run(4)
+        assert fb["packed_dtype"] == 0
+    assert np.array_equal(np.asarray(a.state.w), np.asarray(b.state.w))
 
 
-def test_shrunk_fd_wanting_kernels_falls_back_loudly():
-    from aiocluster_tpu.ops.gossip import fd_phase_engaged, pallas_fallbacks
+def test_u4r_off_kernel_domain_falls_back_loudly():
+    """UNSUPPORTED packed shapes still degrade to byte-space XLA with a
+    counted reason: the heartbeat-tracking packed profile (two tile
+    widths in one stream table — no kernel carries that) and a
+    pinned-m8 packed config (the single-pass kernel has no nibble
+    codec)."""
+    from aiocluster_tpu.ops.gossip import (
+        pallas_fallback_reason,
+        pallas_fallbacks_scope,
+        pallas_path_engaged,
+    )
+
+    hb = SimConfig(n_nodes=256, keys_per_node=8, budget=24,
+                   version_dtype="u4r", track_failure_detector=False,
+                   track_heartbeats=True, use_pallas=True)
+    assert not pallas_path_engaged(hb)
+    assert pallas_fallback_reason(hb) == "packed_dtype"
+    m8 = SimConfig(n_nodes=256, keys_per_node=8, budget=24,
+                   version_dtype="u4r", track_failure_detector=False,
+                   track_heartbeats=False, use_pallas=True,
+                   pallas_variant="m8")
+    assert not pallas_path_engaged(m8)
+    assert pallas_fallback_reason(m8) == "packed_dtype"
+    with pallas_fallbacks_scope() as fb:
+        Simulator(hb, seed=0, chunk=2).run(2)
+        Simulator(m8, seed=0, chunk=2).run(2)
+        assert fb["packed_dtype"] == 2
+
+
+def test_shrunk_fd_rides_fused_epilogue_and_standalone_falls_back():
+    """The shrunk-bookkeeping rungs now FUSE (the epilogue widens int8
+    counters per tile and writes the live bitmap straight from VMEM);
+    the standalone FD kernel stays unpacked-only, so pinning the pull
+    to m8 degrades the FD phase to XLA — counted."""
+    from aiocluster_tpu.ops.gossip import (
+        fd_phase_engaged,
+        pallas_fallbacks_scope,
+    )
 
     cfg = SimConfig(**{
         **FULL, "n_nodes": 256, "icount_dtype": "int8", "live_bits": True,
         "use_pallas": True,
     })
-    # The PULL kernels still serve the round; only the FD phase degrades.
-    assert fd_phase_engaged(cfg) == "xla"
-    before = pallas_fallbacks["fd_packed_bookkeeping"]
-    Simulator(cfg, seed=0, chunk=2).run(2)
-    assert pallas_fallbacks["fd_packed_bookkeeping"] == before + 1
+    assert fd_phase_engaged(cfg) == "fused"
+    with pallas_fallbacks_scope() as fb:
+        Simulator(cfg, seed=0, chunk=2).run(2)
+        assert fb["fd_packed_bookkeeping"] == 0
+    off_pairs = dataclasses.replace(cfg, pallas_variant="m8")
+    assert fd_phase_engaged(off_pairs) == "xla"
+    with pallas_fallbacks_scope() as fb:
+        Simulator(off_pairs, seed=0, chunk=2).run(2)
+        assert fb["fd_packed_bookkeeping"] == 1
 
 
 # -- codec + overflow guards --------------------------------------------------
@@ -518,6 +571,38 @@ def test_lean_rung_max_scale_model_lifts_3x_past_100k():
     assert lm["full_fd_deepest"]["meets_target"] is True
     for rung in lm["lean_single_chip"].values():
         assert rung["certified"] is False
+
+
+def test_packed_rung_kernel_discount_and_refreshed_ceiling():
+    """PR 12's acceptance numbers: a kernel-served packed rung charges
+    ZERO gather transient (the in-place discount, per the same
+    dispatch sim_step uses), the re-stamped lean u4r single-chip
+    ceiling STRICTLY exceeds the old 117,120 XLA-transient model
+    (still certified: false), and every packed rung reports
+    kernel-engaged for the bench stamp."""
+    from aiocluster_tpu.sim.memory import (
+        engaged_variant,
+        lean_config,
+        max_scale_model,
+        packed_kernel_engagement,
+        plan,
+    )
+
+    cfg = lean_config(25_600, rung="u4r")
+    assert engaged_variant(cfg) == "pairs"
+    assert plan(cfg).transient_bytes == 0
+    # Off the kernel domain (heartbeats tracked) the packed gather is
+    # still charged at the packed width — no phantom discount.
+    hb = lean_config(25_600, rung="u4r", track_heartbeats=True)
+    assert engaged_variant(hb) == "xla"
+    assert plan(hb).transient_bytes > 0
+    ms = max_scale_model("lean", "u4r")
+    assert ms["max_nodes_model"] > 117_120
+    assert ms["variant"] == "pairs"
+    assert ms["certified"] is False
+    assert packed_kernel_engagement() == {
+        "u4r": True, "shrunk": True, "deep": True,
+    }
 
 
 def test_fits_verdict_keys_evidence_by_hosts(tmp_path):

@@ -416,3 +416,80 @@ def check_silent_widen_packed_state(ctx: FileContext):
                 f"'{_trailing_name(node.args[0])}' — use the sanctioned "
                 "widen helpers in sim/packed.py",
             )
+
+
+# -- ACT029: packed matrix widened in HBM (ops/ hot paths) --------------------
+#
+# The packed rungs' whole claim is "the wide matrix never exists in
+# HBM": the XLA hot path computes on the nibbles inside the fusion, and
+# the Pallas pairs kernel widens per 8-row tile in VMEM only. A call to
+# the unpack codecs (sim/packed.unpack_u4 / unpack_bits / residuals_u4)
+# from an ops/ module OUTSIDE a kernel body therefore materializes the
+# full wide matrix on the hot path — exactly the transient the packed
+# rungs exist to avoid (and the one sim/memory.plan stopped charging
+# for kernel-served rungs). Enforced the same way ACT025 guards sim/:
+# the sanctioned module (sim/packed.py) and kernel bodies (functions
+# named *_kernel — the pallas_call targets, which widen in VMEM by
+# construction) are exempt; everything else in the ops domain must
+# route through the value-level helpers (watermarks_i32 and friends)
+# off the hot path, or stay packed.
+
+UNPACK_HELPER_NAMES = {"unpack_u4", "unpack_bits", "residuals_u4"}
+
+
+def _enclosing_function_names(tree: ast.Module) -> dict[int, tuple[str, ...]]:
+    """Map each Call node id to the names of ALL its enclosing
+    FunctionDefs, outermost first (() at module scope). The whole chain
+    matters: kernel bodies in this repo do their per-tile work inside
+    nested closures (``def body(s, _)`` inside ``_pairs_kernel``), and
+    a closure's decode is still a VMEM transient of the kernel that
+    owns it."""
+    out: dict[int, tuple[str, ...]] = {}
+
+    def visit(node: ast.AST, stack: tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, stack + (child.name,))
+            else:
+                if isinstance(child, ast.Call):
+                    out[id(child)] = stack
+                visit(child, stack)
+
+    visit(tree, ())
+    return out
+
+
+@rule(
+    "ACT029",
+    "packed-widen-in-hbm",
+    "full packed matrix widened outside kernels and the sanctioned helpers",
+)
+def check_packed_widen_in_hbm(ctx: FileContext):
+    if ctx.tree is None or "ops" not in ctx.domains:
+        return
+    if ctx.relpath.replace("\\", "/").endswith(_SANCTIONED_FILE_SUFFIX):
+        return  # THE sanctioned widen module
+    owners = _enclosing_function_names(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = ctx.resolve(node.func)
+        tail = (target or "").rsplit(".", 1)[-1]
+        if tail not in UNPACK_HELPER_NAMES:
+            continue
+        chain = owners.get(id(node), ())
+        if any(name.endswith("_kernel") for name in chain):
+            # Kernel bodies widen per tile in VMEM by construction —
+            # the decode never round-trips through HBM there; closures
+            # nested inside a kernel body are part of that body.
+            continue
+        where = f"in '{chain[-1]}'" if chain else "at module scope"
+        yield ctx.finding(
+            node,
+            "ACT029",
+            f"'{tail}' {where} materializes the full wide matrix in "
+            "HBM on an ops/ path — compute on the nibbles in place "
+            "(the byte-space algebra), run it inside a *_kernel body, "
+            "or move the decode off the hot path via the sanctioned "
+            "value helpers in sim/packed.py",
+        )
