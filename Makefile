@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -103,6 +103,21 @@ restart-bench:
 restart-smoke:
 	$(PY) benchmarks/restart_bench.py --smoke
 
+# Digital twin closed loop (benchmarks/twin_bench.py, docs/twin.md):
+# record a twin-grade trace from a real loopback fleet, replay it
+# through the deterministic sim, fit the runtime<->sim transfer on the
+# first half and validate it on the HELD-OUT second half, then drive
+# the SLO autotuner over a candidate grid under ONE sweep compile.
+# GATES: held-out prediction within the stated tolerance, exactly one
+# jit compile for the whole grid, and the recommended config's
+# predicted convergence strictly beating the default config's. The
+# smoke (6 nodes, 8 lanes, ~30 s CPU) gates CI via `check`.
+twin-bench:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/twin_bench.py
+
+twin-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/twin_bench.py --smoke
+
 # Multihost smoke (benchmarks/multihost_bench.py): TWO real processes
 # join a localhost coordinator (4 virtual CPU devices each, gloo
 # collectives) and run the sharded lean profile — a measured rounds/s
@@ -116,11 +131,13 @@ multihost-smoke:
 # a multihost parity/measurement failure, a red byzantine-atlas
 # baseline, a serve-tier encode-once/ratio regression, an
 # overload-degradation regression (availability ratio, breaker
-# opening, epoch monotonicity), or a durability regression (warm rejoin
-# ratio/speed, leave-vs-phi detection) cannot land through this gate. (kernel-parity re-runs one test file that
+# opening, epoch monotonicity), a durability regression (warm rejoin
+# ratio/speed, leave-vs-phi detection), or a twin regression (held-out
+# calibration error, one-compile autotune, recommendation-beats-
+# default) cannot land through this gate. (kernel-parity re-runs one test file that
 # test-all also covers — the explicit target keeps the merge gate for
 # kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke test-all
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke twin-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \
@@ -149,9 +166,11 @@ obs-demo:
 		--trace-file build/obs_demo_trace.jsonl
 	$(PY) -c "from aiocluster_tpu.obs import read_trace; \
 		t = read_trace('build/obs_demo_trace.jsonl'); \
-		assert t and all(e['event'] == 'sim_round' for e in t), t; \
-		assert t[-1]['mean_fraction'] == 1.0, t[-1]; \
-		print(f'obs-demo OK: {len(t)} sampled rounds, converged')"
+		assert t and t[0]['event'] == 'trace_header', t[:1]; \
+		rounds = [e for e in t[1:] if e['event'] == 'sim_round']; \
+		assert rounds and len(rounds) == len(t) - 1, t; \
+		assert rounds[-1]['mean_fraction'] == 1.0, rounds[-1]; \
+		print(f'obs-demo OK: {len(rounds)} sampled rounds, converged')"
 
 clean:
 	rm -rf build .pytest_cache

@@ -285,6 +285,103 @@ def _run_sim(args: argparse.Namespace, cfg) -> int:
     return 0 if converged is not None else 1
 
 
+def _run_twin(args: argparse.Namespace) -> int:
+    """Replay → calibrate (→ autotune) from the CLI (docs/twin.md): the
+    one-command form of the twin loop. Prints a JSON summary; exits
+    nonzero when the held-out validation misses its stated tolerance or
+    no candidate lane meets the SLO."""
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from . import twin
+
+    def csv_list(text, cast):
+        return None if text is None else [cast(x) for x in text.split(",")]
+
+    # Flag-combination validation up front, before any work: candidate
+    # lists / an FD budget without a deadline would otherwise be
+    # silently dropped, and a deadline without candidates has no grid
+    # to sweep — both are operator mistakes, not runnable requests.
+    tuning_flags = [
+        name for name, val in (
+            ("--fanout", args.fanout),
+            ("--phi", args.phi),
+            ("--writes", args.writes),
+            ("--fd-budget", args.fd_budget),
+        ) if val is not None
+    ]
+    if args.deadline is None and tuning_flags:
+        print(
+            f"twin: {', '.join(tuning_flags)} require --deadline "
+            "(the SLO the candidates are tuned against)",
+            file=sys.stderr, flush=True,
+        )
+        return 2
+    if args.deadline is not None and not (
+        args.fanout or args.phi or args.writes
+    ):
+        print(
+            "twin: --deadline needs at least one candidate list "
+            "(--fanout/--phi/--writes) spanning two or more lanes",
+            file=sys.stderr, flush=True,
+        )
+        return 2
+
+    trace = twin.load_runtime_trace(args.trace)
+    report = twin.replay(trace, seed=args.seed)
+    cal = twin.fit_calibration(report, tolerance=args.tolerance)
+    if args.calibration_out:
+        twin.save_calibration(args.calibration_out, cal)
+    out = {
+        "trace": trace.path,
+        "n_nodes": trace.n_nodes,
+        "trace_rounds": len(trace.rounds),
+        "skipped_lines": trace.skipped,
+        "sim_converged_round": report.sim_converged_round,
+        "calibration": cal.to_dict(),
+    }
+    ok = cal.holdout_ok
+    if args.deadline is not None:
+        from .core.config import Config
+        from .core.identity import NodeId
+
+        slo = twin.SLO(
+            convergence_deadline_s=args.deadline,
+            fd_false_positive_budget=args.fd_budget,
+        )
+        # The CLI has no deployment Config to tune against; recommend
+        # over a placeholder identity — the tunables are what matter.
+        base = Config(
+            node_id=NodeId(
+                name="operator", gossip_advertise_addr=("127.0.0.1", 0)
+            )
+        )
+        try:
+            rec = twin.autotune(
+                slo,
+                cal,
+                base,
+                twin.lift_sim_config(trace),
+                fanout=csv_list(args.fanout, int),
+                phi_threshold=csv_list(args.phi, float),
+                writes_per_round=csv_list(args.writes, int),
+                seed=args.seed,
+            )
+            out["recommendation"] = rec.to_dict()
+        except twin.AutotuneInfeasible as exc:
+            out["autotune_infeasible"] = str(exc)
+            out["lanes"] = exc.lanes
+            ok = False
+        except ValueError as exc:
+            # e.g. a single-value candidate list (one lane is not a
+            # sweep) — still report through the JSON contract.
+            out["autotune_error"] = str(exc)
+            ok = False
+    print(json.dumps(out), flush=True)
+    return 0 if ok else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="python -m aiocluster_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -344,12 +441,42 @@ def main(argv: list[str] | None = None) -> int:
                      "full FD profile at int16/bf16 scale dtypes; no "
                      "churn/shards)")
 
+    twin = sub.add_parser(
+        "twin",
+        help="replay a recorded runtime trace, fit a calibration, "
+        "optionally autotune against an SLO (docs/twin.md)",
+    )
+    twin.add_argument("--trace", required=True, metavar="PATH",
+                      help="twin-grade JSONL trace (Cluster.trace_rounds)")
+    twin.add_argument("--calibration-out", default=None, metavar="PATH",
+                      help="write the fitted CalibrationRecord JSON here")
+    twin.add_argument("--seed", type=int, default=0)
+    twin.add_argument("--tolerance", type=float, default=0.35,
+                      help="held-out validation tolerance recorded in "
+                      "(and gated by) the calibration (default 0.35)")
+    twin.add_argument("--deadline", type=float, default=None,
+                      metavar="SECONDS",
+                      help="SLO convergence deadline; with candidate "
+                      "lists below, runs the autotuner")
+    twin.add_argument("--fd-budget", type=float, default=None,
+                      help="SLO failure-detector false-positive budget")
+    twin.add_argument("--fanout", default=None,
+                      help="comma-separated fanout candidates")
+    twin.add_argument("--phi", default=None,
+                      help="comma-separated phi-threshold candidates")
+    twin.add_argument("--writes", default=None,
+                      help="comma-separated writes-per-round candidates")
+    twin.add_argument("--cpu", action="store_true",
+                      help="pin the CPU backend")
+
     args = parser.parse_args(argv)
     if args.command == "node":
         try:
             return asyncio.run(_run_node(args))
         except KeyboardInterrupt:
             return 0
+    if args.command == "twin":
+        return _run_twin(args)
     try:
         cfg = _sim_config(args)
     except ValueError as exc:  # bad --mtu/--nodes/--grace combinations

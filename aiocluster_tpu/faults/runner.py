@@ -43,12 +43,18 @@ class ChaosHarness:
         gossip_interval: float = 0.05,
         config_overrides: dict | None = None,
         persist_root: str | None = None,
+        trace=None,
     ) -> None:
         self.n_nodes = n_nodes
         self.names = [f"n{i:02d}" for i in range(n_nodes)]
         self._cluster_id = cluster_id
         self._interval = gossip_interval
         self._overrides = config_overrides or {}
+        # Twin-grade fleet tracing (docs/twin.md): one shared TraceWriter
+        # attached to every member (restarts re-attach) via
+        # Cluster.trace_rounds — the recording side of the digital
+        # twin's replay/calibrate loop. None traces nothing.
+        self._trace = trace
         # Durable-store root (docs/robustness.md): when set, every node
         # gets ``Config.persistence`` pointing at its own subdirectory,
         # and crash windows with ``recovery="warm"`` reboot FROM the
@@ -216,6 +222,8 @@ class ChaosHarness:
         self.generations.setdefault(name, []).append(
             cluster.self_node_id.generation_id
         )
+        if self._trace is not None:
+            cluster.trace_rounds(self._trace)
         return cluster
 
     async def start(self) -> None:

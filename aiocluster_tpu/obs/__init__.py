@@ -29,7 +29,7 @@ from .registry import (
     default_registry,
 )
 from .sim import SimMetrics, SweepMetrics
-from .trace import TraceWriter, read_trace
+from .trace import TRACE_SCHEMA, TraceScan, TraceWriter, read_trace, scan_trace
 
 __all__ = (
     "Counter",
@@ -40,9 +40,12 @@ __all__ = (
     "SectionTimer",
     "SimMetrics",
     "SweepMetrics",
+    "TRACE_SCHEMA",
+    "TraceScan",
     "TraceWriter",
     "default_registry",
     "device_trace",
     "read_trace",
     "render_prometheus",
+    "scan_trace",
 )

@@ -7,10 +7,18 @@ the event types both backends emit). JSONL keeps the file greppable,
 streamable, and loadable with one ``read_trace`` call or a pandas
 ``read_json(lines=True)``.
 
+The FIRST record of every fresh trace is a self-describing header
+(``event == "trace_header"`` carrying ``schema``): offline consumers —
+above all the digital twin's calibrator (docs/twin.md), which fits
+numbers against these records — refuse an incompatible schema loudly
+instead of mis-fitting silently. Appending to an existing file never
+injects a second header mid-stream.
+
 Writes are line-buffered under a lock (safe from asyncio callbacks and
 worker threads) and flushed per line so a crash mid-run loses at most the
 line being written — a trace that dies with the process is the one you
-need most.
+need most. ``scan_trace``/``read_trace(skip_invalid=True)`` recover
+every complete record from exactly such a torn file.
 """
 
 from __future__ import annotations
@@ -19,7 +27,14 @@ import io
 import json
 import threading
 import time
+from dataclasses import dataclass, field
 from pathlib import Path
+
+# Version of the trace record vocabulary. Bump ONLY on a change that
+# would make an old consumer mis-read new records (renamed fields,
+# changed units); added event kinds and added fields are
+# forward-compatible and do not bump it.
+TRACE_SCHEMA = "aiocluster-trace/1"
 
 
 class TraceWriter:
@@ -30,6 +45,10 @@ class TraceWriter:
         self._fh: io.TextIOBase | None = self.path.open("a", encoding="utf-8")
         self._lock = threading.Lock()
         self.events_written = 0
+        # A fresh (empty) file self-describes before any event lands;
+        # appending to a non-empty trace keeps its original header.
+        if self._fh.tell() == 0:
+            self.emit("trace_header", kind="trace_header", schema=TRACE_SCHEMA)
 
     def emit(self, event: str, **fields: object) -> None:
         """Write one record; silently drops events after close() (late
@@ -56,11 +75,34 @@ class TraceWriter:
         self.close()
 
 
-def read_trace(path: str | Path) -> list[dict]:
-    """Load a JSONL trace back into a list of dicts. Raises ValueError
-    (with the line number) on a corrupt line — the obs-demo CI target
-    uses this as the validity check."""
-    records: list[dict] = []
+@dataclass
+class TraceScan:
+    """Result of a tolerant trace read: the complete records plus an
+    account of what was skipped (line number, reason) — a crashed
+    process leaves at most a torn final line, but the scan tolerates
+    (and counts) any malformed line so the caller can decide whether
+    the damage is a tail or the whole file."""
+
+    records: list[dict] = field(default_factory=list)
+    skipped: list[tuple[int, str]] = field(default_factory=list)
+
+    @property
+    def first_invalid(self) -> tuple[int, str] | None:
+        """(lineno, reason) of the FIRST malformed line, or None."""
+        return self.skipped[0] if self.skipped else None
+
+    @property
+    def header(self) -> dict | None:
+        """The trace_header record, if the trace carries one."""
+        if self.records and self.records[0].get("event") == "trace_header":
+            return self.records[0]
+        return None
+
+
+def _iter_trace(path: str | Path):
+    """Stream (lineno, record, reason) triples: ``record`` is the
+    parsed dict for a valid line (reason None), None for a malformed
+    one (reason set). Blank lines are skipped entirely."""
     with open(path, encoding="utf-8") as fh:
         for lineno, line in enumerate(fh, start=1):
             line = line.strip()
@@ -69,11 +111,53 @@ def read_trace(path: str | Path) -> list[dict]:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError as exc:
-                raise ValueError(f"{path}:{lineno}: invalid JSONL: {exc}") from None
+                yield lineno, None, f"invalid JSONL: {exc}"
+                continue
             if not isinstance(rec, dict) or "event" not in rec:
-                raise ValueError(
-                    f"{path}:{lineno}: trace records must be objects with "
-                    "an 'event' field"
+                yield (
+                    lineno,
+                    None,
+                    "trace records must be objects with an 'event' field",
                 )
-            records.append(rec)
+                continue
+            yield lineno, rec, None
+
+
+def scan_trace(path: str | Path) -> TraceScan:
+    """Tolerant trace read: never raises on malformed lines. Complete
+    records (valid JSON objects with an ``event`` field) are collected;
+    everything else — above all the torn final line of a crashed
+    writer — is counted with its line number and reason."""
+    scan = TraceScan()
+    for lineno, rec, reason in _iter_trace(path):
+        if rec is None:
+            scan.skipped.append((lineno, reason))
+        else:
+            scan.records.append(rec)
+    return scan
+
+
+def read_trace(path: str | Path, *, skip_invalid: bool = False) -> list[dict]:
+    """Load a JSONL trace back into a list of dicts.
+
+    Strict by default: raises ValueError naming the FIRST malformed
+    line, failing fast at that line without reading the rest (the
+    obs-demo CI target uses this as the validity check — and "first"
+    matters, because the first tear is where the evidence of what went
+    wrong lives; later lines are usually collateral).
+
+    ``skip_invalid=True`` recovers instead of raising: malformed lines
+    are skipped and every complete record is returned — the mode for
+    traces from crashed processes (a torn final line would otherwise
+    make the whole file unreadable, and the trace that died with its
+    process is exactly the one the twin most needs to replay). Use
+    :func:`scan_trace` when the skip accounting itself is needed.
+    """
+    records: list[dict] = []
+    for lineno, rec, reason in _iter_trace(path):
+        if rec is None:
+            if not skip_invalid:
+                raise ValueError(f"{path}:{lineno}: {reason}")
+            continue
+        records.append(rec)
     return records

@@ -352,6 +352,17 @@ class Cluster:
         self._started = False
         self._closing = False
 
+        # Twin-grade round tracing (docs/twin.md): attached by
+        # trace_rounds(), off by default. ``_twin_round`` is this node's
+        # own monotone round index (the replay aligner's per-node clock);
+        # the prev_* cursors difference the engine's cumulative
+        # reconciliation totals into per-round figures.
+        self._twin_trace: TraceWriter | None = None
+        self._twin_round = 0
+        self._twin_prev_sent = 0
+        self._twin_prev_applied = 0
+        self._last_phi_max = 0.0
+
         # Seed our own state: the recovered keyspace (when a store was
         # restored), one heartbeat, then initial keys (idempotent — a
         # recovered live value is not re-written).
@@ -770,6 +781,36 @@ class Cluster:
         an ``obs.MetricsHTTPServer``."""
         return self._metrics
 
+    def trace_rounds(self, trace: TraceWriter) -> None:
+        """Attach a twin-grade round tracer (docs/twin.md).
+
+        Emits one ``twin_node`` record describing this node's tuning
+        surface, then one ``twin_round`` record per initiated gossip
+        round carrying what the digital twin's replay needs to lift the
+        trace into a simulation: the node's own round index and wall
+        duration, the reconciliation volume (key-versions sent/applied
+        since the previous round — responder-side handshakes included,
+        that traffic is part of the round's anti-entropy work), the
+        membership view (live/dead counts), our heartbeat, and the
+        round's worst phi sample. Fleet traces share ONE TraceWriter
+        across nodes (it is lock-serialized); replay groups by ``node``.
+        Without this call nothing twin-related is emitted — the plain
+        ``trace=`` constructor argument keeps its original event set.
+        """
+        self._twin_trace = trace
+        self._twin_prev_sent = self._engine.kv_sent_total
+        self._twin_prev_applied = self._engine.kv_applied_total
+        trace.emit(
+            "twin_node",
+            node=self._config.node_id.name,
+            generation=self._config.node_id.generation_id,
+            gossip_interval_s=self.effective_gossip_interval,
+            gossip_count=self._config.gossip_count,
+            phi_threshold=self._config.failure_detector.phi_threshhold,
+            max_payload_size=self._config.max_payload_size,
+            n_own_keys=len(self.self_node_state().key_values),
+        )
+
     @property
     def fault_controller(self):
         """The FaultController compiled from ``Config.fault_plan``
@@ -981,6 +1022,31 @@ class Cluster:
                 live=len(live),
                 dead=len(dead),
             )
+        if self._twin_trace is not None:
+            # Twin-grade round record (docs/twin.md): per-round DELTAS of
+            # the engine's cumulative reconciliation totals, so replay
+            # sees the anti-entropy volume each round actually moved
+            # (responder-side handshakes since the last round included).
+            kv_sent = self._engine.kv_sent_total
+            kv_applied = self._engine.kv_applied_total
+            self._twin_trace.emit(
+                "twin_round",
+                node=self._config.node_id.name,
+                round=self._twin_round,
+                duration_s=round(duration, 6),
+                targets=len(targets)
+                + (dead_target is not None)
+                + (seed_target is not None),
+                live=len(live),
+                dead=len(dead),
+                kv_sent=kv_sent - self._twin_prev_sent,
+                kv_applied=kv_applied - self._twin_prev_applied,
+                heartbeat=self.self_node_state().heartbeat,
+                phi_max=round(self._last_phi_max, 4),
+            )
+            self._twin_round += 1
+            self._twin_prev_sent = kv_sent
+            self._twin_prev_applied = kv_applied
 
     async def _gossip_with(
         self, host: str, port: int, label: str, tls_name: str | None = None
@@ -1299,6 +1365,7 @@ class Cluster:
             ns = self._cluster_state.node_state(node_id)
             if ns is not None and ns.heartbeat > self._departed[node_id][1]:
                 del self._departed[node_id]
+        phi_max = 0.0
         for node_id in self._cluster_state.nodes():
             if node_id != self.self_node_id and node_id not in self._departed:
                 phi = self._failure_detector.update_node_liveness(
@@ -1306,6 +1373,9 @@ class Cluster:
                 )
                 if phi is not None:
                     self._phi_hist.observe(phi)
+                    phi_max = max(phi_max, phi)
+        # Worst suspicion this pass — the twin_round tracer's FD datum.
+        self._last_phi_max = phi_max
         live = set(self._failure_detector.live_nodes())
         for node_id in live - self._prev_live:
             self._fd_transitions.labels("live").inc()

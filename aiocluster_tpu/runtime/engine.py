@@ -79,9 +79,19 @@ class GossipEngine:
         # of one round — the identical bytes go out without re-encoding.
         self._syn_cache: tuple[int, frozenset[NodeId], bytes] | None = None
         self._digest_stats_exported: dict[str, int] = {}
+        # Cumulative reconciliation totals as plain ints, kept even with
+        # metrics off: the twin-grade round tracer (Cluster.trace_rounds,
+        # docs/twin.md) differences them per round, and registry counters
+        # are write-optimized, not cheap to read back per round.
+        self.kv_sent_total = 0
+        self.kv_applied_total = 0
 
     def _note(self, step: str, sent: Delta | None = None,
               applied: Delta | None = None) -> None:
+        if sent is not None:
+            self.kv_sent_total += _delta_kv_count(sent)
+        if applied is not None:
+            self.kv_applied_total += _delta_kv_count(applied)
         if self._steps is None:
             return
         self._steps.labels(step).inc()

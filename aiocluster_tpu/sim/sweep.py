@@ -159,6 +159,29 @@ class SweepResult:
             "swept": sorted(self.params),
         }
 
+    # -- objective evaluation --------------------------------------------------
+
+    def evaluate(self, objective) -> list:
+        """Score every lane: ``objective(row) -> float | None`` over the
+        per-lane rows (None = lane infeasible under the objective). The
+        generic entry point SLO-style consumers — above all the twin's
+        autotuner (twin/autotune.py, docs/twin.md) — run over ONE
+        sweep's evidence table instead of re-simulating per candidate."""
+        return [objective(row) for row in self.rows()]
+
+    def best_lane(self, objective) -> tuple[int, float] | None:
+        """The feasible lane minimizing ``objective`` as
+        ``(lane, score)``, or None when every lane is infeasible. Ties
+        break toward the LOWER lane index, so callers order their
+        candidate grids cheapest-first and get the cheapest winner."""
+        best: tuple[int, float] | None = None
+        for lane, score in enumerate(self.evaluate(objective)):
+            if score is None:
+                continue
+            if best is None or score < best[1]:
+                best = (lane, float(score))
+        return best
+
 
 class SweepSimulator:
     """Runs S simulated scenarios under ONE compiled step.

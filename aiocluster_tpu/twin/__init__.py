@@ -1,0 +1,59 @@
+"""Digital twin: trace-driven calibration and SLO-driven autotuning.
+
+The runtime and the TPU sim stopped being parallel artifacts here
+(ROADMAP item 5, docs/twin.md): a recorded runtime trace
+(``Cluster.trace_rounds`` / ``ChaosHarness(trace=...)``) is lifted into
+a deterministic simulation and replayed round-for-round (``replay``),
+the residual between the two is fitted as a transfer function with
+stated error bars and persisted as a versioned ``CalibrationRecord``
+(``calibrate``), and an operator SLO is then evaluated over a
+``SweepSimulator`` lane ensemble — every candidate under ONE compile —
+to emit a recommended ``Config`` + ``SimConfig`` pair with the evidence
+attached (``autotune``).
+"""
+
+from .autotune import (
+    SLO,
+    AutotuneInfeasible,
+    Recommendation,
+    autotune,
+)
+from .calibrate import (
+    CALIBRATION_SCHEMA,
+    CalibrationError,
+    CalibrationRecord,
+    CalibrationSchemaError,
+    fit_calibration,
+    load_calibration,
+    save_calibration,
+)
+from .replay import (
+    ReplayReport,
+    RoundRow,
+    RuntimeTrace,
+    TraceSchemaError,
+    lift_sim_config,
+    load_runtime_trace,
+    replay,
+)
+
+__all__ = (
+    "CALIBRATION_SCHEMA",
+    "SLO",
+    "AutotuneInfeasible",
+    "CalibrationError",
+    "CalibrationRecord",
+    "CalibrationSchemaError",
+    "Recommendation",
+    "ReplayReport",
+    "RoundRow",
+    "RuntimeTrace",
+    "TraceSchemaError",
+    "autotune",
+    "fit_calibration",
+    "lift_sim_config",
+    "load_calibration",
+    "load_runtime_trace",
+    "replay",
+    "save_calibration",
+)

@@ -739,6 +739,20 @@ def overload_degradation_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def twin_closed_loop_bench(log, smoke: bool) -> dict | None:
+    """The digital-twin datum (benchmarks/twin_bench.py, docs/twin.md):
+    a real loopback fleet recorded with twin-grade round tracing,
+    replayed through the deterministic sim, the transfer function
+    fitted on the first half of the trace and validated against the
+    held-out second half, then the SLO autotuner driven over a
+    candidate grid under ONE SweepSimulator compile — the calibrated
+    rounds/s prediction and the recommended fanout ride every record
+    with the gate verdicts machine-readable."""
+    return _run_benchmarks_helper(
+        "twin_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 # Hard cap on the stdout record line. Round 3's full record grew to
 # ~4.5 KB and the driver's capture kept only an unparseable tail
 # (BENCH_r03.json "parsed": null); the compact line stays ~an order of
@@ -751,6 +765,8 @@ STDOUT_LINE_CAP = 2000
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
     "packed_kernel_engaged",
+    "twin_recommended_fanout",
+    "twin_predicted_rounds_per_sec",
     "leave_detect_seconds",
     "rejoin_warm_rounds",
     "rejoin_warm_vs_cold_bytes",
@@ -887,6 +903,14 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         ),
         "leave_detect_seconds": (ex.get("restart_bench") or {}).get(
             "leave_detect_seconds"
+        ),
+        # Digital twin (twin_bench): the calibrated (held-out-validated)
+        # wall-clock rate and the SLO autotuner's recommended fanout.
+        "twin_predicted_rounds_per_sec": (ex.get("twin_bench") or {}).get(
+            "twin_predicted_rounds_per_sec"
+        ),
+        "twin_recommended_fanout": (ex.get("twin_bench") or {}).get(
+            "twin_recommended_fanout"
         ),
         # S-lane sweep throughput + compile amortization (sweep_bench).
         "sim_sweep_lane_rounds_per_sec": (ex.get("sweep_bench") or {}).get(
@@ -1524,6 +1548,10 @@ def main() -> None:
         # Durable node state: warm-vs-cold rolling restart + leave
         # detection on real loopback fleets (restart_bench.py).
         restart_rec = restart_durability_bench(log, args.smoke)
+        # Digital twin closed loop: recorded fleet trace -> replay ->
+        # held-out-validated calibration -> one-compile SLO autotune
+        # (twin_bench.py, docs/twin.md).
+        twin_rec = twin_closed_loop_bench(log, args.smoke)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1605,6 +1633,10 @@ def main() -> None:
                 # reconvergence, leave-vs-phi detection, gate verdicts
                 # (restart_bench.py, docs/robustness.md).
                 "restart_bench": restart_rec,
+                # Digital twin: calibrated rounds/s with held-out
+                # validation error + the SLO autotuner's recommendation
+                # (twin_bench.py, docs/twin.md).
+                "twin_bench": twin_rec,
                 # The memory ladder's planning claims (per-rung B/pair,
                 # modeled max scale) — every entry certified: false
                 # until the chip calibrates the new paths.

@@ -1,0 +1,210 @@
+"""Digital-twin closed loop: record → replay → calibrate → autotune.
+
+The twin's whole claim (docs/twin.md) measured end to end on real
+machinery: a loopback ChaosHarness fleet runs with twin-grade round
+tracing on, the recorded trace is lifted into the deterministic sim and
+replayed, the runtime↔sim transfer function is fitted on the FIRST half
+of the trace and validated against the HELD-OUT second half, and the
+fitted calibration then drives the SLO autotuner over a candidate lane
+grid — every candidate under ONE SweepSimulator compile.
+
+Gates (asserted when run as a script; bench.py embeds ``measure()``
+without the assertions and stamps the figures into every BENCH record):
+
+- the held-out wall-clock prediction lands within the calibration's
+  stated tolerance (the closed-loop differential gate);
+- the autotuner's whole grid compiles exactly once (jit cache delta 1);
+- the recommended config's predicted convergence strictly beats the
+  default config's (fanout=3, phi=8) prediction, and meets the SLO
+  deadline.
+
+Usage: python benchmarks/twin_bench.py [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+# Default runtime tuning values — the comparison arm the recommendation
+# must beat (Config.gossip_count / FailureDetectorConfig.phi_threshhold).
+DEFAULT_FANOUT = 3
+DEFAULT_PHI = 8.0
+
+
+async def _record_fleet(path: str, n_nodes: int, interval: float,
+                        extra_seconds: float, log) -> None:
+    from aiocluster_tpu.faults.runner import ChaosHarness
+    from aiocluster_tpu.obs import TraceWriter
+
+    with TraceWriter(path) as tw:
+        async with ChaosHarness(
+            n_nodes, gossip_interval=interval, cluster_id="twin-bench",
+            trace=tw,
+        ) as h:
+            t0 = time.monotonic()
+            await h.wait_converged(timeout=30.0)
+            log(f"fleet converged in {time.monotonic() - t0:.2f}s; "
+                f"recording {extra_seconds:.1f}s of steady state")
+            # The rate fit wants a window of steady rounds on both
+            # sides of the holdout split.
+            await asyncio.sleep(extra_seconds)
+
+
+def measure(
+    smoke: bool = False,
+    log=lambda msg: print(msg, file=sys.stderr, flush=True),
+) -> dict:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from aiocluster_tpu import twin
+    from aiocluster_tpu.core.config import Config
+    from aiocluster_tpu.core.identity import NodeId
+    from aiocluster_tpu.sim import sweep as sweep_mod
+    from aiocluster_tpu.sim.config import SimConfig
+
+    fleet = 6 if smoke else 8
+    interval = 0.04 if smoke else 0.05
+    extra = 1.6 if smoke else 3.0
+    tune_nodes = 32 if smoke else 64
+    deadline_s = 30.0
+    tolerance = 0.35
+
+    tmp = tempfile.mkdtemp(prefix="twin_bench_")
+    try:
+        trace_path = os.path.join(tmp, "fleet.jsonl")
+        asyncio.run(_record_fleet(trace_path, fleet, interval, extra, log))
+
+        trace = twin.load_runtime_trace(trace_path)
+        report = twin.replay(trace)
+        cal = twin.fit_calibration(report, tolerance=tolerance)
+        log(
+            f"calibrated: {cal.rounds_per_sec:.2f} ± "
+            f"{cal.rounds_per_sec_std:.2f} rounds/s over "
+            f"{cal.fit_rounds} rounds; held-out wall err "
+            f"{cal.holdout_wall_rel_err:.1%} (tolerance {tolerance:.0%})"
+        )
+
+        # The SLO sweep runs the TUNING scenario — a bigger fleet with a
+        # constrained per-exchange budget, where fanout genuinely moves
+        # rounds-to-convergence — through the calibration fitted above.
+        slo = twin.SLO(
+            convergence_deadline_s=deadline_s,
+            fd_false_positive_budget=0.25,
+        )
+        base_config = Config(
+            node_id=NodeId(
+                name="operator", gossip_advertise_addr=("127.0.0.1", 0)
+            ),
+            gossip_interval=interval,
+        )
+        tune_cfg = SimConfig(
+            n_nodes=tune_nodes, keys_per_node=16, budget=16,
+            fanout=DEFAULT_FANOUT, phi_threshold=DEFAULT_PHI,
+        )
+        fanouts = [1, 2, 3, 4]
+        phis = [DEFAULT_PHI, 4.0]
+        cache_before = sweep_mod._sweep_chunk_tracked._cache_size()
+        t0 = time.perf_counter()
+        rec = twin.autotune(
+            slo, cal, base_config, tune_cfg,
+            fanout=fanouts, phi_threshold=phis,
+        )
+        tune_wall = time.perf_counter() - t0
+        cache_delta = (
+            sweep_mod._sweep_chunk_tracked._cache_size() - cache_before
+        )
+        lanes = rec.evidence["lanes"]
+        default_lane = next(
+            lane for lane in lanes
+            if lane["fanout"] == DEFAULT_FANOUT
+            and lane["phi_threshold"] == DEFAULT_PHI
+        )
+        default_pred = default_lane.get("predicted")
+        recommended_s = rec.predicted["seconds"]
+        log(
+            f"autotune: {len(lanes)} lanes in {tune_wall:.1f}s "
+            f"(jit cache delta {cache_delta}); recommended fanout="
+            f"{rec.config.gossip_count} phi="
+            f"{rec.config.failure_detector.phi_threshhold} -> "
+            f"{recommended_s:.2f}s predicted vs default "
+            f"{default_pred['seconds'] if default_pred else None}"
+        )
+
+        gates = {
+            "holdout_within_tolerance": bool(cal.holdout_ok),
+            "single_compile": cache_delta <= 1,
+            "recommendation_beats_default": bool(
+                default_pred is not None
+                and recommended_s < default_pred["seconds"]
+            ),
+            "deadline_met": rec.predicted["hi"] <= deadline_s,
+        }
+        return {
+            "smoke": smoke,
+            "fleet_nodes": fleet,
+            "gossip_interval_s": interval,
+            "trace_rounds": len(trace.rounds),
+            "trace_skipped_lines": trace.skipped,
+            "sim_converged_round": report.sim_converged_round,
+            "twin_predicted_rounds_per_sec": round(cal.rounds_per_sec, 3),
+            "rounds_per_sec_std": round(cal.rounds_per_sec_std, 4),
+            "kv_scale": None if cal.kv_scale is None
+            else round(cal.kv_scale, 3),
+            "holdout_wall_rel_err": round(cal.holdout_wall_rel_err, 4),
+            "holdout_kv_rel_err": None if cal.holdout_kv_rel_err is None
+            else round(cal.holdout_kv_rel_err, 4),
+            "tolerance": tolerance,
+            "tune_nodes": tune_nodes,
+            "tune_lanes": len(lanes),
+            "tune_wall_seconds": round(tune_wall, 2),
+            "sweep_jit_cache_delta": cache_delta,
+            "slo_deadline_s": deadline_s,
+            "twin_recommended_fanout": rec.config.gossip_count,
+            "twin_recommended_phi": (
+                rec.config.failure_detector.phi_threshhold
+            ),
+            "recommended_rounds": rec.predicted["rounds"],
+            "recommended_predicted_s": round(recommended_s, 3),
+            "default_rounds": default_lane["rounds_to_convergence"],
+            "default_predicted_s": (
+                None if default_pred is None
+                else round(default_pred["seconds"], 3)
+            ),
+            "recommendation": {
+                k: v for k, v in rec.to_dict().items() if k != "evidence"
+            },
+            "gates": gates,
+            "gates_passed": all(gates.values()),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="small fleet / small tuning grid (the "
+                        "`make twin-smoke` CI gate)")
+    args = parser.parse_args()
+
+    def log(msg: str) -> None:
+        print(f"[twin-bench] {msg}", file=sys.stderr, flush=True)
+
+    record = measure(smoke=args.smoke, log=log)
+    print(json.dumps(record), flush=True)
+    if not record["gates_passed"]:
+        log(f"FAIL: {record['gates']}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+# analyze-domain: runtime
+"""TN: literal event kinds (the discipline), plus the emit shapes the
+rule must not flag — non-trace receivers (hook dispatchers) and
+unscoped helpers."""
+
+
+class Round:
+    def __init__(self, trace, hooks):
+        self._trace = trace
+        self._hooks = hooks
+
+    def finish(self, duration: float) -> None:
+        self._trace.emit("twin_round", duration_s=duration)  # literal kind
+
+    def transition(self, peer: str, to: str) -> None:
+        self._trace.emit("node_transition", peer=peer, to=to)
+
+    def header(self) -> None:
+        # The kind riding emit's named parameter is still a literal.
+        self._trace.emit(event="trace_header", schema="x/1")
+
+    def kick(self, callbacks, payload) -> None:
+        # Not a trace writer: hook dispatch fan-out takes whatever the
+        # binding site queued — out of this rule's scope.
+        self._hooks.emit(callbacks, payload)

@@ -1,0 +1,21 @@
+# analyze-domain: runtime
+"""TP: trace events emitted under computed kinds — the twin replay
+dispatcher routes on literal kinds, so none of these records would ever
+be consumed."""
+
+
+class Round:
+    def __init__(self, trace):
+        self._trace = trace
+
+    def finish(self, phase: str, duration: float) -> None:
+        self._trace.emit(f"round_{phase}", duration_s=duration)  # computed
+
+    def note(self, event: str) -> None:
+        self._trace.emit(event)  # a variable kind: invisible to replay
+
+    def tail(self) -> None:
+        self._trace.emit(**{"event": "x"})  # smuggled: no visible kind
+
+    def keyword(self, name: str) -> None:
+        self._trace.emit(event="round_" + name)  # computed keyword kind

@@ -134,6 +134,31 @@ def _worst_case_result():
                 "breaker_open_peers": 2,
                 "adaptive_timeout_p99_ms": 50.98,
             },
+            "twin_bench": {
+                "smoke": False,
+                "fleet_nodes": 8,
+                "trace_rounds": 61,
+                "twin_predicted_rounds_per_sec": 19.842,
+                "rounds_per_sec_std": 0.31,
+                "kv_scale": 2.47,
+                "holdout_wall_rel_err": 0.018,
+                "holdout_kv_rel_err": 0.0,
+                "tolerance": 0.35,
+                "tune_lanes": 8,
+                "sweep_jit_cache_delta": 1,
+                "slo_deadline_s": 30.0,
+                "twin_recommended_fanout": 4,
+                "twin_recommended_phi": 8.0,
+                "recommended_predicted_s": 0.453,
+                "default_predicted_s": 0.605,
+                "gates": {
+                    "holdout_within_tolerance": True,
+                    "single_compile": True,
+                    "recommendation_beats_default": True,
+                    "deadline_met": True,
+                },
+                "gates_passed": True,
+            },
             "restart_bench": {
                 "scenario": "rolling_restart + leave",
                 "smoke": False,
@@ -218,6 +243,11 @@ def test_stdout_line_stays_under_cap():
     assert ex["rejoin_warm_vs_cold_bytes"] == 0.0
     assert ex["rejoin_warm_rounds"] == 6.2
     assert ex["leave_detect_seconds"] == 0.012
+    # The digital-twin keys round-trip as flat scalars: the calibrated
+    # (held-out-validated) rounds/s prediction and the autotuner's
+    # recommended fanout (twin_bench.py, docs/twin.md).
+    assert ex["twin_predicted_rounds_per_sec"] == 19.842
+    assert ex["twin_recommended_fanout"] == 4
     # The packed-rung engagement dict compacts to the comma-joined
     # engaged list (a dispatch regression would read "none" loudly).
     assert ex["packed_kernel_engaged"] == "u4r,shrunk,deep"

@@ -9,6 +9,7 @@ import threading
 import pytest
 
 from aiocluster_tpu.obs import (
+    TRACE_SCHEMA,
     MetricsHTTPServer,
     MetricsRegistry,
     TraceWriter,
@@ -182,12 +183,28 @@ def test_trace_round_trip(tmp_path):
         t.emit("round", tick=1, frac=0.25)
         t.emit("transition", peer="n2", to="live")
     records = read_trace(path)
-    assert [r["event"] for r in records] == ["round", "transition"]
-    assert records[0]["frac"] == 0.25
+    # A fresh trace self-describes: the FIRST record is the schema
+    # header the twin's calibrator gates on (docs/twin.md).
+    assert [r["event"] for r in records] == [
+        "trace_header", "round", "transition",
+    ]
+    assert records[0]["schema"] == TRACE_SCHEMA
+    assert records[0]["kind"] == "trace_header"
+    assert records[1]["frac"] == 0.25
     assert all("ts" in r for r in records)
     # every line is independently valid JSON
     for line in path.read_text().splitlines():
         json.loads(line)
+
+
+def test_trace_append_writes_no_second_header(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    with TraceWriter(path) as t:
+        t.emit("a")
+    with TraceWriter(path) as t:  # reopen-and-append
+        t.emit("b")
+    events = [r["event"] for r in read_trace(path)]
+    assert events == ["trace_header", "a", "b"]
 
 
 def test_trace_emit_after_close_is_dropped(tmp_path):
@@ -195,7 +212,9 @@ def test_trace_emit_after_close_is_dropped(tmp_path):
     t.emit("a")
     t.close()
     t.emit("b")  # must not raise
-    assert [r["event"] for r in read_trace(tmp_path / "t.jsonl")] == ["a"]
+    assert [r["event"] for r in read_trace(tmp_path / "t.jsonl")] == [
+        "trace_header", "a",
+    ]
 
 
 def test_trace_reader_rejects_corrupt_lines(tmp_path):
@@ -271,7 +290,9 @@ def test_sim_metrics_gauges_and_trace(tmp_path):
     assert snap["aiocluster_sim_mean_fraction{engine=xla}"] == 1.0
     assert snap["aiocluster_sim_version_spread{engine=xla}"] == 0
     assert snap["aiocluster_sim_rounds_total{engine=xla}"] > 0
-    events = read_trace(trace_path)
+    events = [
+        e for e in read_trace(trace_path) if e["event"] != "trace_header"
+    ]
     assert events and all(e["event"] == "sim_round" for e in events)
     # the convergence-fraction series is monotone for a churn-free run
     fracs = [e["mean_fraction"] for e in events]

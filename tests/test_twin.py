@@ -1,0 +1,442 @@
+"""Digital twin (aiocluster_tpu/twin, docs/twin.md): trace round-trip
+under crash truncation, schema refusal discipline, the closed-loop
+differential gate (real fleet trace → replay → calibration validated on
+the held-out half), and the one-compile SLO autotuner.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from aiocluster_tpu import twin
+from aiocluster_tpu.core.config import Config
+from aiocluster_tpu.core.identity import NodeId
+from aiocluster_tpu.obs import TRACE_SCHEMA, TraceWriter, read_trace, scan_trace
+from aiocluster_tpu.sim.config import SimConfig
+
+FLEET = 5
+INTERVAL = 0.04
+
+
+@pytest.fixture(scope="module")
+def recorded_trace(tmp_path_factory):
+    """One twin-grade trace from a real loopback ChaosHarness fleet —
+    the closed-loop tests share it (the fleet run is the expensive
+    part)."""
+    from aiocluster_tpu.faults.runner import ChaosHarness
+
+    path = tmp_path_factory.mktemp("twin") / "fleet.jsonl"
+
+    async def record():
+        with TraceWriter(path) as tw:
+            async with ChaosHarness(
+                FLEET, gossip_interval=INTERVAL, cluster_id="twin-test",
+                trace=tw,
+            ) as h:
+                await h.wait_converged(timeout=20.0)
+                await asyncio.sleep(1.5)  # steady rounds for the rate fit
+
+    asyncio.run(record())
+    return path
+
+
+# -- satellite: crash-truncation torture --------------------------------------
+
+
+def test_trace_truncation_torture(tmp_path):
+    """Write-then-truncate at EVERY byte offset (the intent-log torture
+    of tests/test_persist.py, applied to traces): skip_invalid recovery
+    must return every complete record — always a clean prefix (plus at
+    most the final record whose JSON survived sans newline), never a
+    corrupted or reordered row — and the strict reader must raise
+    exactly when a torn tail exists."""
+    src = tmp_path / "full.jsonl"
+    with TraceWriter(src) as tw:
+        tw.emit("twin_node", node="n0", gossip_count=3)
+        for r in range(3):
+            tw.emit("twin_round", node="n0", round=r, kv_applied=r * 7)
+        tw.emit("node_transition", peer="n1", to="live")
+    raw = src.read_bytes()
+    full = read_trace(src)
+    assert [r["event"] for r in full][0] == "trace_header"
+
+    for offset in range(len(raw) + 1):
+        prefix = raw[:offset]
+        p = tmp_path / "cut.jsonl"
+        p.write_bytes(prefix)
+        complete_lines = prefix.count(b"\n")
+        tail = prefix.rpartition(b"\n")[2]
+
+        recovered = read_trace(p, skip_invalid=True)
+        # Every complete record recovered, as an exact prefix of the
+        # original series (order preserved, nothing corrupted).
+        assert recovered == full[: len(recovered)], offset
+        assert len(recovered) >= complete_lines, offset
+        assert len(recovered) <= complete_lines + 1, offset
+
+        scan = scan_trace(p)
+        torn = bool(tail) and len(recovered) == complete_lines
+        assert bool(scan.skipped) == torn, offset
+        if torn:
+            # The scan names the FIRST (here: only) malformed line.
+            assert scan.first_invalid[0] == complete_lines + 1
+            with pytest.raises(ValueError, match=str(complete_lines + 1)):
+                read_trace(p)
+        else:
+            read_trace(p)  # strict read succeeds
+
+
+# -- satellite: schema stamping + loud refusal --------------------------------
+
+
+def test_trace_header_schema_gates_replay(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with TraceWriter(p) as tw:
+        tw.emit("twin_node", node="a", gossip_count=3)
+    header = read_trace(p)[0]
+    assert header["event"] == "trace_header"
+    assert header["kind"] == "trace_header"
+    assert header["schema"] == TRACE_SCHEMA
+
+    # An incompatible schema is refused by name, not mis-read.
+    bad = tmp_path / "bad.jsonl"
+    lines = p.read_text().splitlines()
+    lines[0] = json.dumps(
+        {"event": "trace_header", "ts": 0, "schema": "aiocluster-trace/999"}
+    )
+    bad.write_text("\n".join(lines) + "\n")
+    with pytest.raises(twin.TraceSchemaError, match="aiocluster-trace/999"):
+        twin.load_runtime_trace(bad)
+
+    # A headerless file (first line lost / foreign JSONL) is refused
+    # unless the caller explicitly opts out.
+    headerless = tmp_path / "no_header.jsonl"
+    headerless.write_text("\n".join(lines[1:]) + "\n")
+    with pytest.raises(twin.TraceSchemaError, match="trace_header"):
+        twin.load_runtime_trace(headerless)
+
+
+def test_calibration_record_schema_refusal(tmp_path):
+    rec = _synthetic_calibration()
+    path = tmp_path / "cal.json"
+    twin.save_calibration(path, rec)
+    assert twin.load_calibration(path) == rec
+
+    raw = rec.to_dict()
+    raw["schema"] = "aiocluster-twin-calibration/999"
+    drifted = tmp_path / "drift.json"
+    drifted.write_text(json.dumps(raw))
+    with pytest.raises(twin.CalibrationSchemaError, match="999"):
+        twin.load_calibration(drifted)
+
+    raw = rec.to_dict()
+    del raw["rounds_per_sec"]
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps(raw))
+    with pytest.raises(twin.CalibrationSchemaError, match="rounds_per_sec"):
+        twin.load_calibration(partial)
+
+    # A NEWER same-major writer's extra key warns but loads.
+    raw = rec.to_dict()
+    raw["future_field"] = 1
+    future = tmp_path / "future.json"
+    future.write_text(json.dumps(raw))
+    with pytest.warns(UserWarning, match="future_field"):
+        assert twin.load_calibration(future) == rec
+
+    with pytest.raises(twin.CalibrationSchemaError, match="not a JSON"):
+        garbage = tmp_path / "garbage.json"
+        garbage.write_text("{nope")
+        twin.load_calibration(garbage)
+
+
+# -- trace lifting ------------------------------------------------------------
+
+
+def test_lift_sim_config_derives_fleet_shape(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with TraceWriter(p) as tw:
+        for i in range(4):
+            tw.emit(
+                "twin_node",
+                node=f"n{i}", gossip_count=5, phi_threshold=6.5,
+                n_own_keys=2, gossip_interval_s=0.5,
+            )
+            tw.emit("twin_round", node=f"n{i}", round=0, ts=1.0,
+                    duration_s=0.01, kv_sent=0, kv_applied=0, live=3)
+    trace = twin.load_runtime_trace(p)
+    assert trace.n_nodes == 4
+    cfg = twin.lift_sim_config(trace)
+    assert cfg.n_nodes == 4
+    assert cfg.fanout == 3  # gossip_count=5 clamped to n_nodes - 1
+    assert cfg.phi_threshold == 6.5
+    assert cfg.keys_per_node == 2
+    assert cfg.pairing == "matching"
+    # Overrides replace any derived field.
+    assert twin.lift_sim_config(trace, budget=7).budget == 7
+    with pytest.raises(ValueError, match="twin_round"):
+        empty = tmp_path / "empty.jsonl"
+        with TraceWriter(empty):
+            pass
+        twin.load_runtime_trace(empty)
+
+
+# -- closed-loop differential gate (acceptance) -------------------------------
+
+
+def test_twin_round_events_carry_replay_contract(recorded_trace):
+    """The twin-grade records carry what replay needs: contiguous
+    per-node round indexes, per-round kv deltas, membership counts."""
+    events = read_trace(recorded_trace)
+    rounds = [e for e in events if e["event"] == "twin_round"]
+    nodes = [e for e in events if e["event"] == "twin_node"]
+    assert len(nodes) == FLEET
+    assert {n["node"] for n in nodes} == {f"n{i:02d}" for i in range(FLEET)}
+    required = {"node", "round", "ts", "duration_s", "targets", "live",
+                "dead", "kv_sent", "kv_applied", "heartbeat", "phi_max"}
+    per_node: dict[str, list[int]] = {}
+    for e in rounds:
+        assert required <= set(e), e
+        per_node.setdefault(e["node"], []).append(e["round"])
+    for name, idx in per_node.items():
+        assert idx == list(range(len(idx))), name  # contiguous from 0
+    # The bootstrap replication is visible: someone applied key-versions.
+    assert sum(e["kv_applied"] for e in rounds) > 0
+    assert sum(e["kv_sent"] for e in rounds) > 0
+
+
+def test_closed_loop_calibration_within_tolerance(recorded_trace):
+    """THE closed-loop differential gate: replay the recorded fleet
+    through the sim, fit the transfer function on the first half, and
+    pin that it predicts the runtime's HELD-OUT second half within the
+    record's stated tolerance (deterministic sim seeds, CPU-only)."""
+    trace = twin.load_runtime_trace(recorded_trace)
+    assert trace.n_nodes == FLEET
+    assert trace.skipped == 0
+    report = twin.replay(trace, seed=0)
+    # Every recorded runtime round has an aligned sim row.
+    assert len(report.rows) == len(trace.rounds)
+    assert report.sim_converged_round is not None
+    assert all(r["sim_mean_fraction"] is not None for r in report.rows)
+
+    cal = twin.fit_calibration(report)
+    assert cal.schema == twin.CALIBRATION_SCHEMA
+    assert cal.fit_rounds >= 2 and cal.holdout_rounds >= 2
+    # The fitted rate must be in the neighbourhood the gossip interval
+    # implies (the fleet cannot round faster than its ticker).
+    assert 0.5 / INTERVAL < cal.rounds_per_sec <= 1.05 / INTERVAL
+    # The stated-tolerance gate itself.
+    assert cal.holdout_wall_rel_err <= cal.tolerance, cal.to_dict()
+    assert cal.holdout_ok
+    # And the volume axis fitted (the fleet replicated real keys).
+    assert cal.kv_scale is not None and cal.kv_scale > 0
+
+    # Wall-clock predictions carry error bars in the right order.
+    pred = cal.predict_wall_seconds(100)
+    assert pred["lo"] <= pred["seconds"] <= pred["hi"]
+
+
+def test_torn_tail_trace_still_calibrates(recorded_trace, tmp_path):
+    """A crashed writer's trace (torn final line) must still replay —
+    that is the trace the twin most needs (ISSUE satellite)."""
+    torn = tmp_path / "torn.jsonl"
+    raw = recorded_trace.read_bytes()
+    torn.write_bytes(raw[: len(raw) - 17])  # mid-record tear
+    trace = twin.load_runtime_trace(torn)
+    assert trace.skipped == 1
+    report = twin.replay(trace, seed=0)
+    cal = twin.fit_calibration(report)
+    assert cal.holdout_ok
+
+
+# -- autotune -----------------------------------------------------------------
+
+
+def _synthetic_calibration(rps: float = 20.0) -> twin.CalibrationRecord:
+    return twin.CalibrationRecord(
+        schema=twin.CALIBRATION_SCHEMA, source="synthetic", n_nodes=8,
+        trace_rounds=40, fit_rounds=20, holdout_rounds=20,
+        rounds_per_sec=rps, rounds_per_sec_std=0.25,
+        round_duration_s=0.01, kv_scale=2.0, kv_scale_std=0.1,
+        sim_converged_round=4, holdout_wall_rel_err=0.01,
+        holdout_kv_rel_err=0.0, tolerance=0.35, holdout_ok=True,
+    )
+
+
+def _base_config() -> Config:
+    return Config(
+        node_id=NodeId(name="op", gossip_advertise_addr=("127.0.0.1", 1))
+    )
+
+
+TUNE_CFG = SimConfig(n_nodes=32, keys_per_node=16, budget=16, fanout=3)
+
+
+def test_autotune_eight_lanes_one_compile_and_roundtrip():
+    """Acceptance: >= 8 candidate lanes under ONE SweepSimulator
+    compile (the jit cache grows by at most one tracked-chunk entry),
+    and the recommended Config round-trips through serialization with
+    the calibration evidence attached."""
+    from aiocluster_tpu.sim import sweep as sweep_mod
+
+    cal = _synthetic_calibration()
+    base = _base_config()
+    slo = twin.SLO(convergence_deadline_s=60.0, fd_false_positive_budget=0.5)
+    before = sweep_mod._sweep_chunk_tracked._cache_size()
+    rec = twin.autotune(
+        slo, cal, base, TUNE_CFG,
+        fanout=[1, 2, 3, 4], phi_threshold=[8.0, 4.0],
+    )
+    after = sweep_mod._sweep_chunk_tracked._cache_size()
+    assert after - before <= 1  # one compile for the whole grid
+    assert len(rec.evidence["lanes"]) == 8
+
+    # The recommendation improves on (or matches) the default lane and
+    # carries the evidence: SLO + calibration + the scored lane table.
+    default = next(
+        lane for lane in rec.evidence["lanes"]
+        if lane["fanout"] == 3 and lane["phi_threshold"] == 8.0
+    )
+    assert rec.predicted["seconds"] <= default["predicted"]["seconds"]
+    assert rec.evidence["calibration"]["schema"] == twin.CALIBRATION_SCHEMA
+    assert rec.evidence["slo"]["convergence_deadline_s"] == 60.0
+
+    # Serialization round-trip: Config and SimConfig both survive.
+    blob = json.dumps(rec.to_dict())
+    rec2 = twin.Recommendation.from_dict(json.loads(blob), base)
+    assert rec2.config == rec.config
+    assert rec2.sim_config == rec.sim_config
+    assert rec2.predicted == rec.predicted
+    assert rec2.evidence["calibration"] == rec.evidence["calibration"]
+    # The tuned knobs landed in the runtime Config's fields.
+    assert rec.config.gossip_count == rec.sim_config.fanout
+    assert (
+        rec.config.failure_detector.phi_threshhold
+        == rec.sim_config.phi_threshold
+    )
+
+
+def test_autotune_infeasible_slo_raises_with_evidence():
+    cal = _synthetic_calibration()
+    slo = twin.SLO(convergence_deadline_s=1e-4)  # nothing can meet this
+    with pytest.raises(twin.AutotuneInfeasible) as exc:
+        twin.autotune(
+            slo, cal, _base_config(), TUNE_CFG,
+            fanout=[1, 2, 3, 4], phi_threshold=[8.0, 4.0],
+        )
+    lanes = exc.value.lanes
+    assert len(lanes) == 8 and all(not lane["feasible"] for lane in lanes)
+
+
+def test_autotune_validates_inputs():
+    cal = _synthetic_calibration()
+    with pytest.raises(ValueError, match="at least two"):
+        twin.autotune(
+            twin.SLO(convergence_deadline_s=10.0), cal, _base_config(),
+            TUNE_CFG,
+        )
+    with pytest.raises(ValueError, match="track"):
+        twin.autotune(
+            twin.SLO(convergence_deadline_s=10.0,
+                     fd_false_positive_budget=0.1),
+            cal, _base_config(),
+            SimConfig(n_nodes=16, track_failure_detector=False,
+                      track_heartbeats=False),
+            fanout=[1, 2],
+        )
+    with pytest.raises(ValueError, match="deadline"):
+        twin.SLO(convergence_deadline_s=0.0)
+    with pytest.raises(ValueError, match="budget"):
+        twin.SLO(convergence_deadline_s=1.0, fd_false_positive_budget=1.5)
+
+
+def test_slo_round_trips_with_fault_plan():
+    from aiocluster_tpu.faults.scenarios import split_brain
+
+    slo = twin.SLO(
+        convergence_deadline_s=12.0,
+        fd_false_positive_budget=0.2,
+        fault_plan=split_brain(2, start=1.0, heal=4.0),
+    )
+    back = twin.SLO.from_dict(json.loads(json.dumps(slo.to_dict())))
+    assert back == slo
+
+
+def test_cli_twin_subcommand(recorded_trace, tmp_path, capsys):
+    """``python -m aiocluster_tpu twin`` replays + calibrates from the
+    command line and persists the record (docs/twin.md's one-command
+    form; the autotune arm is covered in-process above)."""
+    from aiocluster_tpu.__main__ import main
+
+    out = tmp_path / "cal.json"
+    rc = main([
+        "twin", "--trace", str(recorded_trace),
+        "--calibration-out", str(out), "--cpu",
+    ])
+    assert rc == 0
+    printed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert printed["n_nodes"] == FLEET
+    assert printed["calibration"]["holdout_ok"] is True
+    assert twin.load_calibration(out).holdout_ok
+
+
+def test_cli_twin_flag_validation(recorded_trace, tmp_path, capsys):
+    """Operator mistakes fail loudly, not silently: tuning flags
+    without --deadline, a deadline with no candidate grid, and a
+    single-lane grid all report instead of dropping flags or dumping a
+    traceback."""
+    from aiocluster_tpu.__main__ import main
+
+    # Candidates without a deadline would be silently ignored — refuse.
+    rc = main(["twin", "--trace", str(recorded_trace), "--fanout", "1,2"])
+    assert rc == 2
+    assert "--deadline" in capsys.readouterr().err
+    rc = main(["twin", "--trace", str(recorded_trace), "--fd-budget", "0.2"])
+    assert rc == 2
+    # A deadline with nothing to sweep has no grid — refuse.
+    rc = main(["twin", "--trace", str(recorded_trace), "--deadline", "5"])
+    assert rc == 2
+    assert "candidate" in capsys.readouterr().err
+    # A single-lane "grid" surfaces through the JSON contract, not a
+    # traceback.
+    rc = main([
+        "twin", "--trace", str(recorded_trace), "--cpu",
+        "--deadline", "30", "--fanout", "3",
+    ])
+    assert rc == 1
+    printed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "two candidate lanes" in printed["autotune_error"]
+
+
+def test_sweep_result_objective_entry_point():
+    """SweepResult.evaluate/best_lane — the objective-evaluation entry
+    point autotune drives (None = infeasible, ties break to the earlier
+    lane)."""
+    from aiocluster_tpu.sim.sweep import SweepResult
+
+    result = SweepResult(
+        seeds=[0, 0, 0],
+        params={"fanout": [1, 2, 3]},
+        rounds_to_convergence=[30, 10, None],
+        metrics={
+            "version_spread": np.zeros(3),
+            "converged_owners": np.full(3, 8),
+            "mean_fraction": np.ones(3),
+            "min_fraction": np.ones(3),
+            "alive_count": np.full(3, 8),
+        },
+    )
+    scores = result.evaluate(lambda row: row["rounds_to_convergence"])
+    assert scores == [30, 10, None]
+    assert result.best_lane(lambda row: row["rounds_to_convergence"]) == (
+        1, 10.0,
+    )
+    # All-infeasible -> None; ties break to the earlier lane.
+    assert result.best_lane(lambda row: None) is None
+    assert result.best_lane(
+        lambda row: 1.0 if row["rounds_to_convergence"] else None
+    ) == (0, 1.0)

@@ -14,6 +14,8 @@ Code families (docs/static-analysis.md has the full catalogue):
 - ACT02x  JAX purity / tracer discipline (host syncs, impure jit bodies)
 - ACT03x  owner-write invariant (the paper's "only the owner mutates
           its keyspace" rule)
+- ACT04x  observability / trace-event discipline (literal event kinds —
+          the twin replay dispatcher routes on them)
 """
 
 from __future__ import annotations
@@ -192,6 +194,12 @@ def _compute_domains(relpath: str, src: str) -> set[str]:
         domains.add("runtime")
     if "/serve/" in p:
         domains.add("serve")
+    if "/obs/" in p:
+        domains.add("obs")
+    if "/twin/" in p:
+        domains.add("twin")
+    if "/faults/" in p:
+        domains.add("faults")
     if p.endswith("core/kvstate.py"):
         domains.add("kvstate")
     if p.endswith("core/cluster_state.py"):

@@ -1,0 +1,76 @@
+"""ACT04x — observability / trace-event discipline.
+
+The digital twin (docs/twin.md) replays recorded traces through a
+dispatcher keyed on each record's literal event kind: ``twin_node`` and
+``twin_round`` records drive replay, ``trace_header`` gates schema
+compatibility, everything else is provenance. An event emitted under a
+*computed* kind is invisible to that dispatcher — it lands in the file
+but no consumer will ever route it — so every ``TraceWriter`` emit site
+in the instrumented packages must name its kind as a string literal,
+where grep and the docs' event catalogue (docs/observability.md) can
+see it too.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, dotted_name, rule
+
+# Packages whose emit sites feed the replay dispatcher / the documented
+# event catalogue. tests/benchmarks stay out of scope (they fabricate
+# records on purpose).
+_TRACE_DOMAINS = {"runtime", "sim", "obs", "twin", "serve", "faults"}
+
+
+def _is_trace_receiver(node: ast.expr) -> bool:
+    """True for receivers that are trace writers by naming convention:
+    the final name segment contains ``trace`` (``self._trace``,
+    ``self._twin_trace``, ``self.trace``, a local ``trace``/``tw`` does
+    not count unless named so)."""
+    d = dotted_name(node)
+    if d is None:
+        return False
+    return "trace" in d.rsplit(".", 1)[-1].lower()
+
+
+@rule(
+    "ACT040",
+    "dynamic-trace-event-kind",
+    "trace event emitted under a non-literal kind",
+)
+def check_trace_event_literal(ctx: FileContext):
+    if ctx.tree is None or not (_TRACE_DOMAINS & ctx.domains):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "emit"):
+            continue
+        if not _is_trace_receiver(func.value):
+            continue
+        # The kind may ride positionally or as the named ``event=``
+        # parameter (TraceWriter.emit's signature) — either way it must
+        # be a string literal.
+        first = node.args[0] if node.args else None
+        if first is None:
+            for kw in node.keywords:
+                if kw.arg == "event":
+                    first = kw.value
+                    break
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            continue
+        receiver = dotted_name(func.value) or "<trace>"
+        what = (
+            "no kind at all"
+            if first is None
+            else "a computed kind"
+        )
+        yield ctx.finding(
+            node,
+            "ACT040",
+            f"{receiver}.emit(...) passes {what} — trace event kinds "
+            "must be string literals (a dynamic kind is invisible to "
+            "the twin replay dispatcher and the docs' event catalogue)",
+        )
