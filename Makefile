@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke prov-bench prov-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -118,6 +118,20 @@ twin-bench:
 twin-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/twin_bench.py --smoke
 
+# Propagation provenance (benchmarks/propagation_bench.py,
+# docs/observability.md "Propagation & provenance"): one marked write
+# on a real loopback fleet — GATES: the provenance join covers >= 99%
+# of the fleet's applies, the measured write→99%-visibility latency +
+# hop-depth p99 + the sim's wavefront prediction are all present, and
+# the sim staleness tensor bit-matches a host oracle on the int32 AND
+# u4r rungs, unsharded + 2-shard. The smoke (8 nodes, ~1 min CPU)
+# gates CI via `check`.
+prov-bench:
+	$(PY) benchmarks/propagation_bench.py
+
+prov-smoke:
+	$(PY) benchmarks/propagation_bench.py --smoke
+
 # Multihost smoke (benchmarks/multihost_bench.py): TWO real processes
 # join a localhost coordinator (4 virtual CPU devices each, gloo
 # collectives) and run the sharded lean profile — a measured rounds/s
@@ -132,12 +146,14 @@ multihost-smoke:
 # baseline, a serve-tier encode-once/ratio regression, an
 # overload-degradation regression (availability ratio, breaker
 # opening, epoch monotonicity), a durability regression (warm rejoin
-# ratio/speed, leave-vs-phi detection), or a twin regression (held-out
+# ratio/speed, leave-vs-phi detection), a twin regression (held-out
 # calibration error, one-compile autotune, recommendation-beats-
-# default) cannot land through this gate. (kernel-parity re-runs one test file that
+# default), or a propagation-provenance regression (join coverage,
+# measured-spread keys, staleness-oracle bit parity) cannot land
+# through this gate. (kernel-parity re-runs one test file that
 # test-all also covers — the explicit target keeps the merge gate for
 # kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke twin-smoke test-all
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke twin-smoke prov-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

@@ -328,9 +328,36 @@ def _run_twin(args: argparse.Namespace) -> int:
         )
         return 2
 
+    if args.check_drift is not None:
+        # Drift-monitor mode (docs/twin.md, twin/drift.py): verdict a
+        # FRESH trace against a STORED calibration — the cron-shaped
+        # loop; exits 1 on drift so the cron alerts.
+        cal = twin.load_calibration(args.check_drift)
+        verdict = twin.check_drift(
+            cal,
+            args.trace,
+            window=args.drift_window,
+            tolerance=args.tolerance,
+            seed=args.seed,
+        )
+        print(
+            json.dumps(
+                {
+                    "trace": args.trace,
+                    "calibration": args.check_drift,
+                    "drift": verdict.to_dict(),
+                }
+            ),
+            flush=True,
+        )
+        return 0 if verdict.ok else 1
+
     trace = twin.load_runtime_trace(args.trace)
     report = twin.replay(trace, seed=args.seed)
-    cal = twin.fit_calibration(report, tolerance=args.tolerance)
+    cal = twin.fit_calibration(
+        report,
+        tolerance=0.35 if args.tolerance is None else args.tolerance,
+    )
     if args.calibration_out:
         twin.save_calibration(args.calibration_out, cal)
     out = {
@@ -451,7 +478,7 @@ def main(argv: list[str] | None = None) -> int:
     twin.add_argument("--calibration-out", default=None, metavar="PATH",
                       help="write the fitted CalibrationRecord JSON here")
     twin.add_argument("--seed", type=int, default=0)
-    twin.add_argument("--tolerance", type=float, default=0.35,
+    twin.add_argument("--tolerance", type=float, default=None,
                       help="held-out validation tolerance recorded in "
                       "(and gated by) the calibration (default 0.35)")
     twin.add_argument("--deadline", type=float, default=None,
@@ -466,6 +493,14 @@ def main(argv: list[str] | None = None) -> int:
                       help="comma-separated phi-threshold candidates")
     twin.add_argument("--writes", default=None,
                       help="comma-separated writes-per-round candidates")
+    twin.add_argument("--check-drift", default=None, metavar="CALIBRATION",
+                      help="drift-monitor mode: verdict --trace against "
+                      "this stored CalibrationRecord (twin/drift.py); "
+                      "exits 1 on drift")
+    twin.add_argument("--drift-window", type=int, default=None,
+                      metavar="ROUNDS",
+                      help="rolling window for --check-drift (default: "
+                      "the stored record's fit window)")
     twin.add_argument("--cpu", action="store_true",
                       help="pin the CPU backend")
 

@@ -44,6 +44,7 @@ class ChaosHarness:
         config_overrides: dict | None = None,
         persist_root: str | None = None,
         trace=None,
+        prov_trace=None,
     ) -> None:
         self.n_nodes = n_nodes
         self.names = [f"n{i:02d}" for i in range(n_nodes)]
@@ -55,6 +56,12 @@ class ChaosHarness:
         # Cluster.trace_rounds — the recording side of the digital
         # twin's replay/calibrate loop. None traces nothing.
         self._trace = trace
+        # Propagation provenance (obs/prov.py): one shared TraceWriter
+        # attached to every member via Cluster.trace_provenance
+        # (restarts re-attach), joined fleet-wide by
+        # propagation_report(). None traces nothing — byte-identical
+        # member hot paths.
+        self._prov_trace = prov_trace
         # Durable-store root (docs/robustness.md): when set, every node
         # gets ``Config.persistence`` pointing at its own subdirectory,
         # and crash windows with ``recovery="warm"`` reboot FROM the
@@ -224,6 +231,8 @@ class ChaosHarness:
         )
         if self._trace is not None:
             cluster.trace_rounds(self._trace)
+        if self._prov_trace is not None:
+            cluster.trace_provenance(self._prov_trace)
         return cluster
 
     async def start(self) -> None:
@@ -445,6 +454,23 @@ class ChaosHarness:
                 return time.monotonic() - start
             await asyncio.sleep(self._interval / 2)
         raise TimeoutError(f"fleet did not converge within {timeout}s")
+
+    def propagation_report(self, *, key: str | None = None):
+        """Join the fleet's shared provenance trace into epidemic
+        spread trees (obs/prov.py, docs/observability.md "Propagation &
+        provenance"). Requires the harness to have been constructed
+        with ``prov_trace=``; ``key`` narrows the join to one key's
+        trees (the marked-write study). Reads the trace file tolerantly
+        — the writer flushes per line, so an in-flight fleet still
+        joins every completed record."""
+        if self._prov_trace is None:
+            raise ValueError(
+                "propagation_report() needs ChaosHarness(prov_trace=...) "
+                "— no provenance was recorded for this fleet"
+            )
+        from ..obs.prov import join_propagation
+
+        return join_propagation(self._prov_trace.path, key=key)
 
     def fault_counts(self) -> dict[str, int]:
         """Fleet-wide ``aiocluster_faults_injected_total`` by kind."""

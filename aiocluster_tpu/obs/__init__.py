@@ -9,6 +9,12 @@ this package is the measurement substrate both backends report through:
   optional asyncio HTTP ``/metrics`` endpoint (stdlib only).
 - ``trace``: a JSONL trace writer for per-round/per-event records, with a
   reader for round-trips and offline analysis.
+- ``prov``: the propagation-provenance collector — joins per-node
+  ``prov_write``/``prov_apply``/``prov_send`` trace events into
+  per-(key, version) epidemic spread trees (hop graphs, write→visible
+  latency percentiles).
+- ``flightrec``: the always-on bounded ring of recent annotated events
+  every Cluster carries for post-mortems (``/debug/flightrec``).
 - ``profiling``: the XLA device trace + wall-clock section timer that
   used to live in ``utils/profiling.py``.
 
@@ -20,32 +26,42 @@ in every BENCH record. docs/observability.md catalogues the metric names.
 """
 
 from .expo import MetricsHTTPServer, render_prometheus
+from .flightrec import FlightRecorder
 from .profiling import SectionTimer, device_trace
+from .prov import PropagationReport, SpreadTree, join_propagation
 from .registry import (
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
     default_registry,
+    percentile_of_sorted,
 )
-from .sim import SimMetrics, SweepMetrics
+from .sim import SimMetrics, SweepMetrics, marked_write_state, wavefront_series
 from .trace import TRACE_SCHEMA, TraceScan, TraceWriter, read_trace, scan_trace
 
 __all__ = (
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsHTTPServer",
     "MetricsRegistry",
+    "PropagationReport",
     "SectionTimer",
     "SimMetrics",
+    "SpreadTree",
     "SweepMetrics",
     "TRACE_SCHEMA",
     "TraceScan",
     "TraceWriter",
     "default_registry",
     "device_trace",
+    "join_propagation",
+    "marked_write_state",
+    "percentile_of_sorted",
     "read_trace",
     "render_prometheus",
     "scan_trace",
+    "wavefront_series",
 )

@@ -1,0 +1,83 @@
+"""Flight recorder: a bounded ring of recent annotated events per node.
+
+Metrics aggregate away the *sequence* of what happened; traces are
+opt-in and cost a disk write per event. The flight recorder is the
+third shape the post-mortem needs: an ALWAYS-ON, bounded, in-memory
+ring of the last few hundred notable events — handshake outcomes, FD
+flips, breaker transitions, guard rejections, applies, lifecycle steps
+— dumped on demand (``Cluster.flight_record()``,
+``GET /debug/flightrec`` on the serve tier) when an operator asks "what
+did this node just live through?".
+
+Cost discipline (why always-on is safe): ``note()`` is two clock reads,
+a small tuple, and a ``deque.append`` with ``maxlen`` eviction — no
+formatting, no I/O, no allocation proportional to anything; events are
+rendered to dicts only at ``dump()``. The ring is bounded by
+construction, so a chatty subsystem can age out history but never grow
+memory.
+
+Timestamps carry BOTH clocks: ``t_mono`` (``time.monotonic`` — the
+clock the provenance tracer and serve_bench subtract across processes
+on loopback fleets) and ``ts`` (wall — what the operator correlates
+with their logs).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+# Default ring capacity. A gossip round produces O(fanout) handshake
+# events, so 512 covers minutes of quiet operation and the last dozens
+# of seconds of a storm — the window a post-mortem actually reads.
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Bounded ring buffer of (t_mono, ts, kind, fields) events."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        if capacity < 1:
+            raise ValueError("flight recorder capacity must be >= 1")
+        self.capacity = capacity
+        self._ring: deque[tuple[float, float, str, dict]] = deque(
+            maxlen=capacity
+        )
+        # deque.append is atomic, but dump() iterates — the lock keeps a
+        # /metrics-thread dump from racing an asyncio-callback append.
+        self._lock = threading.Lock()
+        self.events_noted = 0  # total ever, not just retained
+
+    def note(self, kind: str, **fields: object) -> None:
+        """Record one event. Hot-path safe: no formatting, no I/O."""
+        entry = (time.monotonic(), time.time(), kind, fields)
+        with self._lock:
+            self._ring.append(entry)
+            self.events_noted += 1
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def dump(self) -> list[dict]:
+        """The retained events, oldest first, as JSON-ready dicts (the
+        one place entries are formatted)."""
+        with self._lock:
+            entries = list(self._ring)
+            total = self.events_noted
+        out = []
+        dropped = total - len(entries)
+        for t_mono, ts, kind, fields in entries:
+            out.append(
+                {
+                    "t_mono": round(t_mono, 6),
+                    "ts": round(ts, 6),
+                    "kind": kind,
+                    **fields,
+                }
+            )
+        if out:
+            # Honesty marker on the first retained record: how many
+            # older events the ring has already aged out.
+            out[0] = {"evicted_before": dropped, **out[0]}
+        return out

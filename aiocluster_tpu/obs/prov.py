@@ -1,0 +1,322 @@
+"""Propagation provenance: join per-node traces into epidemic spread trees.
+
+The paper's whole value proposition is epidemic dissemination — a write
+on one node becomes visible everywhere within a bounded number of
+anti-entropy rounds — and this module makes that process *observable*:
+how did key K version V reach node X, along which hops, and how long
+after the owner's write?
+
+Recording side (runtime/engine.py + runtime/cluster.py, attached via
+``Cluster.trace_provenance`` / ``ChaosHarness(prov_trace=...)`` — OFF
+by default, byte-identical hot paths when detached):
+
+- ``prov_write``  — origin: the owner wrote (key, version) at ``t_mono``.
+- ``prov_apply``  — receiver side: ``node`` applied owner's (key,
+  version); ``from_peer`` names the peer the delta came from when the
+  receiver knows it (initiator-side applies — it dialed the peer; Leave
+  announcements — the message names the leaver) and is null on
+  responder-side applies (a Syn carries no sender identity and the wire
+  stays unchanged).
+- ``prov_send``   — sender side for exactly that blind spot: when an
+  initiator packs the Ack delta it knows the responder it is talking
+  to, so it records (to_peer, key, version, t_mono) and the collector
+  joins the responder's null-``from_peer`` apply to the closest
+  preceding matching send.
+
+Clock contract: ``t_mono`` is CLOCK_MONOTONIC, comparable across the
+processes of one machine — the same assumption serve_bench's
+cross-process watch latencies already rely on (loopback fleets). Wall
+``ts`` rides every record for log correlation only; no clock protocol
+is introduced.
+
+``join_propagation`` groups the events per (owner, key, version) and
+builds one :class:`SpreadTree` each: per-node first-visibility latency
+(write→apply), the hop graph (parent pointers resolved from
+``from_peer`` or the send join), and hop depths (graph distance from
+the owner). ``benchmarks/propagation_bench.py`` gates on it;
+``ChaosHarness.propagation_report()`` is the fleet-level entry point.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .registry import percentile_of_sorted
+
+# A send strictly newer than the apply it would explain cannot be its
+# cause; a send this much older than the apply (seconds) is a previous
+# round's traffic. The window only disambiguates CONCURRENT senders of
+# the same kv — first-apply-wins means at most one send actually landed.
+_SEND_JOIN_HORIZON_S = 30.0
+
+
+@dataclass
+class NodeVisibility:
+    """One node's first sighting of a (owner, key, version)."""
+
+    node: str
+    t_mono: float
+    from_peer: str | None  # named by the receiver, or resolved via send join
+    join: str  # "origin" | "direct" | "send" | "unjoined"
+    latency_s: float | None = None  # write -> first visibility
+    hop: int | None = None  # graph distance from the owner
+
+
+@dataclass
+class SpreadTree:
+    """The epidemic spread of one (owner, key, version)."""
+
+    owner: str
+    key: str
+    version: int
+    origin_t: float | None  # the owner's prov_write t_mono (None if unseen)
+    nodes: dict[str, NodeVisibility] = field(default_factory=dict)
+
+    # -- derived --------------------------------------------------------------
+
+    def applies(self) -> list[NodeVisibility]:
+        """Non-owner visibilities (the fleet's applies), time order."""
+        return sorted(
+            (v for v in self.nodes.values() if v.node != self.owner),
+            key=lambda v: v.t_mono,
+        )
+
+    def joined_fraction(self, fleet_size: int) -> float:
+        """Fraction of the non-owner fleet whose apply the collector
+        joined into this tree — the prov-smoke gate reads this."""
+        expected = max(fleet_size - 1, 1)
+        return len(self.applies()) / expected
+
+    def latencies(self) -> list[float]:
+        return sorted(
+            v.latency_s for v in self.applies() if v.latency_s is not None
+        )
+
+    def visibility_percentile(self, q: float) -> float:
+        """Write→visible latency at quantile ``q`` over the fleet's
+        applies (nearest-rank — the repo's shared convention)."""
+        return percentile_of_sorted(self.latencies(), q)
+
+    def hop_histogram(self) -> dict[int, int]:
+        """hop depth -> node count (owner at 0; unresolved hops are
+        excluded — ``unjoined_hops`` counts them)."""
+        hist: dict[int, int] = {}
+        for v in self.nodes.values():
+            if v.hop is not None:
+                hist[v.hop] = hist.get(v.hop, 0) + 1
+        return dict(sorted(hist.items()))
+
+    def hops_percentile(self, q: float) -> float:
+        hops = sorted(
+            v.hop for v in self.applies() if v.hop is not None
+        )
+        return percentile_of_sorted(hops, q)
+
+    @property
+    def unjoined_hops(self) -> int:
+        """Applies whose hop parent could not be resolved (no
+        ``from_peer`` and no matching send — e.g. a torn trace)."""
+        return sum(1 for v in self.applies() if v.hop is None)
+
+    def summary(self, fleet_size: int | None = None) -> dict:
+        out = {
+            "owner": self.owner,
+            "key": self.key,
+            "version": self.version,
+            "applies": len(self.applies()),
+            "unjoined_hops": self.unjoined_hops,
+            "hop_histogram": {
+                str(k): v for k, v in self.hop_histogram().items()
+            },
+        }
+        lats = self.latencies()
+        if lats:
+            out["visibility_p50_s"] = round(
+                percentile_of_sorted(lats, 0.50), 6
+            )
+            out["visibility_p99_s"] = round(
+                percentile_of_sorted(lats, 0.99), 6
+            )
+            out["visibility_max_s"] = round(lats[-1], 6)
+        hops = sorted(
+            v.hop for v in self.applies() if v.hop is not None
+        )
+        if hops:
+            out["hops_p50"] = percentile_of_sorted(hops, 0.50)
+            out["hops_p99"] = percentile_of_sorted(hops, 0.99)
+            out["hops_max"] = hops[-1]
+        if fleet_size is not None:
+            out["joined_fraction"] = round(
+                self.joined_fraction(fleet_size), 4
+            )
+        return out
+
+
+@dataclass
+class PropagationReport:
+    """All spread trees joined from one trace (or trace set)."""
+
+    trees: dict[tuple[str, str, int], SpreadTree]
+    records_seen: int = 0
+
+    def tree(
+        self, *, owner: str, key: str, version: int | None = None
+    ) -> SpreadTree | None:
+        """The tree for (owner, key) — the highest version unless one is
+        named (a marked write is usually the key's latest)."""
+        matches = [
+            t
+            for (o, k, _v), t in self.trees.items()
+            if o == owner and k == key
+        ]
+        if version is not None:
+            matches = [t for t in matches if t.version == version]
+        if not matches:
+            return None
+        return max(matches, key=lambda t: t.version)
+
+
+def _records_of(traces) -> list[dict]:
+    """Accept a record list, one path, or an iterable of paths; paths
+    are read tolerantly (a torn tail must not lose the whole join)."""
+    from .trace import read_trace
+
+    if isinstance(traces, (str, Path)):
+        return read_trace(traces, skip_invalid=True)
+    traces = list(traces)
+    if traces and isinstance(traces[0], (str, Path)):
+        records: list[dict] = []
+        for p in traces:
+            records.extend(read_trace(p, skip_invalid=True))
+        return records
+    return traces
+
+
+def join_propagation(traces, *, key: str | None = None) -> PropagationReport:
+    """Join provenance events into per-(owner, key, version) spread
+    trees (module docstring). ``traces`` is a list of parsed records, a
+    trace path, or several paths (fleets usually share ONE lock-
+    serialized writer, so one path is the common case). ``key`` filters
+    the join to one key's trees (a marked-write study skips the
+    bootstrap traffic entirely)."""
+    records = _records_of(traces)
+    writes: dict[tuple[str, str, int], dict] = {}
+    applies: list[dict] = []
+    sends: list[dict] = []
+    for rec in records:
+        event = rec.get("event")
+        if event not in ("prov_write", "prov_apply", "prov_send"):
+            continue
+        if key is not None and rec.get("key") != key:
+            continue
+        if event == "prov_write":
+            ident = (rec["node"], rec["key"], int(rec["version"]))
+            prev = writes.get(ident)
+            # First write wins: re-journaled or duplicate records must
+            # not move the origin timestamp later.
+            if prev is None or rec["t_mono"] < prev["t_mono"]:
+                writes[ident] = rec
+        elif event == "prov_apply":
+            applies.append(rec)
+        else:
+            sends.append(rec)
+
+    trees: dict[tuple[str, str, int], SpreadTree] = {}
+
+    def tree_for(owner: str, k: str, version: int) -> SpreadTree:
+        ident = (owner, k, version)
+        t = trees.get(ident)
+        if t is None:
+            w = writes.get(ident)
+            t = trees[ident] = SpreadTree(
+                owner=owner,
+                key=k,
+                version=version,
+                origin_t=None if w is None else float(w["t_mono"]),
+            )
+            if w is not None:
+                t.nodes[owner] = NodeVisibility(
+                    node=owner,
+                    t_mono=float(w["t_mono"]),
+                    from_peer=None,
+                    join="origin",
+                    latency_s=0.0,
+                    hop=0,
+                )
+        return t
+
+    # Writes with no applies still deserve a (trivial) tree.
+    for owner, k, version in writes:
+        tree_for(owner, k, version)
+
+    # Sends indexed by (owner, key, version, to_peer) for the
+    # responder-side join; each list kept in time order.
+    send_index: dict[tuple[str, str, int, str], list[dict]] = {}
+    for rec in sends:
+        send_index.setdefault(
+            (rec["owner"], rec["key"], int(rec["version"]), rec["to_peer"]),
+            [],
+        ).append(rec)
+    for lst in send_index.values():
+        lst.sort(key=lambda r: r["t_mono"])
+
+    for rec in sorted(applies, key=lambda r: r["t_mono"]):
+        owner = rec["owner"]
+        k = rec["key"]
+        version = int(rec["version"])
+        node = rec["node"]
+        t = tree_for(owner, k, version)
+        if node in t.nodes:
+            continue  # first visibility wins (idempotent re-applies)
+        t_mono = float(rec["t_mono"])
+        from_peer = rec.get("from_peer")
+        join = "direct" if from_peer else "unjoined"
+        if not from_peer:
+            # Responder-side apply: the closest preceding matching send
+            # names the initiator that carried the kv here.
+            candidates = send_index.get((owner, k, version, node), ())
+            best = None
+            for s in candidates:
+                if s["t_mono"] > t_mono:
+                    break
+                if t_mono - s["t_mono"] <= _SEND_JOIN_HORIZON_S:
+                    best = s
+            if best is not None:
+                from_peer = best["node"]
+                join = "send"
+        latency = None
+        if t.origin_t is not None:
+            latency = max(t_mono - t.origin_t, 0.0)
+        t.nodes[node] = NodeVisibility(
+            node=node,
+            t_mono=t_mono,
+            from_peer=from_peer,
+            join=join,
+            latency_s=latency,
+        )
+
+    # Hop depths: graph distance from the owner along parent pointers.
+    # Parents may resolve in any order (a child's apply can be recorded
+    # before its parent's when the parent was the origin's responder),
+    # so iterate to a fixed point; unresolved chains stay None.
+    for t in trees.values():
+        changed = True
+        guard = len(t.nodes) + 1  # cycle guard: depth can't exceed N
+        while changed and guard:
+            changed = False
+            guard -= 1
+            for v in t.nodes.values():
+                if v.hop is not None:
+                    continue
+                if v.from_peer == t.owner or (
+                    v.from_peer is None and v.join == "origin"
+                ):
+                    v.hop = 0 if v.join == "origin" else 1
+                    changed = True
+                elif v.from_peer is not None:
+                    parent = t.nodes.get(v.from_peer)
+                    if parent is not None and parent.hop is not None:
+                        v.hop = parent.hop + 1
+                        changed = True
+    return PropagationReport(trees=trees, records_seen=len(records))

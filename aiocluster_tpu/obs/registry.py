@@ -31,6 +31,50 @@ DEFAULT_BUCKETS = (
 )
 
 
+def percentile_of_sorted(sorted_values, q: float) -> float:
+    """Nearest-rank percentile of an already-sorted sequence of raw
+    samples: ``idx = round(q * (n - 1))`` — THE percentile convention
+    every measured figure in this repo shares (bench watch latencies,
+    the sim staleness picks, the propagation report). NaN on empty
+    input, so a missing series reads as missing rather than 0."""
+    n = len(sorted_values)
+    if not n:
+        return float("nan")
+    idx = min(n - 1, int(q * (n - 1) + 0.5))
+    return sorted_values[idx]
+
+
+def _bucket_quantile(
+    buckets: list[tuple[float, int]], count: int, q: float
+) -> float | None:
+    """Bucket-interpolated quantile over ONE atomic ``stats()`` read —
+    the shared math behind ``_HistogramValue.quantile`` and
+    ``snapshot()``'s p50/p99 (both quantiles of a snapshot entry come
+    from the same read as its count/sum, so a concurrent ``observe()``
+    can never make them disagree). Prometheus ``histogram_quantile``
+    conventions: a positive first bound interpolates from 0, a
+    non-positive first bound is returned as-is (0 is not a valid lower
+    anchor below it), and a rank landing in the +Inf bucket clamps to
+    the highest finite bound."""
+    if count == 0:
+        return None
+    rank = q * count
+    prev_bound = 0.0
+    prev_cum = 0
+    for i, (bound, cum) in enumerate(buckets):
+        if rank <= cum:
+            if bound == float("inf"):
+                return prev_bound  # open-ended bucket: clamp
+            if i == 0 and bound <= 0:
+                return bound
+            if cum == prev_cum:  # defensive: rank == cum == prev_cum
+                return bound
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = bound, cum
+    return prev_bound  # unreachable: +Inf always covers the rank
+
+
 def _validate_name(name: str) -> str:
     if not name or not all(c.isalnum() or c in "_:" for c in name):
         raise ValueError(f"invalid metric name: {name!r}")
@@ -206,6 +250,17 @@ class _HistogramValue:
         Prometheus exposition shape."""
         return self.stats()[0]
 
+    def quantile(self, q: float) -> float | None:
+        """Bucket-interpolated quantile in [0, 1] — the
+        ``histogram_quantile`` convention (see ``_bucket_quantile``),
+        computed server-side so round-latency/RTT/phi percentiles are a
+        registry read, not a bench-only recomputation over raw samples.
+        None when the histogram is empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile q must be in [0, 1]")
+        buckets, _, count = self.stats()
+        return _bucket_quantile(buckets, count, q)
+
 
 class Histogram(_Family):
     """Distribution with cumulative buckets (latencies, phi values)."""
@@ -231,6 +286,11 @@ class Histogram(_Family):
 
     def observe(self, value: float) -> None:
         self.labels().observe(value)
+
+    def quantile(self, q: float) -> float | None:
+        """Label-less convenience: bucket-interpolated quantile of the
+        0-label child (see ``_HistogramValue.quantile``)."""
+        return self.labels().quantile(q)
 
 
 class MetricsRegistry:
@@ -297,8 +357,11 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict[str, object]:
         """Flat JSON-friendly view: one entry per (family, label set).
-        Histograms compress to {count, sum, mean}; this is the shape
-        bench.py embeds in BENCH records."""
+        Histograms compress to {count, sum, mean, p50, p99}
+        (bucket-interpolated quantiles — so latency/RTT/phi percentiles
+        ride every snapshot, bench ``metrics_snapshot`` embeds
+        included, instead of being recomputed per consumer); this is
+        the shape bench.py embeds in BENCH records."""
         out: dict[str, object] = {}
         for family in self.families():
             for values, child in family.samples():
@@ -309,11 +372,18 @@ class MetricsRegistry:
                         for n, v in zip(family.label_names, values)
                     ) + "}"
                 if isinstance(child, _HistogramValue):
-                    _, total_sum, count = child.stats()
+                    # ONE atomic stats() read feeds count, sum AND both
+                    # quantiles — an observe() landing mid-snapshot can
+                    # never make the entry disagree with itself.
+                    buckets, total_sum, count = child.stats()
+                    p50 = _bucket_quantile(buckets, count, 0.50)
+                    p99 = _bucket_quantile(buckets, count, 0.99)
                     out[key] = {
                         "count": count,
                         "sum": round(total_sum, 9),
                         "mean": round(total_sum / count, 9) if count else None,
+                        "p50": None if p50 is None else round(p50, 9),
+                        "p99": None if p99 is None else round(p99, 9),
                     }
                 else:
                     out[key] = child.value  # type: ignore[attr-defined]

@@ -30,6 +30,13 @@ from .trace import TraceWriter
 
 # Gauge/counter names shared by both sim engines, labelled by engine
 # ("xla", "host-native") so a process driving both stays legible.
+# Percentiles the sim staleness tensor is compressed to — THE single
+# source for both the sampler keys (``staleness_p<label>``, computed on
+# device by ops.gossip.staleness_percentiles, which imports this) and
+# the ``aiocluster_sim_staleness_rounds{pct=}`` gauge export below.
+# "100" is the max — version_spread in round units.
+STALENESS_PCTS = (("50", 0.50), ("99", 0.99), ("100", 1.0))
+
 _SAMPLE_GAUGES = (
     ("aiocluster_sim_tick", "Current simulated gossip round"),
     ("aiocluster_sim_mean_fraction", "Mean replicated fraction over alive pairs"),
@@ -56,6 +63,7 @@ class SimMetrics:
         engine: str = "xla",
         bytes_per_kv: float = 35.0,
         start_tick: int = 0,
+        writes_per_round: int = 0,
     ) -> None:
         if stride < 1:
             raise ValueError("metrics stride must be >= 1")
@@ -102,6 +110,21 @@ class SimMetrics:
             "(bounded; sim/simulator.py BoundedFnCache)",
             labels=("engine",),
         ).labels(engine)
+        # Staleness normalization: the staleness tensor counts
+        # key-versions behind; at a steady write rate of w versions per
+        # owner per round, lag/w IS rounds-behind (w <= 1, including
+        # the pure-convergence study's w = 0, leaves the raw lag —
+        # versions are rounds there). Kept as a host-side divide at
+        # flush so the device/oracle parity stays on exact integers.
+        self._staleness_scale = max(int(writes_per_round), 1)
+        self._staleness = self.registry.gauge(
+            "aiocluster_sim_staleness_rounds",
+            "Fleet staleness distribution: per-node rounds-behind-"
+            "owner-max-version (the staleness tensor's nearest-rank "
+            "percentile; pct=100 is the max — version_spread in round "
+            "units)",
+            labels=("engine", "pct"),
+        )
         self._state_bytes = self.registry.gauge(
             "aiocluster_sim_state_bytes",
             "Planned resident SimState bytes for this run's memory-"
@@ -245,10 +268,106 @@ class SimMetrics:
             ):
                 if short in last:
                     self._gauges[gauge].set(last[short])
+            for pct, _ in STALENESS_PCTS:
+                key = f"staleness_p{pct}"
+                if key in last:
+                    self._staleness.labels(self.engine, pct).set(
+                        last[key] / self._staleness_scale
+                    )
         self._export_pallas_fallbacks()
         return [
             {k: v for k, v in s.items() if k != "_wall"} for s in self.samples
         ]
+
+
+def marked_write_state(cfg, owner: int = 0):
+    """A fully converged fleet the instant after ``owner`` published ONE
+    new version — the sim-side analogue of the propagation benchmark's
+    marked write on a settled loopback fleet (docs/observability.md
+    "Propagation & provenance").
+
+    Built from ``init_state`` (heartbeats/FD fields at their boot
+    values) with the watermark matrix overridden to full convergence at
+    the old versions and ``max_version[owner]`` bumped by one. Supports
+    every rung: the packed u4 residual form is residual 0 everywhere
+    except the owner's column (one version behind off-diagonal)."""
+    import jax.numpy as jnp
+
+    from ..sim.packed import pack_u4
+    from ..sim.state import VERSION_LIMITS, init_state
+
+    n = cfg.n_nodes
+    if not 0 <= owner < n:
+        raise ValueError(f"owner {owner} outside [0, {n})")
+    keys = cfg.keys_per_node
+    if keys + 1 >= VERSION_LIMITS[cfg.version_dtype]:
+        raise ValueError(
+            f"marked write would overflow version_dtype="
+            f"{cfg.version_dtype} (keys_per_node={keys})"
+        )
+    state = init_state(cfg)
+    mv = jnp.full((n,), keys, jnp.int32).at[owner].add(1)
+    if cfg.version_dtype == "u4r":
+        # Residual space: converged = 0; the marked write leaves every
+        # non-owner observer exactly one version behind the owner.
+        col = jnp.arange(n)[None, :] == owner
+        row = jnp.arange(n)[:, None] == owner
+        w = pack_u4(jnp.where(col & ~row, 1, 0))
+    else:
+        w = jnp.full((n, n), keys, jnp.dtype(cfg.version_dtype))
+        w = w.at[owner, owner].set(keys + 1)
+    return state.replace(w=w, max_version=mv)
+
+
+def wavefront_series(
+    cfg,
+    *,
+    owner: int = 0,
+    seed: int = 0,
+    max_rounds: int = 512,
+    threshold: float = 0.99,
+) -> dict:
+    """The marked write's epidemic wavefront: fraction of alive nodes
+    that see owner's new version, measured after EVERY round — the
+    tensor analogue of the runtime provenance tracer's write→visible
+    latencies, letting twin comparisons line up *curves*, not just
+    convergence round counts.
+
+    A study helper, not a hot loop: it steps a chunk=1 Simulator and
+    syncs one (N,) column per round. Returns ``{"fractions": [...],
+    "rounds_to_threshold": r | None, "threshold": t}`` where
+    ``fractions[k]`` is visibility after k rounds (``fractions[0]`` is
+    the pre-gossip state: just the owner)."""
+    import numpy as np
+
+    from ..sim.packed import watermarks_i32
+    from ..sim.simulator import Simulator
+
+    if not 0.0 < threshold <= 1.0:
+        raise ValueError("threshold must be in (0, 1]")
+    state = marked_write_state(cfg, owner)
+    sim = Simulator(cfg, seed=seed, chunk=1, state=state)
+    target = int(cfg.keys_per_node) + 1
+
+    def fraction() -> float:
+        wv = np.asarray(watermarks_i32(sim.state))
+        alive = np.asarray(sim.state.alive)
+        seen = (wv[:, owner] >= target) & alive
+        return float(seen.sum()) / float(max(alive.sum(), 1))
+
+    fractions = [fraction()]
+    rounds_to_threshold = None
+    for rnd in range(1, max_rounds + 1):
+        sim.run(1)
+        fractions.append(fraction())
+        if fractions[-1] >= threshold:
+            rounds_to_threshold = rnd
+            break
+    return {
+        "fractions": fractions,
+        "rounds_to_threshold": rounds_to_threshold,
+        "threshold": threshold,
+    }
 
 
 class SweepMetrics:

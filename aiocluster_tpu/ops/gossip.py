@@ -1998,13 +1998,67 @@ def version_spread(
     key-versions the most stale alive replica still misses. 0 at full
     convergence; the obs layer samples it as the sim's staleness-depth
     gauge (companion to convergence_metrics' fractions, which normalise
-    this away)."""
+    this away).
+
+    One lag computation serves both staleness views: this is the max of
+    the per-node :func:`staleness_tensor` (a masked-lag fix lands in
+    one place, not two)."""
+    return staleness_tensor(state, axis_name).max()
+
+
+def staleness_tensor(
+    state: SimState, axis_name: str | None = None
+) -> jax.Array:
+    """Per-node staleness: how many key-versions node ``i`` lags behind
+    the alive owner it is MOST behind on — ``max_j alive
+    (max_version[j] - w[i, j])`` — as an (N,) int32 vector (0 for dead
+    observers and at full convergence). The per-node refinement of
+    :func:`version_spread` (whose value is this tensor's max): the
+    fleet-staleness distribution an operator alerts on, not just its
+    worst point.
+
+    Rung-agnostic: the packed u4 residual rung decodes through the
+    sanctioned widen helper (sim/packed.py) — a metrics pass sampled at
+    the obs stride, not the hot loop. Sharded meshes reduce each
+    observer row's max over local owner columns, then ``pmax`` across
+    shards — the tensor is bit-identical to the unsharded one
+    (benchmarks/propagation_bench.py pins it against a host oracle)."""
     n_local = state_n_local(state)
     owners = _local_owner_ids(n_local, axis_name)
     needed = state.max_version[owners][None, :]
     pair_mask = state.alive[:, None] & state.alive[owners][None, :]
     lag = jnp.where(pair_mask, needed - watermarks_i32(state, owners), 0)
-    spread = jnp.maximum(lag.max(), 0)
+    per_node = jnp.maximum(lag.max(axis=1), 0)
     if axis_name is not None:
-        spread = lax.pmax(spread, axis_name)
-    return spread
+        per_node = lax.pmax(per_node, axis_name)
+    return per_node
+
+
+def staleness_percentiles(
+    state: SimState, axis_name: str | None = None
+) -> dict[str, jax.Array]:
+    """The staleness tensor compressed to nearest-rank percentile
+    scalars (``staleness_p50``/``p99``/``p100``) — the stride-sample
+    bundle's keys, still device values (no host sync). Rank indices are
+    host arithmetic on the STATIC node count, and the picks index one
+    device sort — so the values bit-match a host oracle doing
+    ``np.sort`` + the same nearest-rank formula
+    (obs.registry.percentile_of_sorted) on the widened state. The
+    percentile set is single-sourced with the gauge exporter
+    (obs.sim.STALENESS_PCTS)."""
+    from ..obs.sim import STALENESS_PCTS
+
+    per_node = staleness_tensor(state, axis_name)
+    ordered = jnp.sort(per_node)
+    n = int(per_node.shape[0])
+    return {
+        f"staleness_p{label}": ordered[_nearest_rank(n, q)]
+        for label, q in STALENESS_PCTS
+    }
+
+
+def _nearest_rank(n: int, q: float) -> int:
+    """Nearest-rank pick index over n sorted values — the same formula
+    as obs.registry.percentile_of_sorted, on pure host ints (n is the
+    STATIC node count; no device value is touched)."""
+    return min(n - 1, int(q * (n - 1) + 0.5))

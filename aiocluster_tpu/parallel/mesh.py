@@ -25,6 +25,7 @@ from ..ops.gossip import (
     fd_phase_engaged,
     pallas_path_engaged,
     sim_step,
+    staleness_percentiles,
     version_spread,
 )
 from ..sim.config import SimConfig
@@ -382,6 +383,11 @@ def sharded_metrics_fn(mesh: Mesh):
     def metrics(state: SimState):
         out = convergence_metrics(state, axis_name=AXIS)
         out["version_spread"] = version_spread(state, axis_name=AXIS)
+        # Per-node staleness percentiles: each shard maxes its local
+        # owner columns, pmax makes the tensor global, and the sort +
+        # static rank picks replicate — bit-identical to the unsharded
+        # sample (the propagation bench's oracle gate).
+        out.update(staleness_percentiles(state, axis_name=AXIS))
         return out
 
     return jax.jit(metrics)

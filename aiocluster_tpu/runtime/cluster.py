@@ -35,6 +35,7 @@ from ..core.messages import (
     SynAck,
 )
 from ..core.values import VersionedValue
+from ..obs.flightrec import FlightRecorder
 from ..obs.registry import MetricsRegistry, default_registry
 from ..obs.trace import TraceWriter
 from ..utils.clock import utc_now
@@ -114,6 +115,13 @@ class Cluster:
         # and per membership transition.
         self._metrics = metrics if metrics is not None else default_registry()
         self._trace = trace
+        # Flight recorder (obs/flightrec.py): ALWAYS on — a bounded
+        # in-memory ring of recent notable events (handshake outcomes,
+        # FD flips, breaker transitions, guard rejections, applies,
+        # lifecycle), dumped post-mortem via flight_record() and the
+        # serve tier's /debug/flightrec. note() is two clock reads and
+        # a deque append; nothing formats until a dump is asked for.
+        self._flightrec = FlightRecorder()
         self._lifecycle_events = self._metrics.counter(
             "aiocluster_lifecycle_events_total",
             "Node lifecycle events: rejoin_clean (warm rejoin, previous "
@@ -151,12 +159,20 @@ class Cluster:
                 if self._recovered.clean:
                     generation = self._recovered.generation
                     self._lifecycle_events.labels("rejoin_clean").inc()
+                    self._flightrec.note(
+                        "lifecycle", event="rejoin_clean",
+                        generation=generation,
+                    )
                 else:
                     # load() already seeded the guard with the store's
                     # floor, so this is strictly above every generation
                     # the store ever recorded.
                     generation = next_generation_id()
                     self._lifecycle_events.labels("rejoin_unclean").inc()
+                    self._flightrec.note(
+                        "lifecycle", event="rejoin_unclean",
+                        generation=generation,
+                    )
                 config = _dc_replace(
                     config,
                     node_id=_dc_replace(
@@ -206,6 +222,7 @@ class Cluster:
             self._failure_detector,
             on_key_change=self._emit_key_change,
             metrics=self._metrics,
+            flightrec=self._flightrec,
         )
         transport = GossipTransport(
             max_payload_size=config.max_payload_size,
@@ -291,6 +308,7 @@ class Cluster:
                     * self.effective_gossip_interval
                 ),
                 metrics=self._metrics,
+                on_transition=self._note_breaker_transition,
             )
         self._pool = ConnectionPool(
             self._transport.connect,
@@ -362,6 +380,13 @@ class Cluster:
         self._twin_prev_sent = 0
         self._twin_prev_applied = 0
         self._last_phi_max = 0.0
+
+        # Propagation provenance (obs/prov.py, docs/observability.md):
+        # attached by trace_provenance(), off by default — detached
+        # clusters run byte-identical paths (the engine's prov branches
+        # and the per-handshake peer-name resolution below are all
+        # gated on this).
+        self._prov: TraceWriter | None = None
 
         # Seed our own state: the recovered keyspace (when a store was
         # restored), one heartbeat, then initial keys (idempotent — a
@@ -439,6 +464,10 @@ class Cluster:
             self._codec_warmup = asyncio.create_task(
                 asyncio.to_thread(wire_native.warmup)
             )
+        self._flightrec.note(
+            "lifecycle", event="start", node=self._config.node_id.name,
+            generation=self._config.node_id.generation_id,
+        )
         if self._persist is not None and self._recovered is None:
             # A store with intent-log records but no snapshot cannot be
             # recovered (no generation to anchor them to) — seed the
@@ -490,6 +519,9 @@ class Cluster:
         if self._closing or not self._started:
             return
         self._closing = True
+        self._flightrec.note(
+            "lifecycle", event="close", clean=self._persist_clean_on_close
+        )
         await self._ticker.stop()
         # Stop responding BEFORE the persistence flush: an inbound
         # handshake still being served would bump our heartbeat after
@@ -587,6 +619,7 @@ class Cluster:
             await self.close()
             return
         self._lifecycle_events.labels("leave_initiated").inc()
+        self._flightrec.note("lifecycle", event="leave", reason=reason)
         # 1. Stop initiating AND responding (close() repeats both
         #    harmlessly). Stopping the responder freezes our heartbeat —
         #    the announcement below carries the FINAL value, so no
@@ -811,6 +844,31 @@ class Cluster:
             n_own_keys=len(self.self_node_state().key_values),
         )
 
+    def trace_provenance(self, trace: TraceWriter | None) -> None:
+        """Attach a propagation-provenance tracer (obs/prov.py,
+        docs/observability.md "Propagation & provenance").
+
+        While attached, every owner write emits ``prov_write``, every
+        guarded apply emits one ``prov_apply`` per key-version (with
+        ``from_peer`` named where this receiver knows it), and every
+        Ack-direction delta emits ``prov_send`` records so the
+        collector can join responder-side applies to their sender.
+        Fleet traces share ONE TraceWriter (lock-serialized);
+        ``obs.prov.join_propagation`` builds the spread trees. None
+        detaches. Without this call nothing provenance-related is
+        emitted and the hot paths are byte-identical."""
+        self._prov = trace
+        self._engine.attach_provenance(trace)
+
+    def flight_record(self) -> list[dict]:
+        """Dump the always-on flight recorder (obs/flightrec.py): the
+        last few hundred notable events this node lived through, oldest
+        first — also served by the serve tier at ``/debug/flightrec``."""
+        return self._flightrec.dump()
+
+    def _note_breaker_transition(self, addr: Address, to: str) -> None:
+        self._flightrec.note("breaker", peer=f"{addr[0]}:{addr[1]}", to=to)
+
     @property
     def fault_controller(self):
         """The FaultController compiled from ``Config.fault_plan``
@@ -882,6 +940,17 @@ class Cluster:
                 # at most an unflushed OS buffer, never an acknowledged
                 # frame (runtime/persist.py).
                 self._persist.record_write(key, new_vv)
+            if self._prov is not None:
+                # Provenance origin (obs/prov.py): the instant this
+                # owner write existed — every peer's prov_apply latency
+                # for (key, version) is measured from here.
+                self._prov.emit(
+                    "prov_write",
+                    node=self._config.node_id.name,
+                    key=key,
+                    version=new_vv.version,
+                    t_mono=round(time.monotonic(), 6),
+                )
             self._emit_key_change(self.self_node_id, key, old_vv, new_vv)
 
     # -- owner KV API ---------------------------------------------------------
@@ -1071,6 +1140,13 @@ class Cluster:
         addr = (host, port)
         health = self._health
         budget = health.timeout_for(addr) if health is not None else None
+        # Provenance peer name: resolved ONLY while a prov trace is
+        # attached (the resolver scans known nodes — the default path
+        # must not pay it per handshake).
+        prov_peer = (
+            self._peer_label(host, port) if self._prov is not None else None
+        )
+        flightrec = self._flightrec
         if health is not None:
             # An open breaker whose backoff just expired: this
             # handshake IS the half-open probe.
@@ -1108,13 +1184,17 @@ class Cluster:
                             f"Peer {host}:{port} rejected us: wrong cluster "
                             f"(ours={self._config.cluster_id!r})"
                         )
+                        flightrec.note(
+                            "handshake", peer=f"{host}:{port}", label=label,
+                            outcome="bad_cluster",
+                        )
                         if health is not None:
                             # A policy rejection over a healthy link
                             # closes the breaker — quarantine is for
                             # peers that cost time, not ones that say no.
                             health.record_success(addr)
                     elif isinstance(reply.msg, SynAck):
-                        ack = self._engine.handle_synack(reply)
+                        ack = self._engine.handle_synack(reply, peer=prov_peer)
                         await self._transport.write_packet(
                             conn.writer, ack, timeout=budget
                         )
@@ -1124,11 +1204,19 @@ class Cluster:
                             conn = None
                         # else: reference lifecycle — teardown per round,
                         # via the finally's discard.
+                        flightrec.note(
+                            "handshake", peer=f"{host}:{port}", label=label,
+                            outcome="ok", reused=reused,
+                        )
                         if health is not None:
                             health.record_success(addr)
                     else:
                         self._log.debug(
                             f"Unexpected gossip reply from {label} {host}:{port}"
+                        )
+                        flightrec.note(
+                            "handshake", peer=f"{host}:{port}", label=label,
+                            outcome="unexpected_reply",
                         )
                         if health is not None:
                             # The peer answered promptly over a healthy
@@ -1146,6 +1234,10 @@ class Cluster:
                         continue
                     if health is not None:
                         health.record_failure(addr)
+                    flightrec.note(
+                        "handshake", peer=f"{host}:{port}", label=label,
+                        outcome="peer_closed", error=type(exc).__name__,
+                    )
                     self._log.debug(
                         f"Gossip with {label} {host}:{port} failed: {exc}"
                     )
@@ -1154,11 +1246,19 @@ class Cluster:
                         ValueError) as exc:
                     if health is not None:
                         health.record_failure(addr)
+                    flightrec.note(
+                        "handshake", peer=f"{host}:{port}", label=label,
+                        outcome="failed", error=type(exc).__name__,
+                    )
                     self._log.debug(
                         f"Gossip with {label} {host}:{port} failed: {exc}"
                     )
                     return
                 except Exception as exc:
+                    flightrec.note(
+                        "handshake", peer=f"{host}:{port}", label=label,
+                        outcome="error", error=type(exc).__name__,
+                    )
                     self._log.exception(
                         f"Gossip with {label} {host}:{port} errored: {exc}"
                     )
@@ -1295,6 +1395,9 @@ class Cluster:
             task.add_done_callback(self._leave_forwards.discard)
         if self._failure_detector.mark_dead(node_id):
             self._fd_transitions.labels("dead").inc()
+            self._flightrec.note(
+                "fd", peer=node_id.name, to="dead", reason=msg.reason
+            )
             if self._trace is not None:
                 self._trace.emit(
                     "node_transition",
@@ -1379,6 +1482,7 @@ class Cluster:
         live = set(self._failure_detector.live_nodes())
         for node_id in live - self._prev_live:
             self._fd_transitions.labels("live").inc()
+            self._flightrec.note("fd", peer=node_id.name, to="live")
             if self._trace is not None:
                 self._trace.emit(
                     "node_transition",
@@ -1389,6 +1493,7 @@ class Cluster:
             self._hooks.emit(tuple(self._on_node_join), (node_id,))
         for node_id in self._prev_live - live:
             self._fd_transitions.labels("dead").inc()
+            self._flightrec.note("fd", peer=node_id.name, to="dead")
             if self._trace is not None:
                 self._trace.emit(
                     "node_transition",

@@ -10,6 +10,8 @@ JAX sim backend vectorises.
 
 from __future__ import annotations
 
+import time
+
 from ..core.cluster_state import ClusterState
 from ..core.config import Config
 from ..core.failure import FailureDetector
@@ -17,7 +19,9 @@ from ..core.guards import sanitize_delta
 from ..core.identity import NodeId
 from ..core.kvstate import KeyChangeFn
 from ..core.messages import Ack, BadCluster, Delta, Digest, Packet, Syn, SynAck
+from ..obs.flightrec import FlightRecorder
 from ..obs.registry import MetricsRegistry
+from ..obs.trace import TraceWriter
 from ..wire import encode_packet
 
 
@@ -35,11 +39,20 @@ class GossipEngine:
         failure_detector: FailureDetector,
         on_key_change: KeyChangeFn | None = None,
         metrics: MetricsRegistry | None = None,
+        flightrec: FlightRecorder | None = None,
     ) -> None:
         self._config = config
         self._state = cluster_state
         self._fd = failure_detector
         self._on_key_change = on_key_change
+        # Post-mortem ring (obs/flightrec.py): guard rejections and
+        # non-trivial applies are the engine's notable events.
+        self._flightrec = flightrec
+        # Propagation provenance (obs/prov.py): attached by
+        # Cluster.trace_provenance, None by default — every prov branch
+        # below is gated on this, so detached clusters run the exact
+        # pre-provenance paths.
+        self._prov: TraceWriter | None = None
         # Protocol-level telemetry: handshake steps by role/step, and the
         # reconciliation payload itself — key-version updates sent vs
         # applied (the transport counts the wire bytes; this counts the
@@ -179,7 +192,57 @@ class GossipEngine:
             self._config.cluster_id, SynAck(self._self_digest(excluded), delta)
         )
 
-    def _apply_guarded(self, delta: Delta) -> Delta:
+    def attach_provenance(self, trace: TraceWriter | None) -> None:
+        """Attach (or detach, with None) the propagation-provenance
+        trace (obs/prov.py; wired by ``Cluster.trace_provenance``)."""
+        self._prov = trace
+
+    def _emit_prov_applies(self, delta: Delta, from_peer: str | None) -> None:
+        """One ``prov_apply`` per applied key-version: receiver-side
+        provenance (obs/prov.py). ``from_peer`` is the peer the delta
+        came from when this receiver knows it (it initiated the
+        handshake, or a Leave named its sender); None on responder-side
+        applies — the collector joins those to the initiator's
+        ``prov_send`` records instead (no wire change)."""
+        t_mono = round(time.monotonic(), 6)
+        node = self._config.node_id.name
+        for nd in delta.node_deltas:
+            owner = nd.node_id.name
+            for kv in nd.key_values:
+                self._prov.emit(
+                    "prov_apply",
+                    node=node,
+                    owner=owner,
+                    key=kv.key,
+                    version=kv.version,
+                    from_peer=from_peer,
+                    t_mono=t_mono,
+                )
+
+    def _emit_prov_sends(self, delta: Delta, to_peer: str | None) -> None:
+        """One ``prov_send`` per key-version packed into an Ack delta:
+        the initiator knows the responder it is talking to while the
+        responder cannot name its caller — these records are exactly
+        what the collector joins the responder's null-``from_peer``
+        applies against."""
+        if to_peer is None:
+            return
+        t_mono = round(time.monotonic(), 6)
+        node = self._config.node_id.name
+        for nd in delta.node_deltas:
+            owner = nd.node_id.name
+            for kv in nd.key_values:
+                self._prov.emit(
+                    "prov_send",
+                    node=node,
+                    to_peer=to_peer,
+                    owner=owner,
+                    key=kv.key,
+                    version=kv.version,
+                    t_mono=t_mono,
+                )
+
+    def _apply_guarded(self, delta: Delta, from_peer: str | None = None) -> Delta:
         """The apply-delta path: inbound deltas pass the byzantine
         defense guards (core/guards.py — owner-write, floor, over-stamp
         and max_version-support checks) before touching state. Honest
@@ -187,27 +250,50 @@ class GossipEngine:
         every rejection is counted by kind. Returns what was actually
         applied."""
         clean, rejected = sanitize_delta(delta, self._config.node_id)
-        if rejected and self._byz_rejected is not None:
-            for kind, count in rejected.items():
-                self._byz_rejected.labels(kind).inc(count)
+        if rejected:
+            if self._byz_rejected is not None:
+                for kind, count in rejected.items():
+                    self._byz_rejected.labels(kind).inc(count)
+            if self._flightrec is not None:
+                self._flightrec.note(
+                    "guard_reject", peer=from_peer, kinds=dict(rejected)
+                )
         self._state.apply_delta(clean, on_key_change=self._on_key_change)
+        if clean.node_deltas:
+            if self._flightrec is not None:
+                self._flightrec.note(
+                    "apply",
+                    peer=from_peer,
+                    kvs=_delta_kv_count(clean),
+                    nodes=len(clean.node_deltas),
+                )
+            if self._prov is not None:
+                self._emit_prov_applies(clean, from_peer)
         return clean
 
-    def handle_synack(self, packet: Packet) -> Packet:
+    def handle_synack(self, packet: Packet, peer: str | None = None) -> Packet:
         """Initiator step 2: apply the responder's delta (guarded),
-        reply with the delta the responder is missing."""
+        reply with the delta the responder is missing. ``peer`` names
+        the responder for provenance (the initiator dialed it — the
+        cluster resolves the name only while a prov trace is attached)."""
         assert isinstance(packet.msg, SynAck)
         excluded = self._excluded()
         self._observe_digest(packet.msg.digest)
-        applied = self._apply_guarded(packet.msg.delta)
+        applied = self._apply_guarded(packet.msg.delta, from_peer=peer)
         delta = self._state.compute_partial_delta_respecting_mtu(
             packet.msg.digest, self._config.max_payload_size, excluded
         )
+        if self._prov is not None:
+            self._emit_prov_sends(delta, peer)
         self._note("handle_synack", sent=delta, applied=applied)
         return Packet(self._config.cluster_id, Ack(delta))
 
     def handle_ack(self, packet: Packet) -> None:
-        """Responder final step: apply the initiator's delta (guarded)."""
+        """Responder final step: apply the initiator's delta (guarded).
+        The responder cannot name its caller (a Syn carries no sender
+        identity and the wire stays reference-compatible), so these
+        applies record ``from_peer=null`` — the provenance collector
+        joins them to the initiator's ``prov_send`` records."""
         assert isinstance(packet.msg, Ack)
         applied = self._apply_guarded(packet.msg.delta)
         self._note("handle_ack", applied=applied)
@@ -220,6 +306,10 @@ class GossipEngine:
         from ..core.messages import Leave
 
         assert isinstance(packet.msg, Leave)
-        applied = self._apply_guarded(packet.msg.delta)
+        # The announcement names its sender — the one inbound message
+        # whose provenance needs no send join.
+        applied = self._apply_guarded(
+            packet.msg.delta, from_peer=packet.msg.node_id.name
+        )
         self._note("handle_leave", applied=applied)
         return applied

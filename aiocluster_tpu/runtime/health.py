@@ -134,6 +134,7 @@ class HealthTracker:
         rng: Random | None = None,
         clock: Callable[[], float] = time.monotonic,
         metrics: MetricsRegistry | None = None,
+        on_transition: Callable[[Address, str], None] | None = None,
     ) -> None:
         self.adaptive = adaptive
         self.breaker = breaker
@@ -147,6 +148,10 @@ class HealthTracker:
         self._clock = clock
         self._rtt: dict[Address, PeerRtt] = {}
         self._breakers: dict[Address, PeerBreaker] = {}
+        # Transition hook beyond metrics: the cluster's flight recorder
+        # notes every breaker flip (with the peer) — sequence evidence
+        # a by-new-state counter cannot carry.
+        self._on_transition = on_transition
         self._rtt_hist = self._state_gauge = self._transitions = None
         if metrics is not None:
             self._rtt_hist = metrics.histogram(
@@ -183,6 +188,8 @@ class HealthTracker:
             self._state_gauge.labels(f"{addr[0]}:{addr[1]}").set(state)
         if self._transitions is not None:
             self._transitions.labels(_STATE_NAMES[state]).inc()
+        if self._on_transition is not None:
+            self._on_transition(addr, _STATE_NAMES[state])
 
     # -- adaptive timeouts ----------------------------------------------------
 
@@ -265,6 +272,8 @@ class HealthTracker:
         if b.state == OPEN:
             if self._transitions is not None:
                 self._transitions.labels("open").inc()
+            if self._on_transition is not None:
+                self._on_transition(addr, "open")
         else:
             self._set_state(addr, b, OPEN)
 

@@ -20,6 +20,10 @@ Endpoints (full contract in docs/serving.md):
   POST /kv_mark/<key>         delete-after-TTL mark (reference parity)
   GET  /metrics               Prometheus text (the cluster's registry)
   GET  /healthz               liveness
+  GET  /debug/flightrec       the node's flight recorder dump (the
+                              always-on bounded ring of recent events,
+                              obs/flightrec.py) — operator endpoint,
+                              never shed, like /healthz
 
 The hot path does zero redundant work per client: every 200 ``/state``
 and every watch wake serves the SnapshotCache's per-epoch ``bytes``;
@@ -82,8 +86,9 @@ class OverloadPolicy:
     - ``shed_lag_s`` sheds on measured event-loop lag — the signal
       that the process (gossip rounds included) is past saturation;
       applies to every endpoint including ``/watch``.
-    - ``/healthz`` and ``/metrics`` are never shed: the operator's
-      view must survive the storm it is diagnosing.
+    - ``/healthz``, ``/metrics`` and ``/debug/flightrec`` are never
+      shed: the operator's view must survive the storm it is
+      diagnosing.
 
     ``enabled=False`` restores the accept-everything behavior (the
     overload benchmark's control arm).
@@ -418,6 +423,17 @@ class ServeApp:
             )
         if path == "/healthz" and method == "GET":
             return self._handle_healthz()
+        if path == "/debug/flightrec" and method == "GET":
+            # Post-mortem ring dump (obs/flightrec.py): bounded by
+            # construction, so encoding it is O(capacity), not O(state).
+            body = (
+                json.dumps(
+                    {"events": self._cluster.flight_record()},
+                    sort_keys=True,
+                ).encode()
+                + b"\n"
+            )
+            return ("flightrec", "200 OK", (body, _JSON, ()))
         parts = [p for p in path.split("/") if p]
         if len(parts) == 2 and parts[0] == "kv":
             return ("kv",) + self._handle_kv(request, unquote(parts[1]))
@@ -434,7 +450,9 @@ class ServeApp:
         it (see OverloadPolicy). Lag sheds everything; the in-flight
         bound spares /watch (parked long-polls are not executing)."""
         pol = self.overload
-        if not pol.enabled or path in ("/healthz", "/metrics"):
+        if not pol.enabled or path in (
+            "/healthz", "/metrics", "/debug/flightrec",
+        ):
             return None
         if self._lag > pol.shed_lag_s:
             return "lag"

@@ -19,7 +19,12 @@ from ..models.topology import Topology
 from ..obs.registry import MetricsRegistry
 from ..obs.sim import SimMetrics
 from ..obs.trace import TraceWriter
-from ..ops.gossip import convergence_metrics, sim_step, version_spread
+from ..ops.gossip import (
+    convergence_metrics,
+    sim_step,
+    staleness_percentiles,
+    version_spread,
+)
 from ..parallel.mesh import (
     shard_state,
     sharded_chunk_fn,
@@ -32,10 +37,12 @@ from .state import SimState, init_state
 
 @jax.jit
 def _metrics_sample(state: SimState) -> dict[str, jax.Array]:
-    """convergence_metrics + version spread in one fused device pass —
-    the quantity bundle the obs stride sampler buffers per window."""
+    """convergence_metrics + version spread + the staleness-tensor
+    percentiles in one fused device pass — the quantity bundle the obs
+    stride sampler buffers per window."""
     out = convergence_metrics(state)
     out["version_spread"] = version_spread(state)
+    out.update(staleness_percentiles(state))
     return out
 
 
@@ -201,6 +208,7 @@ class Simulator:
             self._obs = SimMetrics(
                 metrics, trace_writer, stride=metrics_stride, engine="xla",
                 start_tick=self._host_tick,
+                writes_per_round=cfg.writes_per_round,
             )
             # Memory-ladder provenance gauge: the rung's planned
             # resident bytes (host arithmetic; docs/observability.md).

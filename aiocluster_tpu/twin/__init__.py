@@ -27,6 +27,7 @@ from .calibrate import (
     load_calibration,
     save_calibration,
 )
+from .drift import AxisDrift, DriftVerdict, check_drift, export_drift
 from .replay import (
     ReplayReport,
     RoundRow,
@@ -35,25 +36,31 @@ from .replay import (
     lift_sim_config,
     load_runtime_trace,
     replay,
+    wavefront_prediction,
 )
 
 __all__ = (
     "CALIBRATION_SCHEMA",
     "SLO",
     "AutotuneInfeasible",
+    "AxisDrift",
     "CalibrationError",
     "CalibrationRecord",
     "CalibrationSchemaError",
+    "DriftVerdict",
     "Recommendation",
     "ReplayReport",
     "RoundRow",
     "RuntimeTrace",
     "TraceSchemaError",
     "autotune",
+    "check_drift",
+    "export_drift",
     "fit_calibration",
     "lift_sim_config",
     "load_calibration",
     "load_runtime_trace",
     "replay",
     "save_calibration",
+    "wavefront_prediction",
 )

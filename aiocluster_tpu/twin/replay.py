@@ -207,6 +207,33 @@ def lift_sim_config(trace: RuntimeTrace, **overrides) -> SimConfig:
     return SimConfig(**derived)
 
 
+def wavefront_prediction(
+    trace: RuntimeTrace,
+    *,
+    threshold: float = 0.99,
+    seed: int = 0,
+    max_rounds: int = 512,
+    **overrides,
+) -> dict:
+    """The sim's predicted epidemic wavefront for THIS deployment: lift
+    the trace's implied SimConfig and run one marked write through it
+    from a converged fleet (obs.sim.wavefront_series). This is what the
+    propagation benchmark lines up against the MEASURED write→visible
+    curve from the provenance tracer — the twin comparing propagation
+    *curves*, not just convergence round counts. Returns the wavefront
+    dict plus the lifted config's shape for provenance."""
+    import dataclasses
+
+    from ..obs.sim import wavefront_series
+
+    cfg = lift_sim_config(trace, **overrides)
+    wf = wavefront_series(
+        cfg, seed=seed, max_rounds=max_rounds, threshold=threshold
+    )
+    wf["sim_config"] = dataclasses.asdict(cfg)
+    return wf
+
+
 @dataclass
 class ReplayReport:
     """The aligned (runtime, sim) comparison the calibrator fits."""

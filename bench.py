@@ -739,6 +739,19 @@ def overload_degradation_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def propagation_provenance_bench(log, smoke: bool) -> dict | None:
+    """The propagation-provenance datum (benchmarks/propagation_bench.py,
+    docs/observability.md "Propagation & provenance"): one marked write
+    on a real loopback fleet, its measured write→99%-visibility latency
+    and hop-depth histogram joined from receiver-side provenance
+    traces, next to the sim's wavefront prediction for the lifted
+    config — plus the staleness-tensor oracle parity cells (int32 and
+    u4r, unsharded and 2-shard where the device layout allows)."""
+    return _run_benchmarks_helper(
+        "propagation_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 def twin_closed_loop_bench(log, smoke: bool) -> dict | None:
     """The digital-twin datum (benchmarks/twin_bench.py, docs/twin.md):
     a real loopback fleet recorded with twin-grade round tracing,
@@ -764,6 +777,9 @@ STDOUT_LINE_CAP = 2000
 # least-essential provenance first; the headline fields
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
+    "sim_wavefront_rounds",
+    "propagation_hops_p99",
+    "propagation_p99_s",
     "packed_kernel_engaged",
     "twin_recommended_fanout",
     "twin_predicted_rounds_per_sec",
@@ -903,6 +919,18 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         ),
         "leave_detect_seconds": (ex.get("restart_bench") or {}).get(
             "leave_detect_seconds"
+        ),
+        # Propagation provenance (propagation_bench.py): the marked
+        # write's measured write→99%-visibility latency, its hop-depth
+        # p99, and the sim's wavefront prediction for the lifted config.
+        "propagation_p99_s": (ex.get("propagation_bench") or {}).get(
+            "propagation_p99_s"
+        ),
+        "propagation_hops_p99": (ex.get("propagation_bench") or {}).get(
+            "propagation_hops_p99"
+        ),
+        "sim_wavefront_rounds": (ex.get("propagation_bench") or {}).get(
+            "sim_wavefront_rounds"
         ),
         # Digital twin (twin_bench): the calibrated (held-out-validated)
         # wall-clock rate and the SLO autotuner's recommended fanout.
@@ -1552,6 +1580,10 @@ def main() -> None:
         # held-out-validated calibration -> one-compile SLO autotune
         # (twin_bench.py, docs/twin.md).
         twin_rec = twin_closed_loop_bench(log, args.smoke)
+        # Propagation provenance: measured marked-write spread (latency
+        # + hops) vs the sim's wavefront prediction, plus the staleness
+        # oracle parity cells (propagation_bench.py).
+        prov_rec = propagation_provenance_bench(log, args.smoke)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1637,6 +1669,10 @@ def main() -> None:
                 # validation error + the SLO autotuner's recommendation
                 # (twin_bench.py, docs/twin.md).
                 "twin_bench": twin_rec,
+                # Propagation provenance: the marked write's measured
+                # spread tree next to the sim wavefront prediction
+                # (propagation_bench.py, docs/observability.md).
+                "propagation_bench": prov_rec,
                 # The memory ladder's planning claims (per-rung B/pair,
                 # modeled max scale) — every entry certified: false
                 # until the chip calibrates the new paths.

@@ -83,11 +83,13 @@ def _raise_fd_limit(needed: int, log) -> int:
     return resource.getrlimit(resource.RLIMIT_NOFILE)[0]
 
 
-def _percentile(sorted_values: list[float], q: float) -> float:
-    if not sorted_values:
-        return float("nan")
-    idx = min(len(sorted_values) - 1, int(q * (len(sorted_values) - 1) + 0.5))
-    return sorted_values[idx]
+# The raw-sample percentile moved into the obs layer (one nearest-rank
+# convention for every measured figure — histogram-backed series read
+# Histogram.quantile instead); the local name survives because
+# overload_bench and friends import it from here.
+from aiocluster_tpu.obs.registry import (  # noqa: E402  (needs the paths above)
+    percentile_of_sorted as _percentile,
+)
 
 
 class _Conn:

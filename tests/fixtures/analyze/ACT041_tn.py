@@ -1,0 +1,25 @@
+# analyze-domain: obs
+"""TN: documented names pass; non-registry receivers, dynamic names and
+non-aiocluster families are out of scope."""
+
+FAMILIES = (("aiocluster_round_seconds", "documented via the table"),)
+
+
+class Telemetry:
+    def __init__(self, registry, counterparty):
+        self.registry = registry
+        self._counterparty = counterparty
+
+    def build(self):
+        # Documented in docs/observability.md's catalogue.
+        self.registry.counter(
+            "aiocluster_gossip_packets_total", "ok", labels=("type",)
+        )
+        self.registry.histogram("aiocluster_round_seconds", "ok")
+        # Dynamic name from a table the docs list: out of scope.
+        for name, help_text in FAMILIES:
+            self.registry.gauge(name, help_text)
+        # Not a registry receiver.
+        self._counterparty.counter("aiocluster_not_a_registry_total")
+        # Not an aiocluster family (a test fabricating a local name).
+        self.registry.counter("fixture_scratch_total", "out of scope")

@@ -1,0 +1,23 @@
+# analyze-domain: obs
+"""TP: metric families registered under names docs/observability.md
+does not catalogue — telemetry only the author can read."""
+
+
+class Telemetry:
+    def __init__(self, registry):
+        self.registry = registry
+        self._metrics = registry
+
+    def build(self):
+        self.registry.counter(
+            "aiocluster_fixture_undocumented_total",
+            "never made it into the catalogue",
+        )
+        self._metrics.gauge(
+            "aiocluster_fixture_undocumented_depth",
+            "nor did this one",
+            labels=("queue",),
+        )
+        self.registry.histogram(
+            "aiocluster_fixture_undocumented_seconds", "or this"
+        )

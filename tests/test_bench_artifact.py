@@ -159,6 +159,40 @@ def _worst_case_result():
                 },
                 "gates_passed": True,
             },
+            "propagation_bench": {
+                "scenario": "marked write propagation + staleness parity",
+                "smoke": False,
+                "n_nodes": 12,
+                "runtime": {
+                    "owner": "n00",
+                    "applies": 11,
+                    "visibility_p50_s": 0.0199,
+                    "visibility_p99_s": 0.0447,
+                    "hops_p99": 3,
+                    "joined_fraction": 1.0,
+                },
+                "sim_wavefront": {
+                    "rounds_to_threshold": 2,
+                    "threshold": 0.99,
+                    "fractions": [0.083, 0.75, 1.0],
+                },
+                "staleness_parity": {
+                    "int32_1shard": True,
+                    "int32_2shard": True,
+                    "u4r_1shard": True,
+                    "u4r_2shard": True,
+                    "ok": True,
+                },
+                "propagation_p99_s": 0.0447,
+                "propagation_hops_p99": 3,
+                "sim_wavefront_rounds": 2,
+                "gates": {
+                    "joined_applies": True,
+                    "measured_keys_present": True,
+                    "staleness_oracle_bitmatch": True,
+                },
+                "gates_passed": True,
+            },
             "restart_bench": {
                 "scenario": "rolling_restart + leave",
                 "smoke": False,
@@ -248,6 +282,13 @@ def test_stdout_line_stays_under_cap():
     # recommended fanout (twin_bench.py, docs/twin.md).
     assert ex["twin_predicted_rounds_per_sec"] == 19.842
     assert ex["twin_recommended_fanout"] == 4
+    # The propagation-provenance keys round-trip as flat scalars: the
+    # marked write's measured write→99%-visibility latency, its
+    # hop-depth p99, and the sim's wavefront prediction
+    # (propagation_bench.py, docs/observability.md).
+    assert ex["propagation_p99_s"] == 0.0447
+    assert ex["propagation_hops_p99"] == 3
+    assert ex["sim_wavefront_rounds"] == 2
     # The packed-rung engagement dict compacts to the comma-joined
     # engaged list (a dispatch regression would read "none" loudly).
     assert ex["packed_kernel_engaged"] == "u4r,shrunk,deep"

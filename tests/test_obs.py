@@ -420,3 +420,147 @@ def test_profiling_absorbed_into_obs():
 
     assert utils.SectionTimer is obs.SectionTimer
     assert utils.device_trace is obs.device_trace
+
+
+# -- Prometheus exposition edge cases (docs/observability.md) -----------------
+
+
+def _lint_promtext(text: str) -> dict:
+    """A small text-format-0.0.4 linter: validates structure (HELP/TYPE
+    before samples), sample syntax, label escaping, and histogram
+    consistency (cumulative buckets, +Inf == _count, _sum present).
+    Returns {family: [(name, labels, value)]} for further assertions."""
+    import re
+
+    assert text.endswith("\n"), "scrapers require the trailing newline"
+    sample_re = re.compile(
+        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?:\{(?P<labels>.*)\})? (?P<value>\S+)$"
+    )
+    label_re = re.compile(
+        r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"'
+    )
+    helped: set[str] = set()
+    typed: dict[str, str] = {}
+    samples: dict[str, list] = {}
+    for line in text[:-1].split("\n"):
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            typed[name] = kind
+            continue
+        m = sample_re.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                base = name[: -len(suffix)]
+        assert base in typed and base in helped, (
+            f"sample {name} precedes its HELP/TYPE headers"
+        )
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = ",".join(
+                f'{k}="{v}"' for k, v in label_re.findall(raw)
+            )
+            assert consumed == raw, f"unparseable labels: {raw!r}"
+            labels = dict(label_re.findall(raw))
+        value = float(m.group("value").replace("+Inf", "inf"))
+        samples.setdefault(base, []).append((name, labels, value))
+    # Histogram consistency per label set.
+    for base, kind in typed.items():
+        if kind != "histogram":
+            continue
+        rows = samples.get(base, [])
+        series: dict[tuple, dict] = {}
+        for name, labels, value in rows:
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"
+            ))
+            entry = series.setdefault(key, {"buckets": []})
+            if name.endswith("_bucket"):
+                entry["buckets"].append((labels["le"], value))
+            elif name.endswith("_sum"):
+                entry["sum"] = value
+            elif name.endswith("_count"):
+                entry["count"] = value
+        for entry in series.values():
+            assert entry["buckets"], "histogram with no buckets"
+            assert entry["buckets"][-1][0] == "+Inf"
+            counts = [c for _le, c in entry["buckets"]]
+            assert counts == sorted(counts), "non-cumulative buckets"
+            assert counts[-1] == entry["count"], "+Inf bucket != _count"
+            assert "sum" in entry
+    return samples
+
+
+def test_promtext_label_escaping_each_character():
+    """Backslash, quote and newline each round-trip the exposition
+    escaping: the rendered value unescapes back to the original."""
+    cases = {
+        "back\\slash": "back\\\\slash",
+        'quo"te': 'quo\\"te',
+        "new\nline": "new\\nline",
+    }
+    for original, escaped in cases.items():
+        reg = MetricsRegistry()
+        reg.counter("esc_total", "E", labels=("v",)).labels(original).inc()
+        text = render_prometheus(reg)
+        assert f'esc_total{{v="{escaped}"}} 1' in text
+        _lint_promtext(text)
+
+
+def test_promtext_empty_label_families_render_headers_only():
+    """A registered family with no children yet still announces itself
+    (HELP/TYPE), with zero sample lines — and a materialized-but-empty
+    histogram exposes a consistent all-zero bucket ladder."""
+    reg = MetricsRegistry()
+    reg.counter("lonely_total", "no children yet", labels=("kind",))
+    h = reg.histogram("quiet_seconds", "no observations", buckets=(1.0,))
+    h.labels()  # materialized, zero observations
+    text = render_prometheus(reg)
+    assert "# TYPE lonely_total counter" in text
+    assert "\nlonely_total" not in text.replace("# HELP lonely_total", "")
+    samples = _lint_promtext(text)
+    assert "lonely_total" not in samples
+    rows = {name: v for name, _l, v in samples["quiet_seconds"]}
+    assert rows["quiet_seconds_count"] == 0
+    assert rows["quiet_seconds_sum"] == 0
+
+
+def test_promtext_inf_bucket_tracks_count_exactly():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_seconds", "T", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0, 50.0):  # two past the top finite bound
+        h.observe(v)
+    samples = _lint_promtext(render_prometheus(reg))
+    rows = {
+        (name, labels.get("le")): v
+        for name, labels, v in samples["t_seconds"]
+    }
+    assert rows[("t_seconds_bucket", "+Inf")] == 4
+    assert rows[("t_seconds_count", None)] == 4
+    assert rows[("t_seconds_sum", None)] == pytest.approx(55.55)
+
+
+def test_promtext_roundtrip_lint_on_live_default_registry():
+    """The process-default registry — whatever this test session has
+    accumulated in it, plus a deliberately hostile family — renders to
+    lintable text format 0.0.4 end to end."""
+    from aiocluster_tpu.obs import default_registry
+
+    reg = default_registry()
+    reg.counter(
+        "aiocluster_test_expo_probe_total", "lint probe", labels=("v",)
+    ).labels('hosti\\le"\nvalue').inc()
+    reg.histogram(
+        "aiocluster_test_expo_probe_seconds", "lint probe"
+    ).observe(0.2)
+    samples = _lint_promtext(render_prometheus(reg))
+    assert "aiocluster_test_expo_probe_total" in samples
+    assert "aiocluster_test_expo_probe_seconds" in samples

@@ -1,0 +1,544 @@
+"""Propagation provenance, flight recorder, staleness tensor, twin drift.
+
+The observability plane of docs/observability.md "Propagation &
+provenance" + "Flight recorder" and docs/twin.md's drift monitor:
+
+- the collector's join semantics on synthetic traces (direct
+  ``from_peer`` edges, the send join for responder-side applies, hop
+  depths, the shared nearest-rank percentiles);
+- a REAL loopback fleet joined end to end (>= 99% of applies for a
+  marked write — the prov-smoke gate at test scale) with byte-identical
+  defaults (no trace attached => no prov events anywhere);
+- the sim staleness tensor bit-matching a host numpy oracle on the
+  int32 AND packed-u4r rungs, unsharded and under a 2-shard mesh;
+- the flight recorder's ring discipline and its never-shed serve
+  endpoint;
+- histogram quantiles (bucket interpolation, snapshot p50/p99);
+- ``twin.check_drift`` verdicts against a stored calibration.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import time
+
+import pytest
+
+from aiocluster_tpu.obs import TraceWriter, join_propagation
+from aiocluster_tpu.obs.flightrec import FlightRecorder
+from aiocluster_tpu.obs.registry import (
+    MetricsRegistry,
+    percentile_of_sorted,
+)
+
+# -- collector unit tests -----------------------------------------------------
+
+
+def _rec(event, **fields):
+    return {"event": event, "ts": 0.0, **fields}
+
+
+def test_join_direct_and_send_edges_build_the_spread_tree():
+    records = [
+        _rec("prov_write", node="a", key="k", version=3, t_mono=10.0),
+        # b pulled from a (initiator side: from_peer named).
+        _rec("prov_apply", node="b", owner="a", key="k", version=3,
+             from_peer="a", t_mono=10.1),
+        # b then initiated toward c and packed the kv into its Ack:
+        # c's apply is responder-side (from_peer null) and joins to the
+        # closest preceding matching send.
+        _rec("prov_send", node="b", to_peer="c", owner="a", key="k",
+             version=3, t_mono=10.2),
+        _rec("prov_apply", node="c", owner="a", key="k", version=3,
+             from_peer=None, t_mono=10.25),
+        # d pulled from c.
+        _rec("prov_apply", node="d", owner="a", key="k", version=3,
+             from_peer="c", t_mono=10.4),
+    ]
+    report = join_propagation(records)
+    tree = report.tree(owner="a", key="k")
+    assert tree is not None and tree.version == 3
+    assert tree.origin_t == 10.0
+    assert tree.nodes["a"].hop == 0
+    assert tree.nodes["b"].hop == 1 and tree.nodes["b"].join == "direct"
+    assert tree.nodes["c"].hop == 2 and tree.nodes["c"].join == "send"
+    assert tree.nodes["c"].from_peer == "b"
+    assert tree.nodes["d"].hop == 3
+    assert tree.unjoined_hops == 0
+    assert tree.joined_fraction(4) == 1.0
+    lats = tree.latencies()
+    assert lats == sorted(lats)
+    assert math.isclose(tree.visibility_percentile(1.0), 0.4, abs_tol=1e-9)
+    assert tree.hop_histogram() == {0: 1, 1: 1, 2: 1, 3: 1}
+    summary = tree.summary(4)
+    assert summary["hops_p99"] == 3 and summary["joined_fraction"] == 1.0
+
+
+def test_join_first_visibility_wins_and_unjoined_counted():
+    records = [
+        _rec("prov_write", node="a", key="k", version=1, t_mono=0.0),
+        _rec("prov_apply", node="b", owner="a", key="k", version=1,
+             from_peer="a", t_mono=1.0),
+        # A later duplicate apply must not move b's first sighting.
+        _rec("prov_apply", node="b", owner="a", key="k", version=1,
+             from_peer="c", t_mono=5.0),
+        # No from_peer and no matching send: joined for latency, but
+        # its hop stays unresolved (counted, not invented).
+        _rec("prov_apply", node="e", owner="a", key="k", version=1,
+             from_peer=None, t_mono=2.0),
+    ]
+    tree = join_propagation(records).tree(owner="a", key="k")
+    assert tree.nodes["b"].t_mono == 1.0 and tree.nodes["b"].from_peer == "a"
+    assert tree.nodes["e"].join == "unjoined"
+    assert tree.nodes["e"].hop is None
+    assert tree.nodes["e"].latency_s == 2.0
+    assert tree.unjoined_hops == 1
+
+
+def test_join_key_filter_and_version_separation():
+    records = [
+        _rec("prov_write", node="a", key="k", version=1, t_mono=0.0),
+        _rec("prov_write", node="a", key="k", version=2, t_mono=1.0),
+        _rec("prov_write", node="a", key="other", version=1, t_mono=0.0),
+        _rec("prov_apply", node="b", owner="a", key="k", version=2,
+             from_peer="a", t_mono=1.5),
+    ]
+    report = join_propagation(records, key="k")
+    assert all(k == "k" for (_o, k, _v) in report.trees)
+    # tree() defaults to the highest version of the (owner, key) pair.
+    assert report.tree(owner="a", key="k").version == 2
+    assert report.tree(owner="a", key="k", version=1).version == 1
+
+
+def test_join_send_horizon_rejects_stale_and_future_senders():
+    records = [
+        _rec("prov_write", node="a", key="k", version=1, t_mono=100.0),
+        # A send far older than the horizon, and one AFTER the apply:
+        # neither may claim the edge.
+        _rec("prov_send", node="x", to_peer="b", owner="a", key="k",
+             version=1, t_mono=10.0),
+        _rec("prov_send", node="y", to_peer="b", owner="a", key="k",
+             version=1, t_mono=101.0),
+        _rec("prov_apply", node="b", owner="a", key="k", version=1,
+             from_peer=None, t_mono=100.5),
+    ]
+    tree = join_propagation(records).tree(owner="a", key="k")
+    assert tree.nodes["b"].join == "unjoined"
+    assert tree.nodes["b"].from_peer is None
+
+
+# -- nearest-rank + histogram quantiles ---------------------------------------
+
+
+def test_percentile_of_sorted_convention():
+    assert math.isnan(percentile_of_sorted([], 0.5))
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert percentile_of_sorted(vals, 0.0) == 1.0
+    assert percentile_of_sorted(vals, 0.5) == 3.0
+    assert percentile_of_sorted(vals, 0.99) == 5.0
+    assert percentile_of_sorted(vals, 1.0) == 5.0
+
+
+def test_histogram_quantile_interpolates_buckets():
+    reg = MetricsRegistry()
+    hist = reg.histogram("h_seconds", "t", buckets=(1.0, 2.0, 4.0))
+    assert hist.quantile(0.5) is None  # empty
+    for v in (0.5, 1.5, 1.5, 3.0):
+        hist.observe(v)
+    # rank 2 of 4 falls in the (1, 2] bucket: cum 1 -> 3 across it.
+    assert hist.quantile(0.5) == pytest.approx(1.5)
+    # rank 0.4 falls in the first bucket, interpolated from 0.
+    assert hist.quantile(0.1) == pytest.approx(0.4)
+    # +Inf landings clamp to the highest finite bound.
+    hist.observe(100.0)
+    assert hist.quantile(1.0) == 4.0
+    with pytest.raises(ValueError):
+        hist.quantile(1.5)
+
+
+def test_histogram_quantile_negative_first_bucket_convention():
+    """Prometheus convention: a non-positive first bound is returned
+    as-is (0 is not a valid interpolation anchor below it) — the
+    quantile can never exceed the bucket every sample sits in."""
+    reg = MetricsRegistry()
+    hist = reg.histogram("neg_units", "t", buckets=(-5.0, 5.0))
+    for v in (-8.0, -7.0, -6.0):
+        hist.observe(v)
+    assert hist.quantile(0.5) == -5.0
+    # Later buckets interpolate between REAL bounds, negative included.
+    hist2 = reg.histogram("neg2_units", "t", buckets=(-10.0, -2.0))
+    for v in (-9.0, -5.0, -5.0, -5.0):
+        hist2.observe(v)
+    assert -10.0 < hist2.quantile(0.75) <= -2.0
+
+
+def test_snapshot_histograms_carry_p50_p99():
+    reg = MetricsRegistry()
+    hist = reg.histogram("h_seconds", "t", buckets=(1.0, 2.0))
+    hist.labels()  # materialize the 0-label child (still empty)
+    entry = reg.snapshot()["h_seconds"]
+    assert entry["p50"] is None and entry["p99"] is None
+    hist.observe(0.5)
+    hist.observe(1.5)
+    entry = reg.snapshot()["h_seconds"]
+    assert 0.0 < entry["p50"] <= 2.0 and 0.0 < entry["p99"] <= 2.0
+    assert entry["count"] == 2
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_recorder_ring_bounds_and_eviction_marker():
+    rec = FlightRecorder(capacity=4)
+    assert len(rec) == 0 and rec.dump() == []
+    for i in range(10):
+        rec.note("handshake", peer=f"p{i}", outcome="ok")
+    assert len(rec) == 4
+    dump = rec.dump()
+    assert [d["peer"] for d in dump] == ["p6", "p7", "p8", "p9"]
+    assert dump[0]["evicted_before"] == 6
+    assert all(d["kind"] == "handshake" for d in dump)
+    assert all("t_mono" in d and "ts" in d for d in dump)
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+# -- staleness tensor: device vs host oracle ----------------------------------
+
+
+def _oracle(state, cfg):
+    import numpy as np
+
+    w = np.asarray(state.w)
+    mv = np.asarray(state.max_version).astype(np.int64)
+    alive = np.asarray(state.alive)
+    n = alive.shape[0]
+    if cfg.version_dtype == "u4r":
+        residual = np.empty((n, n), np.int64)
+        residual[:, 0::2] = (w & 0xF).astype(np.int64)
+        residual[:, 1::2] = (w >> 4).astype(np.int64)
+        wv = mv[None, :] - residual
+    else:
+        wv = w.astype(np.int64)
+    pair = alive[:, None] & alive[None, :]
+    lag = np.where(pair, mv[None, :] - wv, 0)
+    per_node = np.maximum(lag.max(axis=1), 0)
+    ordered = np.sort(per_node)
+    picks = {
+        f"staleness_p{label}": int(
+            ordered[min(n - 1, int(q * (n - 1) + 0.5))]
+        )
+        for label, q in (("50", 0.50), ("99", 0.99), ("100", 1.0))
+    }
+    return per_node.astype(np.int64), picks
+
+
+@pytest.mark.parametrize("rung", ["int32", "u4r"])
+@pytest.mark.parametrize("shards", [1, 2])
+def test_staleness_tensor_bitmatches_host_oracle(rung, shards):
+    import jax
+    import numpy as np
+
+    from aiocluster_tpu.ops.gossip import staleness_tensor
+    from aiocluster_tpu.parallel.mesh import make_mesh
+    from aiocluster_tpu.sim import SimConfig
+    from aiocluster_tpu.sim.simulator import Simulator
+
+    cfg = SimConfig(
+        n_nodes=32, keys_per_node=9, fanout=3, budget=3,
+        version_dtype=rung, track_failure_detector=False,
+        track_heartbeats=False,
+    )
+    mesh = None if shards == 1 else make_mesh(jax.devices()[:2])
+    sim = Simulator(cfg, seed=11, chunk=1, mesh=mesh)
+    sim.run(2)
+    oracle_vec, oracle_picks = _oracle(jax.device_get(sim.state), cfg)
+    assert oracle_picks["staleness_p100"] > 0  # non-trivial mid-flight
+    m = sim.metrics()
+    assert {k: int(m[k]) for k in oracle_picks} == oracle_picks
+    if mesh is None:
+        got = np.asarray(staleness_tensor(sim.state)).astype(np.int64)
+        assert np.array_equal(got, oracle_vec)
+    # p100 is version_spread by construction.
+    assert int(m["staleness_p100"]) == int(m["version_spread"])
+
+
+def test_staleness_gauges_exported_in_round_units():
+    from aiocluster_tpu.obs.sim import SimMetrics
+
+    reg = MetricsRegistry()
+    sm = SimMetrics(reg, stride=1, writes_per_round=4)
+    sm.record(1, {"staleness_p50": 8, "staleness_p99": 12,
+                  "staleness_p100": 20})
+    sm.flush()
+    snap = reg.snapshot()
+    assert snap["aiocluster_sim_staleness_rounds{engine=xla,pct=50}"] == 2.0
+    assert snap["aiocluster_sim_staleness_rounds{engine=xla,pct=99}"] == 3.0
+    assert snap["aiocluster_sim_staleness_rounds{engine=xla,pct=100}"] == 5.0
+
+
+# -- wavefront ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rung", ["int32", "u4r"])
+def test_marked_write_state_is_converged_except_the_marked_write(rung):
+    import numpy as np
+
+    from aiocluster_tpu.obs.sim import marked_write_state
+    from aiocluster_tpu.sim import SimConfig
+    from aiocluster_tpu.sim.packed import watermarks_i32
+
+    cfg = SimConfig(
+        n_nodes=16, keys_per_node=5, fanout=3, budget=8,
+        version_dtype=rung, track_failure_detector=False,
+        track_heartbeats=False,
+    )
+    state = marked_write_state(cfg, owner=3)
+    wv = np.asarray(watermarks_i32(state))
+    mv = np.asarray(state.max_version)
+    assert mv[3] == 6 and (np.delete(mv, 3) == 5).all()
+    assert wv[3, 3] == 6
+    lag = mv[None, :] - wv
+    assert lag[:, 3].sum() == 15  # everyone but the owner one behind
+    assert np.delete(lag, 3, axis=1).sum() == 0
+
+
+def test_wavefront_series_reaches_threshold_monotonically():
+    from aiocluster_tpu.obs.sim import wavefront_series
+    from aiocluster_tpu.sim import SimConfig
+
+    cfg = SimConfig(
+        n_nodes=16, keys_per_node=5, fanout=2, budget=8,
+        track_failure_detector=False, track_heartbeats=False,
+    )
+    wf = wavefront_series(cfg, owner=0, seed=3, max_rounds=64)
+    fr = wf["fractions"]
+    assert fr[0] == pytest.approx(1 / 16)
+    assert all(b >= a for a, b in zip(fr, fr[1:]))  # epidemic: no regress
+    assert wf["rounds_to_threshold"] is not None
+    assert fr[-1] >= 0.99
+
+
+# -- end-to-end: real loopback fleet ------------------------------------------
+
+
+async def _converged_marked_fleet(tmp_path, n=5, prov=True):
+    from aiocluster_tpu.faults.runner import ChaosHarness
+
+    prov_tw = TraceWriter(tmp_path / "prov.jsonl") if prov else None
+    harness = ChaosHarness(
+        n, gossip_interval=0.05, prov_trace=prov_tw
+    )
+    async with harness:
+        await harness.wait_converged(20.0)
+        harness.clusters["n00"].set("marked", "v")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            seen = sum(
+                1
+                for name, c in harness.clusters.items()
+                if name != "n00"
+                for nid, ns in c.node_states_view().items()
+                if nid.name == "n00" and ns.get("marked") is not None
+            )
+            if seen == n - 1:
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise TimeoutError("marked write never fully visible")
+        await asyncio.sleep(0.2)  # let trailing applies hit the trace
+    if prov_tw is not None:
+        prov_tw.close()
+    return harness, prov_tw
+
+
+async def test_runtime_fleet_provenance_joins_all_applies(tmp_path):
+    harness, _tw = await _converged_marked_fleet(tmp_path, n=5)
+    report = harness.propagation_report(key="marked")
+    tree = report.tree(owner="n00", key="marked")
+    assert tree is not None
+    # The prov-smoke acceptance bar at test scale: every apply joined.
+    assert tree.joined_fraction(5) >= 0.99
+    assert tree.origin_t is not None
+    for v in tree.applies():
+        assert v.latency_s is not None and v.latency_s >= 0.0
+        assert v.hop is not None and v.hop >= 1  # every hop resolved
+    # Flight recorders saw the same life: every node has handshake
+    # outcomes and applies in its ring.
+    for cluster in harness.clusters.values():
+        kinds = {e["kind"] for e in cluster.flight_record()}
+        assert "lifecycle" in kinds and "handshake" in kinds
+        assert "apply" in kinds
+
+
+async def test_no_prov_trace_means_no_prov_events(tmp_path):
+    """Defaults untouched: a fleet without prov_trace writes nothing
+    provenance-shaped anywhere (the byte-identical-paths contract)."""
+    harness, _ = await _converged_marked_fleet(tmp_path, n=3, prov=False)
+    with pytest.raises(ValueError):
+        harness.propagation_report()
+    for cluster in harness.clusters.values():
+        assert cluster._prov is None
+        assert cluster._engine._prov is None
+
+
+async def test_flightrec_serve_endpoint_never_shed(tmp_path):
+    from aiocluster_tpu.core.config import Config
+    from aiocluster_tpu.core.identity import NodeId
+    from aiocluster_tpu.runtime.cluster import Cluster
+    from aiocluster_tpu.serve.http import OverloadPolicy, ServeApp
+
+    # Pick a free gossip port up front (NodeId wants a concrete addr).
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    config = Config(
+        node_id=NodeId(name="solo", gossip_advertise_addr=("127.0.0.1", port)),
+        cluster_id="t",
+        gossip_interval=0.05,
+        seed_nodes=[],
+    )
+    cluster = Cluster(config, metrics=MetricsRegistry())
+    await cluster.start()
+    # An overload posture that sheds EVERYTHING shed-able.
+    app = ServeApp(
+        cluster,
+        overload=OverloadPolicy(enabled=True, max_inflight=0),
+    )
+    try:
+        serve_port = await app.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1", serve_port)
+        writer.write(b"GET /debug/flightrec HTTP/1.1\r\n\r\n")
+        await writer.drain()
+        status = await reader.readline()
+        assert b"200" in status  # operator endpoint: never shed
+        headers = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = await reader.readexactly(int(headers["content-length"]))
+        events = json.loads(body)["events"]
+        assert any(
+            e["kind"] == "lifecycle" and e["event"] == "start"
+            for e in events
+        )
+        # A plain endpoint IS shed under the same posture.
+        writer.write(b"GET /state HTTP/1.1\r\n\r\n")
+        await writer.drain()
+        status = await reader.readline()
+        assert b"429" in status
+        writer.close()
+        await writer.wait_closed()
+    finally:
+        await app.stop()
+        await cluster.close()
+
+
+# -- twin drift ---------------------------------------------------------------
+
+
+def _synthetic_twin_trace(tmp_path, *, rate_hz: float, rounds: int = 40,
+                          nodes: int = 3):
+    """A hand-built twin-grade trace at a known per-node round rate."""
+    path = tmp_path / "twin.jsonl"
+    tw = TraceWriter(path)
+    for i in range(nodes):
+        tw.emit(
+            "twin_node", node=f"n{i:02d}", generation=1,
+            gossip_interval_s=1.0 / rate_hz, gossip_count=2,
+            phi_threshold=8.0, max_payload_size=65507, n_own_keys=4,
+        )
+    for r in range(rounds):
+        for i in range(nodes):
+            tw.emit(
+                "twin_round", node=f"n{i:02d}", round=r,
+                duration_s=0.001, targets=2, live=nodes - 1, dead=0,
+                kv_sent=0, kv_applied=0, heartbeat=r + 1, phi_max=0.1,
+            )
+    tw.close()
+    # Rewrite ts fields to an exact cadence (TraceWriter stamps real
+    # wall time; the drift check needs a controlled rate).
+    lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+    out = []
+    for rec in lines:
+        if rec.get("event") == "twin_round":
+            rec["ts"] = 1000.0 + rec["round"] / rate_hz
+        out.append(json.dumps(rec))
+    path.write_text("\n".join(out) + "\n")
+    return path
+
+
+def _calibration(rate_hz: float) -> "object":
+    from aiocluster_tpu.twin import CALIBRATION_SCHEMA, CalibrationRecord
+
+    return CalibrationRecord(
+        schema=CALIBRATION_SCHEMA, source="stored.jsonl", n_nodes=3,
+        trace_rounds=40, fit_rounds=20, holdout_rounds=20,
+        rounds_per_sec=rate_hz, rounds_per_sec_std=0.1,
+        round_duration_s=0.001, kv_scale=None, kv_scale_std=None,
+        sim_converged_round=5, holdout_wall_rel_err=0.01,
+        holdout_kv_rel_err=None, tolerance=0.25, holdout_ok=True,
+    )
+
+
+def test_check_drift_ok_when_rates_match(tmp_path):
+    from aiocluster_tpu.twin import check_drift
+
+    trace = _synthetic_twin_trace(tmp_path, rate_hz=20.0)
+    reg = MetricsRegistry()
+    verdict = check_drift(
+        _calibration(20.0), str(trace), registry=reg
+    )
+    assert verdict.ok and not verdict.drifted_axes
+    assert verdict.window_rounds == 20  # the stored fit window
+    by_axis = {a.axis: a for a in verdict.axes}
+    assert by_axis["rounds_per_sec"].rel_err < 0.05
+    assert reg.snapshot()["aiocluster_twin_drift"] == 0.0
+
+
+def test_check_drift_flags_a_slowed_deployment(tmp_path):
+    from aiocluster_tpu.twin import check_drift
+
+    # The fleet now runs at half the calibrated rate.
+    trace = _synthetic_twin_trace(tmp_path, rate_hz=10.0)
+    reg = MetricsRegistry()
+    verdict = check_drift(_calibration(20.0), str(trace), registry=reg)
+    assert not verdict.ok
+    axes = {a.axis: a for a in verdict.drifted_axes}
+    assert "rounds_per_sec" in axes
+    assert axes["rounds_per_sec"].rel_err == pytest.approx(0.5, abs=0.05)
+    snap = reg.snapshot()
+    assert snap["aiocluster_twin_drift"] == 1.0
+    assert snap[
+        "aiocluster_twin_drift_rel_err{axis=rounds_per_sec}"
+    ] == pytest.approx(0.5, abs=0.05)
+
+
+def test_check_drift_skips_kv_axis_on_midflight_windows(tmp_path):
+    from dataclasses import replace
+
+    from aiocluster_tpu.twin import check_drift
+
+    trace = _synthetic_twin_trace(tmp_path, rate_hz=20.0)
+    cal = replace(_calibration(20.0), kv_scale=2.0, kv_scale_std=0.1)
+    # Window covers only the tail: kv axis is not re-fittable against a
+    # cold-start sim — reported skipped, never silently verdicted.
+    verdict = check_drift(cal, str(trace), window=10)
+    assert "kv_scale" in verdict.skipped_axes
+    assert all(a.axis != "kv_scale" for a in verdict.axes)
+
+
+def test_check_drift_refuses_an_empty_window(tmp_path):
+    from aiocluster_tpu.twin import check_drift
+
+    trace = _synthetic_twin_trace(tmp_path, rate_hz=20.0, rounds=3)
+    with pytest.raises(ValueError):
+        check_drift(_calibration(20.0), str(trace), window=1)

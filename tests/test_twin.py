@@ -440,3 +440,45 @@ def test_sweep_result_objective_entry_point():
     assert result.best_lane(
         lambda row: 1.0 if row["rounds_to_convergence"] else None
     ) == (0, 1.0)
+
+
+def test_cli_twin_check_drift(recorded_trace, tmp_path, capsys):
+    """The cron line (docs/twin.md "drift monitor"): a fresh trace
+    verdicted against a stored calibration — exit 0 when the transfer
+    still fits, 1 once an axis leaves tolerance."""
+    from aiocluster_tpu.__main__ import main
+
+    cal_path = tmp_path / "cal.json"
+    assert main([
+        "twin", "--trace", str(recorded_trace),
+        "--calibration-out", str(cal_path), "--cpu",
+    ]) == 0
+    capsys.readouterr()
+    # The same deployment that produced the calibration: no drift.
+    # Explicit generous tolerance — this asserts the PLUMBING (load a
+    # record, window the trace, verdict, exit 0), not deployment
+    # stability: a loaded CI box can legitimately slow the recorded
+    # fleet's second half past the default 35% vs its first.
+    rc = main([
+        "twin", "--trace", str(recorded_trace),
+        "--check-drift", str(cal_path), "--tolerance", "2.0", "--cpu",
+    ])
+    printed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and printed["drift"]["ok"] is True
+    # A stored record claiming 10x the measured rate: drifted, exit 1
+    # (rel err >= 0.85 even if load halved or doubled the fleet's rate,
+    # far past the record's 0.35 tolerance).
+    stale = json.loads(cal_path.read_text())
+    stale["rounds_per_sec"] *= 10.0
+    stale_path = tmp_path / "stale.json"
+    stale_path.write_text(json.dumps(stale))
+    rc = main([
+        "twin", "--trace", str(recorded_trace),
+        "--check-drift", str(stale_path), "--cpu",
+    ])
+    printed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and printed["drift"]["ok"] is False
+    drifted = [
+        a["axis"] for a in printed["drift"]["axes"] if a["drifted"]
+    ]
+    assert "rounds_per_sec" in drifted

@@ -14,13 +14,42 @@ see it too.
 from __future__ import annotations
 
 import ast
+import re
 
-from .core import FileContext, dotted_name, rule
+from .core import REPO_ROOT, FileContext, dotted_name, rule
 
 # Packages whose emit sites feed the replay dispatcher / the documented
 # event catalogue. tests/benchmarks stay out of scope (they fabricate
 # records on purpose).
 _TRACE_DOMAINS = {"runtime", "sim", "obs", "twin", "serve", "faults"}
+
+# Packages whose metric registrations feed the documented catalogue
+# (docs/observability.md). Same scoping rationale as above: fixture and
+# bench code fabricates families on purpose.
+_METRIC_DOMAINS = _TRACE_DOMAINS | {"ops", "core"}
+
+# The registry's family constructors (obs/registry.py).
+_METRIC_REGISTRARS = {"counter", "gauge", "histogram"}
+
+_METRIC_NAME_RE = re.compile(r"aiocluster_[a-z0-9_:]+")
+
+_documented_cache: frozenset[str] | None = None
+
+
+def _documented_metric_names() -> frozenset[str]:
+    """Every ``aiocluster_*`` token appearing in docs/observability.md
+    — the catalogue ACT041 gates registrations against. Read once per
+    process (the docs file is the same for every analyzed file)."""
+    global _documented_cache
+    if _documented_cache is None:
+        try:
+            text = (REPO_ROOT / "docs" / "observability.md").read_text(
+                encoding="utf-8"
+            )
+        except OSError:
+            text = ""
+        _documented_cache = frozenset(_METRIC_NAME_RE.findall(text))
+    return _documented_cache
 
 
 def _is_trace_receiver(node: ast.expr) -> bool:
@@ -73,4 +102,68 @@ def check_trace_event_literal(ctx: FileContext):
             f"{receiver}.emit(...) passes {what} — trace event kinds "
             "must be string literals (a dynamic kind is invisible to "
             "the twin replay dispatcher and the docs' event catalogue)",
+        )
+
+
+def _is_registry_receiver(node: ast.expr) -> bool:
+    """Receivers that are metric registries by naming convention: the
+    final name segment contains ``metrics`` or ``registry``
+    (``self._metrics``, ``metrics``, ``self.registry``, ``registry``)."""
+    d = dotted_name(node)
+    if d is None:
+        return False
+    last = d.rsplit(".", 1)[-1].lower()
+    return "metrics" in last or "registry" in last
+
+
+@rule(
+    "ACT041",
+    "undocumented-metric-family",
+    "metric family registered but absent from docs/observability.md",
+)
+def check_metric_documented(ctx: FileContext):
+    """Docs-drift gate for the growing metric surface: every family
+    name registered via ``registry.counter/gauge/histogram("...")`` in
+    the instrumented packages must appear in docs/observability.md's
+    catalogue tables — a metric an operator cannot look up is telemetry
+    only its author can read. Only LITERAL names are checked (the one
+    table-driven registration loop, obs/sim.py's ``_SAMPLE_GAUGES``,
+    carries names the docs already list; a dynamic name cannot be
+    verified here and is out of scope by design —
+    docs/static-analysis.md)."""
+    if ctx.tree is None or not (_METRIC_DOMAINS & ctx.domains):
+        return
+    documented = _documented_metric_names()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr in _METRIC_REGISTRARS
+        ):
+            continue
+        if not _is_registry_receiver(func.value):
+            continue
+        first = node.args[0] if node.args else None
+        if first is None:
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    first = kw.value
+                    break
+        if not (
+            isinstance(first, ast.Constant) and isinstance(first.value, str)
+        ):
+            continue  # dynamic names are out of scope (docstring)
+        name = first.value
+        if not name.startswith("aiocluster_"):
+            continue  # fixture/test families live outside the catalogue
+        if name in documented:
+            continue
+        yield ctx.finding(
+            node,
+            "ACT041",
+            f"metric family {name!r} is registered here but missing "
+            "from docs/observability.md's catalogue — document it (the "
+            "metric surface's docs-drift gate)",
         )
