@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke prov-bench prov-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke prov-bench prov-smoke wire-bench wire-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -132,6 +132,20 @@ prov-bench:
 prov-smoke:
 	$(PY) benchmarks/propagation_bench.py --smoke
 
+# Zero-copy wire data plane (benchmarks/handshake_bench.py,
+# docs/migration.md difference #16): quiescent + write-heavy handshake
+# storms, wire_fastpath ON vs OFF on the same pooled fleets. GATES:
+# fast >= 1.5x control handshakes/s quiescent, write-arm encode calls
+# per handshake strictly below control (the segment-cache collapse),
+# and at least one segment/shared-payload cache hit (engagement).
+# Frame byte-identity vs the oracle codec is pinned separately by
+# tests/test_wire_fastpath.py. Smoke ~15 s on a 1-core host.
+wire-bench:
+	$(PY) benchmarks/handshake_bench.py --gate
+
+wire-smoke:
+	$(PY) benchmarks/handshake_bench.py --smoke --gate
+
 # Multihost smoke (benchmarks/multihost_bench.py): TWO real processes
 # join a localhost coordinator (4 virtual CPU devices each, gloo
 # collectives) and run the sharded lean profile — a measured rounds/s
@@ -148,12 +162,13 @@ multihost-smoke:
 # opening, epoch monotonicity), a durability regression (warm rejoin
 # ratio/speed, leave-vs-phi detection), a twin regression (held-out
 # calibration error, one-compile autotune, recommendation-beats-
-# default), or a propagation-provenance regression (join coverage,
-# measured-spread keys, staleness-oracle bit parity) cannot land
-# through this gate. (kernel-parity re-runs one test file that
+# default), a propagation-provenance regression (join coverage,
+# measured-spread keys, staleness-oracle bit parity), or a wire
+# data-plane regression (fast-vs-control ratio, encode-call collapse,
+# cache engagement) cannot land through this gate. (kernel-parity re-runs one test file that
 # test-all also covers — the explicit target keeps the merge gate for
 # kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke twin-smoke prov-smoke test-all
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke twin-smoke prov-smoke wire-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

@@ -32,6 +32,19 @@ from .identity import Address, NodeId
 from .kvstate import KeyChangeFn, NodeState
 from .messages import Delta, Digest, KeyValueUpdate, NodeDelta, NodeDigest
 
+# The wire layer's encode surface, used by the zero-copy packers below.
+# Safe at module level: wire/ imports only core SUBMODULES (identity,
+# messages, values), never this one — and importing it here keeps the
+# helpers out of the per-handshake call path.
+from ..wire.proto import _encode_digest_entry  # noqa: E402
+from ..wire.segments import (  # noqa: E402
+    EMPTY_ENCODED_DELTA,
+    EncodedDelta,
+    SharedNodePayload,
+    node_delta_parts,
+)
+from ..wire.sizes import DeltaSizeModel as _DeltaSizeModel  # noqa: E402
+
 
 @dataclass(frozen=True, slots=True)
 class Staleness:
@@ -81,7 +94,26 @@ class ClusterState:
             "rebuilds": 0,  # per-node NodeDigest reconstructions
             "hits": 0,      # per-node entries served from cache
             "reuses": 0,    # whole assembled Digests served unchanged
+            # Wire fast path (digest_wire_parts): per-node encoded
+            # digest entries rebuilt, and whole assembled parts lists
+            # served unchanged.
+            "parts_rebuilds": 0,
+            "parts_reuses": 0,
         }
+        # Encoded digest section, maintained incrementally alongside
+        # the NodeDigest cache above: one encoded entry (the complete
+        # field-1 submessage bytes) per node, its own dirty set (the
+        # two consumers must not clear each other's), and the live
+        # assembled parts list — patched IN PLACE per dirty entry
+        # (O(dirty) per epoch), fully rebuilt only when membership
+        # order changes or an excluded set is in force.
+        self._dp_entries: dict[NodeId, bytes] = {}
+        self._dp_dirty: set[NodeId] = set()
+        self._dp_parts: list[bytes] | None = None
+        self._dp_index: dict[NodeId, int] = {}
+        self._dp_total = 0
+        self._dp_order_dirty = True
+        self._dp_assembled: tuple | None = None
 
     # -- membership -----------------------------------------------------------
 
@@ -112,6 +144,7 @@ class ClusterState:
         automatically by every NodeState mutator; call it manually after
         white-box direct field writes."""
         self._dirty.add(node_id)
+        self._dp_dirty.add(node_id)
         self._epoch += 1
 
     @property
@@ -144,6 +177,9 @@ class ClusterState:
         self._node_states.pop(node_id, None)
         self._digest_cache.pop(node_id, None)
         self._dirty.discard(node_id)
+        self._dp_entries.pop(node_id, None)
+        self._dp_dirty.discard(node_id)
+        self._dp_order_dirty = True
         self._epoch += 1
 
     # -- reconciliation -------------------------------------------------------
@@ -203,6 +239,123 @@ class ClusterState:
         self._assembled_key = key
         return digest
 
+    def digest_wire_parts(
+        self, scheduled_for_deletion: set[NodeId]
+    ) -> tuple[list[bytes], int]:
+        """The encoded digest section as (buffer list, total length) —
+        the wire fast path's counterpart of
+        ``encode_digest(compute_digest(...))``, byte-identical by
+        construction (each buffer IS a memoized ``_encode_digest_entry``
+        output, in ``_node_states`` iteration order).
+
+        Incremental at both levels: only dirty nodes re-encode their
+        entry, and the assembled list is PATCHED in place per dirty
+        entry — O(dirty) per epoch, which on a live fleet is usually
+        the one node whose heartbeat moved; a full O(n) rebuild happens
+        only on membership-order changes or under a non-empty excluded
+        set (rare: nodes scheduled for deletion). Callers must not
+        mutate the returned list, and must not hold it across state
+        mutations (the engine's packet assemblers copy it into their
+        frame synchronously — the cached Syn parts are flattened
+        copies, so in-place patching can never reach into an
+        already-assembled frame)."""
+        stats = self.digest_cache_stats
+        entries = self._dp_entries
+        if scheduled_for_deletion:
+            # Exclusion in force: serve from the keyed-assembly slow
+            # path (the incremental list below always carries every
+            # member).
+            if self._dp_dirty:
+                rebuilt = 0
+                for node_id in self._dp_dirty:
+                    ns = self._node_states.get(node_id)
+                    if ns is not None:
+                        entries[node_id] = _encode_digest_entry(ns.digest())
+                        rebuilt += 1
+                self._dp_dirty.clear()
+                self._dp_order_dirty = True  # entries moved under the list
+                stats["parts_rebuilds"] += rebuilt
+            key = (self._epoch, frozenset(scheduled_for_deletion))
+            cached = self._dp_assembled
+            if cached is not None and cached[0] == key:
+                stats["parts_reuses"] += 1
+                return cached[1], cached[2]
+            parts: list[bytes] = []
+            total = 0
+            for node_id, ns in self._node_states.items():
+                if node_id in scheduled_for_deletion:
+                    continue
+                e = entries.get(node_id)
+                if e is None:
+                    e = _encode_digest_entry(ns.digest())
+                    entries[node_id] = e
+                    stats["parts_rebuilds"] += 1
+                parts.append(e)
+                total += len(e)
+            self._dp_assembled = (key, parts, total)
+            return parts, total
+        if self._dp_order_dirty or self._dp_parts is None:
+            # Full rebuild: membership changed (add order is handled
+            # incrementally below; removals and excluded-set calls
+            # invalidate order wholesale). Also covers white-box states
+            # injected behind the API — every node re-enters here.
+            rebuilt = 0
+            index: dict[NodeId, int] = {}
+            parts = []
+            total = 0
+            for node_id, ns in self._node_states.items():
+                e = entries.get(node_id)
+                if e is None or node_id in self._dp_dirty:
+                    e = _encode_digest_entry(ns.digest())
+                    entries[node_id] = e
+                    rebuilt += 1
+                index[node_id] = len(parts)
+                parts.append(e)
+                total += len(e)
+            self._dp_dirty.clear()
+            self._dp_order_dirty = False
+            self._dp_parts = parts
+            self._dp_index = index
+            self._dp_total = total
+            stats["parts_rebuilds"] += rebuilt
+        elif self._dp_dirty:
+            parts = self._dp_parts
+            index = self._dp_index
+            total = self._dp_total
+            new_ids: set[NodeId] | None = None
+            rebuilt = 0
+            for node_id in self._dp_dirty:
+                ns = self._node_states.get(node_id)
+                if ns is None:
+                    continue  # raced a removal; order flag handles it
+                e = _encode_digest_entry(ns.digest())
+                entries[node_id] = e
+                rebuilt += 1
+                i = index.get(node_id)
+                if i is None:
+                    if new_ids is None:
+                        new_ids = set()
+                    new_ids.add(node_id)
+                else:
+                    total += len(e) - len(parts[i])
+                    parts[i] = e
+            if new_ids:
+                # Fresh members append in _node_states order (insertion
+                # order — new keys land at the end, matching how a full
+                # rebuild would lay them out).
+                for node_id in self._node_states:
+                    if node_id in new_ids and node_id not in index:
+                        e = entries[node_id]
+                        index[node_id] = len(parts)
+                        parts.append(e)
+                        total += len(e)
+            self._dp_dirty.clear()
+            self._dp_total = total
+            stats["parts_rebuilds"] += rebuilt
+        else:
+            stats["parts_reuses"] += 1
+        return self._dp_parts, self._dp_total
+
     def gc_marked_for_deletion(self, grace_period: timedelta) -> None:
         for ns in self._node_states.values():
             ns.gc_marked_for_deletion(grace_period)
@@ -224,27 +377,10 @@ class ClusterState:
         per-replica knowledge to a single watermark integer.
         """
         if size_model is None:
-            from ..wire.sizes import DeltaSizeModel
-
-            size_model = DeltaSizeModel
+            size_model = _DeltaSizeModel
         sizes = size_model()
 
-        candidates: list[tuple[NodeState, int]] = []
-        for node_id, ns in self._node_states.items():
-            if node_id in scheduled_for_deletion:
-                continue
-            peer = digest.node_digests.get(node_id)
-            peer_gc = peer.last_gc_version if peer is not None else 0
-            peer_max = peer.max_version if peer is not None else 0
-            if ns.max_version <= peer_max:
-                continue
-            # If the peer is so far behind that our GC watermark has passed
-            # everything it knows, restart it from scratch (version floor 0).
-            reset = peer_gc < ns.last_gc_version and peer_max < ns.last_gc_version
-            floor = 0 if reset else peer_max
-            # ns.max_version > peer_max >= floor always holds here, so the
-            # node is stale by construction (no need to score it).
-            candidates.append((ns, floor))
+        candidates = self._stale_candidates(digest, scheduled_for_deletion)
 
         node_deltas: list[NodeDelta] = []
         for ns, floor in candidates:
@@ -283,3 +419,125 @@ class ClusterState:
                 break
 
         return Delta(node_deltas=node_deltas)
+
+    def _stale_candidates(
+        self, digest: Digest, scheduled_for_deletion: set[NodeId]
+    ) -> list[tuple[NodeState, int]]:
+        """(node state, floor) pairs the peer described by ``digest`` is
+        stale on — THE candidate walk, shared verbatim by the object
+        packer above and the encoded packer below so the two can never
+        select differently."""
+        candidates: list[tuple[NodeState, int]] = []
+        for node_id, ns in self._node_states.items():
+            if node_id in scheduled_for_deletion:
+                continue
+            peer = digest.node_digests.get(node_id)
+            peer_gc = peer.last_gc_version if peer is not None else 0
+            peer_max = peer.max_version if peer is not None else 0
+            if ns.max_version <= peer_max:
+                continue
+            # If the peer is so far behind that our GC watermark has passed
+            # everything it knows, restart it from scratch (version floor 0).
+            reset = peer_gc < ns.last_gc_version and peer_max < ns.last_gc_version
+            floor = 0 if reset else peer_max
+            # ns.max_version > peer_max >= floor always holds here, so the
+            # node is stale by construction (no need to score it).
+            candidates.append((ns, floor))
+        return candidates
+
+    def compute_partial_delta_encoded(
+        self,
+        digest: Digest,
+        mtu: int,
+        scheduled_for_deletion: set[NodeId],
+        segments,
+        shared=None,
+        collect_kvs: bool = False,
+    ):
+        """The wire fast path's packer: same candidate walk, same MTU
+        accounting (one shared ``DeltaSizeModel``), same selection —
+        but each key-value is priced by its cached segment LENGTH and
+        the result is an :class:`~..wire.segments.EncodedDelta` of
+        buffer refs, never a re-encode (``b"".join(enc.buffers)`` is
+        byte-identical to ``encode_delta`` of the object packer's
+        result; the differential fuzz suite pins it across every
+        mutation kind and MTU-exact truncation boundaries).
+
+        ``shared`` (a SharedPayloadCache) lets k peers catching up on
+        the same (node, floor) window in one round cost ONE assembly:
+        only UNTRUNCATED node payloads are shared (truncation depends
+        on this frame's remaining budget), and a cached payload is only
+        used when it fits the remaining budget whole — otherwise the
+        truncating walk runs, exactly as the oracle would.
+
+        ``collect_kvs`` additionally records (owner, key, version) refs
+        for provenance emission; it bypasses the shared cache (shared
+        entries carry no refs)."""
+        sizes = _DeltaSizeModel()
+        buffers: list[bytes] = []
+        wire_len = 0
+        kv_total = 0
+        node_count = 0
+        kv_refs: list[tuple[str, list[tuple[str, int]]]] | None = (
+            [] if collect_kvs else None
+        )
+        for ns, floor in self._stale_candidates(digest, scheduled_for_deletion):
+            shared_key = None
+            if shared is not None and not collect_kvs:
+                shared_key = (ns.node, ns.content_epoch, floor)
+                ent = shared.get(shared_key)
+                if ent is not None:
+                    if sizes.delta_total_with(ent.accounted_body) <= mtu:
+                        buffers.extend(ent.buffers)
+                        wire_len += ent.wire_len
+                        kv_total += ent.kv_count
+                        node_count += 1
+                        sizes.commit(ent.accounted_body)
+                        if sizes.total() >= mtu:
+                            break
+                        continue
+                    # Whole payload no longer fits this frame's budget:
+                    # fall through to the truncating walk below.
+            body = sizes.node_delta_base(
+                ns.node, floor, ns.last_gc_version, ns.max_version
+            )
+            segs: list[bytes] = []
+            refs: list[tuple[str, int]] | None = [] if collect_kvs else None
+            truncated = False
+            for key, vv in ns.stale_key_values(floor):
+                seg = segments.segment(ns.node, key, vv)
+                grown = body + sizes.kv_increment_from_segment(seg)
+                if sizes.delta_total_with(grown) > mtu:
+                    truncated = True
+                    break
+                body = grown
+                segs.append(seg)
+                if refs is not None:
+                    refs.append((key, vv.version))
+            if segs:
+                nd_bufs, nd_len = node_delta_parts(
+                    ns.node,
+                    floor,
+                    ns.last_gc_version,
+                    segs,
+                    None if truncated else ns.max_version,
+                )
+                buffers.extend(nd_bufs)
+                wire_len += nd_len
+                kv_total += len(segs)
+                node_count += 1
+                if kv_refs is not None:
+                    kv_refs.append((ns.node.name, refs))
+                sizes.commit(body)
+                if not truncated and shared_key is not None:
+                    shared.store(
+                        shared_key,
+                        SharedNodePayload(
+                            tuple(nd_bufs), body, nd_len, len(segs)
+                        ),
+                    )
+            if sizes.total() >= mtu:
+                break
+        if node_count == 0:
+            return EMPTY_ENCODED_DELTA
+        return EncodedDelta(buffers, wire_len, kv_total, node_count, kv_refs)

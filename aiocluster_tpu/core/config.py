@@ -163,6 +163,19 @@ class Config:
     # zone_bias biases live-target selection toward the node's own
     # zone. None (or the all-defaults instance) changes nothing.
     heterogeneity: "Heterogeneity | None" = None
+    # New in aiocluster_tpu: the zero-copy wire data plane
+    # (wire/segments.py, docs/migration.md difference #16). When True
+    # (the default) outbound SynAck/Ack deltas are assembled from
+    # segment-cached per-key-value encodings (each (node, key, version)
+    # encodes ONCE, MTU packing runs on cached segment LENGTHS instead
+    # of a size-then-encode double walk), the encoded digest section is
+    # maintained incrementally per digest epoch, frames go out as
+    # scatter-gather buffer lists (``writelines`` — no full-payload
+    # ``b"".join``), and inbound frames decode from memoryview spans.
+    # Frames are byte-identical either way (differential-fuzzed);
+    # False restores the encode-per-peer-per-round reference-shaped
+    # paths exactly.
+    wire_fastpath: bool = True
     # New in aiocluster_tpu: durable node state (runtime/persist.py,
     # docs/robustness.md). When set, the cluster journals its own
     # keyspace to a crash-safe local store, restores it at boot (keeping

@@ -58,6 +58,7 @@ class NodeState:
         "max_version",
         "last_gc_version",
         "node",
+        "content_epoch",
         "_vindex",
         "_vindex_dirty",
         "_on_change",
@@ -76,12 +77,28 @@ class NodeState:
         self.key_values: dict[str, VersionedValue] = key_values or {}
         self.max_version = max_version
         self.last_gc_version = last_gc_version
+        # Monotonic content generation: bumps whenever what a delta for
+        # this node could carry changes (key-value installs, tombstones,
+        # TTL marks, GC purges, resets, max_version fast-forwards) —
+        # but NOT on heartbeats. Equal epochs ⇒ identical stale-scan
+        # output at any floor, which is what the wire fast path keys
+        # its shared per-round delta payloads on (wire/segments.py).
+        self.content_epoch = 0
         self._vindex: list[tuple[int, str]] = []
         self._vindex_dirty = bool(self.key_values)
         self._on_change: Callable[[], None] | None = None
 
     def _touch(self) -> None:
         """One of the digest fields changed; tell the container (if any)."""
+        cb = self._on_change
+        if cb is not None:
+            cb()
+
+    def _content_touch(self) -> None:
+        """A kv-content mutation: bump the content generation and fire
+        the digest hook (content mutations conservatively fire the
+        container's dirty-marking exactly like ``_touch`` always did)."""
+        self.content_epoch += 1
         cb = self._on_change
         if cb is not None:
             cb()
@@ -182,14 +199,23 @@ class NodeState:
         """Install ``vv`` unless we already hold an equal-or-newer version.
         Always advances ``max_version`` (the owner has *seen* this version
         even when the key itself is stale)."""
+        bumped = False
         if vv.version > self.max_version:
             self.max_version = vv.version
-            self._touch()
+            self._content_touch()
+            bumped = True
         current = self.key_values.get(key)
         if current is not None and current.version >= vv.version:
             return
         self.key_values[key] = vv
         self._index_add(vv.version, key)
+        if not bumped:
+            # Install BELOW the max_version watermark (a new key at an
+            # old version via set_with_version): the stale scan changed
+            # even though the watermark did not — the content epoch
+            # must move or a shared delta payload cached before this
+            # install would be served missing it (wire/segments.py).
+            self._content_touch()
 
     def set_with_ttl(self, key: str, value: str, ts: datetime | None = None) -> None:
         """Set a value that becomes GC-eligible after the grace period."""
@@ -217,7 +243,7 @@ class NodeState:
         vv.value = ""
         vv.status_change_ts = ts if ts is not None else utc_now()
         self._index_add(vv.version, key)
-        self._touch()
+        self._content_touch()
 
     def delete_after_ttl(self, key: str, ts: datetime | None = None) -> None:
         """Schedule ``key`` for TTL deletion, keeping its value readable via
@@ -230,7 +256,7 @@ class NodeState:
         vv.version = self.max_version
         vv.status_change_ts = ts if ts is not None else utc_now()
         self._index_add(vv.version, key)
-        self._touch()
+        self._content_touch()
 
     # -- replica-side reconciliation ----------------------------------------
 
@@ -275,7 +301,7 @@ class NodeState:
             # reset delta's installs append monotonically again.
             self._vindex = []
             self._vindex_dirty = False
-            self._touch()
+            self._content_touch()
         elif node_delta.last_gc_version > self.last_gc_version:
             self.last_gc_version = node_delta.last_gc_version
             self.key_values = {
@@ -283,7 +309,7 @@ class NodeState:
                 for k, v in self.key_values.items()
                 if v.version > self.last_gc_version or not v.is_deleted()
             }
-            self._touch()
+            self._content_touch()
         for kv in node_delta.key_values:
             if kv.version <= self.max_version:
                 continue
@@ -303,7 +329,7 @@ class NodeState:
             node_delta.max_version > self.max_version
         ):
             self.max_version = node_delta.max_version
-            self._touch()
+            self._content_touch()
 
     # -- garbage collection ---------------------------------------------------
 
@@ -328,7 +354,7 @@ class NodeState:
             # reclaims them, so relative order stays valid.
             self.key_values = survivors
             self.last_gc_version = watermark
-            self._touch()
+            self._content_touch()
 
     # -- heartbeats -----------------------------------------------------------
 

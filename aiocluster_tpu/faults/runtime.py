@@ -591,6 +591,51 @@ class FaultyTransport:
             self._inner._write_timeout if timeout is None else timeout,
         )
 
+    def _rewrite_parts(self, parts, kind: str, label: str | None):
+        """Byzantine rewriting over a scatter-gather parts list FORCES
+        MATERIALIZATION: the attacker must see (and may replace) the
+        whole packet, so the buffers are joined, decoded, rewritten and
+        re-encoded as one buffer — the documented contract that keeps
+        PR 8's wrong-data injection composing unchanged with the
+        zero-copy write path (docs/robustness.md). Honest windows (the
+        overwhelmingly common case) return the parts untouched — the
+        fast path stays join-free."""
+        if not self._ctl.byzantine_active():
+            return parts
+        from ..wire import decode_packet, encode_packet
+
+        payload = b"".join(parts)
+        packet = decode_packet(payload)
+        rewritten = self._ctl.rewrite_packet(packet, label)
+        if rewritten is packet:
+            return parts
+        return [encode_packet(rewritten)]
+
+    async def write_framed_parts(
+        self, writer, parts, kind: str, *, timeout: float | None = None
+    ) -> None:
+        label = self._peer_of.get(writer)
+        # Rewrite before the label gate — an attacker lies in both
+        # roles (the responder's SynAck parts carry label None), same
+        # as write_packet above.
+        parts = self._rewrite_parts(parts, kind, label)
+        if label is None:
+            return await self._inner.write_framed_parts(
+                writer, parts, kind, timeout=timeout
+            )
+        d = self._ctl.apply(label, "write")
+        if d.duplicate:
+            await self._inner.write_framed_parts(
+                writer, parts, kind, timeout=timeout
+            )
+        await self._with_delay(
+            d.delay,
+            lambda: self._inner.write_framed_parts(
+                writer, parts, kind, timeout=timeout
+            ),
+            self._inner._write_timeout if timeout is None else timeout,
+        )
+
     async def start_server(self, host, port, handler):
         return await self._inner.start_server(host, port, handler)
 

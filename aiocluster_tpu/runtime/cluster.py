@@ -224,6 +224,11 @@ class Cluster:
             metrics=self._metrics,
             flightrec=self._flightrec,
         )
+        # Zero-copy wire data plane (wire/segments.py): when on (the
+        # default), handshake steps below route through the
+        # scatter-gather parts paths; False keeps every encode/frame/
+        # decode byte- and path-identical to the reference shape.
+        self._wire_fastpath = config.wire_fastpath
         transport = GossipTransport(
             max_payload_size=config.max_payload_size,
             connect_timeout=config.connect_timeout,
@@ -233,6 +238,7 @@ class Cluster:
             tls_client_context=config.tls_client_context,
             tls_server_hostname=config.tls_server_hostname,
             metrics=self._metrics,
+            wire_fastpath=config.wire_fastpath,
         )
         # Deterministic fault injection (docs/faults.md): only an
         # EFFECTIVE plan — the configured fault_plan plus
@@ -1156,7 +1162,16 @@ class Cluster:
                 conn: PooledConnection | None = None
                 reused = False
                 try:
-                    syn_bytes = self._engine.make_syn_bytes()
+                    syn_parts = (
+                        self._engine.make_syn_parts()
+                        if self._wire_fastpath
+                        else None
+                    )
+                    syn_bytes = (
+                        None
+                        if syn_parts is not None
+                        else self._engine.make_syn_bytes()
+                    )
                     # The retry (attempt 1) must actually redial: another
                     # idle sibling of the connection that just died would
                     # burn the retry on the same peer restart.
@@ -1166,9 +1181,14 @@ class Cluster:
                     )
                     reused = conn.reused
                     rtt_start = time.perf_counter()
-                    await self._transport.write_framed(
-                        conn.writer, syn_bytes, "syn", timeout=budget
-                    )
+                    if syn_parts is not None:
+                        await self._transport.write_framed_parts(
+                            conn.writer, syn_parts, "syn", timeout=budget
+                        )
+                    else:
+                        await self._transport.write_framed(
+                            conn.writer, syn_bytes, "syn", timeout=budget
+                        )
                     reply = await self._transport.read_packet(
                         conn.reader, timeout=budget
                     )
@@ -1194,10 +1214,20 @@ class Cluster:
                             # peers that cost time, not ones that say no.
                             health.record_success(addr)
                     elif isinstance(reply.msg, SynAck):
-                        ack = self._engine.handle_synack(reply, peer=prov_peer)
-                        await self._transport.write_packet(
-                            conn.writer, ack, timeout=budget
-                        )
+                        if self._wire_fastpath:
+                            ack_parts = self._engine.handle_synack_parts(
+                                reply, peer=prov_peer
+                            )
+                            await self._transport.write_framed_parts(
+                                conn.writer, ack_parts, "ack", timeout=budget
+                            )
+                        else:
+                            ack = self._engine.handle_synack(
+                                reply, peer=prov_peer
+                            )
+                            await self._transport.write_packet(
+                                conn.writer, ack, timeout=budget
+                            )
                         if self._config.persistent_connections:
                             # Settled: the finally below must not discard.
                             await self._pool.release(conn)
@@ -1324,10 +1354,19 @@ class Cluster:
                 if not self._verify_peer_tls_name(packet, writer):
                     self._log.warning("TLS peer identity verification failed")
                     return
-                reply = self._engine.handle_syn(packet)
-                await self._transport.write_packet(writer, reply)
-                if isinstance(reply.msg, BadCluster):
-                    return
+                if self._wire_fastpath:
+                    resp = self._engine.handle_syn_parts(packet)
+                    if isinstance(resp, Packet):  # BadCluster
+                        await self._transport.write_packet(writer, resp)
+                        return
+                    await self._transport.write_framed_parts(
+                        writer, resp, "synack"
+                    )
+                else:
+                    reply = self._engine.handle_syn(packet)
+                    await self._transport.write_packet(writer, reply)
+                    if isinstance(reply.msg, BadCluster):
+                        return
                 ack = await self._transport.read_packet(reader)
                 if not isinstance(ack.msg, Ack):
                     self._log.debug("Unexpected gossip ack message type")
@@ -1508,6 +1547,9 @@ class Cluster:
         for node_id in self._failure_detector.garbage_collect():
             self._cluster_state.remove_node(node_id)
             self._departed.pop(node_id, None)
+            # Wire fast path: drop the heartbeat watermark + cached
+            # segments so a future re-add of this NodeId starts fresh.
+            self._engine.note_node_removed(node_id)
             if self._health is not None:
                 # Departed for good: evict the peer's RTT/breaker state
                 # and gauge series (bounded by live membership, not by

@@ -23,6 +23,14 @@ from ..obs.flightrec import FlightRecorder
 from ..obs.registry import MetricsRegistry
 from ..obs.trace import TraceWriter
 from ..wire import encode_packet
+from ..wire.segments import (
+    SegmentStore,
+    SharedPayloadCache,
+    ack_packet_parts,
+    cluster_id_field,
+    syn_packet_parts,
+    synack_packet_parts,
+)
 
 
 def _delta_kv_count(delta: Delta) -> int:
@@ -92,6 +100,41 @@ class GossipEngine:
         # of one round — the identical bytes go out without re-encoding.
         self._syn_cache: tuple[int, frozenset[NodeId], bytes] | None = None
         self._digest_stats_exported: dict[str, int] = {}
+        # Zero-copy wire fast path (Config.wire_fastpath, wire/
+        # segments.py): the segment store (one encode per (node, key,
+        # version)), the shared per-round delta payload LRU, the
+        # scatter-gather Syn parts cache, and the heartbeat-observation
+        # watermark cache. All None with the flag off — every step
+        # below then runs the reference-shaped paths untouched.
+        self._segments = None
+        self._shared_payloads = None
+        self._cid_field = b""
+        self._syn_parts_cache: tuple[int, frozenset[NodeId], list] | None = None
+        self._empty_ack_parts: list[bytes] | None = None
+        self._hb_seen: dict[NodeId, int] | None = None
+        self._wire_segment_events = self._wire_shared = None
+        self._wire_stats_exported: dict[str, int] = {}
+        if config.wire_fastpath:
+            self._segments = SegmentStore()
+            self._shared_payloads = SharedPayloadCache()
+            self._cid_field = cluster_id_field(config.cluster_id)
+            self._hb_seen = {}
+            if metrics is not None:
+                self._wire_segment_events = metrics.counter(
+                    "aiocluster_wire_segment_events_total",
+                    "Wire segment cache activity: hit (cached encode "
+                    "served), miss (first encode of a (node, key, "
+                    "version)), invalidate (cached entry superseded by "
+                    "a newer version/status), evict (LRU bound)",
+                    labels=("event",),
+                )
+                self._wire_shared = metrics.counter(
+                    "aiocluster_wire_shared_payload_total",
+                    "Shared per-round delta payload cache activity: "
+                    "hit (one assembly served to another peer asking "
+                    "for the same (node, floor) window), store, evict",
+                    labels=("event",),
+                )
         # Cumulative reconciliation totals as plain ints, kept even with
         # metrics off: the twin-grade round tracer (Cluster.trace_rounds,
         # docs/twin.md) differences them per round, and registry counters
@@ -100,16 +143,22 @@ class GossipEngine:
         self.kv_applied_total = 0
 
     def _note(self, step: str, sent: Delta | None = None,
-              applied: Delta | None = None) -> None:
+              applied: Delta | None = None,
+              sent_count: int | None = None) -> None:
+        # ``sent_count`` is the fast path's currency (EncodedDelta kv
+        # counts — there is no Delta object to count); ``sent`` remains
+        # the object path's. Either way the same totals and series move.
         if sent is not None:
-            self.kv_sent_total += _delta_kv_count(sent)
+            sent_count = _delta_kv_count(sent)
+        if sent_count is not None:
+            self.kv_sent_total += sent_count
         if applied is not None:
             self.kv_applied_total += _delta_kv_count(applied)
         if self._steps is None:
             return
         self._steps.labels(step).inc()
-        if sent is not None:
-            self._delta_kvs.labels("sent").inc(_delta_kv_count(sent))
+        if sent_count is not None:
+            self._delta_kvs.labels("sent").inc(sent_count)
         if applied is not None:
             self._delta_kvs.labels("applied").inc(_delta_kv_count(applied))
 
@@ -134,15 +183,72 @@ class GossipEngine:
                 self._digest_events.labels(event).inc(value - prev)
                 self._digest_stats_exported[event] = value
 
+    def _sync_wire_metrics(self) -> None:
+        """Export the segment/shared-payload plain counters (wire/ is
+        obs-free, same rationale as the digest stats) as registry
+        counter deltas."""
+        if self._wire_segment_events is None or self._segments is None:
+            return
+        exported = self._wire_stats_exported
+        for prefix, stats, counter in (
+            ("seg_", self._segments.stats, self._wire_segment_events),
+            ("shr_", self._shared_payloads.stats, self._wire_shared),
+        ):
+            for event, value in stats.items():
+                k = prefix + event
+                prev = exported.get(k, 0)
+                if value > prev:
+                    counter.labels(event).inc(value - prev)
+                    exported[k] = value
+
     def _observe_digest(self, digest: Digest) -> None:
         """Heartbeats piggyback on digests; every one we see feeds the
         failure detector (except our own)."""
+        seen = self._hb_seen
+        if seen is not None:
+            # Fast path: a per-peer-node watermark of the highest
+            # heartbeat already processed. A population-sized digest
+            # from a quiescent fleet advances one or two entries per
+            # handshake; every other entry's ``apply_heartbeat`` would
+            # be a guaranteed no-op (it only credits INCREASES), so the
+            # state lookup is skipped wholesale. First observations
+            # (watermark absent) always take the full path, which also
+            # creates the node state — membership still spreads via
+            # digests exactly as before. The cluster drops a node's
+            # watermark when the FD garbage-collects it
+            # (note_node_removed), so a re-added node re-initializes.
+            me = self._config.node_id
+            for node_id, nd in digest.node_digests.items():
+                hb = nd.heartbeat
+                prev = seen.get(node_id)
+                if prev is not None and hb <= prev:
+                    continue
+                if node_id == me:
+                    continue
+                seen[node_id] = hb
+                ns = self._state.node_state_or_default(node_id)
+                if ns.apply_heartbeat(hb):
+                    self._fd.report_heartbeat(node_id)
+            return
         for node_id, nd in digest.node_digests.items():
             if node_id == self._config.node_id:
                 continue
             ns = self._state.node_state_or_default(node_id)
             if ns.apply_heartbeat(nd.heartbeat):
                 self._fd.report_heartbeat(node_id)
+
+    def note_node_removed(self, node_id: NodeId) -> None:
+        """Membership removal (FD garbage collection): drop the
+        heartbeat watermark and the node's cached wire segments AND
+        shared payloads so a future re-add observes and encodes from
+        scratch — a re-added NodeState restarts its content_epoch, so
+        a lingering shared payload could collide with a fresh
+        (epoch, floor) key and serve a pre-removal window."""
+        if self._hb_seen is not None:
+            self._hb_seen.pop(node_id, None)
+        if self._segments is not None:
+            self._segments.invalidate_node(node_id)
+            self._shared_payloads.invalidate_node(node_id)
 
     # -- handshake steps ------------------------------------------------------
 
@@ -174,6 +280,112 @@ class GossipEngine:
         if self._digest_events is not None:
             self._digest_events.labels("syn_encode").inc()
         return raw
+
+    def make_syn_parts(self) -> list[bytes]:
+        """Initiator step 1, zero-copy: the Syn packet as scatter-gather
+        buffers — envelope head + one memoized digest-entry buffer per
+        node (``ClusterState.digest_wire_parts``). Cached whole per
+        (digest epoch, excluded) like ``make_syn_bytes``; on a miss only
+        the dirty entries re-encode and the envelope head (a few bytes)
+        rebuilds. ``b"".join`` of the parts is byte-identical to
+        ``make_syn_bytes()`` — the differential suite pins it."""
+        self._note("make_syn")
+        excluded = self._excluded()
+        key = (self._state.digest_epoch, frozenset(excluded))
+        cached = self._syn_parts_cache
+        if cached is not None and (cached[0], cached[1]) == key:
+            if self._digest_events is not None:
+                self._digest_events.labels("syn_encode_reuse").inc()
+            return cached[2]
+        dparts, dtotal = self._state.digest_wire_parts(excluded)
+        self._sync_digest_metrics()
+        parts = syn_packet_parts(self._cid_field, dparts, dtotal)
+        self._syn_parts_cache = (key[0], key[1], parts)
+        if self._digest_events is not None:
+            self._digest_events.labels("syn_encode").inc()
+        return parts
+
+    def handle_syn_parts(self, packet: Packet) -> Packet | list[bytes]:
+        """Responder step, zero-copy: the SynAck as scatter-gather
+        buffers — the per-epoch digest section plus an
+        ``EncodedDelta`` packed by cached segment lengths and shared
+        across peers catching up on the same windows this round.
+        Returns a ``Packet`` only for the BadCluster reply (the caller
+        writes that through the object path)."""
+        if packet.cluster_id != self._config.cluster_id:
+            self._note("bad_cluster")
+            return Packet(self._config.cluster_id, BadCluster())
+        assert isinstance(packet.msg, Syn)
+        self._observe_digest(packet.msg.digest)
+        excluded = self._excluded()
+        enc = self._state.compute_partial_delta_encoded(
+            packet.msg.digest,
+            self._config.max_payload_size,
+            excluded,
+            self._segments,
+            self._shared_payloads,
+        )
+        dparts, dtotal = self._state.digest_wire_parts(excluded)
+        self._sync_digest_metrics()
+        self._sync_wire_metrics()
+        self._note("handle_syn", sent_count=enc.kv_count)
+        return synack_packet_parts(self._cid_field, dparts, dtotal, enc)
+
+    def handle_synack_parts(
+        self, packet: Packet, peer: str | None = None
+    ) -> list[bytes]:
+        """Initiator step 2, zero-copy: apply the responder's delta
+        (guarded — the object was decoded from memoryview spans by the
+        transport), reply with an Ack assembled from cached segments.
+        An empty-delta-both-ways handshake resolves to one cached
+        constant buffer list — no delta object, no encode, nothing."""
+        assert isinstance(packet.msg, SynAck)
+        excluded = self._excluded()
+        self._observe_digest(packet.msg.digest)
+        applied = self._apply_guarded(packet.msg.delta, from_peer=peer)
+        collect = self._prov is not None
+        enc = self._state.compute_partial_delta_encoded(
+            packet.msg.digest,
+            self._config.max_payload_size,
+            excluded,
+            self._segments,
+            self._shared_payloads,
+            collect_kvs=collect,
+        )
+        if collect and enc.kv_refs:
+            self._emit_prov_send_refs(enc.kv_refs, peer)
+        self._note("handle_synack", sent_count=enc.kv_count, applied=applied)
+        self._sync_wire_metrics()
+        if enc.node_count == 0:
+            parts = self._empty_ack_parts
+            if parts is None:
+                parts = ack_packet_parts(self._cid_field, enc)
+                self._empty_ack_parts = parts
+            return parts
+        return ack_packet_parts(self._cid_field, enc)
+
+    def _emit_prov_send_refs(
+        self,
+        kv_refs: list[tuple[str, list[tuple[str, int]]]],
+        to_peer: str | None,
+    ) -> None:
+        """``_emit_prov_sends`` over EncodedDelta kv refs — same record
+        schema, no Delta object required."""
+        if to_peer is None:
+            return
+        t_mono = round(time.monotonic(), 6)
+        node = self._config.node_id.name
+        for owner, refs in kv_refs:
+            for key, version in refs:
+                self._prov.emit(
+                    "prov_send",
+                    node=node,
+                    to_peer=to_peer,
+                    owner=owner,
+                    key=key,
+                    version=version,
+                    t_mono=t_mono,
+                )
 
     def handle_syn(self, packet: Packet) -> Packet:
         """Responder step: answer a Syn with our digest plus the delta the

@@ -11,11 +11,11 @@ from __future__ import annotations
 import asyncio
 import ssl
 from asyncio import StreamReader, StreamWriter
-from collections.abc import Awaitable, Callable
+from collections.abc import Awaitable, Callable, Sequence
 
 from ..core.messages import Packet
 from ..obs.registry import MetricsRegistry
-from ..utils.framing import HEADER_SIZE, frame, read_frame_size
+from ..utils.framing import HEADER_SIZE, frame, frame_header, read_frame_size
 from ..wire import decode_packet, encode_packet
 
 
@@ -33,8 +33,23 @@ class GossipTransport:
         tls_client_context: ssl.SSLContext | None = None,
         tls_server_hostname: str | None = None,
         metrics: MetricsRegistry | None = None,
+        wire_fastpath: bool = False,
     ) -> None:
         self._max_payload_size = max_payload_size
+        # Zero-copy data plane (Config.wire_fastpath): inbound frames
+        # decode from memoryview spans, buffered reads/flushed drains
+        # skip the wait_for task churn, and the parts write path below
+        # is in use. False keeps every read/write byte- and
+        # object-identical to the reference-shaped paths.
+        self._wire_fastpath = wire_fastpath
+        # Write-path copy accounting (plain ints — the handshake bench
+        # reads them; not a metric family): payload bytes that were
+        # memcpy'd into a contiguous buffer during packet assembly or
+        # framing. write_packet costs 2x its payload (encode
+        # materialization + frame concat), write_framed 1x (the payload
+        # was already encoded; frame concat remains), scatter-gather
+        # parts 0 (writelines sends the refs).
+        self.copy_stats = {"payload_bytes_copied": 0}
         # The read-side frame bound. A reply frames digest + delta in
         # ONE packet: the delta is packed to at most the MTU, and any
         # functioning cluster's digest + envelope fit the MTU on their
@@ -142,27 +157,53 @@ class GossipTransport:
         (clamped to ``read_timeout``, runtime/health.py) must govern
         the payload too or a peer stalling after the 4-byte header
         burns the full fixed constant per round."""
-        header = await asyncio.wait_for(
-            reader.readexactly(HEADER_SIZE),
-            timeout=self._read_timeout if timeout is None else timeout,
+        header = await self._read_exact(
+            reader,
+            HEADER_SIZE,
+            self._read_timeout if timeout is None else timeout,
         )
         size = read_frame_size(header)
         if size <= 0 or size > self._max_frame_size:
             raise ValueError(f"invalid message size: {size}")
-        raw = await asyncio.wait_for(
-            reader.readexactly(size),
-            timeout=(
+        raw = await self._read_exact(
+            reader,
+            size,
+            (
                 self._read_timeout
                 if timeout is None
                 else min(self._read_timeout, timeout)
             ),
         )
-        packet = decode_packet(raw)
+        # Fast path: decode from memoryview spans of the frame — nested
+        # submessages become sub-views instead of slice copies, and only
+        # leaf strings/cache keys materialize (wire/proto.py _Reader).
+        packet = decode_packet(memoryview(raw) if self._wire_fastpath else raw)
         if self._packets is not None:
             kind = type(packet.msg).__name__.lower()
             self._packets.labels(kind, "in").inc()
             self._bytes.labels(kind, "in").inc(HEADER_SIZE + size)
         return packet
+
+    async def _read_exact(
+        self, reader: StreamReader, n: int, timeout: float | None
+    ) -> bytes:
+        """``readexactly`` under the operation's timeout budget. Fast
+        path: when the bytes are ALREADY buffered (the common case
+        mid-handshake — the peer's reply usually lands in one segment),
+        ``readexactly`` completes synchronously and the ``wait_for``
+        task it would otherwise be wrapped in is pure overhead — ~30µs
+        of Task churn per wait on this container, several times per
+        handshake. Nothing can block, so nothing needs a timeout; any
+        actual wait takes the normal guarded path."""
+        if self._wire_fastpath:
+            buf = getattr(reader, "_buffer", None)
+            if (
+                buf is not None
+                and len(buf) >= n
+                and getattr(reader, "_exception", None) is None
+            ):
+                return await reader.readexactly(n)
+        return await asyncio.wait_for(reader.readexactly(n), timeout=timeout)
 
     async def write_packet(
         self,
@@ -171,7 +212,9 @@ class GossipTransport:
         *,
         timeout: float | None = None,
     ) -> None:
-        raw = frame(encode_packet(packet))
+        payload = encode_packet(packet)
+        raw = frame(payload)
+        self.copy_stats["payload_bytes_copied"] += 2 * len(payload)
         await self._write_raw(
             writer, raw, type(packet.msg).__name__.lower(), timeout=timeout
         )
@@ -189,7 +232,60 @@ class GossipTransport:
         same way ``write_packet`` derives from the message type;
         ``timeout`` overrides the configured write timeout (the
         adaptive per-peer budget)."""
+        self.copy_stats["payload_bytes_copied"] += len(payload)
         await self._write_raw(writer, frame(payload), kind, timeout=timeout)
+
+    async def write_framed_parts(
+        self,
+        writer: StreamWriter,
+        parts: Sequence[bytes],
+        kind: str,
+        *,
+        timeout: float | None = None,
+    ) -> None:
+        """Scatter-gather write of an already-encoded packet: frame
+        header + every buffer via ``writelines`` — the payload is never
+        concatenated (zero copy-bytes on this path; ``copy_stats``
+        stays untouched).
+
+        The assembled frame is validated against the READ-side bound
+        here, at assembly time: the reader admits at most 2x the MTU
+        (the PR-11 widening — see ``read_packet``), and a multi-buffer
+        write has no single ``frame()`` choke point to catch an
+        oversized assembly, so an over-bound frame must fail loudly at
+        the sender rather than livelock as a peer-side reject-and-
+        resend loop. The packer bounds the delta section to one MTU and
+        a functioning cluster's digest + envelope fit another, so a
+        correct assembly can never trip this."""
+        total = 0
+        for p in parts:
+            total += len(p)
+        if total > self._max_frame_size:
+            raise ValueError(
+                f"assembled frame of {total} bytes exceeds the "
+                f"{self._max_frame_size}-byte read-side bound "
+                "(2x max_payload_size) — a peer could never accept it"
+            )
+        if self._packets is not None:
+            self._packets.labels(kind, "out").inc()
+            self._bytes.labels(kind, "out").inc(HEADER_SIZE + total)
+        writer.writelines([frame_header(total), *parts])
+        # Drain fast path: write() already pushed everything to the
+        # socket in the common case (empty transport buffer ⇒ drain
+        # returns synchronously) — skip the wait_for task. Anything
+        # still buffered waits under the normal timeout budget.
+        transport = writer.transport
+        if (
+            transport is not None
+            and not transport.is_closing()
+            and transport.get_write_buffer_size() == 0
+        ):
+            await writer.drain()
+            return
+        await asyncio.wait_for(
+            writer.drain(),
+            timeout=self._write_timeout if timeout is None else timeout,
+        )
 
     async def _write_raw(
         self,

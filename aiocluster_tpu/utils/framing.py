@@ -11,12 +11,18 @@ HEADER_SIZE = 4
 _MAX_FRAME = 0xFFFFFFFF
 
 
-def frame(payload: bytes) -> bytes:
-    """Prefix ``payload`` with its 4-byte big-endian length."""
-    n = len(payload)
+def frame_header(n: int) -> bytes:
+    """The 4-byte big-endian length prefix alone — the scatter-gather
+    write path sends ``[header, *payload_parts]`` without ever
+    concatenating the payload."""
     if n > _MAX_FRAME:
         raise ValueError(f"payload too large to frame: {n} bytes")
-    return n.to_bytes(HEADER_SIZE, "big") + payload
+    return n.to_bytes(HEADER_SIZE, "big")
+
+
+def frame(payload: bytes) -> bytes:
+    """Prefix ``payload`` with its 4-byte big-endian length."""
+    return frame_header(len(payload)) + payload
 
 
 def read_frame_size(header: bytes) -> int:

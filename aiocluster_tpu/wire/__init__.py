@@ -5,6 +5,7 @@ preserved) so this framework and the reference can gossip in one cluster.
 """
 
 from .proto import (
+    ENCODE_STATS,
     WireError,
     decode_delta,
     decode_digest,
@@ -13,10 +14,21 @@ from .proto import (
     encode_digest,
     encode_packet,
 )
+from .segments import (
+    EMPTY_ENCODED_DELTA,
+    EncodedDelta,
+    SegmentStore,
+    SharedPayloadCache,
+)
 from .sizes import DeltaSizeModel
 
 __all__ = (
     "DeltaSizeModel",
+    "EMPTY_ENCODED_DELTA",
+    "ENCODE_STATS",
+    "EncodedDelta",
+    "SegmentStore",
+    "SharedPayloadCache",
     "WireError",
     "decode_delta",
     "decode_digest",

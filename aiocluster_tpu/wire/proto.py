@@ -48,6 +48,14 @@ __all__ = (
 _VARINT = 0
 _LEN = 2
 
+# Plain process-wide encode-call accounting (cheap int bumps, exported
+# nowhere by default): every key-value BODY encode — whether for real
+# emission, for a size walk (wire/sizes.py prices by encoding), or for
+# a segment-cache miss (wire/segments.py) — counts here, so the
+# handshake benchmark can measure the encode-per-peer-per-round
+# collapse the segment cache buys as a hard number instead of a claim.
+ENCODE_STATS = {"kv_encodes": 0}
+
 
 class WireError(ValueError):
     """Malformed or unsupported wire data."""
@@ -111,9 +119,20 @@ def _field_msg(out: bytearray, field: int, body: bytes) -> None:
 
 
 class _Reader:
+    """Streaming field reader over ``bytes`` OR a read-only
+    ``memoryview`` (the zero-copy read path: ``chunk()`` on a
+    memoryview yields sub-views, so nested submessages decode without
+    intermediate slice copies; anything that must outlive the frame —
+    strings, cache keys — materializes at the leaf)."""
+
     __slots__ = ("buf", "pos", "end")
 
-    def __init__(self, buf: bytes, start: int = 0, end: int | None = None) -> None:
+    def __init__(
+        self,
+        buf: bytes | memoryview,
+        start: int = 0,
+        end: int | None = None,
+    ) -> None:
         self.buf = buf
         self.pos = start
         self.end = len(buf) if end is None else end
@@ -165,9 +184,13 @@ class _Reader:
             raise WireError("truncated field")
 
 
-def _utf8(raw: bytes) -> str:
+def _utf8(raw: bytes | memoryview) -> str:
     try:
-        return raw.decode("utf-8")
+        if type(raw) is bytes:
+            return raw.decode("utf-8")
+        # memoryview span: str() decodes straight off the buffer — the
+        # leaf materialization of the zero-copy read path.
+        return str(raw, "utf-8")
     except UnicodeDecodeError as exc:
         raise WireError(f"invalid utf-8 string field: {exc}") from exc
 
@@ -218,7 +241,7 @@ def decode_node_id(body: bytes) -> NodeId:
     distinct encoding is safe (and makes snapshot dict lookups cheaper
     via pointer-equal keys)."""
     if len(body) <= _NODE_ID_CACHE_MAX_BODY:
-        return _decode_node_id_cached(bytes(body))
+        return _decode_node_id_cached(bytes(body))  # noqa: ACT042 -- bounded (<=256B) cache-key materialization; a view key would pin the frame
     return _decode_node_id(body)
 
 
@@ -314,13 +337,22 @@ def decode_node_digest(body: bytes) -> NodeDigest:
     return NodeDigest(node_id, heartbeat, last_gc, max_version)
 
 
-def encode_kv_update(kv: KeyValueUpdate) -> bytes:
+def encode_kv_body(key: str, value: str, version: int, status: int) -> bytes:
+    """The KeyValueUpdatePb submessage body from bare fields — THE one
+    kv encoder: ``encode_kv_update`` (the DTO oracle) and the segment
+    cache (wire/segments.py) both delegate here, so the two can never
+    drift byte-wise. Every call is one real encode (ENCODE_STATS)."""
+    ENCODE_STATS["kv_encodes"] += 1
     out = bytearray()
-    _field_str(out, 1, kv.key)
-    _field_str(out, 2, kv.value)
-    _field_varint(out, 3, kv.version)
-    _field_varint(out, 4, int(kv.status))
+    _field_str(out, 1, key)
+    _field_str(out, 2, value)
+    _field_varint(out, 3, version)
+    _field_varint(out, 4, status)
     return bytes(out)
+
+
+def encode_kv_update(kv: KeyValueUpdate) -> bytes:
+    return encode_kv_body(kv.key, kv.value, kv.version, int(kv.status))
 
 
 def decode_kv_update(body: bytes) -> KeyValueUpdate:
@@ -358,6 +390,10 @@ def encode_node_delta(nd: NodeDelta) -> bytes:
         else None
     )
     if bulk is not None:
+        # The C side encoded one body per kv: same accounting currency
+        # as encode_kv_body, so the bench's encode-call collapse figure
+        # is honest whichever path engaged.
+        ENCODE_STATS["kv_encodes"] += len(nd.key_values)
         out += bulk
     else:
         for kv in nd.key_values:
@@ -369,8 +405,13 @@ def encode_node_delta(nd: NodeDelta) -> bytes:
 
 def decode_node_delta(body: bytes) -> NodeDelta:
     # Large bodies (MTU-full deltas, ~2000 kvs at 64KB) take the native
-    # bulk parser; output is identical to the Python loop below.
+    # bulk parser; output is identical to the Python loop below. The
+    # native side needs contiguous bytes (ctypes c_char_p) — the ONE
+    # materialization of a memoryview-span delta, after which every kv
+    # string decodes from it directly.
     if len(body) >= 512:
+        if type(body) is not bytes:
+            body = bytes(body)  # noqa: ACT042 -- the ONE materialization of a memoryview delta: ctypes c_char_p needs contiguous bytes
         try:
             parsed = _native.decode_node_delta_raw(body)
         except _native.NativeDecodeError as exc:
@@ -463,51 +504,107 @@ def _decode_digest_entry_cached(body: bytes) -> NodeDigest:
     return NodeDigest(node_id, heartbeat, last_gc, max_version)
 
 
-def decode_digest(body: bytes) -> Digest:
+def decode_digest(body: bytes | memoryview) -> Digest:
     """Hot path: every handshake carries one or two digests with an
     entry per known node. Small entries (every honest one) go through
     the memoized single-entry decode above — one bytes-slice + dict hit
     per unchanged entry; oversized entries are parsed in a WINDOW of
     the one top-level reader. Both mirror decode_node_digest exactly
-    (same _Reader primitives, same WireError cases; decode_node_digest
-    remains the single-entry API and the differential-test oracle)."""
-    r = _Reader(body)
+    (same varint semantics, same WireError cases; decode_node_digest
+    remains the single-entry API and the differential-test oracle).
+
+    The entry loop is hand-flattened: an honest digest is a run of
+    ``0x0a <len> <body>`` entries with single-byte tags and (for
+    entries under 128 bytes — all of them) single-byte lengths, so the
+    population-sized per-handshake decode costs one byte compare, one
+    slice and one dict probe per entry instead of a reader-object
+    varint walk. Anything else — multi-byte lengths, foreign fields,
+    non-minimal tag encodings — falls back to the generic _Reader
+    path with identical semantics."""
     digests: dict[NodeId, NodeDigest] = {}
-    while not r.at_end():
-        field, wt = r.field()
-        if field == 1 and wt == _LEN:
-            n = r.varint()
-            entry_end = r.pos + n
-            if entry_end > r.end:
+    buf = body
+    pos = 0
+    end = len(body)
+    while pos < end:
+        if buf[pos] == 0x0A:  # field 1, LEN — minimally encoded
+            pos += 1
+            if pos >= end:
+                raise WireError("truncated varint")
+            n = buf[pos]
+            pos += 1
+            if n >= 0x80:
+                # Multi-byte length varint (entries over 127 bytes).
+                n &= 0x7F
+                shift = 7
+                while True:
+                    if pos >= end:
+                        raise WireError("truncated varint")
+                    b = buf[pos]
+                    pos += 1
+                    n |= (b & 0x7F) << shift
+                    if not b & 0x80:
+                        n &= 0xFFFFFFFFFFFFFFFF
+                        break
+                    shift += 7
+                    if shift > 63:
+                        raise WireError("varint too long")
+            entry_end = pos + n
+            if entry_end > end:
                 raise WireError("truncated length-delimited field")
-            if n <= _DIGEST_ENTRY_CACHE_MAX_BODY:
-                nd = _decode_digest_entry_cached(r.buf[r.pos:entry_end])
-                r.pos = entry_end
-                digests[nd.node_id] = nd
-                continue
-            node_id = _EMPTY_NODE_ID
-            heartbeat = last_gc = max_version = 0
-            outer_end = r.end
-            r.end = entry_end
-            while r.pos < entry_end:
-                ef, ewt = r.field()
-                if ef == 1 and ewt == _LEN:
-                    node_id = decode_node_id(r.chunk())
-                elif ef == 2 and ewt == _VARINT:
-                    heartbeat = r.varint()
-                elif ef == 3 and ewt == _VARINT:
-                    last_gc = r.varint()
-                elif ef == 4 and ewt == _VARINT:
-                    max_version = r.varint()
-                else:
-                    r.skip(ewt)
-            r.end = outer_end
-            digests[node_id] = NodeDigest(
-                node_id, heartbeat, last_gc, max_version
-            )
+            nd = _decode_digest_entry_at(buf, pos, entry_end, n)
+            digests[nd.node_id] = nd
+            pos = entry_end
         else:
-            r.skip(wt)
+            # Generic arm: multi-byte/non-minimal tags, unknown fields.
+            r = _Reader(buf, pos, end)
+            field, wt = r.field()
+            if field == 1 and wt == _LEN:
+                n = r.varint()
+                entry_end = r.pos + n
+                if entry_end > end:
+                    raise WireError("truncated length-delimited field")
+                nd = _decode_digest_entry_at(buf, r.pos, entry_end, n)
+                digests[nd.node_id] = nd
+                pos = entry_end
+            else:
+                r.skip(wt)
+                pos = r.pos
     return Digest(digests)
+
+
+def _decode_digest_entry_at(
+    buf: bytes | memoryview, start: int, end: int, n: int
+) -> NodeDigest:
+    """THE entry dispatch both decode_digest arms share: cache-eligible
+    bodies go through the memoized decode (bytes() is the cache-key
+    materialization — a no-op on a bytes buffer; a memoryview key would
+    pin the whole frame), oversized ones parse in a window."""
+    if n <= _DIGEST_ENTRY_CACHE_MAX_BODY:
+        return _decode_digest_entry_cached(bytes(buf[start:end]))  # noqa: ACT042 -- bounded (<=256B) cache-key materialization; a view key would pin the frame
+    return _decode_digest_entry_window(buf, start, end)
+
+
+def _decode_digest_entry_window(
+    buf: bytes | memoryview, start: int, end: int
+) -> NodeDigest:
+    """Oversized (cache-ineligible) digest entry, parsed in a window of
+    the shared buffer — mirrors decode_node_digest exactly."""
+    r = _Reader(buf, start, end)
+    node_id = _EMPTY_NODE_ID
+    heartbeat = last_gc = max_version = 0
+    while r.pos < end:
+        ef, ewt = r.field()
+        if ef == 1 and ewt == _LEN:
+            node_id = decode_node_id(r.chunk())
+        elif ef == 2 and ewt == _VARINT:
+            heartbeat = r.varint()
+        elif ef == 3 and ewt == _VARINT:
+            last_gc = r.varint()
+        elif ef == 4 and ewt == _VARINT:
+            max_version = r.varint()
+        else:
+            r.skip(ewt)
+    return NodeDigest(node_id, heartbeat, last_gc, max_version)
 
 
 def encode_delta(delta: Delta) -> bytes:
@@ -627,7 +724,7 @@ def _decode_ack(body: bytes) -> Ack:
     return Ack(delta)
 
 
-def decode_packet(data: bytes) -> Packet:
+def decode_packet(data: bytes | memoryview) -> Packet:
     r = _Reader(data)
     cluster_id = ""
     msg: Syn | SynAck | Ack | BadCluster | Leave | None = None

@@ -1,0 +1,312 @@
+"""Zero-copy wire data plane: segment-cached kv encoding, shared delta
+payloads, and scatter-gather packet assembly.
+
+The reference (and this repo before ``Config.wire_fastpath``) re-encodes
+every stale key-value **per peer per round**: once to size it against
+the MTU (wire/sizes.py prices by encoding) and once to emit it, then
+copies the assembled payload at least twice more (proto envelope
+concat → frame prefix concat → writer). This module removes all of
+that while staying byte-identical to the oracle codec in proto.py:
+
+- :class:`SegmentStore` — each (node, key, version) key-value encodes
+  ONCE into an immutable *segment*: the complete field-4 submessage
+  (tag + length varint + body), so a delta body is a concatenation of
+  segments and the MTU packer can price by ``len(segment)``
+  (``DeltaSizeModel.kv_increment_from_segment``) with zero encode work.
+  Entries self-validate on use — a lookup whose cached
+  (version, status) no longer matches the live value re-encodes and
+  counts an ``invalidate`` — so a stale segment can never outlive a
+  mutation, whatever path mutated the state.
+- :class:`SharedPayloadCache` — one node's fully-assembled,
+  untruncated delta payload for a given catch-up window, keyed by
+  (node, content_epoch, floor): k peers requesting the same window in
+  one round cost ONE assembly, not k. Truncated payloads are never
+  shared (truncation depends on the requesting frame's remaining
+  budget).
+- :class:`EncodedDelta` + the ``*_packet_parts`` helpers — an encoded
+  DeltaPb as a list of buffer refs plus exact envelope arithmetic, so
+  the transport can ``writelines([header, *parts])`` without ever
+  materializing the payload (``b"".join``-free by construction; the
+  analyzer's ACT042 rule enforces that discipline across wire/ and the
+  transport).
+
+Everything here must stay byte-for-byte equal to
+``encode_packet(Packet(...))`` over the same logical messages — the
+differential fuzz suite (tests/test_wire_fastpath.py) pins that,
+including MTU-exact truncation boundaries and invalidation after every
+mutation kind.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from ..core.identity import NodeId
+from ..core.values import VersionedValue
+from .proto import _uvarint, encode_kv_body, encode_node_id
+
+__all__ = (
+    "SegmentStore",
+    "SharedPayloadCache",
+    "EncodedDelta",
+    "EMPTY_ENCODED_DELTA",
+    "node_delta_parts",
+    "syn_packet_parts",
+    "synack_packet_parts",
+    "ack_packet_parts",
+    "cluster_id_field",
+)
+
+# Single-byte proto3 tags for the schema's fields (field << 3 | wire_type).
+_TAG_DELTA_ENTRY = b"\x0a"   # Delta.node_deltas      (field 1, LEN)
+_TAG_ND_NODE_ID = b"\x0a"    # NodeDelta.node_id      (field 1, LEN)
+_TAG_ND_FVE = 0x10           # NodeDelta.from_version_excluded (2, VARINT)
+_TAG_ND_LGC = 0x18           # NodeDelta.last_gc_version       (3, VARINT)
+_TAG_ND_KV = b"\x22"         # NodeDelta.key_values            (4, LEN)
+_TAG_ND_MAXV = b"\x28"       # NodeDelta.max_version           (5, VARINT)
+_TAG_SYN = b"\x12"           # Packet.syn             (field 2, LEN)
+_TAG_SYNACK = b"\x1a"        # Packet.syn_ack         (field 3, LEN)
+_TAG_ACK = b"\x22"           # Packet.ack             (field 4, LEN)
+_TAG_DIGEST = b"\x12"        # Syn/SynAck.digest      (field 2, LEN)
+_TAG_DELTA = b"\x1a"         # SynAck/Ack.delta       (field 3, LEN)
+_TAG_CLUSTER_ID = b"\x0a"    # Packet.cluster_id      (field 1, LEN)
+
+
+class SegmentStore:
+    """Bounded LRU of encoded key-value segments, keyed (node, key).
+
+    A hit requires the cached (version, status) to match the live
+    ``VersionedValue`` — versions are owner-monotonic and every
+    sanctioned mutation (owner writes, tombstones, TTL marks, replica
+    installs) moves the version, so validation-on-use makes stale
+    segments structurally impossible rather than relying on an
+    invalidation callback firing. ``stats`` are plain ints (core-style;
+    the engine exports them as
+    ``aiocluster_wire_segment_events_total{event}``).
+    """
+
+    __slots__ = ("_cache", "_max_entries", "stats")
+
+    def __init__(self, max_entries: int = 65536) -> None:
+        self._cache: OrderedDict[
+            tuple[NodeId, str], tuple[int, int, bytes]
+        ] = OrderedDict()
+        self._max_entries = max_entries
+        self.stats = {"hit": 0, "miss": 0, "invalidate": 0, "evict": 0}
+
+    def segment(self, node_id: NodeId, key: str, vv: VersionedValue) -> bytes:
+        """The complete field-4 submessage for (key, vv) — encoded once
+        per (version, status), then served as the same immutable bytes
+        to every peer and every sizing pass."""
+        ck = (node_id, key)
+        cache = self._cache
+        entry = cache.get(ck)
+        status = int(vv.status)
+        if entry is not None:
+            if entry[0] == vv.version and entry[1] == status:
+                self.stats["hit"] += 1
+                cache.move_to_end(ck)
+                return entry[2]
+            self.stats["invalidate"] += 1
+        self.stats["miss"] += 1
+        body = encode_kv_body(key, vv.value, vv.version, status)
+        seg = _TAG_ND_KV + _uvarint(len(body)) + body
+        cache[ck] = (vv.version, status, seg)
+        if entry is not None:
+            # Replacing an invalidated entry keeps its (stale) LRU slot
+            # on plain assignment — a hot, frequently-rewritten key
+            # must land at the MRU end like any other fresh use.
+            cache.move_to_end(ck)
+        if len(cache) > self._max_entries:
+            cache.popitem(last=False)
+            self.stats["evict"] += 1
+        return seg
+
+    def invalidate_node(self, node_id: NodeId) -> None:
+        """Drop every segment for ``node_id`` (membership removal).
+        Purely a memory courtesy: validation-on-use already makes the
+        entries harmless, but a departed node's segments would
+        otherwise linger until LRU pressure."""
+        dead = [ck for ck in self._cache if ck[0] == node_id]
+        for ck in dead:
+            del self._cache[ck]
+        if dead:
+            self.stats["invalidate"] += len(dead)
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
+@dataclass(slots=True)
+class EncodedDelta:
+    """An encoded DeltaPb as buffer refs: ``b"".join(buffers)`` equals
+    ``encode_delta(delta)`` for the logical delta it represents, but no
+    caller ever performs that join — the transport writes the list.
+
+    ``kv_refs`` is per-node ``(owner_name, [(key, version), ...])`` and
+    is only collected when the caller asked (provenance tracing);
+    otherwise None.
+    """
+
+    buffers: tuple[bytes, ...] | list[bytes]
+    wire_len: int
+    kv_count: int
+    node_count: int
+    kv_refs: list[tuple[str, list[tuple[str, int]]]] | None = None
+
+
+# Shared empty result: an empty DeltaPb encodes to zero bytes, so every
+# empty-delta handshake reuses this one object — no Delta/NodeDelta
+# construction, no encode (the "empty both ways" fast resolution).
+EMPTY_ENCODED_DELTA = EncodedDelta((), 0, 0, 0, None)
+
+
+@dataclass(slots=True)
+class SharedNodePayload:
+    """One node's untruncated delta payload for one catch-up window."""
+
+    buffers: tuple[bytes, ...]
+    accounted_body: int  # DeltaSizeModel body (max_version reserved)
+    wire_len: int        # actual framed bytes (sum of buffer lengths)
+    kv_count: int
+
+
+class SharedPayloadCache:
+    """Bounded LRU of :class:`SharedNodePayload`, keyed
+    (node, content_epoch, floor). The content epoch moves on every
+    kv-content mutation (core/kvstate.py), so equal keys imply an
+    identical stale scan — the payload is reusable verbatim for every
+    peer catching up on the same window within the same state."""
+
+    __slots__ = ("_cache", "_max_entries", "stats")
+
+    def __init__(self, max_entries: int = 128) -> None:
+        self._cache: OrderedDict[
+            tuple[NodeId, int, int], SharedNodePayload
+        ] = OrderedDict()
+        self._max_entries = max_entries
+        self.stats = {"hit": 0, "store": 0, "evict": 0}
+
+    def get(self, key: tuple[NodeId, int, int]) -> SharedNodePayload | None:
+        ent = self._cache.get(key)
+        if ent is not None:
+            self.stats["hit"] += 1
+            self._cache.move_to_end(key)
+        return ent
+
+    def store(
+        self, key: tuple[NodeId, int, int], payload: SharedNodePayload
+    ) -> None:
+        self._cache[key] = payload
+        self.stats["store"] += 1
+        if len(self._cache) > self._max_entries:
+            self._cache.popitem(last=False)
+            self.stats["evict"] += 1
+
+    def invalidate_node(self, node_id: NodeId) -> None:
+        """Drop every payload for ``node_id``. REQUIRED on membership
+        removal (unlike the segment store's validation-on-use, these
+        entries are keyed by the node's ``content_epoch`` — a re-added
+        NodeState restarts that counter at 0, so a stale entry could
+        collide with a fresh (epoch, floor) pair and serve a
+        pre-removal window)."""
+        dead = [k for k in self._cache if k[0] == node_id]
+        for k in dead:
+            del self._cache[k]
+        if dead:
+            self.stats["evict"] += len(dead)
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
+def node_delta_parts(
+    node_id: NodeId,
+    from_version_excluded: int,
+    last_gc_version: int,
+    segments: list[bytes],
+    max_version: int | None,
+) -> tuple[list[bytes], int]:
+    """Assemble one NodeDelta's buffers: the field-1 entry prefix (tag +
+    body length + node_id/floor/gc fields), the kv segments by
+    reference, and the trailing ``max_version`` field when the delta is
+    complete. Returns (buffers, framed_length). Byte-identical to
+    ``_field_msg(out, 1, encode_node_delta(nd))``."""
+    head = bytearray()
+    nid = encode_node_id(node_id)  # memoized bytes
+    head += _TAG_ND_NODE_ID
+    head += _uvarint(len(nid))
+    head += nid
+    if from_version_excluded:
+        head.append(_TAG_ND_FVE)
+        head += _uvarint(from_version_excluded)
+    if last_gc_version:
+        head.append(_TAG_ND_LGC)
+        head += _uvarint(last_gc_version)
+    kv_len = 0
+    for seg in segments:
+        kv_len += len(seg)
+    trailer = None
+    if max_version is not None:
+        # Explicit-presence field: emitted even when 0 (the oracle's
+        # _field_varint_present).
+        trailer = _TAG_ND_MAXV + _uvarint(max_version)
+    body_len = len(head) + kv_len + (len(trailer) if trailer else 0)
+    prefix = _TAG_DELTA_ENTRY + _uvarint(body_len) + bytes(head)
+    buffers = [prefix, *segments]
+    if trailer is not None:
+        buffers.append(trailer)
+    return buffers, len(prefix) + kv_len + (len(trailer) if trailer else 0)
+
+
+def cluster_id_field(cluster_id: str) -> bytes:
+    """The packet's field-1 cluster_id bytes (empty string omitted,
+    proto3 zero-skip — matches ``_field_str``)."""
+    if not cluster_id:
+        return b""
+    raw = cluster_id.encode("utf-8")
+    return _TAG_CLUSTER_ID + _uvarint(len(raw)) + raw
+
+
+def _len_prefixed(tag: bytes, body_len: int) -> tuple[bytes, int]:
+    """(tag + length varint, total field size including body)."""
+    head = tag + _uvarint(body_len)
+    return head, len(head) + body_len
+
+
+def syn_packet_parts(
+    cid_field: bytes, digest_parts: list[bytes], digest_len: int
+) -> list[bytes]:
+    """Encoded Syn packet as buffers: byte-identical to
+    ``encode_packet(Packet(cluster_id, Syn(digest)))``."""
+    dig_head, dig_total = _len_prefixed(_TAG_DIGEST, digest_len)
+    body_head, _ = _len_prefixed(_TAG_SYN, dig_total)
+    return [cid_field + body_head + dig_head, *digest_parts]
+
+
+def synack_packet_parts(
+    cid_field: bytes,
+    digest_parts: list[bytes],
+    digest_len: int,
+    enc: EncodedDelta,
+) -> list[bytes]:
+    """Encoded SynAck packet as buffers: byte-identical to
+    ``encode_packet(Packet(cluster_id, SynAck(digest, delta)))``."""
+    dig_head, dig_total = _len_prefixed(_TAG_DIGEST, digest_len)
+    dl_head, dl_total = _len_prefixed(_TAG_DELTA, enc.wire_len)
+    body_head, _ = _len_prefixed(_TAG_SYNACK, dig_total + dl_total)
+    return [
+        cid_field + body_head + dig_head,
+        *digest_parts,
+        dl_head,
+        *enc.buffers,
+    ]
+
+
+def ack_packet_parts(cid_field: bytes, enc: EncodedDelta) -> list[bytes]:
+    """Encoded Ack packet as buffers: byte-identical to
+    ``encode_packet(Packet(cluster_id, Ack(delta)))``."""
+    dl_head, dl_total = _len_prefixed(_TAG_DELTA, enc.wire_len)
+    body_head, _ = _len_prefixed(_TAG_ACK, dl_total)
+    return [cid_field + body_head + dl_head, *enc.buffers]

@@ -62,6 +62,16 @@ class DeltaSizeModel:
         """Bytes added to a node-delta body by appending ``kv``."""
         return _len_field_size(len(encode_kv_update(kv)))
 
+    @staticmethod
+    def kv_increment_from_segment(segment: bytes) -> int:
+        """``kv_increment`` priced off a cached wire segment: a segment
+        (wire/segments.py) is the COMPLETE field-4 submessage — tag +
+        length varint + body — so its length IS the body increment.
+        This is how the fast packer sizes by cached lengths with zero
+        encode work; ``kv_increment`` (which encodes to measure)
+        remains the oracle the differential fuzz suite checks against."""
+        return len(segment)
+
     def delta_total_with(self, node_delta_body: int) -> int:
         """Total DeltaPb size if a node delta of ``node_delta_body`` bytes
         were appended to what is already committed."""

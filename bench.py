@@ -777,6 +777,9 @@ STDOUT_LINE_CAP = 2000
 # least-essential provenance first; the headline fields
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
+    "wire_bytes_copied_per_handshake",
+    "wire_segment_hit_rate",
+    "wire_fast_vs_control",
     "sim_wavefront_rounds",
     "propagation_hops_p99",
     "propagation_p99_s",
@@ -864,6 +867,18 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         "runtime_handshakes_per_sec_per_round": (
             hs.get("per_round") or {}
         ).get("handshakes_per_sec"),
+        # Zero-copy wire data plane (wire/segments.py): fast-vs-control
+        # quiescent ratio, the write-arm segment hit rate, and write-
+        # path bytes memcpy'd per handshake on the default config.
+        "wire_fast_vs_control": hs.get("fast_vs_control"),
+        "wire_segment_hit_rate": (
+            ((hs.get("write_heavy") or {}).get("fast") or {}).get(
+                "segment_hit_rate"
+            )
+        ),
+        "wire_bytes_copied_per_handshake": (hs.get("pooled") or {}).get(
+            "bytes_copied_per_handshake"
+        ),
         # Reconvergence after a healed 3-way partition: wall-clock on
         # the 16-node runtime fleet, rounds in the sim arm.
         "fault_reconverge_seconds": (fb.get("runtime") or {}).get(

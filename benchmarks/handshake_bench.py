@@ -1,30 +1,42 @@
-"""Back-to-back gossip handshake microbenchmark (runtime fast path).
+"""Back-to-back gossip handshake microbenchmark (runtime fast paths).
 
 The reference-harness measurement (reference_baseline.py) reports
 rounds/s at a *floored gossip interval*, which pins 64 nodes at the
 interval ceiling (~1.37 rounds/s) — round latency and per-round CPU
-hide under the timer. This bench removes the floor entirely: two real
+hide under the timer. This bench removes the floor entirely: real
 socket-backend nodes, each holding a 64-node cluster view (16 keys per
 node, the BASELINE config-2 shape, so digests are population-sized),
 drive Syn→SynAck→Ack handshakes back to back over loopback TCP and
 report handshakes/second.
 
-Two arms, same wire traffic:
+Arms (same wire traffic in each pairing — frames are byte-identical
+across the wire_fastpath flag, pinned by tests/test_wire_fastpath.py):
 
-- ``pooled``    — persistent peer channels (the default config): the
-  initiator borrows its connection from the per-peer pool and the
-  responder loops handshakes on it; digests serve from the incremental
-  cache and the encoded Syn bytes are reused between quiescent rounds.
+- ``pooled``    — the default config: persistent peer channels AND the
+  zero-copy wire fast path (segment-cached delta encoding, incremental
+  digest parts, scatter-gather frames — wire/segments.py).
+- ``control``   — ``wire_fastpath=False`` on the same pooled fleet: the
+  encode-per-peer-per-round reference-shaped wire paths (PR-3 pooling
+  and digest caching still on). The tentpole gate compares pooled
+  against THIS arm: >= 1.5x handshakes/s quiescent.
 - ``per_round`` — ``persistent_connections=False``: the reference's
-  connect/teardown-per-handshake lifecycle on the same code.
+  connect/teardown-per-handshake lifecycle (the PR-3 baseline arm).
+- ``write_heavy`` — live writes during the storm (so deltas are
+  non-empty) fanned to TWO initiators: measures encode-calls-per-
+  handshake (wire.ENCODE_STATS) fast vs control. The segment cache
+  encodes each new key-value ONCE; the control arm re-encodes it per
+  peer per round plus once per size walk — the gate requires the fast
+  arm's figure strictly below the control's.
 
-The record embeds the pool hit/miss/reconnect counters and the digest
-cache stats, so "the fast path actually engaged" is part of the datum
-(every timed pooled handshake must be a pool hit; handshake counts are
-cross-checked against the engine's step counters).
+Each record embeds the engagement evidence (pool hit/miss, digest
+cache stats, segment hit/miss/invalidate, shared-payload hits, write-
+path bytes copied per handshake), so "the fast path actually engaged"
+is part of the datum.
 
 Usage: python benchmarks/handshake_bench.py [--nodes 64] [--handshakes 256]
-Importable: bench.py calls measure() for its BENCH record.
+       [--smoke] [--gate]
+Importable: bench.py calls measure() for its BENCH record; `make
+wire-smoke` runs --smoke --gate as the CI gate.
 """
 
 from __future__ import annotations
@@ -77,39 +89,31 @@ def _filler_delta(n_nodes: int, keys_per_node: int):
     )
 
 
-async def _bench_arm(
-    n_nodes: int, keys_per_node: int, handshakes: int, persistent: bool
-) -> dict:
+def _mk_cluster(name, port, peer_ports, keys_per_node, reg, *,
+                persistent=True, wire_fastpath=True):
     from aiocluster_tpu import Cluster, Config, NodeId
-    from aiocluster_tpu.obs import MetricsRegistry
 
-    p_a, p_b = free_ports(2)
-    registries = [MetricsRegistry(), MetricsRegistry()]
-    clusters = [
-        Cluster(
-            Config(
-                node_id=NodeId(
-                    name=name, gossip_advertise_addr=("127.0.0.1", port)
-                ),
-                cluster_id="hsbench",
-                seed_nodes=[("127.0.0.1", peer)],
-                persistent_connections=persistent,
+    return Cluster(
+        Config(
+            node_id=NodeId(
+                name=name, gossip_advertise_addr=("127.0.0.1", port)
             ),
-            initial_key_values={
-                f"key-{j:04d}": f"{name}:{j}" for j in range(keys_per_node)
-            },
-            metrics=reg,
-        )
-        for name, port, peer, reg in (
-            ("a", p_a, p_b, registries[0]),
-            ("b", p_b, p_a, registries[1]),
-        )
-    ]
-    a, b = clusters
-    filler = _filler_delta(n_nodes - 2, keys_per_node)
+            cluster_id="hsbench",
+            seed_nodes=[("127.0.0.1", p) for p in peer_ports],
+            persistent_connections=persistent,
+            wire_fastpath=wire_fastpath,
+        ),
+        initial_key_values={
+            f"key-{j:04d}": f"{name}:{j}" for j in range(keys_per_node)
+        },
+        metrics=reg,
+    )
+
+
+async def _boot(clusters, n_nodes, keys_per_node):
+    filler = _filler_delta(n_nodes - len(clusters), keys_per_node)
     for c in clusters:
         c._cluster_state.apply_delta(filler)
-
     # Boot only the servers — no ticker, so every handshake below is
     # ours and the inter-round interval is exactly zero.
     for c in clusters:
@@ -117,10 +121,70 @@ async def _bench_arm(
         c._server = await c._transport.start_server(
             host, port, c._handle_connection
         )
+
+
+async def _teardown(clusters):
+    for c in clusters:
+        await c._pool.close()
+        for writer in list(c._inbound):
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+        c._server.close()
+        await c._server.wait_closed()
+
+
+def _wire_stats(clusters) -> dict:
+    """Fleet-wide segment/shared-payload counters + copy accounting."""
+    seg = {"hit": 0, "miss": 0, "invalidate": 0, "evict": 0}
+    shr = {"hit": 0, "store": 0, "evict": 0}
+    copied = 0
+    for c in clusters:
+        eng = c._engine
+        if eng._segments is not None:
+            for k, v in eng._segments.stats.items():
+                seg[k] += v
+            for k, v in eng._shared_payloads.stats.items():
+                shr[k] += v
+        copied += c._transport.copy_stats["payload_bytes_copied"]
+    looked = seg["hit"] + seg["miss"]
+    return {
+        "segment_events": seg,
+        "shared_payload_events": shr,
+        "segment_hit_rate": (
+            round(seg["hit"] / looked, 4) if looked else None
+        ),
+        "payload_bytes_copied": copied,
+    }
+
+
+async def _bench_arm(
+    n_nodes: int,
+    keys_per_node: int,
+    handshakes: int,
+    persistent: bool,
+    wire_fastpath: bool = True,
+) -> dict:
+    from aiocluster_tpu.obs import MetricsRegistry
+    from aiocluster_tpu.wire import ENCODE_STATS
+
+    p_a, p_b = free_ports(2)
+    registries = [MetricsRegistry(), MetricsRegistry()]
+    clusters = [
+        _mk_cluster("a", p_a, [p_b], keys_per_node, registries[0],
+                    persistent=persistent, wire_fastpath=wire_fastpath),
+        _mk_cluster("b", p_b, [p_a], keys_per_node, registries[1],
+                    persistent=persistent, wire_fastpath=wire_fastpath),
+    ]
+    a, _b = clusters
+    await _boot(clusters, n_nodes, keys_per_node)
     trials = 3
     try:
         for _ in range(8):  # warmup: codec caches, pool dial, digests
             await a._gossip_with("127.0.0.1", p_b, "live")
+        encodes0 = ENCODE_STATS["kv_encodes"]
         # Best-of-N batches: the container's scheduler is noisy and this
         # measures the attainable rate (reference_baseline.py methodology).
         best = float("inf")
@@ -130,22 +194,15 @@ async def _bench_arm(
                 await a._gossip_with("127.0.0.1", p_b, "live")
             best = min(best, time.perf_counter() - start)
         elapsed = best
+        encodes = ENCODE_STATS["kv_encodes"] - encodes0
+        timed = trials * handshakes
     finally:
-        for c in clusters:
-            await c._pool.close()
-            for writer in list(c._inbound):
-                writer.close()
-                try:
-                    await writer.wait_closed()
-                except Exception:
-                    pass
-            c._server.close()
-            await c._server.wait_closed()
+        await _teardown(clusters)
 
     # A failed handshake is swallowed by design in _gossip_with; the
     # step counter proves every timed handshake completed its SynAck.
     snap = registries[0].snapshot()
-    expected = 8 + trials * handshakes
+    expected = 8 + timed
     completed = snap.get('aiocluster_handshake_steps_total{step=handle_synack}')
     if completed != expected:
         raise RuntimeError(
@@ -156,27 +213,155 @@ async def _bench_arm(
         for key, value in snap.items()
         if key.startswith("aiocluster_pool_events_total{")
     }
+    wire = _wire_stats(clusters)
     return {
         "handshakes_per_sec": round(handshakes / elapsed, 1),
         "handshake_latency_us": round(elapsed / handshakes * 1e6, 1),
+        "encode_calls_per_handshake": round(encodes / timed, 3),
+        "bytes_copied_per_handshake": round(
+            wire["payload_bytes_copied"] / (8 + timed), 1
+        ),
+        "segment_hit_rate": wire["segment_hit_rate"],
         "pool_events": pool_events,
         "digest_cache": dict(a._cluster_state.digest_cache_stats),
+        "wire": wire,
+    }
+
+
+async def _bench_write_arm(
+    n_nodes: int, keys_per_node: int, writes: int, wire_fastpath: bool
+) -> dict:
+    """Live writes during the storm, fanned to TWO initiators: per
+    write, the responder packs the fresh key-value to BOTH peers. The
+    control arm encodes it once per size walk plus once per emission
+    per peer (4 encodes per write); the segment cache encodes it ONCE."""
+    from aiocluster_tpu.obs import MetricsRegistry
+    from aiocluster_tpu.wire import ENCODE_STATS
+
+    p_a, p_b, p_c = free_ports(3)
+    regs = [MetricsRegistry() for _ in range(3)]
+    clusters = [
+        _mk_cluster("a", p_a, [p_b], keys_per_node, regs[0],
+                    wire_fastpath=wire_fastpath),
+        _mk_cluster("b", p_b, [p_a, p_c], keys_per_node, regs[1],
+                    wire_fastpath=wire_fastpath),
+        _mk_cluster("c", p_c, [p_b], keys_per_node, regs[2],
+                    wire_fastpath=wire_fastpath),
+    ]
+    a, b, c = clusters
+    await _boot(clusters, n_nodes, keys_per_node)
+    try:
+        for _ in range(4):  # converge the three-node mesh
+            await a._gossip_with("127.0.0.1", p_b, "live")
+            await c._gossip_with("127.0.0.1", p_b, "live")
+        encodes0 = ENCODE_STATS["kv_encodes"]
+        handshakes = 0
+        start = time.perf_counter()
+        for i in range(writes):
+            b.set(f"wk-{i % 8}", f"v{i}")  # a fresh version every write
+            await a._gossip_with("127.0.0.1", p_b, "live")
+            await c._gossip_with("127.0.0.1", p_b, "live")
+            handshakes += 2
+        elapsed = time.perf_counter() - start
+        encodes = ENCODE_STATS["kv_encodes"] - encodes0
+    finally:
+        await _teardown(clusters)
+    for reg, n in ((regs[0], "a"), (regs[2], "c")):
+        snap = reg.snapshot()
+        done = snap.get(
+            'aiocluster_handshake_steps_total{step=handle_synack}'
+        )
+        if done != 4 + writes:
+            raise RuntimeError(
+                f"initiator {n}: only {done} of {4 + writes} handshakes"
+            )
+    wire = _wire_stats(clusters)
+    return {
+        "handshakes_per_sec": round(handshakes / elapsed, 1),
+        "writes": writes,
+        "encode_calls_per_handshake": round(encodes / handshakes, 3),
+        "segment_hit_rate": wire["segment_hit_rate"],
+        "shared_payload_hits": wire["shared_payload_events"]["hit"],
+        "wire": wire,
     }
 
 
 async def _bench(n_nodes: int, keys_per_node: int, handshakes: int) -> dict:
     pooled = await _bench_arm(n_nodes, keys_per_node, handshakes, True)
+    control = await _bench_arm(
+        n_nodes, keys_per_node, handshakes, True, wire_fastpath=False
+    )
     per_round = await _bench_arm(n_nodes, keys_per_node, handshakes, False)
+    writes = max(32, handshakes // 4)
+    wh_fast = await _bench_write_arm(n_nodes, keys_per_node, writes, True)
+    wh_ctrl = await _bench_write_arm(n_nodes, keys_per_node, writes, False)
     return {
         "n_nodes": n_nodes,
         "keys_per_node": keys_per_node,
         "handshakes": handshakes,
         "pooled": pooled,
+        "control": control,
         "per_round": per_round,
         "pooled_vs_per_round": round(
             pooled["handshakes_per_sec"] / per_round["handshakes_per_sec"], 2
         ),
+        "fast_vs_control": round(
+            pooled["handshakes_per_sec"] / control["handshakes_per_sec"], 2
+        ),
+        "write_heavy": {
+            "fast": wh_fast,
+            "control": wh_ctrl,
+            "encode_collapse": round(
+                wh_ctrl["encode_calls_per_handshake"]
+                / max(wh_fast["encode_calls_per_handshake"], 1e-9),
+                2,
+            ),
+        },
     }
+
+
+def check_gates(record: dict) -> list[str]:
+    """The wire-smoke CI gates. Returns failure strings (empty = green).
+
+    - quiescent: the zero-copy fast path must buy >= 1.5x handshakes/s
+      over the wire_fastpath=False control on the same pooled fleet;
+    - write arm: encode calls per handshake must collapse — strictly
+      below the control's figure (the segment cache's whole point);
+    - engagement: the segment cache must actually serve hits on the
+      write arm (a silently-disengaged fast path must not pass).
+    Frame byte-identity is pinned by tests/test_wire_fastpath.py, which
+    `make check` runs via the test suite.
+    """
+    failures = []
+    ratio = record["fast_vs_control"]
+    if ratio < 1.5:
+        failures.append(
+            f"quiescent fast-vs-control {ratio}x < 1.5x "
+            f"({record['pooled']['handshakes_per_sec']} vs "
+            f"{record['control']['handshakes_per_sec']} hs/s)"
+        )
+    wh = record["write_heavy"]
+    fast_calls = wh["fast"]["encode_calls_per_handshake"]
+    ctrl_calls = wh["control"]["encode_calls_per_handshake"]
+    if not fast_calls < ctrl_calls:
+        failures.append(
+            f"write-arm encode calls/handshake did not collapse: "
+            f"fast {fast_calls} vs control {ctrl_calls}"
+        )
+    # Engagement: on the write arm the second peer's catch-up must be
+    # served from cache — either a shared whole-payload hit (the usual
+    # case: both peers ask for the same (node, floor) window) or a
+    # segment hit (windows differ, segments still reused).
+    served = (
+        wh["fast"]["shared_payload_hits"]
+        + wh["fast"]["wire"]["segment_events"]["hit"]
+    )
+    if served <= 0:
+        failures.append(
+            "neither the segment cache nor the shared payload cache "
+            "served a hit on the write arm — the fast path disengaged"
+        )
+    return failures
 
 
 def measure(
@@ -190,11 +375,17 @@ def measure(
     broken loopback environment."""
     try:
         record = asyncio.run(_bench(n_nodes, keys_per_node, handshakes))
+        wh = record["write_heavy"]
         log(
             f"handshake bench @ {n_nodes}-node view: "
             f"{record['pooled']['handshakes_per_sec']} hs/s pooled, "
-            f"{record['per_round']['handshakes_per_sec']} hs/s per-round "
-            f"({record['pooled_vs_per_round']}x)"
+            f"{record['control']['handshakes_per_sec']} control "
+            f"({record['fast_vs_control']}x), "
+            f"{record['per_round']['handshakes_per_sec']} per-round; "
+            f"write arm encodes/hs {wh['fast']['encode_calls_per_handshake']}"
+            f" vs {wh['control']['encode_calls_per_handshake']} "
+            f"({wh['encode_collapse']}x collapse), segment hit rate "
+            f"{wh['fast']['segment_hit_rate']}"
         )
         return record
     except Exception as exc:
@@ -207,7 +398,17 @@ def main() -> None:
     parser.add_argument("--nodes", type=int, default=64)
     parser.add_argument("--keys", type=int, default=16)
     parser.add_argument("--handshakes", type=int, default=256)
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="smoke scale (fewer handshakes) for the CI gate",
+    )
+    parser.add_argument(
+        "--gate", action="store_true",
+        help="exit 1 unless the wire-smoke gates hold (see check_gates)",
+    )
     args = parser.parse_args()
+    if args.smoke:
+        args.handshakes = min(args.handshakes, 128)
 
     def log(m: str) -> None:
         print(f"[hsbench] {m}", file=sys.stderr, flush=True)
@@ -216,6 +417,13 @@ def main() -> None:
     print(json.dumps(record, indent=1))
     if record is None:
         sys.exit(1)
+    if args.gate:
+        failures = check_gates(record)
+        for f in failures:
+            log(f"GATE FAILED: {f}")
+        if failures:
+            sys.exit(1)
+        log("wire-smoke gates green")
 
 
 if __name__ == "__main__":

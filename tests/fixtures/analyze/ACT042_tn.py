@@ -1,0 +1,39 @@
+# analyze-domain: wire
+"""TN: sanctioned assembly helpers may materialize (that is their job,
+memoized above them); justified noqa covers bounded cache keys;
+non-bytes arithmetic and out-of-domain shapes stay quiet."""
+
+_CACHE = {}
+
+
+def encode_packet(packet):
+    # Sanctioned codec helper: the one materialization per value.
+    out = bytearray()
+    out += b"\x0a"
+    return bytes(out)
+
+
+def frame_header(n):
+    # Sanctioned framing helper.
+    return bytes(4)
+
+
+def node_delta_parts(segments):
+    # Sanctioned segments.py assembly helper.
+    head = bytearray()
+    head += b"\x0a"
+    return [bytes(head), *segments]
+
+
+def segment(node_id, key, vv):
+    # Sanctioned segment-store encoder: one materialization per value.
+    body = bytearray()
+    body += b"\x22"
+    return bytes(body)
+
+
+def total_length(parts):
+    total = 0
+    for p in parts:
+        total += len(p)  # int accumulation, not a payload copy
+    return total

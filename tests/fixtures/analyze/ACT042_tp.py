@@ -1,0 +1,23 @@
+# analyze-domain: wire
+"""TP: full-payload materializations on the wire hot path, outside the
+sanctioned assembly helpers — each one silently reintroduces the
+per-peer-per-round copies the zero-copy data plane removes."""
+
+
+def assemble_reply(parts):
+    # Joining the whole payload instead of writing the parts list.
+    payload = b"".join(parts)
+    return payload
+
+
+def reframe(view):
+    # Materializing a frame span nobody caches or bounds.
+    raw = bytes(view)
+    return raw
+
+
+def grow_packet(header):
+    out = header
+    # Concat-growing a payload: every += re-copies the accumulation.
+    out += b"\x0a\x05hello"
+    return out

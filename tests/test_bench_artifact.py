@@ -99,6 +99,27 @@ def _worst_case_result():
                 "nodes": 65_536, "planner_limit_nodes": 65_536,
                 "profile": "lean", "rounds_per_sec": 6.1,
             },
+            "runtime_handshake_bench": {
+                "n_nodes": 64,
+                "keys_per_node": 16,
+                "handshakes": 256,
+                "pooled": {
+                    "handshakes_per_sec": 812.4,
+                    "bytes_copied_per_handshake": 0.0,
+                },
+                "control": {"handshakes_per_sec": 455.1},
+                "per_round": {"handshakes_per_sec": 348.2},
+                "fast_vs_control": 1.79,
+                "write_heavy": {
+                    "fast": {
+                        "encode_calls_per_handshake": 0.5,
+                        "segment_hit_rate": 0.62,
+                        "shared_payload_hits": 33,
+                    },
+                    "control": {"encode_calls_per_handshake": 2.0},
+                    "encode_collapse": 4.0,
+                },
+            },
             "serve_bench": {
                 "n_nodes": 64,
                 "watchers": 10_000,
@@ -257,6 +278,15 @@ def test_stdout_line_stays_under_cap():
     assert ex["roofline_fraction_of_peak"] == 0.467
     assert ex["max_scale_nodes"] == 65_536
     assert ex["full_record"] == "benchmarks/records/bench_last_run.json"
+    # The zero-copy wire data-plane keys round-trip the writer as flat
+    # scalars: the pooled fast-path rate, the fast-vs-control ratio,
+    # the write-arm segment hit rate, and the write-path copy figure
+    # (handshake_bench.py, docs/migration.md #16).
+    assert ex["runtime_handshakes_per_sec"] == 812.4
+    assert ex["runtime_handshakes_per_sec_per_round"] == 348.2
+    assert ex["wire_fast_vs_control"] == 1.79
+    assert ex["wire_segment_hit_rate"] == 0.62
+    assert ex["wire_bytes_copied_per_handshake"] == 0.0
     # The serve-tier keys round-trip the writer as flat scalars: the
     # cached-read rate, the 10k-watcher wake p99, and the measured
     # encode-once + vs-control evidence.

@@ -200,6 +200,10 @@ def _compute_domains(relpath: str, src: str) -> set[str]:
         domains.add("twin")
     if "/faults/" in p:
         domains.add("faults")
+    if "/wire/" in p:
+        domains.add("wire")
+    if p.endswith("runtime/transport.py"):
+        domains.add("transport")
     if p.endswith("core/kvstate.py"):
         domains.add("kvstate")
     if p.endswith("core/cluster_state.py"):

@@ -14,7 +14,7 @@ from collections import Counter
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from . import rules_async, rules_jax, rules_obs, rules_owner, rules_style  # noqa: ACT002 -- imported for rule registration side effects
+from . import rules_async, rules_jax, rules_obs, rules_owner, rules_style, rules_wire  # noqa: ACT002 -- imported for rule registration side effects
 from .core import RULES, FileContext, Finding, load_context
 
 # Directory suffix of the deliberate-violation fixture corpus: analyzing

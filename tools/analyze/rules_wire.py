@@ -1,0 +1,115 @@
+"""ACT04x (cont.) — wire data-plane copy discipline.
+
+The zero-copy gossip data plane (wire/segments.py, `Config.wire_fastpath`)
+holds a structural promise: payloads are assembled as lists of cached
+buffer refs and written scatter-gather — the only full-payload
+materializations are the sanctioned assembly/codec helpers (which encode
+each buffer ONCE) and the explicitly-documented decode-side cache-key
+conversions. A stray ``bytes(...)``, ``b"".join`` or bytes-concat
+``+=`` on the hot path silently reintroduces the per-peer-per-round
+copies the fast path exists to remove — and nothing would fail, it
+would just get slower. ACT042 makes that a gate instead of a hope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, rule
+
+# Files in scope: the wire package and the socket transport — the
+# byte-moving hot path. (faults/runtime.py's byzantine materialization
+# is OUT of scope by design: rewriting is documented to force a join.)
+_COPY_DOMAINS = {"wire", "transport"}
+
+# Sanctioned assembly/codec helpers: materializing a buffer is their
+# JOB, and each materialization happens once per logical value (encode
+# memoization / segment cache above them dedups the rest). Anything
+# else in the domain that copies must either move into one of these or
+# carry an explicit ``# noqa: ACT042 -- why`` justification.
+_SANCTIONED_FUNCS = frozenset({
+    # proto.py field/primitive emitters
+    "_uvarint", "_field_str", "_field_msg", "_field_varint",
+    "_field_varint_present",
+    # proto.py message encoders (bytearray -> bytes materialization)
+    "encode_kv_body", "encode_kv_update", "encode_node_id",
+    "encode_node_digest", "_encode_digest_entry", "encode_node_delta",
+    "encode_digest", "encode_delta", "encode_packet",
+    # native bulk marshaling (ctypes needs contiguous input)
+    "encode_kv_updates", "decode_node_delta_raw",
+    # framing
+    "frame", "frame_header", "unframe",
+    # segments.py assembly helpers
+    "segment", "node_delta_parts", "cluster_id_field", "_len_prefixed",
+    "syn_packet_parts", "synack_packet_parts", "ack_packet_parts",
+})
+
+
+def _is_bytes_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in {"bytes", "bytearray"}
+    )
+
+
+def _is_bytes_join(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "join"
+        and isinstance(node.func.value, ast.Constant)
+        and isinstance(node.func.value.value, bytes)
+    )
+
+
+def _is_bytes_augadd(node: ast.AST) -> bool:
+    """``x += b"..."`` / ``x += bytes(...)`` — growing a buffer by
+    concatenation (each step copies the whole accumulated payload)."""
+    if not (isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add)):
+        return False
+    v = node.value
+    return (
+        (isinstance(v, ast.Constant) and isinstance(v.value, bytes))
+        or _is_bytes_call(v)
+    )
+
+
+@rule(
+    "ACT042",
+    "hot-path-payload-copy",
+    "payload materialization outside the sanctioned assembly helpers",
+)
+def check_hot_path_payload_copy(ctx: FileContext):
+    """Flags ``bytes(...)``/``bytearray(...)`` calls, ``b"".join``, and
+    bytes-concat ``+=`` in wire/ and runtime/transport.py outside the
+    sanctioned assembly helpers (see _SANCTIONED_FUNCS) — the copy
+    discipline the zero-copy data plane's throughput rests on
+    (docs/static-analysis.md)."""
+    if ctx.tree is None or not (_COPY_DOMAINS & ctx.domains):
+        return
+    # Walk with an enclosing-function stack so findings know whether
+    # they sit inside a sanctioned helper.
+    stack: list[tuple[ast.AST, bool]] = [(ctx.tree, False)]
+    while stack:
+        node, sanctioned = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sanctioned = sanctioned or node.name in _SANCTIONED_FUNCS
+        hit = None
+        if _is_bytes_call(node):
+            hit = f"{node.func.id}(...) materializes a payload copy"
+        elif _is_bytes_join(node):
+            hit = 'b"".join(...) concatenates the whole payload'
+        elif _is_bytes_augadd(node):
+            hit = "bytes += concat re-copies the accumulated payload"
+        if hit is not None and not sanctioned:
+            yield ctx.finding(
+                node,
+                "ACT042",
+                f"{hit} on the wire hot path — assemble through the "
+                "sanctioned helpers (wire/segments.py, the proto "
+                "encoders) or justify with a noqa (zero-copy "
+                "data-plane discipline, docs/static-analysis.md)",
+            )
+        for child in ast.iter_child_nodes(node):
+            stack.append((child, sanctioned))
