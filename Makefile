@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke prov-bench prov-smoke wire-bench wire-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke prov-bench prov-smoke wire-bench wire-smoke fleet-bench fleet-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -146,6 +146,20 @@ wire-bench:
 wire-smoke:
 	$(PY) benchmarks/handshake_bench.py --smoke --gate
 
+# Fleet telemetry plane (benchmarks/fleet_bench.py,
+# docs/observability.md "Fleet telemetry"): gossip-borne health digests
+# + any-member fleet views through a split-brain heal, with wire-level
+# trace context on. GATES: a random member's view covers >= 99% of the
+# fleet with bounded staleness p99, per-entry advertised watermarks
+# stay monotone across the heal, and the marked write's provenance
+# joins 100% of applies EXACTLY (zero send-heuristic joins). The smoke
+# (6 nodes, ~20 s CPU) gates CI via `check`.
+fleet-bench:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py
+
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --smoke
+
 # Multihost smoke (benchmarks/multihost_bench.py): TWO real processes
 # join a localhost coordinator (4 virtual CPU devices each, gloo
 # collectives) and run the sharded lean profile — a measured rounds/s
@@ -163,12 +177,14 @@ multihost-smoke:
 # ratio/speed, leave-vs-phi detection), a twin regression (held-out
 # calibration error, one-compile autotune, recommendation-beats-
 # default), a propagation-provenance regression (join coverage,
-# measured-spread keys, staleness-oracle bit parity), or a wire
+# measured-spread keys, staleness-oracle bit parity), a wire
 # data-plane regression (fast-vs-control ratio, encode-call collapse,
-# cache engagement) cannot land through this gate. (kernel-parity re-runs one test file that
+# cache engagement), or a fleet-telemetry regression (view coverage,
+# staleness bound, watermark monotonicity, exact provenance joins)
+# cannot land through this gate. (kernel-parity re-runs one test file that
 # test-all also covers — the explicit target keeps the merge gate for
 # kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke twin-smoke prov-smoke wire-smoke test-all
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke twin-smoke prov-smoke wire-smoke fleet-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

@@ -1,12 +1,15 @@
-"""Command-line entry points: ``python -m aiocluster_tpu {node,sim}``.
+"""Command-line entry points: ``python -m aiocluster_tpu {node,sim,...}``.
 
-The reference is library-only (no CLI); these two subcommands make both
+The reference is library-only (no CLI); these subcommands make both
 backends usable without writing code:
 
 - ``node`` boots one asyncio cluster node (reference examples/simple.py
   shape) and prints a snapshot line per gossip interval until Ctrl-C.
 - ``sim`` runs a tensor-sim convergence study and prints one JSON line
   of results (metrics + rounds to convergence).
+- ``twin`` replays a recorded trace into the digital twin (docs/twin.md).
+- ``fleet`` asks any member's serve tier for its fleet view (GET /fleet,
+  obs/fleet.py) and renders the per-node health table.
 """
 
 from __future__ import annotations
@@ -409,6 +412,67 @@ def _run_twin(args: argparse.Namespace) -> int:
     return 0 if ok else 1
 
 
+def _run_fleet(args: argparse.Namespace) -> int:
+    """Operator fleet view: fetch GET /fleet from any member's serve
+    tier (stdlib urllib — the CLI must work on a box with nothing but
+    the package installed) and render the table. ``--json`` passes the
+    payload through for scripting."""
+    from urllib.error import URLError
+    from urllib.request import urlopen
+
+    url = args.url.rstrip("/") + "/fleet"
+    if args.stale_s is not None:
+        url = f"{url}?stale_s={args.stale_s:g}"
+    try:
+        with urlopen(url, timeout=args.timeout) as resp:
+            view = json.loads(resp.read().decode())
+    except (URLError, OSError, ValueError) as exc:
+        print(f"fleet: {url}: {exc}", file=sys.stderr, flush=True)
+        return 2
+    if args.json:
+        print(json.dumps(view, sort_keys=True), flush=True)
+        return 0
+    head = (
+        f"fleet via {view.get('self', '?')}  epoch={view.get('epoch')}  "
+        f"known={view.get('known')}  covered={view.get('covered')}  "
+        f"coverage={view.get('coverage_frac')}  "
+        f"suspect={view.get('suspect')}"
+    )
+    if "staleness_p99_s" in view:
+        head += (
+            f"  staleness p50/p99/max="
+            f"{view['staleness_p50_s']:g}/{view['staleness_p99_s']:g}"
+            f"/{view['staleness_max_s']:g}s"
+        )
+    print(head, flush=True)
+    rows = [("NODE", "LIVE", "HB", "STALE_S", "STATE", "P99_S")]
+    for name in sorted(view.get("nodes", {})):
+        entry = view["nodes"][name]
+        digest = entry.get("digest") or {}
+        if entry.get("suspect"):
+            stale = "suspect"
+        elif entry.get("staleness_s") is not None:
+            stale = f"{entry['staleness_s']:g}"
+        else:
+            stale = "-"
+        p99 = digest.get("p99")
+        rows.append((
+            name,
+            "yes" if entry.get("live") else "no",
+            str(entry.get("heartbeat_local", "-")),
+            stale,
+            str(digest.get("st", "-")),
+            "-" if p99 is None else f"{p99:g}",
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for row in rows:
+        print(
+            "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip(),
+            flush=True,
+        )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="python -m aiocluster_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -504,6 +568,20 @@ def main(argv: list[str] | None = None) -> int:
     twin.add_argument("--cpu", action="store_true",
                       help="pin the CPU backend")
 
+    fleet = sub.add_parser(
+        "fleet",
+        help="render any member's fleet view (GET /fleet, obs/fleet.py)",
+    )
+    fleet.add_argument("--url", required=True, metavar="URL",
+                       help="base URL of a member's serve tier, e.g. "
+                       "http://127.0.0.1:8080")
+    fleet.add_argument("--stale-s", type=float, default=None, dest="stale_s",
+                       metavar="SECONDS",
+                       help="only entries at most this stale (?stale_s=)")
+    fleet.add_argument("--timeout", type=float, default=5.0)
+    fleet.add_argument("--json", action="store_true",
+                       help="print the raw JSON payload instead of a table")
+
     args = parser.parse_args(argv)
     if args.command == "node":
         try:
@@ -512,6 +590,8 @@ def main(argv: list[str] | None = None) -> int:
             return 0
     if args.command == "twin":
         return _run_twin(args)
+    if args.command == "fleet":
+        return _run_fleet(args)
     try:
         cfg = _sim_config(args)
     except ValueError as exc:  # bad --mtu/--nodes/--grace combinations

@@ -185,3 +185,27 @@ class Config:
     # (the default) constructs none of it: every path is byte-identical
     # to the reference's amnesiac restart semantics.
     persistence: PersistenceConfig | None = None
+    # New in aiocluster_tpu: wire-level span context
+    # (docs/observability.md "Fleet telemetry"). When True, every
+    # Syn/SynAck/Ack this node sends carries envelope field 7 — the
+    # sender's name plus an initiator-chosen handshake id echoed by the
+    # responder — so responder-side provenance applies name their
+    # ``from_peer`` EXACTLY (no 30s send-join heuristic) and flight
+    # recorders on both sides correlate one handshake's three packets.
+    # Reference peers skip the unknown field. False (the default)
+    # appends nothing: frames are byte-identical to the reference.
+    trace_context: bool = False
+    # New in aiocluster_tpu: gossip-borne self-telemetry
+    # (obs/fleet.py, docs/observability.md "Fleet telemetry"). When
+    # set, the node folds a compact health digest (heartbeat, phi
+    # posture, live/dead counts, breaker-open peers, persist/rejoin
+    # state, round-latency p50/p99, serve epoch, applied-kv watermark)
+    # into its OWN keyspace under TELEMETRY_PREFIX every this-many
+    # seconds — one owner write per interval, so the content epoch
+    # bumps at most once per interval and SnapshotCache dedup / shared
+    # payloads stay effective. Replicates like any key (guards,
+    # segments fastpath, MTU budget); ``Cluster.fleet_view()`` and
+    # ``GET /fleet`` assemble the fleet table from it. None (the
+    # default) publishes nothing: the keyspace is byte-identical to the
+    # reference's.
+    telemetry_interval: float | None = None

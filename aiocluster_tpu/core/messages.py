@@ -135,8 +135,33 @@ class Leave:
 
 
 @dataclass(slots=True)
+class TraceContext:
+    """Wire-level span context riding Syn/SynAck/Ack when
+    ``Config.trace_context`` is on (docs/observability.md "Fleet
+    telemetry"). ``node`` names the packet's SENDER; ``handshake_id``
+    is chosen by the handshake's initiator and echoed by the responder,
+    correlating all three packets of one exchange across both nodes'
+    flight recorders. It closes the provenance collector's one blind
+    spot: a responder applying an Ack delta can name ``from_peer``
+    exactly instead of relying on the 30s closest-preceding-send
+    heuristic. New beyond the reference schema (envelope field 7) —
+    reference peers skip unknown fields, and the context only ever
+    rides WITH a handshake message, so they decode the same packet
+    minus the context."""
+
+    node: str
+    handshake_id: int
+
+
+@dataclass(slots=True)
 class Packet:
-    """Top-level envelope: cluster id + exactly one handshake message."""
+    """Top-level envelope: cluster id + exactly one handshake message.
+
+    ``trace`` is the optional wire-level span context (envelope field
+    7, see :class:`TraceContext`); ``None`` — the default and the
+    ``Config.trace_context=False`` state — keeps frames byte-identical
+    to the reference."""
 
     cluster_id: str
     msg: Syn | SynAck | Ack | BadCluster | Leave
+    trace: TraceContext | None = None

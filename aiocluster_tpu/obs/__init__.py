@@ -26,6 +26,15 @@ in every BENCH record. docs/observability.md catalogues the metric names.
 """
 
 from .expo import MetricsHTTPServer, render_prometheus
+from .fleet import (
+    TELEMETRY_KEY,
+    TELEMETRY_PREFIX,
+    FleetEntry,
+    assemble_fleet_view,
+    build_fleet_entry,
+    decode_health_digest,
+    encode_health_digest,
+)
 from .flightrec import FlightRecorder
 from .profiling import SectionTimer, device_trace
 from .prov import PropagationReport, SpreadTree, join_propagation
@@ -42,6 +51,7 @@ from .trace import TRACE_SCHEMA, TraceScan, TraceWriter, read_trace, scan_trace
 
 __all__ = (
     "Counter",
+    "FleetEntry",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -52,11 +62,17 @@ __all__ = (
     "SimMetrics",
     "SpreadTree",
     "SweepMetrics",
+    "TELEMETRY_KEY",
+    "TELEMETRY_PREFIX",
     "TRACE_SCHEMA",
     "TraceScan",
     "TraceWriter",
+    "assemble_fleet_view",
+    "build_fleet_entry",
+    "decode_health_digest",
     "default_registry",
     "device_trace",
+    "encode_health_digest",
     "join_propagation",
     "marked_write_state",
     "percentile_of_sorted",

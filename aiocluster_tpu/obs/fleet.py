@@ -1,0 +1,196 @@
+"""Fleet telemetry plane: gossip-borne node health and any-member views.
+
+The reference's production use-case (quickwit's chitchat) is exactly
+this pattern: nodes gossip their own liveness/health metadata and any
+member answers for the whole fleet. This module makes it a first-class,
+guarded, staleness-annotated surface (docs/observability.md "Fleet
+telemetry"; docs/migration.md difference #17):
+
+- **Self-telemetry keys.** When ``Config.telemetry_interval`` is set,
+  each node periodically folds a compact versioned digest of its own
+  health into its OWN keyspace under :data:`TELEMETRY_KEY` — one plain
+  owner write per interval, riding the existing owner-write invariant,
+  byzantine guards, segments fastpath and MTU budget. One write per
+  interval means at most one content-epoch bump per interval, so the
+  serve tier's SnapshotCache heartbeat dedup and shared payloads stay
+  effective.
+
+- **Fleet views.** ``Cluster.fleet_view()`` (and ``GET /fleet``, and
+  ``python -m aiocluster_tpu fleet``) assembles the replicated
+  telemetry into a per-node table. Each entry carries *staleness*: the
+  lag between the owner's advertised heartbeat (stamped into the digest
+  at publish time) and the local heartbeat watermark for that owner —
+  the concrete per-member epoch vector ROADMAP item 2a asks for,
+  converted to approximate seconds via the owner's advertised gossip
+  interval (an upper bound: inbound handshakes also advance
+  heartbeats).
+
+- **Suspect marking.** A digest advertising a heartbeat ABOVE the
+  local failure detector's known watermark cannot have come from the
+  owner's normal publish cadence (the watermark replicates with or
+  ahead of the key); the entry is marked ``suspect`` rather than
+  trusted. Forged telemetry *for* a victim's keyspace never gets this
+  far — the owner-violation guard rejects and counts it
+  (core/guards.py, tests/test_byzantine.py).
+
+The wire stays byte-identical when telemetry is off: no key is ever
+written, nothing is appended to any frame.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from .registry import percentile_of_sorted
+
+# Reserved key prefix for gossip-borne self-telemetry. Code in
+# runtime/serve/obs must reference this constant instead of repeating
+# the literal (analyzer rule ACT043, docs/static-analysis.md) — the
+# prefix is the contract boundary between application keys and the
+# telemetry plane.
+TELEMETRY_PREFIX = "__fleet:"
+
+# The one self-telemetry key each node owns (schema below).
+TELEMETRY_KEY = TELEMETRY_PREFIX + "health"
+
+# Digest schema version, stamped into every payload as ``v``. Decoders
+# accept any payload whose version they can read; unknown future fields
+# are carried through untouched.
+TELEMETRY_SCHEMA_VERSION = 1
+
+
+def encode_health_digest(fields: dict) -> str:
+    """Compact JSON encoding of one node's health digest. ``fields``
+    uses the short keys documented in docs/observability.md ("Fleet
+    telemetry" key schema); the schema version is stamped here so every
+    publish site agrees."""
+    payload = dict(fields)
+    payload["v"] = TELEMETRY_SCHEMA_VERSION
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"))
+
+
+def decode_health_digest(raw: str | None) -> dict | None:
+    """Tolerant decode of a replicated telemetry value: ``None`` (and
+    never an exception) for a missing, unparsable, or non-object
+    payload — a malformed digest from one node must not take down
+    another node's fleet view."""
+    if not raw:
+        return None
+    try:
+        payload = json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(payload, dict) or "v" not in payload:
+        return None
+    return payload
+
+
+def round_latency_percentiles(durations) -> tuple[float, float] | None:
+    """(p50, p99) over recent gossip-round wall durations (seconds),
+    nearest-rank — the repo's shared percentile convention. None when
+    there are no samples yet."""
+    samples = sorted(float(d) for d in durations)
+    if not samples:
+        return None
+    return (
+        percentile_of_sorted(samples, 0.50),
+        percentile_of_sorted(samples, 0.99),
+    )
+
+
+@dataclass(slots=True)
+class FleetEntry:
+    """One node's row in a fleet view."""
+
+    node: str
+    live: bool
+    heartbeat_local: int  # this member's replicated watermark for the owner
+    digest: dict | None = None  # decoded telemetry payload (None = no key yet)
+    heartbeat_advertised: int | None = None  # ``hb`` stamped at publish time
+    staleness_beats: int | None = None  # local watermark - advertised
+    staleness_s: float | None = None  # beats x advertised interval (approx)
+    suspect: bool = False  # advertised heartbeat ABOVE the local watermark
+
+    def as_dict(self) -> dict:
+        return {
+            "node": self.node,
+            "live": self.live,
+            "heartbeat_local": self.heartbeat_local,
+            "heartbeat_advertised": self.heartbeat_advertised,
+            "staleness_beats": self.staleness_beats,
+            "staleness_s": self.staleness_s,
+            "suspect": self.suspect,
+            "digest": self.digest,
+        }
+
+
+def build_fleet_entry(
+    name: str, *, live: bool, heartbeat: int, raw: str | None
+) -> FleetEntry:
+    """One node's entry from its locally-replicated state: decode the
+    telemetry value and annotate staleness/suspicion against the local
+    heartbeat watermark (module docstring has the semantics)."""
+    entry = FleetEntry(node=name, live=live, heartbeat_local=int(heartbeat))
+    digest = decode_health_digest(raw)
+    if digest is None:
+        return entry
+    entry.digest = digest
+    adv = digest.get("hb")
+    if not isinstance(adv, int):
+        return entry
+    entry.heartbeat_advertised = adv
+    if adv > entry.heartbeat_local:
+        # The digest claims a heartbeat the local FD has never credited:
+        # it cannot be the owner's honest publish (the watermark
+        # replicates with or ahead of the key). Flag, don't trust.
+        entry.suspect = True
+        return entry
+    entry.staleness_beats = entry.heartbeat_local - adv
+    interval = digest.get("int")
+    if isinstance(interval, (int, float)) and interval > 0:
+        entry.staleness_s = round(entry.staleness_beats * float(interval), 6)
+    return entry
+
+
+def assemble_fleet_view(
+    entries: list[FleetEntry],
+    *,
+    self_name: str,
+    epoch: int,
+    stale_s: float | None = None,
+) -> dict:
+    """The fleet table ``Cluster.fleet_view()`` / ``GET /fleet`` serve:
+    per-node entries plus coverage and staleness aggregates. With
+    ``stale_s`` set, entries whose staleness exceeds it — or is unknown
+    (no telemetry, suspect, or no advertised interval) — are filtered
+    out, except the assembling member itself (its own entry is local by
+    definition)."""
+    covered = sum(1 for e in entries if e.heartbeat_advertised is not None)
+    suspect = sum(1 for e in entries if e.suspect)
+    stale_values = sorted(
+        e.staleness_s for e in entries if e.staleness_s is not None
+    )
+    shown = entries
+    if stale_s is not None:
+        shown = [
+            e
+            for e in entries
+            if e.node == self_name
+            or (e.staleness_s is not None and e.staleness_s <= stale_s)
+        ]
+    view = {
+        "self": self_name,
+        "epoch": epoch,
+        "known": len(entries),
+        "covered": covered,
+        "coverage_frac": round(covered / len(entries), 4) if entries else 0.0,
+        "suspect": suspect,
+        "stale_s": stale_s,
+        "nodes": {e.node: e.as_dict() for e in shown},
+    }
+    if stale_values:
+        view["staleness_p50_s"] = percentile_of_sorted(stale_values, 0.50)
+        view["staleness_p99_s"] = percentile_of_sorted(stale_values, 0.99)
+        view["staleness_max_s"] = stale_values[-1]
+    return view

@@ -119,6 +119,27 @@ class SpreadTree:
         ``from_peer`` and no matching send — e.g. a torn trace)."""
         return sum(1 for v in self.applies() if v.hop is None)
 
+    def join_kinds(self) -> dict[str, int]:
+        """Apply count per join kind — ``direct`` (the receiver named
+        its peer: it dialed, a Leave named its sender, or the wire's
+        trace context carried it), ``send`` (the legacy
+        closest-preceding-send heuristic), ``unjoined``."""
+        counts: dict[str, int] = {}
+        for v in self.applies():
+            counts[v.join] = counts.get(v.join, 0) + 1
+        return dict(sorted(counts.items()))
+
+    def exact_join_fraction(self) -> float | None:
+        """Fraction of this tree's applies joined EXACTLY (kind
+        ``direct``) rather than by heuristic or not at all — 1.0 is the
+        fleet_bench gate with ``Config.trace_context`` on. None when
+        there are no applies to judge."""
+        applies = self.applies()
+        if not applies:
+            return None
+        exact = sum(1 for v in applies if v.join == "direct")
+        return exact / len(applies)
+
     def summary(self, fleet_size: int | None = None) -> dict:
         out = {
             "owner": self.owner,
@@ -129,7 +150,11 @@ class SpreadTree:
             "hop_histogram": {
                 str(k): v for k, v in self.hop_histogram().items()
             },
+            "join_kinds": self.join_kinds(),
         }
+        exact = self.exact_join_fraction()
+        if exact is not None:
+            out["exact_join_frac"] = round(exact, 4)
         lats = self.latencies()
         if lats:
             out["visibility_p50_s"] = round(

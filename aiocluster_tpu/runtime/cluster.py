@@ -33,14 +33,23 @@ from ..core.messages import (
     Packet,
     Syn,
     SynAck,
+    TraceContext,
 )
 from ..core.values import VersionedValue
+from ..obs.fleet import (
+    TELEMETRY_KEY,
+    assemble_fleet_view,
+    build_fleet_entry,
+    encode_health_digest,
+    round_latency_percentiles,
+)
 from ..obs.flightrec import FlightRecorder
 from ..obs.registry import MetricsRegistry, default_registry
 from ..obs.trace import TraceWriter
 from ..utils.clock import utc_now
 from ..utils.logging import node_logger
 from ..wire import native as wire_native
+from ..wire.proto import encode_trace_context
 from .engine import GossipEngine
 from .hooks import HookDispatcher, HookStats
 from .peers import select_gossip_targets
@@ -393,6 +402,54 @@ class Cluster:
         # and the per-handshake peer-name resolution below are all
         # gated on this).
         self._prov: TraceWriter | None = None
+
+        # Wire-level span context (docs/observability.md "Fleet
+        # telemetry"): with ``Config.trace_context`` on, every
+        # Syn/SynAck/Ack carries envelope field 7 (sender name +
+        # initiator-chosen handshake id) appended AFTER the cached
+        # parts — the per-digest-epoch Syn caches and shared payloads
+        # never see the per-handshake bytes. Off (the default): nothing
+        # is appended, frames byte-identical to the reference.
+        self._trace_context = config.trace_context
+        self._next_handshake_id = 0
+
+        # Gossip-borne self-telemetry (obs/fleet.py): with
+        # ``Config.telemetry_interval`` set, `_gossip_round` folds a
+        # compact health digest into this node's own keyspace every
+        # ``_telemetry_every_rounds`` rounds — ONE owner write per
+        # interval, so the content epoch bumps at most once per
+        # interval and SnapshotCache dedup stays effective. None (the
+        # default) publishes nothing and tracks nothing.
+        self._telemetry_interval = config.telemetry_interval
+        self._telemetry_every_rounds = 1
+        self._round_durations = None
+        if self._telemetry_interval is not None:
+            from collections import deque
+
+            self._telemetry_every_rounds = max(
+                1,
+                round(
+                    self._telemetry_interval
+                    / max(self.effective_gossip_interval, 1e-9)
+                ),
+            )
+            self._round_durations = deque(maxlen=128)
+        # First telemetry-eligible round publishes immediately (the
+        # fleet should not wait a full interval to see a booted node).
+        self._rounds_since_telemetry = self._telemetry_every_rounds
+        self._fleet_publishes = self._metrics.counter(
+            "aiocluster_fleet_telemetry_publishes_total",
+            "Self-telemetry digests folded into this node's own keyspace",
+        )
+        self._fleet_view_nodes = self._metrics.gauge(
+            "aiocluster_fleet_view_nodes",
+            "Known nodes in the most recently assembled fleet view",
+        )
+        self._fleet_suspects = self._metrics.counter(
+            "aiocluster_fleet_view_suspect_total",
+            "Fleet-view entries whose advertised heartbeat exceeded the "
+            "locally known watermark (marked suspect, not trusted)",
+        )
 
         # Seed our own state: the recovered keyspace (when a store was
         # restored), one heartbeat, then initial keys (idempotent — a
@@ -814,6 +871,82 @@ class Cluster:
             summary["breaker_open_peers"] = []
         return summary
 
+    def _persist_posture(self) -> str:
+        """Durability/rejoin state for the telemetry digest: ``none``
+        (no store), ``fresh`` (store, first boot), ``rejoin_clean`` or
+        ``rejoin_unclean`` (docs/robustness.md)."""
+        if self._persist is None:
+            return "none"
+        if self._recovered is None:
+            return "fresh"
+        return "rejoin_clean" if self._recovered.clean else "rejoin_unclean"
+
+    def _publish_telemetry(self) -> None:
+        """Fold a compact digest of this node's health into its OWN
+        keyspace under ``TELEMETRY_KEY`` (obs/fleet.py;
+        docs/observability.md "Fleet telemetry" has the key schema).
+        One plain owner write per telemetry interval: it replicates
+        under the existing owner-write invariant, byzantine guards,
+        segments fastpath and MTU budget, and bumps the content epoch
+        at most once per interval."""
+        summary = self.health_summary()
+        fields = {
+            # Short keys (docs/observability.md): the digest rides
+            # every delta to every peer, so it pays MTU per byte.
+            "hb": self.self_node_state().heartbeat,
+            "live": summary["live"],
+            "dead": summary["dead"],
+            "ep": summary["epoch"],
+            "int": round(self.effective_gossip_interval, 6),
+            "kv": self._engine.kv_applied_total,
+            "brk": summary["breaker_open_peers"],
+            "st": self._persist_posture(),
+        }
+        if summary.get("max_phi") is not None:
+            fields["phi"] = summary["max_phi"]
+        lat = round_latency_percentiles(self._round_durations or ())
+        if lat is not None:
+            fields["p50"] = round(lat[0], 6)
+            fields["p99"] = round(lat[1], 6)
+        self.set(TELEMETRY_KEY, encode_health_digest(fields))
+        self._fleet_publishes.inc()
+
+    def fleet_view(self, *, stale_s: float | None = None) -> dict:
+        """Any-member fleet table assembled from the replicated
+        self-telemetry (obs/fleet.py): one entry per known node with
+        its decoded health digest and per-entry STALENESS — the lag
+        between the digest's advertised heartbeat and this member's
+        local watermark for that owner, the per-member epoch vector
+        ROADMAP item 2a asks for. Entries advertising a heartbeat the
+        local failure detector never credited are marked ``suspect``
+        rather than trusted. ``stale_s`` filters to entries fresher
+        than that many seconds. Works with telemetry publishing off
+        (entries simply have no digest) — assembly reads only local
+        replicated state and never blocks."""
+        live = set(self._failure_detector.live_nodes())
+        live.add(self.self_node_id)
+        entries = []
+        for node_id, ns in self.node_states_view().items():
+            vv = ns.get(TELEMETRY_KEY)
+            entries.append(
+                build_fleet_entry(
+                    node_id.name,
+                    live=node_id in live,
+                    heartbeat=ns.heartbeat,
+                    raw=vv.value if vv is not None else None,
+                )
+            )
+        view = assemble_fleet_view(
+            entries,
+            self_name=self.self_node_id.name,
+            epoch=self.state_epoch(),
+            stale_s=stale_s,
+        )
+        self._fleet_view_nodes.set(view["known"])
+        if view["suspect"]:
+            self._fleet_suspects.inc(view["suspect"])
+        return view
+
     def metrics_registry(self) -> MetricsRegistry:
         """The registry this cluster reports through (the process default
         unless one was injected) — hand it to ``obs.render_prometheus`` or
@@ -1057,6 +1190,14 @@ class Cluster:
             self._peer_selection.labels("seed").inc()
 
         self.self_node_state().inc_heartbeat()
+        if self._round_durations is not None:
+            # Self-telemetry publish (obs/fleet.py): due this round, and
+            # BEFORE the handshakes so the fresh digest rides this
+            # round's deltas. One owner write per telemetry interval.
+            self._rounds_since_telemetry += 1
+            if self._rounds_since_telemetry >= self._telemetry_every_rounds:
+                self._rounds_since_telemetry = 0
+                self._publish_telemetry()
         self._cluster_state.gc_marked_for_deletion(
             timedelta(seconds=self._config.marked_for_deletion_grace_period)
         )
@@ -1086,6 +1227,10 @@ class Cluster:
         self._update_liveness()
         duration = time.perf_counter() - round_start
         self._round_seconds.observe(duration)
+        if self._round_durations is not None:
+            # Telemetry's round-latency window (p50/p99 ride the next
+            # published digest).
+            self._round_durations.append(duration)
         if self._trace is not None:
             self._trace.emit(
                 "gossip_round",
@@ -1152,6 +1297,21 @@ class Cluster:
         prov_peer = (
             self._peer_label(host, port) if self._prov is not None else None
         )
+        # Wire-level span context: one handshake id per initiated
+        # exchange; the encoded field is APPENDED after the cached
+        # Syn/Ack parts (proto3 field order is insignificant on decode)
+        # so the per-digest-epoch caches stay per-handshake-free. Off:
+        # tc_field is None and every frame below is byte-identical.
+        tc_field = None
+        hsid: int | None = None
+        tc_note: dict = {}
+        if self._trace_context:
+            self._next_handshake_id += 1
+            hsid = self._next_handshake_id
+            tc_field = encode_trace_context(
+                TraceContext(self._config.node_id.name, hsid)
+            )
+            tc_note = {"hsid": hsid}
         flightrec = self._flightrec
         if health is not None:
             # An open breaker whose backoff just expired: this
@@ -1172,6 +1332,13 @@ class Cluster:
                         if syn_parts is not None
                         else self._engine.make_syn_bytes()
                     )
+                    if tc_field is not None:
+                        # Copy, never mutate: the parts list is owned by
+                        # the engine's per-epoch cache.
+                        if syn_parts is not None:
+                            syn_parts = [*syn_parts, tc_field]
+                        else:
+                            syn_bytes = syn_bytes + tc_field
                     # The retry (attempt 1) must actually redial: another
                     # idle sibling of the connection that just died would
                     # burn the retry on the same peer restart.
@@ -1206,7 +1373,7 @@ class Cluster:
                         )
                         flightrec.note(
                             "handshake", peer=f"{host}:{port}", label=label,
-                            outcome="bad_cluster",
+                            outcome="bad_cluster", **tc_note,
                         )
                         if health is not None:
                             # A policy rejection over a healthy link
@@ -1216,15 +1383,23 @@ class Cluster:
                     elif isinstance(reply.msg, SynAck):
                         if self._wire_fastpath:
                             ack_parts = self._engine.handle_synack_parts(
-                                reply, peer=prov_peer
+                                reply, peer=prov_peer, hsid=hsid
                             )
+                            if tc_field is not None:
+                                # Copy — the empty-ack parts list is a
+                                # cached constant.
+                                ack_parts = [*ack_parts, tc_field]
                             await self._transport.write_framed_parts(
                                 conn.writer, ack_parts, "ack", timeout=budget
                             )
                         else:
                             ack = self._engine.handle_synack(
-                                reply, peer=prov_peer
+                                reply, peer=prov_peer, hsid=hsid
                             )
+                            if hsid is not None:
+                                ack.trace = TraceContext(
+                                    self._config.node_id.name, hsid
+                                )
                             await self._transport.write_packet(
                                 conn.writer, ack, timeout=budget
                             )
@@ -1236,7 +1411,7 @@ class Cluster:
                         # via the finally's discard.
                         flightrec.note(
                             "handshake", peer=f"{host}:{port}", label=label,
-                            outcome="ok", reused=reused,
+                            outcome="ok", reused=reused, **tc_note,
                         )
                         if health is not None:
                             health.record_success(addr)
@@ -1246,7 +1421,7 @@ class Cluster:
                         )
                         flightrec.note(
                             "handshake", peer=f"{host}:{port}", label=label,
-                            outcome="unexpected_reply",
+                            outcome="unexpected_reply", **tc_note,
                         )
                         if health is not None:
                             # The peer answered promptly over a healthy
@@ -1267,6 +1442,7 @@ class Cluster:
                     flightrec.note(
                         "handshake", peer=f"{host}:{port}", label=label,
                         outcome="peer_closed", error=type(exc).__name__,
+                        **tc_note,
                     )
                     self._log.debug(
                         f"Gossip with {label} {host}:{port} failed: {exc}"
@@ -1279,6 +1455,7 @@ class Cluster:
                     flightrec.note(
                         "handshake", peer=f"{host}:{port}", label=label,
                         outcome="failed", error=type(exc).__name__,
+                        **tc_note,
                     )
                     self._log.debug(
                         f"Gossip with {label} {host}:{port} failed: {exc}"
@@ -1288,6 +1465,7 @@ class Cluster:
                     flightrec.note(
                         "handshake", peer=f"{host}:{port}", label=label,
                         outcome="error", error=type(exc).__name__,
+                        **tc_note,
                     )
                     self._log.exception(
                         f"Gossip with {label} {host}:{port} errored: {exc}"
@@ -1354,16 +1532,38 @@ class Cluster:
                 if not self._verify_peer_tls_name(packet, writer):
                     self._log.warning("TLS peer identity verification failed")
                     return
+                # Echoed span context: with trace_context on AND the
+                # initiator's Syn carrying one (a peer that speaks the
+                # field), the SynAck names us + echoes the initiator's
+                # handshake id. A context-less peer gets reference
+                # frames back, byte-identical.
+                reply_tc = None
+                if self._trace_context and packet.trace is not None:
+                    reply_tc = encode_trace_context(
+                        TraceContext(
+                            self._config.node_id.name,
+                            packet.trace.handshake_id,
+                        )
+                    )
                 if self._wire_fastpath:
                     resp = self._engine.handle_syn_parts(packet)
                     if isinstance(resp, Packet):  # BadCluster
                         await self._transport.write_packet(writer, resp)
                         return
+                    if reply_tc is not None:
+                        resp = [*resp, reply_tc]
                     await self._transport.write_framed_parts(
                         writer, resp, "synack"
                     )
                 else:
                     reply = self._engine.handle_syn(packet)
+                    if reply_tc is not None and not isinstance(
+                        reply.msg, BadCluster
+                    ):
+                        reply.trace = TraceContext(
+                            self._config.node_id.name,
+                            packet.trace.handshake_id,
+                        )
                     await self._transport.write_packet(writer, reply)
                     if isinstance(reply.msg, BadCluster):
                         return
@@ -1371,7 +1571,16 @@ class Cluster:
                 if not isinstance(ack.msg, Ack):
                     self._log.debug("Unexpected gossip ack message type")
                     return
-                self._engine.handle_ack(ack)
+                # The Ack's span context names its sender exactly — the
+                # blind spot the send-join heuristic existed for
+                # (obs/prov.py). A context-less Ack keeps the legacy
+                # null-from_peer path.
+                atc = ack.trace
+                self._engine.handle_ack(
+                    ack,
+                    from_peer=(atc.node or None) if atc is not None else None,
+                    hsid=atc.handshake_id if atc is not None else None,
+                )
                 handshakes += 1
                 if not self._config.persistent_connections:
                     return  # reference lifecycle: one handshake per conn

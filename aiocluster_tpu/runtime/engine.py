@@ -332,17 +332,22 @@ class GossipEngine:
         return synack_packet_parts(self._cid_field, dparts, dtotal, enc)
 
     def handle_synack_parts(
-        self, packet: Packet, peer: str | None = None
+        self, packet: Packet, peer: str | None = None,
+        hsid: int | None = None,
     ) -> list[bytes]:
         """Initiator step 2, zero-copy: apply the responder's delta
         (guarded — the object was decoded from memoryview spans by the
         transport), reply with an Ack assembled from cached segments.
         An empty-delta-both-ways handshake resolves to one cached
-        constant buffer list — no delta object, no encode, nothing."""
+        constant buffer list — no delta object, no encode, nothing.
+        ``hsid`` is the handshake id when trace context is on — it
+        rides the apply's provenance/flight-recorder records."""
         assert isinstance(packet.msg, SynAck)
         excluded = self._excluded()
         self._observe_digest(packet.msg.digest)
-        applied = self._apply_guarded(packet.msg.delta, from_peer=peer)
+        applied = self._apply_guarded(
+            packet.msg.delta, from_peer=peer, hsid=hsid
+        )
         collect = self._prov is not None
         enc = self._state.compute_partial_delta_encoded(
             packet.msg.digest,
@@ -409,27 +414,44 @@ class GossipEngine:
         trace (obs/prov.py; wired by ``Cluster.trace_provenance``)."""
         self._prov = trace
 
-    def _emit_prov_applies(self, delta: Delta, from_peer: str | None) -> None:
+    def _emit_prov_applies(
+        self, delta: Delta, from_peer: str | None, hsid: int | None = None
+    ) -> None:
         """One ``prov_apply`` per applied key-version: receiver-side
         provenance (obs/prov.py). ``from_peer`` is the peer the delta
         came from when this receiver knows it (it initiated the
-        handshake, or a Leave named its sender); None on responder-side
-        applies — the collector joins those to the initiator's
-        ``prov_send`` records instead (no wire change)."""
+        handshake, a Leave named its sender, or — with
+        ``Config.trace_context`` on — the wire's span context named the
+        Ack's sender); None only on legacy responder-side applies,
+        which the collector joins to the initiator's ``prov_send``
+        records. ``hsid`` (the wire handshake id) rides the record when
+        known, correlating it with both nodes' flight recorders."""
         t_mono = round(time.monotonic(), 6)
         node = self._config.node_id.name
         for nd in delta.node_deltas:
             owner = nd.node_id.name
             for kv in nd.key_values:
-                self._prov.emit(
-                    "prov_apply",
-                    node=node,
-                    owner=owner,
-                    key=kv.key,
-                    version=kv.version,
-                    from_peer=from_peer,
-                    t_mono=t_mono,
-                )
+                if hsid is not None:
+                    self._prov.emit(
+                        "prov_apply",
+                        node=node,
+                        owner=owner,
+                        key=kv.key,
+                        version=kv.version,
+                        from_peer=from_peer,
+                        hsid=hsid,
+                        t_mono=t_mono,
+                    )
+                else:
+                    self._prov.emit(
+                        "prov_apply",
+                        node=node,
+                        owner=owner,
+                        key=kv.key,
+                        version=kv.version,
+                        from_peer=from_peer,
+                        t_mono=t_mono,
+                    )
 
     def _emit_prov_sends(self, delta: Delta, to_peer: str | None) -> None:
         """One ``prov_send`` per key-version packed into an Ack delta:
@@ -454,44 +476,72 @@ class GossipEngine:
                     t_mono=t_mono,
                 )
 
-    def _apply_guarded(self, delta: Delta, from_peer: str | None = None) -> Delta:
+    def _apply_guarded(
+        self,
+        delta: Delta,
+        from_peer: str | None = None,
+        hsid: int | None = None,
+    ) -> Delta:
         """The apply-delta path: inbound deltas pass the byzantine
         defense guards (core/guards.py — owner-write, floor, over-stamp
         and max_version-support checks) before touching state. Honest
         deltas apply unchanged (the guards return the original object);
         every rejection is counted by kind. Returns what was actually
-        applied."""
+        applied. ``hsid`` — the wire-carried handshake id, when trace
+        context named one — rides the flight-recorder and provenance
+        records for cross-node correlation."""
         clean, rejected = sanitize_delta(delta, self._config.node_id)
         if rejected:
             if self._byz_rejected is not None:
                 for kind, count in rejected.items():
                     self._byz_rejected.labels(kind).inc(count)
             if self._flightrec is not None:
-                self._flightrec.note(
-                    "guard_reject", peer=from_peer, kinds=dict(rejected)
-                )
+                if hsid is not None:
+                    self._flightrec.note(
+                        "guard_reject", peer=from_peer,
+                        kinds=dict(rejected), hsid=hsid,
+                    )
+                else:
+                    self._flightrec.note(
+                        "guard_reject", peer=from_peer, kinds=dict(rejected)
+                    )
         self._state.apply_delta(clean, on_key_change=self._on_key_change)
         if clean.node_deltas:
             if self._flightrec is not None:
-                self._flightrec.note(
-                    "apply",
-                    peer=from_peer,
-                    kvs=_delta_kv_count(clean),
-                    nodes=len(clean.node_deltas),
-                )
+                if hsid is not None:
+                    self._flightrec.note(
+                        "apply",
+                        peer=from_peer,
+                        kvs=_delta_kv_count(clean),
+                        nodes=len(clean.node_deltas),
+                        hsid=hsid,
+                    )
+                else:
+                    self._flightrec.note(
+                        "apply",
+                        peer=from_peer,
+                        kvs=_delta_kv_count(clean),
+                        nodes=len(clean.node_deltas),
+                    )
             if self._prov is not None:
-                self._emit_prov_applies(clean, from_peer)
+                self._emit_prov_applies(clean, from_peer, hsid)
         return clean
 
-    def handle_synack(self, packet: Packet, peer: str | None = None) -> Packet:
+    def handle_synack(
+        self, packet: Packet, peer: str | None = None,
+        hsid: int | None = None,
+    ) -> Packet:
         """Initiator step 2: apply the responder's delta (guarded),
         reply with the delta the responder is missing. ``peer`` names
         the responder for provenance (the initiator dialed it — the
-        cluster resolves the name only while a prov trace is attached)."""
+        cluster resolves the name only while a prov trace is attached);
+        ``hsid`` is the handshake id when trace context is on."""
         assert isinstance(packet.msg, SynAck)
         excluded = self._excluded()
         self._observe_digest(packet.msg.digest)
-        applied = self._apply_guarded(packet.msg.delta, from_peer=peer)
+        applied = self._apply_guarded(
+            packet.msg.delta, from_peer=peer, hsid=hsid
+        )
         delta = self._state.compute_partial_delta_respecting_mtu(
             packet.msg.digest, self._config.max_payload_size, excluded
         )
@@ -500,14 +550,24 @@ class GossipEngine:
         self._note("handle_synack", sent=delta, applied=applied)
         return Packet(self._config.cluster_id, Ack(delta))
 
-    def handle_ack(self, packet: Packet) -> None:
+    def handle_ack(
+        self,
+        packet: Packet,
+        from_peer: str | None = None,
+        hsid: int | None = None,
+    ) -> None:
         """Responder final step: apply the initiator's delta (guarded).
-        The responder cannot name its caller (a Syn carries no sender
-        identity and the wire stays reference-compatible), so these
-        applies record ``from_peer=null`` — the provenance collector
-        joins them to the initiator's ``prov_send`` records."""
+        With ``Config.trace_context`` on the Ack's wire span context
+        names its sender, so the cluster passes ``from_peer``/``hsid``
+        and these applies join EXACTLY. Without it the responder cannot
+        name its caller (a bare Syn carries no sender identity), the
+        applies record ``from_peer=null``, and the provenance collector
+        joins them to the initiator's ``prov_send`` records — the
+        legacy heuristic path."""
         assert isinstance(packet.msg, Ack)
-        applied = self._apply_guarded(packet.msg.delta)
+        applied = self._apply_guarded(
+            packet.msg.delta, from_peer=from_peer, hsid=hsid
+        )
         self._note("handle_ack", applied=applied)
 
     def handle_leave(self, packet: Packet) -> Delta:

@@ -24,6 +24,13 @@ Endpoints (full contract in docs/serving.md):
                               always-on bounded ring of recent events,
                               obs/flightrec.py) — operator endpoint,
                               never shed, like /healthz
+  GET  /fleet                 any-member fleet view from gossip-borne
+                              telemetry (obs/fleet.py): per-node health
+                              digests with staleness annotations;
+                              ``ETag: "<epoch>"`` + If-None-Match 304,
+                              cached per digest-epoch; ``?stale_s=``
+                              filters to fresh entries (uncached path);
+                              operator endpoint, never shed
 
 The hot path does zero redundant work per client: every 200 ``/state``
 and every watch wake serves the SnapshotCache's per-epoch ``bytes``;
@@ -86,8 +93,8 @@ class OverloadPolicy:
     - ``shed_lag_s`` sheds on measured event-loop lag — the signal
       that the process (gossip rounds included) is past saturation;
       applies to every endpoint including ``/watch``.
-    - ``/healthz``, ``/metrics`` and ``/debug/flightrec`` are never
-      shed: the operator's view must survive the storm it is
+    - ``/healthz``, ``/metrics``, ``/debug/flightrec`` and ``/fleet``
+      are never shed: the operator's view must survive the storm it is
       diagnosing.
 
     ``enabled=False`` restores the accept-everything behavior (the
@@ -171,6 +178,9 @@ class ServeApp:
         self._lag = 0.0
         self._inflight = 0
         self._shed_total = 0
+        # /fleet payload cached per digest-epoch (same dedup signal the
+        # snapshot cache keys on): (epoch, encoded bytes).
+        self._fleet_cache: tuple[int, bytes] | None = None
         self._lag_task: asyncio.Task | None = None
         self._server: asyncio.Server | None = None
         self._conns: set[asyncio.StreamWriter] = set()
@@ -434,6 +444,8 @@ class ServeApp:
                 + b"\n"
             )
             return ("flightrec", "200 OK", (body, _JSON, ()))
+        if path == "/fleet" and method == "GET":
+            return ("fleet",) + self._handle_fleet(request)
         parts = [p for p in path.split("/") if p]
         if len(parts) == 2 and parts[0] == "kv":
             return ("kv",) + self._handle_kv(request, unquote(parts[1]))
@@ -451,7 +463,7 @@ class ServeApp:
         bound spares /watch (parked long-polls are not executing)."""
         pol = self.overload
         if not pol.enabled or path in (
-            "/healthz", "/metrics", "/debug/flightrec",
+            "/healthz", "/metrics", "/debug/flightrec", "/fleet",
         ):
             return None
         if self._lag > pol.shed_lag_s:
@@ -492,6 +504,49 @@ class ServeApp:
         )
         http_status = "503 Service Unavailable" if closed else "200 OK"
         return ("healthz", http_status, (body, _JSON, ()))
+
+    def _handle_fleet(
+        self, request: _Request
+    ) -> tuple[str, tuple[bytes, str, tuple[tuple[str, str], ...]]]:
+        """Any-member fleet view (obs/fleet.py). The unfiltered payload
+        is cached per digest-epoch — a watcher fleet polling /fleet
+        costs one assemble+encode per epoch, not per request — with the
+        same ETag/If-None-Match contract as /state. ``?stale_s=``
+        re-assembles at request time (the filter depends on the client's
+        threshold, not just the epoch)."""
+        stale_raw = request.q1("stale_s")
+        if stale_raw is not None:
+            try:
+                stale_s = float(stale_raw)
+            except ValueError:
+                return "400 Bad Request", (b"bad stale_s", _TEXT, ())
+            if not math.isfinite(stale_s) or stale_s < 0:
+                return "400 Bad Request", (b"bad stale_s", _TEXT, ())
+            body = (
+                json.dumps(
+                    self._cluster.fleet_view(stale_s=stale_s), sort_keys=True
+                ).encode()
+                + b"\n"
+            )
+            return "200 OK", (body, _JSON, ())
+        epoch = self._cluster.state_epoch()
+        client_epoch = parse_etag(request.headers.get("if-none-match"))
+        if client_epoch is not None and client_epoch == epoch:
+            return "304 Not Modified", (
+                b"",
+                _JSON,
+                (("ETag", f'"{epoch}"'),),
+            )
+        cached = self._fleet_cache
+        if cached is not None and cached[0] == epoch:
+            body = cached[1]
+        else:
+            body = (
+                json.dumps(self._cluster.fleet_view(), sort_keys=True).encode()
+                + b"\n"
+            )
+            self._fleet_cache = (epoch, body)
+        return "200 OK", (body, _JSON, (("ETag", f'"{epoch}"'),))
 
     def _handle_state(
         self, request: _Request

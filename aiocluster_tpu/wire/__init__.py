@@ -13,6 +13,7 @@ from .proto import (
     encode_delta,
     encode_digest,
     encode_packet,
+    encode_trace_context,
 )
 from .segments import (
     EMPTY_ENCODED_DELTA,
@@ -36,4 +37,5 @@ __all__ = (
     "encode_delta",
     "encode_digest",
     "encode_packet",
+    "encode_trace_context",
 )

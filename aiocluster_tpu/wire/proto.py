@@ -31,6 +31,7 @@ from ..core.messages import (
     Packet,
     Syn,
     SynAck,
+    TraceContext,
 )
 from ..core.values import VersionStatusEnum
 
@@ -42,6 +43,7 @@ __all__ = (
     "decode_digest",
     "encode_delta",
     "decode_delta",
+    "encode_trace_context",
     "varint_size",
 )
 
@@ -661,7 +663,40 @@ def encode_packet(packet: Packet) -> bytes:
         _field_msg(out, 6, bytes(body))
     else:  # pragma: no cover - exhaustiveness guard
         raise WireError(f"unknown packet message type: {type(msg)!r}")
+    if packet.trace is not None:
+        # New beyond the reference schema (field 7, skipped by its
+        # decoders): span context — sender name + handshake id.
+        out += encode_trace_context(packet.trace)
     return bytes(out)
+
+
+def encode_trace_context(trace: TraceContext) -> bytes:
+    """The complete envelope field 7 (tag + length + body) for a span
+    context — standalone so the zero-copy parts path can APPEND it as a
+    trailing buffer after the cached Syn/SynAck/Ack parts (proto3 field
+    order is insignificant on decode; the per-digest-epoch caches never
+    see the per-handshake bytes)."""
+    body = bytearray()
+    _field_str(body, 1, trace.node)
+    _field_varint(body, 2, trace.handshake_id)
+    out = bytearray()
+    _field_msg(out, 7, bytes(body))
+    return bytes(out)
+
+
+def _decode_trace_context(body: bytes) -> TraceContext:
+    r = _Reader(body)
+    node = ""
+    handshake_id = 0
+    while not r.at_end():
+        field, wt = r.field()
+        if field == 1 and wt == _LEN:
+            node = _utf8(r.chunk())
+        elif field == 2 and wt == _VARINT:
+            handshake_id = r.varint()
+        else:
+            r.skip(wt)
+    return TraceContext(node, handshake_id)
 
 
 def _decode_syn(body: bytes) -> Syn:
@@ -728,6 +763,7 @@ def decode_packet(data: bytes | memoryview) -> Packet:
     r = _Reader(data)
     cluster_id = ""
     msg: Syn | SynAck | Ack | BadCluster | Leave | None = None
+    trace: TraceContext | None = None
     while not r.at_end():
         field, wt = r.field()
         if field == 1 and wt == _LEN:
@@ -743,8 +779,10 @@ def decode_packet(data: bytes | memoryview) -> Packet:
             msg = BadCluster()
         elif field == 6 and wt == _LEN:
             msg = _decode_leave(r.chunk())
+        elif field == 7 and wt == _LEN:
+            trace = _decode_trace_context(r.chunk())
         else:
             r.skip(wt)
     if msg is None:
         raise WireError("packet carries no handshake message")
-    return Packet(cluster_id, msg)
+    return Packet(cluster_id, msg, trace)

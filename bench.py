@@ -752,6 +752,20 @@ def propagation_provenance_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def fleet_telemetry_bench(log, smoke: bool) -> dict | None:
+    """The fleet-telemetry datum (benchmarks/fleet_bench.py,
+    docs/observability.md "Fleet telemetry"): gossip-borne health
+    digests + any-member fleet views measured through a split-brain
+    heal on a real loopback fleet — view coverage, bounded per-entry
+    staleness, monotone advertised watermarks — plus the exact
+    provenance-join fraction with wire trace context on (100% direct
+    joins, zero send-heuristic) and the sim's telemetry-wavefront
+    prediction."""
+    return _run_benchmarks_helper(
+        "fleet_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 def twin_closed_loop_bench(log, smoke: bool) -> dict | None:
     """The digital-twin datum (benchmarks/twin_bench.py, docs/twin.md):
     a real loopback fleet recorded with twin-grade round tracing,
@@ -777,6 +791,9 @@ STDOUT_LINE_CAP = 2000
 # least-essential provenance first; the headline fields
 # (metric/value/unit/vs_baseline) and platform are never dropped.
 _SACRIFICE_ORDER = (
+    "prov_exact_join_frac",
+    "fleet_staleness_p99_s",
+    "fleet_view_coverage_frac",
     "wire_bytes_copied_per_handshake",
     "wire_segment_hit_rate",
     "wire_fast_vs_control",
@@ -946,6 +963,18 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         ),
         "sim_wavefront_rounds": (ex.get("propagation_bench") or {}).get(
             "sim_wavefront_rounds"
+        ),
+        # Fleet telemetry (fleet_bench.py): any-member view coverage,
+        # bounded per-entry staleness, and the exact provenance-join
+        # fraction with wire trace context on.
+        "fleet_view_coverage_frac": (ex.get("fleet_bench") or {}).get(
+            "fleet_view_coverage_frac"
+        ),
+        "fleet_staleness_p99_s": (ex.get("fleet_bench") or {}).get(
+            "fleet_staleness_p99_s"
+        ),
+        "prov_exact_join_frac": (ex.get("fleet_bench") or {}).get(
+            "prov_exact_join_frac"
         ),
         # Digital twin (twin_bench): the calibrated (held-out-validated)
         # wall-clock rate and the SLO autotuner's recommended fanout.
@@ -1599,6 +1628,10 @@ def main() -> None:
         # + hops) vs the sim's wavefront prediction, plus the staleness
         # oracle parity cells (propagation_bench.py).
         prov_rec = propagation_provenance_bench(log, args.smoke)
+        # Fleet telemetry plane: any-member views + exact wire-level
+        # provenance joins through a split-brain heal (fleet_bench.py,
+        # docs/observability.md "Fleet telemetry").
+        fleet_rec = fleet_telemetry_bench(log, args.smoke)
         # A CPU-fallback record is still a valid run, but its headline is
         # not the chip's — point the reader at the preserved on-chip
         # measurement so a down tunnel can't erase the evidence again
@@ -1688,6 +1721,10 @@ def main() -> None:
                 # spread tree next to the sim wavefront prediction
                 # (propagation_bench.py, docs/observability.md).
                 "propagation_bench": prov_rec,
+                # Fleet telemetry: any-member view coverage/staleness
+                # through a split-brain heal + exact provenance joins
+                # (fleet_bench.py, docs/observability.md).
+                "fleet_bench": fleet_rec,
                 # The memory ladder's planning claims (per-rung B/pair,
                 # modeled max scale) — every entry certified: false
                 # until the chip calibrates the new paths.

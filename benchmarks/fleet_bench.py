@@ -1,0 +1,282 @@
+"""Fleet telemetry benchmark: any-member views, exact provenance joins.
+
+Three arms (docs/observability.md "Fleet telemetry"):
+
+- **Fleet view (measured)** — a real loopback fleet (ChaosHarness) with
+  ``Config.telemetry_interval`` set rides a 2-way split-brain that
+  heals mid-run. A fixed randomly-chosen member samples
+  ``Cluster.fleet_view()`` throughout; per-entry advertised heartbeat
+  watermarks must be MONOTONE non-decreasing across the heal (frozen
+  during the cut is fine; regression is not). GATES: after the heal a
+  random member's view covers ≥ 99% of the fleet
+  (``fleet_view_coverage_frac``) with a bounded staleness p99
+  (``fleet_staleness_p99_s``), and no watermark ever regressed.
+
+- **Exact provenance joins (measured)** — the same fleet runs with
+  ``Config.trace_context`` on, so every anti-entropy packet names its
+  sender on the wire and the provenance collector joins BOTH sides of
+  every handshake exactly — no closest-preceding-send heuristic. One
+  marked write after the heal must join 100% of the fleet's applies
+  with kind ``direct`` only (``prov_exact_join_frac`` == 1.0, zero
+  ``send``/``unjoined`` joins).
+
+- **Sim telemetry wavefront (predicted)** — the telemetry plane is one
+  gossip-replicated key per node, so its convergence is exactly the
+  marked-write wavefront of a ``keys_per_node=1`` sim
+  (``obs.sim.wavefront_series`` — the PR-14 staleness machinery, no new
+  kernel): rounds for a fresh health digest to reach ≥ 99% of the
+  fleet (``sim_telemetry_wavefront_rounds``).
+
+Usage: python benchmarks/fleet_bench.py [--smoke]
+Importable: bench.py calls measure() for its BENCH record
+(``extra.fleet_bench``; compact keys ``fleet_view_coverage_frac``,
+``fleet_staleness_p99_s``, ``prov_exact_join_frac``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+NODES = 10
+NODES_SMOKE = 6
+INTERVAL_S = 0.05
+TELEMETRY_INTERVAL_S = 0.2
+COVERAGE_FRAC = 0.99
+# Post-heal staleness ceiling (seconds, per-entry approximation). The
+# honest steady-state lag is a few gossip beats; this bound only has to
+# catch a telemetry plane that stopped replicating.
+STALENESS_P99_BOUND_S = 2.0
+MARKED_KEY = "fleet-marked"
+# Split-brain window (seconds from harness start): late enough that the
+# fleet has bootstrapped and telemetry is flowing, short enough that the
+# post-heal settle dominates the run.
+SPLIT_START_S = 1.6
+SPLIT_HEAL_S = 2.8
+SAMPLE_EVERY_S = 0.1
+
+
+async def _runtime_arm(nodes: int, log) -> dict:
+    from aiocluster_tpu.faults.runner import ChaosHarness
+    from aiocluster_tpu.faults.scenarios import split_brain
+    from aiocluster_tpu.obs import TraceWriter
+
+    rng = random.Random(1234)
+    with tempfile.TemporaryDirectory() as td:
+        prov_tw = TraceWriter(os.path.join(td, "prov.jsonl"))
+        harness = ChaosHarness(
+            nodes,
+            lambda h: split_brain(
+                2,
+                start=SPLIT_START_S,
+                heal=SPLIT_HEAL_S,
+                groups=h.name_groups(2),
+            ),
+            gossip_interval=INTERVAL_S,
+            config_overrides={
+                "telemetry_interval": TELEMETRY_INTERVAL_S,
+                "trace_context": True,
+            },
+            prov_trace=prov_tw,
+        )
+        observer = rng.choice(harness.names)
+        watermarks: dict[str, int] = {}
+        regressions: list[dict] = []
+        samples = 0
+
+        async def sample_views() -> None:
+            """Poll the fixed observer's fleet view through the split
+            and heal; any per-entry advertised-watermark regression is a
+            gate failure (frozen entries during the cut are expected)."""
+            nonlocal samples
+            while True:
+                cluster = harness.clusters.get(observer)
+                if cluster is not None:
+                    view = cluster.fleet_view()
+                    samples += 1
+                    for name, entry in view["nodes"].items():
+                        adv = entry["heartbeat_advertised"]
+                        if adv is None:
+                            continue
+                        prev = watermarks.get(name)
+                        if prev is not None and adv < prev:
+                            regressions.append(
+                                {"node": name, "from": prev, "to": adv}
+                            )
+                        else:
+                            watermarks[name] = adv
+                await asyncio.sleep(SAMPLE_EVERY_S)
+
+        async with harness:
+            sampler = asyncio.create_task(sample_views())
+            try:
+                # Returns only once the heal has let the islands remerge.
+                await harness.wait_converged(40.0)
+                # Let every member publish a fresh digest post-heal.
+                await asyncio.sleep(TELEMETRY_INTERVAL_S * 3)
+                owner = harness.names[0]
+                harness.clusters[owner].set(MARKED_KEY, "x")
+                needed = nodes - 1
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    seen = sum(
+                        1
+                        for name, cluster in harness.clusters.items()
+                        if name != owner
+                        and any(
+                            nid.name == owner
+                            and ns.get(MARKED_KEY) is not None
+                            for nid, ns in cluster.node_states_view().items()
+                        )
+                    )
+                    if seen >= needed:
+                        break
+                    await asyncio.sleep(INTERVAL_S / 4)
+                else:
+                    raise TimeoutError("marked write not fleet-visible in 30s")
+                # A few more beats so straggler applies land in the
+                # trace before the join.
+                await asyncio.sleep(INTERVAL_S * 4)
+                view = harness.clusters[observer].fleet_view()
+            finally:
+                sampler.cancel()
+                try:
+                    await sampler
+                except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued at teardown
+                    pass
+        prov_tw.close()
+        log(
+            f"fleet view via {observer}: coverage "
+            f"{view['coverage_frac']} over {view['known']} nodes, "
+            f"staleness p99 {view.get('staleness_p99_s')}s, "
+            f"{samples} samples, {len(regressions)} regressions"
+        )
+        report = harness.propagation_report(key=MARKED_KEY)
+        tree = report.tree(owner=owner, key=MARKED_KEY)
+        if tree is None:
+            raise RuntimeError("provenance join produced no marked tree")
+        prov = tree.summary(nodes)
+        log(
+            f"provenance: {prov['applies']}/{nodes - 1} applies, "
+            f"joins {prov['join_kinds']}"
+        )
+        return {
+            "observer": observer,
+            "view_samples": samples,
+            "watermark_regressions": regressions,
+            "coverage_frac": view["coverage_frac"],
+            "known": view["known"],
+            "covered": view["covered"],
+            "suspect": view["suspect"],
+            "staleness_p50_s": view.get("staleness_p50_s"),
+            "staleness_p99_s": view.get("staleness_p99_s"),
+            "staleness_max_s": view.get("staleness_max_s"),
+            "provenance": prov,
+        }
+
+
+def _sim_arm(nodes: int, log) -> dict:
+    """Telemetry-plane convergence in the tensor sim: one replicated
+    key per node (the health digest), wavefront of one fresh publish."""
+    from aiocluster_tpu.obs.sim import wavefront_series
+    from aiocluster_tpu.sim import SimConfig
+
+    cfg = SimConfig(
+        n_nodes=max(nodes, 8),
+        keys_per_node=1,
+        fanout=3,
+        budget=4,
+        track_failure_detector=False,
+        track_heartbeats=False,
+    )
+    series = wavefront_series(cfg, seed=0, threshold=COVERAGE_FRAC)
+    log(
+        f"sim telemetry wavefront: {series['rounds_to_threshold']} rounds "
+        f"to {COVERAGE_FRAC:.0%}, curve "
+        f"{[round(f, 4) for f in series['fractions']]}"
+    )
+    return {
+        "n_nodes": cfg.n_nodes,
+        "rounds_to_threshold": series["rounds_to_threshold"],
+        "threshold": series["threshold"],
+        "fractions": [round(f, 4) for f in series["fractions"]],
+    }
+
+
+def measure(*, smoke: bool = False, log=lambda m: None) -> dict | None:
+    """The BENCH-record entry point (also the ``make fleet-smoke``
+    body): returns the record dict, or None when the measurement could
+    not run (bench.py embeds what it can, never dies on an anchor)."""
+    nodes = NODES_SMOKE if smoke else NODES
+    runtime = asyncio.run(_runtime_arm(nodes, log))
+    sim = _sim_arm(nodes, log)
+
+    prov = runtime["provenance"]
+    exact_frac = prov.get("exact_join_frac")
+    heuristic_joins = sum(
+        count
+        for kind, count in prov["join_kinds"].items()
+        if kind != "direct"
+    )
+    p99 = runtime["staleness_p99_s"]
+    gates = {
+        "fleet_coverage": runtime["coverage_frac"] >= COVERAGE_FRAC,
+        "staleness_bounded": (
+            p99 is not None and p99 <= STALENESS_P99_BOUND_S
+        ),
+        "watermarks_monotone": not runtime["watermark_regressions"],
+        "prov_exact_joins": (
+            prov.get("joined_fraction", 0.0) >= 1.0
+            and exact_frac == 1.0
+            and heuristic_joins == 0
+        ),
+        "sim_keys_present": sim["rounds_to_threshold"] is not None,
+    }
+    record = {
+        "scenario": "fleet telemetry through split-brain heal",
+        "smoke": smoke,
+        "n_nodes": nodes,
+        "gossip_interval_s": INTERVAL_S,
+        "telemetry_interval_s": TELEMETRY_INTERVAL_S,
+        "runtime": runtime,
+        "sim_wavefront": sim,
+        # Compact keys (bench.py stdout line; writer round-trip pinned
+        # in tests/test_bench_artifact.py).
+        "fleet_view_coverage_frac": runtime["coverage_frac"],
+        "fleet_staleness_p99_s": p99,
+        "prov_exact_join_frac": exact_frac,
+        "sim_telemetry_wavefront_rounds": sim["rounds_to_threshold"],
+        "gates": gates,
+        "gates_passed": all(gates.values()),
+    }
+    return record
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true")
+    args = parser.parse_args()
+
+    def log(m: str) -> None:
+        print(f"# {m}", file=sys.stderr, flush=True)
+
+    record = measure(smoke=args.smoke, log=log)
+    print(json.dumps(record, indent=2))
+    if not record["gates_passed"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

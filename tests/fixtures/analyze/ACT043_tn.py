@@ -1,0 +1,18 @@
+# analyze-domain: runtime
+"""TN: building reserved keys from the imported constant is the
+sanctioned spelling; ordinary application keys and prose mentioning the
+prefix mid-string stay quiet."""
+
+TELEMETRY_PREFIX = "stand-in-for-the-imported-constant"
+
+
+def publish(cluster):
+    cluster.set(TELEMETRY_PREFIX + "health", "{}")  # built, not respelled
+
+
+def app_key(cluster):
+    cluster.set("fleet:health", "{}")  # not the reserved prefix
+
+
+def note() -> str:
+    return "keys under the __fleet: prefix are reserved"  # prose mention

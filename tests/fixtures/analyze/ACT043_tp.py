@@ -1,0 +1,12 @@
+# analyze-domain: runtime
+"""TP: the reserved telemetry key prefix respelled as literals — every
+site must import TELEMETRY_PREFIX/TELEMETRY_KEY from obs/fleet.py so
+the reserved keyspace keeps one defining module."""
+
+
+def publish(cluster):
+    cluster.set("__fleet:health", "{}")  # respelled reserved key
+
+
+def is_telemetry(key: str) -> bool:
+    return key.startswith("__fleet:")  # respelled prefix check

@@ -66,6 +66,16 @@ def test_registry_spans_all_four_families():
     assert len(CODES) >= 10
 
 
+def test_act043_prefix_pin_matches_package_constant():
+    """ACT043 deliberately duplicates the reserved telemetry prefix (the
+    analyzer never imports the package it audits); this pin is what
+    keeps the duplicate honest."""
+    from aiocluster_tpu.obs.fleet import TELEMETRY_PREFIX
+    from tools.analyze import rules_obs
+
+    assert rules_obs._TELEMETRY_PREFIX == TELEMETRY_PREFIX
+
+
 def test_corpus_excluded_from_directory_walks():
     report = analyze_paths([REPO / "tests"])
     assert not any("fixtures/analyze" in f.path for f in report.findings)

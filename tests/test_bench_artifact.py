@@ -214,6 +214,44 @@ def _worst_case_result():
                 },
                 "gates_passed": True,
             },
+            "fleet_bench": {
+                "scenario": "fleet telemetry through split-brain heal",
+                "smoke": False,
+                "n_nodes": 10,
+                "telemetry_interval_s": 0.2,
+                "runtime": {
+                    "observer": "n07",
+                    "coverage_frac": 1.0,
+                    "known": 10,
+                    "covered": 10,
+                    "suspect": 0,
+                    "staleness_p99_s": 0.65,
+                    "watermark_regressions": [],
+                    "provenance": {
+                        "applies": 9,
+                        "join_kinds": {"direct": 9},
+                        "exact_join_frac": 1.0,
+                        "joined_fraction": 1.0,
+                    },
+                },
+                "sim_wavefront": {
+                    "rounds_to_threshold": 2,
+                    "threshold": 0.99,
+                    "fractions": [0.1, 0.8, 1.0],
+                },
+                "fleet_view_coverage_frac": 1.0,
+                "fleet_staleness_p99_s": 0.65,
+                "prov_exact_join_frac": 1.0,
+                "sim_telemetry_wavefront_rounds": 2,
+                "gates": {
+                    "fleet_coverage": True,
+                    "staleness_bounded": True,
+                    "watermarks_monotone": True,
+                    "prov_exact_joins": True,
+                    "sim_keys_present": True,
+                },
+                "gates_passed": True,
+            },
             "restart_bench": {
                 "scenario": "rolling_restart + leave",
                 "smoke": False,
@@ -319,6 +357,19 @@ def test_stdout_line_stays_under_cap():
     assert ex["propagation_p99_s"] == 0.0447
     assert ex["propagation_hops_p99"] == 3
     assert ex["sim_wavefront_rounds"] == 2
+    # The fleet-telemetry keys round-trip as flat scalars: any-member
+    # view coverage, staleness p99, and the exact provenance-join
+    # fraction (fleet_bench.py, docs/observability.md "Fleet
+    # telemetry") — and they sit at the FRONT of the sacrifice order
+    # (newest provenance sheds first under cap pressure).
+    assert ex["fleet_view_coverage_frac"] == 1.0
+    assert ex["fleet_staleness_p99_s"] == 0.65
+    assert ex["prov_exact_join_frac"] == 1.0
+    assert bench._SACRIFICE_ORDER[:3] == (
+        "prov_exact_join_frac",
+        "fleet_staleness_p99_s",
+        "fleet_view_coverage_frac",
+    )
     # The packed-rung engagement dict compacts to the comma-joined
     # engaged list (a dispatch regression would read "none" loudly).
     assert ex["packed_kernel_engaged"] == "u4r,shrunk,deep"

@@ -216,6 +216,58 @@ def test_injected_owner_violation_on_truncated_relay_is_caught():
     assert clean.node_deltas == []  # nothing of the fabrication survives
 
 
+def test_guard_rejects_forged_telemetry_for_victim():
+    """Gossip-borne telemetry adds NO new trust surface: a relay that
+    fabricates a ``__fleet:health`` digest inside the victim's own
+    keyspace is an owner violation like any other self-keyspace write —
+    rejected wholesale at the victim AND counted
+    (docs/observability.md "Fleet telemetry")."""
+    from aiocluster_tpu.obs.fleet import TELEMETRY_KEY, encode_health_digest
+
+    me = _nid("victim")
+    forged = encode_health_digest({"hb": 10**6, "live": 99, "int": 0.001})
+    nd = NodeDelta(
+        node_id=me,
+        from_version_excluded=0,
+        last_gc_version=0,
+        key_values=[
+            KeyValueUpdate(TELEMETRY_KEY, forged, 500, KeyStatus.SET)
+        ],
+        max_version=None,
+    )
+    clean, rejections = sanitize_delta(_delta(nd), me)
+    assert clean.node_deltas == []
+    assert rejections == {"owner_violation": 1}
+
+
+def test_fleet_view_marks_overclaimed_heartbeat_suspect():
+    """The receiving side of the same defense: a replicated telemetry
+    digest advertising a heartbeat ABOVE the local failure detector's
+    watermark cannot be the owner's honest publish cadence (the
+    watermark replicates with or ahead of the key) — the fleet view
+    marks the entry suspect instead of trusting it, and never computes
+    a negative staleness."""
+    from aiocluster_tpu.obs.fleet import build_fleet_entry, encode_health_digest
+
+    honest = build_fleet_entry(
+        "peer",
+        live=True,
+        heartbeat=50,
+        raw=encode_health_digest({"hb": 48, "int": 0.5}),
+    )
+    assert not honest.suspect
+    assert honest.staleness_beats == 2 and honest.staleness_s == 1.0
+    forged = build_fleet_entry(
+        "peer",
+        live=True,
+        heartbeat=50,
+        raw=encode_health_digest({"hb": 51, "int": 0.5}),
+    )
+    assert forged.suspect
+    assert forged.heartbeat_advertised == 51
+    assert forged.staleness_beats is None and forged.staleness_s is None
+
+
 def test_guards_never_fire_across_live_cluster_state():
     """Property-style honest soak: deltas produced by the real packer
     between two honestly-evolving ClusterStates never trip a guard."""

@@ -1,0 +1,286 @@
+"""Fleet telemetry plane (aiocluster_tpu/obs/fleet.py;
+docs/observability.md "Fleet telemetry").
+
+Pins the tentpole contracts:
+- the health-digest codec: schema stamp on encode, TOLERANT decode
+  (``None``, never an exception, for missing/garbage/non-object
+  payloads — one node's malformed digest must not take down another
+  node's fleet view);
+- per-entry staleness math against the local heartbeat watermark, the
+  suspect rule, and the no-advertised-interval edge;
+- ``assemble_fleet_view`` aggregates and the ``stale_s`` filter's
+  keep-self exception;
+- runtime integration: a loopback fleet with ``telemetry_interval`` set
+  converges to FULL fleet-view coverage from a non-owner member, with
+  the publish counter accounting for every digest;
+- ``GET /fleet``: ETag/304 on an unchanged digest epoch, the cached
+  body invalidating on an epoch bump, ``?stale_s=`` validation, and the
+  never-shed guarantee.
+
+The byzantine half (forged telemetry rejected + counted, suspect
+marking) lives with the other guard pins in tests/test_byzantine.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from conftest import wait_for
+
+from aiocluster_tpu import Cluster, Config, NodeId
+from aiocluster_tpu.faults.runner import ChaosHarness
+from aiocluster_tpu.obs import MetricsRegistry
+from aiocluster_tpu.obs.fleet import (
+    TELEMETRY_KEY,
+    TELEMETRY_PREFIX,
+    TELEMETRY_SCHEMA_VERSION,
+    FleetEntry,
+    assemble_fleet_view,
+    build_fleet_entry,
+    decode_health_digest,
+    encode_health_digest,
+    round_latency_percentiles,
+)
+from aiocluster_tpu.serve import ServeApp
+
+INTERVAL = 0.05
+
+
+# -- digest codec --------------------------------------------------------------
+
+
+def test_health_digest_round_trip_stamps_schema():
+    raw = encode_health_digest({"hb": 7, "live": 3, "int": 0.5})
+    payload = decode_health_digest(raw)
+    assert payload is not None
+    assert payload["v"] == TELEMETRY_SCHEMA_VERSION
+    assert payload["hb"] == 7 and payload["live"] == 3
+    # Compact on the wire: no spaces, sorted keys (stable bytes for the
+    # segments fastpath's per-write invalidation).
+    assert " " not in raw and raw == json.dumps(
+        json.loads(raw), sort_keys=True, separators=(",", ":")
+    )
+
+
+def test_health_digest_decode_is_tolerant():
+    for bad in (None, "", "not json{", "[1,2,3]", '"str"', "42", "{}"):
+        assert decode_health_digest(bad) is None
+    # Unknown future fields ride through untouched.
+    fwd = decode_health_digest('{"v":99,"hb":1,"future":"x"}')
+    assert fwd == {"v": 99, "hb": 1, "future": "x"}
+
+
+def test_round_latency_percentiles():
+    assert round_latency_percentiles([]) is None
+    p50, p99 = round_latency_percentiles([0.01] * 98 + [0.5, 1.0])
+    assert p50 == 0.01 and p99 == 0.5
+
+
+def test_telemetry_key_is_under_reserved_prefix():
+    assert TELEMETRY_KEY.startswith(TELEMETRY_PREFIX)
+
+
+# -- per-entry staleness / suspicion -------------------------------------------
+
+
+def test_entry_staleness_math():
+    e = build_fleet_entry(
+        "n", live=True, heartbeat=100,
+        raw=encode_health_digest({"hb": 96, "int": 0.25}),
+    )
+    assert e.heartbeat_advertised == 96
+    assert e.staleness_beats == 4 and e.staleness_s == 1.0
+    assert not e.suspect
+
+
+def test_entry_without_telemetry_or_with_bad_hb():
+    bare = build_fleet_entry("n", live=False, heartbeat=5, raw=None)
+    assert bare.digest is None and bare.heartbeat_advertised is None
+    assert bare.staleness_s is None and not bare.suspect
+    # A digest whose ``hb`` is not an int annotates nothing.
+    odd = build_fleet_entry(
+        "n", live=True, heartbeat=5, raw='{"v":1,"hb":"high"}'
+    )
+    assert odd.digest is not None and odd.heartbeat_advertised is None
+
+
+def test_entry_without_advertised_interval_has_beats_only():
+    e = build_fleet_entry(
+        "n", live=True, heartbeat=10, raw=encode_health_digest({"hb": 8})
+    )
+    assert e.staleness_beats == 2 and e.staleness_s is None
+
+
+# -- view assembly -------------------------------------------------------------
+
+
+def _entries() -> list[FleetEntry]:
+    return [
+        build_fleet_entry(
+            "self", live=True, heartbeat=50,
+            raw=encode_health_digest({"hb": 50, "int": 0.5}),
+        ),
+        build_fleet_entry(
+            "fresh", live=True, heartbeat=50,
+            raw=encode_health_digest({"hb": 49, "int": 0.5}),
+        ),
+        build_fleet_entry(
+            "stale", live=True, heartbeat=50,
+            raw=encode_health_digest({"hb": 30, "int": 0.5}),
+        ),
+        build_fleet_entry("silent", live=False, heartbeat=3, raw=None),
+    ]
+
+
+def test_assemble_fleet_view_aggregates():
+    view = assemble_fleet_view(_entries(), self_name="self", epoch=17)
+    assert view["self"] == "self" and view["epoch"] == 17
+    assert view["known"] == 4 and view["covered"] == 3
+    assert view["coverage_frac"] == 0.75 and view["suspect"] == 0
+    assert set(view["nodes"]) == {"self", "fresh", "stale", "silent"}
+    assert view["staleness_p50_s"] == 0.5  # {0.0, 0.5, 10.0}
+    assert view["staleness_max_s"] == 10.0
+
+
+def test_assemble_fleet_view_stale_filter_keeps_self():
+    view = assemble_fleet_view(
+        _entries(), self_name="self", epoch=17, stale_s=1.0
+    )
+    # "stale" (10 s) and "silent" (unknown staleness) are filtered out;
+    # the assembling member itself always stays — its entry is local by
+    # definition.
+    assert set(view["nodes"]) == {"self", "fresh"}
+    # Aggregates still describe the WHOLE fleet, not the filtered rows.
+    assert view["known"] == 4 and view["covered"] == 3
+    assert view["stale_s"] == 1.0
+
+
+def test_assemble_fleet_view_empty():
+    view = assemble_fleet_view([], self_name="x", epoch=0)
+    assert view["known"] == 0 and view["coverage_frac"] == 0.0
+    assert "staleness_p50_s" not in view
+
+
+# -- runtime integration -------------------------------------------------------
+
+
+async def test_fleet_view_converges_across_loopback_fleet():
+    """3-node loopback fleet with telemetry on: a NON-owner member's
+    fleet_view reaches full coverage with zero suspects, every entry's
+    digest carries the schema stamp, and the publish counter accounts
+    for each node's digests."""
+    async with ChaosHarness(
+        3,
+        None,
+        gossip_interval=INTERVAL,
+        config_overrides={"telemetry_interval": 4 * INTERVAL},
+    ) as h:
+        await h.wait_converged(timeout=20.0)
+        observer = h.clusters["n02"]
+
+        def covered() -> bool:
+            v = observer.fleet_view()
+            return v["coverage_frac"] == 1.0 and v["suspect"] == 0
+
+        await wait_for(covered, timeout=20.0)
+        view = observer.fleet_view()
+        assert view["known"] == 3 and view["covered"] == 3
+        for name, row in view["nodes"].items():
+            assert row["digest"]["v"] == TELEMETRY_SCHEMA_VERSION
+            assert row["suspect"] is False, name
+        snap = observer.metrics_registry().snapshot()
+        assert snap.get("aiocluster_fleet_telemetry_publishes_total", 0) > 0
+        assert snap.get("aiocluster_fleet_view_nodes", 0) == 3
+
+
+# -- GET /fleet ----------------------------------------------------------------
+
+
+def _make_cluster(port: int) -> Cluster:
+    return Cluster(
+        Config(
+            node_id=NodeId(
+                name=f"fleet-{port}",
+                gossip_advertise_addr=("127.0.0.1", port),
+            ),
+            cluster_id="fleet-test",
+            gossip_interval=60.0,  # quiescent: the test drives changes
+        ),
+        metrics=MetricsRegistry(),
+    )
+
+
+async def _request(port, method, path, headers=()):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        extra = "".join(f"{k}: {v}\r\n" for k, v in headers)
+        writer.write(
+            f"{method} {path} HTTP/1.1\r\nHost: t\r\n{extra}\r\n".encode()
+        )
+        await writer.drain()
+        status = (await reader.readline()).decode().split(" ", 1)[1].strip()
+        hdrs: dict[str, str] = {}
+        while True:
+            line = (await reader.readline()).decode().strip()
+            if not line:
+                break
+            name, _, value = line.partition(":")
+            hdrs[name.lower()] = value.strip()
+        body = b""
+        length = int(hdrs.get("content-length") or 0)
+        if length:
+            body = await reader.readexactly(length)
+        return status, hdrs, body
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def test_fleet_endpoint_etag_cache_and_filter(free_port):
+    c = _make_cluster(free_port)
+    c.set("x", "0")
+    async with c:
+        app = ServeApp(c)
+        port = await app.start()
+        try:
+            status, hdrs, body = await _request(port, "GET", "/fleet")
+            assert status.startswith("200")
+            etag = hdrs["etag"]
+            assert etag == f'"{c.state_epoch()}"'
+            view = json.loads(body)
+            assert view["self"] == c.self_node_id.name
+            assert c.self_node_id.name in view["nodes"]
+
+            # Unchanged digest epoch: If-None-Match short-circuits to
+            # 304, and a plain re-GET serves the cached bytes.
+            status, hdrs2, body2 = await _request(
+                port, "GET", "/fleet", (("If-None-Match", etag),)
+            )
+            assert status.startswith("304") and hdrs2["etag"] == etag
+            _, _, again = await _request(port, "GET", "/fleet")
+            assert again == body
+
+            # An epoch bump invalidates: new ETag, the old validator no
+            # longer matches.
+            c.set("x", "1")
+            status, hdrs3, _ = await _request(
+                port, "GET", "/fleet", (("If-None-Match", etag),)
+            )
+            assert status.startswith("200") and hdrs3["etag"] != etag
+
+            # ?stale_s= filters (self always kept) and validates.
+            status, _, body4 = await _request(
+                port, "GET", "/fleet?stale_s=0.5"
+            )
+            assert status.startswith("200")
+            assert c.self_node_id.name in json.loads(body4)["nodes"]
+            status, _, body5 = await _request(
+                port, "GET", "/fleet?stale_s=bogus"
+            )
+            assert status.startswith("400") and body5 == b"bad stale_s"
+        finally:
+            await app.stop()

@@ -394,3 +394,138 @@ def test_leave_packet_round_trip():
     bare = decode_packet(encode_packet(Packet("c", Leave(N1, Delta()))))
     assert isinstance(bare.msg, Leave)
     assert bare.msg.reason == "leave" and bare.msg.heartbeat == 0
+
+
+def test_trace_context_round_trip():
+    """Span-context envelope (field 7, beyond the reference schema):
+    sender name + handshake id survive the wire on every handshake
+    message; an absent field decodes to ``trace=None``."""
+    from aiocluster_tpu.core.messages import TraceContext
+
+    tc = TraceContext("alpha", 918273)
+    for msg in (
+        Syn(make_digest()),
+        SynAck(make_digest(), make_delta()),
+        Ack(make_delta()),
+    ):
+        out = decode_packet(encode_packet(Packet("c", msg, tc)))
+        assert out.trace == tc
+        assert type(out.msg) is type(msg)
+    plain = decode_packet(encode_packet(Packet("c", Syn(make_digest()))))
+    assert plain.trace is None
+
+
+def test_trace_context_is_a_pure_append():
+    """encode(pkt with trace) == encode(pkt sans trace) + the standalone
+    field-7 bytes — the property that lets the zero-copy parts path
+    APPEND the per-handshake span context after the cached frame parts,
+    and the ``trace=None`` half of the byte-identical-frames contract
+    (docs/migration.md difference #17)."""
+    from aiocluster_tpu.core.messages import TraceContext
+    from aiocluster_tpu.wire.proto import encode_trace_context
+
+    tc = TraceContext("n00", 41)
+    for msg in (
+        Syn(make_digest()),
+        SynAck(make_digest(), make_delta()),
+        Ack(make_delta()),
+        BadCluster(),
+    ):
+        plain = encode_packet(Packet("c", msg))
+        traced = encode_packet(Packet("c", msg, tc))
+        assert traced == plain + encode_trace_context(tc)
+
+
+def test_reference_shaped_decoder_skips_trace_field():
+    """Mirror of the Leave discipline (field 6): a reference-shaped
+    proto3 walker that skips envelope fields beyond its schema consumes
+    EXACTLY the untraced frame's fields from a traced frame — and
+    dropping field 7 wholesale re-emits the untraced bytes
+    identically."""
+    from aiocluster_tpu.core.messages import TraceContext
+    from aiocluster_tpu.wire.proto import _Reader, _field_msg
+
+    def envelope_fields(buf: bytes) -> list[tuple[int, bytes]]:
+        r = _Reader(buf)
+        out = []
+        while not r.at_end():
+            field, wt = r.field()
+            assert wt == 2  # the envelope is all LEN fields
+            out.append((field, bytes(r.chunk())))
+        return out
+
+    tc = TraceContext("alpha", 7)
+    plain = encode_packet(Packet("c1", SynAck(make_digest(), make_delta())))
+    traced = encode_packet(
+        Packet("c1", SynAck(make_digest(), make_delta()), tc)
+    )
+    assert traced != plain
+    known = [(f, body) for f, body in envelope_fields(traced) if f <= 6]
+    assert known == envelope_fields(plain)
+    stripped = bytearray()
+    for f, body in known:
+        _field_msg(stripped, f, body)
+    assert bytes(stripped) == plain
+
+
+def test_fuzz_trace_append_and_skip_invariants():
+    """Differential fuzz over random handshake packets: the field-7
+    append property and round-trip hold on every frame, so
+    ``Config.trace_context=False`` (``trace=None``) frames are
+    byte-identical to the reference by construction."""
+    import random
+
+    from aiocluster_tpu.core.messages import TraceContext
+    from aiocluster_tpu.wire.proto import encode_trace_context
+
+    rng = random.Random(0x7C7C)
+
+    def rand_digest() -> Digest:
+        d = Digest()
+        for i in range(rng.randrange(4)):
+            d.add_node(
+                NodeId(f"n{i}", rng.randrange(1 << 20), ("h", 1 + i), None),
+                heartbeat=rng.randrange(1 << 30),
+                last_gc_version=rng.randrange(4),
+                max_version=rng.randrange(1 << 16),
+            )
+        return d
+
+    def rand_delta() -> Delta:
+        nds = []
+        for i in range(rng.randrange(3)):
+            kvs = [
+                KeyValueUpdate(
+                    f"k{j}",
+                    "x" * rng.randrange(6),
+                    rng.randrange(1, 1 << 12),
+                    VersionStatusEnum.SET,
+                )
+                for j in range(rng.randrange(3))
+            ]
+            nds.append(
+                NodeDelta(
+                    NodeId(f"d{i}", i, ("h", 50 + i), None),
+                    rng.randrange(4),
+                    0,
+                    kvs,
+                    max_version=rng.choice([None, rng.randrange(1 << 12)]),
+                )
+            )
+        return Delta(node_deltas=nds)
+
+    for step in range(60):
+        msg = rng.choice(
+            [
+                lambda: Syn(rand_digest()),
+                lambda: SynAck(rand_digest(), rand_delta()),
+                lambda: Ack(rand_delta()),
+            ]
+        )()
+        tc = TraceContext(f"sender-{step}", rng.randrange(1 << 40))
+        plain = encode_packet(Packet("fuzz", msg))
+        traced = encode_packet(Packet("fuzz", msg, tc))
+        assert traced == plain + encode_trace_context(tc), step
+        out = decode_packet(traced)
+        assert out.trace == tc, step
+        assert decode_packet(plain).trace is None, step

@@ -9,6 +9,12 @@ but no consumer will ever route it — so every ``TraceWriter`` emit site
 in the instrumented packages must name its kind as a string literal,
 where grep and the docs' event catalogue (docs/observability.md) can
 see it too.
+
+ACT043 guards the fleet-telemetry plane's reserved keyspace the same
+way: ``__fleet:``-prefixed keys are the contract boundary between
+application data and gossip-borne self-telemetry (obs/fleet.py), and
+every consumer must import the constants rather than respell the
+prefix — a drifted literal silently splits the keyspace.
 """
 
 from __future__ import annotations
@@ -166,4 +172,51 @@ def check_metric_documented(ctx: FileContext):
             f"metric family {name!r} is registered here but missing "
             "from docs/observability.md's catalogue — document it (the "
             "metric surface's docs-drift gate)",
+        )
+
+
+# The telemetry plane's reserved key prefix. Deliberately DUPLICATED
+# from aiocluster_tpu/obs/fleet.py's TELEMETRY_PREFIX — the analyzer
+# never imports the package it audits — and pinned equal to the real
+# constant by tests/test_analyze.py so the two cannot drift apart.
+_TELEMETRY_PREFIX = "__fleet:"
+
+# The defining module: the one place allowed to spell the prefix.
+_TELEMETRY_HOME = "obs/fleet.py"
+
+# Packages that handle keys near the telemetry plane (publish, view
+# assembly, serving). Everything else is out of scope: tests and
+# benchmarks fabricate reserved keys on purpose.
+_FLEET_DOMAINS = {"runtime", "serve", "obs"}
+
+
+@rule(
+    "ACT043",
+    "reserved-telemetry-prefix-literal",
+    "reserved __fleet: key prefix respelled as a literal",
+)
+def check_reserved_prefix_literal(ctx: FileContext):
+    """Single-source gate for the reserved telemetry keyspace: any
+    string literal beginning with ``__fleet:`` outside obs/fleet.py
+    must instead import ``TELEMETRY_PREFIX``/``TELEMETRY_KEY`` — a
+    respelled prefix is invisible to refactors of the constant and
+    silently splits the keyspace (docs/static-analysis.md)."""
+    if ctx.tree is None or not (_FLEET_DOMAINS & ctx.domains):
+        return
+    if ctx.relpath.endswith(_TELEMETRY_HOME):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Constant) and isinstance(node.value, str)
+        ):
+            continue
+        if not node.value.startswith(_TELEMETRY_PREFIX):
+            continue
+        yield ctx.finding(
+            node,
+            "ACT043",
+            f"string literal {node.value!r} respells the reserved "
+            "telemetry key prefix — import TELEMETRY_PREFIX/"
+            "TELEMETRY_KEY from aiocluster_tpu.obs.fleet instead (the "
+            "reserved keyspace has one defining module)",
         )

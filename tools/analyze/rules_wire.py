@@ -35,6 +35,7 @@ _SANCTIONED_FUNCS = frozenset({
     "encode_kv_body", "encode_kv_update", "encode_node_id",
     "encode_node_digest", "_encode_digest_entry", "encode_node_delta",
     "encode_digest", "encode_delta", "encode_packet",
+    "encode_trace_context",
     # native bulk marshaling (ctypes needs contiguous input)
     "encode_kv_updates", "decode_node_delta_raw",
     # framing
