@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke prov-bench prov-smoke wire-bench wire-smoke fleet-bench fleet-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze analyze-concurrency chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke prov-bench prov-smoke wire-bench wire-smoke fleet-bench fleet-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -21,10 +21,17 @@ lint:
 
 # Domain-aware static analysis (docs/static-analysis.md): async-safety,
 # JAX purity, and the paper's owner-write invariant, plus the ACT00x
-# style family. Pre-existing findings are grandfathered in
-# tools/analyze/baseline.json; only NEW findings fail.
+# style family and the flow-sensitive ACT05x await-interleaving tier.
+# The baseline (tools/analyze/baseline.json) is EMPTY — every finding
+# is either fixed or justify-suppressed in source; any NEW finding fails.
 analyze:
 	$(PY) -m tools.analyze $(LINT_PATHS)
+
+# Fast iteration loop for concurrency work: only the flow-sensitive
+# ACT05x family (CFG + whole-repo symbol graph), skipping the syntactic
+# tiers. Same paths and exit semantics as `analyze`.
+analyze-concurrency:
+	$(PY) -m tools.analyze --only-family ACT05x $(LINT_PATHS)
 
 # Deterministic chaos soak (docs/faults.md): seeded flaky_links +
 # split_brain + crash/restart against real loopback fleets and the sim,

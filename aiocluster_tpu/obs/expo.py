@@ -133,10 +133,12 @@ class MetricsHTTPServer:
         return self.port
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # Swap-to-local before wait_closed suspends so a concurrent
+        # stop() sees None at the guard instead of double-closing.
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()
 
     # -- thread mode (synchronous drivers: sim CLI, bench.py) ---------------
 

@@ -511,12 +511,19 @@ class Cluster:
             f"Booting {self.self_node_id.long_name()} "
             f"[{self._config.cluster_id}]"
         )
-        # Bind before latching _started so a failed boot (e.g. EADDRINUSE)
-        # leaves the cluster retryable instead of permanently half-dead.
-        self._server = await self._transport.start_server(
-            host, port, self._handle_connection
-        )
+        # Latch _started BEFORE the bind suspends: a second start()
+        # arriving while the bind is in flight must see the latch and
+        # return, not bind twice. A failed boot (e.g. EADDRINUSE) rolls
+        # the latch back so the cluster stays retryable instead of
+        # permanently half-dead.
         self._started = True
+        try:
+            self._server = await self._transport.start_server(
+                host, port, self._handle_connection
+            )
+        except BaseException:
+            self._started = False
+            raise
         # Warm the native bulk codec in the background: its first use
         # otherwise shells out to g++ inside a gossip handshake, and
         # awaiting it here would serialize cold-cache boots behind the
@@ -609,22 +616,27 @@ class Cluster:
                 except Exception as exc:
                     self._log.warning(f"clean marker write failed: {exc!r}")
             self._persist.close()
-        if self._codec_warmup is not None:
+        # Swap the handle out BEFORE awaiting the join: a concurrent
+        # close() (or a start() racing shutdown) must see None at once,
+        # not cancel/await a task another closer already owns.
+        warmup, self._codec_warmup = self._codec_warmup, None
+        if warmup is not None:
             # Don't wait for a cold-cache native build (g++, up to 120s)
             # whose result nobody needs anymore — cancel and move on; the
             # orphaned compile thread finishes harmlessly.
-            self._codec_warmup.cancel()
+            warmup.cancel()
             try:
-                await self._codec_warmup
+                await warmup
             except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued
                 # Our own cancel() surfacing. If close() itself was
                 # cancelled in the same window, that cancellation
                 # re-raises at the next await point (3.10 has no
                 # Task.uncancel to tell the two apart).
                 pass
-            except Exception:
-                pass  # a failed warmup build is harmless: codec no-ops to pure Python
-            self._codec_warmup = None
+            except Exception as exc:
+                # A failed warmup build is harmless (the codec no-ops to
+                # pure Python) — but say so once instead of eating it.
+                self._log.debug(f"native codec warmup failed: {exc!r}")
         # Ticker is stopped, so no new borrows: close the idle pool
         # before the server so peers see orderly FINs, not RSTs.
         await self._pool.close()
@@ -634,8 +646,10 @@ class Cluster:
                 await task
             except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued; terminal join at close
                 pass
-            except Exception:
-                pass  # a failed relay is already just best-effort
+            except Exception as exc:
+                # The relay is best-effort, but a swallowed failure here
+                # hid real teardown bugs before — leave a trace.
+                self._log.debug(f"leave relay failed: {exc!r}")
         await self._stop_server()
         await self._hooks.stop()
 
@@ -644,9 +658,13 @@ class Cluster:
         of close() because ``leave()`` must stop responding BEFORE it
         announces: the announced final heartbeat is only final if no
         later inbound handshake can bump the counter."""
-        if self._server is None:
+        # Swap-to-local before any await: close() and leave() both call
+        # this, and the second caller must see None immediately rather
+        # than close an already-closing server after a stale guard read.
+        server, self._server = self._server, None
+        if server is None:
             return
-        self._server.close()
+        server.close()
         # Persistent inbound channels may be parked waiting for their
         # next Syn; close them so the handler tasks finish now rather
         # than lingering for the idle window (on 3.12+ wait_closed
@@ -656,8 +674,7 @@ class Cluster:
             writer.close()
             with suppress(Exception):
                 await writer.wait_closed()
-        await self._server.wait_closed()
-        self._server = None
+        await server.wait_closed()
 
     async def shutdown(self) -> None:
         await self.close()

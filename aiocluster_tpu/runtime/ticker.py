@@ -97,13 +97,17 @@ class Ticker:
 
     async def stop(self) -> None:
         self._stopping = True
-        if self._task is None:
+        # Swap-to-local before the join suspends: a concurrent stop()
+        # must see None at the guard, not cancel a task the first
+        # stopper is still awaiting (``closed`` flips the moment the
+        # stop commits, which is also when the swap makes it true).
+        task, self._task = self._task, None
+        if task is None:
             return
-        self._task.cancel()
+        task.cancel()
         try:
-            await self._task
+            await task
         except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued
             # Terminal join of the tick task we just cancelled; stop()
             # owns its lifecycle and retains no other awaiter.
             pass
-        self._task = None

@@ -227,24 +227,28 @@ class ServeApp:
         self._cluster.remove_on_key_change(self._on_key_change)
         self._cluster.remove_on_node_join(self._on_membership)
         self._cluster.remove_on_node_leave(self._on_membership)
-        if self._lag_task is not None:
-            self._lag_task.cancel()
+        # Swap both handles to locals before any await: stop() can race
+        # a second stop() (app teardown vs test cleanup), and the second
+        # caller must see None at the guards instead of re-cancelling
+        # tasks or re-closing a server the first already owns.
+        lag_task, self._lag_task = self._lag_task, None
+        if lag_task is not None:
+            lag_task.cancel()
             try:
-                await self._lag_task
+                await lag_task
             except asyncio.CancelledError:  # noqa: ACT013 -- absorbing the cancel we just issued at app teardown
                 pass
-            self._lag_task = None
         await self.hub.stop()
-        if self._server is not None:
-            self._server.close()
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
             # Parked watch handlers hold open connections; close them so
             # their tasks finish now instead of at client timeout.
             for writer in list(self._conns):
                 writer.close()
                 with suppress(Exception):
                     await writer.wait_closed()
-            await self._server.wait_closed()
-            self._server = None
+            await server.wait_closed()
 
     async def __aenter__(self) -> "ServeApp":
         await self.start()

@@ -178,7 +178,11 @@ class WatchHub:
             self._pump_task = asyncio.create_task(self._pump())
 
     async def stop(self) -> None:
-        if self._pump_task is not None:
+        # Swap-to-local before the join suspends: a second stop() racing
+        # this one must see None at the guard, not cancel/await a pump
+        # another stopper already owns.
+        pump, self._pump_task = self._pump_task, None
+        if pump is not None:
             # Belt AND suspenders: on 3.10, ``asyncio.wait_for`` can
             # swallow a cancellation that races the awaited future's
             # completion (bpo-42130) — and the pump's kick.wait()
@@ -188,10 +192,9 @@ class WatchHub:
             # the parked waits.
             self._stopping = True
             self._kick.set()
-            self._pump_task.cancel()
+            pump.cancel()
             with suppress(asyncio.CancelledError):  # noqa: ACT013 -- joining our own cancelled pump at shutdown
-                await self._pump_task
-            self._pump_task = None
+                await pump
         for fut in self._parked:
             if not fut.done():
                 fut.cancel()

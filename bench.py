@@ -73,7 +73,7 @@ def measure_python_handshake_seconds(n_nodes: int) -> float:
         population = nodes if know_all else [nodes[self_idx]]
         for k, node in enumerate(population):
             ns = cs.node_state_or_default(node)
-            ns.heartbeat = 5
+            ns.heartbeat = 5  # noqa: ACT030 -- white-box: fabricating bench payload state, never gossiped
             for j in range(16):
                 ns.set_with_version(f"key-{j:04d}", f"v{k}:{j}", j + 1, ts=ts)
         return GossipEngine(cfg, cs, fd)
@@ -369,18 +369,31 @@ def analyzer_health(log) -> dict | None:
     trajectory over a dirty tree is not a trajectory worth chasing.
     ``analyze_clean`` is the `make check` gate verdict (no NEW findings
     under the committed baseline); ``analyze_findings`` counts new +
-    grandfathered (suppressed judged-intentional sites excluded)."""
+    grandfathered (suppressed judged-intentional sites excluded).
+    ``analyze_duration_seconds`` keeps the gate honest about its own
+    cost (budget: tests pin it under 10 s), and
+    ``analyze_family_counts`` breaks actionable findings down per rule
+    family (ACT00x..ACT05x) so a regression names its tier."""
     try:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         try:
             from tools.analyze import run_default
 
+            t0 = time.perf_counter()
             report = run_default()
+            duration = time.perf_counter() - t0
         finally:
             sys.path.pop(0)
+        families: dict = {}
+        for f in report.findings:
+            if f.status in ("new", "baselined"):
+                key = f.code[:5] + "x"
+                families[key] = families.get(key, 0) + 1
         return {
             "analyze_clean": report.new == 0,
             "analyze_findings": report.new + report.count("baselined"),
+            "analyze_duration_seconds": round(duration, 3),
+            "analyze_family_counts": dict(sorted(families.items())),
         }
     except Exception as exc:
         log(f"analyzer health check failed: {exc!r}")
@@ -878,6 +891,7 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         "platform": ex.get("platform"),
         "analyze_clean": ex.get("analyze_clean"),
         "analyze_findings": ex.get("analyze_findings"),
+        "analyze_duration_seconds": ex.get("analyze_duration_seconds"),
         "runtime_handshakes_per_sec": (hs.get("pooled") or {}).get(
             "handshakes_per_sec"
         ),

@@ -1,0 +1,36 @@
+# analyze-domain: runtime
+"""Quiet under ACT050: the hardened idioms — swap-to-local before the
+await, latch writes, same-statement re-reads, and atomic counters."""
+import asyncio
+
+
+class Ticker:
+    def __init__(self):
+        self._task = None
+        self._closing = False
+        self._spins = 0
+        self._lag = 0.0
+
+    async def start(self):
+        self._task = asyncio.ensure_future(asyncio.sleep(60))
+
+    async def stop(self):
+        # swap-to-local: the rebind happens in the same statement as the
+        # read, BEFORE any suspension — a second stop() sees None at once
+        task, self._task = self._task, None
+        if task is None:
+            return
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:  # noqa: ACT013 -- fixture: terminal join of an owned task
+            pass
+
+    async def run_once(self):
+        if self._closing:
+            return
+        self._closing = True  # latch: last pre-await access is a WRITE
+        await asyncio.sleep(0)
+        self._spins += 1  # atomic RMW of the binding, never a stale pair
+        # same-statement re-read: the pre-await value is NOT consumed
+        self._lag = max(0.0, self._lag * 0.5)

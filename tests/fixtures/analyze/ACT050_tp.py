@@ -1,0 +1,22 @@
+# analyze-domain: runtime
+"""Deliberate ACT050: the non-reentrant teardown shape — guard read,
+await, then a rebind that acts on the stale pre-await read."""
+import asyncio
+
+
+class Ticker:
+    def __init__(self):
+        self._task = None
+
+    async def start(self):
+        self._task = asyncio.ensure_future(asyncio.sleep(60))
+
+    async def stop(self):
+        if self._task is None:  # read ...
+            return
+        self._task.cancel()
+        try:
+            await self._task  # ... suspension ...
+        except asyncio.CancelledError:  # noqa: ACT013 -- fixture: terminal join of an owned task
+            pass
+        self._task = None  # ACT050: ... stale rebind (2nd stop() races here)

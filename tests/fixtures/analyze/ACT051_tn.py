@@ -1,0 +1,33 @@
+# analyze-domain: runtime
+"""Quiet under ACT051: finally-covered guard resets, a pure latch, and
+every mutation of the lock-protected field inside its section."""
+import asyncio
+
+
+class Worker:
+    def __init__(self):
+        self._busy = False
+        self._closed = False
+        self._lock = asyncio.Lock()
+        self._count = 0
+
+    async def run(self):
+        if self._busy:
+            return
+        self._busy = True
+        try:
+            await asyncio.sleep(0)
+        finally:
+            self._busy = False  # covering finally: reset survives cancel
+
+    async def close(self):
+        self._closed = True  # latch: never reset — not a guard
+        await asyncio.sleep(0)
+
+    async def bump(self):
+        async with self._lock:
+            self._count = self._count + 1
+
+    async def reset(self):
+        async with self._lock:
+            self._count = 0

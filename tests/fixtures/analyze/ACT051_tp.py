@@ -1,0 +1,23 @@
+# analyze-domain: runtime
+"""Deliberate ACT051: a flag guard that leaks across an await (reset
+not finally-covered), and a lock-protected field mutated unlocked."""
+import asyncio
+
+
+class Worker:
+    def __init__(self):
+        self._busy = False
+        self._lock = asyncio.Lock()
+        self._count = 0
+
+    async def run(self):
+        self._busy = True  # ACT051: guard held across await, reset below
+        await asyncio.sleep(0)
+        self._busy = False  # ... is not in a covering finally
+
+    async def bump(self):
+        async with self._lock:
+            self._count = self._count + 1
+
+    async def sneak(self):
+        self._count = 0  # ACT051: written unlocked, guarded in bump()

@@ -1,0 +1,48 @@
+# analyze-domain: runtime
+"""Quiet under ACT052: every borrow settles on every exit path (finally
+release, discard-on-error, ownership transfer), inc/dec in finally."""
+import asyncio
+
+
+class ConnectionPool:
+    async def acquire(self):
+        return object()
+
+    def release(self, conn):
+        pass
+
+    def discard(self, conn):
+        pass
+
+
+class Client:
+    def __init__(self):
+        self._pool = ConnectionPool()
+        self._inflight = 0
+
+    async def fetch(self, query):
+        conn = await self._pool.acquire()
+        try:
+            return await asyncio.sleep(0, result=query)
+        finally:
+            self._pool.release(conn)  # covers the early return too
+
+    async def borrow(self):
+        conn = await self._pool.acquire()
+        return conn  # ownership transferred to the caller
+
+    async def probe(self):
+        conn = await self._pool.acquire()
+        try:
+            await asyncio.sleep(0)
+        except OSError:
+            self._pool.discard(conn)
+            raise
+        self._pool.release(conn)
+
+    async def handle(self, req):
+        self._inflight += 1
+        try:
+            await asyncio.sleep(0)
+        finally:
+            self._inflight -= 1

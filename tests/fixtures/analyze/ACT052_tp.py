@@ -1,0 +1,34 @@
+# analyze-domain: runtime
+"""Deliberate ACT052: a pool borrow that leaks on an early-return path,
+and an inflight counter whose decrement isn't finally-covered."""
+import asyncio
+
+
+class ConnectionPool:
+    async def acquire(self):
+        return object()
+
+    def release(self, conn):
+        pass
+
+    def discard(self, conn):
+        pass
+
+
+class Client:
+    def __init__(self):
+        self._pool = ConnectionPool()
+        self._inflight = 0
+
+    async def fetch(self, query):
+        conn = await self._pool.acquire()  # ACT052: leaks on the early return
+        rows = await asyncio.sleep(0, result=query)
+        if not rows:
+            return None  # exit path with `conn` unsettled
+        self._pool.release(conn)
+        return rows
+
+    async def handle(self, req):
+        self._inflight += 1  # ACT052: dec below isn't finally-covered
+        await asyncio.sleep(0)
+        self._inflight -= 1

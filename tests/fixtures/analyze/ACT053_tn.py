@@ -1,0 +1,38 @@
+# analyze-domain: runtime
+"""Quiet under ACT053: broad handlers that account for the failure
+(re-raise, log, count) and narrow handlers that name what they eat."""
+import asyncio
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class Pump:
+    def __init__(self, metrics):
+        self._metrics = metrics
+
+    async def run(self):
+        while True:
+            try:
+                await asyncio.sleep(0)
+            except Exception:
+                log.exception("pump step failed")
+
+    async def drain(self):
+        try:
+            await asyncio.sleep(0)
+        except Exception:
+            self._metrics.inc("drain_errors")
+
+    async def step(self):
+        try:
+            await asyncio.sleep(0)
+        except Exception:
+            log.debug("step failed, rolling back")
+            raise
+
+    async def poll(self):
+        try:
+            await asyncio.sleep(0)
+        except (OSError, ValueError):  # narrow: names what it eats
+            return None

@@ -1,0 +1,19 @@
+# analyze-domain: runtime
+"""Deliberate ACT053: broad handlers on the hot path that absorb
+failures without re-raising, logging, or counting."""
+import asyncio
+
+
+class Pump:
+    async def run(self):
+        while True:
+            try:
+                await asyncio.sleep(0)
+            except Exception:  # ACT053: silent absorption
+                pass
+
+    async def drain(self):
+        try:
+            await asyncio.sleep(0)
+        except:  # ACT053: bare except, not even CancelledError escapes  # noqa: ACT013 -- fixture: the bare-except shape IS the ACT053 violation under test
+            return None

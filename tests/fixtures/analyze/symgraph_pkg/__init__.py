@@ -1,0 +1,7 @@
+"""Symbol-graph fixture package: re-exports (plain and aliased) that
+tests/test_analyze.py resolves through with exact assertions."""
+
+from .base import ConnectionPool as Pool
+from .base import Widget
+
+__all__ = ["Pool", "Widget"]

@@ -1,0 +1,15 @@
+"""Absolute import through a re-export, a relative module import, and
+an aliased stdlib import — all feeding self.* field-type inference."""
+
+import asyncio as aio
+
+from symgraph_pkg import Widget
+
+from . import base
+
+
+class Api:
+    def __init__(self):
+        self._lock = aio.Lock()
+        self._w = Widget()
+        self._pool = base.ConnectionPool()

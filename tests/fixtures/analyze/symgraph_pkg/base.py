@@ -1,0 +1,17 @@
+"""The defining module every other fixture import chain must land on."""
+
+
+class Widget:
+    def __init__(self):
+        self.label = "w"
+
+
+class ConnectionPool:
+    async def acquire(self):
+        return object()
+
+    def release(self, conn):
+        pass
+
+    def discard(self, conn):
+        pass

@@ -1,0 +1,14 @@
+"""``from x import y as z`` and an aliased dotted module import."""
+
+import symgraph_pkg.base as b
+
+from .base import Widget as W
+
+from symgraph_pkg import Pool
+
+
+class Client:
+    def __init__(self):
+        self._w = W()
+        self._pool = b.ConnectionPool()
+        self._spare = Pool()

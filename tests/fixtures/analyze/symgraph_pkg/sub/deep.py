@@ -1,0 +1,8 @@
+"""Level-2 relative import: resolved against the subpackage's parent."""
+
+from ..base import Widget
+
+
+class Deep:
+    def __init__(self):
+        self._w = Widget()

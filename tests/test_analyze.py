@@ -60,9 +60,9 @@ def test_true_negative_fixture_is_quiet(code):
     assert code not in got, f"{f.name} must not trigger {code}"
 
 
-def test_registry_spans_all_four_families():
+def test_registry_spans_all_families():
     prefixes = {c[:5] for c in CODES}
-    assert {"ACT00", "ACT01", "ACT02", "ACT03"} <= prefixes
+    assert {"ACT00", "ACT01", "ACT02", "ACT03", "ACT04", "ACT05"} <= prefixes
     assert len(CODES) >= 10
 
 
@@ -471,3 +471,333 @@ def test_annotation_string_still_credits_import(tmp_path):
         """,
     )
     assert not any(f.code == "ACT002" for f in findings(p))
+
+
+# -- the whole-repo symbol graph (tools/analyze/symbols.py) -------------------
+
+
+SYMPKG = CORPUS / "symgraph_pkg"
+
+
+@pytest.fixture(scope="module")
+def symgraph():
+    from tools.analyze.symbols import SymbolGraph
+
+    contexts = [
+        load_context(p) for p in sorted(SYMPKG.rglob("*.py"))
+    ]
+    return SymbolGraph.build(contexts)
+
+
+def test_symbol_graph_discovers_package_modules(symgraph):
+    assert set(symgraph.modules) == {
+        "symgraph_pkg",
+        "symgraph_pkg.api",
+        "symgraph_pkg.base",
+        "symgraph_pkg.client",
+        "symgraph_pkg.sub",
+        "symgraph_pkg.sub.deep",
+    }
+
+
+@pytest.mark.parametrize(
+    "module, name, expect",
+    [
+        # absolute import through the package __init__ re-export
+        ("symgraph_pkg.api", "Widget", "symgraph_pkg.base.Widget"),
+        # `from . import base` relative module import, then attribute
+        ("symgraph_pkg.api", "base.ConnectionPool",
+         "symgraph_pkg.base.ConnectionPool"),
+        # `from .base import Widget as W` aliased relative import
+        ("symgraph_pkg.client", "W", "symgraph_pkg.base.Widget"),
+        # `import symgraph_pkg.base as b` aliased dotted module import
+        ("symgraph_pkg.client", "b.ConnectionPool",
+         "symgraph_pkg.base.ConnectionPool"),
+        # re-export under a NEW name: `from .base import ConnectionPool
+        # as Pool` in __init__, imported as `from symgraph_pkg import Pool`
+        ("symgraph_pkg.client", "Pool", "symgraph_pkg.base.ConnectionPool"),
+        # level-2 relative import from a subpackage
+        ("symgraph_pkg.sub.deep", "Widget", "symgraph_pkg.base.Widget"),
+        # a name defined in its own module resolves to itself
+        ("symgraph_pkg.base", "Widget", "symgraph_pkg.base.Widget"),
+    ],
+)
+def test_symbol_graph_resolves_import_chains(symgraph, module, name, expect):
+    assert symgraph.resolve(module, name) == expect
+
+
+def test_symbol_graph_infers_self_field_types(symgraph):
+    api = symgraph.modules["symgraph_pkg.api"].classes["Api"]
+    assert {a: i.type for a, i in api.attrs.items()} == {
+        "_lock": "asyncio.Lock",
+        "_w": "symgraph_pkg.base.Widget",
+        "_pool": "symgraph_pkg.base.ConnectionPool",
+    }
+    client = symgraph.modules["symgraph_pkg.client"].classes["Client"]
+    assert client.attrs["_w"].type == "symgraph_pkg.base.Widget"
+    assert client.attrs["_pool"].type == "symgraph_pkg.base.ConnectionPool"
+    # the aliased re-export chain feeds ctor inference too
+    assert client.attrs["_spare"].type == "symgraph_pkg.base.ConnectionPool"
+
+
+def test_symbol_graph_lock_type_recognized(symgraph):
+    from tools.analyze.symbols import LOCK_TYPES
+
+    api = symgraph.modules["symgraph_pkg.api"].classes["Api"]
+    assert api.attrs["_lock"].type in LOCK_TYPES
+
+
+def test_two_phase_engine_feeds_rules_the_whole_repo_graph():
+    from tools.analyze import rules_concurrency as rc
+    from tools.analyze.symbols import SymbolGraph
+
+    contexts = [load_context(p) for p in sorted(SYMPKG.rglob("*.py"))]
+    graph = SymbolGraph.build(contexts)
+    for ctx in contexts:
+        ctx.symbols = graph  # what analyze_paths phase 2 does
+    assert rc._graph(contexts[0]) is graph
+    # analyze-file-alone (fixture tests) falls back to a 1-file graph:
+    # cross-module chains are gone, same-file facts survive
+    solo = load_context(SYMPKG / "api.py")
+    assert solo.symbols is None
+    solo_graph = rc._graph(solo)
+    assert solo_graph is not graph
+    assert solo.symbols is solo_graph  # cached on the context
+    # the corpus is excluded from directory walks; explicit file paths
+    # still go through the two-phase engine
+    report = analyze_paths(sorted(SYMPKG.rglob("*.py")))
+    assert report.files == 6
+
+
+# -- the per-function CFG (tools/analyze/flow.py) -----------------------------
+
+
+def _cfg_of(src: str):
+    import ast
+
+    from tools.analyze.flow import build_cfg
+
+    tree = ast.parse(textwrap.dedent(src))
+    func = next(
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.AsyncFunctionDef, ast.FunctionDef))
+    )
+    return build_cfg(func)
+
+
+def _event_kinds(cfg) -> list:
+    out = []
+    for b in cfg.blocks:
+        for ev in b.events:
+            if ev[0] in ("await", "self_read", "self_write", "self_rw"):
+                out.append(ev[:2] if ev[0] != "await" else (ev[0],))
+    return out
+
+
+def test_cfg_orders_reads_before_awaits_before_writes():
+    cfg = _cfg_of(
+        """\
+        async def step(self):
+            self.x = await self.fetch(self.x)
+        """
+    )
+    kinds = _event_kinds(cfg)
+    assert ("self_read", "x") in kinds
+    assert ("await",) in kinds
+    assert ("self_write", "x") in kinds
+    flat = [k for k in kinds if k != ("self_read", "fetch")]
+    assert flat.index(("self_read", "x")) < flat.index(("await",))
+    assert flat.index(("await",)) < flat.index(("self_write", "x"))
+
+
+def test_cfg_augassign_is_a_single_rw_event():
+    cfg = _cfg_of(
+        """\
+        def bump(self):
+            self.n += 1
+        """
+    )
+    kinds = _event_kinds(cfg)
+    assert kinds.count(("self_rw", "n")) == 1
+    assert ("self_write", "n") not in kinds
+
+
+def test_cfg_finally_covers_early_return():
+    # the finally body must be reachable from the early return, so a
+    # dataflow over the CFG sees the release on EVERY path out
+    cfg = _cfg_of(
+        """\
+        async def io(self):
+            try:
+                if self.fast:
+                    return 1
+                await self.slow()
+            finally:
+                self.done = True
+        """
+    )
+    writes = [
+        b.id
+        for b in cfg.blocks
+        for ev in b.events
+        if ev[0] == "self_write" and ev[1] == "done"
+    ]
+    # duplicated per path: early-return inline + normal + exceptional
+    assert len(writes) >= 2
+
+
+def test_cfg_async_for_and_async_with_are_suspension_points():
+    cfg = _cfg_of(
+        """\
+        async def drain(self, it, lock):
+            async with lock:
+                async for item in it:
+                    self.last = item
+        """
+    )
+    kinds = _event_kinds(cfg)
+    assert kinds.count(("await",)) >= 3  # aenter, iteration, aexit
+
+
+def test_dataflow_reaches_fixpoint_on_a_loop():
+    from tools.analyze.flow import dataflow
+
+    cfg = _cfg_of(
+        """\
+        async def pump(self):
+            while self.alive:
+                await self.tick()
+                self.beat = 1
+        """
+    )
+
+    def transfer(state, block):
+        for ev in block.events:
+            if ev[0] == "await":
+                state["awaits"] = min(state.get("awaits", 0) + 1, 5)
+        return state
+
+    def merge(a, b):
+        return {"awaits": max(a.get("awaits", 0), b.get("awaits", 0))}
+
+    states = dataflow(cfg, {"awaits": 0}, transfer, merge)
+    # the back edge re-enters the loop header with awaits > 0, and the
+    # bounded lattice terminates the fixpoint instead of diverging
+    assert states[cfg.exit].get("awaits", 0) >= 1
+
+
+# -- SARIF output (--format sarif) --------------------------------------------
+
+
+def test_sarif_round_trip(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa=""))
+    proc = run_cli("--format", "sarif", "--no-baseline", str(p))
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "aiocluster-analyze"
+    assert {r["id"] for r in driver["rules"]} == set(CODES)
+    results = run["results"]
+    assert any(r["ruleId"] == "ACT010" for r in results)
+    for r in results:
+        assert r["level"] in ("error", "note")
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"]
+        region = loc["region"]
+        assert region["startLine"] >= 1 and region["startColumn"] >= 1
+        assert r["message"]["text"]
+
+
+def test_sarif_results_match_text_findings(tmp_path):
+    p = _write(tmp_path, BLOCKING.format(noqa=""))
+    expected = [
+        (f.code, f.line) for f in findings(p) if f.status == "new"
+    ]
+    proc = run_cli("--format", "sarif", "--no-baseline", str(p))
+    doc = json.loads(proc.stdout)
+    got = [
+        (r["ruleId"],
+         r["locations"][0]["physicalLocation"]["region"]["startLine"])
+        for r in doc["runs"][0]["results"]
+        if "suppressions" not in r
+    ]
+    assert sorted(got) == sorted(expected)
+
+
+def test_sarif_suppressed_findings_carry_suppressions(tmp_path):
+    p = _write(
+        tmp_path, BLOCKING.format(noqa="  # noqa: ACT010 -- fixture")
+    )
+    proc = run_cli("--format", "sarif", "--no-baseline", str(p))
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    sup = [
+        r for r in doc["runs"][0]["results"]
+        if r["ruleId"] == "ACT010"
+    ]
+    assert sup and sup[0]["suppressions"][0]["kind"] == "inSource"
+
+
+# -- the --only-family fast path ----------------------------------------------
+
+
+def test_only_family_act05x_fast_path_is_clean():
+    proc = run_cli("--only-family", "ACT05x", "aiocluster_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_only_family_restricts_rules(tmp_path):
+    # an ACT010 violation is invisible to the ACT05x family run
+    p = _write(tmp_path, BLOCKING.format(noqa=""))
+    proc = run_cli(
+        "--only-family", "ACT05x", "--no-baseline", "--format", "json", str(p)
+    )
+    assert proc.returncode == 0
+    data = json.loads(proc.stdout)
+    assert data["findings"] == []
+    assert {r["code"][:5] for r in data["rules"]} == {"ACT05"}
+
+
+def test_only_family_unknown_exits_2_with_hint():
+    proc = run_cli("--only-family", "ACT99x", "bench.py")
+    assert proc.returncode == 2
+    assert "unknown rule family" in proc.stderr
+    assert "ACT05x" in proc.stderr  # the hint lists the known families
+
+
+def test_only_family_conflicts_with_select():
+    proc = run_cli(
+        "--only-family", "ACT05x", "--select", "ACT010", "bench.py"
+    )
+    assert proc.returncode == 2
+
+
+# -- ratchet: the committed baseline is empty and stays empty -----------------
+
+
+def test_committed_baseline_is_empty():
+    """The burn-down is DONE: every historical finding was either fixed
+    or justify-suppressed in source. The baseline must never grow again
+    — a new finding is fixed or suppressed with a reason, not
+    grandfathered. This assert is the ratchet."""
+    data = json.loads(
+        (REPO / "tools" / "analyze" / "baseline.json").read_text()
+    )
+    assert data["schema"] == "aiocluster-analyze-baseline/1"
+    assert data["findings"] == []
+
+
+def test_analyze_gate_duration_budget():
+    """The full two-phase gate (parse + symbol graph + all families over
+    the repo) must stay interactive: < 10 s. bench.py stamps the same
+    number as analyze_duration_seconds in every BENCH record."""
+    import time
+
+    t0 = time.perf_counter()
+    report = run_default()
+    elapsed = time.perf_counter() - t0
+    assert report.files > 50  # sanity: the gate actually walked the repo
+    assert elapsed < 10.0, f"analyze gate took {elapsed:.2f}s (budget 10s)"

@@ -1,0 +1,127 @@
+"""Regressions for the await-interleaving races the ACT05x analyzer
+surfaced (docs/static-analysis.md "ACT05x"): every lifecycle field that
+was guard-read before an await and rebound after it now uses the
+swap-to-local idiom, and Cluster.start() latches before its bind
+suspends (with rollback on a failed boot).
+
+Each test here pins one fixed true positive: the pre-fix code either
+performed the guarded side effect twice (double bind, double join) or
+wedged a retryable object (a failed start leaving ``_started`` latched).
+"""
+
+import asyncio
+
+import pytest
+
+from aiocluster_tpu import Cluster, Config, NodeId
+from aiocluster_tpu.runtime.hooks import HookDispatcher
+from aiocluster_tpu.runtime.ticker import Ticker
+from aiocluster_tpu.serve.hub import WatchHub
+
+
+def _config(name: str, port: int) -> Config:
+    return Config(
+        node_id=NodeId(name=name, gossip_advertise_addr=("127.0.0.1", port)),
+        gossip_interval=0.05,
+        seed_nodes=[],
+        cluster_id="act05x-regress",
+    )
+
+
+async def test_concurrent_start_binds_exactly_once(free_port):
+    """cluster.py start(): pre-fix, ``_started`` was only set AFTER the
+    bind await, so two start() calls racing through the suspension both
+    passed the guard and bound the listener twice (the second one dying
+    on EADDRINUSE). The latch now commits before the bind suspends."""
+    c = Cluster(_config("solo", free_port))
+    real = c._transport.start_server
+    calls = 0
+
+    async def slow_start(*args, **kwargs):
+        nonlocal calls
+        calls += 1
+        await asyncio.sleep(0.05)  # widen the pre-fix race window
+        return await real(*args, **kwargs)
+
+    c._transport.start_server = slow_start
+    try:
+        await asyncio.gather(c.start(), c.start(), c.start())
+        assert calls == 1
+    finally:
+        await c.close()
+
+
+async def test_failed_start_rolls_back_the_latch(free_port):
+    """The early latch must not wedge a failed boot: a bind error rolls
+    ``_started`` back so the same Cluster object stays retryable."""
+    c = Cluster(_config("retry", free_port))
+    real = c._transport.start_server
+
+    async def refuse(*args, **kwargs):
+        raise OSError(98, "address already in use")
+
+    c._transport.start_server = refuse
+    with pytest.raises(OSError):
+        await c.start()
+    assert not c._started
+
+    c._transport.start_server = real
+    await c.start()
+    assert c._started
+    await c.close()
+
+
+async def test_concurrent_stop_server_closes_once(free_port):
+    """cluster.py _stop_server(): close() and leave() both call it; the
+    second caller must see the swapped-out None, not re-close a server
+    the first caller is still awaiting."""
+    c = Cluster(_config("stopper", free_port))
+    await c.start()
+    assert c._server is not None
+    await asyncio.gather(c._stop_server(), c._stop_server())
+    assert c._server is None
+    await c.close()
+
+
+async def test_concurrent_ticker_stop_completes_cleanly():
+    ticks = 0
+
+    async def tick():
+        nonlocal ticks
+        ticks += 1
+
+    t = Ticker(tick, 0.01)
+    t.start()
+    await asyncio.sleep(0.03)
+    # Pre-fix, a second stop() read the still-set ``_task`` after the
+    # first stop's cancel suspended, and cancelled/joined it again.
+    await asyncio.gather(t.stop(), t.stop(), t.stop())
+    assert t.closed
+    assert ticks >= 1
+
+
+async def test_concurrent_hook_dispatcher_stop_joins_worker_once():
+    fired = []
+
+    d = HookDispatcher(8, shutdown_timeout=1.0)
+    d.start()
+    d.emit((lambda *a: fired.append(a),), ("evt",))
+    await asyncio.sleep(0.01)
+    await asyncio.gather(d.stop(), d.stop())
+    assert d._worker is None
+    assert fired  # the drain ran before the join
+
+
+async def test_concurrent_watch_hub_stop():
+    class _IdleCache:
+        def epoch_now(self):
+            return 0
+
+        def get(self):  # pragma: no cover - idle pump must not encode
+            raise AssertionError("idle pump called get()")
+
+    hub = WatchHub(_IdleCache(), poll_interval=0.01)
+    hub.start()
+    await asyncio.sleep(0.02)
+    await asyncio.gather(hub.stop(), hub.stop())
+    assert hub._pump_task is None

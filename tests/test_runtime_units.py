@@ -245,6 +245,6 @@ def test_engine_heartbeats_feed_failure_detector():
     bob = engine_for(N2)
     # Two exchanges with increasing heartbeats → bob has an interval sample.
     for _ in range(3):
-        alice._state.node_state_or_default(N1).inc_heartbeat()
+        alice._state.node_state_or_default(N1).inc_heartbeat()  # noqa: ACT031 -- white-box: the test drives alice's own state through her private engine
         bob.handle_syn(alice.make_syn())
     assert bob._state.node_state(N1).heartbeat > 0

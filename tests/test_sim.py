@@ -374,8 +374,8 @@ def test_sim_matches_object_model_convergence_shape():
     a, b = NodeId("a", 1, ("h", 1)), NodeId("b", 2, ("h", 2))
     cs_a, cs_b = ClusterState(), ClusterState()
     for i in range(5):
-        cs_a.node_state_or_default(a).set(f"k{i}", "v", ts=t)
-        cs_b.node_state_or_default(b).set(f"k{i}", "v", ts=t)
+        cs_a.node_state_or_default(a).set(f"k{i}", "v", ts=t)  # noqa: ACT031 -- white-box: the test plays owner a to build the differential fixture
+        cs_b.node_state_or_default(b).set(f"k{i}", "v", ts=t)  # noqa: ACT031 -- white-box: the test plays owner b to build the differential fixture
     delta_for_a = cs_b.compute_partial_delta_respecting_mtu(
         cs_a.compute_digest(set()), 65_507, set()
     )
@@ -787,7 +787,7 @@ def test_sim_matches_object_model_at_matched_mtu():
                      max_payload_size=MTU)
         cs = ClusterState()
         ns = cs.node_state_or_default(nodes[idx])
-        ns.heartbeat = 1
+        ns.heartbeat = 1  # noqa: ACT030 -- white-box: fabricating a packed-codec fixture state, not gossiping it
         for j in range(K):
             ns.set_with_version(f"key-{j:03d}", f"val-{j:03d}", j + 1, ts=ts)
         return GossipEngine(cfg, cs, FailureDetector(FailureDetectorConfig()))

@@ -186,7 +186,7 @@ def test_reset_delta_wipes_replica_state():
 
     # Owner-side packer decides to reset (peer_max=2 < watermark=3).
     cs = ClusterState()
-    cs._node_states[N1] = owner
+    cs._node_states[N1] = owner  # noqa: ACT032 -- white-box: seeding the container directly to exercise the public surface
     d = Digest()
     d.add_node(N1, heartbeat=1, last_gc_version=0, max_version=2)
     delta = cs.compute_partial_delta_respecting_mtu(d, 65_507, set())
@@ -203,10 +203,10 @@ def test_reset_delta_wipes_replica_state():
 
 def test_apply_delta_skips_deletes_covered_by_watermark():
     ns = NodeState(N1)
-    ns.last_gc_version = 10
+    ns.last_gc_version = 10  # noqa: ACT030 -- white-box: fabricating GC watermarks to test the digest path
     nd = delta_for(N1, [KeyValueUpdate("a", "", 8, VersionStatusEnum.DELETED)])
     # version 8 <= watermark 10 and it's a tombstone: never installed.
-    ns.max_version = 5
+    ns.max_version = 5  # noqa: ACT030 -- white-box: fabricating GC watermarks to test the digest path
     ns.apply_delta(nd, ts=T0)
     assert "a" not in ns.key_values
 

@@ -118,8 +118,8 @@ def test_generated_class_bytes_decode_through_codec(pb):
     nd.node_id.generation_id = 42
     nd.node_id.gossip_advertise_addr.host = "h"
     nd.node_id.gossip_advertise_addr.port = 1234
-    nd.heartbeat = 5
-    nd.max_version = 8
+    nd.heartbeat = 5  # noqa: ACT030 -- white-box: fabricating a codec fixture, never gossiped
+    nd.max_version = 8  # noqa: ACT030 -- white-box: fabricating a codec fixture, never gossiped
     d = msg.synack.delta.node_deltas.add()
     d.node_id.name = "gen-node"
     d.node_id.generation_id = 42
@@ -130,7 +130,7 @@ def test_generated_class_bytes_decode_through_codec(pb):
     kv.value = "v"
     kv.version = 8
     kv.status = pb.VersionStatus.DELETE_AFTER_TTL
-    d.max_version = 8
+    d.max_version = 8  # noqa: ACT030 -- white-box: fabricating a codec fixture, never gossiped
 
     decoded = decode_packet(msg.SerializeToString(deterministic=True))
     assert decoded.cluster_id == "gen"

@@ -1,11 +1,17 @@
 """Dependency-free, AST-based static analysis for this repo.
 
-Four rule families over one shared parse per file (docs/static-analysis.md):
+Five rule families over one shared parse per file (docs/static-analysis.md):
 
 - ACT00x  style/imports (the old tools/lint.py, now a shim over this)
 - ACT01x  async-safety for the runtime backend's event loop
 - ACT02x  JAX purity / tracer discipline for the sim backend
 - ACT03x  the paper's owner-write invariant around core/kvstate.py
+- ACT05x  flow-sensitive concurrency: await-interleaving races detected
+          on per-function CFGs over a whole-repo symbol graph
+
+The engine is two-phase: a collect pass parses every file once and
+builds the symbol graph (tools/analyze/symbols.py); the analyze pass
+runs the rules over the same parses with the graph attached.
 
 Inline suppression: ``# noqa: ACT012 -- justification``. Pre-existing
 findings are grandfathered in tools/analyze/baseline.json; only NEW

@@ -1,8 +1,10 @@
-"""Command-line front end: text/JSON output, baseline handling, exit codes.
+"""Command-line front end: text/JSON/SARIF output, baseline handling,
+exit codes.
 
 Exit codes: 0 = clean (every finding suppressed or baselined),
 1 = new findings, 2 = usage error. The JSON schema is stable
-(``aiocluster-analyze/1``) and covered by tests/test_analyze.py.
+(``aiocluster-analyze/1``), the SARIF output is 2.1.0 (for CI
+annotation surfaces), and both are covered by tests/test_analyze.py.
 """
 
 from __future__ import annotations
@@ -17,6 +19,16 @@ from .core import RULES, Rule
 from .engine import Report, analyze_paths, selected_rules
 
 JSON_SCHEMA = "aiocluster-analyze/1"
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def known_families() -> dict[str, str]:
+    """family label (e.g. ``ACT05x``) -> rule-code prefix."""
+    return {f"{code[:5]}x": code[:5] for code in sorted(RULES)}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -27,7 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
         "See docs/static-analysis.md.",
     )
     p.add_argument("paths", nargs="*", help=".py files or directories")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     p.add_argument(
         "--baseline",
         type=Path,
@@ -48,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--select", default=None, metavar="PREFIX[,PREFIX]",
         help="only run rules whose code matches a prefix (e.g. ACT01,ACT02)",
+    )
+    p.add_argument(
+        "--only-family", default=None, metavar="FAMILY",
+        help="fast path for one rule family by its catalogue label "
+        "(e.g. ACT05x); unknown families are a usage error (exit 2)",
     )
     p.add_argument(
         "--include-corpus", action="store_true",
@@ -85,6 +102,61 @@ def report_json(report: Report, rules: list[Rule]) -> dict:
                 "status": f.status,
             }
             for f in report.findings
+        ],
+    }
+
+
+def report_sarif(report: Report, rules: list[Rule]) -> dict:
+    """SARIF 2.1.0 — the CI-annotation interchange shape. Suppressed and
+    baselined findings are carried with a ``suppressions`` entry so the
+    viewer shows them struck-through rather than losing them."""
+    results = []
+    for f in report.findings:
+        res = {
+            "ruleId": f.code,
+            "level": "error" if f.status == "new" else "note",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": max(1, f.col + 1),
+                        },
+                    }
+                }
+            ],
+        }
+        if f.status != "new":
+            res["suppressions"] = [
+                {
+                    "kind": "inSource" if f.status == "suppressed" else "external",
+                    "justification": f.status,
+                }
+            ]
+        results.append(res)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "aiocluster-analyze",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": [
+                            {
+                                "id": r.code,
+                                "name": r.name,
+                                "shortDescription": {"text": r.summary},
+                            }
+                            for r in sorted(rules, key=lambda r: r.code)
+                        ],
+                    }
+                },
+                "results": results,
+            }
         ],
     }
 
@@ -129,6 +201,28 @@ def main(argv: list[str] | None = None) -> int:
         print("usage: python -m tools.analyze PATH...", file=sys.stderr)
         return 2
     select = tuple(s.strip() for s in args.select.split(",")) if args.select else None
+    if args.only_family:
+        if select:
+            print(
+                "analyze: --only-family and --select are two spellings of "
+                "the same filter — pass one",
+                file=sys.stderr,
+            )
+            return 2
+        families = known_families()
+        label = args.only_family.strip()
+        prefix = families.get(label) or families.get(f"{label.upper()}")
+        if prefix is None and label.upper() in families.values():
+            prefix = label.upper()  # accept the bare prefix spelling too
+        if prefix is None:
+            print(
+                f"analyze: unknown rule family {label!r} — known families: "
+                + ", ".join(sorted(families))
+                + " (see docs/static-analysis.md)",
+                file=sys.stderr,
+            )
+            return 2
+        select = (prefix,)
     if args.write_baseline and select:
         # A narrowed run would REPLACE the baseline with its subset,
         # silently un-grandfathering every other family's findings.
@@ -167,6 +261,8 @@ def main(argv: list[str] | None = None) -> int:
     rules = selected_rules(select)
     if args.format == "json":
         print(json.dumps(report_json(report, rules), indent=1))
+    elif args.format == "sarif":
+        print(json.dumps(report_sarif(report, rules), indent=1))
     else:
         report_text(report, rules)
     return 1 if report.new else 0

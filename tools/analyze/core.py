@@ -16,6 +16,8 @@ Code families (docs/static-analysis.md has the full catalogue):
           its keyspace" rule)
 - ACT04x  observability / trace-event discipline (literal event kinds —
           the twin replay dispatcher routes on them)
+- ACT05x  flow-sensitive concurrency (await-interleaving races, on the
+          whole-repo symbol graph + per-function CFGs; empty baseline)
 """
 
 from __future__ import annotations
@@ -99,6 +101,10 @@ class FileContext:
     suppressions: dict[int, set[str] | None]  # line -> codes (None=blanket)
     domains: set[str]
     import_map: dict[str, str]  # local binding -> dotted origin
+    #: SymbolGraph attached by the two-phase engine (analyze_paths)
+    #: after the collect pass; None means "analyze this file alone" —
+    #: flow-sensitive rules then build a single-file graph on demand.
+    symbols: object | None = None
 
     def finding(self, node: ast.AST | int, code: str, message: str) -> Finding:
         if isinstance(node, int):

@@ -14,8 +14,17 @@ from collections import Counter
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from . import rules_async, rules_jax, rules_obs, rules_owner, rules_style, rules_wire  # noqa: ACT002 -- imported for rule registration side effects
+from . import (  # noqa: ACT002 -- imported for rule registration side effects
+    rules_async,
+    rules_concurrency,
+    rules_jax,
+    rules_obs,
+    rules_owner,
+    rules_style,
+    rules_wire,
+)
 from .core import RULES, FileContext, Finding, load_context
+from .symbols import SymbolGraph
 
 # Directory suffix of the deliberate-violation fixture corpus: analyzing
 # it as part of the repo gate would (by design) light up every rule.
@@ -99,9 +108,17 @@ def analyze_paths(
     root: Path | None = None,
 ) -> Report:
     report = Report()
+    # Phase 1 (collect): parse everything once and build the whole-repo
+    # symbol graph, so the flow-sensitive rules resolve imports, class
+    # attribute tables, and self.* field types across file boundaries.
+    contexts: list[FileContext] = []
     for path in iter_py_files(paths, include_corpus=include_corpus):
+        contexts.append(load_context(path, root=root))
+    graph = SymbolGraph.build(contexts)
+    # Phase 2 (analyze): run the selected rules over the same parses.
+    for ctx in contexts:
+        ctx.symbols = graph
         report.files += 1
-        ctx = load_context(path, root=root)
         report.findings.extend(analyze_file(ctx, select))
     return report
 

@@ -1,0 +1,329 @@
+"""Per-function control-flow graphs with await/suspension tracking.
+
+The ACT05x family reasons about *paths*: a read that an ``await``
+separates from the write consuming it, an acquired connection reaching
+a ``return`` unsettled, a decrement that a jump skips. ``build_cfg``
+lowers one function body to basic blocks of ordered **events**:
+
+- ``("stmt", node)``            — a statement begins here (rules that
+  classify whole statements — acquire/settle — scan these)
+- ``("await", node)``           — a suspension point: ``await``, async
+  ``for``/``with`` protocol steps, or a ``yield`` in an async generator
+- ``("self_read", attr, node)`` — ``self.<attr>`` evaluated (Load)
+- ``("self_write", attr, node)``— ``self.<attr>`` rebound (Store)
+- ``("self_rw", attr, node)``   — ``self.<attr> += ...`` (atomic
+  read-modify-write of the binding; never a stale-read hazard per se)
+
+Within one statement events are ordered reads → awaits → writes, which
+matches evaluation order for every assignment shape we care about
+(``self.x = await f(self.y)``) and — crucially — makes a same-statement
+re-read (``x, self.t = self.t, None``) register as *fresh* at its own
+write.
+
+``finally`` bodies are **duplicated** along every path that runs them —
+normal completion, the exception edge, and each ``return``/``break``/
+``continue`` that jumps through them — so a settle-in-finally covers
+every exit the way the runtime actually executes it. Exception edges
+are block-granular: any block of a ``try`` body may hand off to each
+handler. Nested ``def``/``class``/``lambda`` bodies are opaque (they
+run elsewhere, possibly on another thread).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+Event = tuple  # (kind, *payload, node)
+
+
+@dataclass
+class Block:
+    id: int
+    events: list[Event] = field(default_factory=list)
+    succs: list[int] = field(default_factory=list)
+
+
+@dataclass
+class CFG:
+    func: ast.FunctionDef | ast.AsyncFunctionDef
+    blocks: list[Block]
+    entry: int = 0
+    exit: int = 1
+
+    def iter_events(self):
+        for b in self.blocks:
+            for ev in b.events:
+                yield b, ev
+
+
+_SCOPE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _walk_expr(node: ast.AST):
+    """Expression walk that never enters nested scopes (their bodies do
+    not execute at this point in the flow)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _SCOPE):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+class _Builder:
+    def __init__(self, func: ast.FunctionDef | ast.AsyncFunctionDef):
+        self.func = func
+        self.blocks: list[Block] = []
+        self.cur = self._new()   # entry = 0
+        self.exit = self._new()  # exit = 1
+        self.cur = self.blocks[0]
+        # (continue_target, break_target, finally_depth at loop entry)
+        self.loops: list[tuple[Block, Block, int]] = []
+        self.finallies: list[list[ast.stmt]] = []
+
+    # -- graph plumbing ----------------------------------------------------
+    def _new(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    def _edge(self, a: Block, b: Block) -> None:
+        if b.id not in a.succs:
+            a.succs.append(b.id)
+
+    def _start(self, *preds: Block) -> Block:
+        b = self._new()
+        for p in preds:
+            self._edge(p, b)
+        return b
+
+    # -- event extraction --------------------------------------------------
+    def _events_for(self, stmt: ast.stmt, exprs: list[ast.AST]) -> None:
+        """Emit ("stmt", …) then reads → awaits → writes for the given
+        expression roots of one statement."""
+        ev = self.cur.events
+        ev.append(("stmt", stmt))
+        reads: list[Event] = []
+        awaits: list[Event] = []
+        writes: list[Event] = []
+        for root in exprs:
+            for n in _walk_expr(root):
+                if _is_self_attr(n):
+                    if isinstance(n.ctx, ast.Store):
+                        writes.append(("self_write", n.attr, n))
+                    elif isinstance(n.ctx, ast.Load):
+                        reads.append(("self_read", n.attr, n))
+                elif isinstance(n, (ast.Await, ast.Yield, ast.YieldFrom)):
+                    awaits.append(("await", n))
+        if isinstance(stmt, ast.AugAssign) and _is_self_attr(stmt.target):
+            # the binding-level RMW is atomic: drop the separate
+            # read/write halves so it can't read as a stale-read pair
+            writes = [("self_rw", stmt.target.attr, stmt.target)]
+        ev.extend(reads)
+        ev.extend(awaits)
+        ev.extend(writes)
+
+    # -- statement dispatch ------------------------------------------------
+    def emit(self, stmts: list[ast.stmt]) -> None:
+        for s in stmts:
+            self._stmt(s)
+
+    def _stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            # decorators/defaults evaluate here; bodies do not
+            self._events_for(s, list(s.decorator_list))
+        elif isinstance(s, ast.If):
+            self._if(s)
+        elif isinstance(s, ast.While):
+            self._loop(s, header_exprs=[s.test])
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            self._loop(s, header_exprs=[s.iter, s.target],
+                       header_await=isinstance(s, ast.AsyncFor))
+        elif isinstance(s, ast.Try):
+            self._try(s)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            self._with(s)
+        elif isinstance(s, ast.Match):
+            self._match(s)
+        elif isinstance(s, ast.Return):
+            self._events_for(s, [s.value] if s.value else [])
+            self._run_finallies(0)
+            self._edge(self.cur, self.exit)
+            self.cur = self._new()  # unreachable continuation
+        elif isinstance(s, (ast.Break, ast.Continue)):
+            self._events_for(s, [])
+            if self.loops:
+                cont, brk, depth = self.loops[-1]
+                self._run_finallies(depth)
+                self._edge(self.cur, brk if isinstance(s, ast.Break) else cont)
+            self.cur = self._new()
+        elif isinstance(s, ast.Raise):
+            self._events_for(s, [x for x in (s.exc, s.cause) if x])
+            self._run_finallies(0)
+            self._edge(self.cur, self.exit)
+            self.cur = self._new()
+        else:
+            # simple statement: Assign/AnnAssign/AugAssign/Expr/Assert/
+            # Delete/Global/Nonlocal/Pass/Import...
+            self._events_for(s, [s])
+
+    def _if(self, s: ast.If) -> None:
+        self._events_for(s, [s.test])
+        cond = self.cur
+        then = self._start(cond)
+        self.cur = then
+        self.emit(s.body)
+        then_exit = self.cur
+        if s.orelse:
+            els = self._start(cond)
+            self.cur = els
+            self.emit(s.orelse)
+            after = self._start(then_exit, self.cur)
+        else:
+            after = self._start(cond, then_exit)
+        self.cur = after
+
+    def _loop(self, s, *, header_exprs: list, header_await: bool = False) -> None:
+        header = self._start(self.cur)
+        self.cur = header
+        self._events_for(s, [e for e in header_exprs if e is not None])
+        if header_await:
+            header.events.append(("await", s))
+        after = self._new()
+        body = self._start(header)
+        self.loops.append((header, after, len(self.finallies)))
+        self.cur = body
+        self.emit(s.body)
+        self._edge(self.cur, header)  # back edge
+        self.loops.pop()
+        self.cur = self._start(header)
+        if getattr(s, "orelse", None):
+            self.emit(s.orelse)
+        self._edge(self.cur, after)
+        self.cur = after
+
+    def _with(self, s) -> None:
+        self._events_for(s, [it.context_expr for it in s.items]
+                         + [it.optional_vars for it in s.items if it.optional_vars])
+        if isinstance(s, ast.AsyncWith):
+            self.cur.events.append(("await", s))  # __aenter__
+        self.emit(s.body)
+        if isinstance(s, ast.AsyncWith):
+            self.cur.events.append(("await", s))  # __aexit__
+
+    def _match(self, s: ast.Match) -> None:
+        self._events_for(s, [s.subject])
+        subj = self.cur
+        exits = [subj]  # no-case-matches fall-through
+        for case in s.cases:
+            self.cur = self._start(subj)
+            if case.guard is not None:
+                self._events_for(case, [case.guard])
+            self.emit(case.body)
+            exits.append(self.cur)
+        self.cur = self._start(*exits)
+
+    def _try(self, s: ast.Try) -> None:
+        self._events_for(s, [])
+        if s.finalbody:
+            self.finallies.append(s.finalbody)
+        body_first = len(self.blocks)
+        body_entry = self._start(self.cur)
+        self.cur = body_entry
+        self.emit(s.body)
+        body_exit = self.cur
+        body_blocks = self.blocks[body_first:]
+        if s.orelse:
+            self.emit(s.orelse)
+            body_exit = self.cur
+        normal_exits = [body_exit]
+        for h in s.handlers:
+            hb = self._new()
+            for bb in body_blocks:  # an exception can arise in any body block
+                self._edge(bb, hb)
+            self.cur = hb
+            self._events_for(h, [h.type] if h.type else [])
+            self.emit(h.body)
+            normal_exits.append(self.cur)
+        if s.finalbody:
+            self.finallies.pop()
+            # exceptional run of the finally: propagates onward (exit),
+            # through any outer finallies
+            if not s.handlers:
+                exc_fin = self._new()
+                for bb in body_blocks:
+                    self._edge(bb, exc_fin)
+                save = self.cur
+                self.cur = exc_fin
+                self._emit_finally(s.finalbody)
+                self._run_finallies(0)
+                self._edge(self.cur, self.exit)
+                self.cur = save
+            # normal run: falls through to the continuation
+            self.cur = self._start(*normal_exits)
+            self._emit_finally(s.finalbody)
+        else:
+            self.cur = self._start(*normal_exits)
+
+    # -- finally duplication ----------------------------------------------
+    def _emit_finally(self, finalbody: list[ast.stmt]) -> None:
+        """Inline one finally body at the current point. The enclosing
+        finally stack is trimmed so a jump *inside* the finally doesn't
+        re-run it."""
+        try:
+            idx = next(i for i, fb in enumerate(self.finallies) if fb is finalbody)
+            saved = self.finallies
+            self.finallies = self.finallies[:idx]
+        except StopIteration:
+            saved = None
+        self.emit(finalbody)
+        if saved is not None:
+            self.finallies = saved
+
+    def _run_finallies(self, down_to: int) -> None:
+        """Inline every pending finally body above ``down_to``
+        (innermost first) — the path a jump statement actually takes."""
+        for fb in reversed(self.finallies[down_to:]):
+            saved = self.finallies
+            self.finallies = self.finallies[: saved.index(fb)]
+            self.emit(fb)
+            self.finallies = saved
+
+
+def build_cfg(func: ast.FunctionDef | ast.AsyncFunctionDef) -> CFG:
+    b = _Builder(func)
+    b.emit(func.body)
+    b._edge(b.cur, b.exit)
+    return CFG(func=func, blocks=b.blocks)
+
+
+# -- dataflow helpers used by rules_concurrency ------------------------------
+
+def dataflow(cfg: CFG, init, transfer, merge):
+    """Generic forward fixpoint: ``transfer(state, block) -> state``,
+    ``merge(a, b) -> a∪b``. Returns block-entry states."""
+    states = {cfg.entry: init}
+    work = [cfg.entry]
+    while work:
+        bid = work.pop()
+        out = transfer(dict(states[bid]), cfg.blocks[bid])
+        for succ in cfg.blocks[bid].succs:
+            if succ in states:
+                merged = merge(states[succ], out)
+                if merged != states[succ]:
+                    states[succ] = merged
+                    work.append(succ)
+            else:
+                states[succ] = dict(out)
+                work.append(succ)
+    return states

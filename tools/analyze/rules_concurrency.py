@@ -1,0 +1,519 @@
+"""ACT05x — flow-sensitive concurrency analysis (docs/static-analysis.md).
+
+Every hard runtime bug this repo has shipped was an *interleaving* bug:
+read shared state, await (the scheduler runs someone else), then act on
+the stale read. These rules run on the per-function CFGs from flow.py
+and the resolved class/attr tables from symbols.py, scoped to the
+domains where the event loop actually interleaves (``runtime/``,
+``serve/``, ``obs/`` — fixtures opt in with ``# analyze-domain:``).
+
+- ACT050 stale-read-across-await: a shared ``self.<attr>`` is rebound
+  on a path where the most recent access was a READ separated from this
+  write by a suspension point — the non-reentrant teardown/guard shape
+  (``if self._t: ... await ... self._t = None``). Fix by swapping to a
+  local before the await or re-reading after it.
+- ACT051 critical-section discipline: (a) a plain ``self.<flag> = True``
+  guard held across an await whose reset is not in a covering
+  ``finally``; (b) a field that one method mutates under ``async with
+  self.<lock>`` mutated elsewhere outside any such section.
+- ACT052 paired-resource flow: (a) a pool ``acquire()``/``borrow()``
+  result that reaches some exit path neither released, discarded,
+  closed, returned, nor handed off; (b) ``self.<n> += 1`` before an
+  await whose paired ``-= 1`` is not in a covering ``finally``.
+- ACT053 broad-except-on-hot-path: a bare/``Exception`` handler in
+  ``runtime/``/``serve/`` that neither re-raises, logs, nor counts —
+  silent failure absorption in the gossip loop.
+
+The family starts with an EMPTY baseline: every repo finding is fixed
+or carries a justified ``# noqa: ACT05x -- why``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, dotted_name, rule
+from .flow import build_cfg, dataflow, _is_self_attr
+from .symbols import LOCK_TYPES, ClassInfo, SymbolGraph
+
+#: Where the asyncio event loop interleaves this repo's shared state.
+HOT_DOMAINS = frozenset({"runtime", "serve", "obs"})
+#: ACT053's narrower scope: the gossip/serve hot path proper.
+EXC_DOMAINS = frozenset({"runtime", "serve"})
+
+_LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "error", "exception", "critical", "log"}
+)
+_COUNT_METHODS = frozenset(
+    {"inc", "observe", "set", "labels", "note", "_note", "count", "record", "add"}
+)
+_ACQUIRE_METHODS = frozenset({"acquire", "borrow"})
+_SETTLE_SELF_METHODS = frozenset({"close", "release", "aclose", "discard"})
+
+
+def _graph(ctx: FileContext) -> SymbolGraph:
+    """Whole-repo graph when the two-phase engine attached one; a
+    single-file graph otherwise (fixture tests analyze one file)."""
+    if ctx.symbols is None:
+        ctx.symbols = SymbolGraph.build([ctx])
+    return ctx.symbols
+
+
+def _classes(ctx: FileContext) -> list[ClassInfo]:
+    mod = _graph(ctx).by_relpath.get(ctx.relpath)
+    return list(mod.classes.values()) if mod else []
+
+
+def _method_walk(meth: ast.AST):
+    """Walk a method body without entering nested function/class scopes
+    (their statements execute elsewhere)."""
+    stack = list(ast.iter_child_nodes(meth))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _suspensions(meth: ast.AST) -> list[ast.AST]:
+    out = [n for n in _method_walk(meth)
+           if isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))]
+    return out
+
+
+def _try_spans(meth: ast.AST) -> list[tuple[set[int], set[int]]]:
+    """(ids of nodes in body+handlers+orelse, ids in finalbody) for each
+    Try under the method — containment currency for the finally checks."""
+    spans = []
+    for n in _method_walk(meth):
+        if isinstance(n, ast.Try) and n.finalbody:
+            span: set[int] = set()
+            for part in (n.body, n.handlers, n.orelse):
+                for s in part:
+                    span.update(id(x) for x in ast.walk(s))
+            fin: set[int] = set()
+            for s in n.finalbody:
+                fin.update(id(x) for x in ast.walk(s))
+            spans.append((span, fin))
+    return spans
+
+
+def _finally_covers(meth, anchor, awaits_after, resets) -> bool:
+    """True when some ``finally`` contains a reset AND its Try contains
+    either the anchor statement or one of the awaits after it — i.e. the
+    reset runs no matter how the suspended region exits."""
+    for span, fin in _try_spans(meth):
+        if not any(id(r) in fin for r in resets):
+            continue
+        if id(anchor) in span or any(id(a) in span for a in awaits_after):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# ACT050 — stale read across await
+# ---------------------------------------------------------------------------
+
+_NONE, _WRITTEN, _FRESH, _STALE = 0, 1, 2, 3
+
+
+def _act050_transfer(collect):
+    def transfer(state, block):
+        for ev in block.events:
+            kind = ev[0]
+            if kind == "self_read":
+                state[ev[1]] = _FRESH
+            elif kind == "await":
+                for a, v in state.items():
+                    if v == _FRESH:
+                        state[a] = _STALE
+            elif kind == "self_write":
+                if state.get(ev[1], _NONE) == _STALE and collect is not None:
+                    collect.add((ev[1], ev[2]))
+                state[ev[1]] = _WRITTEN
+            elif kind == "self_rw":
+                state[ev[1]] = _WRITTEN
+        return state
+
+    return transfer
+
+
+def _act050_merge(a, b):
+    return {k: max(a.get(k, _NONE), b.get(k, _NONE)) for k in set(a) | set(b)}
+
+
+@rule(
+    "ACT050",
+    "stale-read-across-await",
+    "shared self attribute rebound after an await that follows the read "
+    "it acted on (guard/teardown races: swap to a local before the await)",
+)
+def act050(ctx: FileContext):
+    if ctx.tree is None or not (ctx.domains & HOT_DOMAINS):
+        return
+    for ci in _classes(ctx):
+        for mname, meth in ci.methods.items():
+            if not isinstance(meth, ast.AsyncFunctionDef):
+                continue
+            cfg = build_cfg(meth)
+            states = dataflow(cfg, {}, _act050_transfer(None), _act050_merge)
+            collect: set[tuple[str, ast.AST]] = set()
+            tr = _act050_transfer(collect)
+            for bid, st in states.items():
+                tr(dict(st), cfg.blocks[bid])
+            for attr, node in sorted(collect, key=lambda t: (t[0], t[1].lineno)):
+                info = ci.attrs.get(attr)
+                if info is None or not info.shared:
+                    continue  # single-method attrs have no second party
+                yield ctx.finding(
+                    node,
+                    "ACT050",
+                    f"stale read across await: {ci.qualname}.{mname}() rebinds "
+                    f"self.{attr} after an await that follows the read it "
+                    "acted on — swap to a local before the await or re-read "
+                    "after it",
+                )
+
+
+# ---------------------------------------------------------------------------
+# ACT051 — critical-section discipline
+# ---------------------------------------------------------------------------
+
+def _is_flag_assign(stmt: ast.stmt, value: bool) -> str | None:
+    """attr name when stmt is ``self.<attr> = True/False``."""
+    if (
+        isinstance(stmt, ast.Assign)
+        and len(stmt.targets) == 1
+        and _is_self_attr(stmt.targets[0])
+        and isinstance(stmt.value, ast.Constant)
+        and stmt.value.value is value
+    ):
+        return stmt.targets[0].attr
+    return None
+
+
+@rule(
+    "ACT051",
+    "critical-section-discipline",
+    "flag guard held across an await without a finally reset, or a "
+    "lock-protected field mutated outside its async-with section",
+)
+def act051(ctx: FileContext):
+    if ctx.tree is None or not (ctx.domains & HOT_DOMAINS):
+        return
+    for ci in _classes(ctx):
+        yield from _act051_flags(ctx, ci)
+        yield from _act051_locks(ctx, ci)
+
+
+def _act051_flags(ctx: FileContext, ci: ClassInfo):
+    for mname, meth in ci.methods.items():
+        if not isinstance(meth, ast.AsyncFunctionDef):
+            continue
+        stmts = list(_method_walk(meth))
+        sets = [(s, _is_flag_assign(s, True)) for s in stmts]
+        sets = [(s, a) for s, a in sets if a]
+        if not sets:
+            continue
+        # A reset inside an except handler that re-raises is the
+        # latch-with-ROLLBACK idiom (undo the latch on failure, keep it
+        # on success) — not the guard shape this rule polices.
+        rollback_ids: set[int] = set()
+        for n in _method_walk(meth):
+            if isinstance(n, ast.ExceptHandler) and any(
+                isinstance(x, ast.Raise) for s in n.body for x in ast.walk(s)
+            ):
+                for s in n.body:
+                    rollback_ids.update(id(x) for x in ast.walk(s))
+        resets_by_attr: dict[str, list[ast.stmt]] = {}
+        for s in stmts:
+            a = _is_flag_assign(s, False)
+            if a and id(s) not in rollback_ids:
+                resets_by_attr.setdefault(a, []).append(s)
+        awaits = _suspensions(meth)
+        for set_stmt, attr in sets:
+            resets = resets_by_attr.get(attr)
+            if not resets:
+                continue  # no reset at all: a latch, not a guard
+            after = [a for a in awaits if a.lineno > set_stmt.lineno]
+            if not after:
+                continue
+            if _finally_covers(meth, set_stmt, after, resets):
+                continue
+            yield ctx.finding(
+                set_stmt,
+                "ACT051",
+                f"flag guard leaks across await: {ci.qualname}.{mname}() sets "
+                f"self.{attr} = True, suspends, and resets it outside any "
+                "covering finally — an exception or cancellation leaves the "
+                "guard latched",
+            )
+
+
+def _act051_locks(ctx: FileContext, ci: ClassInfo):
+    lock_attrs = {
+        name
+        for name, a in ci.attrs.items()
+        if (a.type in LOCK_TYPES)
+        or ("lock" in name.lower() and a.written_in_init and a.type is None)
+    }
+    if not lock_attrs:
+        return
+    guarded: dict[str, set[str]] = {}  # field -> lock attrs seen guarding it
+    writes: list[tuple[str, ast.AST, str, bool]] = []  # field, node, meth, locked
+    for mname, meth in ci.methods.items():
+        if mname == "__init__":
+            continue
+        locked_ids: dict[int, str] = {}
+        for n in _method_walk(meth):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for it in n.items:
+                    if _is_self_attr(it.context_expr) and it.context_expr.attr in lock_attrs:
+                        for sub in ast.walk(n):
+                            locked_ids[id(sub)] = it.context_expr.attr
+        for n in _method_walk(meth):
+            field = None
+            if _is_self_attr(n) and isinstance(n.ctx, ast.Store):
+                field = n.attr
+            if field is None or field in lock_attrs:
+                continue
+            lock = locked_ids.get(id(n))
+            if lock is not None:
+                guarded.setdefault(field, set()).add(lock)
+                writes.append((field, n, mname, True))
+            else:
+                writes.append((field, n, mname, False))
+    for field, node, mname, locked in writes:
+        if locked or field not in guarded:
+            continue
+        lock = sorted(guarded[field])[0]
+        yield ctx.finding(
+            node,
+            "ACT051",
+            f"lock-protected field mutated outside its critical section: "
+            f"self.{field} is written under `async with self.{lock}` "
+            f"elsewhere in {ci.qualname} but {mname}() mutates it unlocked",
+        )
+
+
+# ---------------------------------------------------------------------------
+# ACT052 — paired-resource flow
+# ---------------------------------------------------------------------------
+
+def _pool_like(ctx: FileContext, ci: ClassInfo | None, recv: ast.AST) -> bool:
+    graph = _graph(ctx)
+    if _is_self_attr(recv) and ci is not None:
+        t = graph.attr_type(ci, recv.attr)
+        if t:
+            if t.endswith("ConnectionPool") or t.endswith("Pool"):
+                return True
+            target = graph.class_info(t)
+            if target is not None and (
+                target.has_methods("release") or target.has_methods("discard")
+            ):
+                return True
+        return "pool" in recv.attr.lower()
+    d = dotted_name(recv)
+    return bool(d) and "pool" in d.lower()
+
+
+def _acquires(func: ast.AST, ctx: FileContext, ci: ClassInfo | None):
+    """{statement-id: (var, stmt)} for ``v = await <pool>.acquire(...)``."""
+    out: dict[int, tuple[str, ast.stmt]] = {}
+    for n in _method_walk(func):
+        if (
+            isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)
+            and isinstance(n.value, ast.Await)
+            and isinstance(n.value.value, ast.Call)
+            and isinstance(n.value.value.func, ast.Attribute)
+            and n.value.value.func.attr in _ACQUIRE_METHODS
+            and _pool_like(ctx, ci, n.value.value.func.value)
+        ):
+            out[id(n)] = (n.targets[0].id, n)
+    return out
+
+
+def _settles(stmt: ast.AST, var: str) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call):
+            args = list(n.args) + [k.value for k in n.keywords]
+            if any(isinstance(a, ast.Name) and a.id == var for a in args):
+                return True  # released/discarded/handed off
+            if (
+                isinstance(n.func, ast.Attribute)
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == var
+                and n.func.attr in _SETTLE_SELF_METHODS
+            ):
+                return True
+        elif isinstance(n, ast.Return) and n.value is not None:
+            if any(isinstance(x, ast.Name) and x.id == var
+                   for x in ast.walk(n.value)):
+                return True  # ownership transferred to the caller
+        elif isinstance(n, ast.Assign):
+            if isinstance(n.value, ast.Name) and n.value.id == var:
+                return True  # stored/aliased verbatim: ownership moved
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            if any(isinstance(it.context_expr, ast.Name)
+                   and it.context_expr.id == var for it in n.items):
+                return True  # a context manager settles it
+    return False
+
+
+@rule(
+    "ACT052",
+    "paired-resource-flow",
+    "pool borrow not settled (release/discard/transfer) on every exit "
+    "path, or a counter increment whose decrement isn't finally-covered",
+)
+def act052(ctx: FileContext):
+    if ctx.tree is None or not (ctx.domains & HOT_DOMAINS):
+        return
+    graph = _graph(ctx)
+    mod = graph.by_relpath.get(ctx.relpath)
+    funcs: list[tuple[ClassInfo | None, str, ast.AST]] = []
+    if mod:
+        for ci in mod.classes.values():
+            for mname, meth in ci.methods.items():
+                funcs.append((ci, f"{ci.qualname}.{mname}", meth))
+    if ctx.tree:
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.append((None, stmt.name, stmt))
+    for ci, label, func in funcs:
+        if isinstance(func, ast.AsyncFunctionDef):
+            yield from _act052_borrows(ctx, ci, label, func)
+            yield from _act052_counters(ctx, ci, label, func)
+
+
+def _act052_borrows(ctx, ci, label, func):
+    acquires = _acquires(func, ctx, ci)
+    if not acquires:
+        return
+    cfg = build_cfg(func)
+
+    def transfer(state, block):
+        for ev in block.events:
+            if ev[0] != "stmt":
+                continue
+            stmt = ev[1]
+            acq = acquires.get(id(stmt))
+            if acq is not None:
+                state[acq[0]] = 1
+                continue
+            for var, v in list(state.items()):
+                if v and _settles(stmt, var):
+                    state[var] = 0
+        return state
+
+    def merge(a, b):
+        return {k: max(a.get(k, 0), b.get(k, 0)) for k in set(a) | set(b)}
+
+    states = dataflow(cfg, {}, transfer, merge)
+    at_exit = states.get(cfg.exit, {})
+    leaked = {v for v, s in at_exit.items() if s}
+    for var, stmt in acquires.values():
+        if var in leaked:
+            yield ctx.finding(
+                stmt,
+                "ACT052",
+                f"borrowed resource can leak: {label}() binds `{var}` from a "
+                "pool acquire but some exit path neither releases, discards, "
+                "closes, returns, nor hands it off — settle it in a finally",
+            )
+
+
+def _act052_counters(ctx, ci, label, func):
+    incs: list[tuple[ast.AugAssign, str]] = []
+    decs: dict[str, list[ast.stmt]] = {}
+    for n in _method_walk(func):
+        if isinstance(n, ast.AugAssign) and _is_self_attr(n.target):
+            if isinstance(n.op, ast.Add):
+                incs.append((n, n.target.attr))
+            elif isinstance(n.op, ast.Sub):
+                decs.setdefault(n.target.attr, []).append(n)
+    if not incs:
+        return
+    awaits = _suspensions(func)
+    for inc, attr in incs:
+        resets = decs.get(attr)
+        if not resets:
+            continue  # no paired decrement in this function
+        after = [a for a in awaits if a.lineno > inc.lineno]
+        if not after:
+            continue
+        if _finally_covers(func, inc, after, resets):
+            continue
+        yield ctx.finding(
+            inc,
+            "ACT052",
+            f"counter pairing leaks across await: {label}() increments "
+            f"self.{attr}, suspends, and decrements it outside any covering "
+            "finally — an exception leaves the counter high forever",
+        )
+
+
+# ---------------------------------------------------------------------------
+# ACT053 — broad except on the hot path
+# ---------------------------------------------------------------------------
+
+def _broad_handler(t: ast.expr | None) -> str | None:
+    if t is None:
+        return "bare except"
+    if isinstance(t, ast.Tuple):
+        for el in t.elts:
+            got = _broad_handler(el)
+            if got:
+                return got
+        return None
+    d = dotted_name(t)
+    if d in ("Exception", "BaseException") or (
+        d and d.split(".")[-1] in ("Exception", "BaseException")
+    ):
+        return f"except {d}"
+    return None
+
+
+def _handler_accounted(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            meth = n.func.attr
+            if meth == "exception":
+                return True  # logger.exception(...)
+            recv = (dotted_name(n.func.value) or "").lower()
+            if meth in _LOG_METHODS and "log" in recv:
+                return True
+            if meth in _COUNT_METHODS:
+                return True
+    return False
+
+
+@rule(
+    "ACT053",
+    "broad-except-on-hot-path",
+    "bare/Exception handler in runtime//serve/ that neither re-raises, "
+    "logs, nor counts — silent failure absorption in the gossip loop",
+)
+def act053(ctx: FileContext):
+    if ctx.tree is None or not (ctx.domains & EXC_DOMAINS):
+        return
+    for n in ast.walk(ctx.tree):
+        if not isinstance(n, ast.ExceptHandler):
+            continue
+        shape = _broad_handler(n.type)
+        if shape is None:
+            continue
+        if _handler_accounted(n):
+            continue
+        yield ctx.finding(
+            n,
+            "ACT053",
+            f"{shape} on a hot path absorbs failures silently — re-raise, "
+            "log, or count the error (or narrow the exception type)",
+        )

@@ -1,0 +1,275 @@
+"""Whole-repo symbol graph: the collect pass of the two-phase engine.
+
+The per-file tier (ACT00x-ACT04x) matches on naming conventions and a
+single module's import map. The flow-sensitive ACT05x family needs more:
+*which class* an attribute lives on, *which methods* share it, and what
+*type* a ``self.*`` field was constructed with — so a lock is a lock
+because ``__init__`` assigned ``asyncio.Lock()``, not because the name
+contains "lock", and a pool is a pool because the resolved constructor
+is a ``ConnectionPool``.
+
+``SymbolGraph.build(contexts)`` consumes the same already-parsed
+``FileContext`` objects the engine built (one parse per file stays
+true); it never imports the code it audits.
+
+Module naming: a file's dotted module name is derived from its real
+package root — walk up while ``__init__.py`` exists — so
+``aiocluster_tpu/runtime/pool.py`` is ``aiocluster_tpu.runtime.pool``
+and a fixture package under ``tests/fixtures/analyze/`` gets its
+natural short name (``symgraph_pkg.base``). Relative imports resolve
+against that name; ``from x import y`` chains through re-exports to the
+module that actually defines ``y``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core import FileContext, dotted_name
+
+#: Resolved constructor types treated as locks by ACT051 (async-with
+#: discipline) — threading locks included: persist-style sync helpers
+#: share classes with async callers.
+LOCK_TYPES = frozenset({
+    "asyncio.Lock",
+    "asyncio.Condition",
+    "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+})
+
+
+@dataclass
+class AttrInfo:
+    """One ``self.<name>`` field of a class, aggregated over methods."""
+
+    name: str
+    type: str | None = None  # canonical dotted constructor, if inferable
+    written_in_init: bool = False
+    writer_methods: set[str] = field(default_factory=set)
+    reader_methods: set[str] = field(default_factory=set)
+
+    @property
+    def methods(self) -> set[str]:
+        return self.writer_methods | self.reader_methods
+
+    @property
+    def shared(self) -> bool:
+        """Accessed by two or more methods — the precondition for an
+        interleaving hazard (a single-method attr has no second party)."""
+        return len(self.methods) >= 2
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    qualname: str
+    node: ast.ClassDef
+    bases: tuple[str, ...] = ()
+    methods: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = field(
+        default_factory=dict
+    )
+    attrs: dict[str, AttrInfo] = field(default_factory=dict)
+
+    @property
+    def canonical(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+    def has_methods(self, *names: str) -> bool:
+        return all(n in self.methods for n in names)
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    relpath: str
+    package: str  # enclosing package ("" for a top-level module)
+    imports: dict[str, str] = field(default_factory=dict)  # binding -> origin
+    classes: dict[str, ClassInfo] = field(default_factory=dict)  # qualname ->
+    defs: set[str] = field(default_factory=set)  # top-level defined names
+
+
+def module_name_for(path: Path, relpath: str) -> tuple[str, str]:
+    """(module name, enclosing package) for a file, from its real
+    package root: walk up while ``__init__.py`` exists. Falls back to
+    the dotted relpath when the file isn't on disk (unit-test strings).
+    """
+    parts: list[str]
+    if path.name == "__init__.py":
+        parts = []
+        cur = path.parent
+    else:
+        parts = [path.stem]
+        cur = path.parent
+    try:
+        on_disk = (cur / "__init__.py").exists()
+    except OSError:
+        on_disk = False
+    if on_disk:
+        while (cur / "__init__.py").exists() and cur.name:
+            parts.insert(0, cur.name)
+            cur = cur.parent
+    else:
+        rel = relpath[: -len(".py")] if relpath.endswith(".py") else relpath
+        parts = rel.replace("\\", "/").split("/")
+        if parts and parts[-1] == "__init__":
+            parts.pop()
+    name = ".".join(parts) if parts else path.stem
+    if path.name == "__init__.py":
+        return name, name  # a package IS its own import base
+    pkg, _, _ = name.rpartition(".")
+    return name, pkg
+
+
+def _import_map(tree: ast.Module, package: str) -> dict[str, str]:
+    """binding -> dotted origin, with relative imports resolved against
+    the module's enclosing package (the piece core.build_import_map
+    deliberately skips — it has no module identity to resolve against).
+    """
+    imap: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    imap[a.asname] = a.name
+                else:  # ``import a.b`` binds ``a`` (the package root)
+                    root = a.name.partition(".")[0]
+                    imap[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                hops = package.split(".") if package else []
+                if node.level - 1:
+                    hops = hops[: -(node.level - 1)] if node.level - 1 <= len(hops) else []
+                prefix = ".".join(hops)
+                base = f"{prefix}.{node.module}" if node.module else prefix
+            if not base:
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    imap[a.asname or a.name] = f"{base}.{a.name}"
+    return imap
+
+
+def _collect_class(mod: ModuleInfo, node: ast.ClassDef, imap: dict[str, str]) -> ClassInfo:
+    info = ClassInfo(
+        module=mod.name,
+        qualname=node.name,
+        node=node,
+        bases=tuple(filter(None, (dotted_name(b) for b in node.bases))),
+    )
+    for stmt in node.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info.methods[stmt.name] = stmt
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Attribute) and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == "self":
+                attr = info.attrs.setdefault(sub.attr, AttrInfo(sub.attr))
+                if isinstance(sub.ctx, ast.Store):
+                    attr.writer_methods.add(stmt.name)
+                    if stmt.name == "__init__":
+                        attr.written_in_init = True
+                else:
+                    attr.reader_methods.add(stmt.name)
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt = sub.targets[0]
+                if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self" \
+                        and isinstance(sub.value, ast.Call):
+                    ctor = dotted_name(sub.value.func)
+                    if ctor:
+                        attr = info.attrs.setdefault(tgt.attr, AttrInfo(tgt.attr))
+                        if attr.type is None:
+                            attr.type = ctor  # raw; canonicalized in pass 2
+    return info
+
+
+class SymbolGraph:
+    """Modules, classes, and resolved names across one analyzed tree."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_relpath: dict[str, ModuleInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+
+    @classmethod
+    def build(cls, contexts: list[FileContext]) -> "SymbolGraph":
+        g = cls()
+        # Pass 1: module identity, imports, class/attr tables.
+        for ctx in contexts:
+            if ctx.tree is None:
+                continue
+            name, package = module_name_for(ctx.path, ctx.relpath)
+            mod = ModuleInfo(name=name, relpath=ctx.relpath, package=package)
+            mod.imports = _import_map(ctx.tree, package)
+            for stmt in ctx.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    mod.defs.add(stmt.name)
+                elif isinstance(stmt, ast.ClassDef):
+                    mod.defs.add(stmt.name)
+                    mod.classes[stmt.name] = _collect_class(mod, stmt, mod.imports)
+                elif isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            mod.defs.add(t.id)
+            g.modules[name] = mod
+            g.by_relpath[ctx.relpath] = mod
+        # Pass 2: canonical class index + attr constructor types resolved
+        # through the (now complete) import graph.
+        for mod in g.modules.values():
+            for ci in mod.classes.values():
+                g.classes[ci.canonical] = ci
+        for mod in g.modules.values():
+            for ci in mod.classes.values():
+                for attr in ci.attrs.values():
+                    if attr.type:
+                        attr.type = g.resolve(mod.name, attr.type)
+        return g
+
+    def resolve(self, module: str, dotted: str) -> str:
+        """Canonical dotted origin of ``dotted`` as seen from ``module``:
+        chase the module's import map, then re-export chains, until the
+        name lands in the module that defines it (or leaves the graph).
+        """
+        seen: set[tuple[str, str]] = set()
+        cur_mod, cur = module, dotted
+        while (cur_mod, cur) not in seen:
+            seen.add((cur_mod, cur))
+            if not cur:
+                return cur_mod  # the name IS a module (``from . import base``)
+            mod = self.modules.get(cur_mod)
+            if mod is None:
+                return cur
+            root, _, rest = cur.partition(".")
+            if root in mod.defs and root not in mod.imports:
+                return f"{mod.name}.{cur}"
+            origin = mod.imports.get(root)
+            if origin is None:
+                return cur
+            cur = f"{origin}.{rest}" if rest else origin
+            # Re-enter from the module that (transitively) exports it:
+            # the longest known-module prefix of the new dotted path.
+            cur_mod, cur = self._split_known(cur)
+        return cur
+
+    def _split_known(self, dotted: str) -> tuple[str, str]:
+        """(module, remainder-within-module) for the longest known-module
+        prefix; falls back to ("", dotted) when nothing matches."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:i])
+            if prefix in self.modules:
+                return prefix, ".".join(parts[i:])
+        return "", dotted
+
+    def class_info(self, canonical: str) -> ClassInfo | None:
+        return self.classes.get(canonical)
+
+    def attr_type(self, ci: ClassInfo, attr: str) -> str | None:
+        a = ci.attrs.get(attr)
+        return a.type if a else None
