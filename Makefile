@@ -4,7 +4,7 @@
 PY ?= python
 LINT_PATHS = aiocluster_tpu tests benchmarks tools bench.py __graft_entry__.py
 
-.PHONY: test test-all lint analyze analyze-concurrency chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke twin-bench twin-smoke prov-bench prov-smoke wire-bench wire-smoke fleet-bench fleet-smoke check cov protos smoke obs-demo clean
+.PHONY: test test-all lint analyze analyze-concurrency chaos atlas atlas-smoke sweep-bench kernel-parity multihost-smoke serve-bench serve-smoke overload-bench overload-smoke restart-bench restart-smoke vtime-bench vtime-smoke twin-bench twin-smoke prov-bench prov-smoke wire-bench wire-smoke fleet-bench fleet-smoke check cov protos smoke obs-demo clean
 
 # Fast verification loop: everything except tests marked `slow`
 # (interpret-mode Pallas sweeps, multi-device mesh sims, subprocess
@@ -110,6 +110,19 @@ restart-bench:
 restart-smoke:
 	$(PY) benchmarks/restart_bench.py --smoke
 
+# Virtual-time runtime (benchmarks/vtime_bench.py, docs/virtual-time.md):
+# a real loopback fleet on the compressed clock. Full scale drives 200
+# protocol instances through a virtual HOUR and GATES on <= 120 s wall
+# (>= 30x compression), bit-identical seeded chaos replay, and the
+# long-horizon scenario pack (dead-node GC lifecycle, week-long drift,
+# slow-leak churn). The smoke (16 nodes, ten virtual minutes, < 10 s
+# wall) gates CI via `check`.
+vtime-bench:
+	$(PY) benchmarks/vtime_bench.py
+
+vtime-smoke:
+	$(PY) benchmarks/vtime_bench.py --smoke
+
 # Digital twin closed loop (benchmarks/twin_bench.py, docs/twin.md):
 # record a twin-grade trace from a real loopback fleet, replay it
 # through the deterministic sim, fit the runtime<->sim transfer on the
@@ -186,12 +199,14 @@ multihost-smoke:
 # default), a propagation-provenance regression (join coverage,
 # measured-spread keys, staleness-oracle bit parity), a wire
 # data-plane regression (fast-vs-control ratio, encode-call collapse,
-# cache engagement), or a fleet-telemetry regression (view coverage,
-# staleness bound, watermark monotonicity, exact provenance joins)
-# cannot land through this gate. (kernel-parity re-runs one test file that
+# cache engagement), a fleet-telemetry regression (view coverage,
+# staleness bound, watermark monotonicity, exact provenance joins),
+# or a virtual-time regression (compression ratio, bit-identical
+# seeded replay, long-horizon scenario verdicts) cannot land through
+# this gate. (kernel-parity re-runs one test file that
 # test-all also covers — the explicit target keeps the merge gate for
 # kernel work nameable and runnable alone.)
-check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke twin-smoke prov-smoke wire-smoke fleet-smoke test-all
+check: lint analyze kernel-parity sweep-bench multihost-smoke atlas-smoke serve-smoke overload-smoke restart-smoke vtime-smoke twin-smoke prov-smoke wire-smoke fleet-smoke test-all
 
 cov:
 	@$(PY) -c "import pytest_cov" 2>/dev/null \

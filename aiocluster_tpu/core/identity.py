@@ -35,7 +35,7 @@ def next_generation_id() -> int:
     """
     global _last_generation
     with _generation_lock:
-        generation = time.time_ns()
+        generation = time.time_ns()  # noqa: ACT044 -- wall-clock BY CONTRACT: generations order incarnations across process death, which no virtual/seam clock survives (docstring above; vtime soaks bypass via ChaosHarness._next_generation)
         if generation <= _last_generation:
             generation = _last_generation + 1
         _last_generation = generation

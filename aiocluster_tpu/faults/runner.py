@@ -16,18 +16,24 @@ fault benchmark (benchmarks/fault_bench.py) and ad-hoc scenario runs.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import socket
-import time
+from random import Random
 
 from ..core.config import Config
 from ..core.identity import NodeId, next_generation_id
 from ..obs.registry import MetricsRegistry
 from ..runtime.cluster import Cluster
+from ..utils.clock import resolve_clock
+from ..utils.clock import sleep as clock_sleep
 from .plan import FaultPlan
 
 # Crash schedule granularity: how often the harness compares plan time
-# against the crash windows. Fine enough for sub-second scenario steps.
+# against the crash windows. Fine enough for sub-second scenario steps;
+# long-horizon plans (gossip intervals of seconds to minutes under
+# virtual time) coarsen it to interval/4 so an hour-long soak does not
+# spend its wall budget polling an empty schedule.
 _CRASH_POLL_S = 0.02
 
 
@@ -45,12 +51,28 @@ class ChaosHarness:
         persist_root: str | None = None,
         trace=None,
         prov_trace=None,
+        virtual_time: bool = False,
+        seed: int = 0,
+        ports: dict[str, int] | None = None,
     ) -> None:
         self.n_nodes = n_nodes
         self.names = [f"n{i:02d}" for i in range(n_nodes)]
         self._cluster_id = cluster_id
         self._interval = gossip_interval
         self._overrides = config_overrides or {}
+        # Virtual-time mode (docs/virtual-time.md): the harness must be
+        # running under a vtime.VirtualClockLoop (start() checks loudly)
+        # and flips every run-to-run nondeterminism source it owns to a
+        # seeded/deterministic variant: per-node cluster RNGs (jitter,
+        # peer selection, breaker backoff) derive from ``seed``, restart
+        # generations count up from the previous incarnation instead of
+        # stamping wall-clock nanoseconds, and callers pin ``ports`` so
+        # two runs advertise identical peer labels. The clock itself
+        # resolves through the utils.clock seam either way — the
+        # default real-time path is byte-identical to before.
+        self._virtual = virtual_time
+        self._seed = seed
+        self._clock = resolve_clock(None)
         # Twin-grade fleet tracing (docs/twin.md): one shared TraceWriter
         # attached to every member (restarts re-attach) via
         # Cluster.trace_rounds — the recording side of the digital
@@ -75,8 +97,16 @@ class ChaosHarness:
         # BOTH name and "host:port": before a peer's first handshake the
         # cluster state cannot resolve an address to a name, and a
         # name-only partition group would let bootstrap traffic leak
-        # across the cut (see name_groups).
-        self._ports: dict[str, int] = self._free_ports()
+        # across the cut (see name_groups). Replay runs pass ``ports``
+        # (e.g. a previous run's ``harness._ports``) so both runs emit
+        # identical peer labels in flight-recorder/trace streams.
+        if ports is not None:
+            missing = [n for n in self.names if n not in ports]
+            if missing:
+                raise ValueError(f"ports= missing nodes: {missing}")
+            self._ports: dict[str, int] = {n: ports[n] for n in self.names}
+        else:
+            self._ports = self._free_ports()
         # ``plan`` may be a factory taking the harness — the hook for
         # building explicit groups over the fleet's real labels:
         #   ChaosHarness(6, lambda h: split_brain(2, groups=h.name_groups(2)))
@@ -162,6 +192,28 @@ class ChaosHarness:
             os.path.join(self._persist_root, name), ignore_errors=True
         )
 
+    def _node_rng(self, name: str) -> Random | None:
+        """Seeded per-node, per-incarnation RNG under virtual time
+        (startup jitter, gossip target draws, breaker backoff all flow
+        from it); None otherwise — the cluster keeps its own unseeded
+        Random() and the default path is untouched."""
+        if not self._virtual:
+            return None
+        incarnation = len(self.generations.get(name, []))
+        h = hashlib.blake2b(
+            f"{self._seed}|{name}|{incarnation}".encode(), digest_size=8
+        )
+        return Random(int.from_bytes(h.digest(), "big"))
+
+    def _next_generation(self, name: str) -> int:
+        """Generation for an amnesiac reboot: the wall-clock-ns stamp
+        (the reference semantics) normally; under virtual time the
+        previous incarnation plus one — newer-generation-wins needs
+        only ordering, and a wall stamp would differ run to run."""
+        if not self._virtual:
+            return next_generation_id()
+        return max(self.generations.get(name) or [0]) + 1
+
     def _make_cluster(
         self,
         name: str,
@@ -172,6 +224,12 @@ class ChaosHarness:
         seeds = [
             ("127.0.0.1", p) for n, p in self._ports.items() if n != name
         ]
+        if generation is None and self._virtual and (
+            self._persist_root is None or persisted is False
+        ):
+            # No store to decide it: stamp the deterministic incarnation
+            # index (1, 2, ...) instead of identity.py's wall-clock ns.
+            generation = len(self.generations.get(name, [])) + 1
         node_id = (
             NodeId(name=name, gossip_advertise_addr=("127.0.0.1", port))
             if generation is None
@@ -203,6 +261,7 @@ class ChaosHarness:
         cluster = Cluster(
             config,
             initial_key_values={f"from-{name}": name},
+            rng=self._node_rng(name),
             metrics=registry,
         )
         # Static label table for the fault transport: fraction-addressed
@@ -236,13 +295,22 @@ class ChaosHarness:
         return cluster
 
     async def start(self) -> None:
+        if self._virtual:
+            loop = asyncio.get_running_loop()
+            if not getattr(loop, "aiocluster_virtual", False):
+                raise RuntimeError(
+                    "ChaosHarness(virtual_time=True) must run under a "
+                    "vtime.VirtualClockLoop — wrap the scenario in "
+                    "aiocluster_tpu.vtime.run(coro, seed=...) "
+                    "(docs/virtual-time.md)"
+                )
         self.clusters = {name: self._make_cluster(name) for name in self.names}
         # One epoch for the whole fleet, latched BEFORE any boot traffic
         # can lazily start a controller's local clock: every
         # controller's t=0 is the same instant, so windows open and
         # heal simultaneously (explicit epochs also override any lazy
         # latch that sneaks in — see FaultController.start).
-        self._epoch = time.monotonic()
+        self._epoch = self._clock.monotonic()
         for cluster in self.clusters.values():
             ctl = cluster.fault_controller
             if ctl is not None:
@@ -275,7 +343,7 @@ class ChaosHarness:
 
     def elapsed(self) -> float:
         assert self._epoch is not None, "harness not started"
-        return time.monotonic() - self._epoch
+        return self._clock.monotonic() - self._epoch
 
     # -- crash/restart driver -------------------------------------------------
 
@@ -338,7 +406,7 @@ class ChaosHarness:
                             if warm
                             else self._make_cluster(
                                 name,
-                                generation=next_generation_id(),
+                                generation=self._next_generation(name),
                                 persisted=False,
                             )
                         )
@@ -362,7 +430,7 @@ class ChaosHarness:
                         f"{'close' if down else 'restart'} failed "
                         f"(retrying next poll): {exc!r}"
                     )
-            await asyncio.sleep(_CRASH_POLL_S)
+            await clock_sleep(max(_CRASH_POLL_S, self._interval / 4))
 
     async def restart_node(
         self, name: str, recovery: str = "amnesia", *, graceful: bool = False
@@ -389,7 +457,7 @@ class ChaosHarness:
             self._make_cluster(name, generation=None)
             if recovery == "warm"
             else self._make_cluster(
-                name, generation=next_generation_id(), persisted=False
+                name, generation=self._next_generation(name), persisted=False
             )
         )
         ctl = new.fault_controller
@@ -447,12 +515,12 @@ class ChaosHarness:
     async def wait_converged(self, timeout: float = 30.0) -> float:
         """Poll until :meth:`converged`; returns how long it took.
         Raises TimeoutError when the deadline passes."""
-        start = time.monotonic()
+        start = self._clock.monotonic()
         deadline = start + timeout
-        while time.monotonic() < deadline:
+        while self._clock.monotonic() < deadline:
             if self.converged():
-                return time.monotonic() - start
-            await asyncio.sleep(self._interval / 2)
+                return self._clock.monotonic() - start
+            await clock_sleep(self._interval / 2)
         raise TimeoutError(f"fleet did not converge within {timeout}s")
 
     def propagation_report(self, *, key: str | None = None):

@@ -34,12 +34,13 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import time
 import weakref
 from collections.abc import Callable
 from dataclasses import dataclass
 
 from ..obs.registry import MetricsRegistry
+from ..utils.clock import Clock, resolve_clock
+from ..utils.clock import sleep as clock_sleep
 from .plan import FaultPlan
 
 # Operation labels the transport wrapper reports; part of the hash
@@ -59,10 +60,11 @@ class Decision:
 class FaultController:
     """Deterministic fault schedule for one node (see module docstring).
 
-    ``clock`` defaults to ``time.monotonic``; tests inject a fake. The
-    epoch is latched by :meth:`start` (the ChaosHarness synchronises one
-    epoch across a fleet so partitions heal simultaneously) or lazily on
-    the first decision.
+    ``clock`` defaults to the ambient ``utils.clock`` seam (real
+    monotonic, or the loop's virtual clock under ``vtime``); tests
+    inject a ``ManualClock``. The epoch is latched by :meth:`start` (the
+    ChaosHarness synchronises one epoch across a fleet so partitions
+    heal simultaneously) or lazily on the first decision.
     """
 
     def __init__(
@@ -71,11 +73,11 @@ class FaultController:
         self_name: str,
         *,
         metrics: MetricsRegistry | None = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Clock | None = None,
     ) -> None:
         self._plan = plan
         self._self = self_name
-        self._clock = clock
+        self._clock = resolve_clock(clock)
         self._t0: float | None = None
         self._op_index: dict[tuple[str, str], int] = {}
         self._injected = self._partition_gauge = None
@@ -105,11 +107,11 @@ class FaultController:
         if epoch is not None:
             self._t0 = epoch
         elif self._t0 is None:
-            self._t0 = self._clock()
+            self._t0 = self._clock.monotonic()
 
     def elapsed(self) -> float:
         self.start()
-        return self._clock() - self._t0
+        return self._clock.monotonic() - self._t0
 
     # -- deterministic draws --------------------------------------------------
 
@@ -507,7 +509,7 @@ class FaultyTransport:
         if delay <= 0:
             return await make_coro()
         async def delayed():
-            await asyncio.sleep(delay)
+            await clock_sleep(delay)
             return await make_coro()
         return await asyncio.wait_for(delayed(), timeout=budget)
 

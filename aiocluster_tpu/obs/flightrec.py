@@ -16,17 +16,20 @@ rendered to dicts only at ``dump()``. The ring is bounded by
 construction, so a chatty subsystem can age out history but never grow
 memory.
 
-Timestamps carry BOTH clocks: ``t_mono`` (``time.monotonic`` — the
-clock the provenance tracer and serve_bench subtract across processes
-on loopback fleets) and ``ts`` (wall — what the operator correlates
-with their logs).
+Timestamps carry BOTH clocks: ``t_mono`` (monotonic — the clock the
+provenance tracer and serve_bench subtract across processes on loopback
+fleets) and ``ts`` (wall — what the operator correlates with their
+logs). Both come from the ``utils.clock`` seam, so a recorder living in
+a virtual-time run (docs/virtual-time.md) stamps virtual instants — the
+byte-identical-replay currency of tests/test_vtime.py.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
+
+from ..utils.clock import Clock, resolve_clock
 
 # Default ring capacity. A gossip round produces O(fanout) handshake
 # events, so 512 covers minutes of quiet operation and the last dozens
@@ -37,10 +40,16 @@ DEFAULT_CAPACITY = 512
 class FlightRecorder:
     """Bounded ring buffer of (t_mono, ts, kind, fields) events."""
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        *,
+        clock: Clock | None = None,
+    ) -> None:
         if capacity < 1:
             raise ValueError("flight recorder capacity must be >= 1")
         self.capacity = capacity
+        self._clock = resolve_clock(clock)
         self._ring: deque[tuple[float, float, str, dict]] = deque(
             maxlen=capacity
         )
@@ -51,7 +60,7 @@ class FlightRecorder:
 
     def note(self, kind: str, **fields: object) -> None:
         """Record one event. Hot-path safe: no formatting, no I/O."""
-        entry = (time.monotonic(), time.time(), kind, fields)
+        entry = (self._clock.monotonic(), self._clock.wall(), kind, fields)
         with self._lock:
             self._ring.append(entry)
             self.events_noted += 1

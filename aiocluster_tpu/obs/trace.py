@@ -1,7 +1,7 @@
 """JSONL trace writer: one JSON object per line, one line per event.
 
 The schema is deliberately open — every record carries ``event`` (the
-record type) and ``ts`` (seconds, ``time.time()``), and the emitter adds
+record type) and ``ts`` (wall seconds via the clock seam), and the emitter adds
 whatever scalar fields describe the event (docs/observability.md lists
 the event types both backends emit). JSONL keeps the file greppable,
 streamable, and loadable with one ``read_trace`` call or a pandas
@@ -26,9 +26,10 @@ from __future__ import annotations
 import io
 import json
 import threading
-import time
 from dataclasses import dataclass, field
 from pathlib import Path
+
+from ..utils.clock import Clock, resolve_clock
 
 # Version of the trace record vocabulary. Bump ONLY on a change that
 # would make an old consumer mis-read new records (renamed fields,
@@ -40,10 +41,14 @@ TRACE_SCHEMA = "aiocluster-trace/1"
 class TraceWriter:
     """Append-only JSONL event sink. Usable as a context manager."""
 
-    def __init__(self, path: str | Path) -> None:
+    def __init__(self, path: str | Path, *, clock: Clock | None = None) -> None:
         self.path = Path(path)
         self._fh: io.TextIOBase | None = self.path.open("a", encoding="utf-8")
         self._lock = threading.Lock()
+        # ``ts`` comes from the clock seam: real wall time by default,
+        # the virtual wall under a vtime loop (docs/virtual-time.md) —
+        # which is what makes twin traces replay bit-identically there.
+        self._clock = resolve_clock(clock)
         self.events_written = 0
         # A fresh (empty) file self-describes before any event lands;
         # appending to a non-empty trace keeps its original header.
@@ -53,7 +58,7 @@ class TraceWriter:
     def emit(self, event: str, **fields: object) -> None:
         """Write one record; silently drops events after close() (late
         callbacks during shutdown must not raise into the event loop)."""
-        record = {"event": event, "ts": round(time.time(), 6), **fields}
+        record = {"event": event, "ts": round(self._clock.wall(), 6), **fields}
         line = json.dumps(record, separators=(",", ":"), default=str)
         with self._lock:
             if self._fh is None:

@@ -9,7 +9,6 @@ applications port without changes.
 from __future__ import annotations
 
 import asyncio
-import time
 from asyncio import StreamReader, StreamWriter
 from collections.abc import Awaitable, Callable, Sequence
 from contextlib import suppress
@@ -46,7 +45,7 @@ from ..obs.fleet import (
 from ..obs.flightrec import FlightRecorder
 from ..obs.registry import MetricsRegistry, default_registry
 from ..obs.trace import TraceWriter
-from ..utils.clock import utc_now
+from ..utils.clock import resolve_clock, utc_now
 from ..utils.logging import node_logger
 from ..wire import native as wire_native
 from ..wire.proto import encode_trace_context
@@ -116,6 +115,13 @@ class Cluster:
         trace: TraceWriter | None = None,
     ) -> None:
         self._rng = rng if rng is not None else Random()
+        # The one clock this node reads (utils/clock.py): ambient —
+        # real time by default, the loop's virtual clock when running
+        # under vtime (docs/virtual-time.md). Round durations, RTT
+        # samples, pool idle stamps, flight-recorder timestamps and
+        # provenance t_mono all come from here so they compress (and
+        # replay) together.
+        self._clock = resolve_clock(None)
 
         # Telemetry (obs/): every subsystem reports through one registry —
         # the process default unless the caller injects its own (tests and
@@ -130,7 +136,7 @@ class Cluster:
         # lifecycle), dumped post-mortem via flight_record() and the
         # serve tier's /debug/flightrec. note() is two clock reads and
         # a deque append; nothing formats until a dump is asked for.
-        self._flightrec = FlightRecorder()
+        self._flightrec = FlightRecorder(clock=self._clock)
         self._lifecycle_events = self._metrics.counter(
             "aiocluster_lifecycle_events_total",
             "Node lifecycle events: rejoin_clean (warm rejoin, previous "
@@ -232,6 +238,7 @@ class Cluster:
             on_key_change=self._emit_key_change,
             metrics=self._metrics,
             flightrec=self._flightrec,
+            clock=self._clock,
         )
         # Zero-copy wire data plane (wire/segments.py): when on (the
         # default), handshake steps below route through the
@@ -310,6 +317,15 @@ class Cluster:
             self._health = HealthTracker(
                 adaptive=config.adaptive_timeouts,
                 breaker=config.circuit_breaker,
+                # An injected cluster rng is the determinism signal
+                # (ChaosHarness virtual-time soaks): derive the breaker
+                # backoff rng from it so the whole node is one seed.
+                # Default (rng=None) keeps the tracker's own Random().
+                rng=(
+                    Random(self._rng.getrandbits(64))
+                    if rng is not None
+                    else None
+                ),
                 k=config.adaptive_timeout_k,
                 min_timeout=config.adaptive_timeout_min,
                 max_timeout=config.read_timeout,
@@ -334,6 +350,7 @@ class Cluster:
             ),
             idle_timeout=config.pool_idle_timeout,
             metrics=self._metrics,
+            clock=self._clock,
             on_dial=(
                 None
                 if self._health is None or not config.adaptive_timeouts
@@ -1105,7 +1122,7 @@ class Cluster:
                     node=self._config.node_id.name,
                     key=key,
                     version=new_vv.version,
-                    t_mono=round(time.monotonic(), 6),
+                    t_mono=round(self._clock.monotonic(), 6),
                 )
             self._emit_key_change(self.self_node_id, key, old_vv, new_vv)
 
@@ -1141,7 +1158,7 @@ class Cluster:
     # -- gossip round (initiator) --------------------------------------------
 
     async def _gossip_round(self) -> None:
-        round_start = time.perf_counter()
+        round_start = self._clock.monotonic()
         tls_names: dict[Address, str | None] = {
             n.gossip_advertise_addr: n.tls_name
             for n in self._cluster_state.nodes()
@@ -1242,7 +1259,7 @@ class Cluster:
             await asyncio.gather(*handshakes)
 
         self._update_liveness()
-        duration = time.perf_counter() - round_start
+        duration = self._clock.monotonic() - round_start
         self._round_seconds.observe(duration)
         if self._round_durations is not None:
             # Telemetry's round-latency window (p50/p99 ride the next
@@ -1364,7 +1381,7 @@ class Cluster:
                         connect_timeout=budget,
                     )
                     reused = conn.reused
-                    rtt_start = time.perf_counter()
+                    rtt_start = self._clock.monotonic()
                     if syn_parts is not None:
                         await self._transport.write_framed_parts(
                             conn.writer, syn_parts, "syn", timeout=budget
@@ -1381,7 +1398,7 @@ class Cluster:
                         # (Karn's rule holds: timed-out reads never
                         # reach this line).
                         health.record_rtt(
-                            addr, time.perf_counter() - rtt_start
+                            addr, self._clock.monotonic() - rtt_start
                         )
                     if isinstance(reply.msg, BadCluster):
                         self._log.warning(

@@ -10,8 +10,6 @@ JAX sim backend vectorises.
 
 from __future__ import annotations
 
-import time
-
 from ..core.cluster_state import ClusterState
 from ..core.config import Config
 from ..core.failure import FailureDetector
@@ -22,6 +20,7 @@ from ..core.messages import Ack, BadCluster, Delta, Digest, Packet, Syn, SynAck
 from ..obs.flightrec import FlightRecorder
 from ..obs.registry import MetricsRegistry
 from ..obs.trace import TraceWriter
+from ..utils.clock import Clock, resolve_clock
 from ..wire import encode_packet
 from ..wire.segments import (
     SegmentStore,
@@ -48,11 +47,16 @@ class GossipEngine:
         on_key_change: KeyChangeFn | None = None,
         metrics: MetricsRegistry | None = None,
         flightrec: FlightRecorder | None = None,
+        clock: Clock | None = None,
     ) -> None:
         self._config = config
         self._state = cluster_state
         self._fd = failure_detector
         self._on_key_change = on_key_change
+        # Provenance t_mono stamps come from the shared clock seam so
+        # they join flight-recorder/trace timestamps on one axis (and
+        # compress under vtime).
+        self._clock = resolve_clock(clock)
         # Post-mortem ring (obs/flightrec.py): guard rejections and
         # non-trivial applies are the engine's notable events.
         self._flightrec = flightrec
@@ -378,7 +382,7 @@ class GossipEngine:
         schema, no Delta object required."""
         if to_peer is None:
             return
-        t_mono = round(time.monotonic(), 6)
+        t_mono = round(self._clock.monotonic(), 6)
         node = self._config.node_id.name
         for owner, refs in kv_refs:
             for key, version in refs:
@@ -426,7 +430,7 @@ class GossipEngine:
         which the collector joins to the initiator's ``prov_send``
         records. ``hsid`` (the wire handshake id) rides the record when
         known, correlating it with both nodes' flight recorders."""
-        t_mono = round(time.monotonic(), 6)
+        t_mono = round(self._clock.monotonic(), 6)
         node = self._config.node_id.name
         for nd in delta.node_deltas:
             owner = nd.node_id.name
@@ -461,7 +465,7 @@ class GossipEngine:
         applies against."""
         if to_peer is None:
             return
-        t_mono = round(time.monotonic(), 6)
+        t_mono = round(self._clock.monotonic(), 6)
         node = self._config.node_id.name
         for nd in delta.node_deltas:
             owner = nd.node_id.name

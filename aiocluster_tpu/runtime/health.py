@@ -36,18 +36,21 @@ breaker's quarantine to a per-round peer-selection mask
 (faults/sim.quarantine_mask) so fleet-scale scenarios stay
 differentially comparable.
 
-All time is ``time.monotonic`` unless a clock is injected (the
-determinism seam for transition tests, like FaultController).
+All time flows through the ``utils.clock.Clock`` seam (the SAME seam
+FaultController and the pool use): real monotonic by default, a
+``ManualClock`` in transition tests, the loop's virtual clock under
+``vtime`` — which is how breaker backoff windows compress with
+everything else (docs/virtual-time.md).
 """
 
 from __future__ import annotations
 
 import math
-import time
 from collections.abc import Callable
 from random import Random
 
 from ..obs.registry import MetricsRegistry
+from ..utils.clock import Clock, resolve_clock
 
 # Breaker states, exported as the aiocluster_breaker_state gauge value.
 CLOSED, OPEN, HALF_OPEN = 0, 1, 2
@@ -132,7 +135,7 @@ class HealthTracker:
         base_backoff: float = 2.0,
         max_backoff: float = 64.0,
         rng: Random | None = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Clock | None = None,
         metrics: MetricsRegistry | None = None,
         on_transition: Callable[[Address, str], None] | None = None,
     ) -> None:
@@ -145,7 +148,7 @@ class HealthTracker:
         self._base_backoff = max(1e-6, base_backoff)
         self._max_backoff = max(self._base_backoff, max_backoff)
         self._rng = rng if rng is not None else Random()
-        self._clock = clock
+        self._clock = resolve_clock(clock)
         self._rtt: dict[Address, PeerRtt] = {}
         self._breakers: dict[Address, PeerBreaker] = {}
         # Transition hook beyond metrics: the cluster's flight recorder
@@ -228,8 +231,8 @@ class HealthTracker:
         b = self._breakers.get(addr)
         if b is None or b.state not in (OPEN, HALF_OPEN):
             return
-        if self._clock() >= b.open_until:
-            b.open_until = self._clock() + self._base_backoff
+        if self._clock.monotonic() >= b.open_until:
+            b.open_until = self._clock.monotonic() + self._base_backoff
             self._set_state(addr, b, HALF_OPEN)
 
     def record_success(self, addr: Address) -> None:
@@ -256,7 +259,7 @@ class HealthTracker:
             b.state == CLOSED and b.failures >= self._threshold
         ):
             self._open(addr, b)
-        elif b.state == OPEN and self._clock() >= b.open_until:
+        elif b.state == OPEN and self._clock.monotonic() >= b.open_until:
             # A non-probe path (a dead/seed pick raced the draw) failed
             # after expiry: re-open rather than leaving a stale window.
             self._open(addr, b)
@@ -266,7 +269,7 @@ class HealthTracker:
         b.backoff = min(
             self._max_backoff, self._rng.uniform(self._base_backoff, prev * 3)
         )
-        b.open_until = self._clock() + b.backoff
+        b.open_until = self._clock.monotonic() + b.backoff
         b.opens += 1
         # Force the transition even from OPEN (re-open = new window).
         if b.state == OPEN:
@@ -297,7 +300,7 @@ class HealthTracker:
         Empty when the breaker is disabled."""
         if not self.breaker:
             return set()
-        now = self._clock()
+        now = self._clock.monotonic()
         return {a for a, b in self._breakers.items() if b.quarantined(now)}
 
     def open_peer_labels(self) -> list[str]:

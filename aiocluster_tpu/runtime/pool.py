@@ -30,14 +30,14 @@ unpooled nodes are indistinguishable on the wire.
 
 from __future__ import annotations
 
-import time
 from asyncio import StreamReader, StreamWriter
 from collections import deque
 from collections.abc import Awaitable, Callable
 from contextlib import suppress
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..obs.registry import MetricsRegistry
+from ..utils.clock import Clock, resolve_clock
 
 PoolKey = tuple[str, int, str | None]
 # Dial function; must additionally accept ``timeout=`` when the caller
@@ -53,7 +53,9 @@ class PooledConnection:
     reader: StreamReader
     writer: StreamWriter
     reused: bool = False
-    last_used: float = field(default_factory=time.monotonic)
+    # Stamped by the pool from its clock at dial/release time (0.0 only
+    # for hand-built connections in tests).
+    last_used: float = 0.0
 
     def is_dead(self) -> bool:
         """Best-effort liveness: a peer's processed FIN/RST shows up as
@@ -72,8 +74,10 @@ class ConnectionPool:
         idle_timeout: float = 60.0,
         metrics: MetricsRegistry | None = None,
         on_dial: Callable[[PoolKey, float], None] | None = None,
+        clock: Clock | None = None,
     ) -> None:
         self._connect = connect
+        self._clock = resolve_clock(clock)
         self._max_idle_per_peer = max(0, max_idle_per_peer)
         self._idle_timeout = idle_timeout
         # Dial-latency observer (runtime/health.py): every successful
@@ -154,7 +158,7 @@ class ConnectionPool:
             self._note("hit")
             return conn
         self._note("miss")
-        dial_start = time.monotonic()
+        dial_start = self._clock.monotonic()
         if connect_timeout is None:
             reader, writer = await self._connect(host, port, tls_name)
         else:
@@ -162,9 +166,11 @@ class ConnectionPool:
                 host, port, tls_name, timeout=connect_timeout
             )
         if self._on_dial is not None:
-            self._on_dial(key, time.monotonic() - dial_start)
+            self._on_dial(key, self._clock.monotonic() - dial_start)
         self._track_open(+1)
-        return PooledConnection(key, reader, writer)
+        return PooledConnection(
+            key, reader, writer, last_used=self._clock.monotonic()
+        )
 
     async def release(self, conn: PooledConnection) -> None:
         """Return a healthy connection to the idle pool (closing it
@@ -173,7 +179,7 @@ class ConnectionPool:
         if self._closed or conn.is_dead():
             await self._close_conn(conn, "discarded")
             return
-        conn.last_used = time.monotonic()
+        conn.last_used = self._clock.monotonic()
         conn.reused = False
         queue = self._idle.setdefault(conn.key, deque())
         queue.append(conn)
@@ -195,7 +201,7 @@ class ConnectionPool:
         """Close idle connections unused for ``idle_timeout`` seconds.
         Returns how many were evicted. Cheap when nothing is idle — the
         gossip round calls this once per tick."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         evicted = 0
         for key in list(self._idle):
             queue = self._idle[key]

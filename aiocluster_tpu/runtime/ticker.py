@@ -11,6 +11,7 @@ import asyncio
 from collections.abc import Awaitable, Callable
 
 from ..obs.registry import MetricsRegistry
+from ..utils.clock import sleep as clock_sleep
 
 
 def drift_compensated_timeout(
@@ -71,7 +72,7 @@ class Ticker:
     async def _run(self) -> None:
         loop = asyncio.get_event_loop()
         if self._initial_delay > 0:
-            await asyncio.sleep(self._initial_delay)
+            await clock_sleep(self._initial_delay)
         while not self._stopping:
             started = loop.time()
             try:
@@ -87,7 +88,7 @@ class Ticker:
                 self._seconds.observe(stopped - started)
                 if stopped - started > self._interval:
                     self._overruns.inc()
-            await asyncio.sleep(
+            await clock_sleep(
                 self._timeout_func(self._interval, started, stopped)
             )
 

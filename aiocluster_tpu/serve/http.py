@@ -51,6 +51,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 from ..obs.expo import render_prometheus
 from ..obs.registry import MetricsRegistry
 from ..runtime.cluster import Cluster
+from ..utils.clock import sleep as clock_sleep
 from .cache import SnapshotCache, encode_snapshot, parse_etag
 from .hub import WatchHub
 
@@ -215,7 +216,7 @@ class ServeApp:
         interval = self.overload.probe_interval_s
         while True:
             t0 = loop.time()
-            await asyncio.sleep(interval)
+            await clock_sleep(interval)
             lag = max(0.0, loop.time() - t0 - interval)
             self._lag = max(lag, self._lag * _LAG_DECAY)
             self._lag_gauge.set(self._lag)

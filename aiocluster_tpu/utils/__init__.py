@@ -1,17 +1,33 @@
 """Shared utilities: clock, logging, wire framing, profiling."""
 
-from .clock import utc_now
+from .clock import (
+    CONTEXT_CLOCK,
+    SYSTEM_CLOCK,
+    Clock,
+    ManualClock,
+    SystemClock,
+    current_clock,
+    resolve_clock,
+    utc_now,
+)
 from .framing import frame, read_frame_size, unframe
 from .logging import logger, node_logger
 from .profiling import SectionTimer, device_trace
 
 __all__ = (
+    "CONTEXT_CLOCK",
+    "Clock",
+    "ManualClock",
+    "SYSTEM_CLOCK",
     "SectionTimer",
+    "SystemClock",
+    "current_clock",
     "device_trace",
     "frame",
     "logger",
     "node_logger",
     "read_frame_size",
+    "resolve_clock",
     "unframe",
     "utc_now",
 )

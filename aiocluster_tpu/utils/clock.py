@@ -1,19 +1,169 @@
-"""Time helpers.
+"""The one clock seam (docs/virtual-time.md).
 
-Every time-dependent method in the core takes an optional ``ts`` so tests can
-time-travel instead of sleeping (parity with reference utils.py:5-6 and the
-clock-injection seam described in SURVEY.md §4).
+Every time-dependent method in the core takes an optional ``ts`` so tests
+can time-travel instead of sleeping (parity with reference utils.py:5-6 and
+the clock-injection seam described in SURVEY.md §4). This module widens
+that seam into a single :class:`Clock` protocol shared by every runtime
+clock consumer — phi windows, breaker backoff, adaptive timeouts, TTLs,
+fault windows, pool idle eviction, flight-recorder and trace timestamps —
+so that installing ONE virtual clock (``aiocluster_tpu.vtime``) compresses
+all of them together.
+
+Resolution order, per read:
+
+1. an explicitly injected ``Clock`` (construction parameter), else
+2. the running event loop's ``aiocluster_clock`` attribute (set by
+   ``vtime.VirtualClockLoop``), else
+3. :data:`SYSTEM_CLOCK` (real ``time.monotonic``/``time.time``).
+
+Components that default their clock hold :data:`CONTEXT_CLOCK`, which
+re-resolves on EVERY read — so an object built before the loop exists
+(the common ``Cluster(config)``-then-``await start()`` shape) still picks
+up the virtual clock once it runs under one, and the default real-clock
+path stays byte-identical to the pre-seam code (same ``time.monotonic``
+/ ``time.time`` reads, one dispatch away).
+
+``sleep`` is the sanctioned suspension primitive for runtime/serve/faults
+code (analyzer rule ACT044): it is loop-clock-driven, so it compresses
+under virtual time with no code change at the call sites.
 """
 
 from __future__ import annotations
 
+import asyncio
+import time
 from datetime import datetime, timezone
+from typing import Protocol, runtime_checkable
 
 # ``datetime.UTC`` only exists on Python 3.11+; this alias keeps the whole
 # package (and its tests) importable on 3.10, where it equals timezone.utc.
 UTC = timezone.utc
 
 
+@runtime_checkable
+class Clock(Protocol):
+    """Three views of one instant: a monotonic float for durations and
+    deadlines, a wall float (epoch seconds) for trace records, and an
+    aware UTC datetime for the core's ``ts=`` seams. Implementations
+    must keep the three consistent (``now() == fromtimestamp(wall())``)
+    so mixed consumers agree on ordering."""
+
+    def monotonic(self) -> float:
+        """Seconds on the monotonic axis (durations, deadlines)."""
+        ...
+
+    def wall(self) -> float:
+        """Seconds since the epoch (trace ``ts`` fields)."""
+        ...
+
+    def now(self) -> datetime:
+        """The wall instant as an aware UTC datetime."""
+        ...
+
+
+class SystemClock:
+    """The real clocks, undecorated."""
+
+    __slots__ = ()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def wall(self) -> float:
+        return time.time()
+
+    def now(self) -> datetime:
+        return datetime.now(UTC)
+
+
+SYSTEM_CLOCK = SystemClock()
+
+
+class ManualClock:
+    """The one hand-cranked test clock, replacing the ad-hoc
+    ``lambda: now["t"]`` shims the breaker/pool/fault tests used to
+    carry. Starts at ``start`` and only moves when told to; ``wall()``
+    tracks ``monotonic()`` offset by ``wall_base`` so datetime-facing
+    consumers stay consistent with float-facing ones."""
+
+    __slots__ = ("_t", "wall_base")
+
+    def __init__(self, start: float = 0.0, *, wall_base: float = 0.0) -> None:
+        self._t = float(start)
+        self.wall_base = float(wall_base)
+
+    def monotonic(self) -> float:
+        return self._t
+
+    def wall(self) -> float:
+        return self.wall_base + self._t
+
+    def now(self) -> datetime:
+        return datetime.fromtimestamp(self.wall(), UTC)
+
+    def advance(self, dt: float) -> float:
+        """Move time forward by ``dt`` seconds; returns the new time."""
+        if dt < 0:
+            raise ValueError(f"clocks do not run backwards: advance({dt})")
+        self._t += dt
+        return self._t
+
+    def set_time(self, t: float) -> None:
+        """Jump to absolute monotonic time ``t`` (forward only)."""
+        if t < self._t:
+            raise ValueError(
+                f"clocks do not run backwards: set_time({t}) < {self._t}"
+            )
+        self._t = float(t)
+
+
+def current_clock() -> Clock:
+    """The ambient clock: the running loop's ``aiocluster_clock`` if a
+    loop is running and carries one (``vtime.VirtualClockLoop`` does),
+    else the system clock. Callable from any thread; threads without a
+    running loop read real time."""
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        return SYSTEM_CLOCK
+    return getattr(loop, "aiocluster_clock", None) or SYSTEM_CLOCK
+
+
+class _ContextClock:
+    """Defers resolution to the ambient clock on EVERY read, so one
+    object built before any loop exists follows whichever loop it later
+    runs under. This is what ``resolve_clock(None)`` hands out."""
+
+    __slots__ = ()
+
+    def monotonic(self) -> float:
+        return current_clock().monotonic()
+
+    def wall(self) -> float:
+        return current_clock().wall()
+
+    def now(self) -> datetime:
+        return current_clock().now()
+
+
+CONTEXT_CLOCK = _ContextClock()
+
+
+def resolve_clock(clock: Clock | None) -> Clock:
+    """The constructor-side half of the seam: an injected clock wins;
+    ``None`` means "the ambient clock, re-resolved per read"."""
+    return clock if clock is not None else CONTEXT_CLOCK
+
+
 def utc_now() -> datetime:
-    """Current wall-clock time as an aware UTC datetime."""
-    return datetime.now(UTC)
+    """Current wall-clock time as an aware UTC datetime — through the
+    clock seam, so core TTLs/phi windows/GC grace periods compress
+    under a virtual loop with no call-site changes."""
+    return current_clock().now()
+
+
+async def sleep(delay: float, result: object = None) -> object:
+    """The sanctioned suspension primitive for runtime/serve/faults code
+    (ACT044): identical to ``asyncio.sleep`` — and loop-clock-driven, so
+    it compresses under ``vtime`` — but greppable as the seam."""
+    return await asyncio.sleep(delay, result)

@@ -1,0 +1,22 @@
+"""Virtual-time runtime: a deterministic compressed-clock event loop.
+
+``vtime.run(coro, seed=...)`` executes real asyncio cluster code — real
+sockets, real protocol bytes — under a virtual clock that jumps across
+every idle gap, turning an hour of cluster time into seconds of CPU,
+with seeded same-deadline scheduling so a seeded chaos soak replays
+bit-identically (docs/virtual-time.md; migration.md difference #18).
+"""
+
+from .loop import (
+    DEFAULT_WALL_BASE,
+    VirtualClock,
+    VirtualClockLoop,
+    run,
+)
+
+__all__ = [
+    "DEFAULT_WALL_BASE",
+    "VirtualClock",
+    "VirtualClockLoop",
+    "run",
+]

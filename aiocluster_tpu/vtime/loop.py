@@ -1,0 +1,315 @@
+"""A deterministic virtual-time asyncio event loop.
+
+``VirtualClockLoop`` is a ``SelectorEventLoop`` whose ``time()`` is a
+virtual clock: the idle step *advances the clock to the next scheduled
+callback* instead of sleeping through the gap, while real loopback I/O
+still drains — the selector is polled with a zero timeout (twice, with a
+scheduler yield between, giving the kernel one beat to surface in-flight
+loopback events), and only when no fd is ready and no callback is due
+does virtual time jump. TCP handshakes between co-hosted ``Cluster``
+instances therefore complete at virtual-instant speed, and an hour of
+gossip-interval waiting costs microseconds of wall clock — the
+FoundationDB-style deterministic-simulation posture, applied to the
+asyncio backend (docs/virtual-time.md has the full contract).
+
+Determinism: asyncio's ready queue is FIFO and the timer heap orders by
+deadline — but under a virtual clock, *same-deadline* timers are the
+common case (every ticker armed in one ``gather`` shares an exact float
+deadline), and heap order among equals is an implementation accident.
+``VirtualClockLoop`` therefore schedules every timer through a seeded
+tie-break: each ``call_at`` draws a 64-bit key from a seeded stream, and
+same-deadline timers execute in the seeded permutation. Same seed ⇒ same
+interleaving, bit-identical replay; different seed ⇒ a genuinely
+different legal schedule (the cheapest chaos amplifier there is).
+The fd side gets the same treatment: every non-empty selector batch is
+settled (one scheduler beat for in-flight loopback bytes to surface)
+and returned in canonical fd order, so wake order within a batch is a
+function of the ready SET, never of epoll's internal list order.
+
+What stays real: the fd world. Socket readiness, kernel buffers, and
+worker threads (``asyncio.to_thread``, executor jobs) run in real time —
+virtual time can advance while a thread works, which is exactly the
+documented determinism boundary (keep blocking-thread work out of
+determinism-sensitive soaks; ``ChaosHarness(virtual_time=True)`` does).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import os
+import random
+import selectors
+from datetime import datetime
+
+from ..utils.clock import UTC
+
+__all__ = [
+    "DEFAULT_WALL_BASE",
+    "VirtualClock",
+    "VirtualClockLoop",
+    "run",
+]
+
+# The virtual epoch: a fixed, obviously-synthetic wall base so virtual
+# runs are reproducible run-to-run (a real ``time.time()`` base would
+# leak wall-clock nondeterminism into every trace timestamp).
+# 2020-01-01T00:00:00Z.
+DEFAULT_WALL_BASE = 1_577_836_800.0
+
+
+class VirtualClock:
+    """The loop's clock, satisfying ``utils.clock.Clock``: ``monotonic``
+    is the virtual axis the loop advances, ``wall``/``now`` are the same
+    axis offset by a fixed synthetic epoch. Only the loop's idle step
+    moves it (monotonically — time never runs backwards)."""
+
+    __slots__ = ("_t", "wall_base")
+
+    def __init__(
+        self, start: float = 0.0, *, wall_base: float = DEFAULT_WALL_BASE
+    ) -> None:
+        self._t = float(start)
+        self.wall_base = float(wall_base)
+
+    def monotonic(self) -> float:
+        return self._t
+
+    def wall(self) -> float:
+        return self.wall_base + self._t
+
+    def now(self) -> datetime:
+        return datetime.fromtimestamp(self.wall(), UTC)
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"clocks do not run backwards: advance({dt})")
+        self._t += dt
+        return self._t
+
+
+class _VirtualSelector:
+    """Selector wrapper implementing the idle-step contract.
+
+    ``select(timeout)`` never sleeps through a positive timeout: it
+    polls at zero timeout, yields the OS scheduler one beat and polls
+    once more (in-flight loopback events — an accepted connection, a
+    written buffer — become epoll-visible within that beat), and only
+    then, with the fd world provably quiet, advances virtual time by
+    the full timeout and reports idleness. A ``None`` timeout (no
+    timers scheduled at all) blocks for REAL — the loop is waiting on
+    I/O or a cross-thread wakeup, and spinning would burn a core.
+    """
+
+    __slots__ = ("_real", "_clock")
+
+    def __init__(
+        self, real: selectors.BaseSelector, clock: VirtualClock
+    ) -> None:
+        self._real = real
+        self._clock = clock
+
+    # -- the virtual-time idle step --------------------------------------
+    def select(self, timeout: float | None = None):
+        events = self._real.select(0)
+        if events:
+            return self._settled(events)
+        if timeout is not None and timeout <= 0:
+            return events
+        if timeout is None:
+            # No timers scheduled: there is nothing to advance TO. Wait
+            # for real I/O (or a call_soon_threadsafe self-pipe wakeup).
+            return self._settled(self._real.select(None))
+        os.sched_yield()
+        events = self._real.select(0)
+        if events:
+            return self._settled(events)
+        self._clock.advance(timeout)
+        return []
+
+    def _settled(self, events):
+        """Canonicalize an event batch: one scheduler beat for in-flight
+        stragglers to become epoll-visible, merge, and return in fd
+        order. epoll's ready-list order (and which side of a poll
+        boundary a just-written fd lands on) is kernel timing, not
+        protocol state — without this, two tasks woken "simultaneously"
+        can swap between same-seed runs and break byte-replay."""
+        if not events:
+            return events
+        os.sched_yield()
+        merged = {key.fd: (key, mask) for key, mask in events}
+        for key, mask in self._real.select(0):
+            prev = merged.get(key.fd)
+            merged[key.fd] = (key, mask | (prev[1] if prev else 0))
+        return [merged[fd] for fd in sorted(merged)]
+
+    # -- plain delegation -------------------------------------------------
+    def register(self, fileobj, events, data=None):
+        return self._real.register(fileobj, events, data)
+
+    def unregister(self, fileobj):
+        return self._real.unregister(fileobj)
+
+    def modify(self, fileobj, events, data=None):
+        return self._real.modify(fileobj, events, data)
+
+    def get_key(self, fileobj):
+        return self._real.get_key(fileobj)
+
+    def get_map(self):
+        return self._real.get_map()
+
+    def close(self):
+        return self._real.close()
+
+
+class _SeededTimerHandle(asyncio.TimerHandle):
+    """A TimerHandle whose ordering among same-deadline peers is a
+    seeded 64-bit key instead of heap accident."""
+
+    __slots__ = ("_vtb",)
+
+    def __init__(self, vtb, when, callback, args, loop, context=None):
+        super().__init__(when, callback, args, loop, context)
+        self._vtb = vtb
+
+    def _key(self):
+        return (self._when, self._vtb)
+
+    def __lt__(self, other):
+        when = getattr(other, "_when", None)
+        if when is None:
+            return NotImplemented
+        if self._when != when:
+            return self._when < when
+        return self._vtb < getattr(other, "_vtb", self._vtb)
+
+    def __le__(self, other):
+        lt = self.__lt__(other)
+        if lt is NotImplemented:
+            return NotImplemented
+        return lt or self == other
+
+    def __gt__(self, other):
+        le = self.__le__(other)
+        if le is NotImplemented:
+            return NotImplemented
+        return not le
+
+    def __ge__(self, other):
+        lt = self.__lt__(other)
+        if lt is NotImplemented:
+            return NotImplemented
+        return not lt
+
+
+class VirtualClockLoop(asyncio.SelectorEventLoop):
+    """The deterministic compressed-clock event loop (module docstring
+    has the contract). ``aiocluster_clock`` is the attribute the
+    ``utils.clock`` seam resolves, so every clock consumer in
+    runtime/serve/faults/obs follows this clock automatically."""
+
+    def __init__(
+        self,
+        *,
+        seed: int = 0,
+        start: float = 0.0,
+        wall_base: float = DEFAULT_WALL_BASE,
+    ) -> None:
+        self.vclock = VirtualClock(start, wall_base=wall_base)
+        super().__init__(_VirtualSelector(selectors.DefaultSelector(), self.vclock))
+        self.seed = seed
+        # The seam contract (utils/clock.py): a loop that carries
+        # ``aiocluster_clock`` owns ambient time for code running on it.
+        self.aiocluster_clock = self.vclock
+        self.aiocluster_virtual = True
+        # The tie-break stream: one seeded Mersenne Twister, one 64-bit
+        # draw per scheduled timer. Deterministic across platforms and
+        # runs for a given seed; a different seed permutes every
+        # same-deadline group differently.
+        self._vtb_rng = random.Random(seed)
+
+    def time(self) -> float:
+        return self.vclock.monotonic()
+
+    def call_at(self, when, callback, *args, context=None):
+        """``BaseEventLoop.call_at`` with the seeded tie-break handle —
+        the only scheduling entry point for timers (``call_later`` and
+        every ``asyncio.sleep``/``wait_for`` funnel through here)."""
+        self._check_closed()
+        if self._debug:
+            self._check_thread()
+            self._check_callback(callback, "call_at")
+        timer = _SeededTimerHandle(
+            self._vtb_rng.getrandbits(64), when, callback, args, self, context
+        )
+        if timer._source_traceback:
+            del timer._source_traceback[-1]
+        heapq.heappush(self._scheduled, timer)
+        timer._scheduled = True
+        return timer
+
+
+def _cancel_all_tasks(loop: asyncio.AbstractEventLoop) -> None:
+    # asyncio.runners shape: cancel stragglers, drain them, surface
+    # their exceptions through the loop handler.
+    to_cancel = asyncio.all_tasks(loop)
+    if not to_cancel:
+        return
+    for task in to_cancel:
+        task.cancel()
+    loop.run_until_complete(
+        asyncio.gather(*to_cancel, return_exceptions=True)
+    )
+    for task in to_cancel:
+        if task.cancelled():
+            continue
+        if task.exception() is not None:
+            loop.call_exception_handler(
+                {
+                    "message": "unhandled exception during vtime.run() shutdown",
+                    "exception": task.exception(),
+                    "task": task,
+                }
+            )
+
+
+def run(
+    main,
+    *,
+    seed: int = 0,
+    start: float = 0.0,
+    wall_base: float = DEFAULT_WALL_BASE,
+    debug: bool | None = None,
+):
+    """``asyncio.run``, on a ``VirtualClockLoop``.
+
+    The virtual-time entry point: creates the loop with the given seed
+    and virtual epoch, installs it as the thread's event loop (so
+    libraries that call ``get_event_loop`` inside follow the virtual
+    clock), runs ``main`` to completion, then tears down exactly as
+    ``asyncio.run`` would (cancel stragglers, drain async generators
+    and the default executor, close). Returns ``main``'s result.
+    """
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        pass
+    else:
+        raise RuntimeError(
+            "vtime.run() cannot be called from a running event loop"
+        )
+    loop = VirtualClockLoop(seed=seed, start=start, wall_base=wall_base)
+    try:
+        asyncio.set_event_loop(loop)
+        if debug is not None:
+            loop.set_debug(debug)
+        return loop.run_until_complete(main)
+    finally:
+        try:
+            _cancel_all_tasks(loop)
+            loop.run_until_complete(loop.shutdown_asyncgens())
+            loop.run_until_complete(loop.shutdown_default_executor())
+        finally:
+            asyncio.set_event_loop(None)
+            loop.close()

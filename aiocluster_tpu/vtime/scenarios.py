@@ -1,0 +1,305 @@
+"""Long-horizon scenario pack (docs/virtual-time.md): the soaks that
+only exist because virtual time makes them affordable.
+
+Each scenario is an async function meant to run under
+:func:`aiocluster_tpu.vtime.run` — it boots a real loopback fleet
+(``ChaosHarness(virtual_time=True)``), drives hours-to-days of virtual
+time through it in seconds of wall time, and returns a result dict whose
+``ok`` key is the scenario's own acceptance verdict. They are driven by
+``benchmarks/vtime_bench.py`` (scaled up), ``make vtime-smoke`` (scaled
+down) and tests/test_vtime.py.
+
+The pack covers the bug classes a wall-clock CI can never reach:
+
+- :func:`dead_node_gc_cycles` — a node stays down past the phi
+  detector's dead-node grace period, is garbage-collected from every
+  peer's state, then reboots and must re-join from nothing (the
+  full lifecycle: live -> dead -> FORGOTTEN -> live again).
+- :func:`week_long_drift` — a quiet fleet gossips for days: heartbeat
+  versions, phi windows and virtual wall time all run far past their
+  usual test horizons, and nobody may ever falsely suspect a live peer.
+- :func:`slow_leak_churn` — rolling crash/restart churn for hours; the
+  per-peer state a restart leaves behind (old incarnations, breaker
+  entries, phi samples) must be garbage-collected, not accumulated.
+
+Every fleet here scales the phi configuration with the gossip interval
+— heartbeats arrive once per round, so a detector tuned for 1 s rounds
+would declare the whole fleet dead at a 60 s round cadence.
+"""
+
+from __future__ import annotations
+
+from datetime import timedelta
+
+from ..core.config import FailureDetectorConfig
+from ..faults.plan import FaultPlan, NodeCrash
+from ..faults.runner import ChaosHarness
+from ..utils.clock import current_clock
+from ..utils.clock import sleep as clock_sleep
+
+
+def _scaled_fd(interval: float, grace: float) -> FailureDetectorConfig:
+    """Phi tuning proportional to the round cadence: samples arrive
+    once per round, so the window bounds scale with ``interval`` and
+    the dead-node grace period is the scenario's to choose."""
+    return FailureDetectorConfig(
+        initial_interval=timedelta(seconds=2 * interval),
+        max_interval=timedelta(seconds=4 * interval),
+        dead_node_grace_period=timedelta(seconds=grace),
+    )
+
+
+def _fleet(
+    n_nodes: int,
+    plan,
+    *,
+    interval: float,
+    grace: float,
+    seed: int,
+    marked_gc: float | None = None,
+) -> ChaosHarness:
+    overrides: dict = {"failure_detector": _scaled_fd(interval, grace)}
+    if marked_gc is not None:
+        overrides["marked_for_deletion_grace_period"] = int(marked_gc)
+    return ChaosHarness(
+        n_nodes,
+        plan,
+        cluster_id="vtime",
+        gossip_interval=interval,
+        config_overrides=overrides,
+        virtual_time=True,
+        seed=seed,
+    )
+
+
+def _forgotten(harness: ChaosHarness, victim: str) -> bool:
+    """No running peer retains ANY incarnation of ``victim`` — the
+    post-GC state (stronger than "marked dead")."""
+    return all(
+        not any(nid.name == victim for nid in
+                harness.clusters[peer].node_states_view())
+        for peer in harness.running()
+        if peer != victim
+    )
+
+
+def _false_dead_events(harness: ChaosHarness) -> int:
+    """fd transitions to dead/GC recorded by running clusters — zero on
+    a fleet where nothing actually died (the false-suspicion probe)."""
+    count = 0
+    for name in harness.running():
+        for entry in harness.clusters[name].flight_record():
+            if entry.get("kind") == "fd" and entry.get("to") in (
+                "dead",
+                "gc",
+            ):
+                count += 1
+    return count
+
+
+async def dead_node_gc_cycles(
+    *,
+    nodes: int = 8,
+    cycles: int = 2,
+    seed: int = 0,
+    interval: float = 30.0,
+    grace: float = 900.0,
+) -> dict:
+    """``cycles`` full lifecycle loops: the victim crashes, stays down
+    past the dead-node grace period (so every peer garbage-collects it
+    entirely), reboots with a bumped generation, and the fleet must
+    reconverge around the returned stranger. ~``cycles * 2.3 * grace``
+    virtual seconds, a few wall seconds."""
+    victim = "n01"
+    cycle_len = 2.3 * grace
+    down_for = 1.6 * grace  # well past grace: GC fires mid-window
+
+    def plan(h: ChaosHarness) -> FaultPlan:
+        return FaultPlan(
+            seed=seed,
+            crashes=tuple(
+                NodeCrash(
+                    nodes=h.node_set(victim),
+                    at=grace + i * cycle_len,
+                    down_for=down_for,
+                )
+                for i in range(cycles)
+            ),
+        )
+
+    gc_observed: list[bool] = []
+    reconverged: list[bool] = []
+    async with _fleet(
+        nodes, plan, interval=interval, grace=grace, seed=seed
+    ) as h:
+        await h.wait_converged(timeout=grace)
+        for i in range(cycles):
+            # Sample late in the down window, after the grace expired.
+            down_at = grace + i * cycle_len
+            while h.elapsed() < down_at + 1.5 * grace:
+                await clock_sleep(interval)
+            gc_observed.append(_forgotten(h, victim))
+            # Past the restart edge: the fleet reabsorbs the stranger.
+            while h.elapsed() < down_at + down_for + 0.1 * grace:
+                await clock_sleep(interval)
+            try:
+                await h.wait_converged(timeout=2 * grace)
+                reconverged.append(True)
+            except TimeoutError:
+                reconverged.append(False)
+        virtual_elapsed = h.elapsed()
+        incarnations = len(h.generations.get(victim, []))
+    return {
+        "scenario": "dead_node_gc_cycles",
+        "nodes": nodes,
+        "cycles": cycles,
+        "virtual_seconds": round(virtual_elapsed, 3),
+        "gc_observed": gc_observed,
+        "reconverged": reconverged,
+        "victim_incarnations": incarnations,
+        "ok": all(gc_observed) and all(reconverged)
+        and incarnations == cycles + 1,
+    }
+
+
+async def week_long_drift(
+    *,
+    nodes: int = 6,
+    days: float = 7.0,
+    seed: int = 0,
+    interval: float = 3600.0,
+) -> dict:
+    """A quiet fleet gossips for ``days`` of virtual time at a one-round
+    -per-``interval`` cadence, with one owner write per virtual day as
+    the only churn. Verdict: no false dead/GC verdicts ever, the fleet
+    is converged at the horizon, and the virtual wall really moved
+    ``days`` forward (the clock seam carried every subsystem along)."""
+    horizon = days * 86400.0
+    last_day = max(1, int(days))
+    async with _fleet(
+        nodes, None, interval=interval, grace=horizon * 10, seed=seed
+    ) as h:
+        wall0 = current_clock().wall()
+        await h.wait_converged(timeout=40 * interval)
+        written = 0
+        while h.elapsed() < horizon:
+            await clock_sleep(interval)
+            # One owner write per virtual day, stamped at midday so the
+            # final key still has half a day to replicate fleet-wide.
+            midday = (written + 0.5) * 86400.0
+            if written < last_day and h.elapsed() >= midday:
+                written += 1
+                h.clusters["n00"].set(f"day-{written}", str(written))
+        try:
+            await h.wait_converged(timeout=40 * interval)
+            converged = True
+        except TimeoutError:
+            converged = False
+        false_dead = _false_dead_events(h)
+        wall_moved = current_clock().wall() - wall0
+        last_replicated = any(
+            nid.name == "n00" and ns.get(f"day-{last_day}") is not None
+            for nid, ns in h.clusters["n01"].node_states_view().items()
+        )
+    return {
+        "scenario": "week_long_drift",
+        "nodes": nodes,
+        "virtual_days": round(wall_moved / 86400.0, 3),
+        "false_dead_events": false_dead,
+        "converged": converged,
+        "last_day_replicated": last_replicated,
+        "ok": converged
+        and false_dead == 0
+        and wall_moved >= horizon
+        and last_replicated,
+    }
+
+
+async def slow_leak_churn(
+    *,
+    nodes: int = 8,
+    hours: float = 2.0,
+    restart_every: float = 600.0,
+    seed: int = 0,
+    interval: float = 30.0,
+) -> dict:
+    """Rolling crash/restart churn for ``hours`` of virtual time: node
+    ``i % nodes`` crashes at ``i * restart_every`` and reboots two
+    rounds later with a bumped generation. The leak probe runs after a
+    post-churn quiet window long enough for phi accrual plus the
+    dead-node grace period on the LAST restart: every dead incarnation
+    must then be garbage-collected from every peer's view — the final
+    state is exactly the live fleet, churn state recycled rather than
+    accumulated. (Detector latency varies per peer, which is why the
+    probe waits for quiescence instead of modeling a tail.)
+
+    The grace period is deliberately LONG relative to the detector:
+    observers declare one death hundreds of intervals apart (phi
+    accrual depends on each one's sample history), and a grace shorter
+    than twice that spread lets a collected incarnation be re-learned
+    from a peer still advertising it — the zombie-resurrection cycle
+    the reference's 24 h grace makes impossible. ``grace/2`` must stay
+    above the spread, so both scale in interval units here."""
+    horizon = hours * 3600.0
+    grace = 300 * interval
+    n_restarts = int(horizon / restart_every) - 1
+    # Post-churn drain: worst-case dead declaration (phi with samples
+    # capped at max_interval = 4*interval accrues slowly) + full grace.
+    drain = grace + 200 * interval
+
+    def plan(h: ChaosHarness) -> FaultPlan:
+        return FaultPlan(
+            seed=seed,
+            crashes=tuple(
+                NodeCrash(
+                    nodes=h.node_set(h.names[(i + 1) % nodes]),
+                    at=(i + 1) * restart_every,
+                    down_for=2 * interval,
+                )
+                for i in range(n_restarts)
+            ),
+        )
+
+    async with _fleet(
+        nodes,
+        plan,
+        interval=interval,
+        grace=grace,
+        seed=seed,
+        marked_gc=int(grace),
+    ) as h:
+        await h.wait_converged(timeout=grace)
+        while h.elapsed() < horizon:
+            await clock_sleep(interval)
+        peak_view = max(
+            len(h.clusters[name].node_states_view())
+            for name in h.running()
+        )
+        # Quiet drain, then the exact-state probe.
+        while h.elapsed() < horizon + drain:
+            await clock_sleep(interval)
+        try:
+            await h.wait_converged(timeout=grace)
+            converged = True
+        except TimeoutError:
+            converged = False
+        total_incarnations = sum(len(g) for g in h.generations.values())
+        view_sizes = {
+            name: len(h.clusters[name].node_states_view())
+            for name in h.running()
+        }
+        recycled = all(v == nodes for v in view_sizes.values())
+        virtual_elapsed = h.elapsed()
+    return {
+        "scenario": "slow_leak_churn",
+        "nodes": nodes,
+        "virtual_hours": round(virtual_elapsed / 3600.0, 3),
+        "restarts": n_restarts,
+        "total_incarnations": total_incarnations,
+        "peak_view_size": peak_view,
+        "final_view_sizes": sorted(view_sizes.values()),
+        "converged": converged,
+        "ok": converged
+        and recycled
+        and total_incarnations >= nodes + n_restarts,
+    }

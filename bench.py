@@ -740,6 +740,20 @@ def restart_durability_bench(log, smoke: bool) -> dict | None:
     )
 
 
+def vtime_runtime_bench(log, smoke: bool) -> dict | None:
+    """The virtual-time datum (benchmarks/vtime_bench.py,
+    docs/virtual-time.md): a 200-node loopback fleet driven through a
+    full virtual hour of protocol time on the compressed clock (smoke:
+    16 nodes, ten virtual minutes), the bit-identical seeded chaos
+    replay measured rather than assumed, and the long-horizon scenario
+    pack (dead-node GC lifecycle, week-long drift, slow-leak churn) —
+    compression ratio and replay identity ride every record with the
+    gate verdicts machine-readable."""
+    return _run_benchmarks_helper(
+        "vtime_bench", "measure", log, smoke=smoke, log=log
+    )
+
+
 def overload_degradation_bench(log, smoke: bool) -> dict | None:
     """The overload/degradation datum (benchmarks/overload_bench.py,
     docs/robustness.md): a slow-peer storm (adaptive timeouts + circuit
@@ -965,6 +979,15 @@ def compact_record(result: dict, record_path: str | None = None) -> dict:
         ),
         "leave_detect_seconds": (ex.get("restart_bench") or {}).get(
             "leave_detect_seconds"
+        ),
+        # Virtual-time runtime (vtime_bench.py): how hard the
+        # compressed clock compresses a real loopback hour, and whether
+        # the seeded chaos replay stayed bit-identical this run.
+        "vtime_compression_ratio": (ex.get("vtime_bench") or {}).get(
+            "vtime_compression_ratio"
+        ),
+        "vtime_replay_identical": (ex.get("vtime_bench") or {}).get(
+            "vtime_replay_identical"
         ),
         # Propagation provenance (propagation_bench.py): the marked
         # write's measured write→99%-visibility latency, its hop-depth
@@ -1634,6 +1657,10 @@ def main() -> None:
         # Durable node state: warm-vs-cold rolling restart + leave
         # detection on real loopback fleets (restart_bench.py).
         restart_rec = restart_durability_bench(log, args.smoke)
+        # Virtual-time runtime: the compressed-clock compression ratio,
+        # bit-identical seeded replay, and the long-horizon scenario
+        # pack (vtime_bench.py, docs/virtual-time.md).
+        vtime_rec = vtime_runtime_bench(log, args.smoke)
         # Digital twin closed loop: recorded fleet trace -> replay ->
         # held-out-validated calibration -> one-compile SLO autotune
         # (twin_bench.py, docs/twin.md).
@@ -1727,6 +1754,10 @@ def main() -> None:
                 # reconvergence, leave-vs-phi detection, gate verdicts
                 # (restart_bench.py, docs/robustness.md).
                 "restart_bench": restart_rec,
+                # Virtual-time runtime: compressed-clock compression
+                # ratio, bit-identical seeded replay, long-horizon
+                # scenario verdicts (vtime_bench.py, docs/virtual-time.md).
+                "vtime_bench": vtime_rec,
                 # Digital twin: calibrated rounds/s with held-out
                 # validation error + the SLO autotuner's recommendation
                 # (twin_bench.py, docs/twin.md).

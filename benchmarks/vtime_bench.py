@@ -1,0 +1,322 @@
+"""Virtual-time runtime benchmark: the compressed-clock event loop's
+headline numbers (docs/virtual-time.md).
+
+Three arms, all on real loopback fleets under
+:func:`aiocluster_tpu.vtime.run`:
+
+- **compression** — the flagship: a 200-node fleet (smoke: 16) gossips
+  through a full virtual HOUR (smoke: ten virtual minutes) of protocol
+  time — real sockets, real frames, virtual clock. GATES: >=200 real
+  protocol instances, >=1h virtual in <=120s wall, compression >=30x
+  (smoke: the ten-minute soak lands in <10s wall — the ``make
+  vtime-smoke`` budget).
+- **replay** — the determinism contract, measured not assumed: two
+  chaos soaks (crash + partition + byzantine) with the same seed and
+  pinned ports must produce BYTE-identical flight-recorder streams and
+  twin traces; a third run with a different seed must diverge. GATE:
+  identical AND divergent, i.e. the equality is meaningful.
+- **scenarios** — the long-horizon pack
+  (:mod:`aiocluster_tpu.vtime.scenarios`): dead-node GC lifecycle
+  cycles, a week of virtual drift, hours of slow-leak churn. GATE:
+  every scenario's own ``ok`` verdict.
+
+Usage: python benchmarks/vtime_bench.py [--smoke]
+Importable: bench.py calls measure() for its BENCH record
+(``extra.vtime_bench``; compact keys ``vtime_compression_ratio``,
+``vtime_replay_identical``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+from datetime import timedelta
+from pathlib import Path
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# Flagship compression arm: one virtual hour on a 200-node fleet at a
+# 3-minute round cadence (the fleet converges in ~5 rounds and then
+# idles — exactly the regime where the clock compresses hardest).
+COMP_NODES, COMP_INTERVAL, COMP_HORIZON = 200, 180.0, 3600.0
+COMP_NODES_SMOKE, COMP_INTERVAL_SMOKE, COMP_HORIZON_SMOKE = 16, 15.0, 600.0
+SMOKE_WALL_BUDGET_S = 10.0  # the make vtime-smoke bar
+
+REPLAY_NODES, REPLAY_HORIZON = 24, 6.0
+REPLAY_NODES_SMOKE, REPLAY_HORIZON_SMOKE = 8, 4.0
+REPLAY_INTERVAL = 0.25
+
+
+def _scaled_fd(interval: float, grace: float):
+    """Phi tuning proportional to the round cadence (heartbeats arrive
+    once per round, so a 1s-tuned detector would bury a 3-minute one)."""
+    from aiocluster_tpu.core.config import FailureDetectorConfig
+
+    return FailureDetectorConfig(
+        initial_interval=timedelta(seconds=2 * interval),
+        max_interval=timedelta(seconds=4 * interval),
+        dead_node_grace_period=timedelta(seconds=grace),
+    )
+
+
+def _compression_arm(smoke: bool) -> dict:
+    from aiocluster_tpu import vtime
+    from aiocluster_tpu.faults.runner import ChaosHarness
+    from aiocluster_tpu.utils.clock import sleep as clock_sleep
+
+    nodes = COMP_NODES_SMOKE if smoke else COMP_NODES
+    interval = COMP_INTERVAL_SMOKE if smoke else COMP_INTERVAL
+    horizon = COMP_HORIZON_SMOKE if smoke else COMP_HORIZON
+
+    async def scenario():
+        h = ChaosHarness(
+            nodes,
+            None,
+            cluster_id="vtimebench",
+            gossip_interval=interval,
+            config_overrides={
+                "failure_detector": _scaled_fd(interval, horizon * 10)
+            },
+            virtual_time=True,
+            seed=1,
+        )
+        async with h:
+            converged_at = await h.wait_converged(timeout=horizon)
+            while h.elapsed() < horizon:
+                await clock_sleep(interval)
+            return converged_at, h.elapsed()
+
+    wall0 = time.monotonic()
+    converged_at, virtual = vtime.run(scenario(), seed=1)
+    wall = time.monotonic() - wall0
+    return {
+        "nodes": nodes,
+        "gossip_interval_s": interval,
+        "virtual_seconds": round(virtual, 1),
+        "wall_seconds": round(wall, 2),
+        "converged_at_virtual_s": round(converged_at, 1),
+        "compression_ratio": round(virtual / wall, 1) if wall else None,
+    }
+
+
+def _replay_soak(
+    nodes: int, horizon: float, seed: int, ports, trace_path: Path
+) -> tuple[dict, str, bytes]:
+    from aiocluster_tpu import vtime
+    from aiocluster_tpu.faults.plan import (
+        ByzantineFault,
+        FaultPlan,
+        NodeCrash,
+        Partition,
+    )
+    from aiocluster_tpu.faults.runner import ChaosHarness
+    from aiocluster_tpu.obs.trace import TraceWriter
+
+    def plan(h: ChaosHarness) -> FaultPlan:
+        return FaultPlan(
+            seed=seed + 1000,
+            partitions=(
+                Partition(
+                    n_groups=2,
+                    start=1.0,
+                    end=3.0,
+                    groups=h.name_groups(2),
+                ),
+            ),
+            crashes=(
+                NodeCrash(
+                    nodes=h.node_set("n03"), at=1.5, down_for=1.5
+                ),
+            ),
+            byzantine=(
+                ByzantineFault(
+                    kind="stale_replay",
+                    nodes=h.node_set("n05"),
+                    rate=0.3,
+                    start=0.5,
+                    end=horizon - 1.0,
+                ),
+            ),
+        )
+
+    async def scenario():
+        trace = TraceWriter(trace_path)
+        h = ChaosHarness(
+            nodes,
+            plan,
+            gossip_interval=REPLAY_INTERVAL,
+            virtual_time=True,
+            seed=seed,
+            ports=ports,
+            trace=trace,
+        )
+        async with h:
+            await asyncio.sleep(horizon)
+            dumps = {n: h.clusters[n].flight_record() for n in h.names}
+        trace.close()
+        return h._ports, dumps
+
+    ports_out, dumps = vtime.run(scenario(), seed=seed)
+    return ports_out, json.dumps(dumps, sort_keys=True), trace_path.read_bytes()
+
+
+def _replay_arm(smoke: bool) -> dict:
+    nodes = REPLAY_NODES_SMOKE if smoke else REPLAY_NODES
+    horizon = REPLAY_HORIZON_SMOKE if smoke else REPLAY_HORIZON
+    with tempfile.TemporaryDirectory(prefix="aiocluster-vtime-") as root:
+        rootp = Path(root)
+        ports, rec1, tr1 = _replay_soak(
+            nodes, horizon, 7, None, rootp / "t1.jsonl"
+        )
+        _, rec2, tr2 = _replay_soak(
+            nodes, horizon, 7, ports, rootp / "t2.jsonl"
+        )
+        _, rec3, tr3 = _replay_soak(
+            nodes, horizon, 8, ports, rootp / "t3.jsonl"
+        )
+    identical = rec1 == rec2 and tr1 == tr2
+    divergent = rec1 != rec3 and tr1 != tr3
+    return {
+        "nodes": nodes,
+        "virtual_seconds": horizon,
+        "flight_record_bytes": len(rec1),
+        "trace_bytes": len(tr1),
+        "same_seed_identical": identical,
+        "different_seed_diverges": divergent,
+        "replay_identical": identical and divergent,
+    }
+
+
+def _scenarios_arm(smoke: bool) -> dict:
+    from aiocluster_tpu import vtime
+    from aiocluster_tpu.vtime.scenarios import (
+        dead_node_gc_cycles,
+        slow_leak_churn,
+        week_long_drift,
+    )
+
+    if smoke:
+        runs = [
+            dead_node_gc_cycles(
+                nodes=6, cycles=1, interval=30.0, grace=600.0, seed=3
+            ),
+            week_long_drift(nodes=5, days=1.0, interval=1800.0, seed=3),
+            slow_leak_churn(
+                nodes=6,
+                hours=0.5,
+                restart_every=300.0,
+                interval=20.0,
+                seed=3,
+            ),
+        ]
+    else:
+        runs = [
+            dead_node_gc_cycles(),
+            week_long_drift(),
+            slow_leak_churn(),
+        ]
+    out: dict = {"scenarios": []}
+    for coro in runs:
+        wall0 = time.monotonic()
+        res = vtime.run(coro, seed=3)
+        res["wall_seconds"] = round(time.monotonic() - wall0, 2)
+        out["scenarios"].append(res)
+    out["all_ok"] = all(s["ok"] for s in out["scenarios"])
+    return out
+
+
+def measure(*, smoke: bool = False, log=lambda m: None) -> dict | None:
+    """The datum bench.py embeds (``extra.vtime_bench``). Returns None
+    instead of raising; the arms fail independently but the GATES only
+    pass on a complete record."""
+    record: dict = {"scenario": "virtual-time runtime", "smoke": smoke}
+    try:
+        record["compression"] = _compression_arm(smoke)
+        record["vtime_compression_ratio"] = record["compression"][
+            "compression_ratio"
+        ]
+        log(
+            f"compression: {record['compression']['nodes']} nodes, "
+            f"{record['compression']['virtual_seconds']}s virtual in "
+            f"{record['compression']['wall_seconds']}s wall "
+            f"({record['vtime_compression_ratio']}x)"
+        )
+    except Exception as exc:
+        log(f"vtime bench compression arm failed: {exc!r}")
+        record["compression"] = None
+    try:
+        record["replay"] = _replay_arm(smoke)
+        record["vtime_replay_identical"] = record["replay"][
+            "replay_identical"
+        ]
+        log(
+            f"replay: identical={record['replay']['same_seed_identical']} "
+            f"diverges={record['replay']['different_seed_diverges']} "
+            f"({record['replay']['trace_bytes']}B trace)"
+        )
+    except Exception as exc:
+        log(f"vtime bench replay arm failed: {exc!r}")
+        record["replay"] = None
+    try:
+        record["long_horizon"] = _scenarios_arm(smoke)
+        for s in record["long_horizon"]["scenarios"]:
+            log(
+                f"scenario {s['scenario']}: ok={s['ok']} "
+                f"({s['wall_seconds']}s wall)"
+            )
+    except Exception as exc:
+        log(f"vtime bench scenario arm failed: {exc!r}")
+        record["long_horizon"] = None
+    if record["compression"] is None and record["replay"] is None:
+        return None
+    comp = record.get("compression") or {}
+    gates = {
+        "replay_identical": bool(record.get("vtime_replay_identical")),
+        "compression_ge_30x": (
+            comp.get("compression_ratio") is not None
+            and comp["compression_ratio"] >= 30.0
+        ),
+        "scenarios_ok": bool(
+            record.get("long_horizon")
+            and record["long_horizon"]["all_ok"]
+        ),
+    }
+    if smoke:
+        gates["smoke_wall_under_budget"] = (
+            comp.get("wall_seconds") is not None
+            and comp["wall_seconds"] < SMOKE_WALL_BUDGET_S
+        )
+    else:
+        gates["nodes_ge_200"] = comp.get("nodes", 0) >= 200
+        gates["virtual_hour_in_wall_budget"] = (
+            comp.get("virtual_seconds", 0.0) >= 3600.0
+            and comp.get("wall_seconds", float("inf")) <= 120.0
+        )
+    record["gates"] = gates
+    record["gates_passed"] = all(gates.values())
+    return record
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    args = parser.parse_args()
+
+    def log(m: str) -> None:
+        print(f"[vtimebench] {m}", file=sys.stderr, flush=True)
+
+    record = measure(smoke=args.smoke, log=log)
+    print(json.dumps(record, indent=1))
+    if record is None or not record.get("gates_passed"):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

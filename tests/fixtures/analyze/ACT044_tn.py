@@ -1,0 +1,33 @@
+# analyze-domain: runtime
+"""TN: the clock-seam discipline — seam reads, the sleep wrapper, the
+yield idiom, and the loop clock. (Justified wall-clock exceptions carry
+``# noqa: ACT044 -- why``; core/identity.py's generation stamp is the
+in-repo template.)"""
+
+import asyncio
+
+from aiocluster_tpu.utils.clock import Clock, resolve_clock, utc_now
+from aiocluster_tpu.utils.clock import sleep as clock_sleep
+
+
+class Window:
+    def __init__(self, clock: Clock | None = None):
+        self._clock = resolve_clock(clock)
+        self.opened = self._clock.monotonic()  # seam read
+
+    def stamp(self):
+        return self._clock.wall()
+
+    def when(self):
+        return utc_now()  # the datetime seam
+
+    def loop_time(self):
+        # The running loop's own clock IS the virtual clock under
+        # vtime — reading it is seam-equivalent, not a raw read.
+        return asyncio.get_running_loop().time()
+
+    async def backoff(self):
+        await clock_sleep(2.0)  # the sanctioned suspension primitive
+
+    async def yield_point(self):
+        await asyncio.sleep(0)  # the yield idiom: nothing to compress

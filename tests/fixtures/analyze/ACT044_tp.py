@@ -1,0 +1,31 @@
+# analyze-domain: runtime
+"""TP: raw clock reads and timed sleeps in a clocked package — each one
+is a subsystem that stays on real time under a virtual-time soak."""
+
+import asyncio
+import time
+from datetime import datetime
+from time import monotonic
+
+
+class Window:
+    def __init__(self):
+        self.opened = time.monotonic()  # raw monotonic read
+
+    def stamp(self):
+        return time.time()  # raw wall read
+
+    def bench(self):
+        return time.perf_counter()  # raw perf read
+
+    def when(self):
+        return datetime.now()  # raw datetime read
+
+    def short(self):
+        return monotonic()  # from-imported alias still resolves
+
+    def block(self):
+        time.sleep(0.5)  # blocking sleep, doubly wrong
+
+    async def backoff(self):
+        await asyncio.sleep(2.0)  # timed wait outside the seam

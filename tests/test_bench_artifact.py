@@ -277,6 +277,35 @@ def _worst_case_result():
                 },
                 "gates_passed": True,
             },
+            "vtime_bench": {
+                "scenario": "virtual-time runtime",
+                "smoke": False,
+                "compression": {
+                    "nodes": 200,
+                    "gossip_interval_s": 180.0,
+                    "virtual_seconds": 3600.0,
+                    "wall_seconds": 67.3,
+                    "converged_at_virtual_s": 810.0,
+                    "compression_ratio": 53.5,
+                },
+                "replay": {
+                    "nodes": 24,
+                    "virtual_seconds": 6.0,
+                    "same_seed_identical": True,
+                    "different_seed_diverges": True,
+                    "replay_identical": True,
+                },
+                "vtime_compression_ratio": 53.5,
+                "vtime_replay_identical": True,
+                "gates": {
+                    "replay_identical": True,
+                    "compression_ge_30x": True,
+                    "scenarios_ok": True,
+                    "nodes_ge_200": True,
+                    "virtual_hour_in_wall_budget": True,
+                },
+                "gates_passed": True,
+            },
             "fd_kernel": False,
             "xla_path_rounds_per_sec": 43.2,
             "pallas_speedup": 1.56,
@@ -345,6 +374,12 @@ def test_stdout_line_stays_under_cap():
     assert ex["rejoin_warm_vs_cold_bytes"] == 0.0
     assert ex["rejoin_warm_rounds"] == 6.2
     assert ex["leave_detect_seconds"] == 0.012
+    # The virtual-time keys round-trip as flat scalars: how hard the
+    # compressed clock compressed the real loopback hour, and whether
+    # the seeded chaos replay stayed bit-identical (vtime_bench.py,
+    # docs/virtual-time.md).
+    assert ex["vtime_compression_ratio"] == 53.5
+    assert ex["vtime_replay_identical"] is True
     # The digital-twin keys round-trip as flat scalars: the calibrated
     # (held-out-validated) rounds/s prediction and the autotuner's
     # recommended fanout (twin_bench.py, docs/twin.md).

@@ -28,6 +28,7 @@ from aiocluster_tpu.faults import (
 )
 from aiocluster_tpu.faults.plan import _frac_of
 from aiocluster_tpu.faults.runner import ChaosHarness
+from aiocluster_tpu.utils.clock import ManualClock
 
 INTERVAL = 0.05
 
@@ -198,7 +199,7 @@ def test_injected_owner_violation_on_truncated_relay_is_caught():
             ),
         ),
     )
-    ctl = FaultController(plan, "att", clock=lambda: 1.0)
+    ctl = FaultController(plan, "att", clock=ManualClock(start=1.0))
     truncated = NodeDelta(
         node_id=_nid("victim"),
         from_version_excluded=7,

@@ -2,12 +2,21 @@
 seeded library scenarios driven against real loopback fleets and the
 batched sim. The unmarked tests together stay well under 60 s on a
 1-core CPU host (tier-1-safe); the full-scale variants are `slow`.
+
+The harness soaks run on VIRTUAL time by default (docs/virtual-time.md):
+same loopback sockets, compressed clock, seeded schedule — which is why
+the 16-node layered soak lives in tier-1 now. One soak
+(`test_chaos_flaky_links_soak`) deliberately stays on the real clock as
+the smoke pin: if the virtual conversions ever mask a real-time
+regression (a wall-clock sleep snuck into the gossip path, say), the
+pinned soak still catches it.
 """
 
 import asyncio
 
 import pytest
 
+from aiocluster_tpu import vtime
 from aiocluster_tpu.faults import (
     NodeCrash,
     FaultPlan,
@@ -21,7 +30,10 @@ from aiocluster_tpu.faults.runner import ChaosHarness
 
 async def test_chaos_flaky_links_soak():
     """ScuttleButt converges THROUGH a 25%-drop network, and live writes
-    still propagate — slower, not never (the paper's point)."""
+    still propagate — slower, not never (the paper's point).
+
+    REAL-clock smoke pin: this soak intentionally does not use virtual
+    time (module docstring)."""
     plan = flaky_links(0.25, seed=1)
     async with ChaosHarness(3, plan, gossip_interval=0.05) as h:
         await h.wait_converged(timeout=20.0)
@@ -46,55 +58,72 @@ async def test_chaos_flaky_links_soak():
     assert counts.get("drop", 0) > 0  # the chaos actually bit
 
 
-async def test_chaos_split_brain_heals():
+def test_chaos_split_brain_heals():
     """2-way split on a 6-node fleet: islands stay mutually blind while
-    the cut holds, then reconverge after heal."""
+    the cut holds, then reconverge after heal. Virtual time: the heal
+    window and reconvergence compress to milliseconds of wall clock."""
     heal = 1.2
-    h = ChaosHarness(
-        6,
-        lambda h: split_brain(2, start=0.0, heal=heal, groups=h.name_groups(2)),
-        gossip_interval=0.05,
-    )
-    groups = h.plan.partitions[0].groups
-    async with h:
-        await asyncio.sleep(heal - 0.2)
-        assert h.cross_group_blind(groups)  # still cut
-        assert not h.converged()
-        await h.wait_converged(timeout=20.0)
-        assert h.fault_counts().get("partition", 0) > 0
+
+    async def soak():
+        h = ChaosHarness(
+            6,
+            lambda h: split_brain(
+                2, start=0.0, heal=heal, groups=h.name_groups(2)
+            ),
+            gossip_interval=0.05,
+            virtual_time=True,
+            seed=11,
+        )
+        groups = h.plan.partitions[0].groups
+        async with h:
+            await asyncio.sleep(heal - 0.2)
+            assert h.cross_group_blind(groups)  # still cut
+            assert not h.converged()
+            await h.wait_converged(timeout=20.0)
+            assert h.fault_counts().get("partition", 0) > 0
+
+    vtime.run(soak(), seed=11)
 
 
-async def test_chaos_crash_restart_bumps_generation():
+def test_chaos_crash_restart_bumps_generation():
     """A crashed-and-restarted node comes back as a NEW incarnation
     (higher generation) and the fleet reconverges on its fresh state —
-    newer-generation-wins exercised end to end."""
-    h = ChaosHarness(3, None, gossip_interval=0.05)
-    # Crash n02 from t=0.8 for 0.8 s; label both ways (name + addr).
-    h.plan = FaultPlan(
-        crashes=(NodeCrash(nodes=h.node_set("n02"), at=0.8, down_for=0.8),)
-    )
-    async with h:
-        await h.wait_converged(timeout=20.0)
-        await asyncio.sleep(1.0)  # into the crash window
-        assert "n02" in h._crashed or len(h.generations["n02"]) > 1
+    newer-generation-wins exercised end to end, on the virtual clock."""
 
-        def restarted_state_won() -> bool:
-            gens = h.generations["n02"]
-            if len(gens) < 2:
-                return False
-            observer = h.clusters["n00"]
-            return any(
-                n.name == "n02" and n.generation_id == gens[-1]
-                for n in observer.snapshot().node_states
+    async def soak():
+        h = ChaosHarness(
+            3, None, gossip_interval=0.05, virtual_time=True, seed=12
+        )
+        # Crash n02 from t=0.8 for 0.8 s; label both ways (name + addr).
+        h.plan = FaultPlan(
+            crashes=(
+                NodeCrash(nodes=h.node_set("n02"), at=0.8, down_for=0.8),
             )
+        )
+        async with h:
+            await h.wait_converged(timeout=20.0)
+            await asyncio.sleep(1.0)  # into the crash window
+            assert "n02" in h._crashed or len(h.generations["n02"]) > 1
 
-        deadline = asyncio.get_event_loop().time() + 20.0
-        while not restarted_state_won():
-            assert asyncio.get_event_loop().time() < deadline
-            await asyncio.sleep(0.05)
-        await h.wait_converged(timeout=20.0)
-        gens = h.generations["n02"]
-        assert len(gens) == 2 and gens[1] > gens[0]
+            def restarted_state_won() -> bool:
+                gens = h.generations["n02"]
+                if len(gens) < 2:
+                    return False
+                observer = h.clusters["n00"]
+                return any(
+                    n.name == "n02" and n.generation_id == gens[-1]
+                    for n in observer.snapshot().node_states
+                )
+
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while not restarted_state_won():
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            await h.wait_converged(timeout=20.0)
+            gens = h.generations["n02"]
+            assert len(gens) == 2 and gens[1] > gens[0]
+
+    vtime.run(soak(), seed=12)
 
 
 # -- sim soak (tier-1) ---------------------------------------------------------
@@ -125,7 +154,7 @@ def test_chaos_sim_flaky_links_converges():
     assert again.run_until_converged(max_rounds=400) == r_flaky
 
 
-# -- full-scale variants (slow) ------------------------------------------------
+# -- full-scale variants (sim ones slow; the runtime soak went virtual) --------
 
 
 @pytest.mark.slow
@@ -176,25 +205,31 @@ def test_sim_split_brain_at_10k():
     assert record["sim_fault_reconverge_rounds"] > 0
 
 
-@pytest.mark.slow
-async def test_chaos_16_node_runtime_soak():
+def test_chaos_16_node_runtime_soak():
     """The fault bench's runtime arm shape as a soak: 16 nodes, 3-way
-    split, flaky links layered on top, full reconvergence."""
+    split, flaky links layered on top, full reconvergence. Formerly a
+    `slow` wall-clock soak; the virtual clock moved it into tier-1."""
     heal = 2.0
-    h = ChaosHarness(
-        16,
-        lambda h: FaultPlan(
+
+    async def soak():
+        h = ChaosHarness(
+            16,
+            lambda h: FaultPlan(
+                seed=5,
+                links=flaky_links(0.15, seed=5).links,
+                partitions=split_brain(
+                    3, start=0.0, heal=heal, groups=h.name_groups(3)
+                ).partitions,
+            ),
+            gossip_interval=0.05,
+            virtual_time=True,
             seed=5,
-            links=flaky_links(0.15, seed=5).links,
-            partitions=split_brain(
-                3, start=0.0, heal=heal, groups=h.name_groups(3)
-            ).partitions,
-        ),
-        gossip_interval=0.05,
-    )
-    async with h:
-        await asyncio.sleep(heal)
-        await h.wait_converged(timeout=40.0)
-        counts = h.fault_counts()
+        )
+        async with h:
+            await asyncio.sleep(heal)
+            await h.wait_converged(timeout=40.0)
+            return h.fault_counts()
+
+    counts = vtime.run(soak(), seed=5)
     assert counts.get("partition", 0) > 0
     assert counts.get("drop", 0) > 0

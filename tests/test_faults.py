@@ -22,6 +22,7 @@ from aiocluster_tpu.faults import (
 )
 from aiocluster_tpu.faults.runtime import FaultController
 from aiocluster_tpu.obs import MetricsRegistry
+from aiocluster_tpu.utils.clock import ManualClock
 
 # -- plan model ----------------------------------------------------------------
 
@@ -84,7 +85,7 @@ def test_controller_schedule_is_deterministic():
     ops = [("b", "write"), ("b", "read"), ("c", "connect")] * 40
     streams = []
     for _ in range(2):
-        ctl = FaultController(plan, "a", clock=lambda: 0.0)
+        ctl = FaultController(plan, "a", clock=ManualClock())
         streams.append([ctl.decide(dst, op).action for dst, op in ops])
     assert streams[0] == streams[1]
     assert "drop" in streams[0] and "ok" in streams[0]  # actually flaky
@@ -92,49 +93,49 @@ def test_controller_schedule_is_deterministic():
 
 def test_controller_different_seed_different_schedule():
     ops = [("b", "write")] * 64
-    a = FaultController(flaky_links(0.3, seed=1), "a", clock=lambda: 0.0)
-    b = FaultController(flaky_links(0.3, seed=2), "a", clock=lambda: 0.0)
+    a = FaultController(flaky_links(0.3, seed=1), "a", clock=ManualClock())
+    b = FaultController(flaky_links(0.3, seed=2), "a", clock=ManualClock())
     assert [a.decide(*o).action for o in ops] != [
         b.decide(*o).action for o in ops
     ]
 
 
 def test_controller_windows_follow_injected_clock():
-    now = {"t": 0.0}
+    clk = ManualClock()
     plan = FaultPlan(links=(LinkFault(drop=1.0, start=5.0, end=10.0),))
-    ctl = FaultController(plan, "a", clock=lambda: now["t"])
+    ctl = FaultController(plan, "a", clock=clk)
     ctl.start()
     assert ctl.decide("b", "write").action == "ok"
-    now["t"] = 7.0
+    clk.set_time(7.0)
     assert ctl.decide("b", "write").action == "drop"
-    now["t"] = 10.0
+    clk.set_time(10.0)
     assert ctl.decide("b", "write").action == "ok"  # healed
 
 
 def test_controller_partition_and_crash_decisions():
-    now = {"t": 0.0}
+    clk = ManualClock()
     plan = FaultPlan(
         partitions=(Partition(n_groups=2, start=1.0, end=2.0, groups=(("a",), ("b",))),),
         crashes=(NodeCrash(nodes=NodeSet(names=("b",)), at=3.0, down_for=1.0),),
     )
     reg = MetricsRegistry()
-    ctl = FaultController(plan, "a", metrics=reg, clock=lambda: now["t"])
+    ctl = FaultController(plan, "a", metrics=reg, clock=clk)
     ctl.start()
     assert ctl.decide("b", "connect").action == "ok"
-    now["t"] = 1.5
+    clk.set_time(1.5)
     assert ctl.decide("b", "connect").action == "partition"
     assert ctl.partitions_active() == 1
-    now["t"] = 2.5
+    clk.set_time(2.5)
     assert ctl.decide("b", "connect").action == "ok"
     assert ctl.partitions_active() == 0
-    now["t"] = 3.5  # peer down
+    clk.set_time(3.5)  # peer down
     assert ctl.decide("b", "connect").action == "down"
-    now["t"] = 4.5  # restarted
+    clk.set_time(4.5)  # restarted
     assert ctl.decide("b", "connect").action == "ok"
 
 
 def test_controller_apply_raises_the_right_exceptions():
-    now = {"t": 0.0}
+    clk = ManualClock()
     plan = FaultPlan(
         links=(
             LinkFault(drop=1.0, start=0.0, end=1.0),
@@ -142,13 +143,13 @@ def test_controller_apply_raises_the_right_exceptions():
         ),
     )
     reg = MetricsRegistry()
-    ctl = FaultController(plan, "a", metrics=reg, clock=lambda: now["t"])
+    ctl = FaultController(plan, "a", metrics=reg, clock=clk)
     ctl.start()
     with pytest.raises(ConnectionRefusedError):
         ctl.apply("b", "connect")  # a dropped connect is refused
     with pytest.raises(ConnectionResetError):
         ctl.apply("b", "write")  # a dropped write is a reset
-    now["t"] = 1.5
+    clk.set_time(1.5)
     with pytest.raises(asyncio.IncompleteReadError):
         ctl.apply("b", "read")  # mid-handshake EOF
     assert ctl.apply("b", "write").duplicate is False  # eof never hits writes
@@ -333,7 +334,7 @@ def test_partition_explicit_groups_fail_closed():
     plan = FaultPlan(
         partitions=(Partition(n_groups=2, groups=(("a",), ("b",)),),),
     )
-    ctl = FaultController(plan, "a", clock=lambda: 0.0)
+    ctl = FaultController(plan, "a", clock=ManualClock())
     ctl.start()
     assert ctl.decide("b", "connect").action == "partition"  # cross-group
     assert ctl.decide("127.0.0.1:9999", "connect").action == "partition"

@@ -13,6 +13,7 @@ import pytest
 
 from aiocluster_tpu.faults.plan import FaultPlan, _frac_of
 from aiocluster_tpu.models import Heterogeneity
+from aiocluster_tpu.utils.clock import ManualClock
 from aiocluster_tpu.sim.config import SimConfig
 from aiocluster_tpu.sim.simulator import Simulator
 
@@ -281,7 +282,7 @@ def test_runtime_wan_builds_fault_controller():
     zone0 = [n for n in names if het.zone_of_name(n) == 0]
     zone1 = [n for n in names if het.zone_of_name(n) == 1]
     assert zone0 and zone1
-    ctl = FaultController(plan, zone0[0], clock=lambda: 0.0)
+    ctl = FaultController(plan, zone0[0], clock=ManualClock())
     ctl.start(0.0)
     cross = ctl.decide(zone1[0], "write", t=1.0)
     intra = ctl.decide(zone0[1], "write", t=1.0)

@@ -148,14 +148,18 @@ async def test_wrong_cluster_id_never_joins(free_port_factory):
         assert all(n.name != "one" for n in bad.snapshot().node_states)
 
 
-async def test_dead_node_lifecycle_over_sockets(free_port_factory):
+def test_dead_node_lifecycle_over_sockets(free_port_factory):
     """The socket backend's full dead-node story (reference
     failure_detector.py:108-128 + server.py:618-620): a stopped node goes
     live -> dead at its peers via phi, and after the (shortened) grace
-    period its state is garbage-collected from their cluster state."""
+    period its state is garbage-collected from their cluster state.
+
+    Virtual time: phi accrual and BOTH grace stages are pure clock
+    schedule, so the whole lifecycle compresses to milliseconds (the
+    suite's other socket tests stay on the real clock as pins)."""
     from datetime import timedelta
 
-    from aiocluster_tpu import FailureDetectorConfig
+    from aiocluster_tpu import FailureDetectorConfig, vtime
 
     fd = FailureDetectorConfig(
         # Tight windows so detection and both grace stages fit in seconds.
@@ -164,11 +168,18 @@ async def test_dead_node_lifecycle_over_sockets(free_port_factory):
         dead_node_grace_period=timedelta(seconds=2.0),
     )
     p1, p2, p3 = (free_port_factory() for _ in range(3))
-    c1 = Cluster(make_config("a", p1, [p2, p3], failure_detector=fd),
-                 initial_key_values={"ka": "va"})
-    c2 = Cluster(make_config("b", p2, [p1, p3], failure_detector=fd))
-    c3 = Cluster(make_config("c", p3, [p1, p2], failure_detector=fd))
 
+    async def lifecycle():
+        c1 = Cluster(make_config("a", p1, [p2, p3], failure_detector=fd),
+                     initial_key_values={"ka": "va"})
+        c2 = Cluster(make_config("b", p2, [p1, p3], failure_detector=fd))
+        c3 = Cluster(make_config("c", p3, [p1, p2], failure_detector=fd))
+        await _lifecycle_body(c1, c2, c3)
+
+    vtime.run(lifecycle(), seed=9)
+
+
+async def _lifecycle_body(c1, c2, c3):
     # close() is idempotent, so the explicit mid-test close composes with
     # the context manager's unconditional cleanup on any failure path.
     async with c1, c2, c3:

@@ -21,6 +21,7 @@ from random import Random
 import numpy as np
 import pytest
 
+from aiocluster_tpu import vtime
 from aiocluster_tpu.faults import FaultPlan, LinkFault, NodeSet
 from aiocluster_tpu.faults.plan import _frac_of
 from aiocluster_tpu.faults.runner import ChaosHarness
@@ -34,6 +35,7 @@ from aiocluster_tpu.runtime.health import (
     PeerRtt,
 )
 from aiocluster_tpu.runtime.peers import select_gossip_targets
+from aiocluster_tpu.utils.clock import ManualClock
 
 INTERVAL = 0.05
 ADDR = ("10.0.0.1", 9000)
@@ -79,7 +81,7 @@ def test_adaptive_flag_gates_timeout_not_sampling():
 
 
 def _tracker(reg=None, **kw):
-    now = {"t": 0.0}
+    clk = ManualClock()
     tracker = HealthTracker(
         adaptive=False,
         breaker=True,
@@ -87,11 +89,11 @@ def _tracker(reg=None, **kw):
         base_backoff=1.0,
         max_backoff=8.0,
         rng=Random(7),
-        clock=lambda: now["t"],
+        clock=clk,
         metrics=reg,
         **kw,
     )
-    return tracker, now
+    return tracker, clk
 
 
 def _transitions(reg: MetricsRegistry) -> dict[str, int]:
@@ -104,7 +106,7 @@ def _transitions(reg: MetricsRegistry) -> dict[str, int]:
 
 def test_breaker_exact_transitions_under_injected_clock():
     reg = MetricsRegistry()
-    tracker, now = _tracker(reg)
+    tracker, clk = _tracker(reg)
 
     # Two failures: still closed, nothing quarantined.
     tracker.record_failure(ADDR)
@@ -121,9 +123,9 @@ def test_breaker_exact_transitions_under_injected_clock():
     assert tracker.open_peer_labels() == ["10.0.0.1:9000"]
 
     # Inside the window: quarantined. At expiry: released for a probe.
-    now["t"] = b.open_until - 1e-6
+    clk.set_time(b.open_until - 1e-6)
     assert tracker.quarantined_peers() == {ADDR}
-    now["t"] = b.open_until
+    clk.set_time(b.open_until)
     assert tracker.quarantined_peers() == set()
 
     # The next attempt IS the half-open probe — and a probe in flight
@@ -140,7 +142,7 @@ def test_breaker_exact_transitions_under_injected_clock():
     assert b.opens == 2
 
     # Heal: expire, probe, success -> closed, failure streak reset.
-    now["t"] = b.open_until
+    clk.set_time(b.open_until)
     tracker.begin_attempt(ADDR)
     tracker.record_success(ADDR)
     assert tracker.breaker_state(ADDR) == CLOSED
@@ -158,16 +160,16 @@ def test_half_open_probe_window_lapses_instead_of_sticking():
     the peer forever: the probe holds the quarantine for one
     base-backoff window, then the next draw re-probes."""
     reg = MetricsRegistry()
-    tracker, now = _tracker(reg)
+    tracker, clk = _tracker(reg)
     for _ in range(3):
         tracker.record_failure(ADDR)
     b = tracker._breakers[ADDR]
-    now["t"] = b.open_until
+    clk.set_time(b.open_until)
     tracker.begin_attempt(ADDR)
     assert tracker.breaker_state(ADDR) == HALF_OPEN
     assert tracker.quarantined_peers() == {ADDR}
     # The probe never reports. Its window (one base backoff) lapses:
-    now["t"] = b.open_until
+    clk.set_time(b.open_until)
     assert tracker.quarantined_peers() == set()
     # The next attempt is a fresh probe — same state, a new window,
     # NO extra half_open transition counted.
@@ -180,13 +182,13 @@ def test_half_open_probe_window_lapses_instead_of_sticking():
 
 
 def test_breaker_backoff_capped_at_max():
-    tracker, now = _tracker()
+    tracker, clk = _tracker()
     for _ in range(40):  # repeated probe failures grow the window
         for _ in range(3):
             tracker.record_failure(ADDR)
         b = tracker._breakers[ADDR]
         assert b.backoff <= 8.0
-        now["t"] = b.open_until
+        clk.set_time(b.open_until)
         tracker.begin_attempt(ADDR)
 
 
@@ -531,15 +533,22 @@ def _sim_verdict(plan, max_rounds=200):
     return Simulator(cfg, seed=3).run_until_converged(max_rounds=max_rounds)
 
 
-async def _runtime_verdict(plan, n=6, wait_s=6.0) -> bool:
+def _runtime_verdict(plan, n=6, wait_s=6.0) -> bool:
     # Breakers + adaptive timeouts are DEFAULT-ON: the runtime arm is
-    # the shipped posture, not a tuned one.
-    async with ChaosHarness(n, plan, gossip_interval=INTERVAL) as h:
-        try:
-            await h.wait_converged(timeout=wait_s)
-            return True
-        except TimeoutError:
-            return False
+    # the shipped posture, not a tuned one. Virtual time: the hostile
+    # arm used to wait out its whole timeout on the wall clock.
+    async def arm() -> bool:
+        h = ChaosHarness(
+            n, plan, gossip_interval=INTERVAL, virtual_time=True, seed=3
+        )
+        async with h:
+            try:
+                await h.wait_converged(timeout=wait_s)
+                return True
+            except TimeoutError:
+                return False
+
+    return vtime.run(arm(), seed=3)
 
 
 def _slow_names(n: int) -> list[str]:
@@ -550,7 +559,7 @@ def _slow_names(n: int) -> list[str]:
     ]
 
 
-async def test_differential_slow_third_hostile_neither_converges():
+def test_differential_slow_third_hostile_neither_converges():
     """The same un-healed slow-third plan on both backends: the slow
     set is unreachable in both directions, so full convergence is
     impossible — runtime (breakers quarantining) and sim (masks) agree
@@ -559,16 +568,14 @@ async def test_differential_slow_third_hostile_neither_converges():
     slow = _slow_names(6)
     assert slow and len(slow) < 6, slow  # the fleet has both classes
     assert _sim_verdict(plan) is None
-    assert await _runtime_verdict(plan) is False
+    assert _runtime_verdict(plan) is False
 
 
-async def test_differential_slow_third_healed_both_reconverge():
+def test_differential_slow_third_healed_both_reconverge():
     """A healing window: the breakers' half-open probes readmit the
     slow set on the runtime, the mask lifts in the sim — the SAME
     verdict (reconverges after the heal) on both backends."""
     sim_r = _sim_verdict(slow_third(delay=30.0, end=20.0), max_rounds=240)
     assert sim_r is not None and sim_r > 20
-    run_conv = await _runtime_verdict(
-        slow_third(delay=30.0, end=2.0), wait_s=20.0
-    )
+    run_conv = _runtime_verdict(slow_third(delay=30.0, end=2.0), wait_s=20.0)
     assert run_conv is True

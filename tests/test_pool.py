@@ -11,6 +11,7 @@ from conftest import wait_for
 from aiocluster_tpu import Cluster, Config, NodeId
 from aiocluster_tpu.obs import MetricsRegistry
 from aiocluster_tpu.runtime.pool import ConnectionPool
+from aiocluster_tpu.utils.clock import ManualClock
 
 
 # -- pool units (fake transport) ----------------------------------------------
@@ -267,9 +268,9 @@ async def test_pool_retry_under_injected_eof_and_refused_storm(
     c2 = _mk_cluster("two", p2, p1, metrics=MetricsRegistry())
 
     # Deterministic plan time: drive the controller off a fake clock.
-    now = {"t": 0.0}
+    clk = ManualClock()
     ctl = c1.fault_controller
-    ctl._clock = lambda: now["t"]
+    ctl._clock = clk
     ctl._t0 = 0.0
 
     # Boot only the servers (the handshake_bench pattern): every
@@ -295,7 +296,7 @@ async def test_pool_retry_under_injected_eof_and_refused_storm(
 
         # Phase 1 (EOF window): reused conn EOFs mid-handshake -> one
         # reconnect; the fresh retry EOFs too -> NOT retried again.
-        now["t"] = 15.0
+        clk.set_time(15.0)
         before = events()
         await c1._gossip_with("127.0.0.1", p2, "live")
         assert delta(before, events()) == {
@@ -307,7 +308,7 @@ async def test_pool_retry_under_injected_eof_and_refused_storm(
         assert c1._pool.idle_connections() == 0
 
         # Phase 2 (healed, t=25): recovery, conn pooled again.
-        now["t"] = 25.0
+        clk.set_time(25.0)
         before = events()
         await c1._gossip_with("127.0.0.1", p2, "live")
         assert delta(before, events()) == {"miss": 1}
@@ -315,7 +316,7 @@ async def test_pool_retry_under_injected_eof_and_refused_storm(
 
         # Phase 3 (refused storm): the reused conn's write is reset ->
         # one reconnect; the redial is refused at connect -> give up.
-        now["t"] = 35.0
+        clk.set_time(35.0)
         before = events()
         await c1._gossip_with("127.0.0.1", p2, "live")
         assert delta(before, events()) == {
@@ -330,7 +331,7 @@ async def test_pool_retry_under_injected_eof_and_refused_storm(
         assert delta(before, events()) == {"miss": 1}
 
         # Phase 4 (healed): the pool recovers from the storm.
-        now["t"] = 50.0
+        clk.set_time(50.0)
         before = events()
         await c1._gossip_with("127.0.0.1", p2, "live")
         assert delta(before, events()) == {"miss": 1}
@@ -413,9 +414,9 @@ async def test_breaker_storm_exact_transitions_and_zero_redials_while_open(
     c1 = _mk_cluster("one", p1, p2, metrics=r1, fault_plan=plan)
     c2 = _mk_cluster("two", p2, p1, metrics=MetricsRegistry())
 
-    now = {"t": 0.0}
+    clk = ManualClock()
     ctl = c1.fault_controller
-    ctl._clock = lambda: now["t"]
+    ctl._clock = clk
     ctl._t0 = 0.0
     # The breaker under test: deterministic clock + seeded backoff rng,
     # its own registry so transition counts start at zero.
@@ -427,7 +428,7 @@ async def test_breaker_storm_exact_transitions_and_zero_redials_while_open(
         base_backoff=1.0,
         max_backoff=8.0,
         rng=Random(7),
-        clock=lambda: now["t"],
+        clock=clk,
         metrics=r_health,
     )
     c1._health = health
@@ -454,7 +455,7 @@ async def test_breaker_storm_exact_transitions_and_zero_redials_while_open(
         # Storm (t=15): handshake 1 loses the pooled conn (reconnect
         # consumed, redial refused), handshakes 2-3 are fresh refused
         # dials -> the third consecutive failure OPENS the breaker.
-        now["t"] = 15.0
+        clk.set_time(15.0)
         await c1._gossip_with("127.0.0.1", p2, "live")
         assert health.breaker_state(addr) == CLOSED
         await c1._gossip_with("127.0.0.1", p2, "live")
@@ -485,7 +486,7 @@ async def test_breaker_storm_exact_transitions_and_zero_redials_while_open(
 
         # Backoff expiry, storm still on: the next handshake is the
         # half-open probe; its failure re-opens with a grown window.
-        now["t"] = b.open_until
+        clk.set_time(b.open_until)
         assert health.quarantined_peers() == set()
         prev_backoff = b.backoff
         await c1._gossip_with("127.0.0.1", p2, "live")
@@ -496,7 +497,7 @@ async def test_breaker_storm_exact_transitions_and_zero_redials_while_open(
 
         # Healed (t=25 > end) and past the window: probe succeeds,
         # breaker closes, the peer pools a live connection again.
-        now["t"] = max(25.0, b.open_until)
+        clk.set_time(max(25.0, b.open_until))
         before = dict(_pool_events(r1))
         await c1._gossip_with("127.0.0.1", p2, "live")
         assert health.breaker_state(addr) == CLOSED

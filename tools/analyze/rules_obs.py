@@ -15,6 +15,12 @@ way: ``__fleet:``-prefixed keys are the contract boundary between
 application data and gossip-borne self-telemetry (obs/fleet.py), and
 every consumer must import the constants rather than respell the
 prefix — a drifted literal silently splits the keyspace.
+
+ACT044 guards the clock seam (docs/virtual-time.md): timed behavior in
+the clocked packages reads ``utils.clock``, never ``time.*`` /
+``datetime.now`` / bare ``asyncio.sleep``, so one virtual loop
+compresses every window together and seeded chaos replays
+bit-identically.
 """
 
 from __future__ import annotations
@@ -220,3 +226,92 @@ def check_reserved_prefix_literal(ctx: FileContext):
             "TELEMETRY_KEY from aiocluster_tpu.obs.fleet instead (the "
             "reserved keyspace has one defining module)",
         )
+
+
+# -- ACT044: the clock seam (docs/virtual-time.md) ---------------------------
+
+# Packages whose time reads must flow through the utils.clock seam so a
+# virtual loop compresses ALL of them together: one raw read is one
+# subsystem whose windows silently stay on real time under a vtime soak
+# (phi watches a frozen wall; TTLs never expire; replay diverges).
+_CLOCK_DOMAINS = {"runtime", "serve", "faults", "core"}
+
+# Raw clock reads / blocking sleeps banned in the clocked packages.
+# datetime.date is date.today's origin under ``from datetime import date``.
+_RAW_CLOCK_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.sleep",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+}
+
+# The sanctioned replacements, named in the finding message.
+_CLOCK_SEAM_HINT = (
+    "route it through the clock seam (aiocluster_tpu.utils.clock: "
+    "resolve_clock/current_clock for reads, utc_now for datetimes, "
+    "utils.clock.sleep for suspension) so virtual time compresses it "
+    "(docs/virtual-time.md)"
+)
+
+
+def _is_literal_zero(node: ast.expr | None) -> bool:
+    """The ``await asyncio.sleep(0)`` yield idiom — a scheduling point,
+    not a timed wait; virtual time has nothing to compress there."""
+    return (
+        isinstance(node, ast.Constant)
+        and type(node.value) in (int, float)
+        and node.value == 0
+    )
+
+
+@rule(
+    "ACT044",
+    "raw-clock-or-sleep",
+    "raw clock read or asyncio.sleep outside the clock seam",
+)
+def check_raw_clock_or_sleep(ctx: FileContext):
+    """The virtual-time contract (docs/virtual-time.md): every timed
+    behavior in the clocked packages — phi windows, breaker backoff,
+    TTLs, fault windows, idle eviction, trace stamps — reads the ONE
+    Clock seam, so ``vtime.VirtualClockLoop`` compresses them together
+    and a seeded chaos soak replays bit-identically. A raw
+    ``time.monotonic()``/``time.time()``/``datetime.now()`` read or a
+    direct ``asyncio.sleep(dt)`` reintroduces real time into exactly
+    one subsystem, which then drifts against the compressed rest —
+    the kind of bug only a week-long soak exposes. ``asyncio.sleep(0)``
+    (the yield idiom) is exempt; deliberate wall reads justify
+    themselves with ``# noqa: ACT044 -- why`` (core/identity.py's
+    generation stamp — wall-clock BY CONTRACT across restarts — is the
+    template)."""
+    if ctx.tree is None or not (_CLOCK_DOMAINS & ctx.domains):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        origin = ctx.resolve(node.func)
+        if origin in _RAW_CLOCK_CALLS:
+            yield ctx.finding(
+                node,
+                "ACT044",
+                f"raw clock call {origin}() in a clocked package — "
+                + _CLOCK_SEAM_HINT,
+            )
+        elif origin == "asyncio.sleep":
+            first = node.args[0] if node.args else None
+            if _is_literal_zero(first):
+                continue
+            yield ctx.finding(
+                node,
+                "ACT044",
+                "asyncio.sleep(...) with a nonzero delay in a clocked "
+                "package — " + _CLOCK_SEAM_HINT.replace(
+                    "route it", "route the wait"
+                ),
+            )
